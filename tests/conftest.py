@@ -1,0 +1,34 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §5).
+
+The reference tested "distributed" behavior on Flink's in-process MiniCluster;
+our equivalent is a single-process 8-device CPU JAX runtime — sharding tests
+exercise real ``Mesh``/``shard_map`` code paths without TPU hardware. Must run
+before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+import sys
+
+# Make the repo root importable regardless of how pytest is invoked.
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def assets_dir(tmp_path_factory):
+    """Generated PMML fixtures shared across the test session."""
+    from assets.generate import generate_all
+
+    out = tmp_path_factory.mktemp("pmml_assets")
+    generate_all(str(out))
+    return out

@@ -1,0 +1,74 @@
+"""Unit tests for the Prediction ADT and control/identity models.
+
+Mirrors the reference's ``PredictionSpec`` and model specs (SURVEY.md §5).
+"""
+
+import math
+
+import pytest
+
+from flink_jpmml_tpu.models.control import AddMessage, DelMessage
+from flink_jpmml_tpu.models.core import ModelId
+from flink_jpmml_tpu.models.prediction import (
+    EmptyScore,
+    Prediction,
+    Score,
+    decode_batch,
+)
+
+
+class TestPrediction:
+    def test_of_value(self):
+        p = Prediction.of(3.5)
+        assert not p.is_empty
+        assert p.score == Score(3.5)
+        assert p.score.get_or_else(0.0) == 3.5
+
+    def test_of_nan_is_empty(self):
+        p = Prediction.of(float("nan"))
+        assert p.is_empty
+        assert isinstance(p.score, EmptyScore)
+        assert p.score.get_or_else(-1.0) == -1.0
+
+    def test_of_none_is_empty(self):
+        assert Prediction.of(None).is_empty
+
+    def test_decode_batch_masks_invalid_lanes(self):
+        preds = decode_batch(
+            values=[1.0, 2.0, float("nan"), 4.0],
+            valid=[True, False, True, True],
+        )
+        assert [p.is_empty for p in preds] == [False, True, True, False]
+        assert preds[0].score == Score(1.0)
+        assert preds[3].score == Score(4.0)
+
+    def test_decode_batch_with_labels(self):
+        preds = decode_batch(
+            values=[0.0, 1.0],
+            valid=[True, True],
+            labels=["setosa", "virginica"],
+            probabilities=[{"setosa": 0.9}, {"virginica": 0.8}],
+        )
+        assert preds[0].target.label == "setosa"
+        assert math.isclose(preds[1].target.probabilities["virginica"], 0.8)
+
+
+class TestModelId:
+    def test_key_roundtrip(self):
+        mid = ModelId("kmeans-iris", 3)
+        assert ModelId.from_key(mid.key()) == mid
+
+    def test_rejects_separator_in_name(self):
+        with pytest.raises(ValueError):
+            ModelId("bad_name", 1)
+
+    def test_rejects_negative_version(self):
+        with pytest.raises(ValueError):
+            ModelId("m", -1)
+
+
+class TestControlMessages:
+    def test_add_del_model_id(self):
+        add = AddMessage("m", 1, "/tmp/m.pmml", 10.0)
+        rm = DelMessage("m", 1, 11.0)
+        assert add.model_id == rm.model_id == ModelId("m", 1)
