@@ -1,0 +1,4 @@
+"""Generated PMML fixtures (reference parity: ``flink-jpmml-assets``,
+SURVEY.md §3 row D1). The reference shipped static ``.pmml`` resources; the
+mount was empty, so we *generate* deterministic fixtures instead
+(SURVEY.md §8 step 7)."""

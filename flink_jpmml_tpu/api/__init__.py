@@ -1,0 +1,1 @@
+"""User-facing API surface (SURVEY.md §8 step 6): Stream.evaluate & readers."""

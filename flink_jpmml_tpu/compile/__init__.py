@@ -1,0 +1,1 @@
+"""PMML IR → JAX lowering (SURVEY.md §8 step 2): the heart of the framework."""

@@ -1,0 +1,1 @@
+"""Device mesh, sharding and keyed partitioning (SURVEY.md §8 step 4)."""

@@ -1,0 +1,634 @@
+"""Reference interpreter: slow, per-record, exact PMML semantics.
+
+This module is the framework's *semantic oracle*. The reference delegated
+per-record evaluation to JPMML-Evaluator (SURVEY.md §2 layer EXT-B, JVM-only);
+we cannot run a JVM here, so golden tests diff the fast JAX lowering
+(:mod:`flink_jpmml_tpu.compile`) against this deliberately simple Python
+interpreter instead (SURVEY.md §5 "golden outputs"). It is intentionally the
+*opposite* of the TPU design — per-record, branchy, dict-based — so that a
+bug in the vectorised lowering and a bug here are unlikely to coincide.
+
+Missing-value semantics follow DMG PMML 4.x:
+- predicates over missing fields evaluate to UNKNOWN (``None`` here);
+- TreeModel ``missingValueStrategy`` ∈ {none, defaultChild, lastPrediction,
+  nullPrediction} decides what UNKNOWN does during descent;
+- RegressionModel: a missing *numeric* predictor makes the table value
+  missing; a missing *categorical* predictor contributes 0;
+- MiningModel: a missing segment result makes aggregate results missing
+  (sum/average/weightedAverage), is excluded from votes, and propagates
+  through modelChain.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Mapping, Optional, Tuple, Union
+
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+Value = Union[float, str, None]
+Record = Mapping[str, Value]
+
+
+@dataclass
+class EvalResult:
+    """Interpreter output for one record.
+
+    ``value``: numeric predicted value (regression score, winning-class
+    probability is NOT here — see ``label``/``probabilities`` for
+    classification; for clustering it is the winning cluster's *index*).
+    ``None`` ⇔ the reference's ``EmptyScore``.
+    """
+
+    value: Optional[float] = None
+    label: Optional[str] = None
+    probabilities: Dict[str, float] = dc_field(default_factory=dict)
+
+    @property
+    def is_missing(self) -> bool:
+        return self.value is None and self.label is None
+
+
+def _is_missing(v: Value) -> bool:
+    return v is None or (isinstance(v, float) and math.isnan(v))
+
+
+def _as_float(v: Value) -> Optional[float]:
+    if _is_missing(v):
+        return None
+    if isinstance(v, str):
+        try:
+            return float(v)
+        except ValueError:
+            return None
+    return float(v)
+
+
+def _values_equal(record_value: Value, pmml_value: str) -> bool:
+    """PMML value comparison: numeric when both sides parse, else string."""
+    if _is_missing(record_value):
+        return False
+    f = _as_float(record_value)
+    try:
+        pf = float(pmml_value)
+    except ValueError:
+        pf = None
+    if f is not None and pf is not None:
+        return f == pf
+    return str(record_value) == pmml_value
+
+
+# ---------------------------------------------------------------------------
+# Predicates → True / False / None (UNKNOWN)
+# ---------------------------------------------------------------------------
+
+
+def eval_predicate(pred: ir.Predicate, record: Record) -> Optional[bool]:
+    if isinstance(pred, ir.TruePredicate):
+        return True
+    if isinstance(pred, ir.FalsePredicate):
+        return False
+    if isinstance(pred, ir.SimplePredicate):
+        v = record.get(pred.field)
+        if pred.operator == "isMissing":
+            return _is_missing(v)
+        if pred.operator == "isNotMissing":
+            return not _is_missing(v)
+        if _is_missing(v):
+            return None
+        if pred.operator == "equal":
+            return _values_equal(v, pred.value)
+        if pred.operator == "notEqual":
+            return not _values_equal(v, pred.value)
+        f = _as_float(v)
+        t = _as_float(pred.value)
+        if f is None or t is None:
+            return None
+        return {
+            "lessThan": f < t,
+            "lessOrEqual": f <= t,
+            "greaterThan": f > t,
+            "greaterOrEqual": f >= t,
+        }[pred.operator]
+    if isinstance(pred, ir.SimpleSetPredicate):
+        v = record.get(pred.field)
+        if _is_missing(v):
+            return None
+        member = any(_values_equal(v, s) for s in pred.values)
+        return member if pred.boolean_operator == "isIn" else not member
+    if isinstance(pred, ir.CompoundPredicate):
+        results = [eval_predicate(p, record) for p in pred.predicates]
+        op = pred.boolean_operator
+        if op == "and":
+            if any(r is False for r in results):
+                return False
+            return None if any(r is None for r in results) else True
+        if op == "or":
+            if any(r is True for r in results):
+                return True
+            return None if any(r is None for r in results) else False
+        if op == "xor":
+            if any(r is None for r in results):
+                return None
+            return sum(bool(r) for r in results) % 2 == 1
+        if op == "surrogate":
+            for r in results:
+                if r is not None:
+                    return r
+            return None
+        raise ModelCompilationException(f"unsupported CompoundPredicate {op!r}")
+    raise ModelCompilationException(f"unsupported predicate {type(pred).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Expressions (DerivedField subset)
+# ---------------------------------------------------------------------------
+
+
+def eval_expression(expr: ir.Expression, record: Record) -> Optional[float]:
+    if isinstance(expr, ir.Constant):
+        return expr.value
+    if isinstance(expr, ir.FieldRef):
+        return _as_float(record.get(expr.field))
+    if isinstance(expr, ir.NormContinuous):
+        x = _as_float(record.get(expr.field))
+        if x is None:
+            return expr.map_missing_to
+        if expr.outliers == "asMissingValues" and not (
+            expr.norms[0].orig <= x <= expr.norms[-1].orig
+        ):
+            return expr.map_missing_to
+        return _norm_continuous(x, expr)
+    if isinstance(expr, ir.NormDiscrete):
+        v = record.get(expr.field)
+        if _is_missing(v):
+            return expr.map_missing_to
+        return 1.0 if _values_equal(v, expr.value) else 0.0
+    if isinstance(expr, ir.Apply):
+        args = [eval_expression(a, record) for a in expr.args]
+        if any(a is None for a in args):
+            return expr.map_missing_to
+        return _apply_function(expr.function, args)
+    raise ModelCompilationException(f"unsupported expression {type(expr).__name__}")
+
+
+def _norm_continuous(x: float, expr: ir.NormContinuous) -> float:
+    ns = expr.norms
+    if expr.outliers == "asExtremeValues":
+        if x < ns[0].orig:
+            return ns[0].norm
+        if x > ns[-1].orig:
+            return ns[-1].norm
+    # piecewise-linear; extrapolate from the outermost segments (asIs)
+    for a, b in zip(ns, ns[1:]):
+        if x <= b.orig or b is ns[-1]:
+            if b.orig == a.orig:
+                return a.norm
+            t = (x - a.orig) / (b.orig - a.orig)
+            return a.norm + t * (b.norm - a.norm)
+    return ns[-1].norm  # unreachable
+
+
+def _apply_function(fn: str, args: List[float]) -> Optional[float]:
+    try:
+        if fn == "+":
+            return args[0] + args[1]
+        if fn == "-":
+            return args[0] - args[1]
+        if fn == "*":
+            return args[0] * args[1]
+        if fn == "/":
+            return args[0] / args[1]
+        if fn == "min":
+            return min(args)
+        if fn == "max":
+            return max(args)
+        if fn == "pow":
+            return args[0] ** args[1]
+        if fn == "exp":
+            return math.exp(args[0])
+        if fn == "ln":
+            return math.log(args[0]) if args[0] > 0 else None
+        if fn == "sqrt":
+            return math.sqrt(args[0]) if args[0] >= 0 else None
+        if fn == "abs":
+            return abs(args[0])
+        if fn == "floor":
+            return math.floor(args[0])
+        if fn == "ceil":
+            return math.ceil(args[0])
+        if fn == "threshold":
+            return 1.0 if args[0] > args[1] else 0.0
+        if fn == "if":
+            return args[1] if args[0] != 0.0 else (args[2] if len(args) > 2 else None)
+    except (ValueError, ZeroDivisionError, OverflowError):
+        return None
+    raise ModelCompilationException(f"unsupported Apply function {fn!r}")
+
+
+# ---------------------------------------------------------------------------
+# Model evaluation
+# ---------------------------------------------------------------------------
+
+
+def evaluate(doc: ir.PmmlDocument, record: Record) -> EvalResult:
+    """Score one record through the document, applying mining-schema missing
+    value replacement and Targets rescaling — the oracle's public entry."""
+    rec = _apply_missing_replacement(doc.model.mining_schema, record)
+    res = _eval_model(doc.model, rec)
+    return _apply_targets(doc.targets, res)
+
+
+def _apply_missing_replacement(schema: ir.MiningSchema, record: Record) -> Record:
+    replacements = {
+        f.name: f.missing_value_replacement
+        for f in schema.fields
+        if f.missing_value_replacement is not None
+    }
+    if not replacements:
+        return record
+    out = dict(record)
+    for name, rep in replacements.items():
+        if _is_missing(out.get(name)):
+            out[name] = rep
+    return out
+
+
+def _apply_targets(targets: Tuple[ir.Target, ...], res: EvalResult) -> EvalResult:
+    if not targets or res.value is None:
+        return res
+    t = targets[0]
+    v = res.value * t.rescale_factor + t.rescale_constant
+    if t.cast_integer == "round":
+        v = float(round(v))
+    elif t.cast_integer == "ceiling":
+        v = float(math.ceil(v))
+    elif t.cast_integer == "floor":
+        v = float(math.floor(v))
+    return EvalResult(value=v, label=res.label, probabilities=res.probabilities)
+
+
+def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
+    if isinstance(model, ir.TreeModelIR):
+        return _eval_tree(model, record)
+    if isinstance(model, ir.RegressionModelIR):
+        return _eval_regression(model, record)
+    if isinstance(model, ir.NeuralNetworkIR):
+        return _eval_neural_network(model, record)
+    if isinstance(model, ir.ClusteringModelIR):
+        return _eval_clustering(model, record)
+    if isinstance(model, ir.MiningModelIR):
+        return _eval_mining(model, record)
+    raise ModelCompilationException(f"unsupported model {type(model).__name__}")
+
+
+# --- TreeModel -------------------------------------------------------------
+
+
+def _node_result(node: ir.TreeNode, function_name: str) -> EvalResult:
+    if function_name == "classification":
+        probs: Dict[str, float] = {}
+        total = sum(sd.record_count for sd in node.score_distribution)
+        for sd in node.score_distribution:
+            if sd.probability is not None:
+                probs[sd.value] = sd.probability
+            elif total > 0:
+                probs[sd.value] = sd.record_count / total
+        label = node.score
+        if label is None and probs:
+            label = max(probs, key=probs.get)
+        value = probs.get(label) if label is not None and probs else None
+        return EvalResult(value=value, label=label, probabilities=probs)
+    v = _as_float(node.score) if node.score is not None else None
+    return EvalResult(value=v)
+
+
+_TREE_STRATEGIES = ("none", "defaultChild", "lastPrediction", "nullPrediction")
+
+
+def _eval_tree(model: ir.TreeModelIR, record: Record) -> EvalResult:
+    if model.missing_value_strategy not in _TREE_STRATEGIES:
+        raise ModelCompilationException(
+            f"unsupported missingValueStrategy {model.missing_value_strategy!r} "
+            f"(supported: {', '.join(_TREE_STRATEGIES)})"
+        )
+    node = model.root
+    if eval_predicate(node.predicate, record) is not True:
+        return EvalResult()
+    last_scored = node if node.score is not None or node.score_distribution else None
+    while not node.is_leaf:
+        chosen: Optional[ir.TreeNode] = None
+        unknown = False
+        for child in node.children:
+            r = eval_predicate(child.predicate, record)
+            if r is True:
+                chosen = child
+                break
+            if r is None:
+                unknown = True
+                if model.missing_value_strategy in ("defaultChild", "lastPrediction",
+                                                    "nullPrediction"):
+                    break
+        if chosen is None:
+            strat = model.missing_value_strategy
+            if unknown and strat == "defaultChild":
+                chosen = _default_child(node)
+                if chosen is None:
+                    return EvalResult()
+            elif unknown and strat == "lastPrediction":
+                return (
+                    _node_result(last_scored, model.function_name)
+                    if last_scored is not None
+                    else EvalResult()
+                )
+            elif unknown and strat == "nullPrediction":
+                return EvalResult()
+            else:
+                # no child matched (or strategy 'none' treats UNKNOWN as no-match)
+                if model.no_true_child_strategy == "returnLastPrediction":
+                    return (
+                        _node_result(last_scored, model.function_name)
+                        if last_scored is not None
+                        else EvalResult()
+                    )
+                return EvalResult()
+        node = chosen
+        if node.score is not None or node.score_distribution:
+            last_scored = node
+    return _node_result(node, model.function_name)
+
+
+def _default_child(node: ir.TreeNode) -> Optional[ir.TreeNode]:
+    if node.default_child is None:
+        return None
+    for c in node.children:
+        if c.node_id == node.default_child:
+            return c
+    return None
+
+
+# --- RegressionModel -------------------------------------------------------
+
+
+def _eval_table(table: ir.RegressionTable, record: Record) -> Optional[float]:
+    y = table.intercept
+    for p in table.numeric_predictors:
+        x = _as_float(record.get(p.name))
+        if x is None:
+            return None  # missing numeric input ⇒ table value missing
+        y += p.coefficient * (x ** p.exponent)
+    for p in table.categorical_predictors:
+        v = record.get(p.name)
+        if _is_missing(v):
+            continue  # missing categorical input contributes 0
+        if _values_equal(v, p.value):
+            y += p.coefficient
+    return y
+
+
+def _eval_regression(model: ir.RegressionModelIR, record: Record) -> EvalResult:
+    raw = [_eval_table(t, record) for t in model.tables]
+    nm = model.normalization_method
+    if model.function_name == "regression":
+        y = raw[0]
+        if y is None:
+            return EvalResult()
+        if nm in ("none", "identity"):
+            return EvalResult(value=y)
+        if nm == "softmax" or nm == "logit":
+            return EvalResult(value=1.0 / (1.0 + math.exp(-y)))
+        if nm == "exp":
+            return EvalResult(value=math.exp(y))
+        raise ModelCompilationException(f"unsupported normalization {nm!r}")
+
+    # classification: one table per target category
+    if any(y is None for y in raw):
+        return EvalResult()
+    cats = [t.target_category or str(i) for i, t in enumerate(model.tables)]
+    if nm == "softmax":
+        m = max(raw)
+        exps = [math.exp(y - m) for y in raw]
+        s = sum(exps)
+        probs = {c: e / s for c, e in zip(cats, exps)}
+    elif nm == "simplemax":
+        s = sum(raw)
+        probs = {c: y / s for c, y in zip(cats, raw)} if s != 0 else {}
+    elif nm in ("none", "identity"):
+        probs = {c: y for c, y in zip(cats, raw)}
+    elif nm == "logit":
+        if len(raw) == 2:
+            p = 1.0 / (1.0 + math.exp(-raw[0]))
+            probs = {cats[0]: p, cats[1]: 1.0 - p}
+        else:
+            probs = {c: 1.0 / (1.0 + math.exp(-y)) for c, y in zip(cats, raw)}
+    else:
+        raise ModelCompilationException(f"unsupported normalization {nm!r}")
+    if not probs:
+        return EvalResult()
+    label = max(probs, key=probs.get)
+    return EvalResult(value=probs[label], label=label, probabilities=probs)
+
+
+# --- NeuralNetwork ---------------------------------------------------------
+
+_ACTIVATIONS = {
+    "logistic": lambda z: 1.0 / (1.0 + math.exp(-z)),
+    "tanh": math.tanh,
+    "identity": lambda z: z,
+    "rectifier": lambda z: max(0.0, z),
+}
+
+
+def _eval_neural_network(model: ir.NeuralNetworkIR, record: Record) -> EvalResult:
+    acts: Dict[str, float] = {}
+    for ni in model.inputs:
+        v = eval_expression(ni.derived_field.expression, record)
+        if v is None:
+            return EvalResult()
+        acts[ni.neuron_id] = v
+    for layer in model.layers:
+        fn_name = layer.activation or model.activation_function
+        fn = _ACTIVATIONS.get(fn_name)
+        if fn is None:
+            raise ModelCompilationException(f"unsupported activation {fn_name!r}")
+        zs = {}
+        for n in layer.neurons:
+            z = n.bias + sum(acts[src] * w for src, w in n.weights)
+            zs[n.neuron_id] = fn(z)
+        norm = layer.normalization or (
+            model.normalization_method if layer is model.layers[-1] else "none"
+        )
+        if norm == "softmax":
+            m = max(zs.values())
+            exps = {k: math.exp(v - m) for k, v in zs.items()}
+            s = sum(exps.values())
+            zs = {k: v / s for k, v in exps.items()}
+        elif norm == "simplemax":
+            s = sum(zs.values())
+            if s != 0:
+                zs = {k: v / s for k, v in zs.items()}
+        acts.update(zs)
+
+    if model.function_name == "classification":
+        probs: Dict[str, float] = {}
+        for no in model.outputs:
+            expr = no.derived_field.expression
+            if isinstance(expr, ir.NormDiscrete):
+                probs[expr.value] = acts[no.output_neuron]
+            else:
+                raise ModelCompilationException(
+                    "classification NeuralOutput must map via NormDiscrete"
+                )
+        if not probs:
+            return EvalResult()
+        label = max(probs, key=probs.get)
+        return EvalResult(value=probs[label], label=label, probabilities=probs)
+
+    # regression: single output neuron, optionally denormalized
+    if not model.outputs:
+        return EvalResult()
+    no = model.outputs[0]
+    y = acts[no.output_neuron]
+    expr = no.derived_field.expression
+    if isinstance(expr, ir.NormContinuous):
+        y = _denorm_continuous(y, expr)
+    elif not isinstance(expr, ir.FieldRef):
+        raise ModelCompilationException(
+            f"unsupported NeuralOutput expression {type(expr).__name__}"
+        )
+    return EvalResult(value=y)
+
+
+def _denorm_continuous(y: float, expr: ir.NormContinuous) -> float:
+    """NeuralOutput NormContinuous runs *backwards*: network output is in
+    norm space, result in orig space."""
+    ns = expr.norms
+    for a, b in zip(ns, ns[1:]):
+        if y <= b.norm or b is ns[-1]:
+            if b.norm == a.norm:
+                return a.orig
+            t = (y - a.norm) / (b.norm - a.norm)
+            return a.orig + t * (b.orig - a.orig)
+    return ns[-1].orig
+
+
+# --- ClusteringModel -------------------------------------------------------
+
+
+def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
+    xs: List[Optional[float]] = []
+    weights: List[float] = []
+    for cf in model.clustering_fields:
+        xs.append(_as_float(record.get(cf.field)))
+        weights.append(cf.weight)
+    if any(x is None for x in xs):
+        return EvalResult()
+    best_idx, best_dist = -1, math.inf
+    for i, cl in enumerate(model.clusters):
+        if len(cl.center) != len(xs):
+            raise ModelCompilationException(
+                f"cluster {i} center arity {len(cl.center)} != fields {len(xs)}"
+            )
+        diffs = [w * abs(x - c) for x, c, w in zip(xs, cl.center, weights)]
+        m = model.measure.metric
+        if m == "squaredEuclidean":
+            d = sum(dd * dd for dd in diffs)
+        elif m == "euclidean":
+            d = math.sqrt(sum(dd * dd for dd in diffs))
+        elif m == "cityBlock":
+            d = sum(diffs)
+        elif m == "chebychev":
+            d = max(diffs)
+        else:
+            raise ModelCompilationException(f"unsupported metric {m!r}")
+        if d < best_dist:
+            best_idx, best_dist = i, d
+    cl = model.clusters[best_idx]
+    label = cl.cluster_id or cl.name or str(best_idx + 1)
+    return EvalResult(value=float(best_idx), label=label,
+                      probabilities={"distance": best_dist})
+
+
+# --- MiningModel -----------------------------------------------------------
+
+
+def _eval_mining(model: ir.MiningModelIR, record: Record) -> EvalResult:
+    method = model.segmentation.multiple_model_method
+    segments = model.segmentation.segments
+
+    if method == "modelChain":
+        rec = dict(record)
+        res = EvalResult()
+        for seg in segments:
+            if eval_predicate(seg.predicate, rec) is not True:
+                continue
+            res = _eval_model(seg.model, rec)
+            for of in seg.output_fields:
+                if of.feature == "predictedValue":
+                    # classification segments export the *label*; numeric
+                    # segments export the value (DMG: predictedValue is the
+                    # target-space result)
+                    rec[of.name] = res.label if res.label is not None else res.value
+                elif of.feature == "probability" and of.target_value is not None:
+                    rec[of.name] = res.probabilities.get(of.target_value)
+                else:
+                    raise ModelCompilationException(
+                        f"unsupported OutputField feature {of.feature!r}"
+                    )
+            if res.is_missing:
+                return EvalResult()
+        return res
+
+    if method == "selectFirst":
+        for seg in segments:
+            if eval_predicate(seg.predicate, record) is True:
+                return _eval_model(seg.model, record)
+        return EvalResult()
+
+    # aggregate methods over active segments
+    results: List[Tuple[float, EvalResult]] = []
+    for seg in segments:
+        if eval_predicate(seg.predicate, record) is not True:
+            continue
+        results.append((seg.weight, _eval_model(seg.model, record)))
+    if not results:
+        return EvalResult()
+
+    if method in ("sum", "average", "weightedAverage", "max", "median"):
+        vals = [(w, r.value) for w, r in results]
+        if any(v is None for _, v in vals):
+            return EvalResult()
+        if method == "sum":
+            return EvalResult(value=sum(v for _, v in vals))
+        if method == "average":
+            return EvalResult(value=sum(v for _, v in vals) / len(vals))
+        if method == "weightedAverage":
+            tw = sum(w for w, _ in vals)
+            if tw == 0:
+                return EvalResult()
+            return EvalResult(value=sum(w * v for w, v in vals) / tw)
+        if method == "max":
+            return EvalResult(value=max(v for _, v in vals))
+        svals = sorted(v for _, v in vals)
+        mid = len(svals) // 2
+        med = svals[mid] if len(svals) % 2 else (svals[mid - 1] + svals[mid]) / 2.0
+        return EvalResult(value=med)
+
+    if method in ("majorityVote", "weightedMajorityVote"):
+        votes: Dict[str, float] = {}
+        for w, r in results:
+            if r.label is None:
+                continue
+            votes[r.label] = votes.get(r.label, 0.0) + (
+                w if method == "weightedMajorityVote" else 1.0
+            )
+        if not votes:
+            return EvalResult()
+        total = sum(votes.values())
+        probs = {k: v / total for k, v in votes.items()}
+        label = max(votes, key=votes.get)
+        return EvalResult(value=probs[label], label=label, probabilities=probs)
+
+    raise ModelCompilationException(f"unsupported multipleModelMethod {method!r}")

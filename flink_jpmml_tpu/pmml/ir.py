@@ -1,0 +1,434 @@
+"""Typed IR for PMML 4.x documents.
+
+Replaces the JAXB object tree of ``jpmml-model`` (reference layer EXT-B,
+SURVEY.md §2) with plain frozen dataclasses. Only the subset of PMML the
+capability contract requires is modelled (SURVEY.md §1 C1): DataDictionary,
+MiningSchema, TransformationDictionary (a pragmatic expression subset),
+Targets, and the five model families — TreeModel, RegressionModel,
+NeuralNetwork, ClusteringModel, MiningModel (all segmentation modes incl.
+``modelChain``). Unknown elements are ignored by the parser; unsupported
+*semantics* (e.g. an activation we can't lower) raise at parse/compile time,
+never silently misevaluate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+# ---------------------------------------------------------------------------
+# Data dictionary / mining schema
+# ---------------------------------------------------------------------------
+
+CONTINUOUS = "continuous"
+CATEGORICAL = "categorical"
+ORDINAL = "ordinal"
+
+
+@dataclass(frozen=True)
+class DataField:
+    name: str
+    optype: str  # continuous | categorical | ordinal
+    dtype: str  # double | float | integer | string | boolean
+    values: Tuple[str, ...] = ()  # declared categories, in document order
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.optype in (CATEGORICAL, ORDINAL)
+
+
+@dataclass(frozen=True)
+class DataDictionary:
+    fields: Tuple[DataField, ...]
+
+    def field(self, name: str) -> DataField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return any(f.name == name for f in self.fields)
+
+
+@dataclass(frozen=True)
+class MiningField:
+    name: str
+    usage_type: str = "active"  # active | target | predicted | supplementary
+    missing_value_replacement: Optional[str] = None
+    invalid_value_treatment: str = "returnInvalid"
+
+
+@dataclass(frozen=True)
+class MiningSchema:
+    fields: Tuple[MiningField, ...]
+
+    @property
+    def active_fields(self) -> Tuple[str, ...]:
+        return tuple(f.name for f in self.fields if f.usage_type == "active")
+
+    @property
+    def target_field(self) -> Optional[str]:
+        for f in self.fields:
+            if f.usage_type in ("target", "predicted"):
+                return f.name
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Expressions (TransformationDictionary / DerivedField subset)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FieldRef:
+    field: str
+
+
+@dataclass(frozen=True)
+class Constant:
+    value: float
+
+
+@dataclass(frozen=True)
+class LinearNorm:
+    orig: float
+    norm: float
+
+
+@dataclass(frozen=True)
+class NormContinuous:
+    """Piecewise-linear normalization of a continuous field."""
+
+    field: str
+    norms: Tuple[LinearNorm, ...]
+    outliers: str = "asIs"  # asIs | asMissingValues | asExtremeValues
+    map_missing_to: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class NormDiscrete:
+    """One-hot indicator: 1.0 when ``field == value`` else 0.0."""
+
+    field: str
+    value: str
+    map_missing_to: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class Apply:
+    """Built-in function application over sub-expressions.
+
+    Supported functions: + - * / min max pow exp ln sqrt abs floor ceil
+    threshold if (3-arg) equal lessThan greaterThan and or not.
+    """
+
+    function: str
+    args: Tuple["Expression", ...]
+    map_missing_to: Optional[float] = None
+
+
+Expression = Union[FieldRef, Constant, NormContinuous, NormDiscrete, Apply]
+
+
+@dataclass(frozen=True)
+class DerivedField:
+    name: str
+    optype: str
+    dtype: str
+    expression: Expression
+
+
+@dataclass(frozen=True)
+class TransformationDictionary:
+    derived_fields: Tuple[DerivedField, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# Predicates
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SimplePredicate:
+    field: str
+    operator: str  # equal notEqual lessThan lessOrEqual greaterThan
+    #               greaterOrEqual isMissing isNotMissing
+    value: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SimpleSetPredicate:
+    field: str
+    boolean_operator: str  # isIn | isNotIn
+    values: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class CompoundPredicate:
+    boolean_operator: str  # and | or | xor | surrogate
+    predicates: Tuple["Predicate", ...] = ()
+
+
+@dataclass(frozen=True)
+class TruePredicate:
+    pass
+
+
+@dataclass(frozen=True)
+class FalsePredicate:
+    pass
+
+
+Predicate = Union[
+    SimplePredicate, SimpleSetPredicate, CompoundPredicate, TruePredicate, FalsePredicate
+]
+
+
+# ---------------------------------------------------------------------------
+# TreeModel
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ScoreDistribution:
+    value: str
+    record_count: float
+    confidence: Optional[float] = None
+    probability: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class TreeNode:
+    predicate: Predicate
+    score: Optional[str] = None
+    node_id: Optional[str] = None
+    record_count: Optional[float] = None
+    default_child: Optional[str] = None
+    children: Tuple["TreeNode", ...] = ()
+    score_distribution: Tuple[ScoreDistribution, ...] = ()
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self.children
+
+
+@dataclass(frozen=True)
+class TreeModelIR:
+    function_name: str  # regression | classification
+    mining_schema: MiningSchema
+    root: TreeNode
+    missing_value_strategy: str = "none"
+    # none | defaultChild | lastPrediction | nullPrediction | weightedConfidence
+    no_true_child_strategy: str = "returnNullPrediction"
+    split_characteristic: str = "binarySplit"
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# RegressionModel
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class NumericPredictor:
+    name: str
+    coefficient: float
+    exponent: float = 1.0
+
+
+@dataclass(frozen=True)
+class CategoricalPredictor:
+    name: str
+    value: str
+    coefficient: float
+
+
+@dataclass(frozen=True)
+class RegressionTable:
+    intercept: float
+    target_category: Optional[str] = None
+    numeric_predictors: Tuple[NumericPredictor, ...] = ()
+    categorical_predictors: Tuple[CategoricalPredictor, ...] = ()
+
+
+@dataclass(frozen=True)
+class RegressionModelIR:
+    function_name: str  # regression | classification
+    mining_schema: MiningSchema
+    normalization_method: str  # none simplemax softmax logit exp cauchit cloglog
+    tables: Tuple[RegressionTable, ...]
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# NeuralNetwork
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class NeuralInput:
+    neuron_id: str
+    derived_field: DerivedField
+
+
+@dataclass(frozen=True)
+class Neuron:
+    neuron_id: str
+    bias: float
+    weights: Tuple[Tuple[str, float], ...]  # (from_neuron_id, weight)
+
+
+@dataclass(frozen=True)
+class NeuralLayer:
+    neurons: Tuple[Neuron, ...]
+    activation: Optional[str] = None  # overrides model default
+    normalization: Optional[str] = None  # softmax | simplemax
+
+
+@dataclass(frozen=True)
+class NeuralOutput:
+    output_neuron: str
+    derived_field: DerivedField  # maps network output back to target space
+
+
+@dataclass(frozen=True)
+class NeuralNetworkIR:
+    function_name: str
+    mining_schema: MiningSchema
+    activation_function: str  # logistic | tanh | identity | rectifier | …
+    inputs: Tuple[NeuralInput, ...]
+    layers: Tuple[NeuralLayer, ...]
+    outputs: Tuple[NeuralOutput, ...]
+    normalization_method: str = "none"
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# ClusteringModel
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Cluster:
+    center: Tuple[float, ...]
+    name: Optional[str] = None
+    cluster_id: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class ClusteringField:
+    field: str
+    weight: float = 1.0
+    compare_function: Optional[str] = None  # absDiff | delta | …
+
+
+@dataclass(frozen=True)
+class ComparisonMeasure:
+    kind: str  # distance | similarity
+    metric: str  # squaredEuclidean euclidean cityBlock chebychev
+    compare_function: str = "absDiff"
+
+
+@dataclass(frozen=True)
+class ClusteringModelIR:
+    function_name: str  # clustering
+    mining_schema: MiningSchema
+    model_class: str  # centerBased
+    measure: ComparisonMeasure
+    clustering_fields: Tuple[ClusteringField, ...]
+    clusters: Tuple[Cluster, ...]
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# MiningModel (ensembles / stacking)
+# ---------------------------------------------------------------------------
+
+ModelIR = Union[
+    TreeModelIR,
+    RegressionModelIR,
+    NeuralNetworkIR,
+    ClusteringModelIR,
+    "MiningModelIR",
+]
+
+
+@dataclass(frozen=True)
+class OutputField:
+    """Subset of PMML <Output>: feature exported by a segment (for modelChain)."""
+
+    name: str
+    feature: str = "predictedValue"  # predictedValue | probability | …
+    target_value: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Segment:
+    predicate: Predicate
+    model: ModelIR
+    segment_id: Optional[str] = None
+    weight: float = 1.0
+    output_fields: Tuple[OutputField, ...] = ()
+
+
+@dataclass(frozen=True)
+class Segmentation:
+    multiple_model_method: str
+    # sum average weightedAverage majorityVote weightedMajorityVote
+    # modelChain selectFirst selectAll(unsupported) max median
+    segments: Tuple[Segment, ...]
+
+
+@dataclass(frozen=True)
+class MiningModelIR:
+    function_name: str
+    mining_schema: MiningSchema
+    segmentation: Segmentation
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Targets (output rescaling) + document root
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Target:
+    field: Optional[str]
+    rescale_constant: float = 0.0
+    rescale_factor: float = 1.0
+    cast_integer: Optional[str] = None  # round | ceiling | floor
+
+
+@dataclass(frozen=True)
+class Header:
+    description: Optional[str] = None
+    application: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class PmmlDocument:
+    version: str
+    header: Header
+    data_dictionary: DataDictionary
+    transformations: TransformationDictionary
+    model: ModelIR
+    targets: Tuple[Target, ...] = ()
+
+    @property
+    def active_fields(self) -> Tuple[str, ...]:
+        """The model's input contract, in mining-schema order.
+
+        This is what the vector converter validates arity against
+        (capability C4): dense vectors zip positionally with these names.
+        """
+        return _mining_schema_of(self.model).active_fields
+
+    @property
+    def target_field(self) -> Optional[str]:
+        return _mining_schema_of(self.model).target_field
+
+
+def _mining_schema_of(model: ModelIR) -> MiningSchema:
+    return model.mining_schema

@@ -1,0 +1,624 @@
+"""PMML 4.x XML → typed IR parser.
+
+Replaces the reference's ``ModelReader``'s JAXB unmarshalling + version gate
+(SURVEY.md §3 row B3: expected upstream ``…/api/reader/ModelReader.scala``
+[UNVERIFIED]; supported versions 4.0–4.3-era per SURVEY.md §1 C1 — we gate
+4.0–4.4). Namespace-agnostic: PMML documents declare per-version namespaces
+(``http://www.dmg.org/PMML-4_2`` …); we strip them and dispatch on local
+names, which is what makes one parser cover all 4.x minor versions.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Optional, Tuple
+
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import (
+    ModelLoadingException,
+    UnsupportedPmmlVersionException,
+)
+
+SUPPORTED_VERSIONS = ("4.0", "4.1", "4.2", "4.3", "4.4")
+
+_MODEL_TAGS = (
+    "TreeModel",
+    "RegressionModel",
+    "NeuralNetwork",
+    "ClusteringModel",
+    "MiningModel",
+)
+
+
+def _local(tag: str) -> str:
+    """Strip ``{namespace}`` prefix from an element tag."""
+    return tag.rsplit("}", 1)[-1]
+
+
+def _children(elem: ET.Element, name: str) -> list[ET.Element]:
+    return [c for c in elem if _local(c.tag) == name]
+
+
+def _child(elem: ET.Element, name: str) -> Optional[ET.Element]:
+    for c in elem:
+        if _local(c.tag) == name:
+            return c
+    return None
+
+
+def _req_child(elem: ET.Element, name: str) -> ET.Element:
+    c = _child(elem, name)
+    if c is None:
+        raise ModelLoadingException(
+            f"<{_local(elem.tag)}> is missing required child <{name}>"
+        )
+    return c
+
+
+def _float(elem: ET.Element, attr: str, default: Optional[float] = None) -> float:
+    raw = elem.get(attr)
+    if raw is None:
+        if default is None:
+            raise ModelLoadingException(
+                f"<{_local(elem.tag)}> is missing required attribute {attr!r}"
+            )
+        return default
+    try:
+        return float(raw)
+    except ValueError as e:
+        raise ModelLoadingException(
+            f"<{_local(elem.tag)}> attribute {attr}={raw!r} is not a number"
+        ) from e
+
+
+def _opt_float(elem: ET.Element, attr: str) -> Optional[float]:
+    """Optional numeric attribute: absent → None, present-but-garbage → raise."""
+    if elem.get(attr) is None:
+        return None
+    return _float(elem, attr)
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def parse_pmml(xml_text: str) -> ir.PmmlDocument:
+    """Parse a PMML document string into the typed IR (capability C1)."""
+    try:
+        root = ET.fromstring(xml_text)
+    except ET.ParseError as e:
+        raise ModelLoadingException(f"malformed PMML XML: {e}") from e
+    if _local(root.tag) != "PMML":
+        raise ModelLoadingException(
+            f"root element is <{_local(root.tag)}>, expected <PMML>"
+        )
+
+    version = root.get("version", "")
+    if version not in SUPPORTED_VERSIONS:
+        raise UnsupportedPmmlVersionException(
+            f"PMML version {version!r} is not supported "
+            f"(supported: {', '.join(SUPPORTED_VERSIONS)})"
+        )
+
+    header = _parse_header(_child(root, "Header"))
+    dd_elem = _req_child(root, "DataDictionary")
+    data_dictionary = _parse_data_dictionary(dd_elem)
+    transformations = _parse_transformation_dictionary(
+        _child(root, "TransformationDictionary")
+    )
+
+    model_elem = None
+    for c in root:
+        if _local(c.tag) in _MODEL_TAGS:
+            model_elem = c
+            break
+    if model_elem is None:
+        raise ModelLoadingException(
+            f"no supported model element found (supported: {', '.join(_MODEL_TAGS)})"
+        )
+
+    model = _parse_model(model_elem)
+    targets = _parse_targets(_child(model_elem, "Targets"))
+    return ir.PmmlDocument(
+        version=version,
+        header=header,
+        data_dictionary=data_dictionary,
+        transformations=transformations,
+        model=model,
+        targets=targets,
+    )
+
+
+def parse_pmml_file(path: str) -> ir.PmmlDocument:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        raise ModelLoadingException(f"cannot read PMML at {path!r}: {e}") from e
+    return parse_pmml(text)
+
+
+# ---------------------------------------------------------------------------
+# Dictionaries / schemas / transformations
+# ---------------------------------------------------------------------------
+
+
+def _parse_header(elem: Optional[ET.Element]) -> ir.Header:
+    if elem is None:
+        return ir.Header()
+    app = _child(elem, "Application")
+    return ir.Header(
+        description=elem.get("description"),
+        application=app.get("name") if app is not None else None,
+    )
+
+
+def _parse_data_dictionary(elem: ET.Element) -> ir.DataDictionary:
+    fields = []
+    for df in _children(elem, "DataField"):
+        values = tuple(
+            v.get("value", "") for v in _children(df, "Value")
+            if v.get("property", "valid") == "valid"
+        )
+        fields.append(
+            ir.DataField(
+                name=df.get("name", ""),
+                optype=df.get("optype", "continuous"),
+                dtype=df.get("dataType", "double"),
+                values=values,
+            )
+        )
+    return ir.DataDictionary(fields=tuple(fields))
+
+
+def _parse_mining_schema(elem: ET.Element) -> ir.MiningSchema:
+    ms = _req_child(elem, "MiningSchema")
+    fields = []
+    for mf in _children(ms, "MiningField"):
+        fields.append(
+            ir.MiningField(
+                name=mf.get("name", ""),
+                usage_type=mf.get("usageType", "active"),
+                missing_value_replacement=mf.get("missingValueReplacement"),
+                invalid_value_treatment=mf.get("invalidValueTreatment", "returnInvalid"),
+            )
+        )
+    return ir.MiningSchema(fields=tuple(fields))
+
+
+def _parse_transformation_dictionary(
+    elem: Optional[ET.Element],
+) -> ir.TransformationDictionary:
+    if elem is None:
+        return ir.TransformationDictionary()
+    dfs = tuple(_parse_derived_field(df) for df in _children(elem, "DerivedField"))
+    return ir.TransformationDictionary(derived_fields=dfs)
+
+
+def _parse_derived_field(elem: ET.Element) -> ir.DerivedField:
+    expr = None
+    for c in elem:
+        parsed = _try_parse_expression(c)
+        if parsed is not None:
+            expr = parsed
+            break
+    if expr is None:
+        raise ModelLoadingException(
+            f"DerivedField {elem.get('name')!r} has no supported expression"
+        )
+    return ir.DerivedField(
+        name=elem.get("name", ""),
+        optype=elem.get("optype", "continuous"),
+        dtype=elem.get("dataType", "double"),
+        expression=expr,
+    )
+
+
+def _try_parse_expression(elem: ET.Element) -> Optional[ir.Expression]:
+    tag = _local(elem.tag)
+    if tag == "FieldRef":
+        return ir.FieldRef(field=elem.get("field", ""))
+    if tag == "Constant":
+        try:
+            return ir.Constant(value=float(elem.text or "0"))
+        except ValueError as e:
+            raise ModelLoadingException(
+                f"non-numeric <Constant>{elem.text}</Constant>"
+            ) from e
+    if tag == "NormContinuous":
+        norms = tuple(
+            ir.LinearNorm(orig=_float(n, "orig"), norm=_float(n, "norm"))
+            for n in _children(elem, "LinearNorm")
+        )
+        if len(norms) < 2:
+            raise ModelLoadingException(
+                "NormContinuous requires at least two LinearNorm points"
+            )
+        return ir.NormContinuous(
+            field=elem.get("field", ""),
+            norms=norms,
+            outliers=elem.get("outliers", "asIs"),
+            map_missing_to=_opt_float(elem, "mapMissingTo"),
+        )
+    if tag == "NormDiscrete":
+        return ir.NormDiscrete(
+            field=elem.get("field", ""),
+            value=elem.get("value", ""),
+            map_missing_to=_opt_float(elem, "mapMissingTo"),
+        )
+    if tag == "Apply":
+        args = []
+        for c in elem:
+            if _local(c.tag) == "Extension":
+                continue
+            parsed = _try_parse_expression(c)
+            if parsed is None:
+                raise ModelLoadingException(
+                    f"unsupported expression <{_local(c.tag)}> inside <Apply "
+                    f"function={elem.get('function')!r}>"
+                )
+            args.append(parsed)
+        return ir.Apply(
+            function=elem.get("function", ""),
+            args=tuple(args),
+            map_missing_to=_opt_float(elem, "mapMissingTo"),
+        )
+    return None
+
+
+def _parse_targets(elem: Optional[ET.Element]) -> Tuple[ir.Target, ...]:
+    if elem is None:
+        return ()
+    out = []
+    for t in _children(elem, "Target"):
+        out.append(
+            ir.Target(
+                field=t.get("field"),
+                rescale_constant=_float(t, "rescaleConstant", 0.0),
+                rescale_factor=_float(t, "rescaleFactor", 1.0),
+                cast_integer=t.get("castInteger"),
+            )
+        )
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Predicates
+# ---------------------------------------------------------------------------
+
+_PREDICATE_TAGS = (
+    "SimplePredicate",
+    "SimpleSetPredicate",
+    "CompoundPredicate",
+    "True",
+    "False",
+)
+
+
+def _parse_predicate(elem: ET.Element) -> ir.Predicate:
+    tag = _local(elem.tag)
+    if tag == "SimplePredicate":
+        op = elem.get("operator", "")
+        value = elem.get("value")
+        if op not in (
+            "equal",
+            "notEqual",
+            "lessThan",
+            "lessOrEqual",
+            "greaterThan",
+            "greaterOrEqual",
+            "isMissing",
+            "isNotMissing",
+        ):
+            raise ModelLoadingException(f"unsupported SimplePredicate operator {op!r}")
+        if op not in ("isMissing", "isNotMissing") and value is None:
+            raise ModelLoadingException(
+                f"SimplePredicate {op} on {elem.get('field')!r} has no value"
+            )
+        return ir.SimplePredicate(field=elem.get("field", ""), operator=op, value=value)
+    if tag == "SimpleSetPredicate":
+        arr = _req_child(elem, "Array")
+        return ir.SimpleSetPredicate(
+            field=elem.get("field", ""),
+            boolean_operator=elem.get("booleanOperator", "isIn"),
+            values=tuple(_parse_string_array(arr)),
+        )
+    if tag == "CompoundPredicate":
+        preds = tuple(
+            _parse_predicate(c) for c in elem if _local(c.tag) in _PREDICATE_TAGS
+        )
+        return ir.CompoundPredicate(
+            boolean_operator=elem.get("booleanOperator", "and"), predicates=preds
+        )
+    if tag == "True":
+        return ir.TruePredicate()
+    if tag == "False":
+        return ir.FalsePredicate()
+    raise ModelLoadingException(f"unsupported predicate element <{tag}>")
+
+
+def _find_predicate(elem: ET.Element) -> ir.Predicate:
+    for c in elem:
+        if _local(c.tag) in _PREDICATE_TAGS:
+            return _parse_predicate(c)
+    raise ModelLoadingException(f"<{_local(elem.tag)}> has no predicate child")
+
+
+def _parse_string_array(arr: ET.Element) -> list[str]:
+    """PMML <Array> holds space-separated tokens; quoted tokens may hold spaces."""
+    text = (arr.text or "").strip()
+    out: list[str] = []
+    i = 0
+    while i < len(text):
+        if text[i].isspace():
+            i += 1
+            continue
+        if text[i] == '"':
+            j = i + 1
+            buf = []
+            while j < len(text) and text[j] != '"':
+                if text[j] == "\\" and j + 1 < len(text) and text[j + 1] == '"':
+                    buf.append('"')
+                    j += 2
+                    continue
+                buf.append(text[j])
+                j += 1
+            out.append("".join(buf))
+            i = j + 1
+        else:
+            j = i
+            while j < len(text) and not text[j].isspace():
+                j += 1
+            out.append(text[i:j])
+            i = j
+    return out
+
+
+def _parse_real_array(arr: ET.Element) -> Tuple[float, ...]:
+    try:
+        return tuple(float(tok) for tok in (arr.text or "").split())
+    except ValueError as e:
+        raise ModelLoadingException(f"non-numeric token in <Array>: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# Models
+# ---------------------------------------------------------------------------
+
+
+def _parse_model(elem: ET.Element) -> ir.ModelIR:
+    tag = _local(elem.tag)
+    if tag == "TreeModel":
+        return _parse_tree_model(elem)
+    if tag == "RegressionModel":
+        return _parse_regression_model(elem)
+    if tag == "NeuralNetwork":
+        return _parse_neural_network(elem)
+    if tag == "ClusteringModel":
+        return _parse_clustering_model(elem)
+    if tag == "MiningModel":
+        return _parse_mining_model(elem)
+    raise ModelLoadingException(f"unsupported model element <{tag}>")
+
+
+def _parse_tree_model(elem: ET.Element) -> ir.TreeModelIR:
+    return ir.TreeModelIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=_parse_mining_schema(elem),
+        root=_parse_tree_node(_req_child(elem, "Node")),
+        missing_value_strategy=elem.get("missingValueStrategy", "none"),
+        no_true_child_strategy=elem.get("noTrueChildStrategy", "returnNullPrediction"),
+        split_characteristic=elem.get("splitCharacteristic", "binarySplit"),
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_tree_node(elem: ET.Element) -> ir.TreeNode:
+    dists = tuple(
+        ir.ScoreDistribution(
+            value=sd.get("value", ""),
+            record_count=_float(sd, "recordCount", 0.0),
+            confidence=_opt_float(sd, "confidence"),
+            probability=_opt_float(sd, "probability"),
+        )
+        for sd in _children(elem, "ScoreDistribution")
+    )
+    children = tuple(_parse_tree_node(c) for c in _children(elem, "Node"))
+    return ir.TreeNode(
+        predicate=_find_predicate(elem),
+        score=elem.get("score"),
+        node_id=elem.get("id"),
+        record_count=_opt_float(elem, "recordCount"),
+        default_child=elem.get("defaultChild"),
+        children=children,
+        score_distribution=dists,
+    )
+
+
+def _parse_regression_model(elem: ET.Element) -> ir.RegressionModelIR:
+    tables = []
+    for t in _children(elem, "RegressionTable"):
+        nums = tuple(
+            ir.NumericPredictor(
+                name=p.get("name", ""),
+                coefficient=_float(p, "coefficient"),
+                exponent=_float(p, "exponent", 1.0),
+            )
+            for p in _children(t, "NumericPredictor")
+        )
+        cats = tuple(
+            ir.CategoricalPredictor(
+                name=p.get("name", ""),
+                value=p.get("value", ""),
+                coefficient=_float(p, "coefficient"),
+            )
+            for p in _children(t, "CategoricalPredictor")
+        )
+        tables.append(
+            ir.RegressionTable(
+                intercept=_float(t, "intercept", 0.0),
+                target_category=t.get("targetCategory"),
+                numeric_predictors=nums,
+                categorical_predictors=cats,
+            )
+        )
+    if not tables:
+        raise ModelLoadingException("RegressionModel has no RegressionTable")
+    return ir.RegressionModelIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=_parse_mining_schema(elem),
+        normalization_method=elem.get("normalizationMethod", "none"),
+        tables=tuple(tables),
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_neural_network(elem: ET.Element) -> ir.NeuralNetworkIR:
+    inputs = []
+    for ni in _children(_req_child(elem, "NeuralInputs"), "NeuralInput"):
+        inputs.append(
+            ir.NeuralInput(
+                neuron_id=ni.get("id", ""),
+                derived_field=_parse_derived_field(_req_child(ni, "DerivedField")),
+            )
+        )
+    layers = []
+    for nl in _children(elem, "NeuralLayer"):
+        neurons = []
+        for n in _children(nl, "Neuron"):
+            weights = tuple(
+                (c.get("from", ""), _float(c, "weight")) for c in _children(n, "Con")
+            )
+            neurons.append(
+                ir.Neuron(
+                    neuron_id=n.get("id", ""),
+                    bias=_float(n, "bias", 0.0),
+                    weights=weights,
+                )
+            )
+        layers.append(
+            ir.NeuralLayer(
+                neurons=tuple(neurons),
+                activation=nl.get("activationFunction"),
+                normalization=nl.get("normalizationMethod"),
+            )
+        )
+    outputs = []
+    no_elem = _child(elem, "NeuralOutputs")
+    if no_elem is not None:
+        for no in _children(no_elem, "NeuralOutput"):
+            outputs.append(
+                ir.NeuralOutput(
+                    output_neuron=no.get("outputNeuron", ""),
+                    derived_field=_parse_derived_field(_req_child(no, "DerivedField")),
+                )
+            )
+    return ir.NeuralNetworkIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=_parse_mining_schema(elem),
+        activation_function=elem.get("activationFunction", "logistic"),
+        inputs=tuple(inputs),
+        layers=tuple(layers),
+        outputs=tuple(outputs),
+        normalization_method=elem.get("normalizationMethod", "none"),
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
+    cm = _req_child(elem, "ComparisonMeasure")
+    metric_elem = None
+    for c in cm:
+        metric_elem = c
+        break
+    if metric_elem is None:
+        raise ModelLoadingException("ComparisonMeasure has no metric child")
+    metric_map = {
+        "squaredEuclidean": "squaredEuclidean",
+        "euclidean": "euclidean",
+        "cityBlock": "cityBlock",
+        "chebychev": "chebychev",
+    }
+    metric = metric_map.get(_local(metric_elem.tag))
+    if metric is None:
+        raise ModelLoadingException(
+            f"unsupported comparison metric <{_local(metric_elem.tag)}>"
+        )
+    fields = tuple(
+        ir.ClusteringField(
+            field=cf.get("field", ""),
+            weight=_float(cf, "fieldWeight", 1.0),
+            compare_function=cf.get("compareFunction"),
+        )
+        for cf in _children(elem, "ClusteringField")
+    )
+    clusters = tuple(
+        ir.Cluster(
+            center=_parse_real_array(_req_child(cl, "Array")),
+            name=cl.get("name"),
+            cluster_id=cl.get("id"),
+        )
+        for cl in _children(elem, "Cluster")
+    )
+    if not clusters:
+        raise ModelLoadingException("ClusteringModel has no Cluster elements")
+    return ir.ClusteringModelIR(
+        function_name=elem.get("functionName", "clustering"),
+        mining_schema=_parse_mining_schema(elem),
+        model_class=elem.get("modelClass", "centerBased"),
+        measure=ir.ComparisonMeasure(
+            kind=cm.get("kind", "distance"),
+            metric=metric,
+            compare_function=cm.get("compareFunction", "absDiff"),
+        ),
+        clustering_fields=fields,
+        clusters=clusters,
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_mining_model(elem: ET.Element) -> ir.MiningModelIR:
+    seg_elem = _req_child(elem, "Segmentation")
+    segments = []
+    for s in _children(seg_elem, "Segment"):
+        model_elem = None
+        for c in s:
+            if _local(c.tag) in _MODEL_TAGS:
+                model_elem = c
+                break
+        if model_elem is None:
+            raise ModelLoadingException(
+                f"Segment {s.get('id')!r} has no supported embedded model"
+            )
+        out_fields = []
+        out_elem = _child(model_elem, "Output")
+        if out_elem is not None:
+            for of in _children(out_elem, "OutputField"):
+                out_fields.append(
+                    ir.OutputField(
+                        name=of.get("name", ""),
+                        feature=of.get("feature", "predictedValue"),
+                        target_value=of.get("value"),
+                    )
+                )
+        segments.append(
+            ir.Segment(
+                predicate=_find_predicate(s),
+                model=_parse_model(model_elem),
+                segment_id=s.get("id"),
+                weight=_float(s, "weight", 1.0),
+                output_fields=tuple(out_fields),
+            )
+        )
+    if not segments:
+        raise ModelLoadingException("Segmentation has no Segment elements")
+    return ir.MiningModelIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=_parse_mining_schema(elem),
+        segmentation=ir.Segmentation(
+            multiple_model_method=seg_elem.get("multipleModelMethod", "sum"),
+            segments=tuple(segments),
+        ),
+        model_name=elem.get("modelName"),
+    )

@@ -1,0 +1,1 @@
+"""Streaming micro-batch engine (SURVEY.md §8 step 3): sources, batcher, sinks."""

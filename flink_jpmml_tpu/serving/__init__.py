@@ -1,0 +1,1 @@
+"""Dynamic model serving: registry, managers, control-stream application."""

@@ -1,0 +1,300 @@
+"""Oracle (reference interpreter) tests against hand-computed expectations.
+
+The interpreter is the semantic anchor for all golden tests of the JAX
+lowering, so its own behavior is pinned here on tiny hand-written PMML
+documents where the expected output is computed by hand (SURVEY.md §5:
+"golden outputs (JPMML-computed or hand-derived)").
+"""
+
+import math
+
+import pytest
+
+from flink_jpmml_tpu.pmml import parse_pmml, parse_pmml_file
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+
+def _wrap(model_xml: str, fields=("a", "b")) -> str:
+    dd = "".join(
+        f'<DataField name="{f}" optype="continuous" dataType="double"/>'
+        for f in fields
+    )
+    return (
+        f'<PMML version="4.3"><DataDictionary>{dd}</DataDictionary>'
+        f"{model_xml}</PMML>"
+    )
+
+
+MS = '<MiningSchema><MiningField name="a"/><MiningField name="b"/></MiningSchema>'
+
+
+class TestRegression:
+    def test_linear(self):
+        doc = parse_pmml(
+            _wrap(
+                '<RegressionModel functionName="regression">'
+                + MS
+                + '<RegressionTable intercept="1.5">'
+                '<NumericPredictor name="a" coefficient="2.0"/>'
+                '<NumericPredictor name="b" coefficient="-1.0" exponent="2"/>'
+                "</RegressionTable></RegressionModel>"
+            )
+        )
+        r = evaluate(doc, {"a": 3.0, "b": 2.0})
+        assert r.value == pytest.approx(1.5 + 6.0 - 4.0)
+
+    def test_missing_numeric_gives_empty(self):
+        doc = parse_pmml(
+            _wrap(
+                '<RegressionModel functionName="regression">'
+                + MS
+                + '<RegressionTable intercept="0">'
+                '<NumericPredictor name="a" coefficient="1"/>'
+                "</RegressionTable></RegressionModel>"
+            )
+        )
+        assert evaluate(doc, {"a": None, "b": 1.0}).is_missing
+        assert evaluate(doc, {"a": float("nan"), "b": 1.0}).is_missing
+
+    def test_missing_value_replacement(self):
+        doc = parse_pmml(
+            _wrap(
+                '<RegressionModel functionName="regression">'
+                "<MiningSchema>"
+                '<MiningField name="a" missingValueReplacement="10"/>'
+                '<MiningField name="b"/>'
+                "</MiningSchema>"
+                '<RegressionTable intercept="0">'
+                '<NumericPredictor name="a" coefficient="1"/>'
+                "</RegressionTable></RegressionModel>"
+            )
+        )
+        assert evaluate(doc, {"a": None, "b": 0.0}).value == pytest.approx(10.0)
+
+    def test_logit_regression(self):
+        doc = parse_pmml(
+            _wrap(
+                '<RegressionModel functionName="regression" '
+                'normalizationMethod="logit">'
+                + MS
+                + '<RegressionTable intercept="0.0">'
+                '<NumericPredictor name="a" coefficient="1.0"/>'
+                "</RegressionTable></RegressionModel>"
+            )
+        )
+        r = evaluate(doc, {"a": 0.0, "b": 0.0})
+        assert r.value == pytest.approx(0.5)
+
+    def test_softmax_classification(self):
+        doc = parse_pmml(
+            _wrap(
+                '<RegressionModel functionName="classification" '
+                'normalizationMethod="softmax">'
+                + MS
+                + '<RegressionTable intercept="1.0" targetCategory="yes"/>'
+                '<RegressionTable intercept="0.0" targetCategory="no"/>'
+                "</RegressionModel>"
+            )
+        )
+        r = evaluate(doc, {"a": 0.0, "b": 0.0})
+        p_yes = math.exp(1.0) / (math.exp(1.0) + 1.0)
+        assert r.label == "yes"
+        assert r.probabilities["yes"] == pytest.approx(p_yes)
+        assert r.probabilities["no"] == pytest.approx(1 - p_yes)
+
+    def test_categorical_predictor(self):
+        doc = parse_pmml(
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="color" optype="categorical" dataType="string">'
+            '<Value value="red"/><Value value="blue"/></DataField>'
+            "</DataDictionary>"
+            '<RegressionModel functionName="regression">'
+            '<MiningSchema><MiningField name="color"/></MiningSchema>'
+            '<RegressionTable intercept="1.0">'
+            '<CategoricalPredictor name="color" value="red" coefficient="5.0"/>'
+            "</RegressionTable></RegressionModel></PMML>"
+        )
+        assert evaluate(doc, {"color": "red"}).value == pytest.approx(6.0)
+        assert evaluate(doc, {"color": "blue"}).value == pytest.approx(1.0)
+        # missing categorical contributes 0, does not kill the table
+        assert evaluate(doc, {"color": None}).value == pytest.approx(1.0)
+
+
+TREE = (
+    '<TreeModel functionName="regression" missingValueStrategy="defaultChild">'
+    + MS
+    + '<Node id="root" defaultChild="L"><True/>'
+    '<Node id="L" score="10"><SimplePredicate field="a" operator="lessThan" '
+    'value="2.0"/></Node>'
+    '<Node id="R"><SimplePredicate field="a" operator="greaterOrEqual" '
+    'value="2.0"/>'
+    '<Node id="RL" score="20"><SimplePredicate field="b" operator="lessThan" '
+    'value="0.0"/></Node>'
+    '<Node id="RR" score="30"><SimplePredicate field="b" '
+    'operator="greaterOrEqual" value="0.0"/></Node>'
+    "</Node></Node></TreeModel>"
+)
+
+
+class TestTree:
+    def test_paths(self):
+        doc = parse_pmml(_wrap(TREE))
+        assert evaluate(doc, {"a": 1.0, "b": 0.0}).value == 10.0
+        assert evaluate(doc, {"a": 5.0, "b": -1.0}).value == 20.0
+        assert evaluate(doc, {"a": 5.0, "b": 1.0}).value == 30.0
+
+    def test_missing_goes_default_child(self):
+        doc = parse_pmml(_wrap(TREE))
+        # a missing at root split -> defaultChild L -> score 10
+        assert evaluate(doc, {"a": None, "b": 1.0}).value == 10.0
+        # b missing at inner node: R's defaultChild is unset -> empty
+        # (inner node R has no defaultChild attribute)
+        assert evaluate(doc, {"a": 5.0, "b": None}).is_missing
+
+    def test_null_prediction_strategy(self):
+        doc = parse_pmml(_wrap(TREE.replace("defaultChild", "nullPrediction", 1)))
+        assert evaluate(doc, {"a": None, "b": 1.0}).is_missing
+
+    def test_last_prediction_strategy(self):
+        xml = TREE.replace(
+            'missingValueStrategy="defaultChild"',
+            'missingValueStrategy="lastPrediction"',
+        ).replace('<Node id="root" defaultChild="L">', '<Node id="root" score="7">')
+        doc = parse_pmml(_wrap(xml))
+        assert evaluate(doc, {"a": None, "b": 1.0}).value == 7.0
+
+    def test_classification_distribution(self):
+        xml = (
+            '<TreeModel functionName="classification">'
+            + MS
+            + '<Node id="r"><True/>'
+            '<Node id="l" score="cat"><SimplePredicate field="a" '
+            'operator="lessThan" value="0"/>'
+            '<ScoreDistribution value="cat" recordCount="30"/>'
+            '<ScoreDistribution value="dog" recordCount="10"/>'
+            "</Node>"
+            '<Node id="rr" score="dog"><True/>'
+            '<ScoreDistribution value="cat" recordCount="5"/>'
+            '<ScoreDistribution value="dog" recordCount="15"/>'
+            "</Node></Node></TreeModel>"
+        )
+        doc = parse_pmml(_wrap(xml))
+        r = evaluate(doc, {"a": -1.0, "b": 0.0})
+        assert r.label == "cat"
+        assert r.probabilities == {"cat": 0.75, "dog": 0.25}
+        r2 = evaluate(doc, {"a": 1.0, "b": 0.0})
+        assert r2.label == "dog"
+        assert r2.probabilities["dog"] == pytest.approx(0.75)
+
+
+class TestMining:
+    def test_sum_with_rescale(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "gbm_small.pmml"))
+        rec = {f"f{i}": 0.25 * i - 1.0 for i in range(8)}
+        r = evaluate(doc, rec)
+        assert r.value is not None
+        # sum of 16 trees + rescaleConstant 0.5: recompute by summing each
+        # tree independently
+        total = 0.5
+        for seg in doc.model.segmentation.segments:
+            from flink_jpmml_tpu.pmml.interp import _eval_model
+
+            total += _eval_model(seg.model, rec).value
+        assert r.value == pytest.approx(total)
+
+    def test_majority_vote(self):
+        votes = (
+            '<MiningModel functionName="classification">'
+            + MS
+            + '<Segmentation multipleModelMethod="majorityVote">'
+            + "".join(
+                f'<Segment id="{i}"><True/>'
+                '<TreeModel functionName="classification">'
+                + MS
+                + f'<Node id="r" score="{lbl}"><True/></Node>'
+                "</TreeModel></Segment>"
+                for i, lbl in enumerate(["x", "x", "y"])
+            )
+            + "</Segmentation></MiningModel>"
+        )
+        doc = parse_pmml(_wrap(votes))
+        r = evaluate(doc, {"a": 0.0, "b": 0.0})
+        assert r.label == "x"
+        assert r.probabilities["x"] == pytest.approx(2 / 3)
+
+    def test_select_first(self):
+        xml = (
+            '<MiningModel functionName="regression">'
+            + MS
+            + '<Segmentation multipleModelMethod="selectFirst">'
+            '<Segment id="0"><SimplePredicate field="a" operator="lessThan" '
+            'value="0"/>'
+            '<TreeModel functionName="regression">' + MS +
+            '<Node id="r" score="1"><True/></Node></TreeModel></Segment>'
+            '<Segment id="1"><True/>'
+            '<TreeModel functionName="regression">' + MS +
+            '<Node id="r" score="2"><True/></Node></TreeModel></Segment>'
+            "</Segmentation></MiningModel>"
+        )
+        doc = parse_pmml(_wrap(xml))
+        assert evaluate(doc, {"a": -1.0, "b": 0.0}).value == 1.0
+        assert evaluate(doc, {"a": 1.0, "b": 0.0}).value == 2.0
+
+    def test_model_chain(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "stacked.pmml"))
+        rec = {f"f{i}": 0.1 * i for i in range(12)}
+        r = evaluate(doc, rec)
+        # manually: inner gbm sum -> logit(1.7*s - 0.3)
+        from flink_jpmml_tpu.pmml.interp import _eval_model
+
+        inner = doc.model.segmentation.segments[0].model
+        s = _eval_model(inner, rec).value
+        expected = 1.0 / (1.0 + math.exp(-(1.7 * s - 0.3)))
+        assert r.value == pytest.approx(expected)
+
+
+class TestClustering:
+    def test_nearest_center(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "kmeans.pmml"))
+        c0 = doc.model.clusters[2].center
+        r = evaluate(doc, {f"f{i}": v for i, v in enumerate(c0)})
+        assert r.value == 2.0
+        assert r.label == "3"
+        assert r.probabilities["distance"] == pytest.approx(0.0)
+
+    def test_missing_field_empty(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "kmeans.pmml"))
+        assert evaluate(doc, {"f0": None, "f1": 0, "f2": 0, "f3": 0}).is_missing
+
+
+class TestNeuralNetwork:
+    def test_tiny_manual(self):
+        # 1 input, 1 hidden logistic neuron, identity output
+        xml = (
+            '<NeuralNetwork functionName="regression" '
+            'activationFunction="logistic">'
+            '<MiningSchema><MiningField name="a"/></MiningSchema>'
+            "<NeuralInputs>"
+            '<NeuralInput id="i0"><DerivedField optype="continuous" '
+            'dataType="double"><FieldRef field="a"/></DerivedField>'
+            "</NeuralInput></NeuralInputs>"
+            '<NeuralLayer><Neuron id="h0" bias="0.5">'
+            '<Con from="i0" weight="2.0"/></Neuron></NeuralLayer>'
+            '<NeuralLayer activationFunction="identity">'
+            '<Neuron id="o0" bias="1.0"><Con from="h0" weight="3.0"/>'
+            "</Neuron></NeuralLayer>"
+            "<NeuralOutputs>"
+            '<NeuralOutput outputNeuron="o0"><DerivedField '
+            'optype="continuous" dataType="double">'
+            '<FieldRef field="target"/></DerivedField></NeuralOutput>'
+            "</NeuralOutputs></NeuralNetwork>"
+        )
+        doc = parse_pmml(_wrap(xml, fields=("a",)))
+        h = 1.0 / (1.0 + math.exp(-(0.5 + 2.0 * 1.0)))
+        assert evaluate(doc, {"a": 1.0}).value == pytest.approx(1.0 + 3.0 * h)
+
+    def test_mlp_classification_probs_sum_to_one(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "mlp_small.pmml"))
+        r = evaluate(doc, {f"x{i}": 0.1 * i for i in range(8)})
+        assert r.label in {"0", "1", "2"}
+        assert sum(r.probabilities.values()) == pytest.approx(1.0)

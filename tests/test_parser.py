@@ -1,0 +1,148 @@
+"""Parser + IR tests (reference parity: ModelReaderSpec, SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.pmml import ir, parse_pmml, parse_pmml_file
+from flink_jpmml_tpu.pmml.parser import _parse_string_array
+from flink_jpmml_tpu.utils.exceptions import (
+    ModelLoadingException,
+    UnsupportedPmmlVersionException,
+)
+
+
+class TestVersionGate:
+    def test_unsupported_version_rejected(self, assets_dir):
+        with pytest.raises(UnsupportedPmmlVersionException, match="3.2"):
+            parse_pmml_file(str(assets_dir / "unsupported_version.pmml"))
+
+    def test_malformed_rejected(self, assets_dir):
+        with pytest.raises(ModelLoadingException, match="malformed"):
+            parse_pmml_file(str(assets_dir / "malformed.pmml"))
+
+    def test_no_model_rejected(self, assets_dir):
+        with pytest.raises(ModelLoadingException, match="no supported model"):
+            parse_pmml_file(str(assets_dir / "no_model.pmml"))
+
+    def test_missing_file(self):
+        with pytest.raises(ModelLoadingException, match="cannot read"):
+            parse_pmml_file("/nonexistent/model.pmml")
+
+    @pytest.mark.parametrize("version", ["4.0", "4.1", "4.2", "4.3", "4.4"])
+    def test_supported_versions(self, version):
+        doc = parse_pmml(
+            f'<PMML version="{version}"><DataDictionary>'
+            '<DataField name="x" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<RegressionModel functionName="regression">'
+            '<MiningSchema><MiningField name="x"/></MiningSchema>'
+            '<RegressionTable intercept="1.0"/>'
+            "</RegressionModel></PMML>"
+        )
+        assert doc.version == version
+
+
+class TestIrisLr:
+    def test_structure(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "iris_lr.pmml"))
+        m = doc.model
+        assert isinstance(m, ir.RegressionModelIR)
+        assert m.function_name == "classification"
+        assert m.normalization_method == "softmax"
+        assert len(m.tables) == 3
+        assert doc.active_fields == (
+            "sepal_length",
+            "sepal_width",
+            "petal_length",
+            "petal_width",
+        )
+        assert doc.target_field == "species"
+        assert doc.data_dictionary.field("species").values == (
+            "setosa",
+            "versicolor",
+            "virginica",
+        )
+
+
+class TestGbm:
+    def test_structure(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "gbm_small.pmml"))
+        m = doc.model
+        assert isinstance(m, ir.MiningModelIR)
+        assert m.segmentation.multiple_model_method == "sum"
+        assert len(m.segmentation.segments) == 16
+        tree = m.segmentation.segments[0].model
+        assert isinstance(tree, ir.TreeModelIR)
+        assert tree.missing_value_strategy == "defaultChild"
+        # root is a True-predicate node with two predicate children
+        assert isinstance(tree.root.predicate, ir.TruePredicate)
+        assert len(tree.root.children) == 2
+        assert tree.root.default_child is not None
+        # targets rescale (base score)
+        assert doc.targets and doc.targets[0].rescale_constant == 0.5
+
+
+class TestMlp:
+    def test_structure(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "mlp_small.pmml"))
+        m = doc.model
+        assert isinstance(m, ir.NeuralNetworkIR)
+        assert len(m.inputs) == 8
+        assert [len(l.neurons) for l in m.layers] == [16, 3]
+        assert m.layers[-1].activation == "identity"
+        assert m.normalization_method == "softmax"
+        assert len(m.outputs) == 3
+        assert isinstance(m.outputs[0].derived_field.expression, ir.NormDiscrete)
+
+
+class TestKmeans:
+    def test_structure(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "kmeans.pmml"))
+        m = doc.model
+        assert isinstance(m, ir.ClusteringModelIR)
+        assert m.measure.metric == "squaredEuclidean"
+        assert len(m.clusters) == 5
+        assert all(len(c.center) == 4 for c in m.clusters)
+
+
+class TestStacked:
+    def test_structure(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "stacked.pmml"))
+        m = doc.model
+        assert isinstance(m, ir.MiningModelIR)
+        assert m.segmentation.multiple_model_method == "modelChain"
+        inner = m.segmentation.segments[0]
+        assert isinstance(inner.model, ir.MiningModelIR)
+        assert inner.output_fields[0].name == "gbm_score"
+        calib = m.segmentation.segments[1].model
+        assert isinstance(calib, ir.RegressionModelIR)
+        assert calib.normalization_method == "logit"
+        assert calib.mining_schema.active_fields == ("gbm_score",)
+
+
+class TestArrayParsing:
+    def test_plain_tokens(self):
+        class Fake:
+            text = "a b 3.5"
+
+        assert _parse_string_array(Fake()) == ["a", "b", "3.5"]
+
+    def test_quoted_tokens_with_spaces(self):
+        class Fake:
+            text = '"hello world" plain "with \\" quote"'
+
+        assert _parse_string_array(Fake()) == [
+            "hello world",
+            "plain",
+            'with " quote',
+        ]
+
+
+class TestDeterminism:
+    def test_regeneration_is_byte_identical(self, assets_dir, tmp_path):
+        from assets.generate import gen_iris_lr
+
+        p2 = gen_iris_lr(str(tmp_path))
+        a = (assets_dir / "iris_lr.pmml").read_bytes()
+        b = open(p2, "rb").read()
+        assert a == b
