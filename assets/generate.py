@@ -117,19 +117,11 @@ def gen_iris_lr(out_dir: str, seed: int = 7) -> str:
 
 
 def _gen_tree_nodes(parent, rng, n_features, depth, node_counter, value_scale):
-    """Complete binary tree of the given depth: split on a random feature,
-    children carry (lessThan t, greaterOrEqual t), defaultChild → left."""
-    if depth == 0:
-        leaf = ET.SubElement(
-            parent,
-            "Node",
-            {
-                "id": str(next(node_counter)),
-                "score": _fmt(rng.normal(0.0, value_scale)),
-            },
-        )
-        ET.SubElement(leaf, "True")
-        return
+    """Complete binary tree of the given depth under ``parent``: each split
+    puts complementary (lessThan t, greaterOrEqual t) predicates on the two
+    children; ``defaultChild`` points left; depth-1 children carry scores."""
+    if depth < 1:
+        raise ValueError(f"tree depth must be >= 1, got {depth}")
     feat = int(rng.integers(0, n_features))
     thr = float(rng.normal(0.0, 1.0))
     left_id = str(next(node_counter))
@@ -141,7 +133,12 @@ def _gen_tree_nodes(parent, rng, n_features, depth, node_counter, value_scale):
             "SimplePredicate",
             {"field": f"f{feat}", "operator": op, "value": _fmt(thr)},
         )
-        _gen_tree_nodes(node, rng, n_features, depth - 1, node_counter, value_scale)
+        if depth == 1:
+            node.set("score", _fmt(rng.normal(0.0, value_scale)))
+        else:
+            _gen_tree_nodes(
+                node, rng, n_features, depth - 1, node_counter, value_scale
+            )
     parent.set("defaultChild", left_id)
 
 

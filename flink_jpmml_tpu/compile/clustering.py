@@ -1,0 +1,78 @@
+"""ClusteringModel → JAX: batched distance matrix + argmin.
+
+Reference behavior (quick-evaluate over a K-Means PMML, SURVEY.md §1 C3/C8):
+per record, compute the comparison measure against every cluster center and
+emit the winning cluster. Here the whole batch's distance matrix is one
+broadcasted reduction — ``probs`` carries the per-cluster distances.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
+    if model.model_class != "centerBased":
+        raise ModelCompilationException(
+            f"unsupported ClusteringModel class {model.model_class!r}"
+        )
+    if model.measure.kind != "distance":
+        raise ModelCompilationException(
+            f"unsupported ComparisonMeasure kind {model.measure.kind!r}"
+        )
+    if model.measure.compare_function not in ("absDiff",):
+        raise ModelCompilationException(
+            f"unsupported compareFunction {model.measure.compare_function!r}"
+        )
+    for cf in model.clustering_fields:
+        if cf.compare_function not in (None, "absDiff"):
+            raise ModelCompilationException(
+                f"unsupported per-field compareFunction {cf.compare_function!r}"
+            )
+    metric = model.measure.metric
+
+    cols = np.asarray(
+        [ctx.column(cf.field) for cf in model.clustering_fields], np.int32
+    )
+    centers = np.asarray([c.center for c in model.clusters], np.float32)  # [K,D]
+    if centers.shape[1] != cols.size:
+        raise ModelCompilationException(
+            f"cluster center arity {centers.shape[1]} != clustering fields "
+            f"{cols.size}"
+        )
+    weights = np.asarray(
+        [cf.weight for cf in model.clustering_fields], np.float32
+    )
+    labels = tuple(
+        c.cluster_id or c.name or str(i + 1) for i, c in enumerate(model.clusters)
+    )
+    params = {"centers": centers, "weights": weights}
+
+    def fn(p, X, M):
+        xs = X[:, cols]  # [B, D]
+        missing = jnp.any(M[:, cols], axis=1)
+        diffs = jnp.abs(xs[:, None, :] - p["centers"][None, :, :]) * p["weights"]
+        if metric == "squaredEuclidean":
+            d = jnp.sum(diffs * diffs, axis=-1)
+        elif metric == "euclidean":
+            d = jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
+        elif metric == "cityBlock":
+            d = jnp.sum(diffs, axis=-1)
+        elif metric == "chebychev":
+            d = jnp.max(diffs, axis=-1)
+        else:
+            raise ModelCompilationException(f"unsupported metric {metric!r}")
+        label_idx = jnp.argmin(d, axis=1).astype(jnp.int32)
+        return ModelOutput(
+            value=label_idx.astype(jnp.float32),
+            valid=~missing,
+            probs=d,  # per-cluster distances (oracle exposes the winner's)
+            label_idx=label_idx,
+        )
+
+    return Lowered(fn=fn, params=params, labels=labels)

@@ -1,0 +1,186 @@
+"""Top-level PMML → JAX compiler: dispatch, jit, decode.
+
+Replaces the reference's ``PmmlModel.fromReader`` + ``predict`` core
+(SURVEY.md §3 row B1: expected upstream ``…/api/PmmlModel.scala``
+[UNVERIFIED]) with an ahead-of-time compile: parse → lower → ``jax.jit``
+with a fixed batch shape. The per-record ``predict(vector, replaceNan)``
+becomes ``CompiledModel.predict(X, M)`` over a micro-batch; totality
+(capability C5) is the ``valid`` lane, decoded to ``Prediction`` objects by
+:meth:`CompiledModel.decode`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile import prepare
+from flink_jpmml_tpu.compile.clustering import lower_clustering
+from flink_jpmml_tpu.compile.common import (
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+    apply_targets,
+    build_codecs,
+)
+from flink_jpmml_tpu.compile.mining import lower_mining
+from flink_jpmml_tpu.compile.neural import lower_neural_network
+from flink_jpmml_tpu.compile.regression import lower_regression
+from flink_jpmml_tpu.compile.trees import lower_tree
+from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.config import CompileConfig
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
+    """Dispatch a parsed model to its family lowerer."""
+    if isinstance(model, ir.TreeModelIR):
+        return lower_tree(model, ctx)
+    if isinstance(model, ir.RegressionModelIR):
+        return lower_regression(model, ctx)
+    if isinstance(model, ir.NeuralNetworkIR):
+        return lower_neural_network(model, ctx)
+    if isinstance(model, ir.ClusteringModelIR):
+        return lower_clustering(model, ctx)
+    if isinstance(model, ir.MiningModelIR):
+        return lower_mining(model, ctx)
+    raise ModelCompilationException(
+        f"unsupported model IR {type(model).__name__}"
+    )
+
+
+@dataclass
+class CompiledModel:
+    """A PMML document compiled to a jitted batch scorer.
+
+    ``predict`` is the hot path: numpy/JAX arrays in, :class:`ModelOutput`
+    out, no host-side per-record work. ``score_records`` / ``score_dense``
+    are convenience wrappers that also decode to ``Prediction`` lists.
+    """
+
+    field_space: prepare.FieldSpace
+    labels: Tuple[str, ...]
+    params: Dict
+    batch_size: Optional[int]
+    _jit_fn: object
+    model_name: Optional[str] = None
+
+    @property
+    def is_classification(self) -> bool:
+        return bool(self.labels)
+
+    @property
+    def active_fields(self) -> Tuple[str, ...]:
+        return self.field_space.fields
+
+    def predict(self, X, M) -> ModelOutput:
+        return self._jit_fn(self.params, X, M)
+
+    def warmup(self) -> "CompiledModel":
+        """Force compilation (and params transfer) ahead of the hot path."""
+        b = self.batch_size or 1
+        X = np.zeros((b, self.field_space.arity), np.float32)
+        M = np.zeros((b, self.field_space.arity), bool)
+        jax.block_until_ready(self.predict(X, M))
+        return self
+
+    # -- convenience wrappers (host-side decode; not for the hot loop) -----
+
+    def score_dense(
+        self, vectors, replace_nan: Optional[float] = None
+    ) -> List[Prediction]:
+        X, M = prepare.from_dense(self.field_space, vectors, replace_nan)
+        return self._score(X, M, n=X.shape[0])
+
+    def score_records(self, records: Sequence[dict]) -> List[Prediction]:
+        X, M = prepare.from_records(self.field_space, records)
+        return self._score(X, M, n=X.shape[0])
+
+    def _score(self, X, M, n: int) -> List[Prediction]:
+        if self.batch_size is not None:
+            X, M, _ = prepare.pad_batch(X, M, self.batch_size)
+        out = self.predict(X, M)
+        return self.decode(out, n)
+
+    def decode(self, out: ModelOutput, n: Optional[int] = None) -> List[Prediction]:
+        value = np.asarray(out.value)[:n]
+        valid = np.asarray(out.valid)[:n]
+        labels = None
+        probabilities = None
+        if self.is_classification and out.label_idx is not None:
+            idx = np.asarray(out.label_idx)[:n]
+            labels = [self.labels[i] for i in idx]
+            if out.probs is not None:
+                P = np.asarray(out.probs)[:n]
+                probabilities = [
+                    dict(zip(self.labels, row.tolist())) for row in P
+                ]
+        return decode_batch(value.tolist(), valid.tolist(), labels, probabilities)
+
+
+def compile_pmml(
+    doc: ir.PmmlDocument,
+    batch_size: Optional[int] = None,
+    config: Optional[CompileConfig] = None,
+    donate: Optional[bool] = None,
+) -> CompiledModel:
+    """Parse-tree → jitted scorer (capability C1 + the north-star hot path).
+
+    ``batch_size`` fixes the traced batch shape (None = shape-polymorphic:
+    jit re-traces per distinct batch size — fine for tests, wrong for the
+    streaming runtime, which always pads to a fixed size).
+    """
+    config = config or CompileConfig()
+    fields = doc.active_fields
+    if not fields:
+        raise ModelCompilationException("model has no active fields")
+    ctx = LowerCtx(
+        field_index={f: i for i, f in enumerate(fields)},
+        codecs=build_codecs(doc.data_dictionary),
+        config=config,
+    )
+    lowered = lower_model(doc.model, ctx)
+
+    # top-level mining-schema missingValueReplacement (C4), vectorized
+    schema = doc.model.mining_schema
+    repl = np.zeros((len(fields),), np.float32)
+    has_repl = np.zeros((len(fields),), bool)
+    for mf in schema.fields:
+        if mf.missing_value_replacement is not None and mf.name in ctx.field_index:
+            j = ctx.field_index[mf.name]
+            has_repl[j] = True
+            repl[j] = ctx.encode(mf.name, mf.missing_value_replacement)
+    any_repl = bool(has_repl.any())
+    targets = doc.targets
+
+    def full_fn(params, X, M):
+        X = X.astype(jnp.float32)
+        if any_repl:
+            use = M & has_repl[None, :]
+            X = jnp.where(use, repl[None, :], X)
+            M = M & ~has_repl[None, :]
+        out = lowered.fn(params, X, M)
+        return apply_targets(out, targets)
+
+    donate_args = (
+        config.donate_batches if donate is None else donate
+    )
+    jit_fn = jax.jit(
+        full_fn, donate_argnums=(1, 2) if donate_args else ()
+    )
+
+    name = getattr(doc.model, "model_name", None)
+    return CompiledModel(
+        field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
+        labels=lowered.labels,
+        params=jax.device_put(lowered.params),
+        batch_size=batch_size,
+        _jit_fn=jit_fn,
+        model_name=name,
+    )

@@ -1,0 +1,529 @@
+"""TreeModel / tree ensembles → JAX via a path-matrix einsum lowering.
+
+This is the performance-critical lowering (BASELINE config 2: 500-tree GBM at
+≥1M rec/s/chip). The reference walks each tree per record on the CPU
+(SURVEY.md §4.1 hot loop); a TPU wants matmuls, so we restructure evaluation
+as three dense contractions (the "GEMM strategy" family — cf. Hummingbird —
+adapted to per-tree block structure so the FLOP count stays linear in
+trees × leaves):
+
+1. **Split indicators**: gather each split's feature into ``x[B,T,S]``,
+   compare against thresholds → ``go_left[B,T,S]`` (missing values follow the
+   split's ``defaultChild`` direction, or poison the lane when the strategy
+   demands a null prediction).
+2. **Leaf matching**: encode each tree's topology as a path matrix
+   ``P[T,S,L] ∈ {+1 (left edge), −1 (right edge), 0 (off-path)}`` with
+   per-leaf edge counts ``c[T,L]``. A leaf is reached iff
+   ``einsum('bts,tsl->btl', sign(go_left), P) == c`` — an MXU-friendly
+   batched matmul. Operands are cast to ``CompileConfig.matmul_dtype``
+   (bfloat16 by default): values are in {−1,0,+1} and path sums are bounded
+   by tree depth ≤ 255, all exactly representable in bf16 with float32
+   accumulation, so the comparison is exact.
+3. **Leaf values**: one-hot leaf selection contracts with leaf values
+   (float32, to preserve regression exactness) or per-class distributions.
+
+Trees deeper than ``CompileConfig.max_dense_depth`` use an iterative
+node-hop traversal (``lax.fori_loop`` + gathers) instead — O(depth) gathers
+rather than an O(S·L) matmul.
+
+Supported missing-value strategies: ``defaultChild``, ``none``,
+``nullPrediction`` (vectorized as data); ``lastPrediction`` is rejected at
+compile time (the oracle supports it; a lowering can follow).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import HIGHEST, Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+# opcodes for canonical splits (static per model)
+_OPS = {"lessThan": 0, "lessOrEqual": 1, "greaterThan": 2, "greaterOrEqual": 3,
+        "equal": 4, "notEqual": 5}
+_COMPLEMENT = {
+    "lessThan": "greaterOrEqual",
+    "lessOrEqual": "greaterThan",
+    "greaterThan": "lessOrEqual",
+    "greaterOrEqual": "lessThan",
+    "equal": "notEqual",
+    "notEqual": "equal",
+}
+
+
+@dataclass
+class _CanonLeaf:
+    score: Optional[str]
+    distribution: Tuple[ir.ScoreDistribution, ...]
+
+
+@dataclass
+class _CanonSplit:
+    col: int
+    op: str
+    value: float
+    default_left: bool
+    missing_null: bool  # True → a missing value here nulls the prediction
+    left: "_CanonNode"
+    right: "_CanonNode"
+
+
+_CanonNode = object  # _CanonSplit | _CanonLeaf
+
+
+def _canonicalize(
+    node: ir.TreeNode, model: ir.TreeModelIR, ctx: LowerCtx
+) -> _CanonNode:
+    """Reduce a PMML tree node to canonical binary form.
+
+    Canonical: every internal node has exactly two children whose predicates
+    are (P, complement-of-P) or (P, True) for a simple comparison P. This is
+    the shape every mainstream GBM/CART exporter emits. Non-canonical trees
+    raise with a clear message rather than silently misevaluating.
+    """
+    if node.is_leaf:
+        return _CanonLeaf(score=node.score, distribution=node.score_distribution)
+    if len(node.children) != 2:
+        raise ModelCompilationException(
+            f"non-binary tree node (id={node.node_id!r}, "
+            f"{len(node.children)} children) — only binary-split trees lower "
+            "to the dense path"
+        )
+    c1, c2 = node.children
+    p1, p2 = c1.predicate, c2.predicate
+
+    split = _extract_split(p1, p2, ctx, node)
+    if split is None:
+        # degenerate: first child is catch-all → it always wins (first-match)
+        if isinstance(p1, ir.TruePredicate):
+            return _canonicalize(c1, model, ctx)
+        raise ModelCompilationException(
+            f"tree node {node.node_id!r} children predicates "
+            f"({type(p1).__name__}, {type(p2).__name__}) are not a canonical "
+            "binary split"
+        )
+    col, op, value = split
+
+    strategy = model.missing_value_strategy
+    if strategy == "defaultChild":
+        if node.default_child is not None:
+            default_left = node.default_child == c1.node_id
+            if not default_left and node.default_child != c2.node_id:
+                raise ModelCompilationException(
+                    f"defaultChild {node.default_child!r} names no child of "
+                    f"node {node.node_id!r}"
+                )
+            missing_null = False
+        else:
+            # no defaultChild attribute: a missing value nulls the prediction
+            default_left, missing_null = True, True
+    elif strategy in ("none", "nullPrediction"):
+        default_left, missing_null = True, True
+    else:
+        raise ModelCompilationException(
+            f"missingValueStrategy {strategy!r} has no vectorized lowering "
+            "(supported: defaultChild, none, nullPrediction)"
+        )
+
+    return _CanonSplit(
+        col=col,
+        op=op,
+        value=value,
+        default_left=default_left,
+        missing_null=missing_null,
+        left=_canonicalize(c1, model, ctx),
+        right=_canonicalize(c2, model, ctx),
+    )
+
+
+def _extract_split(
+    p1: ir.Predicate, p2: ir.Predicate, ctx: LowerCtx, node: ir.TreeNode
+) -> Optional[Tuple[int, str, float]]:
+    """(left predicate, right predicate) → (col, op, value) or None."""
+    if isinstance(p1, ir.SimplePredicate) and p1.operator in _OPS:
+        col = ctx.column(p1.field)
+        value = ctx.encode(p1.field, p1.value)
+        if isinstance(p2, ir.TruePredicate):
+            return col, p1.operator, value
+        if (
+            isinstance(p2, ir.SimplePredicate)
+            and p2.field == p1.field
+            and p2.operator == _COMPLEMENT[p1.operator]
+            and p2.value == p1.value
+        ):
+            return col, p1.operator, value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Packing: canonical trees → padded dense arrays
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FlatTree:
+    # per split
+    cols: List[int] = dc_field(default_factory=list)
+    ops: List[int] = dc_field(default_factory=list)
+    values: List[float] = dc_field(default_factory=list)
+    dleft: List[bool] = dc_field(default_factory=list)
+    mnull: List[bool] = dc_field(default_factory=list)
+    # per leaf
+    leaf_scores: List[Optional[str]] = dc_field(default_factory=list)
+    leaf_dists: List[Tuple[ir.ScoreDistribution, ...]] = dc_field(
+        default_factory=list
+    )
+    paths: List[List[Tuple[int, int]]] = dc_field(default_factory=list)
+    # (split_idx, +1 left / −1 right) per edge on the leaf's path
+    depth: int = 0
+
+
+def _flatten(node: _CanonNode, flat: _FlatTree, path: List[Tuple[int, int]]):
+    if isinstance(node, _CanonLeaf):
+        flat.leaf_scores.append(node.score)
+        flat.leaf_dists.append(node.distribution)
+        flat.paths.append(list(path))
+        flat.depth = max(flat.depth, len(path))
+        return
+    s: _CanonSplit = node
+    idx = len(flat.cols)
+    flat.cols.append(s.col)
+    flat.ops.append(_OPS[s.op])
+    flat.values.append(s.value)
+    flat.dleft.append(s.default_left)
+    flat.mnull.append(s.missing_null)
+    _flatten(s.left, flat, path + [(idx, +1)])
+    _flatten(s.right, flat, path + [(idx, -1)])
+
+
+@dataclass
+class PackedEnsemble:
+    """Padded dense arrays for T trees (static shape metadata + params)."""
+
+    n_trees: int
+    n_splits: int  # S (max, padded)
+    n_leaves: int  # L (max, padded)
+    depth: int
+    opcodes: np.ndarray  # i8[T, S] — static (specializes comparisons)
+    uniform_op: Optional[int]
+    labels: Tuple[str, ...]  # classification class list ((),) for regression
+    params: Dict[str, np.ndarray]
+    # params: feat i32[T,S], thresh f32[T,S], dleft f32[T,S], mnull f32[T,S],
+    #         P f32[T,S,L], count f32[T,L],
+    #         leaf_values f32[T,L] (regression) or leaf_probs f32[T,L,C] and
+    #         leaf_label i8/i32[T,L] (classification)
+
+
+def pack_ensemble(
+    trees: Sequence[ir.TreeModelIR], ctx: LowerCtx
+) -> PackedEnsemble:
+    classification = trees[0].function_name == "classification"
+    for t in trees:
+        if (t.function_name == "classification") != classification:
+            raise ModelCompilationException(
+                "mixed regression/classification trees in one ensemble"
+            )
+        if not isinstance(t.root.predicate, (ir.TruePredicate,)):
+            raise ModelCompilationException(
+                "tree root predicate must be <True/> for the dense lowering"
+            )
+
+    flats: List[_FlatTree] = []
+    for t in trees:
+        flat = _FlatTree()
+        _flatten(_canonicalize(t.root, t, ctx), flat, [])
+        if not flat.cols:
+            # single-leaf tree: manufacture a no-op split so S ≥ 1
+            flat.cols, flat.ops, flat.values = [0], [0], [float("inf")]
+            flat.dleft, flat.mnull = [True], [False]
+            flat.paths = [[(0, +1)], [(0, -1)]]
+            flat.leaf_scores = flat.leaf_scores * 2
+            flat.leaf_dists = flat.leaf_dists * 2
+            flat.depth = 1
+        flats.append(flat)
+
+    T = len(flats)
+    S = max(len(f.cols) for f in flats)
+    L = max(len(f.leaf_scores) for f in flats)
+    depth = max(f.depth for f in flats)
+
+    feat = np.zeros((T, S), np.int32)
+    ops = np.zeros((T, S), np.int8)
+    thresh = np.zeros((T, S), np.float32)
+    dleft = np.zeros((T, S), np.float32)
+    mnull = np.zeros((T, S), np.float32)
+    P = np.zeros((T, S, L), np.float32)
+    count = np.full((T, L), -5.0, np.float32)  # padded leaves can never match
+
+    labels: Tuple[str, ...] = ()
+    if classification:
+        label_set: List[str] = []
+        for f in flats:
+            for s, dist in zip(f.leaf_scores, f.leaf_dists):
+                for d in dist:
+                    if d.value not in label_set:
+                        label_set.append(d.value)
+                if s is not None and s not in label_set:
+                    label_set.append(s)
+        labels = tuple(label_set)
+        C = len(labels)
+        leaf_probs = np.zeros((T, L, C), np.float32)
+        leaf_label = np.zeros((T, L), np.int32)
+    else:
+        leaf_values = np.zeros((T, L), np.float32)
+
+    for ti, f in enumerate(flats):
+        ns = len(f.cols)
+        feat[ti, :ns] = f.cols
+        ops[ti, :ns] = f.ops
+        thresh[ti, :ns] = f.values
+        dleft[ti, :ns] = np.asarray(f.dleft, np.float32)
+        mnull[ti, :ns] = np.asarray(f.mnull, np.float32)
+        for li, path in enumerate(f.paths):
+            count[ti, li] = len(path)
+            for s_idx, direction in path:
+                P[ti, s_idx, li] = direction
+            score = f.leaf_scores[li]
+            if classification:
+                dist = f.leaf_dists[li]
+                total = sum(d.record_count for d in dist)
+                probs = {}
+                for d in dist:
+                    if d.probability is not None:
+                        probs[d.value] = d.probability
+                    elif total > 0:
+                        probs[d.value] = d.record_count / total
+                lab = score if score is not None else (
+                    max(probs, key=probs.get) if probs else None
+                )
+                if lab is None:
+                    raise ModelCompilationException(
+                        f"classification leaf {li} in tree {ti} has neither "
+                        "score nor ScoreDistribution"
+                    )
+                leaf_label[ti, li] = labels.index(lab)
+                for lbl, pr in probs.items():
+                    leaf_probs[ti, li, labels.index(lbl)] = pr
+                if not probs:
+                    leaf_probs[ti, li, labels.index(lab)] = 1.0
+            else:
+                if score is None:
+                    raise ModelCompilationException(
+                        f"regression leaf {li} in tree {ti} has no score"
+                    )
+                try:
+                    leaf_values[ti, li] = float(score)
+                except ValueError:
+                    raise ModelCompilationException(
+                        f"regression leaf score {score!r} is not numeric"
+                    ) from None
+
+    # uniform-op specialization: padded split slots don't constrain it
+    real_ops = {op for f in flats for op in f.ops}
+    uniform_op = real_ops.pop() if len(real_ops) == 1 else None
+    if uniform_op is not None:
+        ops[:] = uniform_op
+
+    params: Dict[str, np.ndarray] = {
+        "feat": feat,
+        "thresh": thresh,
+        "dleft": dleft,
+        "mnull": mnull,
+        "P": P,
+        "count": count,
+    }
+    if classification:
+        params["leaf_probs"] = leaf_probs
+        params["leaf_label"] = leaf_label.astype(np.float32)
+    else:
+        params["leaf_values"] = leaf_values
+
+    return PackedEnsemble(
+        n_trees=T,
+        n_splits=S,
+        n_leaves=L,
+        depth=depth,
+        opcodes=ops,
+        uniform_op=int(uniform_op) if uniform_op is not None else None,
+        labels=labels,
+        params=params,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+
+def _go_left(
+    x: jnp.ndarray,  # f32[B, T, S] gathered feature values
+    m: jnp.ndarray,  # bool[B, T, S] missing
+    p: dict,
+    opcodes: np.ndarray,
+    uniform_op: Optional[int],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (go_left bool[B,T,S], nulled bool[B,T,S])."""
+    t = p["thresh"][None, :, :]
+    if uniform_op is not None:
+        op = uniform_op
+        cmp = (
+            x < t if op == 0 else
+            x <= t if op == 1 else
+            x > t if op == 2 else
+            x >= t if op == 3 else
+            x == t if op == 4 else
+            x != t
+        )
+    else:
+        oc = opcodes[None, :, :]
+        cmp = jnp.where(
+            oc == 0, x < t,
+            jnp.where(oc == 1, x <= t,
+            jnp.where(oc == 2, x > t,
+            jnp.where(oc == 3, x >= t,
+            jnp.where(oc == 4, x == t, x != t)))),
+        )
+    go = jnp.where(m, p["dleft"][None] > 0.5, cmp)
+    nulled = m & (p["mnull"][None] > 0.5)
+    return go, nulled
+
+
+def make_ensemble_eval(packed: PackedEnsemble, ctx: LowerCtx):
+    """→ fn(params, X, M) -> (sel bf/f32[B,T,L] one-hot, tree_null bool[B,T]).
+
+    ``sel`` one-hot selects each tree's reached leaf; ``tree_null`` marks
+    (record, tree) pairs whose selected path crossed a missing-nulled split.
+    """
+    # bf16 topology matmuls are exact here (±1/0 operands, depth-bounded
+    # sums) and run at full MXU rate on TPU; the CPU backend has no bf16 dot
+    # kernel, so fall back to f32 there.
+    use_bf16 = (
+        ctx.config.matmul_dtype == "bfloat16"
+        and jax.default_backend() != "cpu"
+    )
+    cdtype = jnp.bfloat16 if use_bf16 else jnp.float32
+    opcodes = packed.opcodes
+    uniform_op = packed.uniform_op
+
+    def fn(p: dict, X: jnp.ndarray, M: jnp.ndarray):
+        feat = p["feat"]  # i32[T, S]
+        x = X[:, feat]  # [B, T, S]
+        m = M[:, feat]
+        go, nulled = _go_left(x, m, p, opcodes, uniform_op)
+        sign = (2.0 * go.astype(cdtype) - 1.0)
+        Pm = p["P"].astype(cdtype)
+        match = jnp.einsum(
+            "bts,tsl->btl", sign, Pm, preferred_element_type=jnp.float32
+        )
+        # sel stays float32: XLA would otherwise fuse a bf16 sel through the
+        # downstream value einsums and demote the f32 leaf values to bf16
+        sel = (match == p["count"][None]).astype(jnp.float32)  # one-hot [B,T,L]
+        # a nulled split on the selected path ⇒ tree result is null
+        nullcnt = jnp.einsum(
+            "bts,tsl->btl",
+            nulled.astype(cdtype),
+            jnp.abs(Pm),
+            preferred_element_type=jnp.float32,
+        )
+        on_path_null = jnp.einsum(
+            "btl,btl->bt", sel, nullcnt, precision=HIGHEST
+        )
+        return sel, on_path_null > 0.5
+
+    return fn
+
+
+def lower_tree_ensemble(
+    trees: Sequence[ir.TreeModelIR],
+    weights: Sequence[float],
+    method: str,
+    ctx: LowerCtx,
+) -> Lowered:
+    """Fused lowering for an ensemble of canonical trees under one
+    segmentation method (the 500-tree-GBM fast path). ``method`` ∈
+    {sum, average, weightedAverage, max, median, majorityVote,
+    weightedMajorityVote} — or 'single' for a lone TreeModel."""
+    packed = pack_ensemble(trees, ctx)
+    ev = make_ensemble_eval(packed, ctx)
+    w = np.asarray(weights, np.float32)
+    T = packed.n_trees
+    classification = bool(packed.labels)
+
+    if not classification:
+        def rfn(p, X, M):
+            sel, tree_null = ev(p, X, M)
+            per_tree = jnp.einsum(
+                "btl,tl->bt", sel, p["leaf_values"], precision=HIGHEST
+            )
+            valid = ~jnp.any(tree_null, axis=1)
+            if method in ("sum", "single"):
+                value = jnp.sum(per_tree, axis=1)
+            elif method == "average":
+                value = jnp.mean(per_tree, axis=1)
+            elif method == "weightedAverage":
+                value = jnp.dot(per_tree, w, precision=HIGHEST) / np.float32(w.sum())
+            elif method == "max":
+                value = jnp.max(per_tree, axis=1)
+            elif method == "median":
+                value = jnp.median(per_tree, axis=1)
+            else:
+                raise ModelCompilationException(
+                    f"unsupported regression ensemble method {method!r}"
+                )
+            return ModelOutput(value=value, valid=valid)
+
+        return Lowered(fn=rfn, params=packed.params)
+
+    C = len(packed.labels)
+
+    if method not in ("single", "majorityVote", "weightedMajorityVote"):
+        # sum/average over classification trees aggregate *numeric* winning
+        # probabilities in the oracle — not votes; route those through the
+        # generic per-segment path (mining._lower_aggregate) instead
+        raise ModelCompilationException(
+            f"classification ensemble method {method!r} has no fused lowering"
+        )
+
+    def cfn(p, X, M):
+        sel, tree_null = ev(p, X, M)
+        if method == "single":
+            probs = jnp.einsum(
+                "btl,tlc->bc", sel, p["leaf_probs"], precision=HIGHEST
+            )
+            valid = ~tree_null[:, 0]
+        else:
+            # each tree votes its leaf's label one-hot (weighted); a tree
+            # nulled by a missing value abstains (oracle: excluded from the
+            # vote), it does not poison the lane
+            leaf_onehot = jax.nn.one_hot(
+                p["leaf_label"].astype(jnp.int32), C, dtype=jnp.float32
+            )  # [T, L, C]
+            votes = jnp.einsum(
+                "btl,tlc->btc", sel, leaf_onehot, precision=HIGHEST
+            )
+            votes = votes * (~tree_null).astype(jnp.float32)[:, :, None]
+            if method == "weightedMajorityVote":
+                votes = votes * w[None, :, None]
+            total = jnp.sum(votes, axis=(1, 2))
+            probs = jnp.sum(votes, axis=1) / jnp.maximum(
+                total[:, None], 1e-30
+            )
+            valid = total > 0
+        label_idx = jnp.argmax(probs, axis=1).astype(jnp.int32)
+        value = jnp.take_along_axis(probs, label_idx[:, None], axis=1)[:, 0]
+        return ModelOutput(
+            value=value, valid=valid, probs=probs, label_idx=label_idx
+        )
+
+    return Lowered(fn=cfn, params=packed.params, labels=packed.labels)
+
+
+def lower_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
+    """A standalone TreeModel is an ensemble of one."""
+    return lower_tree_ensemble([model], [1.0], "single", ctx)

@@ -234,11 +234,41 @@ def _apply_function(fn: str, args: List[float]) -> Optional[float]:
 
 
 def evaluate(doc: ir.PmmlDocument, record: Record) -> EvalResult:
-    """Score one record through the document, applying mining-schema missing
-    value replacement and Targets rescaling — the oracle's public entry."""
-    rec = _apply_missing_replacement(doc.model.mining_schema, record)
+    """Score one record through the document, applying DataDictionary value
+    sanitization, mining-schema missing-value replacement and Targets
+    rescaling — the oracle's public entry."""
+    rec = _sanitize_categoricals(doc.data_dictionary, record)
+    rec = _apply_missing_replacement(doc.model.mining_schema, rec)
     res = _eval_model(doc.model, rec)
     return _apply_targets(doc.targets, res)
+
+
+def _sanitize_categoricals(dd: ir.DataDictionary, record: Record) -> Record:
+    """DataDictionary-declared string categoricals: an undeclared string
+    value is *invalid* → treated as missing (matching the compiled path's
+    codec behavior); a float value is interpreted as a pre-encoded category
+    code (the dense-vector convention) and decoded back to its category."""
+    decl = {
+        f.name: f.values
+        for f in dd.fields
+        if f.is_categorical and f.dtype == "string" and f.values
+    }
+    if not decl:
+        return record
+    out = dict(record)
+    for name, values in decl.items():
+        if name not in out:
+            continue
+        v = out[name]
+        if _is_missing(v):
+            continue
+        if isinstance(v, str):
+            if v not in values:
+                out[name] = None
+        else:
+            idx = int(v)
+            out[name] = values[idx] if 0 <= idx < len(values) and idx == v else None
+    return out
 
 
 def _apply_missing_replacement(schema: ir.MiningSchema, record: Record) -> Record:

@@ -64,7 +64,9 @@ class CompileConfig:
     # Hard cap on supported tree depth for the padded-dense lowering; deeper
     # trees fall back to the iterative gather traversal.
     max_dense_depth: int = 10
-    donate_batches: bool = True
+    # donate input batch buffers to the jitted call; off by default because
+    # score outputs rarely alias input shapes (XLA would warn and ignore it)
+    donate_batches: bool = False
 
 
 @dataclass(frozen=True)

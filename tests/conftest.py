@@ -15,6 +15,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The axon TPU plugin ignores the JAX_PLATFORMS env var in this image, so
+# force the CPU backend through the config API as well — otherwise "CPU"
+# tests silently run on the real chip.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import pathlib
 import sys
 

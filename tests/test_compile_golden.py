@@ -1,0 +1,395 @@
+"""Golden tests: the JAX lowering vs the reference interpreter (oracle).
+
+This is the SURVEY.md §5 tier-1 strategy: "pure unit tests of the PMML→JAX
+compiler per model class against golden outputs". Every family is diffed
+against the oracle over randomized record batches, including lanes with
+missing values, so both value semantics and totality semantics are pinned.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml, parse_pmml_file
+from flink_jpmml_tpu.pmml.interp import evaluate
+from flink_jpmml_tpu.utils.exceptions import (
+    InputValidationException,
+    ModelCompilationException,
+)
+
+RTOL = 2e-4  # bf16 match einsum is exact; float32 math differs from float64
+
+
+def _random_records(fields, n, rng, missing_rate=0.0, scale=2.0, loc=0.0):
+    X = rng.normal(loc, scale, size=(n, len(fields)))
+    recs = []
+    for b in range(n):
+        rec = {}
+        for j, f in enumerate(fields):
+            if missing_rate and rng.random() < missing_rate:
+                rec[f] = None
+            else:
+                rec[f] = float(X[b, j])
+        recs.append(rec)
+    return recs
+
+
+def _assert_match(cm, doc, records, check_label=True):
+    preds = cm.score_records(records)
+    for rec, p in zip(records, preds):
+        o = evaluate(doc, rec)
+        if o.is_missing:
+            assert p.is_empty, f"oracle empty but compiled gave {p} for {rec}"
+            continue
+        assert not p.is_empty, f"compiled empty but oracle gave {o} for {rec}"
+        if o.value is not None:
+            assert p.score.value == pytest.approx(o.value, rel=RTOL, abs=1e-5), rec
+        if check_label and o.label is not None:
+            assert p.target is not None and p.target.label == o.label, (
+                rec, p.target, o.label, o.probabilities,
+            )
+
+
+class TestRegressionGolden:
+    def test_iris_lr(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "iris_lr.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(1)
+        recs = _random_records(doc.active_fields, 64, rng, loc=4.0)
+        _assert_match(cm, doc, recs)
+
+    def test_iris_lr_with_missing(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "iris_lr.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(2)
+        recs = _random_records(doc.active_fields, 64, rng, missing_rate=0.3)
+        _assert_match(cm, doc, recs)
+
+    def test_probabilities_match(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "iris_lr.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(3)
+        recs = _random_records(doc.active_fields, 8, rng, loc=4.0)
+        preds = cm.score_records(recs)
+        for rec, p in zip(recs, preds):
+            o = evaluate(doc, rec)
+            for lbl, prob in o.probabilities.items():
+                assert p.target.probabilities[lbl] == pytest.approx(
+                    prob, rel=RTOL, abs=1e-6
+                )
+
+    def test_categorical_predictor_with_codec(self):
+        doc = parse_pmml(
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="color" optype="categorical" dataType="string">'
+            '<Value value="red"/><Value value="blue"/></DataField>'
+            '<DataField name="x" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<RegressionModel functionName="regression">'
+            '<MiningSchema><MiningField name="color"/><MiningField name="x"/>'
+            "</MiningSchema>"
+            '<RegressionTable intercept="1.0">'
+            '<NumericPredictor name="x" coefficient="2.0"/>'
+            '<CategoricalPredictor name="color" value="red" coefficient="5.0"/>'
+            "</RegressionTable></RegressionModel></PMML>"
+        )
+        cm = compile_pmml(doc)
+        recs = [
+            {"color": "red", "x": 1.0},
+            {"color": "blue", "x": 1.0},
+            {"color": None, "x": 1.0},
+            {"color": "green", "x": 1.0},  # undeclared category → missing cat
+        ]
+        _assert_match(cm, doc, recs)
+
+    def test_exponent(self):
+        doc = parse_pmml(
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="x" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<RegressionModel functionName="regression" '
+            'normalizationMethod="exp">'
+            '<MiningSchema><MiningField name="x"/></MiningSchema>'
+            '<RegressionTable intercept="0.5">'
+            '<NumericPredictor name="x" coefficient="1.5" exponent="3"/>'
+            "</RegressionTable></RegressionModel></PMML>"
+        )
+        cm = compile_pmml(doc)
+        _assert_match(cm, doc, [{"x": 0.7}, {"x": -1.2}, {"x": 2.0}])
+
+
+class TestTreeGolden:
+    def test_gbm_sum(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "gbm_small.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(4)
+        recs = _random_records(doc.active_fields, 128, rng)
+        _assert_match(cm, doc, recs)
+
+    def test_gbm_with_missing_default_child(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "gbm_small.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(5)
+        recs = _random_records(doc.active_fields, 128, rng, missing_rate=0.25)
+        _assert_match(cm, doc, recs)
+
+    def test_single_tree_none_strategy_missing_is_empty(self):
+        xml = (
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="a" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<TreeModel functionName="regression" missingValueStrategy="none">'
+            '<MiningSchema><MiningField name="a"/></MiningSchema>'
+            '<Node id="r"><True/>'
+            '<Node id="l" score="1"><SimplePredicate field="a" '
+            'operator="lessThan" value="0"/></Node>'
+            '<Node id="rr" score="2"><SimplePredicate field="a" '
+            'operator="greaterOrEqual" value="0"/></Node>'
+            "</Node></TreeModel></PMML>"
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        _assert_match(cm, doc, [{"a": -1.0}, {"a": 1.0}, {"a": None}])
+
+    def test_classification_tree(self):
+        xml = (
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="a" optype="continuous" dataType="double"/>'
+            '<DataField name="b" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<TreeModel functionName="classification">'
+            '<MiningSchema><MiningField name="a"/><MiningField name="b"/>'
+            "</MiningSchema>"
+            '<Node id="r"><True/>'
+            '<Node id="l"><SimplePredicate field="a" operator="lessThan" '
+            'value="0"/>'
+            '<Node id="ll" score="cat"><SimplePredicate field="b" '
+            'operator="lessThan" value="1"/>'
+            '<ScoreDistribution value="cat" recordCount="8"/>'
+            '<ScoreDistribution value="dog" recordCount="2"/></Node>'
+            '<Node id="lr" score="dog"><SimplePredicate field="b" '
+            'operator="greaterOrEqual" value="1"/>'
+            '<ScoreDistribution value="cat" recordCount="1"/>'
+            '<ScoreDistribution value="dog" recordCount="9"/></Node>'
+            "</Node>"
+            '<Node id="rr" score="bird"><SimplePredicate field="a" '
+            'operator="greaterOrEqual" value="0"/>'
+            '<ScoreDistribution value="bird" recordCount="10"/></Node>'
+            "</Node></TreeModel></PMML>"
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(6)
+        recs = _random_records(("a", "b"), 64, rng, scale=1.5)
+        _assert_match(cm, doc, recs)
+        preds = cm.score_records(recs)
+        for rec, p in zip(recs, preds):
+            o = evaluate(doc, rec)
+            for lbl, pr in o.probabilities.items():
+                assert p.target.probabilities[lbl] == pytest.approx(
+                    pr, rel=RTOL, abs=1e-6
+                )
+
+    def test_majority_vote_forest(self):
+        trees = "".join(
+            f'<Segment id="{i}"><True/>'
+            '<TreeModel functionName="classification">'
+            '<MiningSchema><MiningField name="a"/></MiningSchema>'
+            f'<Node id="r"><True/>'
+            f'<Node id="l" score="{l1}"><SimplePredicate field="a" '
+            f'operator="lessThan" value="{thr}"/></Node>'
+            f'<Node id="rr" score="{l2}"><SimplePredicate field="a" '
+            f'operator="greaterOrEqual" value="{thr}"/></Node>'
+            "</Node></TreeModel></Segment>"
+            for i, (thr, l1, l2) in enumerate(
+                [(0.0, "x", "y"), (0.5, "x", "y"), (-0.5, "y", "x")]
+            )
+        )
+        xml = (
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="a" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<MiningModel functionName="classification">'
+            '<MiningSchema><MiningField name="a"/></MiningSchema>'
+            f'<Segmentation multipleModelMethod="majorityVote">{trees}'
+            "</Segmentation></MiningModel></PMML>"
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(7)
+        recs = _random_records(("a",), 64, rng, scale=1.0)
+        _assert_match(cm, doc, recs)
+        # a missing split field makes trees abstain (strategy 'none'), but
+        # the remaining votes still elect a winner — lane must stay valid
+        _assert_match(cm, doc, [{"a": None}])
+
+    def test_classification_average_uses_numeric_path(self):
+        # sum/average over classification trees aggregates winning
+        # probabilities (no label) — must match the oracle via the generic
+        # per-segment path, not the vote-based fused path
+        trees = "".join(
+            f'<Segment id="{i}"><True/>'
+            '<TreeModel functionName="classification">'
+            '<MiningSchema><MiningField name="a"/></MiningSchema>'
+            f'<Node id="r"><True/>'
+            f'<Node id="l" score="x"><SimplePredicate field="a" '
+            f'operator="lessThan" value="{thr}"/>'
+            '<ScoreDistribution value="x" recordCount="7"/>'
+            '<ScoreDistribution value="y" recordCount="3"/></Node>'
+            f'<Node id="rr" score="y"><SimplePredicate field="a" '
+            f'operator="greaterOrEqual" value="{thr}"/>'
+            '<ScoreDistribution value="x" recordCount="2"/>'
+            '<ScoreDistribution value="y" recordCount="8"/></Node>'
+            "</Node></TreeModel></Segment>"
+            for i, thr in enumerate([0.0, 0.5])
+        )
+        xml = (
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="a" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<MiningModel functionName="classification">'
+            '<MiningSchema><MiningField name="a"/></MiningSchema>'
+            f'<Segmentation multipleModelMethod="average">{trees}'
+            "</Segmentation></MiningModel></PMML>"
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        _assert_match(
+            cm, doc, [{"a": -1.0}, {"a": 0.2}, {"a": 1.0}], check_label=False
+        )
+
+    def test_deep_or_set_trees_rejected_clearly(self):
+        # non-binary node → clear compile error, not silent misevaluation
+        xml = (
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="a" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<TreeModel functionName="regression">'
+            '<MiningSchema><MiningField name="a"/></MiningSchema>'
+            '<Node id="r"><True/>'
+            '<Node id="1" score="1"><SimplePredicate field="a" '
+            'operator="lessThan" value="0"/></Node>'
+            '<Node id="2" score="2"><SimplePredicate field="a" '
+            'operator="lessThan" value="1"/></Node>'
+            '<Node id="3" score="3"><True/></Node>'
+            "</Node></TreeModel></PMML>"
+        )
+        with pytest.raises(ModelCompilationException, match="non-binary"):
+            compile_pmml(parse_pmml(xml))
+
+
+class TestNeuralGolden:
+    def test_mlp(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "mlp_small.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(8)
+        recs = _random_records(doc.active_fields, 64, rng, scale=1.0)
+        _assert_match(cm, doc, recs)
+
+    def test_mlp_missing_input_is_empty(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "mlp_small.pmml"))
+        cm = compile_pmml(doc)
+        recs = [{f: (None if f == "x3" else 0.5) for f in doc.active_fields}]
+        _assert_match(cm, doc, recs)
+
+    def test_regression_nn_with_denorm(self):
+        xml = (
+            '<PMML version="4.3"><DataDictionary>'
+            '<DataField name="a" optype="continuous" dataType="double"/>'
+            "</DataDictionary>"
+            '<NeuralNetwork functionName="regression" '
+            'activationFunction="tanh">'
+            '<MiningSchema><MiningField name="a"/></MiningSchema>'
+            '<NeuralInputs><NeuralInput id="i0">'
+            '<DerivedField optype="continuous" dataType="double">'
+            '<NormContinuous field="a">'
+            '<LinearNorm orig="0" norm="0"/><LinearNorm orig="10" norm="1"/>'
+            "</NormContinuous></DerivedField></NeuralInput></NeuralInputs>"
+            '<NeuralLayer><Neuron id="h" bias="0.1">'
+            '<Con from="i0" weight="1.3"/></Neuron></NeuralLayer>'
+            '<NeuralLayer activationFunction="identity">'
+            '<Neuron id="o" bias="0.0"><Con from="h" weight="2.0"/></Neuron>'
+            "</NeuralLayer>"
+            '<NeuralOutputs><NeuralOutput outputNeuron="o">'
+            '<DerivedField optype="continuous" dataType="double">'
+            '<NormContinuous field="t">'
+            '<LinearNorm orig="100" norm="0"/><LinearNorm orig="200" norm="1"/>'
+            "</NormContinuous></DerivedField></NeuralOutput></NeuralOutputs>"
+            "</NeuralNetwork></PMML>"
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        recs = [{"a": v} for v in (-3.0, 0.0, 5.0, 12.0)]
+        _assert_match(cm, doc, recs)
+
+
+class TestClusteringGolden:
+    def test_kmeans(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "kmeans.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(9)
+        recs = _random_records(doc.active_fields, 128, rng, scale=3.0)
+        _assert_match(cm, doc, recs)
+        # winning distance matches the oracle's
+        from flink_jpmml_tpu.compile import prepare
+
+        preds_out = cm.predict(*prepare.from_records(cm.field_space, recs))
+        D = np.asarray(preds_out.probs)
+        for i, rec in enumerate(recs):
+            o = evaluate(doc, rec)
+            assert D[i].min() == pytest.approx(
+                o.probabilities["distance"], rel=1e-4
+            )
+
+    def test_kmeans_missing(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "kmeans.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(10)
+        recs = _random_records(doc.active_fields, 32, rng, missing_rate=0.2)
+        _assert_match(cm, doc, recs)
+
+
+class TestChainGolden:
+    def test_stacked(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "stacked.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(11)
+        recs = _random_records(doc.active_fields, 128, rng)
+        _assert_match(cm, doc, recs)
+
+    def test_stacked_with_missing(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "stacked.pmml"))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(12)
+        recs = _random_records(doc.active_fields, 64, rng, missing_rate=0.2)
+        _assert_match(cm, doc, recs)
+
+
+class TestInputContract:
+    def test_arity_mismatch_raises(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "iris_lr.pmml"))
+        cm = compile_pmml(doc)
+        with pytest.raises(InputValidationException, match="arity"):
+            cm.score_dense(np.zeros((4, 3), np.float32))
+
+    def test_replace_nan(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "iris_lr.pmml"))
+        cm = compile_pmml(doc)
+        X = np.full((2, 4), np.nan, np.float32)
+        # without replacement: missing numeric → empty
+        assert all(p.is_empty for p in cm.score_dense(X))
+        # with replaceNan: scores as if all-zero input
+        preds = cm.score_dense(X, replace_nan=0.0)
+        assert not any(p.is_empty for p in preds)
+        o = evaluate(doc, {f: 0.0 for f in doc.active_fields})
+        assert preds[0].score.value == pytest.approx(o.value, rel=RTOL)
+
+    def test_padded_batch(self, assets_dir):
+        doc = parse_pmml_file(str(assets_dir / "iris_lr.pmml"))
+        cm = compile_pmml(doc, batch_size=32)
+        X = np.ones((5, 4), np.float32)
+        preds = cm.score_dense(X)
+        assert len(preds) == 5
+        assert not any(p.is_empty for p in preds)
