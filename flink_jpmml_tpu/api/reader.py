@@ -1,0 +1,65 @@
+"""ModelReader: the path-not-model distribution contract (capability C2).
+
+Reference parity: ``ModelReader(path)`` (SURVEY.md §3 row B3, §4.4
+[UNVERIFIED]) — the PMML document never travels through the job graph; only
+its *path* does, and every worker loads it independently in the operator's
+``open()`` hook. Here the reader is a tiny pickleable handle; ``load()``
+parses + compiles at the worker, with a process-level cache keyed by
+(path, mtime, batch size) so repeated opens (restarts, multiple pipelines)
+compile once — the idempotent-reload property C7 depends on.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from flink_jpmml_tpu.compile import CompiledModel, compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.utils.config import CompileConfig
+
+_cache_lock = threading.Lock()
+_cache: Dict[Tuple, CompiledModel] = {}
+
+
+@dataclass(frozen=True)
+class ModelReader:
+    path: str
+
+    def load(
+        self,
+        batch_size: Optional[int] = None,
+        config: Optional[CompileConfig] = None,
+        warmup: bool = False,
+    ) -> CompiledModel:
+        key = (
+            os.path.abspath(self.path),
+            _mtime(self.path),
+            batch_size,
+            config,
+        )
+        with _cache_lock:
+            cached = _cache.get(key)
+        if cached is not None:
+            return cached
+        doc = parse_pmml_file(self.path)
+        model = compile_pmml(doc, batch_size=batch_size, config=config)
+        if warmup:
+            model.warmup()
+        with _cache_lock:
+            _cache[key] = model
+        return model
+
+
+def _mtime(path: str) -> float:
+    try:
+        return os.stat(path).st_mtime
+    except OSError:
+        return -1.0
+
+
+def clear_model_cache() -> None:
+    with _cache_lock:
+        _cache.clear()

@@ -54,11 +54,12 @@ class ModelOutput(NamedTuple):
     label_idx: Optional[jnp.ndarray] = None
 
 
-# fn(params, X, M) -> ModelOutput. ``params`` is a pytree of arrays passed as
-# *arguments* rather than closed-over constants, so that two models with the
-# same architecture (e.g. successive versions of a GBM with identical
-# tree/leaf shapes) share one compiled executable — dynamic model swap
-# (capability C6) then costs a host-to-device copy, not a recompile.
+# fn(params, X, M) -> ModelOutput. ``params`` is a pytree of arrays passed
+# as *arguments* rather than closed-over constants: XLA doesn't constant-
+# fold over megabytes of tree tensors, and the door stays open for
+# executable sharing between same-architecture model versions (today each
+# document still gets its own jit entry — sharing would key the jitted fn on
+# an architecture signature; the ModelReader cache dedupes same-path loads).
 ModelFn = Callable[[dict, jnp.ndarray, jnp.ndarray], ModelOutput]
 
 

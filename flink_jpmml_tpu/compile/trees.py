@@ -109,6 +109,13 @@ def _canonicalize(
             "binary split"
         )
     col, op, value = split
+    right_is_catch_all = isinstance(p2, ir.TruePredicate)
+
+    if model.no_true_child_strategy == "returnLastPrediction":
+        raise ModelCompilationException(
+            "noTrueChildStrategy 'returnLastPrediction' has no vectorized "
+            "lowering (interior-node scores; oracle only)"
+        )
 
     strategy = model.missing_value_strategy
     if strategy == "defaultChild":
@@ -123,6 +130,9 @@ def _canonicalize(
         else:
             # no defaultChild attribute: a missing value nulls the prediction
             default_left, missing_null = True, True
+    elif strategy == "none" and right_is_catch_all:
+        # UNKNOWN left predicate → scan continues → the <True/> child matches
+        default_left, missing_null = False, False
     elif strategy in ("none", "nullPrediction"):
         default_left, missing_null = True, True
     else:
@@ -497,6 +507,17 @@ def lower_tree_ensemble(
                 "btl,tlc->bc", sel, p["leaf_probs"], precision=HIGHEST
             )
             valid = ~tree_null[:, 0]
+            # the label comes from the leaf's 'score' attribute (packed as
+            # leaf_label), NOT argmax of the distribution — PMML allows them
+            # to disagree
+            lab = jnp.einsum(
+                "btl,tl->bt", sel, p["leaf_label"], precision=HIGHEST
+            )[:, 0]
+            label_idx = jnp.round(lab).astype(jnp.int32)
+            value = jnp.take_along_axis(probs, label_idx[:, None], axis=1)[:, 0]
+            return ModelOutput(
+                value=value, valid=valid, probs=probs, label_idx=label_idx
+            )
         else:
             # each tree votes its leaf's label one-hot (weighted); a tree
             # nulled by a missing value abstains (oracle: excluded from the

@@ -265,6 +265,8 @@ def _sanitize_categoricals(dd: ir.DataDictionary, record: Record) -> Record:
         if isinstance(v, str):
             if v not in values:
                 out[name] = None
+        elif not math.isfinite(v):
+            out[name] = None
         else:
             idx = int(v)
             out[name] = values[idx] if 0 <= idx < len(values) and idx == v else None

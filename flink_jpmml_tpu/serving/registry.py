@@ -1,0 +1,108 @@
+"""Served-model registry: metadata + lazily compiled models (capability C6).
+
+Reference parity (SURVEY.md §4.3): the dynamic co-operator holds a
+checkpointed map ``ModelId → ModelInfo``; model *instances* are loaded
+lazily from their path on the first matching event, never checkpointed.
+Here "loaded" means parsed + compiled to a jitted scorer, via the
+``ModelReader`` compile cache (same path+mtime loads once per process; a
+*new* version compiles once on first use — async warmup keeps that off the
+hot path).
+
+State for checkpointing is the metadata map alone, as
+``{"name_version": path}`` — JSON-shaped, tiny, resumable (C7).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from flink_jpmml_tpu.api.reader import ModelReader
+from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.models.control import ServingMessage
+from flink_jpmml_tpu.models.core import ModelId, ModelInfo
+from flink_jpmml_tpu.serving import managers
+from flink_jpmml_tpu.utils.config import CompileConfig
+from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+
+class ModelRegistry:
+    def __init__(
+        self,
+        batch_size: Optional[int] = None,
+        compile_config: Optional[CompileConfig] = None,
+    ):
+        self._meta: managers.Metadata = {}
+        self._compiled: Dict[ModelId, CompiledModel] = {}
+        self._lock = threading.Lock()
+        self._batch_size = batch_size
+        self._compile_config = compile_config
+
+    def apply(self, msg: ServingMessage) -> bool:
+        """Apply one control message; returns True if the registry changed."""
+        with self._lock:
+            new_meta, changed = managers.apply_message(self._meta, msg)
+            if changed:
+                removed = set(self._meta) - set(new_meta)
+                self._meta = new_meta
+                for mid in removed:
+                    self._compiled.pop(mid, None)
+            return changed
+
+    def resolve(
+        self, name: str, version: Optional[int] = None
+    ) -> Optional[ModelId]:
+        """Served id for (name, version); version None → newest served."""
+        with self._lock:
+            if version is not None:
+                mid = ModelId(name, version)
+                return mid if mid in self._meta else None
+            v = managers.latest_version(self._meta, name)
+            return ModelId(name, v) if v >= 0 else None
+
+    def model(self, mid: ModelId) -> Optional[CompiledModel]:
+        """The compiled model for a served id; compiles lazily on first use
+        (C6 'lazy load on first matching event'). Returns None if unserved;
+        raises ModelLoadingException if the path is bad — callers decide
+        whether that poisons the lane or the stream."""
+        with self._lock:
+            cached = self._compiled.get(mid)
+            info = self._meta.get(mid)
+        if cached is not None:
+            return cached
+        if info is None:
+            return None
+        compiled = ModelReader(info.path).load(
+            batch_size=self._batch_size, config=self._compile_config
+        )
+        with self._lock:
+            if mid in self._meta:  # deleted concurrently → don't resurrect
+                self._compiled[mid] = compiled
+        return compiled
+
+    @property
+    def served(self) -> Dict[ModelId, ModelInfo]:
+        with self._lock:
+            return dict(self._meta)
+
+    # -- checkpoint state (C7) --------------------------------------------
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "served": {mid.key(): info.path for mid, info in self._meta.items()}
+            }
+
+    def restore(self, state: dict) -> None:
+        served = state.get("served", {})
+        meta: managers.Metadata = {}
+        for key, path in served.items():
+            try:
+                meta[ModelId.from_key(key)] = ModelInfo(path=path)
+            except (ValueError, TypeError) as e:
+                raise ModelLoadingException(
+                    f"corrupt registry checkpoint entry {key!r}: {e}"
+                ) from e
+        with self._lock:
+            self._meta = meta
+            self._compiled.clear()
