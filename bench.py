@@ -1,0 +1,116 @@
+"""Benchmark: 500-tree GBM scoring throughput on one TPU chip.
+
+BASELINE config 2 / north star: "score a 500-tree GBM PMML over a stream at
+>= 1M records/sec with no CPU evaluator in the hot path". The reference
+(flink-jpmml) walks every tree per record on the CPU inside
+JPMML-Evaluator; here the whole micro-batch is three einsums on the MXU.
+
+Measured: steady-state records/sec through the scoring hot path — fresh
+host batches each iteration (host->device transfer included), jitted
+ensemble scoring, validity decode back on the host (device->host included),
+with a 2-deep in-flight window exactly like the streaming runtime. Compile
+and warmup excluded.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is the ratio against the 1M rec/s north-star target
+(the reference publishes no numbers of its own - BASELINE.md).
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+NORTH_STAR_REC_S = 1_000_000.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trees", type=int, default=500)
+    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument("--features", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+
+    cache_dir = os.path.join(
+        tempfile.gettempdir(),
+        f"fjt-bench-{args.trees}x{args.depth}x{args.features}",
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    pmml = os.path.join(cache_dir, f"gbm_{args.trees}.pmml")
+    if not os.path.exists(pmml):
+        gen_gbm(
+            cache_dir,
+            n_trees=args.trees,
+            depth=args.depth,
+            n_features=args.features,
+        )
+
+    cm = compile_pmml(parse_pmml_file(pmml), batch_size=args.batch)
+
+    rng = np.random.default_rng(0)
+    n_buf = 8  # rotate pre-built host batches (fresh arrays, no caching)
+    host_batches = [
+        rng.normal(0, 1, size=(args.batch, args.features)).astype(np.float32)
+        for _ in range(n_buf)
+    ]
+    M = np.zeros((args.batch, args.features), bool)
+
+    def run_once(i):
+        out = cm.predict(host_batches[i % n_buf], M)  # async dispatch
+        return out
+
+    # warmup: compile + stabilize
+    for i in range(3):
+        jax.block_until_ready(run_once(i))
+
+    # timed: 2-deep in-flight window, decode validity on the host each batch
+    in_flight = []
+    n_batches = 0
+    t0 = time.perf_counter()
+    deadline = t0 + args.seconds
+    i = 0
+    while time.perf_counter() < deadline or n_batches < 10:
+        in_flight.append(run_once(i))
+        i += 1
+        if len(in_flight) >= 2:
+            out = in_flight.pop(0)
+            _ = np.asarray(out.valid)  # device->host sync + decode input
+            n_batches += 1
+        if n_batches >= 10 and time.perf_counter() >= deadline:
+            break
+    while in_flight:
+        out = in_flight.pop(0)
+        _ = np.asarray(out.valid)
+        n_batches += 1
+    dt = time.perf_counter() - t0
+
+    rec_s = n_batches * args.batch / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"gbm{args.trees}_records_per_sec_per_chip",
+                "value": round(rec_s, 1),
+                "unit": "records/s/chip",
+                "vs_baseline": round(rec_s / NORTH_STAR_REC_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
