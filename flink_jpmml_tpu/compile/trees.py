@@ -47,6 +47,8 @@ from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 # opcodes for canonical splits (static per model)
 _OPS = {"lessThan": 0, "lessOrEqual": 1, "greaterThan": 2, "greaterOrEqual": 3,
         "equal": 4, "notEqual": 5}
+_OP_IN = 6       # SimpleSetPredicate isIn   (categorical splits)
+_OP_NOT_IN = 7   # SimpleSetPredicate isNotIn
 _COMPLEMENT = {
     "lessThan": "greaterOrEqual",
     "lessOrEqual": "greaterThan",
@@ -66,12 +68,13 @@ class _CanonLeaf:
 @dataclass
 class _CanonSplit:
     col: int
-    op: str
-    value: float
+    op: int  # opcode (_OPS values, _OP_IN, _OP_NOT_IN)
+    value: float  # threshold (comparison splits; 0.0 for set splits)
     default_left: bool
     missing_null: bool  # True → a missing value here nulls the prediction
     left: "_CanonNode"
     right: "_CanonNode"
+    set_values: Tuple[float, ...] = ()  # member codes (set splits only)
 
 
 _CanonNode = object  # _CanonSplit | _CanonLeaf
@@ -108,7 +111,7 @@ def _canonicalize(
             f"({type(p1).__name__}, {type(p2).__name__}) are not a canonical "
             "binary split"
         )
-    col, op, value = split
+    col, op, value, set_values = split
     right_is_catch_all = isinstance(p2, ir.TruePredicate)
 
     if model.no_true_child_strategy == "returnLastPrediction":
@@ -149,25 +152,45 @@ def _canonicalize(
         missing_null=missing_null,
         left=_canonicalize(c1, model, ctx),
         right=_canonicalize(c2, model, ctx),
+        set_values=set_values,
     )
 
 
 def _extract_split(
     p1: ir.Predicate, p2: ir.Predicate, ctx: LowerCtx, node: ir.TreeNode
-) -> Optional[Tuple[int, str, float]]:
-    """(left predicate, right predicate) → (col, op, value) or None."""
+) -> Optional[Tuple[int, int, float, Tuple[float, ...]]]:
+    """(left pred, right pred) → (col, opcode, threshold, set_codes) or None."""
     if isinstance(p1, ir.SimplePredicate) and p1.operator in _OPS:
         col = ctx.column(p1.field)
         value = ctx.encode(p1.field, p1.value)
         if isinstance(p2, ir.TruePredicate):
-            return col, p1.operator, value
+            return col, _OPS[p1.operator], value, ()
         if (
             isinstance(p2, ir.SimplePredicate)
             and p2.field == p1.field
             and p2.operator == _COMPLEMENT[p1.operator]
             and p2.value == p1.value
         ):
-            return col, p1.operator, value
+            return col, _OPS[p1.operator], value, ()
+    if isinstance(p1, ir.SimpleSetPredicate):
+        col = ctx.column(p1.field)
+        codes = tuple(ctx.encode(p1.field, v) for v in p1.values)
+        op = _OP_IN if p1.boolean_operator == "isIn" else _OP_NOT_IN
+        value = 0.0
+        if not codes:
+            # degenerate empty set: isIn {} ≡ always-false, isNotIn {} ≡
+            # always-true — encode as a NaN comparison (x == NaN is never
+            # true, x != NaN always is); missing-value handling is unchanged
+            op = _OPS["equal"] if op == _OP_IN else _OPS["notEqual"]
+            value = float("nan")
+        complementary = (
+            isinstance(p2, ir.SimpleSetPredicate)
+            and p2.field == p1.field
+            and p2.values == p1.values
+            and p2.boolean_operator != p1.boolean_operator
+        )
+        if isinstance(p2, ir.TruePredicate) or complementary:
+            return col, op, value, codes
     return None
 
 
@@ -184,6 +207,7 @@ class _FlatTree:
     values: List[float] = dc_field(default_factory=list)
     dleft: List[bool] = dc_field(default_factory=list)
     mnull: List[bool] = dc_field(default_factory=list)
+    sets: List[Tuple[float, ...]] = dc_field(default_factory=list)
     # per leaf
     leaf_scores: List[Optional[str]] = dc_field(default_factory=list)
     leaf_dists: List[Tuple[ir.ScoreDistribution, ...]] = dc_field(
@@ -204,10 +228,11 @@ def _flatten(node: _CanonNode, flat: _FlatTree, path: List[Tuple[int, int]]):
     s: _CanonSplit = node
     idx = len(flat.cols)
     flat.cols.append(s.col)
-    flat.ops.append(_OPS[s.op])
+    flat.ops.append(s.op)
     flat.values.append(s.value)
     flat.dleft.append(s.default_left)
     flat.mnull.append(s.missing_null)
+    flat.sets.append(s.set_values)
     _flatten(s.left, flat, path + [(idx, +1)])
     _flatten(s.right, flat, path + [(idx, -1)])
 
@@ -230,28 +255,47 @@ class PackedEnsemble:
     #         leaf_label i8/i32[T,L] (classification)
 
 
-def pack_ensemble(
+def _canonicalize_forest(
     trees: Sequence[ir.TreeModelIR], ctx: LowerCtx
-) -> PackedEnsemble:
+) -> Tuple[List[_CanonNode], bool, int]:
+    """Canonicalize + validate an ensemble ONCE → (canons, classification,
+    depth). Both packers consume the canonical forest, so the recursive
+    canonicalization cost is paid a single time on the 500-tree fast path."""
     classification = trees[0].function_name == "classification"
+    canons: List[_CanonNode] = []
+    depth = 1
     for t in trees:
         if (t.function_name == "classification") != classification:
             raise ModelCompilationException(
                 "mixed regression/classification trees in one ensemble"
             )
-        if not isinstance(t.root.predicate, (ir.TruePredicate,)):
+        if not isinstance(t.root.predicate, ir.TruePredicate):
             raise ModelCompilationException(
-                "tree root predicate must be <True/> for the dense lowering"
+                "tree root predicate must be <True/> for the fused lowering"
             )
+        canon = _canonicalize(t.root, t, ctx)
+        canons.append(canon)
+        depth = max(depth, _canon_depth(canon))
+    return canons, classification, depth
 
+
+def _canon_depth(canon: _CanonNode) -> int:
+    if isinstance(canon, _CanonLeaf):
+        return 0
+    return 1 + max(_canon_depth(canon.left), _canon_depth(canon.right))
+
+
+def pack_ensemble(
+    canons: Sequence[_CanonNode], classification: bool, ctx: LowerCtx
+) -> PackedEnsemble:
     flats: List[_FlatTree] = []
-    for t in trees:
+    for canon in canons:
         flat = _FlatTree()
-        _flatten(_canonicalize(t.root, t, ctx), flat, [])
+        _flatten(canon, flat, [])
         if not flat.cols:
             # single-leaf tree: manufacture a no-op split so S ≥ 1
             flat.cols, flat.ops, flat.values = [0], [0], [float("inf")]
-            flat.dleft, flat.mnull = [True], [False]
+            flat.dleft, flat.mnull, flat.sets = [True], [False], [()]
             flat.paths = [[(0, +1)], [(0, -1)]]
             flat.leaf_scores = flat.leaf_scores * 2
             flat.leaf_dists = flat.leaf_dists * 2
@@ -270,6 +314,10 @@ def pack_ensemble(
     mnull = np.zeros((T, S), np.float32)
     P = np.zeros((T, S, L), np.float32)
     count = np.full((T, L), -5.0, np.float32)  # padded leaves can never match
+    K = max((len(s) for f in flats for s in f.sets), default=0)
+    set_codes = (
+        np.full((T, S, K), np.nan, np.float32) if K > 0 else None
+    )  # NaN pad: never equal to any input
 
     labels: Tuple[str, ...] = ()
     if classification:
@@ -295,6 +343,10 @@ def pack_ensemble(
         thresh[ti, :ns] = f.values
         dleft[ti, :ns] = np.asarray(f.dleft, np.float32)
         mnull[ti, :ns] = np.asarray(f.mnull, np.float32)
+        if set_codes is not None:
+            for si, s in enumerate(f.sets):
+                if s:
+                    set_codes[ti, si, : len(s)] = s
         for li, path in enumerate(f.paths):
             count[ti, li] = len(path)
             for s_idx, direction in path:
@@ -348,6 +400,8 @@ def pack_ensemble(
         "P": P,
         "count": count,
     }
+    if set_codes is not None:
+        params["set_codes"] = set_codes
     if classification:
         params["leaf_probs"] = leaf_probs
         params["leaf_label"] = leaf_label.astype(np.float32)
@@ -371,6 +425,41 @@ def pack_ensemble(
 # ---------------------------------------------------------------------------
 
 
+def _compare(x, t, op_arr, uniform_op, member=None):
+    """Split comparison dispatch shared by the dense and iterative paths.
+
+    ``op_arr`` broadcasts against ``x`` (int opcodes); ``member`` is the set
+    membership lane for _OP_IN/_OP_NOT_IN splits (None when no set splits).
+    """
+    if uniform_op is not None:
+        op = uniform_op
+        if op == _OP_IN:
+            return member
+        if op == _OP_NOT_IN:
+            return ~member
+        return (
+            x < t if op == 0 else
+            x <= t if op == 1 else
+            x > t if op == 2 else
+            x >= t if op == 3 else
+            x == t if op == 4 else
+            x != t
+        )
+    cmp = jnp.where(
+        op_arr == 0, x < t,
+        jnp.where(op_arr == 1, x <= t,
+        jnp.where(op_arr == 2, x > t,
+        jnp.where(op_arr == 3, x >= t,
+        jnp.where(op_arr == 4, x == t, x != t)))),
+    )
+    if member is not None:
+        cmp = jnp.where(
+            op_arr == _OP_IN, member,
+            jnp.where(op_arr == _OP_NOT_IN, ~member, cmp),
+        )
+    return cmp
+
+
 def _go_left(
     x: jnp.ndarray,  # f32[B, T, S] gathered feature values
     m: jnp.ndarray,  # bool[B, T, S] missing
@@ -380,25 +469,10 @@ def _go_left(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (go_left bool[B,T,S], nulled bool[B,T,S])."""
     t = p["thresh"][None, :, :]
-    if uniform_op is not None:
-        op = uniform_op
-        cmp = (
-            x < t if op == 0 else
-            x <= t if op == 1 else
-            x > t if op == 2 else
-            x >= t if op == 3 else
-            x == t if op == 4 else
-            x != t
-        )
-    else:
-        oc = opcodes[None, :, :]
-        cmp = jnp.where(
-            oc == 0, x < t,
-            jnp.where(oc == 1, x <= t,
-            jnp.where(oc == 2, x > t,
-            jnp.where(oc == 3, x >= t,
-            jnp.where(oc == 4, x == t, x != t)))),
-        )
+    member = None
+    if "set_codes" in p:
+        member = jnp.any(x[..., None] == p["set_codes"][None], axis=-1)
+    cmp = _compare(x, t, opcodes[None, :, :], uniform_op, member)
     go = jnp.where(m, p["dleft"][None] > 0.5, cmp)
     nulled = m & (p["mnull"][None] > 0.5)
     return go, nulled
@@ -449,6 +523,297 @@ def make_ensemble_eval(packed: PackedEnsemble, ctx: LowerCtx):
     return fn
 
 
+# ---------------------------------------------------------------------------
+# Iterative node-hop evaluation (deep trees: O(depth) gathers instead of an
+# O(S·L) path matrix)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PackedNodes:
+    """Node-table form: every tree's canonical nodes in one padded [T, N]
+    family; leaves self-loop so a fixed ``depth`` iteration count converges."""
+
+    n_trees: int
+    n_nodes: int  # N (max, padded)
+    depth: int
+    uniform_op: Optional[int]
+    has_sets: bool
+    labels: Tuple[str, ...]
+    params: Dict[str, np.ndarray]
+    # params: col i32[T,N], op f32[T,N], thresh f32[T,N], dleft f32[T,N],
+    #         mnull f32[T,N], left i32[T,N], right i32[T,N], is_leaf f32[T,N],
+    #         value f32[T,N] | (probs f32[T,N,C] + label f32[T,N]),
+    #         set_codes f32[T,N,K] (when set splits exist)
+
+
+def _node_flatten(canon: _CanonNode, rows: List[dict]) -> int:
+    """Pre-order flatten; returns this node's index."""
+    idx = len(rows)
+    rows.append({})  # reserve
+    if isinstance(canon, _CanonLeaf):
+        rows[idx] = {
+            "leaf": True,
+            "score": canon.score,
+            "dist": canon.distribution,
+            "left": idx,
+            "right": idx,
+        }
+        return idx
+    s: _CanonSplit = canon
+    left = _node_flatten(s.left, rows)
+    right = _node_flatten(s.right, rows)
+    rows[idx] = {
+        "leaf": False,
+        "col": s.col,
+        "op": s.op,
+        "thresh": s.value,
+        "dleft": s.default_left,
+        "mnull": s.missing_null,
+        "sets": s.set_values,
+        "left": left,
+        "right": right,
+    }
+    return idx
+
+
+def pack_nodes(
+    canons: Sequence[_CanonNode], classification: bool
+) -> PackedNodes:
+    per_tree_rows: List[List[dict]] = []
+    depth = 1
+    for canon in canons:
+        rows: List[dict] = []
+        _node_flatten(canon, rows)
+        per_tree_rows.append(rows)
+        depth = max(depth, _canon_depth(canon))
+
+    T = len(per_tree_rows)
+    N = max(len(r) for r in per_tree_rows)
+    K = max(
+        (len(row.get("sets", ())) for rows in per_tree_rows for row in rows),
+        default=0,
+    )
+
+    col = np.zeros((T, N), np.int32)
+    op = np.zeros((T, N), np.float32)
+    thresh = np.zeros((T, N), np.float32)
+    dleft = np.zeros((T, N), np.float32)
+    mnull = np.zeros((T, N), np.float32)
+    left = np.zeros((T, N), np.int32)
+    right = np.zeros((T, N), np.int32)
+    is_leaf = np.ones((T, N), np.float32)  # padding = self-looping leaves
+    for ti in range(T):
+        for ni in range(N):
+            left[ti, ni] = right[ti, ni] = ni
+    set_codes = np.full((T, N, K), np.nan, np.float32) if K else None
+
+    labels: Tuple[str, ...] = ()
+    if classification:
+        label_set: List[str] = []
+        for rows in per_tree_rows:
+            for row in rows:
+                if row["leaf"]:
+                    for d in row["dist"]:
+                        if d.value not in label_set:
+                            label_set.append(d.value)
+                    if row["score"] is not None and row["score"] not in label_set:
+                        label_set.append(row["score"])
+        labels = tuple(label_set)
+        C = len(labels)
+        probs = np.zeros((T, N, C), np.float32)
+        label = np.zeros((T, N), np.float32)
+    else:
+        value = np.zeros((T, N), np.float32)
+
+    ops_seen = set()
+    for ti, rows in enumerate(per_tree_rows):
+        for ni, row in enumerate(rows):
+            left[ti, ni] = row["left"]
+            right[ti, ni] = row["right"]
+            if row["leaf"]:
+                if classification:
+                    dist = row["dist"]
+                    total = sum(d.record_count for d in dist)
+                    pr = {}
+                    for d in dist:
+                        if d.probability is not None:
+                            pr[d.value] = d.probability
+                        elif total > 0:
+                            pr[d.value] = d.record_count / total
+                    lab = row["score"] if row["score"] is not None else (
+                        max(pr, key=pr.get) if pr else None
+                    )
+                    if lab is None:
+                        raise ModelCompilationException(
+                            f"classification leaf {ni} in tree {ti} has "
+                            "neither score nor ScoreDistribution"
+                        )
+                    label[ti, ni] = labels.index(lab)
+                    for lbl, v in pr.items():
+                        probs[ti, ni, labels.index(lbl)] = v
+                    if not pr:
+                        probs[ti, ni, labels.index(lab)] = 1.0
+                else:
+                    if row["score"] is None:
+                        raise ModelCompilationException(
+                            f"regression leaf {ni} in tree {ti} has no score"
+                        )
+                    try:
+                        value[ti, ni] = float(row["score"])
+                    except ValueError:
+                        raise ModelCompilationException(
+                            f"regression leaf score {row['score']!r} is not "
+                            "numeric"
+                        ) from None
+            else:
+                is_leaf[ti, ni] = 0.0
+                col[ti, ni] = row["col"]
+                op[ti, ni] = row["op"]
+                thresh[ti, ni] = row["thresh"]
+                dleft[ti, ni] = float(row["dleft"])
+                mnull[ti, ni] = float(row["mnull"])
+                ops_seen.add(row["op"])
+                if set_codes is not None and row["sets"]:
+                    set_codes[ti, ni, : len(row["sets"])] = row["sets"]
+
+    uniform_op = ops_seen.pop() if len(ops_seen) == 1 else None
+    params: Dict[str, np.ndarray] = {
+        "col": col,
+        "op": op,
+        "thresh": thresh,
+        "dleft": dleft,
+        "mnull": mnull,
+        "left": left,
+        "right": right,
+        "is_leaf": is_leaf,
+    }
+    if set_codes is not None:
+        params["set_codes"] = set_codes
+    if classification:
+        params["probs"] = probs
+        params["label"] = label
+    else:
+        params["value"] = value
+    return PackedNodes(
+        n_trees=T,
+        n_nodes=N,
+        depth=depth,
+        uniform_op=uniform_op,
+        has_sets=set_codes is not None,
+        labels=labels,
+        params=params,
+    )
+
+
+def make_iterative_eval(packed: PackedNodes):
+    """→ tree_eval(params, X, M) -> (final_idx i32[B,T], null bool[B,T]).
+
+    ``lax.fori_loop`` over tree depth; every step gathers the current
+    node's attributes per (record, tree) and hops left/right. Leaves
+    self-loop, so exactly ``depth`` iterations settle every lane.
+    """
+    T, N, depth = packed.n_trees, packed.n_nodes, packed.depth
+    uniform_op = packed.uniform_op
+    has_sets = packed.has_sets
+
+    def fn(p: dict, X: jnp.ndarray, M: jnp.ndarray):
+        B = X.shape[0]
+        offs = jnp.arange(T, dtype=jnp.int32)[None, :] * N  # [1, T]
+        colf = p["col"].reshape(-1)
+        opf = p["op"].reshape(-1)
+        threshf = p["thresh"].reshape(-1)
+        dleftf = p["dleft"].reshape(-1)
+        mnullf = p["mnull"].reshape(-1)
+        leftf = p["left"].reshape(-1)
+        rightf = p["right"].reshape(-1)
+        leaff = p["is_leaf"].reshape(-1)
+        setf = p["set_codes"].reshape(T * N, -1) if has_sets else None
+
+        def body(_, carry):
+            idx, null = carry
+            g = offs + idx  # [B, T] flat node ids
+            cols = jnp.take(colf, g)
+            x = jnp.take_along_axis(X, cols, axis=1)
+            m = jnp.take_along_axis(M, cols, axis=1)
+            t = jnp.take(threshf, g)
+            opg = jnp.take(opf, g)
+            member = (
+                jnp.any(x[..., None] == jnp.take(setf, g, axis=0), axis=-1)
+                if has_sets
+                else None
+            )
+            cmp = _compare(x, t, opg, uniform_op, member)
+            go = jnp.where(m, jnp.take(dleftf, g) > 0.5, cmp)
+            leaf = jnp.take(leaff, g) > 0.5
+            null = null | (m & (jnp.take(mnullf, g) > 0.5) & ~leaf)
+            nxt = jnp.where(go, jnp.take(leftf, g), jnp.take(rightf, g))
+            idx = jnp.where(leaf, idx, nxt)
+            return idx, null
+
+        idx0 = jnp.zeros((B, T), jnp.int32)
+        null0 = jnp.zeros((B, T), bool)
+        idx, null = jax.lax.fori_loop(0, depth, body, (idx0, null0))
+        return idx, null
+
+    return fn
+
+
+def _tree_eval_fns(trees, ctx):
+    """Choose the dense (path-matrix einsum) or iterative (node-hop)
+    backend and return a uniform per-tree interface:
+
+    regression:      vals(p, X, M)  -> (values f32[B,T], null bool[B,T])
+    classification:  cls(p, X, M)   -> (probs f32[B,T,C], label i32[B,T],
+                                        null bool[B,T])
+    plus (params, labels).
+    """
+    canons, classification, depth = _canonicalize_forest(trees, ctx)
+    dense = depth <= ctx.config.max_dense_depth
+
+    if dense:
+        packed = pack_ensemble(canons, classification, ctx)
+        ev = make_ensemble_eval(packed, ctx)
+        if not classification:
+            def vals(p, X, M):
+                sel, null = ev(p, X, M)
+                v = jnp.einsum(
+                    "btl,tl->bt", sel, p["leaf_values"], precision=HIGHEST
+                )
+                return v, null
+            return vals, packed.params, ()
+
+        def cls(p, X, M):
+            sel, null = ev(p, X, M)
+            probs = jnp.einsum(
+                "btl,tlc->btc", sel, p["leaf_probs"], precision=HIGHEST
+            )
+            lab = jnp.einsum(
+                "btl,tl->bt", sel, p["leaf_label"], precision=HIGHEST
+            )
+            return probs, jnp.round(lab).astype(jnp.int32), null
+        return cls, packed.params, packed.labels
+
+    packed = pack_nodes(canons, classification)
+    ev = make_iterative_eval(packed)
+    T, N = packed.n_trees, packed.n_nodes
+    if not classification:
+        def ivals(p, X, M):
+            idx, null = ev(p, X, M)
+            g = jnp.arange(T, dtype=jnp.int32)[None, :] * N + idx
+            return jnp.take(p["value"].reshape(-1), g), null
+        return ivals, packed.params, ()
+
+    def icls(p, X, M):
+        idx, null = ev(p, X, M)
+        g = jnp.arange(T, dtype=jnp.int32)[None, :] * N + idx
+        C = p["probs"].shape[-1]
+        probs = jnp.take(p["probs"].reshape(T * N, C), g, axis=0)
+        lab = jnp.round(jnp.take(p["label"].reshape(-1), g)).astype(jnp.int32)
+        return probs, lab, null
+    return icls, packed.params, packed.labels
+
+
 def lower_tree_ensemble(
     trees: Sequence[ir.TreeModelIR],
     weights: Sequence[float],
@@ -457,20 +822,18 @@ def lower_tree_ensemble(
 ) -> Lowered:
     """Fused lowering for an ensemble of canonical trees under one
     segmentation method (the 500-tree-GBM fast path). ``method`` ∈
-    {sum, average, weightedAverage, max, median, majorityVote,
-    weightedMajorityVote} — or 'single' for a lone TreeModel."""
-    packed = pack_ensemble(trees, ctx)
-    ev = make_ensemble_eval(packed, ctx)
+    {sum, average, weightedAverage, max, median} for regression,
+    {majorityVote, weightedMajorityVote} for classification — or 'single'
+    for a lone TreeModel. Trees deeper than
+    ``CompileConfig.max_dense_depth`` transparently use the iterative
+    node-hop backend."""
     w = np.asarray(weights, np.float32)
-    T = packed.n_trees
-    classification = bool(packed.labels)
+    classification = trees[0].function_name == "classification"
+    eval_fn, params, labels = _tree_eval_fns(trees, ctx)
 
     if not classification:
         def rfn(p, X, M):
-            sel, tree_null = ev(p, X, M)
-            per_tree = jnp.einsum(
-                "btl,tl->bt", sel, p["leaf_values"], precision=HIGHEST
-            )
+            per_tree, tree_null = eval_fn(p, X, M)
             valid = ~jnp.any(tree_null, axis=1)
             if method in ("sum", "single"):
                 value = jnp.sum(per_tree, axis=1)
@@ -488,9 +851,9 @@ def lower_tree_ensemble(
                 )
             return ModelOutput(value=value, valid=valid)
 
-        return Lowered(fn=rfn, params=packed.params)
+        return Lowered(fn=rfn, params=params)
 
-    C = len(packed.labels)
+    C = len(labels)
 
     if method not in ("single", "majorityVote", "weightedMajorityVote"):
         # sum/average over classification trees aggregate *numeric* winning
@@ -501,48 +864,34 @@ def lower_tree_ensemble(
         )
 
     def cfn(p, X, M):
-        sel, tree_null = ev(p, X, M)
+        tprobs, tlabel, tree_null = eval_fn(p, X, M)
         if method == "single":
-            probs = jnp.einsum(
-                "btl,tlc->bc", sel, p["leaf_probs"], precision=HIGHEST
-            )
+            probs = tprobs[:, 0, :]
             valid = ~tree_null[:, 0]
-            # the label comes from the leaf's 'score' attribute (packed as
-            # leaf_label), NOT argmax of the distribution — PMML allows them
-            # to disagree
-            lab = jnp.einsum(
-                "btl,tl->bt", sel, p["leaf_label"], precision=HIGHEST
-            )[:, 0]
-            label_idx = jnp.round(lab).astype(jnp.int32)
+            # the label comes from the leaf's 'score' attribute, NOT argmax
+            # of the distribution — PMML allows them to disagree
+            label_idx = tlabel[:, 0]
             value = jnp.take_along_axis(probs, label_idx[:, None], axis=1)[:, 0]
             return ModelOutput(
                 value=value, valid=valid, probs=probs, label_idx=label_idx
             )
-        else:
-            # each tree votes its leaf's label one-hot (weighted); a tree
-            # nulled by a missing value abstains (oracle: excluded from the
-            # vote), it does not poison the lane
-            leaf_onehot = jax.nn.one_hot(
-                p["leaf_label"].astype(jnp.int32), C, dtype=jnp.float32
-            )  # [T, L, C]
-            votes = jnp.einsum(
-                "btl,tlc->btc", sel, leaf_onehot, precision=HIGHEST
-            )
-            votes = votes * (~tree_null).astype(jnp.float32)[:, :, None]
-            if method == "weightedMajorityVote":
-                votes = votes * w[None, :, None]
-            total = jnp.sum(votes, axis=(1, 2))
-            probs = jnp.sum(votes, axis=1) / jnp.maximum(
-                total[:, None], 1e-30
-            )
-            valid = total > 0
+        # each tree votes its leaf's label one-hot (weighted); a tree nulled
+        # by a missing value abstains (oracle: excluded from the vote), it
+        # does not poison the lane
+        votes = jax.nn.one_hot(tlabel, C, dtype=jnp.float32)  # [B, T, C]
+        votes = votes * (~tree_null).astype(jnp.float32)[:, :, None]
+        if method == "weightedMajorityVote":
+            votes = votes * w[None, :, None]
+        total = jnp.sum(votes, axis=(1, 2))
+        probs = jnp.sum(votes, axis=1) / jnp.maximum(total[:, None], 1e-30)
+        valid = total > 0
         label_idx = jnp.argmax(probs, axis=1).astype(jnp.int32)
         value = jnp.take_along_axis(probs, label_idx[:, None], axis=1)[:, 0]
         return ModelOutput(
             value=value, valid=valid, probs=probs, label_idx=label_idx
         )
 
-    return Lowered(fn=cfn, params=packed.params, labels=packed.labels)
+    return Lowered(fn=cfn, params=params, labels=labels)
 
 
 def lower_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
