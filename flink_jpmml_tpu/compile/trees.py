@@ -186,7 +186,7 @@ def _extract_split(
         complementary = (
             isinstance(p2, ir.SimpleSetPredicate)
             and p2.field == p1.field
-            and p2.values == p1.values
+            and frozenset(p2.values) == frozenset(p1.values)
             and p2.boolean_operator != p1.boolean_operator
         )
         if isinstance(p2, ir.TruePredicate) or complementary:
@@ -216,6 +216,68 @@ class _FlatTree:
     paths: List[List[Tuple[int, int]]] = dc_field(default_factory=list)
     # (split_idx, +1 left / −1 right) per edge on the leaf's path
     depth: int = 0
+
+
+# -- shared leaf payload rules (both packers MUST agree on these) -----------
+
+
+def _collect_labels(leaves) -> Tuple[str, ...]:
+    """Ordered label space from (score, distribution) leaf pairs."""
+    label_set: List[str] = []
+    for score, dist in leaves:
+        for d in dist:
+            if d.value not in label_set:
+                label_set.append(d.value)
+        if score is not None and score not in label_set:
+            label_set.append(score)
+    return tuple(label_set)
+
+
+def _leaf_class_row(
+    score: Optional[str],
+    dist: Tuple[ir.ScoreDistribution, ...],
+    labels: Tuple[str, ...],
+    where: str,
+) -> Tuple[int, np.ndarray]:
+    """→ (label index, dense per-class probability row).
+
+    The label is the leaf's ``score`` attribute when present (PMML allows it
+    to disagree with the distribution argmax); probabilities come from
+    explicit ``probability`` attributes or record counts; a score-only leaf
+    gets probability 1 on its label.
+    """
+    total = sum(d.record_count for d in dist)
+    probs = {}
+    for d in dist:
+        if d.probability is not None:
+            probs[d.value] = d.probability
+        elif total > 0:
+            probs[d.value] = d.record_count / total
+    lab = score if score is not None else (
+        max(probs, key=probs.get) if probs else None
+    )
+    if lab is None:
+        raise ModelCompilationException(
+            f"classification leaf {where} has neither score nor "
+            "ScoreDistribution"
+        )
+    row = np.zeros((len(labels),), np.float32)
+    for lbl, pr in probs.items():
+        row[labels.index(lbl)] = pr
+    if not probs:
+        row[labels.index(lab)] = 1.0
+    return labels.index(lab), row
+
+
+def _leaf_value(score: Optional[str], where: str) -> float:
+    if score is None:
+        raise ModelCompilationException(f"regression leaf {where} has no score")
+    try:
+        return float(score)
+    except ValueError:
+        raise ModelCompilationException(
+            f"regression leaf score {score!r} is not numeric"
+        ) from None
 
 
 def _flatten(node: _CanonNode, flat: _FlatTree, path: List[Tuple[int, int]]):
@@ -286,7 +348,7 @@ def _canon_depth(canon: _CanonNode) -> int:
 
 
 def pack_ensemble(
-    canons: Sequence[_CanonNode], classification: bool, ctx: LowerCtx
+    canons: Sequence[_CanonNode], classification: bool
 ) -> PackedEnsemble:
     flats: List[_FlatTree] = []
     for canon in canons:
@@ -321,15 +383,11 @@ def pack_ensemble(
 
     labels: Tuple[str, ...] = ()
     if classification:
-        label_set: List[str] = []
-        for f in flats:
-            for s, dist in zip(f.leaf_scores, f.leaf_dists):
-                for d in dist:
-                    if d.value not in label_set:
-                        label_set.append(d.value)
-                if s is not None and s not in label_set:
-                    label_set.append(s)
-        labels = tuple(label_set)
+        labels = _collect_labels(
+            (s, d)
+            for f in flats
+            for s, d in zip(f.leaf_scores, f.leaf_dists)
+        )
         C = len(labels)
         leaf_probs = np.zeros((T, L, C), np.float32)
         leaf_label = np.zeros((T, L), np.int32)
@@ -352,39 +410,15 @@ def pack_ensemble(
             for s_idx, direction in path:
                 P[ti, s_idx, li] = direction
             score = f.leaf_scores[li]
+            where = f"{li} in tree {ti}"
             if classification:
-                dist = f.leaf_dists[li]
-                total = sum(d.record_count for d in dist)
-                probs = {}
-                for d in dist:
-                    if d.probability is not None:
-                        probs[d.value] = d.probability
-                    elif total > 0:
-                        probs[d.value] = d.record_count / total
-                lab = score if score is not None else (
-                    max(probs, key=probs.get) if probs else None
+                lab_idx, row = _leaf_class_row(
+                    score, f.leaf_dists[li], labels, where
                 )
-                if lab is None:
-                    raise ModelCompilationException(
-                        f"classification leaf {li} in tree {ti} has neither "
-                        "score nor ScoreDistribution"
-                    )
-                leaf_label[ti, li] = labels.index(lab)
-                for lbl, pr in probs.items():
-                    leaf_probs[ti, li, labels.index(lbl)] = pr
-                if not probs:
-                    leaf_probs[ti, li, labels.index(lab)] = 1.0
+                leaf_label[ti, li] = lab_idx
+                leaf_probs[ti, li] = row
             else:
-                if score is None:
-                    raise ModelCompilationException(
-                        f"regression leaf {li} in tree {ti} has no score"
-                    )
-                try:
-                    leaf_values[ti, li] = float(score)
-                except ValueError:
-                    raise ModelCompilationException(
-                        f"regression leaf score {score!r} is not numeric"
-                    ) from None
+                leaf_values[ti, li] = _leaf_value(score, where)
 
     # uniform-op specialization: padded split slots don't constrain it
     real_ops = {op for f in flats for op in f.ops}
@@ -578,15 +612,13 @@ def _node_flatten(canon: _CanonNode, rows: List[dict]) -> int:
 
 
 def pack_nodes(
-    canons: Sequence[_CanonNode], classification: bool
+    canons: Sequence[_CanonNode], classification: bool, depth: int
 ) -> PackedNodes:
     per_tree_rows: List[List[dict]] = []
-    depth = 1
     for canon in canons:
         rows: List[dict] = []
         _node_flatten(canon, rows)
         per_tree_rows.append(rows)
-        depth = max(depth, _canon_depth(canon))
 
     T = len(per_tree_rows)
     N = max(len(r) for r in per_tree_rows)
@@ -600,26 +632,20 @@ def pack_nodes(
     thresh = np.zeros((T, N), np.float32)
     dleft = np.zeros((T, N), np.float32)
     mnull = np.zeros((T, N), np.float32)
-    left = np.zeros((T, N), np.int32)
-    right = np.zeros((T, N), np.int32)
-    is_leaf = np.ones((T, N), np.float32)  # padding = self-looping leaves
-    for ti in range(T):
-        for ni in range(N):
-            left[ti, ni] = right[ti, ni] = ni
+    # padding rows are self-looping leaves; real rows are overwritten below
+    left = np.broadcast_to(np.arange(N, dtype=np.int32), (T, N)).copy()
+    right = left.copy()
+    is_leaf = np.ones((T, N), np.float32)
     set_codes = np.full((T, N, K), np.nan, np.float32) if K else None
 
     labels: Tuple[str, ...] = ()
     if classification:
-        label_set: List[str] = []
-        for rows in per_tree_rows:
-            for row in rows:
-                if row["leaf"]:
-                    for d in row["dist"]:
-                        if d.value not in label_set:
-                            label_set.append(d.value)
-                    if row["score"] is not None and row["score"] not in label_set:
-                        label_set.append(row["score"])
-        labels = tuple(label_set)
+        labels = _collect_labels(
+            (row["score"], row["dist"])
+            for rows in per_tree_rows
+            for row in rows
+            if row["leaf"]
+        )
         C = len(labels)
         probs = np.zeros((T, N, C), np.float32)
         label = np.zeros((T, N), np.float32)
@@ -632,40 +658,15 @@ def pack_nodes(
             left[ti, ni] = row["left"]
             right[ti, ni] = row["right"]
             if row["leaf"]:
+                where = f"{ni} in tree {ti}"
                 if classification:
-                    dist = row["dist"]
-                    total = sum(d.record_count for d in dist)
-                    pr = {}
-                    for d in dist:
-                        if d.probability is not None:
-                            pr[d.value] = d.probability
-                        elif total > 0:
-                            pr[d.value] = d.record_count / total
-                    lab = row["score"] if row["score"] is not None else (
-                        max(pr, key=pr.get) if pr else None
+                    lab_idx, prow = _leaf_class_row(
+                        row["score"], row["dist"], labels, where
                     )
-                    if lab is None:
-                        raise ModelCompilationException(
-                            f"classification leaf {ni} in tree {ti} has "
-                            "neither score nor ScoreDistribution"
-                        )
-                    label[ti, ni] = labels.index(lab)
-                    for lbl, v in pr.items():
-                        probs[ti, ni, labels.index(lbl)] = v
-                    if not pr:
-                        probs[ti, ni, labels.index(lab)] = 1.0
+                    label[ti, ni] = lab_idx
+                    probs[ti, ni] = prow
                 else:
-                    if row["score"] is None:
-                        raise ModelCompilationException(
-                            f"regression leaf {ni} in tree {ti} has no score"
-                        )
-                    try:
-                        value[ti, ni] = float(row["score"])
-                    except ValueError:
-                        raise ModelCompilationException(
-                            f"regression leaf score {row['score']!r} is not "
-                            "numeric"
-                        ) from None
+                    value[ti, ni] = _leaf_value(row["score"], where)
             else:
                 is_leaf[ti, ni] = 0.0
                 col[ti, ni] = row["col"]
@@ -772,7 +773,7 @@ def _tree_eval_fns(trees, ctx):
     dense = depth <= ctx.config.max_dense_depth
 
     if dense:
-        packed = pack_ensemble(canons, classification, ctx)
+        packed = pack_ensemble(canons, classification)
         ev = make_ensemble_eval(packed, ctx)
         if not classification:
             def vals(p, X, M):
@@ -794,7 +795,7 @@ def _tree_eval_fns(trees, ctx):
             return probs, jnp.round(lab).astype(jnp.int32), null
         return cls, packed.params, packed.labels
 
-    packed = pack_nodes(canons, classification)
+    packed = pack_nodes(canons, classification, depth)
     ev = make_iterative_eval(packed)
     T, N = packed.n_trees, packed.n_nodes
     if not classification:

@@ -1,0 +1,164 @@
+"""ctypes binding for the C++ data plane (native/fjt_native.cpp).
+
+Builds the shared library on first use with the baked-in ``g++`` (cached
+next to the source; pybind11 isn't in the image, hence the C-plain ABI +
+ctypes). Falls back cleanly: callers check :func:`available` and use the
+pure-Python :class:`flink_jpmml_tpu.runtime.queues.BoundedQueue` otherwise —
+same semantics, lower throughput.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+_SRC = _REPO_ROOT / "native" / "fjt_native.cpp"
+_LIB = _REPO_ROOT / "native" / "build" / "libfjt_native.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library; returns an error string or None."""
+    _LIB.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", str(_LIB), str(_SRC), "-lpthread",
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"g++ invocation failed: {e}"
+    if proc.returncode != 0:
+        return f"g++ failed:\n{proc.stderr[-2000:]}"
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not _SRC.exists():
+            _build_error = f"source missing: {_SRC}"
+            return None
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            err = _build()
+            if err is not None:
+                _build_error = err
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError as e:
+            _build_error = str(e)
+            return None
+        lib.fjt_ring_create.restype = ctypes.c_void_p
+        lib.fjt_ring_create.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
+        lib.fjt_ring_destroy.argtypes = [ctypes.c_void_p]
+        lib.fjt_ring_close.argtypes = [ctypes.c_void_p]
+        lib.fjt_ring_size.restype = ctypes.c_uint32
+        lib.fjt_ring_size.argtypes = [ctypes.c_void_p]
+        lib.fjt_ring_closed.restype = ctypes.c_int
+        lib.fjt_ring_closed.argtypes = [ctypes.c_void_p]
+        lib.fjt_ring_push_block.restype = ctypes.c_uint32
+        lib.fjt_ring_push_block.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_int64,
+        ]
+        lib.fjt_ring_drain.restype = ctypes.c_uint32
+        lib.fjt_ring_drain.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint32,
+            ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+class NativeRing:
+    """Bounded MPSC ring of fixed-arity float32 records (the C++ batcher).
+
+    ``push_block`` takes a contiguous ``[n, arity]`` float32 array with
+    consecutive source offsets; ``drain`` fills a preallocated batch buffer
+    fill-or-deadline and returns (records_view, offsets_view) — zero-copy
+    numpy views over reused buffers, valid until the next drain.
+    """
+
+    def __init__(self, capacity: int, arity: int, batch_size: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native data plane unavailable: {_build_error}")
+        self._lib = lib
+        self._arity = arity
+        self._handle = lib.fjt_ring_create(capacity, arity)
+        if not self._handle:
+            raise MemoryError("fjt_ring_create failed")
+        self._batch = np.zeros((batch_size, arity), np.float32)
+        self._offsets = np.zeros((batch_size,), np.uint64)
+
+    def push_block(
+        self, block: np.ndarray, first_offset: int, timeout_us: int = -1
+    ) -> int:
+        block = np.ascontiguousarray(block, np.float32)
+        if block.ndim != 2 or block.shape[1] != self._arity:
+            raise ValueError(
+                f"block shape {block.shape} != [n, {self._arity}]"
+            )
+        return self._lib.fjt_ring_push_block(
+            self._handle,
+            block.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            first_offset,
+            block.shape[0],
+            timeout_us,
+        )
+
+    def drain(self, deadline_us: int) -> Tuple[np.ndarray, np.ndarray]:
+        n = self._lib.fjt_ring_drain(
+            self._handle,
+            self._batch.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            self._batch.shape[0],
+            deadline_us,
+        )
+        return self._batch[:n], self._offsets[:n]
+
+    def close(self) -> None:
+        self._lib.fjt_ring_close(self._handle)
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._lib.fjt_ring_closed(self._handle))
+
+    def __len__(self) -> int:
+        return self._lib.fjt_ring_size(self._handle)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.fjt_ring_destroy(handle)
+            self._handle = None

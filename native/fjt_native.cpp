@@ -1,0 +1,167 @@
+// fjt_native: host-side data plane for the streaming runtime.
+//
+// Replaces the per-record Python queue on the hot ingest path (the
+// reference's data plane was Flink's Netty stack with credit-based
+// backpressure; SURVEY.md §3 row D1). This is a bounded MPSC ring of
+// fixed-arity float32 records guarded by a mutex + condvars:
+//
+//  - producers push single records or contiguous blocks (blocking with
+//    backpressure or non-blocking);
+//  - the consumer drains fill-or-deadline micro-batches *directly into a
+//    caller-provided contiguous buffer* that numpy wraps zero-copy, so no
+//    Python object per record ever exists on this path;
+//  - close() wakes everyone; drains return what remains.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libfjt_native.so fjt_native.cpp -lpthread
+// Bound via ctypes (flink_jpmml_tpu/runtime/native.py) — no pybind11 in the
+// image, and the ABI below is deliberately C-plain for that reason.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+
+using namespace std::chrono;
+
+namespace {
+
+struct Ring {
+    uint32_t capacity;   // records
+    uint32_t arity;      // floats per record
+    float*   data;       // capacity * arity floats
+    uint64_t* offsets;   // per-record source offset (resume bookkeeping)
+    uint32_t head = 0;   // next slot to pop
+    uint32_t count = 0;  // records in the ring
+    bool     closed = false;
+    std::mutex mu;
+    std::condition_variable not_full;
+    std::condition_variable not_empty;
+};
+
+inline uint32_t slot(const Ring* r, uint32_t logical) {
+    uint32_t s = r->head + logical;
+    if (s >= r->capacity) s -= r->capacity;
+    return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+Ring* fjt_ring_create(uint32_t capacity, uint32_t arity) {
+    if (capacity == 0 || arity == 0) return nullptr;
+    Ring* r = new (std::nothrow) Ring();
+    if (!r) return nullptr;
+    r->capacity = capacity;
+    r->arity = arity;
+    r->data = new (std::nothrow) float[(size_t)capacity * arity];
+    r->offsets = new (std::nothrow) uint64_t[capacity];
+    if (!r->data || !r->offsets) {
+        delete[] r->data;
+        delete[] r->offsets;
+        delete r;
+        return nullptr;
+    }
+    return r;
+}
+
+void fjt_ring_destroy(Ring* r) {
+    if (!r) return;
+    delete[] r->data;
+    delete[] r->offsets;
+    delete r;
+}
+
+void fjt_ring_close(Ring* r) {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+    r->not_empty.notify_all();
+    r->not_full.notify_all();
+}
+
+uint32_t fjt_ring_size(Ring* r) {
+    std::lock_guard<std::mutex> lk(r->mu);
+    return r->count;
+}
+
+int fjt_ring_closed(Ring* r) {
+    std::lock_guard<std::mutex> lk(r->mu);
+    return r->closed ? 1 : 0;
+}
+
+// Push a contiguous block of n records (n*arity floats) with consecutive
+// source offsets starting at first_offset. Blocks until all records are in
+// (backpressure) or timeout_us elapses. Returns the number of records
+// pushed; -1 (as UINT32_MAX) never — closed ring returns what fit.
+uint32_t fjt_ring_push_block(Ring* r, const float* recs, uint64_t first_offset,
+                             uint32_t n, int64_t timeout_us) {
+    uint32_t pushed = 0;
+    auto deadline = steady_clock::now() + microseconds(timeout_us);
+    std::unique_lock<std::mutex> lk(r->mu);
+    while (pushed < n) {
+        while (r->count == r->capacity && !r->closed) {
+            if (timeout_us >= 0) {
+                if (r->not_full.wait_until(lk, deadline) == std::cv_status::timeout)
+                    return pushed;
+            } else {
+                r->not_full.wait(lk);
+            }
+        }
+        if (r->closed) return pushed;
+        uint32_t room = r->capacity - r->count;
+        uint32_t take = n - pushed < room ? n - pushed : room;
+        for (uint32_t i = 0; i < take; ++i) {
+            uint32_t s = slot(r, r->count + i);
+            std::memcpy(r->data + (size_t)s * r->arity,
+                        recs + (size_t)(pushed + i) * r->arity,
+                        r->arity * sizeof(float));
+            r->offsets[s] = first_offset + pushed + i;
+        }
+        r->count += take;
+        pushed += take;
+        r->not_empty.notify_one();
+    }
+    return pushed;
+}
+
+// Fill-or-deadline drain into out (max_n*arity floats) + out_offsets
+// (max_n u64). Blocks until >=1 record (or closed); then keeps taking until
+// max_n or deadline_us after the first take. Returns records drained
+// (0 => closed and empty).
+uint32_t fjt_ring_drain(Ring* r, float* out, uint64_t* out_offsets,
+                        uint32_t max_n, int64_t deadline_us) {
+    std::unique_lock<std::mutex> lk(r->mu);
+    while (r->count == 0) {
+        if (r->closed) return 0;
+        r->not_empty.wait_for(lk, milliseconds(100));
+    }
+    uint32_t drained = 0;
+    auto deadline = steady_clock::now() + microseconds(deadline_us);
+    for (;;) {
+        uint32_t take = r->count < max_n - drained ? r->count : max_n - drained;
+        for (uint32_t i = 0; i < take; ++i) {
+            uint32_t s = slot(r, i);
+            std::memcpy(out + (size_t)(drained + i) * r->arity,
+                        r->data + (size_t)s * r->arity,
+                        r->arity * sizeof(float));
+            out_offsets[drained + i] = r->offsets[s];
+        }
+        r->head = slot(r, take);
+        r->count -= take;
+        drained += take;
+        if (take) r->not_full.notify_all();
+        if (drained >= max_n) break;
+        if (r->count == 0) {
+            if (r->closed) break;
+            if (r->not_empty.wait_until(lk, deadline) == std::cv_status::timeout)
+                break;
+            if (r->count == 0 && r->closed) break;
+            if (steady_clock::now() >= deadline) break;
+        }
+    }
+    return drained;
+}
+
+}  // extern "C"
