@@ -116,14 +116,23 @@ def gen_iris_lr(out_dir: str, seed: int = 7) -> str:
 # ---------------------------------------------------------------------------
 
 
-def _gen_tree_nodes(parent, rng, n_features, depth, node_counter, value_scale):
+def _gen_tree_nodes(
+    parent, rng, n_features, depth, node_counter, value_scale, grids=None
+):
     """Complete binary tree of the given depth under ``parent``: each split
     puts complementary (lessThan t, greaterOrEqual t) predicates on the two
-    children; ``defaultChild`` points left; depth-1 children carry scores."""
+    children; ``defaultChild`` points left; depth-1 children carry scores.
+
+    ``grids`` (optional, [n_features, n_bins]) restricts each feature's
+    thresholds to a fixed per-feature value grid, mirroring histogram-
+    trained GBMs (LightGBM / XGBoost-hist bin boundaries)."""
     if depth < 1:
         raise ValueError(f"tree depth must be >= 1, got {depth}")
     feat = int(rng.integers(0, n_features))
-    thr = float(rng.normal(0.0, 1.0))
+    if grids is not None:
+        thr = float(grids[feat][int(rng.integers(0, len(grids[feat])))])
+    else:
+        thr = float(rng.normal(0.0, 1.0))
     left_id = str(next(node_counter))
     right_id = str(next(node_counter))
     for nid, op in ((left_id, "lessThan"), (right_id, "greaterOrEqual")):
@@ -137,7 +146,8 @@ def _gen_tree_nodes(parent, rng, n_features, depth, node_counter, value_scale):
             node.set("score", _fmt(rng.normal(0.0, value_scale)))
         else:
             _gen_tree_nodes(
-                node, rng, n_features, depth - 1, node_counter, value_scale
+                node, rng, n_features, depth - 1, node_counter, value_scale,
+                grids,
             )
     parent.set("defaultChild", left_id)
 
@@ -156,9 +166,23 @@ def gen_gbm(
     n_features: int = 32,
     seed: int = 11,
     base_score: float = 0.5,
+    hist_bins: int | None = 254,
     name: str | None = None,
 ) -> str:
+    """500-tree GBM fixture (BASELINE config 2).
+
+    ``hist_bins`` (default 254) draws each feature's split thresholds from a
+    fixed per-feature grid of that many values, like histogram-trained GBMs
+    (LightGBM ``max_bin``/XGBoost ``tree_method=hist`` models, whose splits
+    always land on bin boundaries). This keeps the model eligible for the
+    uint8 rank wire (qtrees.py). ``hist_bins=None`` draws unrestricted
+    continuous thresholds instead."""
     rng = np.random.default_rng(seed)
+    grids = (
+        np.sort(rng.normal(0.0, 1.0, size=(n_features, hist_bins)), axis=1)
+        if hist_bins is not None
+        else None
+    )
     fields = tuple(f"f{i}" for i in range(n_features))
     root = _pmml_root()
     _data_dictionary(root, fields)
@@ -186,7 +210,9 @@ def gen_gbm(
         _mining_schema(tree, fields)
         root_node = ET.SubElement(tree, "Node", {"id": "r"})
         ET.SubElement(root_node, "True")
-        _gen_tree_nodes(root_node, rng, n_features, depth, _counter(), 0.1)
+        _gen_tree_nodes(
+            root_node, rng, n_features, depth, _counter(), 0.1, grids
+        )
     fname = name or f"gbm_{n_trees}.pmml"
     return _write(root, os.path.join(out_dir, fname))
 

@@ -24,6 +24,7 @@ from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # All value-carrying dots use full f32 precision: on TPU the *default*
 # precision multiplies f32 operands in bf16 passes, which breaks golden
@@ -254,15 +255,40 @@ def lower_predicate(pred: ir.Predicate, ctx: LowerCtx) -> PredFn:
 # ---------------------------------------------------------------------------
 
 
-def apply_targets(out: ModelOutput, targets: Tuple[ir.Target, ...]) -> ModelOutput:
+def apply_targets_value(value, targets: Tuple[ir.Target, ...]):
+    """Targets rescale/cast on a bare value vector (shared by the f32 and
+    quantized scoring paths so their semantics cannot diverge)."""
     if not targets:
-        return out
+        return value
     t = targets[0]
-    v = out.value * jnp.float32(t.rescale_factor) + jnp.float32(t.rescale_constant)
+    v = value * jnp.float32(t.rescale_factor) + jnp.float32(t.rescale_constant)
     if t.cast_integer == "round":
         v = jnp.round(v)
     elif t.cast_integer == "ceiling":
         v = jnp.ceil(v)
     elif t.cast_integer == "floor":
         v = jnp.floor(v)
-    return out._replace(value=v)
+    return v
+
+
+def apply_targets(out: ModelOutput, targets: Tuple[ir.Target, ...]) -> ModelOutput:
+    if not targets:
+        return out
+    return out._replace(value=apply_targets_value(out.value, targets))
+
+
+def extract_missing_replacements(
+    schema: "ir.MiningSchema", ctx: "LowerCtx"
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Mining-schema ``missingValueReplacement`` per input column →
+    (repl f32[F], has_repl bool[F]). Shared by compiler.compile_pmml and the
+    quantized wire (qtrees.py) — one implementation, one semantics."""
+    F = ctx.n_fields
+    repl = np.zeros((F,), np.float32)
+    has_repl = np.zeros((F,), bool)
+    for mf in schema.fields:
+        if mf.missing_value_replacement is not None and mf.name in ctx.field_index:
+            j = ctx.field_index[mf.name]
+            has_repl[j] = True
+            repl[j] = ctx.encode(mf.name, mf.missing_value_replacement)
+    return repl, has_repl

@@ -27,6 +27,7 @@ from flink_jpmml_tpu.compile.common import (
     ModelOutput,
     apply_targets,
     build_codecs,
+    extract_missing_replacements,
 )
 from flink_jpmml_tpu.compile.mining import lower_mining
 from flink_jpmml_tpu.compile.neural import lower_neural_network
@@ -36,6 +37,8 @@ from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
 from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.config import CompileConfig
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+_UNSET = object()  # sentinel: quantized fast path not yet attempted
 
 
 def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
@@ -70,6 +73,9 @@ class CompiledModel:
     batch_size: Optional[int]
     _jit_fn: object
     model_name: Optional[str] = None
+    _doc: Optional[ir.PmmlDocument] = None
+    _config: Optional[CompileConfig] = None
+    _quantized: object = _UNSET
 
     @property
     def is_classification(self) -> bool:
@@ -81,6 +87,31 @@ class CompiledModel:
 
     def predict(self, X, M) -> ModelOutput:
         return self._jit_fn(self.params, X, M)
+
+    def quantized_scorer(self):
+        """Rank-wire fast path (qtrees.py) for this model, or None.
+
+        Built lazily on first call and cached; eligible only for regression
+        tree ensembles whose splits are all numeric comparisons. The wire
+        ships each record as per-feature threshold ranks (uint8/uint16) —
+        bit-exact with this model's f32 scoring — cutting host→device bytes
+        ~4x for the north-star GBM stream.
+        """
+        if self._quantized is _UNSET:
+            from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
+
+            self._quantized = (
+                build_quantized_scorer(
+                    self._doc, batch_size=self.batch_size, config=self._config
+                )
+                if self._doc is not None
+                else None
+            )
+            # the parse tree is only needed for this probe — release it so a
+            # long-lived served model doesn't pin the whole IR
+            self._doc = None
+            self._config = None
+        return self._quantized
 
     def warmup(self) -> "CompiledModel":
         """Force compilation (and params transfer) ahead of the hot path."""
@@ -148,14 +179,7 @@ def compile_pmml(
     lowered = lower_model(doc.model, ctx)
 
     # top-level mining-schema missingValueReplacement (C4), vectorized
-    schema = doc.model.mining_schema
-    repl = np.zeros((len(fields),), np.float32)
-    has_repl = np.zeros((len(fields),), bool)
-    for mf in schema.fields:
-        if mf.missing_value_replacement is not None and mf.name in ctx.field_index:
-            j = ctx.field_index[mf.name]
-            has_repl[j] = True
-            repl[j] = ctx.encode(mf.name, mf.missing_value_replacement)
+    repl, has_repl = extract_missing_replacements(doc.model.mining_schema, ctx)
     any_repl = bool(has_repl.any())
     targets = doc.targets
 
@@ -183,4 +207,6 @@ def compile_pmml(
         batch_size=batch_size,
         _jit_fn=jit_fn,
         model_name=name,
+        _doc=doc,
+        _config=config,
     )

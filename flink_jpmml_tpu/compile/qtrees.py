@@ -1,0 +1,422 @@
+"""Quantized-wire fast path for numeric tree ensembles (the bench hot path).
+
+The dense path-matrix lowering (trees.py) streams ``f32[B, F]`` feature
+batches to the device. For the north-star workload — a 500-tree GBM scored
+over a network stream (BASELINE config 2) — the binding resource is
+host→device *bytes*, not FLOPs: scoring only ever compares each feature
+against the model's own finite set of split thresholds, so a record can be
+shipped as per-feature *threshold ranks* instead of raw floats.
+
+This module builds that wire format:
+
+- **Cut tables.** Every comparison split is normalised to a ``x <= cut``
+  test (``<`` becomes ``<= nextafter(v, -inf)``; ``>``/``>=`` flip the
+  children, which negates the split's path-matrix row and its missing
+  default direction). The sorted unique cuts per feature form the table
+  ``U[f]``; ``rank(x) = #{c in U[f] : c < x}`` and the split against cut
+  ``U[f][i]`` holds iff ``rank(x) <= i``. Integer compares on ranks are
+  therefore *bit-exact* with the float compares of the dense path.
+- **Wire dtype.** ``uint8`` when every feature has <= 254 cuts (histogram-
+  trained GBMs — LightGBM/XGBoost-hist — always satisfy this), else
+  ``uint16``. The top code (255/65535) is the missing-value sentinel. A
+  32-feature record shrinks from 128+32 bytes (f32 + mask) to 32 bytes.
+- **Device kernel.** The same three-einsum structure as trees.py but all
+  intermediates are int8 (sign indicators, path accumulator, leaf one-hot),
+  which cuts HBM traffic ~4x; leaf values contract in a bf16 hi+lo split
+  (exact to ~2^-17 relative) so the MXU stays in fast dtypes without
+  giving up float32-level accuracy.
+
+Reference parity: this accelerates the same evaluation the reference runs
+per record on the CPU via JPMML-Evaluator (SURVEY.md §4.1 hot loop); the
+general f32 path remains the semantic baseline and every model that is not
+an all-numeric-comparison tree ensemble simply reports "not eligible".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile import prepare
+from flink_jpmml_tpu.compile.common import (
+    LowerCtx,
+    apply_targets_value,
+    build_codecs,
+    extract_missing_replacements,
+)
+from flink_jpmml_tpu.compile.trees import (
+    _canonicalize_forest,
+    pack_ensemble,
+)
+from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.config import CompileConfig
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+# opcodes from trees.py: 0 '<', 1 '<=', 2 '>', 3 '>='
+_SUPPORTED_OPS = frozenset((0, 1, 2, 3))
+_REGRESSION_METHODS = frozenset(
+    ("single", "sum", "average", "weightedAverage", "max", "median")
+)
+
+
+@dataclass(frozen=True)
+class QuantizedWire:
+    """Host-side featurizer: f32 records → threshold-rank codes.
+
+    ``cuts[j]`` is the sorted cut table of input column ``j`` (possibly
+    empty); ``dtype`` is ``np.uint8`` or ``np.uint16``; ``sentinel`` marks
+    missing values. ``repl``/``has_repl`` fold the model's top-level
+    mining-schema ``missingValueReplacement`` into encoding so the device
+    kernel never needs a mask plane.
+    """
+
+    fields: Tuple[str, ...]
+    cuts: Tuple[np.ndarray, ...]
+    dtype: type
+    sentinel: int
+    repl: np.ndarray  # f32[F]
+    has_repl: np.ndarray  # bool[F]
+
+    @property
+    def bytes_per_record(self) -> int:
+        return len(self.fields) * np.dtype(self.dtype).itemsize
+
+    def _flat_tables(self):
+        """(cuts_flat f32, offsets i32[F+1]) for the native bucketizer."""
+        cached = getattr(self, "_flat_cache", None)
+        if cached is None:
+            offs = np.zeros((len(self.cuts) + 1,), np.int32)
+            for j, c in enumerate(self.cuts):
+                offs[j + 1] = offs[j] + len(c)
+            flat = (
+                np.concatenate(self.cuts).astype(np.float32)
+                if offs[-1]
+                else np.empty((0,), np.float32)
+            )
+            cached = (flat, offs)
+            object.__setattr__(self, "_flat_cache", cached)
+        return cached
+
+    def encode(
+        self, X: np.ndarray, M: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """f32[B, F] (+ optional missing mask) → rank codes [B, F].
+
+        NaNs count as missing. Missing cells take the mining-schema
+        replacement value when one is declared, else the sentinel. Uses the
+        multithreaded C++ bucketizer (native/fjt_native.cpp) when built;
+        numpy searchsorted otherwise (identical semantics).
+        """
+        from flink_jpmml_tpu.runtime import native
+
+        flat, offs = self._flat_tables()
+        out = native.bucketize(
+            X,
+            flat,
+            offs,
+            self.repl,
+            self.has_repl.astype(np.uint8),
+            self.dtype,
+            mask=M,
+        )
+        if out is not None:
+            return out
+        X = np.asarray(X, np.float32)
+        miss = np.isnan(X)
+        if M is not None:
+            miss = miss | M
+        if self.has_repl.any():
+            use = miss & self.has_repl[None, :]
+            X = np.where(use, self.repl[None, :], X)
+            miss = miss & ~self.has_repl[None, :]
+        out = np.empty(X.shape, self.dtype)
+        for j, cuts in enumerate(self.cuts):
+            # rank = #{c < x}  (side='left' over the sorted cut table)
+            out[:, j] = np.searchsorted(cuts, X[:, j], side="left")
+        out[miss] = self.sentinel
+        return out
+
+    def encode_records(self, space: prepare.FieldSpace, records) -> np.ndarray:
+        X, M = prepare.from_records(space, records)
+        return self.encode(X, M)
+
+
+@dataclass
+class QuantizedScorer:
+    """Jitted rank-wire scorer for one tree-ensemble model.
+
+    ``predict_wire(Xq)`` runs the device kernel on an encoded batch and
+    returns f32 values (the full aggregate incl. Targets rescale);
+    ``score(X, M)`` is the convenience f32 entry (encode + predict).
+    """
+
+    wire: QuantizedWire
+    params: Dict[str, jnp.ndarray]
+    field_space: prepare.FieldSpace
+    batch_size: Optional[int]
+    n_trees: int
+    _jit_fn: object
+
+    def predict_wire(self, Xq) -> jnp.ndarray:
+        return self._jit_fn(self.params, Xq)
+
+    def score(self, X, M=None) -> List[Prediction]:
+        n = np.asarray(X).shape[0]
+        Xq = self.wire.encode(X, M)
+        if self.batch_size is not None and n != self.batch_size:
+            pad = self.batch_size - (n % self.batch_size or self.batch_size)
+            if pad:
+                Xq = np.concatenate(
+                    [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)], axis=0
+                )
+        values = np.asarray(self.predict_wire(Xq), np.float32)[:n]
+        return decode_batch(values.tolist(), [True] * n, None, None)
+
+
+def _split_bf16(v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """f32 → (hi, lo) bf16 pair with hi + lo ≈ v to ~2^-17 relative."""
+    hi = v.astype(jnp.bfloat16)
+    lo = (v - hi.astype(np.float32)).astype(jnp.bfloat16)
+    return np.asarray(hi), np.asarray(lo)
+
+
+def _match_ensemble(
+    doc: ir.PmmlDocument,
+) -> Optional[Tuple[List[ir.TreeModelIR], List[float], str]]:
+    """doc → (trees, weights, method) when the model is a regression tree
+    ensemble the fast path can take; None otherwise."""
+    model = doc.model
+    if isinstance(model, ir.TreeModelIR):
+        if model.function_name != "regression":
+            return None
+        return [model], [1.0], "single"
+    if not isinstance(model, ir.MiningModelIR):
+        return None
+    if model.function_name != "regression":
+        return None
+    seg = model.segmentation
+    if seg is None:
+        return None
+    method = seg.multiple_model_method
+    if method not in _REGRESSION_METHODS:
+        return None
+    trees: List[ir.TreeModelIR] = []
+    weights: List[float] = []
+    for s in seg.segments:
+        if not isinstance(s.predicate, ir.TruePredicate):
+            return None
+        if not isinstance(s.model, ir.TreeModelIR):
+            return None
+        if s.model.function_name != "regression":
+            return None
+        trees.append(s.model)
+        weights.append(s.weight)
+    if not trees:
+        return None
+    return trees, weights, method
+
+
+def build_quantized_scorer(
+    doc: ir.PmmlDocument,
+    batch_size: Optional[int] = None,
+    config: Optional[CompileConfig] = None,
+) -> Optional[QuantizedScorer]:
+    """Try to build the rank-wire fast path for ``doc``.
+
+    Returns None when the model shape is outside the fast path's contract
+    (non-regression, non-tree segments, set/equality splits, missing-value
+    strategies that null predictions, or trees too deep for the dense
+    lowering). Raises only on malformed documents.
+    """
+    config = config or CompileConfig()
+    if doc.transformations.derived_fields:
+        # derived-field preprocessing isn't folded into the rank wire
+        return None
+    matched = _match_ensemble(doc)
+    if matched is None:
+        return None
+    trees, weights, method = matched
+
+    fields = doc.active_fields
+    ctx = LowerCtx(
+        field_index={f: i for i, f in enumerate(fields)},
+        codecs=build_codecs(doc.data_dictionary),
+        config=config,
+    )
+    try:
+        canons, classification, depth = _canonicalize_forest(trees, ctx)
+    except ModelCompilationException:
+        return None
+    # int8 path sums are bounded by ±depth — beyond 127 the int8 acc/count
+    # would wrap and mis-select leaves, so such trees stay on the f32 path
+    if classification or depth > min(config.max_dense_depth, 127):
+        return None
+    packed = pack_ensemble(canons, classification)
+    p = packed.params
+    if "set_codes" in p or p["mnull"].any():
+        return None
+    T, S, L = packed.n_trees, packed.n_splits, packed.n_leaves
+    ops = packed.opcodes
+    # real split slots lie on >=1 leaf path; padded slots have all-zero rows
+    real = np.abs(p["P"]).sum(axis=2) > 0  # [T, S]
+    if not set(np.unique(ops[real]).tolist()) <= _SUPPORTED_OPS:
+        return None
+    # a codec (string-categorical) field under an order comparison would
+    # compare category codes — semantically fragile; leave to the f32 path
+    if ctx.codecs:
+        codec_cols = {ctx.field_index[f] for f in ctx.codecs if f in ctx.field_index}
+        if any(int(c) in codec_cols for c in np.unique(p["feat"][real])):
+            return None
+
+    thresh = p["thresh"]
+    feat = p["feat"]
+    # normalise every real split to "go_left iff rank <= cut_index"
+    #   '<'  v  → cut nextafter(v,-inf)            '>'  v → cut v, flip
+    #   '<=' v  → cut v                            '>=' v → cut nextafter, flip
+    cut_val = np.where(
+        (ops == 0) | (ops == 3),
+        np.nextafter(thresh, -np.inf, dtype=np.float32),
+        thresh,
+    )
+    flip = (ops == 2) | (ops == 3)
+
+    F = len(fields)
+    cuts: List[np.ndarray] = [np.empty((0,), np.float32) for _ in range(F)]
+    for j in range(F):
+        sel = real & (feat == j)
+        if sel.any():
+            cuts[j] = np.unique(cut_val[sel].astype(np.float32))
+    max_cuts = max((len(c) for c in cuts), default=0)
+    if max_cuts <= 254:
+        dtype, sentinel = np.uint8, 255
+    elif max_cuts <= 65534:
+        dtype, sentinel = np.uint16, 65535
+    else:
+        return None
+
+    # threshold index per split: position of its cut in its feature's table
+    qthr = np.zeros((T, S), dtype)
+    for j in range(F):
+        sel = real & (feat == j)
+        if sel.any():
+            qthr[sel] = np.searchsorted(cuts[j], cut_val[sel]).astype(dtype)
+
+    dleft = (p["dleft"] > 0.5) ^ flip
+    P = p["P"].copy()
+    P[flip] = -P[flip]
+
+    # fold per-tree aggregate coefficients into leaf values where the
+    # aggregate is linear, so one fused einsum produces the final value
+    w = np.asarray(weights, np.float32)
+    vals = p["leaf_values"].astype(np.float32)  # [T, L]
+    if method in ("single", "sum"):
+        fused_linear, coef = True, np.ones((T,), np.float32)
+    elif method == "average":
+        fused_linear, coef = True, np.full((T,), 1.0 / T, np.float32)
+    elif method == "weightedAverage":
+        fused_linear, coef = True, (w / w.sum()).astype(np.float32)
+    else:  # max / median need the per-tree plane
+        fused_linear, coef = False, np.ones((T,), np.float32)
+    vhi, vlo = _split_bf16(vals * coef[:, None])
+
+    targets = doc.targets
+    repl, has_repl = extract_missing_replacements(doc.model.mining_schema, ctx)
+
+    wire = QuantizedWire(
+        fields=fields,
+        cuts=tuple(cuts),
+        dtype=dtype,
+        sentinel=sentinel,
+        repl=repl,
+        has_repl=has_repl,
+    )
+
+    params: Dict[str, np.ndarray] = {
+        "feat": feat.astype(np.int32),
+        "qthr": qthr,
+        "dleft": dleft,
+        "P_i8": P.astype(np.int8),
+        "count_i8": p["count"].astype(np.int8),
+        "vhi": vhi,
+        "vlo": vlo,
+    }
+    if not fused_linear:
+        params["vals_f32"] = vals
+
+    on_cpu = jax.default_backend() == "cpu"
+    sent = dtype(sentinel)
+
+    def qfn(pp, Xq):
+        xv = Xq[:, pp["feat"]]  # [B, T, S] rank codes
+        miss = xv == sent
+        go = jnp.where(miss, pp["dleft"], xv <= pp["qthr"])
+        if on_cpu:
+            # CPU backend: no int8/bf16 dot kernels — compute in f32
+            sign = jnp.where(go, 1.0, -1.0).astype(jnp.float32)
+            acc = jnp.einsum(
+                "bts,tsl->btl", sign, pp["P_i8"].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            hit = (acc == pp["count_i8"].astype(jnp.float32)[None]).astype(
+                jnp.float32
+            )
+            if fused_linear:
+                hi = pp["vhi"].astype(jnp.float32)
+                lo = pp["vlo"].astype(jnp.float32)
+                value = jnp.einsum("btl,tl->b", hit, hi) + jnp.einsum(
+                    "btl,tl->b", hit, lo
+                )
+            else:
+                per_tree = jnp.einsum("btl,tl->bt", hit, pp["vals_f32"])
+                value = (
+                    jnp.max(per_tree, axis=1)
+                    if method == "max"
+                    else jnp.median(per_tree, axis=1)
+                )
+        else:
+            sign = jnp.where(go, jnp.int8(1), jnp.int8(-1))
+            acc = jnp.einsum(
+                "bts,tsl->btl", sign, pp["P_i8"],
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.int8)
+            hit = (acc == pp["count_i8"][None]).astype(jnp.bfloat16)
+            if fused_linear:
+                value = (
+                    jnp.einsum(
+                        "btl,tl->b", hit, pp["vhi"],
+                        preferred_element_type=jnp.float32,
+                    )
+                    + jnp.einsum(
+                        "btl,tl->b", hit, pp["vlo"],
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            else:
+                per_tree = jnp.einsum(
+                    "btl,tl->bt", hit.astype(jnp.float32), pp["vals_f32"],
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                value = (
+                    jnp.max(per_tree, axis=1)
+                    if method == "max"
+                    else jnp.median(per_tree, axis=1)
+                )
+        value = apply_targets_value(value, targets)
+        return value.astype(jnp.float32)
+
+    jit_fn = jax.jit(qfn, donate_argnums=(1,) if config.donate_batches else ())
+    codecs = ctx.codecs
+
+    return QuantizedScorer(
+        wire=wire,
+        params=jax.device_put(params),
+        field_space=prepare.FieldSpace(fields=fields, codecs=codecs),
+        batch_size=batch_size,
+        n_trees=T,
+        _jit_fn=jit_fn,
+    )

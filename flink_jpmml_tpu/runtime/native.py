@@ -87,6 +87,24 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint32,
             ctypes.c_int64,
         ]
+        for name, code_t in (
+            ("fjt_bucketize_u8", ctypes.c_uint8),
+            ("fjt_bucketize_u16", ctypes.c_uint16),
+        ):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_float),   # X
+                ctypes.c_uint64,                  # n
+                ctypes.c_uint32,                  # f
+                ctypes.POINTER(ctypes.c_float),   # cuts
+                ctypes.POINTER(ctypes.c_int32),   # offs
+                ctypes.POINTER(ctypes.c_float),   # repl
+                ctypes.POINTER(ctypes.c_uint8),   # has_repl
+                ctypes.POINTER(ctypes.c_uint8),   # mask (nullable)
+                ctypes.POINTER(code_t),           # out
+                ctypes.c_uint32,                  # n_threads
+            ]
         _lib = lib
         return _lib
 
@@ -162,3 +180,47 @@ class NativeRing:
         if handle:
             self._lib.fjt_ring_destroy(handle)
             self._handle = None
+
+
+def bucketize(
+    X: np.ndarray,
+    cuts_flat: np.ndarray,
+    offs: np.ndarray,
+    repl: np.ndarray,
+    has_repl: np.ndarray,
+    out_dtype,
+    mask: Optional[np.ndarray] = None,
+    n_threads: int = 0,
+) -> Optional[np.ndarray]:
+    """Multithreaded rank-wire featurization (see fjt_bucketize_* in C++).
+
+    Returns the [n, f] code array, or None when the native library is
+    unavailable (caller falls back to the numpy searchsorted path in
+    :meth:`flink_jpmml_tpu.compile.qtrees.QuantizedWire.encode`).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    X = np.ascontiguousarray(X, np.float32)
+    n, f = X.shape
+    out = np.empty((n, f), out_dtype)
+    fn = lib.fjt_bucketize_u8 if out.itemsize == 1 else lib.fjt_bucketize_u16
+    code_t = ctypes.c_uint8 if out.itemsize == 1 else ctypes.c_uint16
+    if mask is not None:
+        mask = np.ascontiguousarray(mask, np.uint8)
+        mask_ptr = mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    else:
+        mask_ptr = ctypes.POINTER(ctypes.c_uint8)()
+    fn(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        f,
+        cuts_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        repl.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        has_repl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mask_ptr,
+        out.ctypes.data_as(ctypes.POINTER(code_t)),
+        n_threads,
+    )
+    return out
