@@ -41,7 +41,7 @@ def main() -> None:
     ap.add_argument("--trees", type=int, default=500)
     ap.add_argument("--depth", type=int, default=6)
     ap.add_argument("--features", type=int, default=32)
-    ap.add_argument("--batch", type=int, default=131072,
+    ap.add_argument("--batch", type=int, default=262144,
                     help="records per dispatch (scored in --chunk chunks)")
     ap.add_argument("--chunk", type=int, default=16384)
     ap.add_argument("--window", type=int, default=2,
@@ -61,7 +61,7 @@ def main() -> None:
 
     cache_dir = os.path.join(
         tempfile.gettempdir(),
-        f"fjt-bench-{args.trees}x{args.depth}x{args.features}",
+        f"fjt-bench-{args.trees}x{args.depth}x{args.features}-h254",
     )
     os.makedirs(cache_dir, exist_ok=True)
     pmml = os.path.join(cache_dir, f"gbm_{args.trees}.pmml")

@@ -161,6 +161,7 @@ class QuantizedScorer:
     batch_size: Optional[int]
     n_trees: int
     _jit_fn: object
+    backend: str = "xla"  # "xla" | "pallas"
 
     def predict_wire(self, Xq) -> jnp.ndarray:
         return self._jit_fn(self.params, Xq)
@@ -225,6 +226,8 @@ def build_quantized_scorer(
     doc: ir.PmmlDocument,
     batch_size: Optional[int] = None,
     config: Optional[CompileConfig] = None,
+    backend: str = "auto",
+    pallas_interpret: bool = False,
 ) -> Optional[QuantizedScorer]:
     """Try to build the rank-wire fast path for ``doc``.
 
@@ -232,6 +235,11 @@ def build_quantized_scorer(
     (non-regression, non-tree segments, set/equality splits, missing-value
     strategies that null predictions, or trees too deep for the dense
     lowering). Raises only on malformed documents.
+
+    ``backend``: "auto" picks the Pallas VMEM-resident kernel
+    (qtrees_pallas.py) on TPU when eligible (uint8 wire, linear aggregate,
+    fixed batch), the XLA einsum path otherwise; "xla"/"pallas" force one.
+    ``pallas_interpret`` runs the kernel in interpreter mode (CPU tests).
     """
     config = config or CompileConfig()
     if doc.transformations.derived_fields:
@@ -409,6 +417,51 @@ def build_quantized_scorer(
         value = apply_targets_value(value, targets)
         return value.astype(jnp.float32)
 
+    # Pallas VMEM-resident kernel: eligible for the uint8 wire with a linear
+    # aggregate and a fixed batch that tiles into blocks (the GBM hot path)
+    want_pallas = backend in ("auto", "pallas")
+    can_pallas = (
+        dtype is np.uint8
+        and fused_linear
+        and batch_size is not None
+        and (not on_cpu or pallas_interpret)
+    )
+    if want_pallas and can_pallas:
+        from flink_jpmml_tpu.compile import qtrees_pallas
+
+        groups = qtrees_pallas.pack_groups(
+            feat=params["feat"].astype(np.int64),
+            qthr=qthr,
+            dleft=np.asarray(dleft),
+            P=params["P_i8"],
+            count=params["count_i8"],
+            vals=vals * coef[:, None],
+            n_fields=F,
+        )
+        raw = qtrees_pallas.build_pallas_fn(
+            groups, batch_size, F, sentinel, interpret=pallas_interpret
+        )
+        if raw is not None:
+            def pqfn(gp, Xq):
+                return apply_targets_value(raw(gp, Xq), targets).astype(
+                    jnp.float32
+                )
+
+            return QuantizedScorer(
+                wire=wire,
+                params=jax.device_put(groups),
+                field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
+                batch_size=batch_size,
+                n_trees=T,
+                _jit_fn=jax.jit(
+                    pqfn,
+                    donate_argnums=(1,) if config.donate_batches else (),
+                ),
+                backend="pallas",
+            )
+    if backend == "pallas":
+        return None  # forced pallas but not eligible
+
     jit_fn = jax.jit(qfn, donate_argnums=(1,) if config.donate_batches else ())
     codecs = ctx.codecs
 
@@ -419,4 +472,5 @@ def build_quantized_scorer(
         batch_size=batch_size,
         n_trees=T,
         _jit_fn=jit_fn,
+        backend="xla",
     )

@@ -1,0 +1,71 @@
+"""Pallas VMEM-resident quantized kernel (qtrees_pallas.py) parity.
+
+Runs in Pallas interpreter mode on the CPU test backend; the math is
+identical to the compiled TPU kernel (same trace), so interpret-mode parity
+plus the XLA-path golden tests pin the kernel's semantics.
+"""
+
+import numpy as np
+import pytest
+
+from assets.generate import gen_gbm
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
+from flink_jpmml_tpu.pmml import parse_pmml_file
+
+
+def _doc(tmp_path, **kw):
+    return parse_pmml_file(gen_gbm(str(tmp_path), **kw))
+
+
+class TestPallasParity:
+    def test_matches_xla_and_f32_paths(self, tmp_path):
+        doc = _doc(tmp_path, n_trees=21, depth=4, n_features=8)
+        B = 64
+        cm = compile_pmml(doc, batch_size=B)
+        qx = build_quantized_scorer(doc, batch_size=B, backend="xla")
+        qp = build_quantized_scorer(
+            doc, batch_size=B, backend="pallas", pallas_interpret=True
+        )
+        assert qp is not None and qp.backend == "pallas"
+        rng = np.random.default_rng(0)
+        X = rng.normal(0.0, 1.5, size=(B, 8)).astype(np.float32)
+        X[rng.random(size=X.shape) < 0.2] = np.nan
+        Xq = qp.wire.encode(X)
+        got = np.asarray(qp.predict_wire(Xq), np.float32)
+        ref_x = np.asarray(qx.predict_wire(Xq), np.float32)
+        M = np.isnan(X)
+        ref_f = np.asarray(
+            cm.predict(np.nan_to_num(X, nan=0.0), M).value, np.float32
+        )
+        np.testing.assert_allclose(got, ref_x, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got, ref_f, rtol=1e-4, atol=1e-5)
+
+    def test_group_padding_trees_not_multiple_of_gt(self, tmp_path):
+        # 19 trees: pads to 20 (GT=4) — padded trees must contribute zero
+        doc = _doc(tmp_path, n_trees=19, depth=3, n_features=4)
+        B = 32
+        qx = build_quantized_scorer(doc, batch_size=B, backend="xla")
+        qp = build_quantized_scorer(
+            doc, batch_size=B, backend="pallas", pallas_interpret=True
+        )
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(B, 4)).astype(np.float32)
+        Xq = qp.wire.encode(X)
+        np.testing.assert_allclose(
+            np.asarray(qp.predict_wire(Xq)),
+            np.asarray(qx.predict_wire(Xq)),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_u16_wire_not_pallas_eligible(self, tmp_path):
+        doc = _doc(tmp_path, n_trees=300, depth=5, n_features=2,
+                   hist_bins=None)
+        qp = build_quantized_scorer(
+            doc, batch_size=64, backend="pallas", pallas_interpret=True
+        )
+        assert qp is None  # u16 ranks are not bf16-exact
+        qa = build_quantized_scorer(
+            doc, batch_size=64, backend="auto", pallas_interpret=True
+        )
+        assert qa is not None and qa.backend == "xla"
