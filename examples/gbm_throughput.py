@@ -1,0 +1,76 @@
+"""Example: 500-tree GBM scored over a tabular stream (BASELINE config 2).
+
+The north-star workload: a histogram-trained gradient-boosted ensemble
+scoring a high-rate feature stream. The reference runs JPMML-Evaluator's
+per-record tree walk inside a Flink flatMap (SURVEY.md §4.1 hot loop);
+here the engine's StaticScorer picks the quantized rank wire
+(compile/qtrees.py) automatically — each record crosses to the device as
+32 uint8 threshold ranks and the whole micro-batch is scored by the
+Pallas VMEM-resident kernel (TPU) or the int8 einsum path.
+
+Run:  python examples/gbm_throughput.py  [--trees 500 --seconds 3]
+bench.py is the measured version of this pipeline.
+"""
+
+import argparse
+import pathlib
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from assets.generate import gen_gbm
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.runtime.engine import Pipeline, StaticScorer
+from flink_jpmml_tpu.runtime.sinks import NullSink
+from flink_jpmml_tpu.runtime.sources import InMemorySource
+from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trees", type=int, default=500)
+    ap.add_argument("--features", type=int, default=32)
+    ap.add_argument("--records", type=int, default=200_000)
+    args = ap.parse_args()
+
+    workdir = tempfile.mkdtemp(prefix="fjt-gbm-")
+    pmml = gen_gbm(workdir, n_trees=args.trees, n_features=args.features)
+    doc = parse_pmml_file(pmml)
+    cm = compile_pmml(doc, batch_size=16384)
+    q = cm.quantized_scorer()
+    print(
+        f"model: {args.trees} trees | rank wire: "
+        f"{q.wire.bytes_per_record if q else 'n/a'} B/record | "
+        f"kernel backend: {q.backend if q else 'f32'}"
+    )
+
+    scorer = StaticScorer(cm)
+    rng = np.random.default_rng(0)
+    block = [
+        {f"f{j}": float(v) for j, v in enumerate(row)}
+        for row in rng.normal(0.0, 1.5, size=(args.records, args.features))
+    ]
+    source = InMemorySource(block)
+    sink = NullSink()
+    pipe = Pipeline(
+        source,
+        scorer,
+        sink,
+        RuntimeConfig(batch=BatchConfig(size=16384, deadline_us=5000)),
+    )
+    t0 = time.perf_counter()
+    pipe.run_until_exhausted(timeout=600.0)
+    dt = time.perf_counter() - t0
+    snap = pipe.metrics.snapshot()
+    print(f"scored {sink.count} records in {dt:.2f}s "
+          f"({sink.count / dt:,.0f} rec/s through the full pipeline)")
+    print(f"metrics: {snap}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Example: stacked modelChain ensemble, sharded over a device mesh
+(BASELINE config 5).
+
+A MiningModel modelChain — inner GBM whose output field feeds a logistic
+calibration RegressionModel — over a wide (default 10k) sparse feature
+space, scored with the batch axis sharded across all available devices
+(data parallelism over ICI; the reference's only parallelism is Flink
+operator DP, SURVEY.md §3 P1). On a CPU host run with
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+  python examples/stacked_sharded.py
+to get the virtual 8-device mesh; on a TPU slice the same code shards over
+the real chips.
+
+Run:  python examples/stacked_sharded.py [--features 10000]
+"""
+
+import argparse
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import os
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon TPU plugin ignores the env var; force via config before the
+    # backend initializes so the virtual multi-device CPU mesh is honored
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from assets.generate import gen_stacked
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.parallel.mesh import make_mesh
+from flink_jpmml_tpu.parallel.sharding import dp_sharded
+from flink_jpmml_tpu.pmml import parse_pmml_file
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--features", type=int, default=10_000)
+    ap.add_argument("--trees", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=2048)
+    args = ap.parse_args()
+
+    workdir = tempfile.mkdtemp(prefix="fjt-stacked-")
+    pmml = gen_stacked(
+        workdir, n_trees=args.trees, depth=4, n_features=args.features
+    )
+    doc = parse_pmml_file(pmml)
+    cm = compile_pmml(doc)
+
+    import jax
+
+    mesh = make_mesh()
+    print(f"mesh: {mesh.shape} over {len(jax.devices())} devices")
+
+    rng = np.random.default_rng(0)
+    # sparse-ish stream: most features zero, a few hot
+    X = np.zeros((args.batch, args.features), np.float32)
+    hot = rng.integers(0, args.features, size=(args.batch, 32))
+    X[np.arange(args.batch)[:, None], hot] = rng.normal(
+        0.0, 1.0, size=hot.shape
+    )
+    M = np.zeros_like(X, bool)
+
+    sharded = dp_sharded(cm, mesh)
+    out = sharded.predict(X, M)
+    values = np.asarray(out.value)
+    print(f"scored {args.batch} x {args.features}-dim records "
+          f"(batch axis sharded {mesh.shape}); "
+          f"calibrated score range [{values.min():.4f}, {values.max():.4f}]")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from flink_jpmml_tpu.compile.common import (
     build_codecs,
     extract_missing_replacements,
 )
+from flink_jpmml_tpu.compile.exprs import lower_expression
 from flink_jpmml_tpu.compile.mining import lower_mining
 from flink_jpmml_tpu.compile.neural import lower_neural_network
 from flink_jpmml_tpu.compile.regression import lower_regression
@@ -171,15 +172,44 @@ def compile_pmml(
     fields = doc.active_fields
     if not fields:
         raise ModelCompilationException("model has no active fields")
+    codecs = build_codecs(doc.data_dictionary)
+
+    # TransformationDictionary derived fields become extra input columns,
+    # computed on-device from the raw columns before the model body runs
+    # (declaration order; later fields may reference earlier ones). The
+    # user-facing field space stays the raw active fields.
+    derived = doc.transformations.derived_fields
+    field_index = {f: i for i, f in enumerate(fields)}
+    derived_fns = []
+    for df in derived:
+        dctx = LowerCtx(
+            field_index=dict(field_index), codecs=codecs, config=config
+        )
+        derived_fns.append(lower_expression(df.expression, dctx))
+        if df.name in field_index:
+            raise ModelCompilationException(
+                f"derived field {df.name!r} shadows an existing field"
+            )
+        field_index[df.name] = len(field_index)
+
     ctx = LowerCtx(
-        field_index={f: i for i, f in enumerate(fields)},
-        codecs=build_codecs(doc.data_dictionary),
+        field_index=field_index,
+        codecs=codecs,
         config=config,
     )
     lowered = lower_model(doc.model, ctx)
 
-    # top-level mining-schema missingValueReplacement (C4), vectorized
-    repl, has_repl = extract_missing_replacements(doc.model.mining_schema, ctx)
+    # top-level mining-schema missingValueReplacement (C4), vectorized —
+    # sized to the RAW columns (it runs before derived columns exist,
+    # mirroring the oracle's replacement → transformations order)
+    raw_ctx = LowerCtx(
+        field_index={f: i for i, f in enumerate(fields)},
+        codecs=codecs,
+        config=config,
+    )
+    repl, has_repl = extract_missing_replacements(
+        doc.model.mining_schema, raw_ctx
+    )
     any_repl = bool(has_repl.any())
     targets = doc.targets
 
@@ -189,6 +219,12 @@ def compile_pmml(
             use = M & has_repl[None, :]
             X = jnp.where(use, repl[None, :], X)
             M = M & ~has_repl[None, :]
+        for dfn in derived_fns:  # appends columns in declaration order
+            v, miss = dfn(X, M)
+            X = jnp.concatenate(
+                [X, v.astype(jnp.float32)[:, None]], axis=1
+            )
+            M = jnp.concatenate([M, miss[:, None]], axis=1)
         out = lowered.fn(params, X, M)
         return apply_targets(out, targets)
 

@@ -239,8 +239,23 @@ def evaluate(doc: ir.PmmlDocument, record: Record) -> EvalResult:
     rescaling — the oracle's public entry."""
     rec = _sanitize_categoricals(doc.data_dictionary, record)
     rec = _apply_missing_replacement(doc.model.mining_schema, rec)
+    rec = _apply_transformations(doc.transformations, rec)
     res = _eval_model(doc.model, rec)
     return _apply_targets(doc.targets, res)
+
+
+def _apply_transformations(
+    td: ir.TransformationDictionary, record: Record
+) -> Record:
+    """TransformationDictionary derived fields extend the record in
+    declaration order (later fields may reference earlier ones); a failed
+    expression leaves the derived field missing."""
+    if not td.derived_fields:
+        return record
+    out = dict(record)
+    for df in td.derived_fields:
+        out[df.name] = eval_expression(df.expression, out)
+    return out
 
 
 def _sanitize_categoricals(dd: ir.DataDictionary, record: Record) -> Record:

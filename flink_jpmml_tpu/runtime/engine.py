@@ -29,7 +29,7 @@ import numpy as np
 
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
-from flink_jpmml_tpu.models.prediction import Prediction
+from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
 from flink_jpmml_tpu.runtime.queues import BoundedQueue, Closed
 from flink_jpmml_tpu.runtime.sinks import Sink
@@ -86,11 +86,15 @@ class StaticScorer(Scorer):
         extract: Optional[ExtractFn] = None,
         emit: Optional[EmitFn] = None,
         replace_nan: Optional[float] = None,
+        use_quantized: bool = True,
     ):
         self._model = model
         self._replace_nan = replace_nan
         self._extract = extract or self._extract_records
         self._emit = emit or (lambda recs, preds: list(preds))
+        # rank-wire fast path (qtrees.py): ships uint8 threshold ranks
+        # instead of f32+mask when the model is an eligible tree ensemble
+        self._q = model.quantized_scorer() if use_quantized else None
 
     def _extract_records(self, records: Sequence[Any]):
         first = records[0]
@@ -104,14 +108,29 @@ class StaticScorer(Scorer):
     def submit(self, records: Sequence[Any]):
         X, M = self._extract(records)
         n = X.shape[0]
+        if self._q is not None:
+            Xq = self._q.wire.encode(X, M)
+            bs = self._q.batch_size
+            if bs is not None and n != bs:
+                pad = (-n) % bs
+                if pad:
+                    Xq = np.concatenate(
+                        [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)]
+                    )
+            out = self._q.predict_wire(Xq)  # async dispatch
+            return ("q", out, records, n)
         if self._model.batch_size is not None:
             X, M, _ = prepare.pad_batch(X, M, self._model.batch_size)
         out = self._model.predict(X, M)  # async dispatch
-        return (out, records, n)
+        return ("f", out, records, n)
 
     def finish(self, ticket) -> List[Any]:
-        out, records, n = ticket
-        preds = self._model.decode(out, n)  # blocks on device
+        kind, out, records, n = ticket
+        if kind == "q":
+            values = np.asarray(out, np.float32)[:n]
+            preds = decode_batch(values.tolist(), [True] * n, None, None)
+        else:
+            preds = self._model.decode(out, n)  # blocks on device
         return self._emit(records, preds)
 
 

@@ -234,3 +234,29 @@ class TestManagers:
         assert not ch3
         meta4, ch4 = managers.apply_message(meta, DelMessage("m", 1, 4.0))
         assert ch4 and not meta4
+
+
+class TestQuantizedScorerPath:
+    def test_static_scorer_uses_rank_wire_for_gbm(self, tmp_path):
+        import numpy as np
+        from assets.generate import gen_gbm
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml_file
+        from flink_jpmml_tpu.runtime.engine import StaticScorer
+
+        doc = parse_pmml_file(gen_gbm(str(tmp_path), n_trees=20, depth=4,
+                                      n_features=6))
+        cm = compile_pmml(doc, batch_size=32)
+        s_q = StaticScorer(cm)
+        s_f = StaticScorer(cm, use_quantized=False)
+        assert s_q._q is not None and s_f._q is None
+        rng = np.random.default_rng(0)
+        records = [
+            {f"f{j}": float(v) for j, v in enumerate(row) if j % 5 != 3}
+            for row in rng.normal(size=(17, 6))
+        ]
+        got = s_q.finish(s_q.submit(records))
+        exp = s_f.finish(s_f.submit(records))
+        assert len(got) == len(exp) == 17
+        for a, b in zip(got, exp):
+            assert abs(a.score.value - b.score.value) < 1e-3

@@ -1,0 +1,120 @@
+"""TransformationDictionary derived fields: compiled path vs oracle.
+
+The reference delegates preprocessing to JPMML-Evaluator's handling of
+TransformationDictionary (SURVEY.md §8 step 1 lists DerivedFields as part
+of the parser/IR scope); here derived fields lower to extra on-device
+columns computed before the model body (compiler.py) and to record
+extension in the oracle (interp.py)."""
+
+import numpy as np
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+_XML = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <DataDictionary numberOfFields="3">
+    <DataField name="a" optype="continuous" dataType="double"/>
+    <DataField name="b" optype="continuous" dataType="double"/>
+    <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TransformationDictionary>
+    <DerivedField name="a_norm" optype="continuous" dataType="double">
+      <NormContinuous field="a">
+        <LinearNorm orig="-2" norm="0"/>
+        <LinearNorm orig="2" norm="1"/>
+      </NormContinuous>
+    </DerivedField>
+    <DerivedField name="ab_sum" optype="continuous" dataType="double">
+      <Apply function="+">
+        <FieldRef field="a_norm"/>
+        <FieldRef field="b"/>
+      </Apply>
+    </DerivedField>
+  </TransformationDictionary>
+  <RegressionModel functionName="regression">
+    <MiningSchema>
+      <MiningField name="y" usageType="target"/>
+      <MiningField name="a"/>
+      <MiningField name="b"/>
+    </MiningSchema>
+    <RegressionTable intercept="0.25">
+      <NumericPredictor name="ab_sum" coefficient="2.0"/>
+      <NumericPredictor name="b" coefficient="-0.5"/>
+    </RegressionTable>
+  </RegressionModel>
+</PMML>"""
+
+_TREE_XML = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <DataDictionary numberOfFields="2">
+    <DataField name="a" optype="continuous" dataType="double"/>
+    <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TransformationDictionary>
+    <DerivedField name="abs_a" optype="continuous" dataType="double">
+      <Apply function="abs"><FieldRef field="a"/></Apply>
+    </DerivedField>
+  </TransformationDictionary>
+  <TreeModel functionName="regression" missingValueStrategy="defaultChild"
+             splitCharacteristic="binarySplit">
+    <MiningSchema>
+      <MiningField name="y" usageType="target"/>
+      <MiningField name="a"/>
+    </MiningSchema>
+    <Node id="0" defaultChild="1"><True/>
+      <Node id="1" score="1.0">
+        <SimplePredicate field="abs_a" operator="lessThan" value="1.0"/>
+      </Node>
+      <Node id="2" score="-1.0">
+        <SimplePredicate field="abs_a" operator="greaterOrEqual" value="1.0"/>
+      </Node>
+    </Node>
+  </TreeModel>
+</PMML>"""
+
+
+def _oracle_values(doc, records):
+    out = []
+    for r in records:
+        res = evaluate(doc, r)
+        out.append(np.nan if res.value is None else res.value)
+    return np.asarray(out, np.float32)
+
+
+class TestDerivedFields:
+    def test_regression_with_chained_derivations(self):
+        doc = parse_pmml(_XML)
+        cm = compile_pmml(doc)
+        assert cm.active_fields == ("a", "b")  # raw user contract
+        rng = np.random.default_rng(0)
+        records = [
+            {"a": float(a), "b": float(b)}
+            for a, b in rng.normal(0, 2, size=(64, 2))
+        ]
+        got = np.asarray(
+            [p.score.value for p in cm.score_records(records)], np.float32
+        )
+        exp = _oracle_values(doc, records)
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+    def test_tree_predicate_on_derived_field(self):
+        doc = parse_pmml(_TREE_XML)
+        cm = compile_pmml(doc)
+        records = [{"a": -2.0}, {"a": -0.5}, {"a": 0.5}, {"a": 2.0}, {}]
+        got = [p.score.value if not p.is_empty else None
+               for p in cm.score_records(records)]
+        exp = []
+        for r in records:
+            res = evaluate(doc, r)
+            exp.append(res.value)
+        assert got == exp
+
+    def test_missing_input_propagates_through_derivation(self):
+        doc = parse_pmml(_XML)
+        cm = compile_pmml(doc)
+        # 'a' missing -> a_norm missing -> ab_sum missing -> empty score
+        preds = cm.score_records([{"b": 1.0}])
+        res = evaluate(doc, {"b": 1.0})
+        assert preds[0].is_empty == (res.value is None)
