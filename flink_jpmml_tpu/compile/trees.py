@@ -75,6 +75,13 @@ class _CanonSplit:
     left: "_CanonNode"
     right: "_CanonNode"
     set_values: Tuple[float, ...] = ()  # member codes (set splits only)
+    # True → a missing value halts traversal and the tree returns the last
+    # *scored* node on the path (lastPrediction / returnLastPrediction)
+    halt: bool = False
+    # this node's own payload (interior nodes may carry scores — they are
+    # the candidates the halt path returns)
+    node_score: Optional[str] = None
+    node_dist: Tuple[ir.ScoreDistribution, ...] = ()
 
 
 _CanonNode = object  # _CanonSplit | _CanonLeaf
@@ -114,13 +121,8 @@ def _canonicalize(
     col, op, value, set_values = split
     right_is_catch_all = isinstance(p2, ir.TruePredicate)
 
-    if model.no_true_child_strategy == "returnLastPrediction":
-        raise ModelCompilationException(
-            "noTrueChildStrategy 'returnLastPrediction' has no vectorized "
-            "lowering (interior-node scores; oracle only)"
-        )
-
     strategy = model.missing_value_strategy
+    halt = False
     if strategy == "defaultChild":
         if node.default_child is not None:
             default_left = node.default_child == c1.node_id
@@ -133,15 +135,27 @@ def _canonicalize(
         else:
             # no defaultChild attribute: a missing value nulls the prediction
             default_left, missing_null = True, True
+    elif strategy == "lastPrediction":
+        # missing → return the last scored node on the path (oracle
+        # interp._eval_tree lastPrediction branch)
+        default_left, missing_null, halt = True, False, True
     elif strategy == "none" and right_is_catch_all:
         # UNKNOWN left predicate → scan continues → the <True/> child matches
         default_left, missing_null = False, False
     elif strategy in ("none", "nullPrediction"):
-        default_left, missing_null = True, True
+        # both children UNKNOWN → no child matches → noTrueChildStrategy
+        # decides: returnNullPrediction nulls, returnLastPrediction halts
+        if (
+            strategy == "none"
+            and model.no_true_child_strategy == "returnLastPrediction"
+        ):
+            default_left, missing_null, halt = True, False, True
+        else:
+            default_left, missing_null = True, True
     else:
         raise ModelCompilationException(
             f"missingValueStrategy {strategy!r} has no vectorized lowering "
-            "(supported: defaultChild, none, nullPrediction)"
+            "(supported: defaultChild, lastPrediction, none, nullPrediction)"
         )
 
     return _CanonSplit(
@@ -153,6 +167,9 @@ def _canonicalize(
         left=_canonicalize(c1, model, ctx),
         right=_canonicalize(c2, model, ctx),
         set_values=set_values,
+        halt=halt,
+        node_score=node.score,
+        node_dist=node.score_distribution,
     )
 
 
@@ -288,6 +305,11 @@ def _flatten(node: _CanonNode, flat: _FlatTree, path: List[Tuple[int, int]]):
         flat.depth = max(flat.depth, len(path))
         return
     s: _CanonSplit = node
+    if s.halt:
+        raise ModelCompilationException(
+            "halting missing-value semantics (lastPrediction / "
+            "returnLastPrediction) require the iterative backend"
+        )
     idx = len(flat.cols)
     flat.cols.append(s.col)
     flat.ops.append(s.op)
@@ -345,6 +367,14 @@ def _canon_depth(canon: _CanonNode) -> int:
     if isinstance(canon, _CanonLeaf):
         return 0
     return 1 + max(_canon_depth(canon.left), _canon_depth(canon.right))
+
+
+def _canon_has_halt(canon: _CanonNode) -> bool:
+    if isinstance(canon, _CanonLeaf):
+        return False
+    return (
+        canon.halt or _canon_has_halt(canon.left) or _canon_has_halt(canon.right)
+    )
 
 
 def pack_ensemble(
@@ -607,6 +637,9 @@ def _node_flatten(canon: _CanonNode, rows: List[dict]) -> int:
         "sets": s.set_values,
         "left": left,
         "right": right,
+        "halt": s.halt,
+        "score": s.node_score,
+        "dist": s.node_dist,
     }
     return idx
 
@@ -632,6 +665,8 @@ def pack_nodes(
     thresh = np.zeros((T, N), np.float32)
     dleft = np.zeros((T, N), np.float32)
     mnull = np.zeros((T, N), np.float32)
+    halt = np.zeros((T, N), np.float32)
+    scored = np.zeros((T, N), np.float32)  # node carries a payload
     # padding rows are self-looping leaves; real rows are overwritten below
     left = np.broadcast_to(np.arange(N, dtype=np.int32), (T, N)).copy()
     right = left.copy()
@@ -644,7 +679,7 @@ def pack_nodes(
             (row["score"], row["dist"])
             for rows in per_tree_rows
             for row in rows
-            if row["leaf"]
+            if row["leaf"] or row["score"] is not None or row["dist"]
         )
         C = len(labels)
         probs = np.zeros((T, N, C), np.float32)
@@ -652,12 +687,20 @@ def pack_nodes(
     else:
         value = np.zeros((T, N), np.float32)
 
+    any_halt = False
     ops_seen = set()
     for ti, rows in enumerate(per_tree_rows):
         for ni, row in enumerate(rows):
             left[ti, ni] = row["left"]
             right[ti, ni] = row["right"]
             if row["leaf"]:
+                has_payload = True  # leaves must decode (raises below if not)
+            elif classification:
+                has_payload = row["score"] is not None or bool(row["dist"])
+            else:
+                has_payload = row["score"] is not None
+            if has_payload:
+                scored[ti, ni] = 1.0
                 where = f"{ni} in tree {ti}"
                 if classification:
                     lab_idx, prow = _leaf_class_row(
@@ -667,13 +710,16 @@ def pack_nodes(
                     probs[ti, ni] = prow
                 else:
                     value[ti, ni] = _leaf_value(row["score"], where)
-            else:
+            if not row["leaf"]:
                 is_leaf[ti, ni] = 0.0
                 col[ti, ni] = row["col"]
                 op[ti, ni] = row["op"]
                 thresh[ti, ni] = row["thresh"]
                 dleft[ti, ni] = float(row["dleft"])
                 mnull[ti, ni] = float(row["mnull"])
+                if row["halt"]:
+                    halt[ti, ni] = 1.0
+                    any_halt = True
                 ops_seen.add(row["op"])
                 if set_codes is not None and row["sets"]:
                     set_codes[ti, ni, : len(row["sets"])] = row["sets"]
@@ -688,6 +734,8 @@ def pack_nodes(
         "left": left,
         "right": right,
         "is_leaf": is_leaf,
+        "halt": halt,
+        "scored": scored,
     }
     if set_codes is not None:
         params["set_codes"] = set_codes
@@ -713,10 +761,17 @@ def make_iterative_eval(packed: PackedNodes):
     ``lax.fori_loop`` over tree depth; every step gathers the current
     node's attributes per (record, tree) and hops left/right. Leaves
     self-loop, so exactly ``depth`` iterations settle every lane.
+
+    Halting strategies (lastPrediction / noTrueChildStrategy
+    returnLastPrediction) latch a ``stopped`` mask and track the node index
+    of the last *scored* ancestor (``last``); a stopped lane's final index
+    is that ancestor (or null when no ancestor ever carried a score) —
+    mirroring the oracle's ``last_scored`` bookkeeping in interp._eval_tree.
     """
     T, N, depth = packed.n_trees, packed.n_nodes, packed.depth
     uniform_op = packed.uniform_op
     has_sets = packed.has_sets
+    any_halt = bool(packed.params["halt"].any())
 
     def fn(p: dict, X: jnp.ndarray, M: jnp.ndarray):
         B = X.shape[0]
@@ -729,11 +784,20 @@ def make_iterative_eval(packed: PackedNodes):
         leftf = p["left"].reshape(-1)
         rightf = p["right"].reshape(-1)
         leaff = p["is_leaf"].reshape(-1)
+        haltf = p["halt"].reshape(-1)
+        scoredf = p["scored"].reshape(-1)
         setf = p["set_codes"].reshape(T * N, -1) if has_sets else None
 
         def body(_, carry):
-            idx, null = carry
+            idx, null, stopped, last = carry
             g = offs + idx  # [B, T] flat node ids
+            # the current node's own payload counts as "last scored" for a
+            # halt at its split (oracle updates last_scored on arrival)
+            if any_halt:
+                live = ~stopped
+                last = jnp.where(
+                    live & (jnp.take(scoredf, g) > 0.5), idx, last
+                )
             cols = jnp.take(colf, g)
             x = jnp.take_along_axis(X, cols, axis=1)
             m = jnp.take_along_axis(M, cols, axis=1)
@@ -748,13 +812,24 @@ def make_iterative_eval(packed: PackedNodes):
             go = jnp.where(m, jnp.take(dleftf, g) > 0.5, cmp)
             leaf = jnp.take(leaff, g) > 0.5
             null = null | (m & (jnp.take(mnullf, g) > 0.5) & ~leaf)
+            if any_halt:
+                stop_now = m & (jnp.take(haltf, g) > 0.5) & ~leaf & ~stopped
+                stopped = stopped | stop_now
             nxt = jnp.where(go, jnp.take(leftf, g), jnp.take(rightf, g))
-            idx = jnp.where(leaf, idx, nxt)
-            return idx, null
+            settled = leaf | stopped if any_halt else leaf
+            idx = jnp.where(settled, idx, nxt)
+            return idx, null, stopped, last
 
         idx0 = jnp.zeros((B, T), jnp.int32)
         null0 = jnp.zeros((B, T), bool)
-        idx, null = jax.lax.fori_loop(0, depth, body, (idx0, null0))
+        stopped0 = jnp.zeros((B, T), bool)
+        last0 = jnp.full((B, T), -1, jnp.int32)
+        idx, null, stopped, last = jax.lax.fori_loop(
+            0, depth, body, (idx0, null0, stopped0, last0)
+        )
+        if any_halt:
+            null = null | (stopped & (last < 0))
+            idx = jnp.where(stopped & (last >= 0), last, idx)
         return idx, null
 
     return fn
@@ -770,7 +845,9 @@ def _tree_eval_fns(trees, ctx):
     plus (params, labels).
     """
     canons, classification, depth = _canonicalize_forest(trees, ctx)
-    dense = depth <= ctx.config.max_dense_depth
+    dense = depth <= ctx.config.max_dense_depth and not any(
+        _canon_has_halt(c) for c in canons
+    )
 
     if dense:
         packed = pack_ensemble(canons, classification)

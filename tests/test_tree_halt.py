@@ -1,0 +1,127 @@
+"""lastPrediction / returnLastPrediction halting strategies vs the oracle.
+
+The oracle (interp._eval_tree) returns the last *scored* node on the path
+when a missing value halts traversal; the iterative backend tracks that
+ancestor's node index per (record, tree) lane.
+"""
+
+import numpy as np
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+
+def _tree_doc(strategy, ntc=None, interior_scores=True):
+    s0 = ' score="0.5"' if interior_scores else ""
+    s1 = ' score="0.7"' if interior_scores else ""
+    ntc_attr = f' noTrueChildStrategy="{ntc}"' if ntc else ""
+    return parse_pmml(f"""<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+      <Header/>
+      <DataDictionary numberOfFields="3">
+        <DataField name="a" optype="continuous" dataType="double"/>
+        <DataField name="b" optype="continuous" dataType="double"/>
+        <DataField name="y" optype="continuous" dataType="double"/>
+      </DataDictionary>
+      <TreeModel functionName="regression" missingValueStrategy="{strategy}"
+                 splitCharacteristic="binarySplit"{ntc_attr}>
+        <MiningSchema>
+          <MiningField name="y" usageType="target"/>
+          <MiningField name="a"/><MiningField name="b"/>
+        </MiningSchema>
+        <Node id="0"{s0}><True/>
+          <Node id="1"{s1}>
+            <SimplePredicate field="a" operator="lessThan" value="0"/>
+            <Node id="3" score="1.0">
+              <SimplePredicate field="b" operator="lessThan" value="0"/>
+            </Node>
+            <Node id="4" score="2.0">
+              <SimplePredicate field="b" operator="greaterOrEqual" value="0"/>
+            </Node>
+          </Node>
+          <Node id="2" score="3.0">
+            <SimplePredicate field="a" operator="greaterOrEqual" value="0"/>
+          </Node>
+        </Node>
+      </TreeModel></PMML>""")
+
+
+def _check(doc, records):
+    cm = compile_pmml(doc)
+    got = cm.score_records(records)
+    for rec, pred in zip(records, got):
+        exp = evaluate(doc, rec)
+        if exp.value is None:
+            assert pred.is_empty, f"{rec}: expected empty, got {pred}"
+        else:
+            assert not pred.is_empty, f"{rec}: expected {exp.value}, got empty"
+            assert abs(pred.score.value - exp.value) < 1e-6, (
+                f"{rec}: {pred.score.value} != {exp.value}"
+            )
+
+
+RECORDS = [
+    {"a": -1.0, "b": -1.0},   # leaf 3
+    {"a": -1.0, "b": 1.0},    # leaf 4
+    {"a": 1.0, "b": 0.0},     # leaf 2
+    {"a": -1.0},              # b missing at depth 2
+    {"b": 1.0},               # a missing at root
+    {},                       # everything missing
+]
+
+
+class TestLastPrediction:
+    def test_interior_scores_return_last_scored(self):
+        _check(_tree_doc("lastPrediction"), RECORDS)
+
+    def test_no_interior_scores_yield_empty_on_halt(self):
+        # halting with no scored ancestor -> EmptyScore (oracle: EvalResult())
+        _check(_tree_doc("lastPrediction", interior_scores=False), RECORDS)
+
+    def test_none_with_return_last_prediction(self):
+        _check(
+            _tree_doc("none", ntc="returnLastPrediction"), RECORDS
+        )
+
+    def test_none_with_null_prediction_ntc(self):
+        _check(_tree_doc("none", ntc="returnNullPrediction"), RECORDS)
+
+    def test_ensemble_of_halting_trees(self):
+        # sum of two lastPrediction trees inside a MiningModel
+        import xml.etree.ElementTree as ET
+
+        doc1 = _tree_doc("lastPrediction")
+        xml = f"""<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+          <Header/>
+          <DataDictionary numberOfFields="3">
+            <DataField name="a" optype="continuous" dataType="double"/>
+            <DataField name="b" optype="continuous" dataType="double"/>
+            <DataField name="y" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <MiningModel functionName="regression">
+            <MiningSchema>
+              <MiningField name="y" usageType="target"/>
+              <MiningField name="a"/><MiningField name="b"/>
+            </MiningSchema>
+            <Segmentation multipleModelMethod="sum">
+              <Segment><True/>
+                <TreeModel functionName="regression" missingValueStrategy="lastPrediction" splitCharacteristic="binarySplit">
+                  <MiningSchema><MiningField name="y" usageType="target"/><MiningField name="a"/><MiningField name="b"/></MiningSchema>
+                  <Node id="0" score="0.25"><True/>
+                    <Node id="1" score="1.5"><SimplePredicate field="a" operator="lessThan" value="0"/></Node>
+                    <Node id="2" score="-2.0"><SimplePredicate field="a" operator="greaterOrEqual" value="0"/></Node>
+                  </Node>
+                </TreeModel>
+              </Segment>
+              <Segment><True/>
+                <TreeModel functionName="regression" missingValueStrategy="lastPrediction" splitCharacteristic="binarySplit">
+                  <MiningSchema><MiningField name="y" usageType="target"/><MiningField name="a"/><MiningField name="b"/></MiningSchema>
+                  <Node id="0" score="0.75"><True/>
+                    <Node id="1" score="4.0"><SimplePredicate field="b" operator="lessThan" value="1"/></Node>
+                    <Node id="2" score="8.0"><SimplePredicate field="b" operator="greaterOrEqual" value="1"/></Node>
+                  </Node>
+                </TreeModel>
+              </Segment>
+            </Segmentation>
+          </MiningModel></PMML>"""
+        _check(parse_pmml(xml), RECORDS)
