@@ -36,6 +36,7 @@ from flink_jpmml_tpu.runtime.sinks import Sink
 from flink_jpmml_tpu.runtime.sources import Source
 from flink_jpmml_tpu.utils.config import RuntimeConfig
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+from flink_jpmml_tpu.utils.profiling import StageTimer
 
 
 @dataclass
@@ -252,10 +253,14 @@ class Pipeline:
         lat = self.metrics.reservoir("record_latency_s")
         in_flight: List[Tuple[Any, List[_Stamped]]] = []
 
+        stages = StageTimer(self.metrics)
+
         def _finish_one():
             ticket, stamped = in_flight.pop(0)
-            outputs = self._scorer.finish(ticket)
-            self._sink.emit(outputs)
+            with stages.stage("readback"):
+                outputs = self._scorer.finish(ticket)
+            with stages.stage("sink"):
+                self._sink.emit(outputs)
             now = time.monotonic()
             # sample a handful of lanes, not all (host-side cost control)
             for s in stamped[:: max(1, len(stamped) // 8)]:
@@ -274,7 +279,10 @@ class Pipeline:
                     break
                 if not stamped:
                     continue
-                ticket = self._scorer.submit([s.record for s in stamped])
+                with stages.stage("featurize_dispatch"):
+                    ticket = self._scorer.submit(
+                        [s.record for s in stamped]
+                    )
                 in_flight.append((ticket, stamped))
                 batches.inc()
                 fill.inc(len(stamped))

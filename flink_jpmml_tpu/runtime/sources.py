@@ -159,3 +159,35 @@ class ControlSource(Source):
 
     def seek(self, offset: int) -> None:
         self._offset = offset
+
+
+class FaultInjectionSource(Source):
+    """Wraps a source and raises after N polled records (SURVEY.md §6 row
+    "failure detection / fault injection": the reference relies on Flink's
+    restart strategies; here recovery = a fresh pipeline restoring the
+    checkpointed source offset, and this wrapper is how tests kill the
+    first attempt mid-stream deterministically)."""
+
+    def __init__(self, inner: Source, fail_after: int,
+                 exc: type = RuntimeError):
+        self._inner = inner
+        self._fail_after = fail_after
+        self._exc = exc
+        self._polled = 0
+        self.armed = True
+
+    def poll(self, max_n: int):
+        if self.armed and self._polled >= self._fail_after:
+            raise self._exc(
+                f"injected fault after {self._polled} records"
+            )
+        out = self._inner.poll(max_n)
+        self._polled += len(out)
+        return out
+
+    def seek(self, offset: int) -> None:
+        self._inner.seek(offset)
+
+    @property
+    def exhausted(self) -> bool:
+        return self._inner.exhausted
