@@ -87,6 +87,14 @@ class _CanonSplit:
 _CanonNode = object  # _CanonSplit | _CanonLeaf
 
 
+class NonCanonicalTreeError(ModelCompilationException):
+    """The forest's *shape* doesn't fit the canonical binary-split form
+    (compound predicates, n-ary nodes, non-complementary children,
+    non-True roots). Routed to the general scan backend (gtrees.py);
+    genuine model errors stay plain ModelCompilationExceptions and
+    propagate loudly instead of silently degrading to the slow path."""
+
+
 def _canonicalize(
     node: ir.TreeNode, model: ir.TreeModelIR, ctx: LowerCtx
 ) -> _CanonNode:
@@ -100,7 +108,7 @@ def _canonicalize(
     if node.is_leaf:
         return _CanonLeaf(score=node.score, distribution=node.score_distribution)
     if len(node.children) != 2:
-        raise ModelCompilationException(
+        raise NonCanonicalTreeError(
             f"non-binary tree node (id={node.node_id!r}, "
             f"{len(node.children)} children) — only binary-split trees lower "
             "to the dense path"
@@ -113,7 +121,7 @@ def _canonicalize(
         # degenerate: first child is catch-all → it always wins (first-match)
         if isinstance(p1, ir.TruePredicate):
             return _canonicalize(c1, model, ctx)
-        raise ModelCompilationException(
+        raise NonCanonicalTreeError(
             f"tree node {node.node_id!r} children predicates "
             f"({type(p1).__name__}, {type(p2).__name__}) are not a canonical "
             "binary split"
@@ -354,7 +362,7 @@ def _canonicalize_forest(
                 "mixed regression/classification trees in one ensemble"
             )
         if not isinstance(t.root.predicate, ir.TruePredicate):
-            raise ModelCompilationException(
+            raise NonCanonicalTreeError(
                 "tree root predicate must be <True/> for the fused lowering"
             )
         canon = _canonicalize(t.root, t, ctx)
@@ -686,6 +694,9 @@ def pack_nodes(
         label = np.zeros((T, N), np.float32)
     else:
         value = np.zeros((T, N), np.float32)
+        # dist-only regression interiors count as "scored" for halt
+        # tracking (oracle last_scored) but their value is null
+        valnull = np.zeros((T, N), np.float32)
 
     any_halt = False
     ops_seen = set()
@@ -693,12 +704,11 @@ def pack_nodes(
         for ni, row in enumerate(rows):
             left[ti, ni] = row["left"]
             right[ti, ni] = row["right"]
-            if row["leaf"]:
-                has_payload = True  # leaves must decode (raises below if not)
-            elif classification:
-                has_payload = row["score"] is not None or bool(row["dist"])
-            else:
-                has_payload = row["score"] is not None
+            has_payload = (
+                row["leaf"]
+                or row["score"] is not None
+                or bool(row["dist"])
+            )
             if has_payload:
                 scored[ti, ni] = 1.0
                 where = f"{ni} in tree {ti}"
@@ -708,6 +718,8 @@ def pack_nodes(
                     )
                     label[ti, ni] = lab_idx
                     probs[ti, ni] = prow
+                elif row["score"] is None and not row["leaf"]:
+                    valnull[ti, ni] = 1.0  # dist-only interior node
                 else:
                     value[ti, ni] = _leaf_value(row["score"], where)
             if not row["leaf"]:
@@ -744,6 +756,7 @@ def pack_nodes(
         params["label"] = label
     else:
         params["value"] = value
+        params["valnull"] = valnull
     return PackedNodes(
         n_trees=T,
         n_nodes=N,
@@ -830,6 +843,10 @@ def make_iterative_eval(packed: PackedNodes):
         if any_halt:
             null = null | (stopped & (last < 0))
             idx = jnp.where(stopped & (last >= 0), last, idx)
+            if "valnull" in p:
+                null = null | (
+                    jnp.take(p["valnull"].reshape(-1), offs + idx) > 0.5
+                )
         return idx, null
 
     return fn
@@ -844,7 +861,15 @@ def _tree_eval_fns(trees, ctx):
                                         null bool[B,T])
     plus (params, labels).
     """
-    canons, classification, depth = _canonicalize_forest(trees, ctx)
+    try:
+        canons, classification, depth = _canonicalize_forest(trees, ctx)
+    except NonCanonicalTreeError:
+        # non-canonical forest (compound predicates, n-ary nodes, non-
+        # complementary children, non-True roots, isMissing operators…):
+        # the general first-match-scan backend handles it faithfully
+        from flink_jpmml_tpu.compile.gtrees import general_tree_eval_fns
+
+        return general_tree_eval_fns(trees, ctx)
     dense = depth <= ctx.config.max_dense_depth and not any(
         _canon_has_halt(c) for c in canons
     )
@@ -874,22 +899,29 @@ def _tree_eval_fns(trees, ctx):
 
     packed = pack_nodes(canons, classification, depth)
     ev = make_iterative_eval(packed)
-    T, N = packed.n_trees, packed.n_nodes
+    fn = node_payload_fns(ev, packed.n_trees, packed.n_nodes, classification)
+    return fn, packed.params, packed.labels
+
+
+def node_payload_fns(ev, T: int, N: int, classification: bool):
+    """Final payload gather shared by every node-table backend (the
+    canonical iterative hop and the general scan in gtrees.py): map the
+    per-lane final node index to its value / (probs, label)."""
     if not classification:
-        def ivals(p, X, M):
+        def vals(p, X, M):
             idx, null = ev(p, X, M)
             g = jnp.arange(T, dtype=jnp.int32)[None, :] * N + idx
             return jnp.take(p["value"].reshape(-1), g), null
-        return ivals, packed.params, ()
+        return vals
 
-    def icls(p, X, M):
+    def cls(p, X, M):
         idx, null = ev(p, X, M)
         g = jnp.arange(T, dtype=jnp.int32)[None, :] * N + idx
         C = p["probs"].shape[-1]
         probs = jnp.take(p["probs"].reshape(T * N, C), g, axis=0)
         lab = jnp.round(jnp.take(p["label"].reshape(-1), g)).astype(jnp.int32)
         return probs, lab, null
-    return icls, packed.params, packed.labels
+    return cls
 
 
 def lower_tree_ensemble(

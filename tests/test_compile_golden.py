@@ -260,8 +260,9 @@ class TestTreeGolden:
             cm, doc, [{"a": -1.0}, {"a": 0.2}, {"a": 1.0}], check_label=False
         )
 
-    def test_deep_or_set_trees_rejected_clearly(self):
-        # non-binary node → clear compile error, not silent misevaluation
+    def test_non_binary_tree_takes_general_backend(self):
+        # non-binary nodes route to the general first-match scan backend
+        # (gtrees.py) instead of erroring — diffed against the oracle
         xml = (
             '<PMML version="4.3"><DataDictionary>'
             '<DataField name="a" optype="continuous" dataType="double"/>'
@@ -276,8 +277,12 @@ class TestTreeGolden:
             '<Node id="3" score="3"><True/></Node>'
             "</Node></TreeModel></PMML>"
         )
-        with pytest.raises(ModelCompilationException, match="non-binary"):
-            compile_pmml(parse_pmml(xml))
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        for a, want in ((-0.5, 1.0), (0.5, 2.0), (1.5, 3.0)):
+            [pred] = cm.score_records([{"a": a}])
+            assert pred.score.value == want
+            assert evaluate(doc, {"a": a}).value == want
 
 
 class TestNeuralGolden:

@@ -1,0 +1,294 @@
+"""General tree backend (gtrees.py): compound predicates, n-ary nodes,
+surrogates, isMissing, non-True roots — all diffed against the oracle.
+
+These are the tree shapes the canonical path-matrix backends reject; the
+reference scores them through JPMML-Evaluator's general traversal, so
+parity here closes the "real-world R/rpart export" gap.
+"""
+
+import itertools
+
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+_HDR = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <DataDictionary numberOfFields="4">
+    <DataField name="a" optype="continuous" dataType="double"/>
+    <DataField name="b" optype="continuous" dataType="double"/>
+    <DataField name="c" optype="continuous" dataType="double"/>
+    <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>"""
+
+_SCHEMA = """<MiningSchema>
+      <MiningField name="y" usageType="target"/>
+      <MiningField name="a"/><MiningField name="b"/><MiningField name="c"/>
+    </MiningSchema>"""
+
+
+def _doc(tree_body, strategy="none", ntc=None):
+    ntc_attr = f' noTrueChildStrategy="{ntc}"' if ntc else ""
+    return parse_pmml(f"""{_HDR}
+  <TreeModel functionName="regression" missingValueStrategy="{strategy}"{ntc_attr}>
+    {_SCHEMA}
+    {tree_body}
+  </TreeModel></PMML>""")
+
+
+def _grid(missing_too=True):
+    vals = [-1.5, -0.25, 0.0, 0.25, 1.5] + ([None] if missing_too else [])
+    recs = []
+    for a, b, c in itertools.product(vals, vals, vals):
+        r = {}
+        if a is not None:
+            r["a"] = a
+        if b is not None:
+            r["b"] = b
+        if c is not None:
+            r["c"] = c
+        recs.append(r)
+    return recs
+
+
+def _check(doc, records):
+    cm = compile_pmml(doc)
+    got = cm.score_records(records)
+    for rec, pred in zip(records, got):
+        exp = evaluate(doc, rec)
+        if exp.value is None:
+            assert pred.is_empty, f"{rec}: expected empty, got {pred}"
+        else:
+            assert not pred.is_empty, f"{rec}: expected {exp.value}, got empty"
+            assert abs(pred.score.value - exp.value) < 1e-6, (
+                f"{rec}: {pred.score.value} != {exp.value}"
+            )
+
+
+class TestCompoundPredicates:
+    def test_and_or_children(self):
+        body = """<Node id="0"><True/>
+          <Node id="1" score="1.0">
+            <CompoundPredicate booleanOperator="and">
+              <SimplePredicate field="a" operator="lessThan" value="0"/>
+              <SimplePredicate field="b" operator="greaterOrEqual" value="0"/>
+            </CompoundPredicate>
+          </Node>
+          <Node id="2" score="2.0">
+            <CompoundPredicate booleanOperator="or">
+              <SimplePredicate field="a" operator="greaterOrEqual" value="1"/>
+              <SimplePredicate field="c" operator="lessThan" value="0"/>
+            </CompoundPredicate>
+          </Node>
+          <Node id="3" score="3.0"><True/></Node>
+        </Node>"""
+        _check(_doc(body), _grid())
+
+    def test_xor(self):
+        body = """<Node id="0"><True/>
+          <Node id="1" score="1.0">
+            <CompoundPredicate booleanOperator="xor">
+              <SimplePredicate field="a" operator="lessThan" value="0"/>
+              <SimplePredicate field="b" operator="lessThan" value="0"/>
+            </CompoundPredicate>
+          </Node>
+          <Node id="2" score="2.0"><True/></Node>
+        </Node>"""
+        _check(_doc(body), _grid())
+
+    def test_surrogate_split(self):
+        # rpart-style: primary on a, surrogate on b, final fallback constant
+        body = """<Node id="0"><True/>
+          <Node id="1" score="1.0">
+            <CompoundPredicate booleanOperator="surrogate">
+              <SimplePredicate field="a" operator="lessThan" value="0"/>
+              <SimplePredicate field="b" operator="lessThan" value="0.25"/>
+            </CompoundPredicate>
+          </Node>
+          <Node id="2" score="2.0"><True/></Node>
+        </Node>"""
+        _check(_doc(body), _grid())
+
+    def test_surrogate_all_unknown_uses_strategy(self):
+        body = """<Node id="0" score="9.0"><True/>
+          <Node id="1" score="1.0">
+            <CompoundPredicate booleanOperator="surrogate">
+              <SimplePredicate field="a" operator="lessThan" value="0"/>
+              <SimplePredicate field="b" operator="lessThan" value="0"/>
+            </CompoundPredicate>
+          </Node>
+          <Node id="2" score="2.0"><True/></Node>
+        </Node>"""
+        for strategy in ("none", "nullPrediction", "lastPrediction"):
+            _check(_doc(body, strategy=strategy), _grid())
+
+
+class TestGeneralShapes:
+    def test_three_way_split(self):
+        body = """<Node id="0"><True/>
+          <Node id="1" score="1.0">
+            <SimplePredicate field="a" operator="lessThan" value="-0.5"/>
+          </Node>
+          <Node id="2" score="2.0">
+            <SimplePredicate field="a" operator="lessThan" value="0.5"/>
+          </Node>
+          <Node id="3" score="3.0"><True/></Node>
+        </Node>"""
+        _check(_doc(body), _grid())
+
+    def test_is_missing_routing(self):
+        body = """<Node id="0"><True/>
+          <Node id="1" score="1.0">
+            <SimplePredicate field="a" operator="isMissing"/>
+          </Node>
+          <Node id="2" score="2.0">
+            <SimplePredicate field="a" operator="lessThan" value="0"/>
+          </Node>
+          <Node id="3" score="3.0"><True/></Node>
+        </Node>"""
+        _check(_doc(body), _grid())
+
+    def test_non_true_root_predicate(self):
+        body = """<Node id="0">
+          <SimplePredicate field="c" operator="greaterOrEqual" value="0"/>
+          <Node id="1" score="1.0">
+            <SimplePredicate field="a" operator="lessThan" value="0"/>
+          </Node>
+          <Node id="2" score="2.0"><True/></Node>
+        </Node>"""
+        _check(_doc(body), _grid())
+
+    def test_default_child_with_compound(self):
+        body = """<Node id="0" defaultChild="n2"><True/>
+          <Node id="n1" score="1.0">
+            <CompoundPredicate booleanOperator="and">
+              <SimplePredicate field="a" operator="lessThan" value="0"/>
+              <SimplePredicate field="b" operator="lessThan" value="0"/>
+            </CompoundPredicate>
+          </Node>
+          <Node id="n2" score="2.0"><True/></Node>
+        </Node>"""
+        _check(_doc(body, strategy="defaultChild"), _grid())
+
+    def test_deeper_mixed_tree(self):
+        body = """<Node id="0"><True/>
+          <Node id="1">
+            <SimplePredicate field="a" operator="lessThan" value="0"/>
+            <Node id="3" score="1.0">
+              <CompoundPredicate booleanOperator="or">
+                <SimplePredicate field="b" operator="lessThan" value="0"/>
+                <SimplePredicate field="c" operator="greaterThan" value="1"/>
+              </CompoundPredicate>
+            </Node>
+            <Node id="4" score="2.0"><True/></Node>
+          </Node>
+          <Node id="2">
+            <True/>
+            <Node id="5" score="3.0">
+              <SimplePredicate field="b" operator="isNotMissing"/>
+            </Node>
+            <Node id="6" score="4.0"><True/></Node>
+          </Node>
+        </Node>"""
+        for strategy in ("none", "nullPrediction"):
+            _check(_doc(body, strategy=strategy), _grid())
+
+    def test_nested_compound_rejected(self):
+        body = """<Node id="0"><True/>
+          <Node id="1" score="1.0">
+            <CompoundPredicate booleanOperator="and">
+              <CompoundPredicate booleanOperator="or">
+                <SimplePredicate field="a" operator="lessThan" value="0"/>
+                <SimplePredicate field="b" operator="lessThan" value="0"/>
+              </CompoundPredicate>
+              <SimplePredicate field="c" operator="lessThan" value="0"/>
+            </CompoundPredicate>
+          </Node>
+          <Node id="2" score="2.0"><True/></Node>
+        </Node>"""
+        from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+        with pytest.raises(ModelCompilationException, match="nested"):
+            compile_pmml(_doc(body))
+
+
+class TestGeneralClassification:
+    def test_classification_compound(self):
+        xml = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+          <Header/>
+          <DataDictionary numberOfFields="3">
+            <DataField name="a" optype="continuous" dataType="double"/>
+            <DataField name="b" optype="continuous" dataType="double"/>
+            <DataField name="y" optype="categorical" dataType="string">
+              <Value value="p"/><Value value="q"/><Value value="r"/>
+            </DataField>
+          </DataDictionary>
+          <TreeModel functionName="classification" missingValueStrategy="none">
+            <MiningSchema>
+              <MiningField name="y" usageType="target"/>
+              <MiningField name="a"/><MiningField name="b"/>
+            </MiningSchema>
+            <Node id="0"><True/>
+              <Node id="1" score="p">
+                <CompoundPredicate booleanOperator="and">
+                  <SimplePredicate field="a" operator="lessThan" value="0"/>
+                  <SimplePredicate field="b" operator="lessThan" value="0"/>
+                </CompoundPredicate>
+              </Node>
+              <Node id="2" score="q">
+                <SimplePredicate field="a" operator="lessThan" value="0"/>
+              </Node>
+              <Node id="3" score="r"><True/></Node>
+            </Node>
+          </TreeModel></PMML>"""
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        recs = [
+            {"a": -1.0, "b": -1.0}, {"a": -1.0, "b": 1.0},
+            {"a": 1.0, "b": -1.0}, {"a": 1.0}, {"b": 0.0}, {},
+        ]
+        got = cm.score_records(recs)
+        for rec, pred in zip(recs, got):
+            exp = evaluate(doc, rec)
+            if exp.label is None:
+                assert pred.is_empty, f"{rec}: expected empty, got {pred}"
+            else:
+                assert pred.target.label == exp.label, (
+                    f"{rec}: {pred.target.label} != {exp.label}"
+                )
+
+
+class TestPaddedChildSlots:
+    def test_no_match_node_with_fewer_children_than_max(self):
+        """Review regression: a 2-child node in a tree whose max fan-out is
+        3 gets a padded child slot; that slot must evaluate FALSE so the
+        no-true-child path still fires (empty result), not a bogus hit."""
+        body = """<Node id="0"><True/>
+          <Node id="t3">
+            <SimplePredicate field="a" operator="lessThan" value="0"/>
+            <Node id="x1" score="1.0">
+              <SimplePredicate field="b" operator="lessThan" value="-0.5"/>
+            </Node>
+            <Node id="x2" score="2.0">
+              <SimplePredicate field="b" operator="lessThan" value="0.5"/>
+            </Node>
+            <Node id="x3" score="3.0"><True/></Node>
+          </Node>
+          <Node id="t2">
+            <True/>
+            <Node id="y1" score="4.0">
+              <SimplePredicate field="b" operator="lessThan" value="0"/>
+            </Node>
+            <Node id="y2" score="5.0">
+              <SimplePredicate field="b" operator="greaterOrEqual" value="1"/>
+            </Node>
+          </Node>
+        </Node>"""
+        # record a>=0, 0 <= b < 1: reaches node t2, neither child matches →
+        # noTrueChildStrategy (returnNullPrediction default) → empty
+        doc = _doc(body)
+        _check(doc, _grid())
+        [pred] = compile_pmml(doc).score_records([{"a": 1.0, "b": 0.5}])
+        assert pred.is_empty
