@@ -94,8 +94,11 @@ class StaticScorer(Scorer):
         self._extract = extract or self._extract_records
         self._emit = emit or (lambda recs, preds: list(preds))
         # rank-wire fast path (qtrees.py): ships uint8 threshold ranks
-        # instead of f32+mask when the model is an eligible tree ensemble
-        self._q = model.quantized_scorer() if use_quantized else None
+        # instead of f32+mask when the model is an eligible tree ensemble.
+        # ShardedModel (parallel/sharding.py) has no quantized path; it
+        # scores through the same f32 predict contract.
+        probe = getattr(model, "quantized_scorer", None)
+        self._q = probe() if (use_quantized and probe is not None) else None
 
     def _extract_records(self, records: Sequence[Any]):
         first = records[0]

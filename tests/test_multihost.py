@@ -1,0 +1,82 @@
+"""Real 2-process jax.distributed smoke test (SURVEY.md §3 row D1).
+
+The in-process tests exercise sharding on a virtual 8-device mesh; this one
+spawns two actual OS processes that join one process group over a local
+coordinator, contribute process-local batch slices via
+``jax.make_array_from_process_local_data``, and run a psum-backed global
+computation — the CPU stand-in for the multi-host ICI/DCN path the
+reference delegates to Flink's Akka/Netty runtime.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from flink_jpmml_tpu.parallel.distributed import (
+        global_batch, init_distributed,
+    )
+    from flink_jpmml_tpu.parallel.mesh import make_mesh
+    from flink_jpmml_tpu.utils.config import MeshConfig
+
+    pid = int(sys.argv[1])
+    ok = init_distributed(
+        coordinator_address=sys.argv[2], num_processes=2, process_id=pid
+    )
+    assert ok, "init_distributed returned False in a 2-process job"
+    assert jax.process_count() == 2
+    mesh = make_mesh(MeshConfig(data=jax.device_count(), model=1))
+
+    # each process contributes 4 rows; global batch is 8 rows
+    X_local = np.full((4, 3), float(pid + 1), np.float32)
+    M_local = np.zeros((4, 3), bool)
+    Xg, Mg = global_batch(mesh, X_local, M_local)
+    assert Xg.shape == (8, 3)
+
+    total = float(jax.jit(lambda x: x.sum())(Xg))
+    # 4*3 ones + 4*3 twos = 36, same answer on every process
+    assert total == 36.0, total
+    print(f"proc {{pid}} OK total={{total}}")
+    """
+)
+
+
+def test_two_process_group_global_batch(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo))
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # one device per process, no virtual mesh
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), coord],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=110)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"proc {i} OK" in out
