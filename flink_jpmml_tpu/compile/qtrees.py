@@ -162,8 +162,14 @@ class QuantizedScorer:
     n_trees: int
     _jit_fn: object
     backend: str = "xla"  # "xla" | "pallas"
+    labels: Tuple[str, ...] = ()  # classification class list; () = regression
 
-    def predict_wire(self, Xq) -> jnp.ndarray:
+    @property
+    def is_classification(self) -> bool:
+        return bool(self.labels)
+
+    def predict_wire(self, Xq):
+        """→ f32 values [B] (regression) or (values, probs, label_idx)."""
         return self._jit_fn(self.params, Xq)
 
     def score(self, X, M=None) -> List[Prediction]:
@@ -175,8 +181,20 @@ class QuantizedScorer:
                 Xq = np.concatenate(
                     [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)], axis=0
                 )
-        values = np.asarray(self.predict_wire(Xq), np.float32)[:n]
-        return decode_batch(values.tolist(), [True] * n, None, None)
+        out = self.predict_wire(Xq)
+        return self.decode(out, n)
+
+    def decode(self, out, n: int) -> List[Prediction]:
+        if not self.is_classification:
+            values = np.asarray(out, np.float32)[:n]
+            return decode_batch(values.tolist(), [True] * n, None, None)
+        value, probs, lab = out
+        value = np.asarray(value, np.float32)[:n]
+        P = np.asarray(probs, np.float32)[:n]
+        idx = np.asarray(lab)[:n]
+        lbls = [self.labels[i] for i in idx]
+        pmaps = [dict(zip(self.labels, row.tolist())) for row in P]
+        return decode_batch(value.tolist(), [True] * n, lbls, pmaps)
 
 
 def _split_bf16(v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -189,22 +207,22 @@ def _split_bf16(v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 def _match_ensemble(
     doc: ir.PmmlDocument,
 ) -> Optional[Tuple[List[ir.TreeModelIR], List[float], str]]:
-    """doc → (trees, weights, method) when the model is a regression tree
-    ensemble the fast path can take; None otherwise."""
+    """doc → (trees, weights, method) when the model is a tree ensemble the
+    fast path can take (regression aggregates, or classification single /
+    majority votes); None otherwise."""
     model = doc.model
     if isinstance(model, ir.TreeModelIR):
-        if model.function_name != "regression":
-            return None
         return [model], [1.0], "single"
     if not isinstance(model, ir.MiningModelIR):
-        return None
-    if model.function_name != "regression":
         return None
     seg = model.segmentation
     if seg is None:
         return None
     method = seg.multiple_model_method
-    if method not in _REGRESSION_METHODS:
+    if model.function_name == "regression":
+        if method not in _REGRESSION_METHODS:
+            return None
+    elif method not in ("majorityVote", "weightedMajorityVote"):
         return None
     trees: List[ir.TreeModelIR] = []
     weights: List[float] = []
@@ -213,7 +231,7 @@ def _match_ensemble(
             return None
         if not isinstance(s.model, ir.TreeModelIR):
             return None
-        if s.model.function_name != "regression":
+        if s.model.function_name != model.function_name:
             return None
         trees.append(s.model)
         weights.append(s.weight)
@@ -262,7 +280,11 @@ def build_quantized_scorer(
         return None
     # int8 path sums are bounded by ±depth — beyond 127 the int8 acc/count
     # would wrap and mis-select leaves, so such trees stay on the f32 path
-    if classification or depth > min(config.max_dense_depth, 127):
+    if depth > min(config.max_dense_depth, 127):
+        return None
+    if classification and method not in (
+        "single", "majorityVote", "weightedMajorityVote"
+    ):
         return None
     packed = pack_ensemble(canons, classification)
     p = packed.params
@@ -321,16 +343,40 @@ def build_quantized_scorer(
     # fold per-tree aggregate coefficients into leaf values where the
     # aggregate is linear, so one fused einsum produces the final value
     w = np.asarray(weights, np.float32)
-    vals = p["leaf_values"].astype(np.float32)  # [T, L]
-    if method in ("single", "sum"):
-        fused_linear, coef = True, np.ones((T,), np.float32)
-    elif method == "average":
-        fused_linear, coef = True, np.full((T,), 1.0 / T, np.float32)
-    elif method == "weightedAverage":
-        fused_linear, coef = True, (w / w.sum()).astype(np.float32)
-    else:  # max / median need the per-tree plane
-        fused_linear, coef = False, np.ones((T,), np.float32)
-    vhi, vlo = _split_bf16(vals * coef[:, None])
+    fused_linear = False
+    if not classification:
+        vals = p["leaf_values"].astype(np.float32)  # [T, L]
+        if method in ("single", "sum"):
+            fused_linear, coef = True, np.ones((T,), np.float32)
+        elif method == "average":
+            fused_linear, coef = True, np.full((T,), 1.0 / T, np.float32)
+        elif method == "weightedAverage":
+            fused_linear, coef = True, (w / w.sum()).astype(np.float32)
+        else:  # max / median need the per-tree plane
+            fused_linear, coef = False, np.ones((T,), np.float32)
+        vhi, vlo = _split_bf16(vals * coef[:, None])
+    else:
+        labels = packed.labels
+        C = len(labels)
+        leaf_label = np.round(p["leaf_label"]).astype(np.int64)  # [T, L]
+        if method == "single":
+            # per-leaf class distributions + the leaf's own label
+            probs_tbl = p["leaf_probs"].astype(np.float32)  # [T, L, C]
+        else:
+            # each tree votes its leaf's label one-hot, weighted
+            w_eff = (
+                w if method == "weightedMajorityVote"
+                else np.ones((T,), np.float32)
+            )
+            probs_tbl = np.zeros((T, L, C), np.float32)
+            tt, ll = np.meshgrid(
+                np.arange(T), np.arange(L), indexing="ij"
+            )
+            probs_tbl[tt, ll, leaf_label] = 1.0
+            probs_tbl *= w_eff[:, None, None]
+            probs_tbl /= w_eff.sum()
+        phi, plo = _split_bf16(probs_tbl)
+        lab_f = leaf_label.astype(np.float32)
 
     targets = doc.targets
     repl, has_repl = extract_missing_replacements(doc.model.mining_schema, ctx)
@@ -350,60 +396,56 @@ def build_quantized_scorer(
         "dleft": dleft,
         "P_i8": P.astype(np.int8),
         "count_i8": p["count"].astype(np.int8),
-        "vhi": vhi,
-        "vlo": vlo,
     }
-    if not fused_linear:
-        params["vals_f32"] = vals
+    if not classification:
+        params["vhi"] = vhi
+        params["vlo"] = vlo
+        if not fused_linear:
+            params["vals_f32"] = vals
+    else:
+        params["phi"] = phi
+        params["plo"] = plo
+        params["lab"] = lab_f
 
     on_cpu = jax.default_backend() == "cpu"
     sent = dtype(sentinel)
 
-    def qfn(pp, Xq):
+    def _hit(pp, Xq):
+        """[B,T,L] leaf one-hot (f32 on CPU — no int8/bf16 dot kernels
+        there — bf16 on TPU)."""
         xv = Xq[:, pp["feat"]]  # [B, T, S] rank codes
         miss = xv == sent
         go = jnp.where(miss, pp["dleft"], xv <= pp["qthr"])
         if on_cpu:
-            # CPU backend: no int8/bf16 dot kernels — compute in f32
             sign = jnp.where(go, 1.0, -1.0).astype(jnp.float32)
             acc = jnp.einsum(
                 "bts,tsl->btl", sign, pp["P_i8"].astype(jnp.float32),
                 preferred_element_type=jnp.float32,
             )
-            hit = (acc == pp["count_i8"].astype(jnp.float32)[None]).astype(
-                jnp.float32
-            )
+            return (
+                acc == pp["count_i8"].astype(jnp.float32)[None]
+            ).astype(jnp.float32)
+        sign = jnp.where(go, jnp.int8(1), jnp.int8(-1))
+        acc = jnp.einsum(
+            "bts,tsl->btl", sign, pp["P_i8"],
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.int8)
+        return (acc == pp["count_i8"][None]).astype(jnp.bfloat16)
+
+    def _pair_einsum(spec, hit, hi, lo):
+        """hi+lo bf16 split contraction, f32-accurate."""
+        if on_cpu:
+            h = hi.astype(jnp.float32) + lo.astype(jnp.float32)
+            return jnp.einsum(spec, hit, h)
+        return jnp.einsum(
+            spec, hit, hi, preferred_element_type=jnp.float32
+        ) + jnp.einsum(spec, hit, lo, preferred_element_type=jnp.float32)
+
+    if not classification:
+        def qfn(pp, Xq):
+            hit = _hit(pp, Xq)
             if fused_linear:
-                hi = pp["vhi"].astype(jnp.float32)
-                lo = pp["vlo"].astype(jnp.float32)
-                value = jnp.einsum("btl,tl->b", hit, hi) + jnp.einsum(
-                    "btl,tl->b", hit, lo
-                )
-            else:
-                per_tree = jnp.einsum("btl,tl->bt", hit, pp["vals_f32"])
-                value = (
-                    jnp.max(per_tree, axis=1)
-                    if method == "max"
-                    else jnp.median(per_tree, axis=1)
-                )
-        else:
-            sign = jnp.where(go, jnp.int8(1), jnp.int8(-1))
-            acc = jnp.einsum(
-                "bts,tsl->btl", sign, pp["P_i8"],
-                preferred_element_type=jnp.int32,
-            ).astype(jnp.int8)
-            hit = (acc == pp["count_i8"][None]).astype(jnp.bfloat16)
-            if fused_linear:
-                value = (
-                    jnp.einsum(
-                        "btl,tl->b", hit, pp["vhi"],
-                        preferred_element_type=jnp.float32,
-                    )
-                    + jnp.einsum(
-                        "btl,tl->b", hit, pp["vlo"],
-                        preferred_element_type=jnp.float32,
-                    )
-                )
+                value = _pair_einsum("btl,tl->b", hit, pp["vhi"], pp["vlo"])
             else:
                 per_tree = jnp.einsum(
                     "btl,tl->bt", hit.astype(jnp.float32), pp["vals_f32"],
@@ -414,8 +456,25 @@ def build_quantized_scorer(
                     if method == "max"
                     else jnp.median(per_tree, axis=1)
                 )
-        value = apply_targets_value(value, targets)
-        return value.astype(jnp.float32)
+            value = apply_targets_value(value, targets)
+            return value.astype(jnp.float32)
+    else:
+        def qfn(pp, Xq):
+            hit = _hit(pp, Xq)
+            probs = _pair_einsum("btl,tlc->bc", hit, pp["phi"], pp["plo"])
+            if method == "single":
+                # the label is the leaf's score attribute, not argmax
+                lab = jnp.round(
+                    jnp.einsum(
+                        "btl,tl->b", hit.astype(jnp.float32), pp["lab"],
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+                ).astype(jnp.int32)
+            else:
+                lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            value = apply_targets_value(value, targets)
+            return value.astype(jnp.float32), probs.astype(jnp.float32), lab
 
     # Pallas VMEM-resident kernel: eligible for the uint8 wire with a linear
     # aggregate and a fixed batch that tiles into blocks (the GBM hot path)
@@ -473,4 +532,5 @@ def build_quantized_scorer(
         n_trees=T,
         _jit_fn=jit_fn,
         backend="xla",
+        labels=packed.labels if classification else (),
     )

@@ -29,7 +29,7 @@ import numpy as np
 
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
-from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
+from flink_jpmml_tpu.models.prediction import Prediction
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
 from flink_jpmml_tpu.runtime.queues import BoundedQueue, Closed
 from flink_jpmml_tpu.runtime.sinks import Sink
@@ -131,8 +131,7 @@ class StaticScorer(Scorer):
     def finish(self, ticket) -> List[Any]:
         kind, out, records, n = ticket
         if kind == "q":
-            values = np.asarray(out, np.float32)[:n]
-            preds = decode_batch(values.tolist(), [True] * n, None, None)
+            preds = self._q.decode(out, n)  # blocks on device
         else:
             preds = self._model.decode(out, n)  # blocks on device
         return self._emit(records, preds)

@@ -260,3 +260,83 @@ class TestNativeBucketizer:
         )
         assert got[2, 3] == q.wire.sentinel
         assert (got[0] != q.wire.sentinel).all()
+
+
+def _forest_xml(method="majorityVote", weighted=False, n_trees=7, seed=21):
+    rng = np.random.default_rng(seed)
+    segs = []
+    for t in range(n_trees):
+        w = f' weight="{0.5 + 0.25 * t}"' if weighted else ""
+        f1, f2 = rng.integers(0, 4, size=2)
+        t1, t2 = rng.normal(0, 1, size=2)
+        labs = rng.choice(["p", "q", "r"], size=3)
+        segs.append(f"""<Segment{w}><True/>
+          <TreeModel functionName="classification" missingValueStrategy="defaultChild" splitCharacteristic="binarySplit">
+            <MiningSchema><MiningField name="y" usageType="target"/>
+              <MiningField name="f0"/><MiningField name="f1"/>
+              <MiningField name="f2"/><MiningField name="f3"/></MiningSchema>
+            <Node id="0" defaultChild="1"><True/>
+              <Node id="1" defaultChild="3">
+                <SimplePredicate field="f{f1}" operator="lessThan" value="{t1:.6f}"/>
+                <Node id="3" score="{labs[0]}"><SimplePredicate field="f{f2}" operator="lessThan" value="{t2:.6f}"/></Node>
+                <Node id="4" score="{labs[1]}"><SimplePredicate field="f{f2}" operator="greaterOrEqual" value="{t2:.6f}"/></Node>
+              </Node>
+              <Node id="2" score="{labs[2]}"><SimplePredicate field="f{f1}" operator="greaterOrEqual" value="{t1:.6f}"/></Node>
+            </Node>
+          </TreeModel></Segment>""")
+    return f"""<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+      <Header/>
+      <DataDictionary numberOfFields="5">
+        <DataField name="f0" optype="continuous" dataType="double"/>
+        <DataField name="f1" optype="continuous" dataType="double"/>
+        <DataField name="f2" optype="continuous" dataType="double"/>
+        <DataField name="f3" optype="continuous" dataType="double"/>
+        <DataField name="y" optype="categorical" dataType="string">
+          <Value value="p"/><Value value="q"/><Value value="r"/></DataField>
+      </DataDictionary>
+      <MiningModel functionName="classification">
+        <MiningSchema><MiningField name="y" usageType="target"/>
+          <MiningField name="f0"/><MiningField name="f1"/>
+          <MiningField name="f2"/><MiningField name="f3"/></MiningSchema>
+        <Segmentation multipleModelMethod="{method}">{''.join(segs)}</Segmentation>
+      </MiningModel></PMML>"""
+
+
+class TestClassificationWire:
+    def _parity_cls(self, xml, n=256, missing_rate=0.15, seed=5):
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        q = cm.quantized_scorer()
+        assert q is not None and q.is_classification
+        rng = np.random.default_rng(seed)
+        X = _rand_X(rng, n, 4, missing_rate=missing_rate)
+        M = np.isnan(X)
+        ref = cm.predict(np.nan_to_num(X, nan=0.0), M)
+        got_v, got_p, got_l = q.predict_wire(q.wire.encode(X))
+        np.testing.assert_array_equal(
+            np.asarray(got_l), np.asarray(ref.label_idx)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_p), np.asarray(ref.probs), rtol=1e-3, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_v), np.asarray(ref.value), rtol=1e-3, atol=1e-4
+        )
+
+    def test_majority_vote_forest(self):
+        self._parity_cls(_forest_xml("majorityVote"))
+
+    def test_weighted_majority_vote(self):
+        self._parity_cls(_forest_xml("weightedMajorityVote", weighted=True))
+
+    def test_scorer_decode_labels(self):
+        doc = parse_pmml(_forest_xml("majorityVote"))
+        q = build_quantized_scorer(doc)
+        rng = np.random.default_rng(9)
+        X = _rand_X(rng, 16, 4)
+        preds = q.score(X)
+        cm = compile_pmml(doc)
+        exp = cm.score_dense(X)
+        for a, b in zip(preds, exp):
+            assert a.target.label == b.target.label
+            assert abs(a.score.value - b.score.value) < 1e-3
