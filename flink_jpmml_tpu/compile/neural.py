@@ -28,6 +28,14 @@ _ACTIVATIONS = {
     "tanh": jnp.tanh,
     "identity": lambda z: z,
     "rectifier": lambda z: jnp.maximum(z, 0.0),
+    "arctan": jnp.arctan,
+    "cosine": jnp.cos,
+    "sine": jnp.sin,
+    "square": lambda z: z * z,
+    "Gauss": lambda z: jnp.exp(-(z * z)),
+    "reciprocal": lambda z: 1.0 / z,
+    "exponential": jnp.exp,
+    "elliott": lambda z: z / (1.0 + jnp.abs(z)),
 }
 
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -73,7 +74,8 @@ def lower_regression(model: ir.RegressionModelIR, ctx: LowerCtx) -> Lowered:
     table_fns = [f for _, f in lowered_tables]
 
     if model.function_name == "regression":
-        if nm not in ("none", "identity", "softmax", "logit", "exp"):
+        if nm not in ("none", "identity", "softmax", "logit", "exp",
+                      "cauchit", "cloglog", "loglog", "probit"):
             raise ModelCompilationException(
                 f"unsupported regression normalization {nm!r}"
             )
@@ -86,6 +88,14 @@ def lower_regression(model: ir.RegressionModelIR, ctx: LowerCtx) -> Lowered:
                 y = 1.0 / (1.0 + jnp.exp(-y))
             elif nm == "exp":
                 y = jnp.exp(y)
+            elif nm == "cauchit":
+                y = 0.5 + jnp.arctan(y) / jnp.pi
+            elif nm == "cloglog":
+                y = 1.0 - jnp.exp(-jnp.exp(y))
+            elif nm == "loglog":
+                y = jnp.exp(-jnp.exp(-y))
+            elif nm == "probit":
+                y = 0.5 * (1.0 + jax.scipy.special.erf(y / jnp.sqrt(2.0)))
             return ModelOutput(value=y, valid=~missing)
 
         return Lowered(fn=fn, params=params)

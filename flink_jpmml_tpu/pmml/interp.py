@@ -448,6 +448,14 @@ def _eval_regression(model: ir.RegressionModelIR, record: Record) -> EvalResult:
             return EvalResult(value=1.0 / (1.0 + math.exp(-y)))
         if nm == "exp":
             return EvalResult(value=math.exp(y))
+        if nm == "cauchit":
+            return EvalResult(value=0.5 + math.atan(y) / math.pi)
+        if nm == "cloglog":
+            return EvalResult(value=1.0 - math.exp(-math.exp(y)))
+        if nm == "loglog":
+            return EvalResult(value=math.exp(-math.exp(-y)))
+        if nm == "probit":
+            return EvalResult(value=0.5 * (1.0 + math.erf(y / math.sqrt(2.0))))
         raise ModelCompilationException(f"unsupported normalization {nm!r}")
 
     # classification: one table per target category
@@ -485,6 +493,14 @@ _ACTIVATIONS = {
     "tanh": math.tanh,
     "identity": lambda z: z,
     "rectifier": lambda z: max(0.0, z),
+    "arctan": math.atan,
+    "cosine": math.cos,
+    "sine": math.sin,
+    "square": lambda z: z * z,
+    "Gauss": lambda z: math.exp(-z * z),
+    "reciprocal": lambda z: 1.0 / z,
+    "exponential": math.exp,
+    "elliott": lambda z: z / (1.0 + abs(z)),
 }
 
 

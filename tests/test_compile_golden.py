@@ -398,3 +398,79 @@ class TestInputContract:
         preds = cm.score_dense(X)
         assert len(preds) == 5
         assert not any(p.is_empty for p in preds)
+
+
+class TestLinkFunctions:
+    def test_regression_normalizations_match_oracle(self):
+        for nm in ("cauchit", "cloglog", "loglog", "probit", "exp", "logit"):
+            xml = f"""<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+              <Header/>
+              <DataDictionary numberOfFields="2">
+                <DataField name="a" optype="continuous" dataType="double"/>
+                <DataField name="y" optype="continuous" dataType="double"/>
+              </DataDictionary>
+              <RegressionModel functionName="regression" normalizationMethod="{nm}">
+                <MiningSchema>
+                  <MiningField name="y" usageType="target"/>
+                  <MiningField name="a"/>
+                </MiningSchema>
+                <RegressionTable intercept="0.1">
+                  <NumericPredictor name="a" coefficient="0.8"/>
+                </RegressionTable>
+              </RegressionModel></PMML>"""
+            doc = parse_pmml(xml)
+            cm = compile_pmml(doc)
+            for a in (-2.0, -0.3, 0.0, 0.7, 2.5):
+                [pred] = cm.score_records([{"a": a}])
+                exp = evaluate(doc, {"a": a})
+                assert pred.score.value == pytest.approx(
+                    exp.value, rel=1e-5, abs=1e-6
+                ), (nm, a)
+
+
+class TestNeuralActivations:
+    def test_extended_activations_match_oracle(self):
+        for act in ("arctan", "cosine", "sine", "square", "Gauss",
+                    "reciprocal", "exponential", "elliott", "tanh"):
+            xml = f"""<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+              <Header/>
+              <DataDictionary numberOfFields="2">
+                <DataField name="a" optype="continuous" dataType="double"/>
+                <DataField name="y" optype="continuous" dataType="double"/>
+              </DataDictionary>
+              <NeuralNetwork functionName="regression" activationFunction="{act}">
+                <MiningSchema>
+                  <MiningField name="y" usageType="target"/>
+                  <MiningField name="a"/>
+                </MiningSchema>
+                <NeuralInputs>
+                  <NeuralInput id="in0">
+                    <DerivedField optype="continuous" dataType="double">
+                      <FieldRef field="a"/>
+                    </DerivedField>
+                  </NeuralInput>
+                </NeuralInputs>
+                <NeuralLayer>
+                  <Neuron id="h0" bias="0.2">
+                    <Con from="in0" weight="1.3"/>
+                  </Neuron>
+                </NeuralLayer>
+                <NeuralLayer activationFunction="identity">
+                  <Neuron id="out0" bias="-0.1">
+                    <Con from="h0" weight="0.9"/>
+                  </Neuron>
+                </NeuralLayer>
+                <NeuralOutputs>
+                  <NeuralOutput outputNeuron="out0">
+                    <DerivedField optype="continuous" dataType="double">
+                      <FieldRef field="y"/>
+                    </DerivedField>
+                  </NeuralOutput>
+                </NeuralOutputs>
+              </NeuralNetwork></PMML>"""
+            doc = parse_pmml(xml)
+            cm = compile_pmml(doc)
+            for a in (-1.5, -0.2, 0.4, 1.1):
+                [pred] = cm.score_records([{"a": a}])
+                exp = evaluate(doc, {"a": a})
+                assert abs(pred.score.value - exp.value) < 1e-5, (act, a)
