@@ -127,6 +127,19 @@ class DynamicScorer(Scorer):
                     np.asarray(payloads, np.float32),
                     self._replace_nan,
                 )
+            # rank-wire fast path per served model (qtrees.py; cached on
+            # the CompiledModel, so the probe is free after the first batch)
+            q = model.quantized_scorer()
+            if q is not None:
+                Xq = q.wire.encode(X, M)
+                if q.batch_size is not None and Xq.shape[0] != q.batch_size:
+                    pad = (-Xq.shape[0]) % q.batch_size
+                    if pad:
+                        Xq = np.concatenate(
+                            [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)]
+                        )
+                tickets.append((q, idxs, q.predict_wire(Xq)))
+                continue
             if model.batch_size is not None:
                 X, M, _ = prepare.pad_batch(X, M, model.batch_size)
             out = model.predict(X, M)  # async dispatch per group
