@@ -16,9 +16,18 @@ Measured: the full streaming pipeline in steady state —
   batch is materialized on the host before it counts.
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 vs_baseline is the ratio against the 1M rec/s north-star target
-(the reference publishes no numbers of its own - BASELINE.md).
+(the reference publishes no numbers of its own - BASELINE.md). The line
+also carries "device_value" — the pure device-side scoring rate with the
+batch already resident — and "backend". When the TPU backend cannot be
+initialized within the bounded probe (retries with hard per-attempt
+timeouts), the bench falls back to the CPU backend at diagnostic scale and
+still prints a capture with "backend": "cpu-fallback" and an "error" field
+describing the TPU failure (exit 0 — a labelled number beats an empty
+artifact, which is what round 1 recorded). Only a wedged in-process init
+after a *successful* probe produces "value": 0 + non-zero exit, via the
+watchdog, and that too within a bounded time.
 """
 
 import argparse
@@ -26,14 +35,72 @@ import collections
 import json
 import os
 import pathlib
+import subprocess
 import sys
 import tempfile
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
 
 NORTH_STAR_REC_S = 1_000_000.0
+
+
+def _fail_line(metric: str, error: str) -> None:
+    print(json.dumps({
+        "metric": metric,
+        "value": 0.0,
+        "unit": "records/s/chip",
+        "vs_baseline": 0.0,
+        "error": error,
+    }), flush=True)
+
+
+def probe_backend(attempts: int, timeout_s: float):
+    """Bounded out-of-process backend probe, retried with backoff.
+
+    A wedged PJRT init cannot be interrupted from inside the process, so
+    the probe runs ``jax.default_backend()`` in a child with a hard
+    timeout. Returns ``(backend_name, None)`` on success or
+    ``(None, error)`` once every attempt has failed — the caller then
+    falls back to a clearly-labelled CPU capture rather than recording
+    nothing (the round-1 BENCH artifact was rc=1 with no number at all)."""
+    err = "unknown"
+    for k in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip().splitlines()[-1], None
+            err = (r.stderr or "backend probe failed").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            err = f"backend init exceeded {timeout_s:.0f}s (attempt {k + 1})"
+        if k + 1 < attempts:
+            time.sleep(min(5.0 * (k + 1), 15.0))
+    return None, f"backend unavailable after {attempts} attempts: {err}"
+
+
+def arm_watchdog(metric: str, timeout_s: float) -> dict:
+    """Belt to the probe's braces: if the *parent's* own backend init still
+    wedges (tunnel raced between probe and init), emit the diagnostic line
+    and hard-exit instead of hanging the driver."""
+    state = {"ready": False}
+
+    def run():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            if state["ready"]:
+                return
+            time.sleep(1.0)
+        _fail_line(metric, f"in-process backend init wedged > {timeout_s:.0f}s")
+        os._exit(1)
+
+    threading.Thread(target=run, daemon=True).start()
+    return state
 
 
 def main() -> None:
@@ -49,11 +116,37 @@ def main() -> None:
     ap.add_argument("--seconds", type=float, default=4.0)
     ap.add_argument("--f32-wire", action="store_true",
                     help="ship raw f32 features instead of the rank wire")
+    ap.add_argument("--probe-timeout", type=float, default=100.0,
+                    help="per-attempt backend probe bound (seconds)")
+    ap.add_argument("--probe-attempts", type=int, default=3)
     args = ap.parse_args()
+
+    metric = f"gbm{args.trees}_records_per_sec_per_chip"
+    backend, probe_err = probe_backend(args.probe_attempts, args.probe_timeout)
+    watchdog = arm_watchdog(metric, 2.0 * args.probe_timeout)
 
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    if backend is None:
+        # TPU tunnel down: capture a CPU number, clearly labelled, instead
+        # of an empty artifact. The env-var route is ignored by the axon
+        # plugin in this image; the config API works (tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+        backend = "cpu-fallback"
+    if backend.startswith("cpu"):
+        # full-size dispatches would allocate GBs of einsum intermediates
+        # on the CPU backend; shrink to a diagnostic-scale workload (also
+        # when the machine simply has no TPU and the probe reported "cpu")
+        args.chunk = min(args.chunk, 1024)
+        args.batch = min(args.batch, 8 * args.chunk)
+        args.seconds = min(args.seconds, 3.0)
+    # keep the dispatch/chunk contract valid for any flag combination
+    args.batch = max(args.chunk, (args.batch // args.chunk) * args.chunk)
+
+    jax.devices()  # force backend init under the watchdog, not mid-compile
+    watchdog["ready"] = True
 
     from assets.generate import gen_gbm
     from flink_jpmml_tpu.compile import compile_pmml
@@ -75,8 +168,7 @@ def main() -> None:
     doc = parse_pmml_file(pmml)
 
     B, C, F = args.batch, args.chunk, args.features
-    assert B % C == 0
-    K = B // C
+    K = B // C  # batch was normalized to a multiple of chunk above
 
     rng = np.random.default_rng(0)
     pool_f32 = [
@@ -149,14 +241,33 @@ def main() -> None:
             done_records += scores.shape[0]
     dt = time.perf_counter() - t0
     enc_pool.shutdown(wait=False)
-
     rate = done_records / dt
-    print(json.dumps({
-        "metric": f"gbm{args.trees}_records_per_sec_per_chip",
+
+    # pure device-side rate: batch already resident, no host link in the
+    # loop — separates chip capability from the (possibly tunneled) link
+    Xq_dev = jax.device_put(encode(pool_f32[0]))
+    jax.block_until_ready(run(params, Xq_dev))
+    reps = 0
+    out = None
+    t1 = time.perf_counter()
+    dev_deadline = t1 + min(3.0, args.seconds)
+    while time.perf_counter() < dev_deadline:
+        out = run(params, Xq_dev)
+        reps += 1
+    jax.block_until_ready(out)
+    dev_rate = reps * B / (time.perf_counter() - t1)
+
+    line = {
+        "metric": metric,
         "value": round(rate, 1),
         "unit": "records/s/chip",
         "vs_baseline": round(rate / NORTH_STAR_REC_S, 3),
-    }))
+        "device_value": round(dev_rate, 1),
+        "backend": backend,
+    }
+    if probe_err is not None:
+        line["error"] = probe_err
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

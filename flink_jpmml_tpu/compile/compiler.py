@@ -101,13 +101,24 @@ class CompiledModel:
         if self._quantized is _UNSET:
             from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
 
-            self._quantized = (
-                build_quantized_scorer(
-                    self._doc, batch_size=self.batch_size, config=self._config
+            # a probe failure must never take down the caller's pipeline —
+            # the f32 path is always available and semantically complete, so
+            # ANY failure here (compilation edge case, or a RuntimeError
+            # from the first device interaction — device_put of the Pallas
+            # group tables happens before any lazy jit executes) degrades
+            # to it rather than killing the stream
+            try:
+                self._quantized = (
+                    build_quantized_scorer(
+                        self._doc,
+                        batch_size=self.batch_size,
+                        config=self._config,
+                    )
+                    if self._doc is not None
+                    else None
                 )
-                if self._doc is not None
-                else None
-            )
+            except Exception:
+                self._quantized = None
             # the parse tree is only needed for this probe — release it so a
             # long-lived served model doesn't pin the whole IR
             self._doc = None

@@ -28,14 +28,16 @@ _ACTIVATIONS = {
     "tanh": jnp.tanh,
     "identity": lambda z: z,
     "rectifier": lambda z: jnp.maximum(z, 0.0),
-    "arctan": jnp.arctan,
+    # PMML 4.x defines arctan as 2*arctan(Z)/pi (range (-1, 1))
+    "arctan": lambda z: 2.0 * jnp.arctan(z) / jnp.pi,
     "cosine": jnp.cos,
     "sine": jnp.sin,
     "square": lambda z: z * z,
     "Gauss": lambda z: jnp.exp(-(z * z)),
     "reciprocal": lambda z: 1.0 / z,
     "exponential": jnp.exp,
-    "elliott": lambda z: z / (1.0 + jnp.abs(z)),
+    "Elliott": lambda z: z / (1.0 + jnp.abs(z)),
+    "elliott": lambda z: z / (1.0 + jnp.abs(z)),  # lenient-case alias
 }
 
 

@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flink_jpmml_tpu.compile import prepare
+from flink_jpmml_tpu.compile import common, prepare
 from flink_jpmml_tpu.compile.common import (
     LowerCtx,
     apply_targets_value,
@@ -49,6 +49,7 @@ from flink_jpmml_tpu.compile.common import (
     extract_missing_replacements,
 )
 from flink_jpmml_tpu.compile.trees import (
+    _canon_has_halt,
     _canonicalize_forest,
     pack_ensemble,
 )
@@ -169,19 +170,33 @@ class QuantizedScorer:
         return bool(self.labels)
 
     def predict_wire(self, Xq):
-        """→ f32 values [B] (regression) or (values, probs, label_idx)."""
-        return self._jit_fn(self.params, Xq)
+        """→ f32 values [B] (regression) or (values, probs, label_idx).
 
-    def score(self, X, M=None) -> List[Prediction]:
-        n = np.asarray(X).shape[0]
-        Xq = self.wire.encode(X, M)
-        if self.batch_size is not None and n != self.batch_size:
-            pad = self.batch_size - (n % self.batch_size or self.batch_size)
+        The ONE place batch-size alignment happens: any batch whose length
+        differs from the compile ``batch_size`` is zero-padded up to a
+        multiple of it — one padded call on the XLA path (bounded retrace
+        per distinct multiple), fixed-grid batch-size chunks on Pallas
+        (whose kernel bakes ``out_shape=(batch_size,)``). Callers pass the
+        encoded batch as-is and trim via ``decode(out, n)``."""
+        n = Xq.shape[0]
+        bs = self.batch_size
+        if bs is not None and n != bs:
+            pad = (-n) % bs
             if pad:
                 Xq = np.concatenate(
                     [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)], axis=0
                 )
-        out = self.predict_wire(Xq)
+            if self.backend == "pallas":
+                outs = [
+                    self._jit_fn(self.params, Xq[i : i + bs])
+                    for i in range(0, Xq.shape[0], bs)
+                ]
+                return jnp.concatenate(outs, axis=0)
+        return self._jit_fn(self.params, Xq)
+
+    def score(self, X, M=None) -> List[Prediction]:
+        n = np.asarray(X).shape[0]
+        out = self.predict_wire(self.wire.encode(X, M))
         return self.decode(out, n)
 
     def decode(self, out, n: int) -> List[Prediction]:
@@ -286,7 +301,14 @@ def build_quantized_scorer(
         "single", "majorityVote", "weightedMajorityVote"
     ):
         return None
-    packed = pack_ensemble(canons, classification)
+    # halting missing-value semantics (lastPrediction / returnLastPrediction)
+    # need the iterative f32 backend; pack_ensemble would raise on them
+    if any(_canon_has_halt(c) for c in canons):
+        return None
+    try:
+        packed = pack_ensemble(canons, classification)
+    except ModelCompilationException:
+        return None
     p = packed.params
     if "set_codes" in p or p["mnull"].any():
         return None
@@ -407,7 +429,7 @@ def build_quantized_scorer(
         params["plo"] = plo
         params["lab"] = lab_f
 
-    on_cpu = jax.default_backend() == "cpu"
+    on_cpu = common.backend_is_cpu()
     sent = dtype(sentinel)
 
     def _hit(pp, Xq):

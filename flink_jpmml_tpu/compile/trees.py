@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_jpmml_tpu.compile import common
 from flink_jpmml_tpu.compile.common import HIGHEST, Lowered, LowerCtx, ModelOutput
 from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
@@ -561,7 +562,7 @@ def make_ensemble_eval(packed: PackedEnsemble, ctx: LowerCtx):
     # kernel, so fall back to f32 there.
     use_bf16 = (
         ctx.config.matmul_dtype == "bfloat16"
-        and jax.default_backend() != "cpu"
+        and not common.backend_is_cpu()
     )
     cdtype = jnp.bfloat16 if use_bf16 else jnp.float32
     opcodes = packed.opcodes
@@ -698,7 +699,6 @@ def pack_nodes(
         # tracking (oracle last_scored) but their value is null
         valnull = np.zeros((T, N), np.float32)
 
-    any_halt = False
     ops_seen = set()
     for ti, rows in enumerate(per_tree_rows):
         for ni, row in enumerate(rows):
@@ -731,7 +731,6 @@ def pack_nodes(
                 mnull[ti, ni] = float(row["mnull"])
                 if row["halt"]:
                     halt[ti, ni] = 1.0
-                    any_halt = True
                 ops_seen.add(row["op"])
                 if set_codes is not None and row["sets"]:
                     set_codes[ti, ni, : len(row["sets"])] = row["sets"]

@@ -493,14 +493,16 @@ _ACTIVATIONS = {
     "tanh": math.tanh,
     "identity": lambda z: z,
     "rectifier": lambda z: max(0.0, z),
-    "arctan": math.atan,
+    # PMML 4.x defines arctan as 2*arctan(Z)/pi (range (-1, 1))
+    "arctan": lambda z: 2.0 * math.atan(z) / math.pi,
     "cosine": math.cos,
     "sine": math.sin,
     "square": lambda z: z * z,
     "Gauss": lambda z: math.exp(-z * z),
     "reciprocal": lambda z: 1.0 / z,
     "exponential": math.exp,
-    "elliott": lambda z: z / (1.0 + abs(z)),
+    "Elliott": lambda z: z / (1.0 + abs(z)),
+    "elliott": lambda z: z / (1.0 + abs(z)),  # lenient-case alias
 }
 
 

@@ -114,13 +114,7 @@ class StaticScorer(Scorer):
         n = X.shape[0]
         if self._q is not None:
             Xq = self._q.wire.encode(X, M)
-            bs = self._q.batch_size
-            if bs is not None and n != bs:
-                pad = (-n) % bs
-                if pad:
-                    Xq = np.concatenate(
-                        [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)]
-                    )
+            # predict_wire owns batch-size alignment (padding / chunking)
             out = self._q.predict_wire(Xq)  # async dispatch
             return ("q", out, records, n)
         if self._model.batch_size is not None:

@@ -131,13 +131,8 @@ class DynamicScorer(Scorer):
             # the CompiledModel, so the probe is free after the first batch)
             q = model.quantized_scorer()
             if q is not None:
+                # predict_wire owns batch-size alignment (padding/chunking)
                 Xq = q.wire.encode(X, M)
-                if q.batch_size is not None and Xq.shape[0] != q.batch_size:
-                    pad = (-Xq.shape[0]) % q.batch_size
-                    if pad:
-                        Xq = np.concatenate(
-                            [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)]
-                        )
                 tickets.append((q, idxs, q.predict_wire(Xq)))
                 continue
             if model.batch_size is not None:

@@ -429,9 +429,32 @@ class TestLinkFunctions:
 
 
 class TestNeuralActivations:
+    def test_spec_defined_activation_values(self):
+        """Golden values straight from the PMML 4.x spec formulas (not
+        oracle parity — the oracle shares the table, so parity alone could
+        not catch a spec divergence like plain atan vs 2*atan(z)/pi)."""
+        import math
+
+        spec = {
+            "arctan": lambda z: 2.0 * math.atan(z) / math.pi,
+            "Elliott": lambda z: z / (1.0 + abs(z)),
+            "logistic": lambda z: 1.0 / (1.0 + math.exp(-z)),
+            "tanh": math.tanh,
+            "rectifier": lambda z: max(0.0, z),
+        }
+        from flink_jpmml_tpu.compile.neural import _ACTIVATIONS as C_ACT
+        from flink_jpmml_tpu.pmml.interp import _ACTIVATIONS as O_ACT
+
+        for name, fn in spec.items():
+            for z in (-3.0, -0.7, 0.0, 0.4, 2.2):
+                exp = fn(z)
+                assert float(C_ACT[name](z)) == pytest.approx(exp, abs=1e-6), name
+                assert float(O_ACT[name](z)) == pytest.approx(exp, abs=1e-9), name
+
     def test_extended_activations_match_oracle(self):
         for act in ("arctan", "cosine", "sine", "square", "Gauss",
-                    "reciprocal", "exponential", "elliott", "tanh"):
+                    "reciprocal", "exponential", "Elliott", "elliott",
+                    "tanh"):
             xml = f"""<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
               <Header/>
               <DataDictionary numberOfFields="2">

@@ -68,6 +68,51 @@ class TestEligibility:
         rng = np.random.default_rng(3)
         _parity(doc, _rand_X(rng, 64, 2, missing_rate=0.1))
 
+    def test_halting_strategy_probe_returns_none(self):
+        # missingValueStrategy=lastPrediction needs the iterative f32
+        # backend; the probe must degrade to None, never raise (a raise
+        # here used to crash StaticScorer/DynamicScorer pipelines)
+        xml = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+          <Header/>
+          <DataDictionary numberOfFields="2">
+            <DataField name="a" optype="continuous" dataType="double"/>
+            <DataField name="y" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <MiningModel functionName="regression">
+            <MiningSchema>
+              <MiningField name="y" usageType="target"/>
+              <MiningField name="a"/>
+            </MiningSchema>
+            <Segmentation multipleModelMethod="sum">
+              <Segment><True/>
+                <TreeModel functionName="regression"
+                           missingValueStrategy="lastPrediction">
+                  <MiningSchema>
+                    <MiningField name="y" usageType="target"/>
+                    <MiningField name="a"/>
+                  </MiningSchema>
+                  <Node score="0.5"><True/>
+                    <Node score="1.0">
+                      <SimplePredicate field="a" operator="lessThan" value="0"/>
+                    </Node>
+                    <Node score="2.0">
+                      <SimplePredicate field="a" operator="greaterOrEqual" value="0"/>
+                    </Node>
+                  </Node>
+                </TreeModel>
+              </Segment>
+            </Segmentation>
+          </MiningModel></PMML>"""
+        doc = parse_pmml(xml)
+        assert build_quantized_scorer(doc) is None
+        cm = compile_pmml(doc)
+        assert cm.quantized_scorer() is None  # guarded probe, no raise
+        # and the f32 path still scores it (incl. the halt semantics)
+        [pred] = cm.score_records([{"a": 1.0}])
+        assert pred.score.value == pytest.approx(2.0)
+        [pred] = cm.score_records([{}])
+        assert pred.score.value == pytest.approx(0.5)
+
     def test_classification_not_eligible(self):
         xml = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
           <Header/>

@@ -58,6 +58,26 @@ class TestPallasParity:
             rtol=1e-4, atol=1e-5,
         )
 
+    def test_oversized_batch_chunks_through_fixed_grid(self, tmp_path):
+        # the kernel bakes out_shape=(batch_size,): batches larger than the
+        # compile batch must be scored in chunks, not silently truncated
+        doc = _doc(tmp_path, n_trees=13, depth=3, n_features=4)
+        B = 32
+        qx = build_quantized_scorer(doc, batch_size=B, backend="xla")
+        qp = build_quantized_scorer(
+            doc, batch_size=B, backend="pallas", pallas_interpret=True
+        )
+        rng = np.random.default_rng(2)
+        for n in (B - 5, B, 2 * B, 2 * B + 7):
+            X = rng.normal(size=(n, 4)).astype(np.float32)
+            X[rng.random(size=X.shape) < 0.15] = np.nan
+            preds = qp.score(X)
+            assert len(preds) == n
+            ref = qx.score(X)
+            got_v = np.asarray([p.score.value for p in preds])
+            ref_v = np.asarray([p.score.value for p in ref])
+            np.testing.assert_allclose(got_v, ref_v, rtol=1e-4, atol=1e-5)
+
     def test_u16_wire_not_pallas_eligible(self, tmp_path):
         doc = _doc(tmp_path, n_trees=300, depth=5, n_features=2,
                    hist_bins=None)
