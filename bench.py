@@ -119,6 +119,10 @@ def main() -> None:
     ap.add_argument("--probe-timeout", type=float, default=100.0,
                     help="per-attempt backend probe bound (seconds)")
     ap.add_argument("--probe-attempts", type=int, default=3)
+    ap.add_argument("--block-pipeline", action="store_true",
+                    help="measure through the production BlockPipeline "
+                         "(ring + rank wire) instead of the hand loop — "
+                         "the engine-vs-bench parity check")
     args = ap.parse_args()
 
     metric = f"gbm{args.trees}_records_per_sec_per_chip"
@@ -176,6 +180,52 @@ def main() -> None:
     ]
 
     cm = compile_pmml(doc, batch_size=C)
+
+    if args.block_pipeline:
+        # the production path: f32 blocks → C++ ring → bucketizer →
+        # quantized scoring → sink. Same model, same chunk size; reported
+        # under the same metric so the two numbers are directly comparable.
+        from flink_jpmml_tpu.runtime.block import (
+            BlockPipeline, CyclingBlockSource,
+        )
+        from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+        count = [0]
+
+        def bsink(out, n, first_off):
+            count[0] += n
+
+        pipe = BlockPipeline(
+            CyclingBlockSource(np.concatenate(pool_f32), block_size=C),
+            cm,
+            bsink,
+            RuntimeConfig(batch=BatchConfig(size=C, deadline_us=5000)),
+            use_quantized=not args.f32_wire,
+        )
+        q = None if args.f32_wire else cm.quantized_scorer()
+        if q is not None:
+            jax.block_until_ready(
+                q.predict_wire(q.wire.encode(pool_f32[0][:C]))
+            )
+        else:
+            cm.warmup()
+        t0 = time.perf_counter()
+        pipe.run_for(seconds=args.seconds)
+        dt = time.perf_counter() - t0
+        rate = count[0] / dt
+        line = {
+            "metric": metric,
+            "value": round(rate, 1),
+            "unit": "records/s/chip",
+            "vs_baseline": round(rate / NORTH_STAR_REC_S, 3),
+            "device_value": None,  # keys uniform with the hand-loop line
+            "backend": f"{backend}/{pipe.backend}",
+        }
+        if probe_err is not None:
+            line["error"] = probe_err
+        print(json.dumps(line))
+        return
+
     if args.f32_wire:
         inner = getattr(cm._jit_fn, "__wrapped__", cm._jit_fn)
         params = cm.params

@@ -3,13 +3,14 @@
 The north-star workload: a histogram-trained gradient-boosted ensemble
 scoring a high-rate feature stream. The reference runs JPMML-Evaluator's
 per-record tree walk inside a Flink flatMap (SURVEY.md §4.1 hot loop);
-here the engine's StaticScorer picks the quantized rank wire
-(compile/qtrees.py) automatically — each record crosses to the device as
-32 uint8 threshold ranks and the whole micro-batch is scored by the
-Pallas VMEM-resident kernel (TPU) or the int8 einsum path.
+here the *production* BlockPipeline drives the quantized rank wire
+(compile/qtrees.py) end to end — f32 blocks flow through the C++ ring,
+are encoded to uint8 threshold ranks by the multithreaded bucketizer, and
+the whole micro-batch is scored by the Pallas VMEM-resident kernel (TPU)
+or the int8 einsum path. No Python object per record exists anywhere.
 
 Run:  python examples/gbm_throughput.py  [--trees 500 --seconds 3]
-bench.py is the measured version of this pipeline.
+bench.py is the driver-measured version of this same pipeline shape.
 """
 
 import argparse
@@ -25,9 +26,7 @@ import numpy as np
 from assets.generate import gen_gbm
 from flink_jpmml_tpu.compile import compile_pmml
 from flink_jpmml_tpu.pmml import parse_pmml_file
-from flink_jpmml_tpu.runtime.engine import Pipeline, StaticScorer
-from flink_jpmml_tpu.runtime.sinks import NullSink
-from flink_jpmml_tpu.runtime.sources import InMemorySource
+from flink_jpmml_tpu.runtime.block import BlockPipeline, CyclingBlockSource
 from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
 
 
@@ -35,13 +34,14 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trees", type=int, default=500)
     ap.add_argument("--features", type=int, default=32)
-    ap.add_argument("--records", type=int, default=200_000)
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--seconds", type=float, default=3.0)
     args = ap.parse_args()
 
     workdir = tempfile.mkdtemp(prefix="fjt-gbm-")
     pmml = gen_gbm(workdir, n_trees=args.trees, n_features=args.features)
     doc = parse_pmml_file(pmml)
-    cm = compile_pmml(doc, batch_size=16384)
+    cm = compile_pmml(doc, batch_size=args.batch)
     q = cm.quantized_scorer()
     print(
         f"model: {args.trees} trees | rank wire: "
@@ -49,26 +49,33 @@ def main() -> None:
         f"kernel backend: {q.backend if q else 'f32'}"
     )
 
-    scorer = StaticScorer(cm)
     rng = np.random.default_rng(0)
-    block = [
-        {f"f{j}": float(v) for j, v in enumerate(row)}
-        for row in rng.normal(0.0, 1.5, size=(args.records, args.features))
-    ]
-    source = InMemorySource(block)
-    sink = NullSink()
-    pipe = Pipeline(
-        source,
-        scorer,
-        sink,
-        RuntimeConfig(batch=BatchConfig(size=16384, deadline_us=5000)),
+    data = rng.normal(0.0, 1.5, size=(4 * args.batch, args.features)).astype(
+        np.float32
     )
+    count = [0]
+
+    def sink(out, n, first_off):
+        count[0] += n
+
+    pipe = BlockPipeline(
+        CyclingBlockSource(data, block_size=args.batch),
+        cm,
+        sink,
+        RuntimeConfig(batch=BatchConfig(size=args.batch, deadline_us=5000)),
+    )
+    print(f"pipeline backend: {pipe.backend} | native ring: {pipe.native}")
+    if q is not None:
+        # one warm dispatch so jit compile stays outside the timed window
+        q.predict_wire(q.wire.encode(data[: args.batch]))
+    else:
+        cm.warmup()
     t0 = time.perf_counter()
-    pipe.run_until_exhausted(timeout=600.0)
+    pipe.run_for(seconds=args.seconds)
     dt = time.perf_counter() - t0
     snap = pipe.metrics.snapshot()
-    print(f"scored {sink.count} records in {dt:.2f}s "
-          f"({sink.count / dt:,.0f} rec/s through the full pipeline)")
+    print(f"scored {count[0]:,} records in {dt:.2f}s "
+          f"({count[0] / dt:,.0f} rec/s through the full block pipeline)")
     print(f"metrics: {snap}")
 
 

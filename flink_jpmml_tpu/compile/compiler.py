@@ -12,6 +12,7 @@ becomes ``CompiledModel.predict(X, M)`` over a micro-batch; totality
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -117,7 +118,18 @@ class CompiledModel:
                     if self._doc is not None
                     else None
                 )
-            except Exception:
+            except Exception as e:
+                # keep the cause findable: the doc is released below, so
+                # the probe cannot be retried — a silent None would leave
+                # a 10x slowdown with no diagnostic anywhere
+                self.quantized_probe_error = e
+                warnings.warn(
+                    f"quantized-wire probe failed for "
+                    f"{self.model_name or 'model'}; scoring stays on the "
+                    f"f32 path: {e!r}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
                 self._quantized = None
             # the parse tree is only needed for this probe — release it so a
             # long-lived served model doesn't pin the whole IR

@@ -190,9 +190,15 @@ def make_ring(capacity: int, arity: int, batch_size: int, native: bool = True):
 class BlockPipeline:
     """source → ring → padded batches → async scoring → sink.
 
-    ``sink(out: ModelOutput, n: int, first_offset: int)`` receives raw
-    device outputs (decode is the caller's choice — fetching to host costs
-    a D2H transfer per batch).
+    ``sink(out, n: int, first_offset: int)`` receives raw device outputs
+    (decode is the caller's choice — fetching to host costs a D2H transfer
+    per batch; use :meth:`decode` to turn one into ``Prediction``s). When
+    the model is rank-wire eligible (``use_quantized``, the default) the
+    scoring hop is the quantized path of compile/qtrees.py: the drained f32
+    block is encoded to threshold ranks by the multithreaded C++ bucketizer
+    and ``out`` is the QuantizedScorer output; otherwise ``out`` is a
+    :class:`ModelOutput` from the f32 path. ``backend`` says which engaged
+    and is also recorded in metrics as ``scorer_backend_*``.
     """
 
     def __init__(
@@ -204,6 +210,7 @@ class BlockPipeline:
         metrics: Optional[MetricsRegistry] = None,
         use_native: bool = True,
         in_flight: int = 2,
+        use_quantized: bool = True,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -222,6 +229,12 @@ class BlockPipeline:
             model.batch_size,
             native=use_native,
         )
+        probe = getattr(model, "quantized_scorer", None)
+        self._q = probe() if (use_quantized and probe is not None) else None
+        self.backend = (
+            f"rank_wire_{self._q.backend}" if self._q is not None else "f32"
+        )
+        self.metrics.counter(f"scorer_backend_{self.backend}").inc()
         self._in_flight_max = max(1, in_flight)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -231,6 +244,12 @@ class BlockPipeline:
     @property
     def native(self) -> bool:
         return not isinstance(self._ring, _PyRing)
+
+    def decode(self, out, n: int):
+        """Sink-received raw output → ``Prediction`` list (host-side)."""
+        if self._q is not None:
+            return self._q.decode(out, n)
+        return self._model.decode(out, n)
 
     def start(self) -> "BlockPipeline":
         t1 = threading.Thread(target=self._ingest, name="fjt-blk-ingest",
@@ -321,15 +340,24 @@ class BlockPipeline:
                         break
                     continue
                 t_start = time.monotonic()
-                # NaN cells are the missing-value convention on this path
-                if np.isnan(X).any():
-                    Mb = np.isnan(X)
-                    Xb = np.where(Mb, 0.0, X).astype(np.float32)
+                if self._q is not None:
+                    # rank wire: the bucketizer folds NaN→missing (and any
+                    # mining-schema replacement) during encoding — no
+                    # separate host-side NaN pass, no f32 mask plane
+                    Xq = self._q.wire.encode(X)
+                    out = self._q.predict_wire(Xq)  # async dispatch
                 else:
-                    Xb, Mb = X, _ZEROS_M.get(n, self._arity)
-                if n < B:
-                    Xb, Mb, _ = prepare.pad_batch(Xb, Mb, B)
-                out = self._model.predict(Xb, Mb)  # async dispatch
+                    # NaN cells are the missing convention on this path;
+                    # one isnan pass builds the mask (any() on bools is
+                    # cheap), not a scan-then-rescan
+                    Mb = np.isnan(X)
+                    if Mb.any():
+                        Xb = np.where(Mb, 0.0, X).astype(np.float32)
+                    else:
+                        Xb, Mb = X, _ZEROS_M.get(n, self._arity)
+                    if n < B:
+                        Xb, Mb, _ = prepare.pad_batch(Xb, Mb, B)
+                    out = self._model.predict(Xb, Mb)  # async dispatch
                 in_flight.append((out, n, int(offsets[0]) if n else 0, t_start))
                 batches.inc()
                 fill.inc(n)

@@ -131,6 +131,46 @@ class TestBlockPipeline:
         snap = pipe.metrics.snapshot()
         assert snap["records_out"] == 1000
 
+    def test_gbm_block_path_takes_rank_wire(self, tmp_path):
+        # the production block path must engage the quantized wire for the
+        # north-star GBM (VERDICT r1 #2: it used to ship f32 via predict)
+        from assets.generate import gen_gbm
+
+        doc = parse_pmml_file(
+            gen_gbm(str(tmp_path), n_trees=20, depth=4, n_features=6)
+        )
+        cm = compile_pmml(doc, batch_size=128)
+        rng = np.random.default_rng(5)
+        data = rng.normal(0.0, 1.5, size=(500, 6)).astype(np.float32)
+        data[rng.random(size=data.shape) < 0.1] = np.nan
+        got = np.full((500,), np.nan, np.float32)
+
+        collected = []
+
+        def sink(out, n, first_off):
+            collected.append((out, n, first_off))
+
+        pipe = BlockPipeline(
+            FiniteBlockSource(data, block_size=100),
+            cm,
+            sink,
+            use_native=native.available(),
+        )
+        assert pipe.backend.startswith("rank_wire_")
+        pipe.run_until_exhausted(timeout=30.0)
+        for out, n, first_off in collected:
+            preds = pipe.decode(out, n)
+            got[first_off : first_off + n] = [p.score.value for p in preds]
+        assert not np.isnan(got).any()
+        M = np.isnan(data)
+        ref = np.asarray(
+            cm.predict(np.nan_to_num(data, nan=0.0), M).value, np.float32
+        )[:500]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        snap = pipe.metrics.snapshot()
+        assert snap[f"scorer_backend_{pipe.backend}"] == 1
+        assert snap["records_out"] == 500
+
     def test_throughput_smoke_cpu(self, iris_model):
         # not a perf assertion — just that the loop sustains block flow
         rng = np.random.default_rng(1)
