@@ -193,6 +193,10 @@ def main() -> None:
         count = [0]
 
         def bsink(out, n, first_off):
+            # force the D2H round trip so the rate counts *completed*
+            # work, same as the hand loop — not async dispatches
+            np.asarray(out.value if hasattr(out, "value") else
+                       out[0] if isinstance(out, tuple) else out)
             count[0] += n
 
         pipe = BlockPipeline(

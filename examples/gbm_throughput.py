@@ -56,6 +56,10 @@ def main() -> None:
     count = [0]
 
     def sink(out, n, first_off):
+        # force the D2H round trip so the printed rate counts *completed*
+        # scoring, not async dispatches still queued on the device
+        np.asarray(out.value if hasattr(out, "value") else
+                   out[0] if isinstance(out, tuple) else out)
         count[0] += n
 
     pipe = BlockPipeline(
@@ -67,7 +71,9 @@ def main() -> None:
     print(f"pipeline backend: {pipe.backend} | native ring: {pipe.native}")
     if q is not None:
         # one warm dispatch so jit compile stays outside the timed window
-        q.predict_wire(q.wire.encode(data[: args.batch]))
+        import jax
+
+        jax.block_until_ready(q.predict_wire(q.wire.encode(data[: args.batch])))
     else:
         cm.warmup()
     t0 = time.perf_counter()

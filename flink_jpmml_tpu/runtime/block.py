@@ -27,6 +27,7 @@ import numpy as np
 
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.utils.config import RuntimeConfig
 from flink_jpmml_tpu.utils.exceptions import InputValidationException
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
@@ -37,6 +38,12 @@ class BlockSource:
 
     def poll(self) -> Optional[Tuple[int, np.ndarray]]:
         raise NotImplementedError
+
+    def seek(self, offset: int) -> None:
+        """Resume hook: next poll starts at this record offset."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support offset seek/resume"
+        )
 
     @property
     def exhausted(self) -> bool:
@@ -66,6 +73,10 @@ class CyclingBlockSource(BlockSource):
         self._offset += blk.shape[0]
         return off, blk
 
+    def seek(self, offset: int) -> None:
+        self._offset = offset
+        self._pos = offset % self._data.shape[0]
+
 
 class FiniteBlockSource(BlockSource):
     def __init__(self, data: np.ndarray, block_size: int):
@@ -80,6 +91,9 @@ class FiniteBlockSource(BlockSource):
         off = self._pos
         self._pos += blk.shape[0]
         return off, blk
+
+    def seek(self, offset: int) -> None:
+        self._pos = offset
 
     @property
     def exhausted(self) -> bool:
@@ -211,6 +225,7 @@ class BlockPipeline:
         use_native: bool = True,
         in_flight: int = 2,
         use_quantized: bool = True,
+        checkpoint=None,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -240,10 +255,27 @@ class BlockPipeline:
         self._threads: List[threading.Thread] = []
         self._error: Optional[BaseException] = None
         self.committed_offset = 0
+        self._ckpt = CheckpointPolicy(
+            checkpoint, self._config.checkpoint_interval_s
+        )
 
     @property
     def native(self) -> bool:
         return not isinstance(self._ring, _PyRing)
+
+    def _ckpt_state(self) -> dict:
+        return {"source_offset": self.committed_offset}
+
+    def restore(self) -> bool:
+        """Resume from the latest checkpoint: seek the source to the last
+        committed record offset (commit happens after sink, C7)."""
+        state = self._ckpt.restore_latest()
+        if state is None:
+            return False
+        off = int(state.get("source_offset", 0))
+        self._source.seek(off)
+        self.committed_offset = off
+        return True
 
     def decode(self, out, n: int):
         """Sink-received raw output → ``Prediction`` list (host-side)."""
@@ -330,6 +362,7 @@ class BlockPipeline:
             lat.observe(time.monotonic() - t_start)
             records_out.inc(n)
             self.committed_offset = first_off + n
+            self._ckpt.maybe_save(self._ckpt_state)
 
         try:
             while True:
@@ -365,6 +398,7 @@ class BlockPipeline:
                     _finish_one()
             while in_flight:
                 _finish_one()
+            self._ckpt.save_now(self._ckpt_state)  # clean drain → exact resume
         except BaseException as e:
             self._error = e
             self._stop.set()

@@ -24,6 +24,39 @@ from flink_jpmml_tpu.utils.exceptions import CheckpointException
 _PREFIX = "ckpt-"
 
 
+class CheckpointPolicy:
+    """Interval-gated save/restore shared by the record and block pipelines
+    (one implementation of the timing + enablement logic, so the two
+    engines cannot drift on checkpoint semantics)."""
+
+    def __init__(self, manager: Optional["CheckpointManager"],
+                 interval_s: float):
+        self._mgr = manager
+        self._interval = interval_s
+        self._last = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self._mgr is not None
+
+    def restore_latest(self) -> Optional[Dict[str, Any]]:
+        if self._mgr is None:
+            return None
+        return self._mgr.load_latest()
+
+    def maybe_save(self, state_fn) -> None:
+        if self._mgr is None:
+            return
+        if time.monotonic() - self._last >= self._interval:
+            self.save_now(state_fn)
+
+    def save_now(self, state_fn) -> None:
+        if self._mgr is None:
+            return
+        self._mgr.save(state_fn())
+        self._last = time.monotonic()
+
+
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3):
         self._dir = directory

@@ -30,7 +30,10 @@ import numpy as np
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
 from flink_jpmml_tpu.models.prediction import Prediction
-from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.runtime.checkpoint import (
+    CheckpointManager,
+    CheckpointPolicy,
+)
 from flink_jpmml_tpu.runtime.queues import BoundedQueue, Closed
 from flink_jpmml_tpu.runtime.sinks import Sink
 from flink_jpmml_tpu.runtime.sources import Source
@@ -149,23 +152,28 @@ class Pipeline:
         self._sink = sink
         self._config = config or RuntimeConfig()
         self.metrics = metrics or MetricsRegistry()
-        self._ckpt = checkpoint
+        self._ckpt = CheckpointPolicy(
+            checkpoint, self._config.checkpoint_interval_s
+        )
         self._in_flight_max = max(1, in_flight)
         self._queue = BoundedQueue(self._config.batch.queue_capacity)
         self._stop = threading.Event()
         self._ingest_thread: Optional[threading.Thread] = None
         self._score_thread: Optional[threading.Thread] = None
         self._committed_offset = 0
-        self._last_ckpt_time = 0.0
         self._error: Optional[BaseException] = None
+
+    def _ckpt_state(self) -> dict:
+        return {
+            "source_offset": self._committed_offset,
+            "scorer": self._scorer.state(),
+        }
 
     # -- lifecycle ---------------------------------------------------------
 
     def restore(self) -> bool:
         """Resume from the latest checkpoint, if any (capability C7)."""
-        if self._ckpt is None:
-            return False
-        state = self._ckpt.load_latest()
+        state = self._ckpt.restore_latest()
         if state is None:
             return False
         self._source.seek(state.get("source_offset", 0))
@@ -263,7 +271,7 @@ class Pipeline:
                 lat.observe(now - s.t_enq)
             records_out.inc(len(stamped))
             self._committed_offset = stamped[-1].offset
-            self._maybe_checkpoint()
+            self._ckpt.maybe_save(self._ckpt_state)
 
         try:
             while True:
@@ -286,25 +294,7 @@ class Pipeline:
                     _finish_one()
             while in_flight:
                 _finish_one()
-            self._checkpoint_now()
+            self._ckpt.save_now(self._ckpt_state)
         except BaseException as e:
             self._error = e
             self._stop.set()
-
-    def _maybe_checkpoint(self) -> None:
-        if self._ckpt is None:
-            return
-        now = time.monotonic()
-        if now - self._last_ckpt_time >= self._config.checkpoint_interval_s:
-            self._checkpoint_now()
-
-    def _checkpoint_now(self) -> None:
-        if self._ckpt is None:
-            return
-        self._ckpt.save(
-            {
-                "source_offset": self._committed_offset,
-                "scorer": self._scorer.state(),
-            }
-        )
-        self._last_ckpt_time = time.monotonic()
