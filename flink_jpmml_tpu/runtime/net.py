@@ -119,6 +119,8 @@ class BlockFrameServer:
                 target=self._serve_client, args=(conn,), daemon=True
             )
             t.start()
+            # keep the handler list bounded across reconnect churn
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _serve_client(self, conn: socket.socket) -> None:
@@ -278,7 +280,13 @@ class TcpBlockSource(BlockSource):
             self._client.eos = True
             return None
         if kind != KIND_BLOCK:
-            return None
+            # a mismatched stream must fail loudly, not complete cleanly
+            # with zero records scored
+            raise ValueError(
+                "stream carries JSON record frames — use TcpRecordSource"
+                if kind == KIND_RECORDS
+                else f"unknown frame kind {kind}"
+            )
         _, first, rows, cols = _BLOCK_HDR.unpack_from(body, 0)
         if self._arity is not None and cols != self._arity:
             raise ValueError(
@@ -318,7 +326,11 @@ class TcpRecordSource(Source):
                 self._client.eos = True
                 break
             if kind != KIND_RECORDS:
-                continue
+                raise ValueError(
+                    "stream carries f32 block frames — use TcpBlockSource"
+                    if kind == KIND_BLOCK
+                    else f"unknown frame kind {kind}"
+                )
             _, first, count = _REC_HDR.unpack_from(body, 0)
             lines = body[_REC_HDR.size :].decode().split("\n")
             for i, line in enumerate(lines[:count]):

@@ -98,14 +98,41 @@ class DynamicScorer(Scorer):
             else:
                 mid = self.registry.resolve(name, version)
                 key = mid.key() if mid else None
-                if mid is not None and mid not in self._failed:
-                    try:
-                        model = self.registry.model(mid)
-                    except FlinkJpmmlTpuError:
-                        # bad path / uncompilable document → those lanes go
-                        # empty and the id is quarantined; the stream lives
-                        self._failed.add(mid)
-                        model = None
+                if mid is not None:
+                    # double-buffered swap (SURVEY §8(d)): a ready model is
+                    # used as-is; while a *new* version is still compiling
+                    # in the background (or failed to), unpinned events
+                    # keep scoring the newest warm version and pinned-cold
+                    # events go empty — the batch loop never stalls on a
+                    # compile. Only the first deployment of a name (nothing
+                    # warm to serve) blocks, joining the in-flight warm.
+                    if mid not in self._failed:
+                        model = self.registry.model_if_warm(mid)
+                        if (
+                            model is None
+                            and self.registry.warm_error(mid) is not None
+                        ):
+                            self._failed.add(mid)
+                    if model is None:
+                        fb = self.registry.resolve_warm(name)
+                        if version is None and fb is not None and fb != mid:
+                            model = self.registry.model_if_warm(fb)
+                            if model is not None:
+                                key = fb.key()
+                        if model is None and mid not in self._failed:
+                            if fb is not None and self.registry.is_warming(
+                                mid
+                            ):
+                                pass  # empty lanes this batch, no stall
+                            else:
+                                try:
+                                    model = self.registry.model(mid)
+                                except FlinkJpmmlTpuError:
+                                    # bad path / uncompilable document →
+                                    # lanes go empty, id quarantined, the
+                                    # stream lives
+                                    self._failed.add(mid)
+                                    model = None
             if model is None:
                 unserved.append(i)
                 continue
