@@ -5,8 +5,14 @@ Reference parity: ``ModelReader(path)`` (SURVEY.md §3 row B3, §4.4
 its *path* does, and every worker loads it independently in the operator's
 ``open()`` hook. Here the reader is a tiny pickleable handle; ``load()``
 parses + compiles at the worker, with a process-level cache keyed by
-(path, mtime, batch size) so repeated opens (restarts, multiple pipelines)
-compile once — the idempotent-reload property C7 depends on.
+(path, version-token, batch size) so repeated opens (restarts, multiple
+pipelines) compile once — the idempotent-reload property C7 depends on.
+
+Paths may be remote — ``http(s)://``, ``gs://``, ``s3://`` (SURVEY.md §1
+C1: the reference read from any Flink filesystem): :mod:`.remote` resolves
+them to a validated local cache copy, and its version token (ETag /
+generation / mtime) takes the cache-key slot mtime fills for local files,
+so a *changed* remote model recompiles and an unchanged one doesn't.
 """
 
 from __future__ import annotations
@@ -16,6 +22,7 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
+from flink_jpmml_tpu.api import remote
 from flink_jpmml_tpu.compile import CompiledModel, compile_pmml
 from flink_jpmml_tpu.pmml import parse_pmml_file
 from flink_jpmml_tpu.utils.config import CompileConfig
@@ -34,9 +41,11 @@ class ModelReader:
         config: Optional[CompileConfig] = None,
         warmup: bool = False,
     ) -> CompiledModel:
+        local_path, token = remote.fetch(self.path)
         key = (
-            os.path.abspath(self.path),
-            _mtime(self.path),
+            self.path if remote.is_remote(self.path)
+            else os.path.abspath(local_path),
+            token,
             batch_size,
             config,
         )
@@ -44,20 +53,13 @@ class ModelReader:
             cached = _cache.get(key)
         if cached is not None:
             return cached
-        doc = parse_pmml_file(self.path)
+        doc = parse_pmml_file(local_path)
         model = compile_pmml(doc, batch_size=batch_size, config=config)
         if warmup:
             model.warmup()
         with _cache_lock:
             _cache[key] = model
         return model
-
-
-def _mtime(path: str) -> float:
-    try:
-        return os.stat(path).st_mtime
-    except OSError:
-        return -1.0
 
 
 def clear_model_cache() -> None:

@@ -1,0 +1,213 @@
+"""Remote PMML fetching with a validated local cache (capability C1).
+
+Reference parity: the reference read PMML from any Flink filesystem —
+``file://``, ``hdfs://``, ``s3://``, ``alluxio://`` … (SURVEY.md §1 C1,
+§3 B3). The TPU-native equivalent resolves a model *URI* to a local file
+the parser can read, caching the bytes on disk and re-validating on each
+``load``:
+
+- ``http(s)://`` — stdlib urllib with conditional GET: the cached copy's
+  ``ETag``/``Last-Modified`` ride ``If-None-Match``/``If-Modified-Since``,
+  so an unchanged model costs one 304 round trip, not a re-download.
+- ``gs://`` / ``s3://`` — served through ``google-cloud-storage`` /
+  ``boto3`` when installed (neither is baked into this image); without the
+  optional dependency the scheme fails with a typed, actionable error
+  instead of an ImportError mid-stream. Object generation/etag is the
+  cache validator.
+- ``file://`` and bare paths — passed through untouched.
+
+The cache key is the URI's SHA-256, under ``$FJT_MODEL_CACHE`` (default
+``~/.cache/flink_jpmml_tpu/models``). ``fetch`` returns
+``(local_path, version_token)``; the token changes when the remote object
+changes, so ModelReader's compile cache invalidates exactly when the
+model does.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional, Tuple
+
+from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+_REMOTE_SCHEMES = ("http", "https", "gs", "s3")
+
+
+def is_remote(path: str) -> bool:
+    return urllib.parse.urlsplit(path).scheme in _REMOTE_SCHEMES
+
+
+def cache_dir() -> str:
+    d = os.environ.get("FJT_MODEL_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "flink_jpmml_tpu", "models"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _cache_paths(uri: str) -> Tuple[str, str]:
+    stem = hashlib.sha256(uri.encode()).hexdigest()[:32]
+    base = os.path.join(cache_dir(), stem)
+    return base + ".pmml", base + ".meta"
+
+
+def _read_meta(meta_path: str) -> dict:
+    try:
+        with open(meta_path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _write_atomic(path: str, data: bytes) -> None:
+    # unique temp per writer: concurrent workers fetching the same URI
+    # (the documented deployment) must not interleave into one temp file
+    fd, tmp = tempfile.mkstemp(prefix=".tmp-", dir=os.path.dirname(path))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def fetch(uri: str, timeout_s: float = 30.0) -> Tuple[str, str]:
+    """Resolve ``uri`` to a local file; → (local_path, version_token).
+
+    Local paths pass through with their mtime as the token. Remote URIs
+    are downloaded into the cache (or revalidated against it) and the
+    token is the remote object's ETag / Last-Modified / generation."""
+    parts = urllib.parse.urlsplit(uri)
+    if parts.scheme in ("http", "https"):
+        return _fetch_http(uri, timeout_s)
+    if parts.scheme == "gs":
+        return _fetch_gs(parts)
+    if parts.scheme == "s3":
+        return _fetch_s3(parts)
+    if parts.scheme == "file":
+        local = urllib.request.url2pathname(parts.path)
+        return local, str(_mtime(local))
+    return uri, str(_mtime(uri))
+
+
+def _mtime(path: str) -> float:
+    try:
+        return os.stat(path).st_mtime
+    except OSError:
+        return -1.0
+
+
+def _fetch_http(uri: str, timeout_s: float) -> Tuple[str, str]:
+    local, meta_path = _cache_paths(uri)
+    meta = _read_meta(meta_path) if os.path.exists(local) else {}
+    req = urllib.request.Request(uri)
+    if meta.get("etag"):
+        req.add_header("If-None-Match", meta["etag"])
+    if meta.get("last_modified"):
+        req.add_header("If-Modified-Since", meta["last_modified"])
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            data = resp.read()
+            headers = resp.headers
+    except urllib.error.HTTPError as e:
+        if e.code == 304:  # cached copy still valid
+            return local, meta.get("etag") or meta.get("last_modified") or "cached"
+        raise ModelLoadingException(
+            f"HTTP {e.code} fetching model {uri!r}"
+        ) from e
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        if os.path.exists(local):
+            # remote unreachable but a cached copy exists: serve stale —
+            # the reference's workers likewise kept serving the loaded
+            # model through DFS blips
+            return (
+                local,
+                meta.get("etag") or meta.get("last_modified") or "stale",
+            )
+        raise ModelLoadingException(
+            f"cannot fetch model {uri!r}: {e}"
+        ) from e
+    _write_atomic(local, data)
+    new_meta = {
+        "etag": headers.get("ETag"),
+        "last_modified": headers.get("Last-Modified"),
+        "uri": uri,
+    }
+    _write_atomic(meta_path, json.dumps(new_meta).encode())
+    token = (
+        new_meta["etag"]
+        or new_meta["last_modified"]
+        or hashlib.sha256(data).hexdigest()[:16]
+    )
+    return local, token
+
+
+def _fetch_gs(parts) -> Tuple[str, str]:
+    try:
+        from google.cloud import storage  # type: ignore
+    except ImportError as e:
+        raise ModelLoadingException(
+            "gs:// model paths need the optional dependency "
+            "google-cloud-storage (pip install google-cloud-storage)"
+        ) from e
+    uri = urllib.parse.urlunsplit(parts)
+    local, meta_path = _cache_paths(uri)
+    try:
+        client = storage.Client()
+        blob = client.bucket(parts.netloc).get_blob(parts.path.lstrip("/"))
+        if blob is None:
+            raise ModelLoadingException(f"no such object: {uri!r}")
+        token = str(blob.generation)
+        meta = _read_meta(meta_path)
+        if os.path.exists(local) and meta.get("token") == token:
+            return local, token
+        data = blob.download_as_bytes()
+    except ModelLoadingException:
+        raise
+    except Exception as e:  # credentials, network, API errors → typed
+        raise ModelLoadingException(
+            f"gs fetch failed for {uri!r}: {e}"
+        ) from e
+    _write_atomic(local, data)
+    _write_atomic(meta_path, json.dumps({"token": token, "uri": uri}).encode())
+    return local, token
+
+
+def _fetch_s3(parts) -> Tuple[str, str]:
+    try:
+        import boto3  # type: ignore
+    except ImportError as e:
+        raise ModelLoadingException(
+            "s3:// model paths need the optional dependency boto3 "
+            "(pip install boto3)"
+        ) from e
+    uri = urllib.parse.urlunsplit(parts)
+    local, meta_path = _cache_paths(uri)
+    try:
+        s3 = boto3.client("s3")
+        key = parts.path.lstrip("/")
+        head = s3.head_object(Bucket=parts.netloc, Key=key)
+        token = (
+            head.get("ETag", "").strip('"') or str(head.get("LastModified"))
+        )
+        meta = _read_meta(meta_path)
+        if os.path.exists(local) and meta.get("token") == token:
+            return local, token
+        body = s3.get_object(Bucket=parts.netloc, Key=key)["Body"].read()
+    except Exception as e:  # credentials, network, API errors → typed
+        raise ModelLoadingException(
+            f"s3 fetch failed for {uri!r}: {e}"
+        ) from e
+    _write_atomic(local, body)
+    _write_atomic(meta_path, json.dumps({"token": token, "uri": uri}).encode())
+    return local, token

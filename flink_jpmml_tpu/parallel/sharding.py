@@ -82,9 +82,17 @@ def dp_sharded(model: CompiledModel, mesh: Mesh) -> ShardedModel:
     """
     batch_spec = NamedSharding(mesh, P(DATA_AXIS))
     repl = NamedSharding(mesh, P())
-    params_sharded = jax.device_put(
-        model.params, jax.tree_util.tree_map(lambda _: repl, model.params)
-    )
+
+    def _replicate(x):
+        # make_array_from_callback works when the mesh spans processes
+        # (device_put cannot target non-addressable devices); every host
+        # holds the full params, so any index slice is servable locally
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, repl, lambda idx: arr[idx]
+        )
+
+    params_sharded = jax.tree_util.tree_map(_replicate, model.params)
     inner = model._jit_fn  # the jitted full_fn(params, X, M)
     fn = getattr(inner, "__wrapped__", inner)
     jit_fn = jax.jit(

@@ -41,9 +41,15 @@ from flink_jpmml_tpu.utils.exceptions import (
 
 
 class _WarmTask:
-    """One in-flight background compile: join-able, result-or-error."""
+    """One in-flight background compile: join-able, result-or-error.
 
-    def __init__(self) -> None:
+    ``info`` pins the exact registration (ModelInfo identity) the warm
+    started from — a Del + re-Add with a different path, or a restore(),
+    creates a *new* ModelInfo, so a stale warm's result/error is never
+    attributed to the new registration."""
+
+    def __init__(self, info: ModelInfo) -> None:
+        self.info = info
         self.done = threading.Event()
         self.result: Optional[CompiledModel] = None
         self.error: Optional[BaseException] = None
@@ -124,16 +130,21 @@ class ModelRegistry:
             return mid in self._warming
 
     def ensure_warming(self, mid: ModelId) -> None:
-        """Start (once) a background parse+compile+jit for a served id."""
+        """Start (once per registration) a background parse+compile+jit
+        for a served id. A warm left over from a superseded registration
+        (same id, different ModelInfo) is replaced, not reused."""
         with self._lock:
+            info = self._meta.get(mid)
             if (
-                mid in self._compiled
-                or mid in self._warming
+                info is None
+                or mid in self._compiled
                 or mid in self._warm_failed
-                or mid not in self._meta
             ):
                 return
-            task = _WarmTask()
+            existing = self._warming.get(mid)
+            if existing is not None and existing.info is info:
+                return
+            task = _WarmTask(info)
             self._warming[mid] = task
         t = threading.Thread(
             target=self._warm_one,
@@ -145,24 +156,23 @@ class ModelRegistry:
 
     def _warm_one(self, mid: ModelId, task: _WarmTask) -> None:
         try:
-            with self._lock:
-                info = self._meta.get(mid)
-            if info is None:
-                return  # deleted before the warm started
-            compiled = self._load(info)
+            compiled = self._load(task.info)
             self._prewarm(compiled)
             task.result = compiled
             with self._lock:
-                if mid in self._meta:  # deleted concurrently → don't resurrect
+                # attribute only to the registration this warm started
+                # from — deleted/re-added/restored ids get a fresh warm
+                if self._meta.get(mid) is task.info:
                     self._compiled[mid] = compiled
         except BaseException as e:  # recorded, surfaced via warm_error/model
             task.error = e
             with self._lock:
-                if mid in self._meta:
+                if self._meta.get(mid) is task.info:
                     self._warm_failed[mid] = e
         finally:
             with self._lock:
-                self._warming.pop(mid, None)
+                if self._warming.get(mid) is task:
+                    del self._warming[mid]
             task.done.set()
 
     def _load(self, info: ModelInfo) -> CompiledModel:
@@ -205,14 +215,15 @@ class ModelRegistry:
             raise ModelLoadingException(
                 f"background compile of {mid.key()} failed: {failed!r}"
             ) from failed
-        if task is not None:
+        if task is not None and task.info is info:
             task.done.wait()
             if task.error is not None:
                 return self.model(mid)  # re-enter to raise the recorded error
             return task.result
         compiled = self._load(info)
         with self._lock:
-            if mid in self._meta:  # deleted concurrently → don't resurrect
+            # attribute only to this registration (see _warm_one)
+            if self._meta.get(mid) is info:
                 self._compiled[mid] = compiled
         return compiled
 

@@ -166,6 +166,34 @@ class TestRegistryWarmup:
         # ready without ever calling the blocking model() path
         assert reg2.model_if_warm(mid) is not None
 
+    def test_readd_with_new_path_not_served_by_stale_warm(self, tmp_path):
+        """Del + re-Add of the same (name, version) with a different path
+        while the old path's warm is in flight: the stale warm's result
+        must not be attributed to the new registration."""
+        from flink_jpmml_tpu.models.control import DelMessage
+
+        old = _write_const(tmp_path, "old.pmml", 1.0)
+        new = _write_const(tmp_path, "new.pmml", 2.0)
+        reg = ModelRegistry(batch_size=4)
+        _slow_loader(reg, "old", 0.4)
+        mid = ModelId("m", 1)
+
+        reg.apply(AddMessage("m", 1, old, timestamp=1.0))
+        assert reg.is_warming(mid)
+        reg.apply(DelMessage("m", 1, timestamp=2.0))
+        reg.apply(AddMessage("m", 1, new, timestamp=3.0))  # same id, new path
+        _wait_warm(reg, mid)
+        deadline = time.monotonic() + 10.0
+        # let the stale old-path warm finish too, then check attribution
+        while reg.is_warming(mid) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.5)  # past the old warm's sleep
+        model = reg.model(mid)
+        [pred] = model.score_records([{"a": 0.0}])
+        assert pred.score.value == pytest.approx(2.0), (
+            "stale warm's artifact served for the re-added registration"
+        )
+
     def test_delete_during_warm_does_not_resurrect(self, tmp_path):
         from flink_jpmml_tpu.models.control import DelMessage
 
