@@ -191,6 +191,11 @@ class QuantizedScorer:
                     self._jit_fn(self.params, Xq[i : i + bs])
                     for i in range(0, Xq.shape[0], bs)
                 ]
+                if isinstance(outs[0], tuple):  # classification triple
+                    return tuple(
+                        jnp.concatenate([o[k] for o in outs], axis=0)
+                        for k in range(len(outs[0]))
+                    )
                 return jnp.concatenate(outs, axis=0)
         return self._jit_fn(self.params, Xq)
 
@@ -270,8 +275,9 @@ def build_quantized_scorer(
     lowering). Raises only on malformed documents.
 
     ``backend``: "auto" picks the Pallas VMEM-resident kernel
-    (qtrees_pallas.py) on TPU when eligible (uint8 wire, linear aggregate,
-    fixed batch), the XLA einsum path otherwise; "xla"/"pallas" force one.
+    (qtrees_pallas.py) on TPU when eligible (uint8 wire, fixed batch, and
+    a linear regression aggregate or a majority-vote classification
+    forest), the XLA einsum path otherwise; "xla"/"pallas" force one.
     ``pallas_interpret`` runs the kernel in interpreter mode (CPU tests).
     """
     config = config or CompileConfig()
@@ -498,16 +504,21 @@ def build_quantized_scorer(
             value = apply_targets_value(value, targets)
             return value.astype(jnp.float32), probs.astype(jnp.float32), lab
 
-    # Pallas VMEM-resident kernel: eligible for the uint8 wire with a linear
-    # aggregate and a fixed batch that tiles into blocks (the GBM hot path)
+    # Pallas VMEM-resident kernel: uint8 wire + fixed batch, with either a
+    # linear regression aggregate (the GBM hot path) or a classification
+    # vote forest (majorityVote — per-leaf class rows contract in-kernel)
     want_pallas = backend in ("auto", "pallas")
-    can_pallas = (
+    pallas_env = (
         dtype is np.uint8
-        and fused_linear
         and batch_size is not None
         and (not on_cpu or pallas_interpret)
     )
-    if want_pallas and can_pallas:
+    pallas_cls = classification and method in (
+        "majorityVote", "weightedMajorityVote"
+    )
+    if want_pallas and pallas_env and (
+        (not classification and fused_linear) or pallas_cls
+    ):
         from flink_jpmml_tpu.compile import qtrees_pallas
 
         groups = qtrees_pallas.pack_groups(
@@ -516,17 +527,31 @@ def build_quantized_scorer(
             dleft=np.asarray(dleft),
             P=params["P_i8"],
             count=params["count_i8"],
-            vals=vals * coef[:, None],
+            vals=probs_tbl if classification else vals * coef[:, None],
             n_fields=F,
         )
         raw = qtrees_pallas.build_pallas_fn(
             groups, batch_size, F, sentinel, interpret=pallas_interpret
         )
         if raw is not None:
-            def pqfn(gp, Xq):
-                return apply_targets_value(raw(gp, Xq), targets).astype(
-                    jnp.float32
-                )
+            if classification:
+                def pqfn(gp, Xq):
+                    probs = raw(gp, Xq)  # [B, C] vote shares
+                    lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+                    value = jnp.take_along_axis(
+                        probs, lab[:, None], axis=1
+                    )[:, 0]
+                    value = apply_targets_value(value, targets)
+                    return (
+                        value.astype(jnp.float32),
+                        probs.astype(jnp.float32),
+                        lab,
+                    )
+            else:
+                def pqfn(gp, Xq):
+                    return apply_targets_value(raw(gp, Xq), targets).astype(
+                        jnp.float32
+                    )
 
             return QuantizedScorer(
                 wire=wire,
@@ -539,6 +564,7 @@ def build_quantized_scorer(
                     donate_argnums=(1,) if config.donate_batches else (),
                 ),
                 backend="pallas",
+                labels=packed.labels if classification else (),
             )
     if backend == "pallas":
         return None  # forced pallas but not eligible

@@ -26,9 +26,14 @@ and scores out:
 
 Per-record HBM traffic: 32B of codes in, 4B of score out, params once per
 call — vs ~100KB/rec for the XLA path. Eligibility: uint8 wire only
-(uint16 ranks are not bf16-exact) and linear aggregates (sum/average/
-weightedAverage/single, whose coefficients fold into leaf values).
-Everything else stays on the XLA path.
+(uint16 ranks up to 65534 are not exactly representable in bf16, so the
+one-hot select matmul would corrupt them; carrying the codes as f32 would
+halve the MXU rate — such models stay on the XLA int-einsum path), and
+either a linear regression aggregate (sum/average/weightedAverage/single,
+whose coefficients fold into leaf values → scalar scores) or a
+classification *vote* forest (majorityVote/weightedMajorityVote, whose
+normalised vote weights fold into per-leaf class rows → [B, C] vote
+shares, argmaxed outside the kernel). Everything else stays on XLA.
 
 Correctness is tested in interpret mode on CPU against the XLA quantized
 path and the f32 reference (tests/test_qtrees_pallas.py).
@@ -57,7 +62,8 @@ def pack_groups(
     dleft: np.ndarray,    # bool[T, S]
     P: np.ndarray,        # i8[T, S, L]
     count: np.ndarray,    # i8[T, L]
-    vals: np.ndarray,     # f32[T, L] (aggregate coefficients folded in)
+    vals: np.ndarray,     # f32[T, L] scalar leaf values, or f32[T, L, C]
+                          # per-leaf class rows (vote weights folded in)
     n_fields: int,
 ) -> Dict[str, np.ndarray]:
     """Group-pack the per-tree tensors for the kernel (numpy, host-side)."""
@@ -75,7 +81,7 @@ def pack_groups(
     dleftp[:T] = dleft.astype(np.float32)
     countp = np.full((Tp, L), -5.0, np.float32)  # padded trees never match
     countp[:T] = count.astype(np.float32)
-    valsp = np.zeros((Tp, L), np.float32)
+    valsp = np.zeros((Tp,) + vals.shape[1:], np.float32)
     valsp[:T] = vals
 
     # one-hot feature selector [G, F, Sg] (bf16 operand of the select dot)
@@ -95,7 +101,9 @@ def pack_groups(
         "dleft": dleftp.reshape(G, Sg),
         "Pg": Pg,
         "count": countp.reshape(G, Lg),
-        "vals": valsp.reshape(G, Lg),
+        # Tp is G*GT contiguous, so collapsing (G, GT, L, …) → (G, Lg, …)
+        # keeps each group's leaves in block order
+        "vals": valsp.reshape((G, Lg) + valsp.shape[2:]),
     }
 
 
@@ -103,9 +111,9 @@ def param_bytes(groups: Dict[str, np.ndarray]) -> int:
     return sum(np.asarray(v).nbytes for v in groups.values())
 
 
-def _kernel(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
-            vals_ref, out_ref, *, sentinel: float):
-    j = pl.program_id(1)
+def _leaf_hits(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
+               j, sentinel: float):
+    """Shared front half: rank codes → [Bblk, Lg] leaf one-hot (f32)."""
     xq = xq_ref[...]                                   # [Bblk, F] bf16
     xv = jnp.dot(
         xq, fsel_ref[j], preferred_element_type=jnp.float32
@@ -120,8 +128,37 @@ def _kernel(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
         sign, p_ref[j].astype(jnp.bfloat16),
         preferred_element_type=jnp.float32,
     )                                                  # [Bblk, Lg]
-    hit = (acc == count_ref[pl.ds(j, 1), :]).astype(jnp.float32)
+    return (acc == count_ref[pl.ds(j, 1), :]).astype(jnp.float32)
+
+
+def _kernel(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
+            vals_ref, out_ref, *, sentinel: float):
+    j = pl.program_id(1)
+    hit = _leaf_hits(
+        xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref, j, sentinel
+    )
     part = jnp.sum(hit * vals_ref[pl.ds(j, 1), :], axis=1)  # [Bblk] f32
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[...] = part
+
+    @pl.when(j > 0)
+    def _():
+        out_ref[...] = out_ref[...] + part
+
+
+def _kernel_cls(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
+                vals_ref, out_ref, *, sentinel: float):
+    """Classification votes: per-leaf class rows contract to [Bblk, C]
+    vote-share partials, accumulated over tree groups."""
+    j = pl.program_id(1)
+    hit = _leaf_hits(
+        xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref, j, sentinel
+    )
+    part = jnp.dot(
+        hit, vals_ref[j], preferred_element_type=jnp.float32
+    )                                                  # [Bblk, C]
 
     @pl.when(j == 0)
     def _():
@@ -140,8 +177,9 @@ def build_pallas_fn(
     block_b: int = 1024,
     interpret: bool = False,
 ):
-    """→ fn(group_params, Xq u8[B, F]) -> f32[B] ensemble sums, or None
-    when the shapes don't fit this kernel (caller falls back to XLA)."""
+    """→ fn(group_params, Xq u8[B, F]) -> f32[B] ensemble sums (scalar
+    ``vals``) or f32[B, C] vote shares (class-row ``vals``), or None when
+    the shapes don't fit this kernel (caller falls back to XLA)."""
     G = groups["fsel"].shape[0]
     if param_bytes(groups) > _VMEM_PARAM_BUDGET:
         return None
@@ -157,8 +195,19 @@ def build_pallas_fn(
         return None
     nb = batch_size // block_b
 
-    kern = functools.partial(_kernel, sentinel=float(sentinel))
+    classification = groups["vals"].ndim == 3
     F = n_fields
+    if classification:
+        C = groups["vals"].shape[2]
+        kern = functools.partial(_kernel_cls, sentinel=float(sentinel))
+        vals_spec = pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0, 0))
+        out_specs = pl.BlockSpec((block_b, C), lambda i, j: (i, 0))
+        out_shape = jax.ShapeDtypeStruct((batch_size, C), jnp.float32)
+    else:
+        kern = functools.partial(_kernel, sentinel=float(sentinel))
+        vals_spec = pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0))
+        out_specs = pl.BlockSpec((block_b,), lambda i, j: (i,))
+        out_shape = jax.ShapeDtypeStruct((batch_size,), jnp.float32)
 
     call = pl.pallas_call(
         kern,
@@ -170,10 +219,10 @@ def build_pallas_fn(
             pl.BlockSpec(groups["dleft"].shape, lambda i, j: (0, 0)),
             pl.BlockSpec(groups["Pg"].shape, lambda i, j: (0, 0, 0)),
             pl.BlockSpec(groups["count"].shape, lambda i, j: (0, 0)),
-            pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0)),
+            vals_spec,
         ],
-        out_specs=pl.BlockSpec((block_b,), lambda i, j: (i,)),
-        out_shape=jax.ShapeDtypeStruct((batch_size,), jnp.float32),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )
 

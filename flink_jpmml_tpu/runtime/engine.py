@@ -102,6 +102,11 @@ class StaticScorer(Scorer):
         # scores through the same f32 predict contract.
         probe = getattr(model, "quantized_scorer", None)
         self._q = probe() if (use_quantized and probe is not None) else None
+        # which scoring backend this scorer engages (surfaced in the
+        # pipeline's metrics as scorer_backend_*)
+        self.backend = (
+            f"rank_wire_{self._q.backend}" if self._q is not None else "f32"
+        )
 
     def _extract_records(self, records: Sequence[Any]):
         first = records[0]
@@ -152,6 +157,9 @@ class Pipeline:
         self._sink = sink
         self._config = config or RuntimeConfig()
         self.metrics = metrics or MetricsRegistry()
+        backend = getattr(scorer, "backend", None)
+        if backend:
+            self.metrics.counter(f"scorer_backend_{backend}").inc()
         self._ckpt = CheckpointPolicy(
             checkpoint, self._config.checkpoint_interval_s
         )
