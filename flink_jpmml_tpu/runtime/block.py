@@ -310,17 +310,20 @@ class BlockPipeline:
         self.join(timeout=30.0)
 
     def run_until_exhausted(self, timeout: float = 60.0) -> None:
+        """Deterministic drain: join the ingest thread (exits once the
+        source is exhausted and fully pushed), then close the ring — the
+        score loop drains the ring's remainder plus its in-flight window
+        before exiting. No sleep-based settle windows."""
         self.start()
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self._source.exhausted and len(self._ring) == 0:
+        ingest = self._threads[0]
+        while ingest.is_alive() and self._error is None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 break
-            if self._error is not None:
-                break
-            time.sleep(0.005)
-        time.sleep(0.05)
+            ingest.join(timeout=min(remaining, 0.05))
         self.stop()
-        self.join(timeout=30.0)
+        self.join(timeout=max(30.0, deadline - time.monotonic()))
 
     # -- internals ---------------------------------------------------------
 

@@ -213,19 +213,26 @@ class Pipeline:
             raise self._error
 
     def run_until_exhausted(self, timeout: float = 60.0) -> None:
-        """Test/batch helper: process the whole (finite) source, then stop."""
+        """Test/batch helper: process the whole (finite) source, then stop.
+
+        Deterministic drain (no sleep windows): the ingest thread exits on
+        its own once the source is exhausted and every record is enqueued;
+        only then is the queue closed. ``BoundedQueue.drain`` keeps serving
+        remaining items after close, so the score loop consumes everything
+        in the queue, then its in-flight window, then exits — zero records
+        can be lost regardless of how slow the scorer is.
+        """
         self.start()
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self._source.exhausted and len(self._queue) == 0:
+        assert self._ingest_thread is not None
+        while self._ingest_thread.is_alive() and self._error is None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 break
-            if self._error is not None:
-                break
-            time.sleep(0.005)
-        # let the scorer drain its in-flight window
-        time.sleep(0.05)
-        self.stop()
-        self.join(timeout=10.0)
+            self._ingest_thread.join(timeout=min(remaining, 0.05))
+        self._stop.set()
+        self._queue.close()
+        self.join(timeout=max(10.0, deadline - time.monotonic()))
 
     @property
     def committed_offset(self) -> int:
