@@ -32,6 +32,7 @@ import tempfile
 import urllib.error
 import urllib.parse
 import urllib.request
+import warnings
 from typing import Optional, Tuple
 
 from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
@@ -129,7 +130,14 @@ def _fetch_http(uri: str, timeout_s: float) -> Tuple[str, str]:
         if os.path.exists(local):
             # remote unreachable but a cached copy exists: serve stale —
             # the reference's workers likewise kept serving the loaded
-            # model through DFS blips
+            # model through DFS blips. Loudly: an operator must be able to
+            # tell that workers are running a possibly-outdated model.
+            warnings.warn(
+                f"model source {uri!r} unreachable ({e}); serving the "
+                "possibly-stale cached copy",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             return (
                 local,
                 meta.get("etag") or meta.get("last_modified") or "stale",
@@ -204,6 +212,8 @@ def _fetch_s3(parts) -> Tuple[str, str]:
         if os.path.exists(local) and meta.get("token") == token:
             return local, token
         body = s3.get_object(Bucket=parts.netloc, Key=key)["Body"].read()
+    except ModelLoadingException:
+        raise
     except Exception as e:  # credentials, network, API errors → typed
         raise ModelLoadingException(
             f"s3 fetch failed for {uri!r}: {e}"

@@ -19,11 +19,19 @@ Protocol (little-endian):
       kind 3 (JSON records): u64 first_offset, u32 count,
                              newline-joined JSON docs
 
-Offsets are *record* offsets and monotone. A client (re)connects at its
-next-needed offset and the server replays from there — the Kafka consumer
-model in miniature. Client-side reconnect is automatic: a dropped
-connection (server restart, network blip) retries with backoff from the
-exact next offset, so no record is lost or duplicated across the blip.
+Offset domain (ONE domain end to end — frames, sources, checkpoints):
+an offset k always means "k records consumed"; equivalently, the next
+record to serve/score has 0-based index k. A frame's ``first_offset`` is
+the consumed-count *before* its first record (= that record's index), and
+the offset checkpointed after scoring a record of index i is ``i + 1``
+(see :func:`consumed_offset` — the only index→offset conversion in this
+module). ``seek(k)`` therefore passes a checkpointed engine offset to the
+frame protocol *unchanged*: both mean "resume at record index k". A
+client (re)connects at its next-needed offset and the server replays from
+there — the Kafka consumer model in miniature. Client-side reconnect is
+automatic: a dropped connection (server restart, network blip) retries
+with backoff from the exact next offset, so no record is lost or
+duplicated across the blip.
 """
 
 from __future__ import annotations
@@ -51,6 +59,15 @@ _REC_HDR = struct.Struct("<BQI")  # kind, first_offset, count
 _REQ = struct.Struct("<4sQ")  # magic, start_offset
 
 
+def consumed_offset(record_index: int) -> int:
+    """Record index → checkpoint offset ("records consumed through this
+    record"). The inverse direction needs no conversion: a checkpointed
+    offset k IS the index of the next record, so ``seek(k)`` forwards k
+    to the frame protocol verbatim. This is the single place the two
+    representations of the one offset domain meet (module docstring)."""
+    return record_index + 1
+
+
 class BlockFrameServer:
     """Serves a replayable record log over the frame protocol.
 
@@ -68,7 +85,11 @@ class BlockFrameServer:
         port: int = 0,
         cycle: bool = False,
         throttle_s: float = 0.0,
+        host: str = "127.0.0.1",
     ):
+        """``host`` is the bind interface — default loopback for tests;
+        pass ``"0.0.0.0"`` (or a specific NIC address) to serve remote
+        workers in a multi-host deployment."""
         self._throttle = throttle_s
         if isinstance(data, np.ndarray):
             self._arr: Optional[np.ndarray] = np.ascontiguousarray(
@@ -84,7 +105,7 @@ class BlockFrameServer:
         self._cycle = cycle
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("127.0.0.1", port))
+        self._sock.bind((host, port))
         self._sock.listen(8)
         self._sock.settimeout(0.2)
         self.port = self._sock.getsockname()[1]
@@ -193,9 +214,16 @@ class _FrameClient:
         self._sock: Optional[socket.socket] = None
         self._buf = bytearray()
         self._poll_timeout = poll_timeout
+        # adaptive idle backoff: each consecutive empty read doubles the
+        # socket timeout (up to _IDLE_TIMEOUT_MAX); any data resets it.
+        # Callers that spin on None therefore cost ~20 wakeups/s against
+        # an idle or dead server instead of ~500/s at the base timeout.
+        self._idle_timeout = poll_timeout
         self._last_retry = 0.0
         self.next_offset = 0
         self.eos = False
+
+    _IDLE_TIMEOUT_MAX = 0.05
 
     def seek(self, offset: int) -> None:
         self.next_offset = int(offset)
@@ -222,7 +250,7 @@ class _FrameClient:
         self._last_retry = now
         try:
             s = socket.create_connection(self._addr, timeout=1.0)
-            s.settimeout(self._poll_timeout)
+            s.settimeout(self._idle_timeout)
             s.sendall(_REQ.pack(MAGIC, self.next_offset))
             self._sock = s
             return True
@@ -246,6 +274,9 @@ class _FrameClient:
                             self._buf[_HDR.size : _HDR.size + body_len]
                         )
                         del self._buf[: _HDR.size + body_len]
+                        if self._idle_timeout != self._poll_timeout:
+                            self._idle_timeout = self._poll_timeout
+                            self._sock.settimeout(self._idle_timeout)
                         return body
                 chunk = self._sock.recv(1 << 20)
                 if not chunk:
@@ -253,6 +284,13 @@ class _FrameClient:
                     return None
                 self._buf.extend(chunk)
         except socket.timeout:
+            self._idle_timeout = min(
+                self._idle_timeout * 2, self._IDLE_TIMEOUT_MAX
+            )
+            try:
+                self._sock.settimeout(self._idle_timeout)
+            except OSError:
+                pass
             return None
         except OSError:
             self._disconnect()
@@ -334,12 +372,13 @@ class TcpRecordSource(Source):
             _, first, count = _REC_HDR.unpack_from(body, 0)
             lines = body[_REC_HDR.size :].decode().split("\n")
             for i, line in enumerate(lines[:count]):
-                # engine offsets are 1-based "records consumed" counts
-                out.append((first + i + 1, json.loads(line)))
+                out.append((consumed_offset(first + i), json.loads(line)))
             self._client.next_offset = first + count
         return out
 
     def seek(self, offset: int) -> None:
+        # checkpointed offset k == index of the next record: one domain,
+        # forwarded verbatim (module docstring / consumed_offset)
         self._client.seek(offset)
 
     @property

@@ -24,6 +24,7 @@ hot before the first event arrives.
 from __future__ import annotations
 
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 import numpy as np
@@ -61,6 +62,8 @@ class ModelRegistry:
         batch_size: Optional[int] = None,
         compile_config: Optional[CompileConfig] = None,
         async_warmup: bool = True,
+        warm_workers: int = 3,
+        warm_join_timeout_s: float = 300.0,
     ):
         self._meta: managers.Metadata = {}
         self._compiled: Dict[ModelId, CompiledModel] = {}
@@ -70,6 +73,14 @@ class ModelRegistry:
         self._batch_size = batch_size
         self._compile_config = compile_config
         self._async = async_warmup
+        # warms run on a small bounded pool, not a thread per model: a
+        # restore() of a registry serving many models must not trigger a
+        # simultaneous parse+compile+jit storm
+        self._warm_workers = max(1, warm_workers)
+        self._warm_pool: Optional[ThreadPoolExecutor] = None
+        # bounded join for in-flight warms (a wedged backend init must
+        # surface as ModelLoadingException, not hang the scoring thread)
+        self._warm_join_timeout_s = warm_join_timeout_s
 
     def apply(self, msg: ServingMessage) -> bool:
         """Apply one control message; returns True if the registry changed.
@@ -146,13 +157,13 @@ class ModelRegistry:
                 return
             task = _WarmTask(info)
             self._warming[mid] = task
-        t = threading.Thread(
-            target=self._warm_one,
-            args=(mid, task),
-            name=f"fjt-warm-{mid.key()}",
-            daemon=True,
-        )
-        t.start()
+            if self._warm_pool is None:
+                self._warm_pool = ThreadPoolExecutor(
+                    max_workers=self._warm_workers,
+                    thread_name_prefix="fjt-warm",
+                )
+            pool = self._warm_pool
+        pool.submit(self._warm_one, mid, task)
 
     def _warm_one(self, mid: ModelId, task: _WarmTask) -> None:
         try:
@@ -216,7 +227,12 @@ class ModelRegistry:
                 f"background compile of {mid.key()} failed: {failed!r}"
             ) from failed
         if task is not None and task.info is info:
-            task.done.wait()
+            if not task.done.wait(self._warm_join_timeout_s):
+                raise ModelLoadingException(
+                    f"background warm of {mid.key()} did not complete "
+                    f"within {self._warm_join_timeout_s:.0f}s (wedged "
+                    "compile or backend init); model quarantined for now"
+                )
             if task.error is not None:
                 return self.model(mid)  # re-enter to raise the recorded error
             return task.result
