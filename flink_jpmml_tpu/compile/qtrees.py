@@ -521,13 +521,21 @@ def build_quantized_scorer(
     ):
         from flink_jpmml_tpu.compile import qtrees_pallas
 
+        # contract the same bf16 hi+lo reconstructed tables as the XLA
+        # path (phi+plo / vhi+vlo), not the raw f32 ones — otherwise
+        # argmax tie-breaks on near-equal vote shares could differ
+        # between backends for the same model
+        if classification:
+            vals_tbl = phi.astype(np.float32) + plo.astype(np.float32)
+        else:
+            vals_tbl = vhi.astype(np.float32) + vlo.astype(np.float32)
         groups = qtrees_pallas.pack_groups(
             feat=params["feat"].astype(np.int64),
             qthr=qthr,
             dleft=np.asarray(dleft),
             P=params["P_i8"],
             count=params["count_i8"],
-            vals=probs_tbl if classification else vals * coef[:, None],
+            vals=vals_tbl,
             n_fields=F,
         )
         raw = qtrees_pallas.build_pallas_fn(

@@ -251,6 +251,10 @@ class BlockPipeline:
         )
         self.metrics.counter(f"scorer_backend_{self.backend}").inc()
         self._in_flight_max = max(1, in_flight)
+        # see engine.Pipeline: True only for run_until_exhausted's full
+        # drain; plain stop() discards the uncommitted ring backlog so it
+        # returns promptly under a flooding source
+        self._drain_all = False
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._error: Optional[BaseException] = None
@@ -322,6 +326,7 @@ class BlockPipeline:
             if remaining <= 0:
                 break
             ingest.join(timeout=min(remaining, 0.05))
+        self._drain_all = True
         self.stop()
         self.join(timeout=max(30.0, deadline - time.monotonic()))
 
@@ -369,6 +374,8 @@ class BlockPipeline:
 
         try:
             while True:
+                if self._stop.is_set() and not self._drain_all:
+                    break  # stop(): skip the uncommitted backlog
                 X, offsets = self._ring.drain(batch_cfg.deadline_us)
                 n = X.shape[0]
                 if n == 0:

@@ -166,6 +166,13 @@ class Pipeline:
         self._in_flight_max = max(1, in_flight)
         self._queue = BoundedQueue(self._config.batch.queue_capacity)
         self._stop = threading.Event()
+        # run_until_exhausted sets this: the score loop then consumes the
+        # whole queued backlog after close. A plain stop() leaves it False
+        # — queued-but-uncommitted records are discarded (they replay from
+        # the committed offset on restore), so stop() returns promptly
+        # even under a flooding source instead of draining for minutes
+        # and leaving a busy daemon thread behind at interpreter exit.
+        self._drain_all = False
         self._ingest_thread: Optional[threading.Thread] = None
         self._score_thread: Optional[threading.Thread] = None
         self._committed_offset = 0
@@ -230,6 +237,7 @@ class Pipeline:
             if remaining <= 0:
                 break
             self._ingest_thread.join(timeout=min(remaining, 0.05))
+        self._drain_all = True
         self._stop.set()
         self._queue.close()
         self.join(timeout=max(10.0, deadline - time.monotonic()))
@@ -290,6 +298,8 @@ class Pipeline:
 
         try:
             while True:
+                if self._stop.is_set() and not self._drain_all:
+                    break  # stop(): skip the uncommitted backlog
                 try:
                     stamped = self._queue.drain(
                         batch_cfg.size, batch_cfg.deadline_us

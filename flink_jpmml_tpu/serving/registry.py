@@ -82,6 +82,10 @@ class ModelRegistry:
         # surface as ModelLoadingException, not hang the scoring thread)
         self._warm_join_timeout_s = warm_join_timeout_s
 
+    @property
+    def async_warmup(self) -> bool:
+        return self._async
+
     def apply(self, msg: ServingMessage) -> bool:
         """Apply one control message; returns True if the registry changed.
         An accepted Add immediately starts warming the new version in the

@@ -57,9 +57,17 @@ class DynamicScorer(Scorer):
         compile_config: Optional[CompileConfig] = None,
         emit_pairs: bool = True,
         emit: Optional[Callable[[Sequence[Any], List[Prediction]], List[Any]]] = None,
+        async_warmup: bool = True,
     ):
+        """``async_warmup=False`` disables background warming: a newly
+        Added model compiles synchronously inside ``submit`` on its first
+        matching event (the reference's operator-blocking lazy load) —
+        kept for comparison/tests; the default never stalls the batch
+        loop on a compile."""
         self.registry = ModelRegistry(
-            batch_size=batch_size, compile_config=compile_config
+            batch_size=batch_size,
+            compile_config=compile_config,
+            async_warmup=async_warmup,
         )
         self._control = control
         self._route = route or default_route
@@ -98,7 +106,18 @@ class DynamicScorer(Scorer):
             else:
                 mid = self.registry.resolve(name, version)
                 key = mid.key() if mid else None
-                if mid is not None:
+                if mid is not None and not self.registry.async_warmup:
+                    # warming disabled: reference-style lazy load — the
+                    # compile happens synchronously in the operator, and
+                    # the batch loop stalls for it (the cost async_warmup
+                    # exists to avoid; see tests/test_async_serving.py SLO)
+                    if mid not in self._failed:
+                        try:
+                            model = self.registry.model(mid)
+                        except FlinkJpmmlTpuError:
+                            self._failed.add(mid)
+                            model = None
+                elif mid is not None:
                     # double-buffered swap (SURVEY §8(d)): a ready model is
                     # used as-is; while a *new* version is still compiling
                     # in the background (or failed to), unpinned events
