@@ -1,328 +1,9 @@
-"""Benchmark: 500-tree GBM scoring throughput on one TPU chip.
+#!/usr/bin/env python
+"""Driver entry: one JSON line of benchmark capture (see
+flink_jpmml_tpu/bench.py for the measurement itself; installed
+deployments get the same via the ``fjt-bench`` console script)."""
 
-BASELINE config 2 / north star: "score a 500-tree GBM PMML over a stream at
->= 1M records/sec with no CPU evaluator in the hot path". The reference
-(flink-jpmml) walks every tree per record on the CPU inside
-JPMML-Evaluator; here scoring is three int8/bf16 einsums on the MXU and the
-stream crosses the host↔device link as per-feature threshold *ranks*
-(uint8 — the rank wire of compile/qtrees.py, bit-exact with f32 scoring),
-so a 32-feature record costs 32 bytes in and 2 bytes (bf16 score) out.
-
-Measured: the full streaming pipeline in steady state —
-  host featurize (f32 → rank codes, thread pool, standing in for the C++
-  ingest plane) → host→device transfer → jitted ensemble scoring →
-  device→host score readback — with a bounded in-flight window exactly
-  like the streaming runtime. Compile and warmup excluded. Every score
-  batch is materialized on the host before it counts.
-
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
-vs_baseline is the ratio against the 1M rec/s north-star target
-(the reference publishes no numbers of its own - BASELINE.md). The line
-also carries "device_value" — the pure device-side scoring rate with the
-batch already resident — and "backend". When the TPU backend cannot be
-initialized within the bounded probe (retries with hard per-attempt
-timeouts), the bench falls back to the CPU backend at diagnostic scale and
-still prints a capture with "backend": "cpu-fallback" and an "error" field
-describing the TPU failure (exit 0 — a labelled number beats an empty
-artifact, which is what round 1 recorded). Only a wedged in-process init
-after a *successful* probe produces "value": 0 + non-zero exit, via the
-watchdog, and that too within a bounded time.
-"""
-
-import argparse
-import collections
-import json
-import os
-import pathlib
-import subprocess
-import sys
-import tempfile
-import threading
-import time
-from concurrent.futures import ThreadPoolExecutor
-
-sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
-
-NORTH_STAR_REC_S = 1_000_000.0
-
-
-def _fail_line(metric: str, error: str) -> None:
-    print(json.dumps({
-        "metric": metric,
-        "value": 0.0,
-        "unit": "records/s/chip",
-        "vs_baseline": 0.0,
-        "error": error,
-    }), flush=True)
-
-
-def probe_backend(attempts: int, timeout_s: float):
-    """Bounded out-of-process backend probe, retried with backoff.
-
-    A wedged PJRT init cannot be interrupted from inside the process, so
-    the probe runs ``jax.default_backend()`` in a child with a hard
-    timeout. Returns ``(backend_name, None)`` on success or
-    ``(None, error)`` once every attempt has failed — the caller then
-    falls back to a clearly-labelled CPU capture rather than recording
-    nothing (the round-1 BENCH artifact was rc=1 with no number at all)."""
-    err = "unknown"
-    for k in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.default_backend())"],
-                capture_output=True, text=True, timeout=timeout_s,
-            )
-            if r.returncode == 0 and r.stdout.strip():
-                return r.stdout.strip().splitlines()[-1], None
-            err = (r.stderr or "backend probe failed").strip()[-500:]
-        except subprocess.TimeoutExpired:
-            err = f"backend init exceeded {timeout_s:.0f}s (attempt {k + 1})"
-        if k + 1 < attempts:
-            time.sleep(min(5.0 * (k + 1), 15.0))
-    return None, f"backend unavailable after {attempts} attempts: {err}"
-
-
-def arm_watchdog(metric: str, timeout_s: float) -> dict:
-    """Belt to the probe's braces: if the *parent's* own backend init still
-    wedges (tunnel raced between probe and init), emit the diagnostic line
-    and hard-exit instead of hanging the driver."""
-    state = {"ready": False}
-
-    def run():
-        t0 = time.monotonic()
-        while time.monotonic() - t0 < timeout_s:
-            if state["ready"]:
-                return
-            time.sleep(1.0)
-        _fail_line(metric, f"in-process backend init wedged > {timeout_s:.0f}s")
-        os._exit(1)
-
-    threading.Thread(target=run, daemon=True).start()
-    return state
-
-
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--trees", type=int, default=500)
-    ap.add_argument("--depth", type=int, default=6)
-    ap.add_argument("--features", type=int, default=32)
-    ap.add_argument("--batch", type=int, default=262144,
-                    help="records per dispatch (scored in --chunk chunks)")
-    ap.add_argument("--chunk", type=int, default=16384)
-    ap.add_argument("--window", type=int, default=2,
-                    help="batches in flight before blocking on readback")
-    ap.add_argument("--seconds", type=float, default=4.0)
-    ap.add_argument("--f32-wire", action="store_true",
-                    help="ship raw f32 features instead of the rank wire")
-    ap.add_argument("--probe-timeout", type=float, default=100.0,
-                    help="per-attempt backend probe bound (seconds)")
-    ap.add_argument("--probe-attempts", type=int, default=3)
-    ap.add_argument("--block-pipeline", action="store_true",
-                    help="measure through the production BlockPipeline "
-                         "(ring + rank wire) instead of the hand loop — "
-                         "the engine-vs-bench parity check")
-    args = ap.parse_args()
-
-    metric = f"gbm{args.trees}_records_per_sec_per_chip"
-    backend, probe_err = probe_backend(args.probe_attempts, args.probe_timeout)
-    watchdog = arm_watchdog(metric, 2.0 * args.probe_timeout)
-
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    if backend is None:
-        # TPU tunnel down: capture a CPU number, clearly labelled, instead
-        # of an empty artifact. The env-var route is ignored by the axon
-        # plugin in this image; the config API works (tests/conftest.py).
-        jax.config.update("jax_platforms", "cpu")
-        backend = "cpu-fallback"
-    if backend.startswith("cpu"):
-        # full-size dispatches would allocate GBs of einsum intermediates
-        # on the CPU backend; shrink to a diagnostic-scale workload (also
-        # when the machine simply has no TPU and the probe reported "cpu")
-        args.chunk = min(args.chunk, 1024)
-        args.batch = min(args.batch, 8 * args.chunk)
-        args.seconds = min(args.seconds, 3.0)
-    # keep the dispatch/chunk contract valid for any flag combination
-    args.batch = max(args.chunk, (args.batch // args.chunk) * args.chunk)
-
-    jax.devices()  # force backend init under the watchdog, not mid-compile
-    watchdog["ready"] = True
-
-    from assets.generate import gen_gbm
-    from flink_jpmml_tpu.compile import compile_pmml
-    from flink_jpmml_tpu.pmml import parse_pmml_file
-
-    cache_dir = os.path.join(
-        tempfile.gettempdir(),
-        f"fjt-bench-{args.trees}x{args.depth}x{args.features}-h254",
-    )
-    os.makedirs(cache_dir, exist_ok=True)
-    pmml = os.path.join(cache_dir, f"gbm_{args.trees}.pmml")
-    if not os.path.exists(pmml):
-        gen_gbm(
-            cache_dir,
-            n_trees=args.trees,
-            depth=args.depth,
-            n_features=args.features,
-        )
-    doc = parse_pmml_file(pmml)
-
-    B, C, F = args.batch, args.chunk, args.features
-    K = B // C  # batch was normalized to a multiple of chunk above
-
-    rng = np.random.default_rng(0)
-    pool_f32 = [
-        rng.normal(0.0, 1.5, size=(B, F)).astype(np.float32) for _ in range(4)
-    ]
-
-    cm = compile_pmml(doc, batch_size=C)
-
-    if args.block_pipeline:
-        # the production path: f32 blocks → C++ ring → bucketizer →
-        # quantized scoring → sink. Same model, same chunk size; reported
-        # under the same metric so the two numbers are directly comparable.
-        from flink_jpmml_tpu.runtime.block import (
-            BlockPipeline, CyclingBlockSource,
-        )
-        from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
-
-        count = [0]
-
-        def bsink(out, n, first_off):
-            # force the D2H round trip so the rate counts *completed*
-            # work, same as the hand loop — not async dispatches
-            np.asarray(out.value if hasattr(out, "value") else
-                       out[0] if isinstance(out, tuple) else out)
-            count[0] += n
-
-        pipe = BlockPipeline(
-            CyclingBlockSource(np.concatenate(pool_f32), block_size=C),
-            cm,
-            bsink,
-            RuntimeConfig(batch=BatchConfig(size=C, deadline_us=5000)),
-            use_quantized=not args.f32_wire,
-        )
-        q = None if args.f32_wire else cm.quantized_scorer()
-        if q is not None:
-            jax.block_until_ready(
-                q.predict_wire(q.wire.encode(pool_f32[0][:C]))
-            )
-        else:
-            cm.warmup()
-        t0 = time.perf_counter()
-        pipe.run_for(seconds=args.seconds)
-        dt = time.perf_counter() - t0
-        rate = count[0] / dt
-        line = {
-            "metric": metric,
-            "value": round(rate, 1),
-            "unit": "records/s/chip",
-            "vs_baseline": round(rate / NORTH_STAR_REC_S, 3),
-            "device_value": None,  # keys uniform with the hand-loop line
-            "backend": f"{backend}/{pipe.backend}",
-        }
-        if probe_err is not None:
-            line["error"] = probe_err
-        print(json.dumps(line))
-        return
-
-    if args.f32_wire:
-        inner = getattr(cm._jit_fn, "__wrapped__", cm._jit_fn)
-        params = cm.params
-
-        @jax.jit
-        def run(p, X):
-            def body(c, x):
-                out = inner(p, x, jnp.isnan(x))
-                return c, out.value.astype(jnp.bfloat16)
-            _, vals = jax.lax.scan(body, 0, X.reshape(K, C, F))
-            return vals.reshape(-1)
-
-        def encode(X):
-            return X
-    else:
-        q = cm.quantized_scorer()
-        assert q is not None, "bench GBM must be rank-wire eligible"
-        qfn = getattr(q._jit_fn, "__wrapped__", q._jit_fn)
-        params = q.params
-
-        @jax.jit
-        def run(p, Xq):
-            def body(c, xq):
-                return c, qfn(p, xq).astype(jnp.bfloat16)
-            _, vals = jax.lax.scan(body, 0, Xq.reshape(K, C, F))
-            return vals.reshape(-1)
-
-        def encode(X):
-            return q.wire.encode(X)
-
-    # ---- pipeline: featurize (threads) → h2d → score → d2h readback ----
-    enc_pool = ThreadPoolExecutor(max_workers=2)
-
-    # warm: compile + first transfers (excluded from the measurement)
-    warm = np.asarray(run(params, jax.device_put(encode(pool_f32[0]))))
-    assert warm.shape == (B,) and np.isfinite(
-        warm.astype(np.float32)
-    ).all(), "warmup produced non-finite scores"
-
-    PRE = args.window + 2  # encoded batches staged ahead of the transfer
-    encoded = collections.deque(
-        enc_pool.submit(encode, pool_f32[i % len(pool_f32)])
-        for i in range(PRE)
-    )
-    inflight = collections.deque()
-    done_records = 0
-    i = 0
-    t0 = time.perf_counter()
-    deadline = t0 + args.seconds
-    while True:
-        now = time.perf_counter()
-        if now >= deadline and not inflight:
-            break
-        if now < deadline:
-            Xq = encoded.popleft().result()
-            encoded.append(
-                enc_pool.submit(encode, pool_f32[(i + PRE) % len(pool_f32)])
-            )
-            inflight.append(run(params, jax.device_put(Xq)))
-            i += 1
-        while len(inflight) > (args.window if now < deadline else 0):
-            scores = np.asarray(inflight.popleft())  # forces the round trip
-            done_records += scores.shape[0]
-    dt = time.perf_counter() - t0
-    enc_pool.shutdown(wait=False)
-    rate = done_records / dt
-
-    # pure device-side rate: batch already resident, no host link in the
-    # loop — separates chip capability from the (possibly tunneled) link
-    Xq_dev = jax.device_put(encode(pool_f32[0]))
-    jax.block_until_ready(run(params, Xq_dev))
-    reps = 0
-    out = None
-    t1 = time.perf_counter()
-    dev_deadline = t1 + min(3.0, args.seconds)
-    while time.perf_counter() < dev_deadline:
-        out = run(params, Xq_dev)
-        reps += 1
-    jax.block_until_ready(out)
-    dev_rate = reps * B / (time.perf_counter() - t1)
-
-    line = {
-        "metric": metric,
-        "value": round(rate, 1),
-        "unit": "records/s/chip",
-        "vs_baseline": round(rate / NORTH_STAR_REC_S, 3),
-        "device_value": round(dev_rate, 1),
-        "backend": backend,
-    }
-    if probe_err is not None:
-        line["error"] = probe_err
-    print(json.dumps(line))
-
+from flink_jpmml_tpu.bench import main
 
 if __name__ == "__main__":
     main()

@@ -23,7 +23,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import numpy as np
 
-from assets.generate import gen_gbm
+from flink_jpmml_tpu.assets_gen import gen_gbm
 from flink_jpmml_tpu.compile import compile_pmml
 from flink_jpmml_tpu.pmml import parse_pmml_file
 from flink_jpmml_tpu.runtime.block import BlockPipeline, CyclingBlockSource

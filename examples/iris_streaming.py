@@ -11,11 +11,14 @@ import pathlib
 import sys
 import tempfile
 
-sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+try:  # installed package (pip install -e .)
+    import flink_jpmml_tpu  # noqa: F401
+except ImportError:  # source checkout without install: add the repo root
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import numpy as np
 
-from assets.generate import gen_iris_lr
+from flink_jpmml_tpu.assets_gen import gen_iris_lr
 from flink_jpmml_tpu.api import ModelReader, StreamEnvironment
 from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
 

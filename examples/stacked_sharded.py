@@ -19,7 +19,10 @@ import pathlib
 import sys
 import tempfile
 
-sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+try:  # installed package (pip install -e .)
+    import flink_jpmml_tpu  # noqa: F401
+except ImportError:  # source checkout without install: add the repo root
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import os
 
@@ -32,7 +35,7 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 import numpy as np
 
-from assets.generate import gen_stacked
+from flink_jpmml_tpu.assets_gen import gen_stacked
 from flink_jpmml_tpu.compile import compile_pmml
 from flink_jpmml_tpu.parallel.mesh import make_mesh
 from flink_jpmml_tpu.parallel.sharding import dp_sharded

@@ -26,7 +26,18 @@ Capability parity map (SURVEY.md §1, C1–C8):
 - C8 examples + assets ........ ``examples/`` and ``assets/`` at the repo root
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
+
+import os as _os
+
+if _os.environ.get("FJT_PLATFORM"):
+    # Opt-in platform pin. Some TPU plugins (the tunneled axon backend in
+    # the target image) ignore JAX_PLATFORMS, so honoring an env var via
+    # the config API is the only reliable way to run examples/tools on a
+    # chosen backend. No-op unless FJT_PLATFORM is set.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["FJT_PLATFORM"])
 
 from flink_jpmml_tpu.models.prediction import (  # noqa: F401
     EmptyScore,

@@ -130,15 +130,28 @@ uint32_t fjt_ring_push_block(Ring* r, const float* recs, uint64_t first_offset,
 }
 
 // Fill-or-deadline drain into out (max_n*arity floats) + out_offsets
-// (max_n u64). Blocks until >=1 record (or closed); then keeps taking until
-// max_n or deadline_us after the first take. Returns records drained
-// (0 => closed and empty).
+// (max_n u64). Blocks until >=1 record (or closed) — bounded by
+// idle_timeout_us when >= 0 (0 records returned on expiry: lets a
+// consumer with control-plane work, e.g. the dynamic serving pipeline's
+// Add/Del polling, wake up on an idle stream; -1 waits indefinitely).
+// Once records flow, keeps taking until max_n or deadline_us after the
+// first take. Returns records drained (0 => closed-and-empty or idle
+// bound expired).
 uint32_t fjt_ring_drain(Ring* r, float* out, uint64_t* out_offsets,
-                        uint32_t max_n, int64_t deadline_us) {
+                        uint32_t max_n, int64_t deadline_us,
+                        int64_t idle_timeout_us) {
     std::unique_lock<std::mutex> lk(r->mu);
+    auto idle_deadline = steady_clock::now() + microseconds(idle_timeout_us);
     while (r->count == 0) {
         if (r->closed) return 0;
-        r->not_empty.wait_for(lk, milliseconds(100));
+        if (idle_timeout_us >= 0) {
+            if (r->not_empty.wait_until(lk, idle_deadline) ==
+                    std::cv_status::timeout ||
+                (r->count == 0 && steady_clock::now() >= idle_deadline))
+                if (r->count == 0) return 0;
+        } else {
+            r->not_empty.wait_for(lk, milliseconds(100));
+        }
     }
     uint32_t drained = 0;
     auto deadline = steady_clock::now() + microseconds(deadline_us);

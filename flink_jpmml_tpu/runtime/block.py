@@ -143,12 +143,25 @@ class _PyRing:
                 self._not_empty.notify()
         return pushed
 
-    def drain(self, deadline_us: int):
+    def drain(self, deadline_us: int, idle_timeout_us: int = -1):
         with self._not_empty:
+            idle_deadline = (
+                None
+                if idle_timeout_us < 0
+                else time.monotonic() + idle_timeout_us / 1e6
+            )
             while self._count == 0:
                 if self._closed:
                     return self._batch[:0], self._offsets[:0]
-                self._not_empty.wait(0.1)
+                if idle_deadline is None:
+                    self._not_empty.wait(0.1)
+                else:
+                    remaining = idle_deadline - time.monotonic()
+                    if remaining <= 0:
+                        # idle bound: empty return on an open ring lets
+                        # the consumer run control-plane work
+                        return self._batch[:0], self._offsets[:0]
+                    self._not_empty.wait(min(remaining, 0.1))
             deadline = time.monotonic() + deadline_us / 1e6
             drained = 0
             max_n = self._batch.shape[0]
@@ -201,55 +214,75 @@ def make_ring(capacity: int, arity: int, batch_size: int, native: bool = True):
     return _PyRing(capacity, arity, batch_size)
 
 
-class BlockPipeline:
-    """source → ring → padded batches → async scoring → sink.
+class BoundScorer:
+    """One servable compiled model bound for block scoring: its (maybe)
+    rank-wire scorer, the ``rank_wire_*``/``f32`` backend tag, and the
+    decode callable (carrying ``model_key``) handed to dynamic sinks.
+    Shared by the static and dynamic pipelines so the probe/backend/
+    decode logic cannot diverge between them."""
 
-    ``sink(out, n: int, first_offset: int)`` receives raw device outputs
-    (decode is the caller's choice — fetching to host costs a D2H transfer
-    per batch; use :meth:`decode` to turn one into ``Prediction``s). When
-    the model is rank-wire eligible (``use_quantized``, the default) the
-    scoring hop is the quantized path of compile/qtrees.py: the drained f32
-    block is encoded to threshold ranks by the multithreaded C++ bucketizer
-    and ``out`` is the QuantizedScorer output; otherwise ``out`` is a
-    :class:`ModelOutput` from the f32 path. ``backend`` says which engaged
-    and is also recorded in metrics as ``scorer_backend_*``.
+    def __init__(self, key: str, model, use_quantized: bool):
+        self.key = key
+        self.model = model
+        probe = getattr(model, "quantized_scorer", None)
+        self.q = probe() if (use_quantized and probe is not None) else None
+        self.backend = (
+            f"rank_wire_{self.q.backend}" if self.q is not None else "f32"
+        )
+
+        def decode(out, n):
+            if self.q is not None:
+                return self.q.decode(out, n)
+            return self.model.decode(out, n)
+
+        decode.model_key = key
+        self.decode = decode
+
+
+class BlockPipelineBase:
+    """Shared machinery of the static and dynamic block pipelines:
+    ingest→ring, lifecycle (start/stop/join/run_*), the ``_drain_all``
+    stop protocol, and the score loop skeleton. Subclass hooks:
+
+    - ``_acquire(finish_one)`` → per-batch scoring handle (or None to
+      abandon the loop — the dynamic pipeline's bounded registry-gap
+      give-up); called with a drained batch pending, between batches.
+    - ``_dispatch(handle, X, n)`` → ``(raw_out, decode_or_None)``, the
+      async device dispatch.
+    - ``_emit(out, n, first_off, decode)`` → deliver to the sink.
+    - ``_on_idle()`` — called when the ring drain returns empty on an
+      open ring; reachable only when ``_IDLE_WAIT_US >= 0`` bounds the
+      drain's wait for a first record (the dynamic pipeline sets it so
+      Add/Del messages apply promptly on an idle stream).
     """
+
+    _THREAD_TAG = "blk"
+    _IDLE_WAIT_US = -1  # block indefinitely for the first record
 
     def __init__(
         self,
         source: BlockSource,
-        model: CompiledModel,
         sink: Callable,
-        config: Optional[RuntimeConfig] = None,
-        metrics: Optional[MetricsRegistry] = None,
-        use_native: bool = True,
-        in_flight: int = 2,
-        use_quantized: bool = True,
-        checkpoint=None,
+        arity: int,
+        batch_size: int,
+        config: Optional[RuntimeConfig],
+        metrics: Optional[MetricsRegistry],
+        use_native: bool,
+        in_flight: int,
+        checkpoint,
     ):
-        if model.batch_size is None:
-            raise InputValidationException(
-                "BlockPipeline needs a fixed-batch compiled model "
-                "(compile_pmml(batch_size=...))"
-            )
         self._source = source
-        self._model = model
         self._sink = sink
+        self._arity = arity
+        self._batch_size = batch_size
         self._config = config or RuntimeConfig()
         self.metrics = metrics or MetricsRegistry()
-        self._arity = model.field_space.arity
         self._ring = make_ring(
             self._config.batch.queue_capacity,
-            self._arity,
-            model.batch_size,
+            arity,
+            batch_size,
             native=use_native,
         )
-        probe = getattr(model, "quantized_scorer", None)
-        self._q = probe() if (use_quantized and probe is not None) else None
-        self.backend = (
-            f"rank_wire_{self._q.backend}" if self._q is not None else "f32"
-        )
-        self.metrics.counter(f"scorer_backend_{self.backend}").inc()
         self._in_flight_max = max(1, in_flight)
         # see engine.Pipeline: True only for run_until_exhausted's full
         # drain; plain stop() discards the uncommitted ring backlog so it
@@ -279,19 +312,23 @@ class BlockPipeline:
         off = int(state.get("source_offset", 0))
         self._source.seek(off)
         self.committed_offset = off
+        self._restore_extra(state)
         return True
 
-    def decode(self, out, n: int):
-        """Sink-received raw output → ``Prediction`` list (host-side)."""
-        if self._q is not None:
-            return self._q.decode(out, n)
-        return self._model.decode(out, n)
+    def _restore_extra(self, state: dict) -> None:
+        pass
 
-    def start(self) -> "BlockPipeline":
-        t1 = threading.Thread(target=self._ingest, name="fjt-blk-ingest",
-                              daemon=True)
-        t2 = threading.Thread(target=self._score, name="fjt-blk-score",
-                              daemon=True)
+    def start(self):
+        t1 = threading.Thread(
+            target=self._ingest,
+            name=f"fjt-{self._THREAD_TAG}-ingest",
+            daemon=True,
+        )
+        t2 = threading.Thread(
+            target=self._score,
+            name=f"fjt-{self._THREAD_TAG}-score",
+            daemon=True,
+        )
         self._threads = [t1, t2]
         t1.start()
         t2.start()
@@ -330,6 +367,44 @@ class BlockPipeline:
         self.stop()
         self.join(timeout=max(30.0, deadline - time.monotonic()))
 
+    # -- subclass hooks ----------------------------------------------------
+
+    def _acquire(self, finish_one):
+        raise NotImplementedError
+
+    def _dispatch(self, handle, X, n):
+        raise NotImplementedError
+
+    def _emit(self, out, n, first_off, decode) -> None:
+        self._sink(out, n, first_off)
+
+    def _on_idle(self) -> None:
+        pass
+
+    def _dispatch_bound(self, bound: "BoundScorer", X, n):
+        """Shared async dispatch through a :class:`BoundScorer` — the
+        rank wire when eligible (the bucketizer folds NaN→missing during
+        encoding: no separate host-side NaN pass, no f32 mask plane),
+        the f32 path otherwise."""
+        if bound.q is not None:
+            Xq = bound.q.wire.encode(X)
+            return bound.q.predict_wire(Xq)  # async dispatch
+        return self._score_f32(bound.model, X, n)
+
+    def _score_f32(self, model, X, n):
+        """Shared f32 fallback dispatch: NaN cells are the missing
+        convention on this path; one isnan pass builds the mask (any()
+        on bools is cheap), not a scan-then-rescan."""
+        B = model.batch_size
+        Mb = np.isnan(X)
+        if Mb.any():
+            Xb = np.where(Mb, 0.0, X).astype(np.float32)
+        else:
+            Xb, Mb = X, _ZEROS_M.get(n, self._arity)
+        if n < B:
+            Xb, Mb, _ = prepare.pad_batch(Xb, Mb, B)
+        return model.predict(Xb, Mb)  # async dispatch
+
     # -- internals ---------------------------------------------------------
 
     def _ingest(self) -> None:
@@ -354,54 +429,48 @@ class BlockPipeline:
             self._stop.set()
 
     def _score(self) -> None:
-        import jax
-
         batch_cfg = self._config.batch
         records_out = self.metrics.counter("records_out")
         batches = self.metrics.counter("batches")
         fill = self.metrics.counter("batch_fill_records")
         lat = self.metrics.reservoir("batch_latency_s")
-        B = self._model.batch_size
         in_flight: List[Tuple] = []
 
         def _finish_one():
-            out, n, first_off, t_start = in_flight.pop(0)
-            self._sink(out, n, first_off)
+            out, n, first_off, t_start, decode = in_flight.pop(0)
+            self._emit(out, n, first_off, decode)
             lat.observe(time.monotonic() - t_start)
             records_out.inc(n)
             self.committed_offset = first_off + n
             self._ckpt.maybe_save(self._ckpt_state)
 
+        def _drain_inflight_one():
+            """Safe for hooks: finish the oldest in-flight batch if any."""
+            if in_flight:
+                _finish_one()
+
         try:
             while True:
                 if self._stop.is_set() and not self._drain_all:
                     break  # stop(): skip the uncommitted backlog
-                X, offsets = self._ring.drain(batch_cfg.deadline_us)
+                X, offsets = self._ring.drain(
+                    batch_cfg.deadline_us, self._IDLE_WAIT_US
+                )
                 n = X.shape[0]
                 if n == 0:
                     if self._ring.closed:
                         break
+                    self._on_idle()
                     continue
+                handle = self._acquire(_drain_inflight_one)
+                if handle is None:
+                    return  # abandoned (records replay from the
+                    # committed offset on restore)
                 t_start = time.monotonic()
-                if self._q is not None:
-                    # rank wire: the bucketizer folds NaN→missing (and any
-                    # mining-schema replacement) during encoding — no
-                    # separate host-side NaN pass, no f32 mask plane
-                    Xq = self._q.wire.encode(X)
-                    out = self._q.predict_wire(Xq)  # async dispatch
-                else:
-                    # NaN cells are the missing convention on this path;
-                    # one isnan pass builds the mask (any() on bools is
-                    # cheap), not a scan-then-rescan
-                    Mb = np.isnan(X)
-                    if Mb.any():
-                        Xb = np.where(Mb, 0.0, X).astype(np.float32)
-                    else:
-                        Xb, Mb = X, _ZEROS_M.get(n, self._arity)
-                    if n < B:
-                        Xb, Mb, _ = prepare.pad_batch(Xb, Mb, B)
-                    out = self._model.predict(Xb, Mb)  # async dispatch
-                in_flight.append((out, n, int(offsets[0]) if n else 0, t_start))
+                out, decode = self._dispatch(handle, X, n)
+                in_flight.append(
+                    (out, n, int(offsets[0]) if n else 0, t_start, decode)
+                )
                 batches.inc()
                 fill.inc(n)
                 if len(in_flight) >= self._in_flight_max:
@@ -412,6 +481,63 @@ class BlockPipeline:
         except BaseException as e:
             self._error = e
             self._stop.set()
+
+
+class BlockPipeline(BlockPipelineBase):
+    """source → ring → padded batches → async scoring → sink.
+
+    ``sink(out, n: int, first_offset: int)`` receives raw device outputs
+    (decode is the caller's choice — fetching to host costs a D2H transfer
+    per batch; use :meth:`decode` to turn one into ``Prediction``s). When
+    the model is rank-wire eligible (``use_quantized``, the default) the
+    scoring hop is the quantized path of compile/qtrees.py: the drained f32
+    block is encoded to threshold ranks by the multithreaded C++ bucketizer
+    and ``out`` is the QuantizedScorer output; otherwise ``out`` is a
+    :class:`ModelOutput` from the f32 path. ``backend`` says which engaged
+    and is also recorded in metrics as ``scorer_backend_*``.
+    """
+
+    def __init__(
+        self,
+        source: BlockSource,
+        model: CompiledModel,
+        sink: Callable,
+        config: Optional[RuntimeConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        use_native: bool = True,
+        in_flight: int = 2,
+        use_quantized: bool = True,
+        checkpoint=None,
+    ):
+        if model.batch_size is None:
+            raise InputValidationException(
+                "BlockPipeline needs a fixed-batch compiled model "
+                "(compile_pmml(batch_size=...))"
+            )
+        super().__init__(
+            source=source,
+            sink=sink,
+            arity=model.field_space.arity,
+            batch_size=model.batch_size,
+            config=config,
+            metrics=metrics,
+            use_native=use_native,
+            in_flight=in_flight,
+            checkpoint=checkpoint,
+        )
+        self._bound = BoundScorer("static", model, use_quantized)
+        self.backend = self._bound.backend
+        self.metrics.counter(f"scorer_backend_{self.backend}").inc()
+
+    def decode(self, out, n: int):
+        """Sink-received raw output → ``Prediction`` list (host-side)."""
+        return self._bound.decode(out, n)
+
+    def _acquire(self, finish_one):
+        return self._bound  # one static model: nothing to resolve
+
+    def _dispatch(self, bound, X, n):
+        return self._dispatch_bound(bound, X, n), None
 
 
 class _ZerosMCache:

@@ -1,15 +1,20 @@
-"""ctypes binding for the C++ data plane (native/fjt_native.cpp).
+"""ctypes binding for the C++ data plane (_native/fjt_native.cpp).
 
-Builds the shared library on first use with the baked-in ``g++`` (cached
-next to the source; pybind11 isn't in the image, hence the C-plain ABI +
-ctypes). Falls back cleanly: callers check :func:`available` and use the
-pure-Python :class:`flink_jpmml_tpu.runtime.queues.BoundedQueue` otherwise —
-same semantics, lower throughput.
+Builds the shared library on first use with the baked-in ``g++``
+(pybind11 isn't in the image, hence the C-plain ABI + ctypes). The source
+ships inside the package (``flink_jpmml_tpu/_native/``) so a pip install
+carries it; the built ``.so`` is cached under ``$FJT_NATIVE_CACHE``
+(default ``~/.cache/flink_jpmml_tpu/native``) — site-packages may be
+read-only — and rebuilt whenever the source is newer. Falls back cleanly:
+callers check :func:`available` and use the pure-Python
+:class:`flink_jpmml_tpu.runtime.queues.BoundedQueue` otherwise — same
+semantics, lower throughput.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import pathlib
 import subprocess
@@ -18,9 +23,29 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
-_SRC = _REPO_ROOT / "native" / "fjt_native.cpp"
-_LIB = _REPO_ROOT / "native" / "build" / "libfjt_native.so"
+_SRC = (
+    pathlib.Path(__file__).resolve().parent.parent
+    / "_native"
+    / "fjt_native.cpp"
+)
+
+
+def _lib_path() -> pathlib.Path:
+    """Cache name carries the source content hash: the shared ~/.cache
+    survives package upgrades/downgrades across venvs, and mtimes are
+    unreliable for wheels (often pinned to a fixed epoch) — a stale
+    ABI loaded through ctypes would corrupt memory, not error."""
+    d = os.environ.get("FJT_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "flink_jpmml_tpu", "native"
+    )
+    try:
+        digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:12]
+    except OSError:
+        digest = "nosrc"
+    return pathlib.Path(d) / f"libfjt_native-{digest}.so"
+
+
+_LIB = _lib_path()
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -30,9 +55,13 @@ _build_error: Optional[str] = None
 def _build() -> Optional[str]:
     """Compile the shared library; returns an error string or None."""
     _LIB.parent.mkdir(parents=True, exist_ok=True)
+    # build to a per-process temp name then atomically replace, so
+    # concurrent workers racing the first build never load a half-written
+    # library
+    tmp = _LIB.with_suffix(f".tmp-{os.getpid()}.so")
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", str(_LIB), str(_SRC), "-lpthread",
+        "-o", str(tmp), str(_SRC), "-lpthread",
     ]
     try:
         proc = subprocess.run(
@@ -42,6 +71,10 @@ def _build() -> Optional[str]:
         return f"g++ invocation failed: {e}"
     if proc.returncode != 0:
         return f"g++ failed:\n{proc.stderr[-2000:]}"
+    try:
+        os.replace(tmp, _LIB)
+    except OSError as e:
+        return f"cache install failed: {e}"
     return None
 
 
@@ -53,7 +86,8 @@ def _load() -> Optional[ctypes.CDLL]:
         if not _SRC.exists():
             _build_error = f"source missing: {_SRC}"
             return None
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        # hash-keyed cache name: existence IS validity (see _lib_path)
+        if not _LIB.exists():
             err = _build()
             if err is not None:
                 _build_error = err
@@ -86,6 +120,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_uint32,
             ctypes.c_int64,
+            ctypes.c_int64,  # idle_timeout_us (-1 = wait indefinitely)
         ]
         for name, code_t in (
             ("fjt_bucketize_u8", ctypes.c_uint8),
@@ -155,13 +190,20 @@ class NativeRing:
             timeout_us,
         )
 
-    def drain(self, deadline_us: int) -> Tuple[np.ndarray, np.ndarray]:
+    def drain(
+        self, deadline_us: int, idle_timeout_us: int = -1
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """``idle_timeout_us >= 0`` bounds the wait for the *first*
+        record — an empty return on an open ring then means "idle", so
+        the consumer can run control-plane work (dynamic serving's
+        Add/Del polling) instead of parking forever."""
         n = self._lib.fjt_ring_drain(
             self._handle,
             self._batch.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             self._batch.shape[0],
             deadline_us,
+            idle_timeout_us,
         )
         return self._batch[:n], self._offsets[:n]
 

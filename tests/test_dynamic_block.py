@@ -1,0 +1,397 @@
+"""Dynamic serving on the block path (serving/block.py): VERDICT r2
+missing #2 — Add/warm/swap/Del at block speed, no in-flight drain, offsets
+exactly-once across the swap, records held (not lost) through registry
+gaps."""
+
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from assets.generate import gen_gbm
+from flink_jpmml_tpu.models.control import AddMessage, DelMessage
+from flink_jpmml_tpu.runtime.block import CyclingBlockSource, FiniteBlockSource
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.runtime.sources import ControlSource
+from flink_jpmml_tpu.serving.block import DynamicBlockPipeline
+from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+F = 4
+B = 32
+
+
+def _gbms(tmp_path, *specs):
+    """specs: (subdir, n_trees, depth[, n_features]) → pmml paths."""
+    out = []
+    for spec in specs:
+        sub, n_trees, depth = spec[:3]
+        nf = spec[3] if len(spec) > 3 else F
+        d = pathlib.Path(tmp_path, sub)
+        d.mkdir(parents=True, exist_ok=True)
+        out.append(
+            gen_gbm(str(d), n_trees=n_trees, depth=depth, n_features=nf)
+        )
+    return out
+
+
+def _slow_loader(reg, slow_substr, delay_s):
+    orig = reg._load
+
+    def load(info):
+        if slow_substr in info.path:
+            time.sleep(delay_s)
+        return orig(info)
+
+    reg._load = load
+
+
+class _RecordingSink:
+    """Collects (first_offset, n, model_key, t_wall) per sunk batch."""
+
+    def __init__(self, decode_every: int = 0):
+        self.rows = []
+        self.decoded = []
+        self._lock = threading.Lock()
+        self._decode_every = decode_every
+
+    def __call__(self, out, n, first_off, decode):
+        if self._decode_every and len(self.rows) % self._decode_every == 0:
+            preds = decode(out, n)
+            with self._lock:
+                self.decoded.append((first_off, preds))
+        with self._lock:
+            self.rows.append(
+                (first_off, n, decode.model_key, time.monotonic())
+            )
+
+    def total(self):
+        with self._lock:
+            return sum(n for _, n, _, _ in self.rows)
+
+    def assert_offsets_contiguous(self, start=0):
+        with self._lock:
+            rows = list(self.rows)
+        expect = start
+        for first, n, _, _ in rows:
+            assert first == expect, f"offset gap: {first} != {expect}"
+            expect = first + n
+
+
+def _cfg():
+    return RuntimeConfig(batch=BatchConfig(size=B, deadline_us=2000))
+
+
+def _wait(cond, timeout=30.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(msg)
+
+
+class TestDynamicBlockPipeline:
+    def test_add_warm_swap_del_cycle_no_stall(self, tmp_path):
+        """Blocks score continuously while v2 warms (its fetch sleeps
+        1.2s); the swap happens between batches; Del of v2 falls back to
+        v1; offsets stay contiguous end to end."""
+        v1, v2 = _gbms(tmp_path, ("v1", 3, 3), ("v2", 40, 4))
+        rng = np.random.default_rng(0)
+        data = rng.normal(0, 1.5, size=(1024, F)).astype(np.float32)
+        ctrl = ControlSource()
+        sink = _RecordingSink()
+        pipe = DynamicBlockPipeline(
+            CyclingBlockSource(data, block_size=64),
+            ctrl, sink, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False,
+        )
+        _slow_loader(pipe.registry, "v2", 1.2)
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        pipe.start()
+        try:
+            _wait(lambda: sink.total() > 0, msg="v1 never served")
+            _wait(lambda: pipe.serving_key == "m_1")
+            t_add = time.monotonic()
+            ctrl.push(AddMessage("m", 2, v2, timestamp=2.0))
+            _wait(lambda: pipe.serving_key == "m_2", timeout=60.0,
+                  msg="v2 never swapped in")
+            t_swap = time.monotonic()
+            assert t_swap - t_add >= 1.2  # the warm was genuinely slow
+            # continuity through the warm window: no sink gap anywhere
+            # near the 1.2s+compile stall the swap would cost if done
+            # synchronously
+            with sink._lock:
+                stamps = [t for _, _, _, t in sink.rows
+                          if t_add - 0.5 <= t <= t_swap + 0.5]
+            gaps = np.diff(stamps)
+            assert len(stamps) > 10
+            assert gaps.max() < 0.6, f"stall {gaps.max():.2f}s during warm"
+            ctrl.push(DelMessage("m", 2, timestamp=3.0))
+            _wait(lambda: pipe.serving_key == "m_1",
+                  msg="Del never fell back to v1")
+        finally:
+            pipe.stop()
+            pipe.join(timeout=30.0)
+        sink.assert_offsets_contiguous()
+        # batches before the swap were scored (and decodable) by v1,
+        # after it by v2 — both keys must appear
+        keys = {k for _, _, k, _ in sink.rows}
+        assert {"m_1", "m_2"} <= keys
+
+    def test_records_held_not_lost_through_registry_gap(self, tmp_path):
+        """Stream starts before any model is served: batches are held
+        (ring backpressure), never dropped; once a model arrives every
+        record scores exactly once."""
+        (v1,) = _gbms(tmp_path, ("v1", 3, 3))
+        rng = np.random.default_rng(1)
+        n_total = 500
+        data = rng.normal(size=(n_total, F)).astype(np.float32)
+        ctrl = ControlSource()
+        sink = _RecordingSink(decode_every=3)
+        pipe = DynamicBlockPipeline(
+            FiniteBlockSource(data, block_size=100),
+            ctrl, sink, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False,
+        )
+        pipe.start()
+        time.sleep(0.4)  # stream runs with nothing served
+        assert sink.total() == 0
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        deadline = time.monotonic() + 60.0
+        while sink.total() < n_total and time.monotonic() < deadline:
+            time.sleep(0.02)
+        pipe._drain_all = True
+        pipe.stop()
+        pipe.join(timeout=30.0)
+        assert sink.total() == n_total
+        sink.assert_offsets_contiguous()
+        assert pipe.committed_offset == n_total
+        # decode works through the sink's 4th argument
+        assert sink.decoded and all(
+            len(p) > 0 for _, p in sink.decoded
+        )
+
+    def test_arity_mismatch_quarantined_not_served(self, tmp_path):
+        bad, good = _gbms(tmp_path, ("bad", 3, 3, 6), ("good", 3, 3))
+        rng = np.random.default_rng(2)
+        data = rng.normal(size=(256, F)).astype(np.float32)
+        ctrl = ControlSource()
+        sink = _RecordingSink()
+        pipe = DynamicBlockPipeline(
+            CyclingBlockSource(data, block_size=64),
+            ctrl, sink, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False,
+        )
+        ctrl.push(AddMessage("m", 1, bad, timestamp=1.0))
+        pipe.start()
+        try:
+            _wait(
+                lambda: pipe.metrics.counter("arity_rejected_models").get()
+                >= 1,
+                msg="mismatched model never rejected",
+            )
+            assert pipe.serving_key is None and sink.total() == 0
+            ctrl.push(AddMessage("m", 2, good, timestamp=2.0))
+            _wait(lambda: pipe.serving_key == "m_2", timeout=60.0)
+            _wait(lambda: sink.total() > 0)
+        finally:
+            pipe.stop()
+            pipe.join(timeout=30.0)
+        sink.assert_offsets_contiguous()
+
+    def test_checkpoint_resume_across_swap(self, tmp_path):
+        """Kill after a swap; a fresh pipeline restores the committed
+        offset AND the served-model metadata, then finishes the stream
+        from exactly where the first left off."""
+        v1, v2 = _gbms(tmp_path, ("v1", 3, 3), ("v2", 5, 3))
+        rng = np.random.default_rng(3)
+        n_total = 6000
+        data = rng.normal(size=(n_total, F)).astype(np.float32)
+        ckpt = CheckpointManager(str(pathlib.Path(tmp_path, "ck")))
+
+        class _Throttled(FiniteBlockSource):
+            """Paces ingest so the stream outlives the v2 warm."""
+
+            def poll(self):
+                r = super().poll()
+                if r is not None:
+                    time.sleep(0.05)
+                return r
+
+        ctrl = ControlSource()
+        sink1 = _RecordingSink()
+        p1 = DynamicBlockPipeline(
+            _Throttled(data, block_size=50),
+            ctrl, sink1, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False, checkpoint=ckpt,
+        )
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        p1.start()
+        _wait(lambda: sink1.total() > 0, msg="first run never scored")
+        ctrl.push(AddMessage("m", 2, v2, timestamp=2.0))
+        _wait(lambda: p1.serving_key == "m_2", timeout=60.0)
+        _wait(lambda: sink1.total() > 200)
+        p1.stop()  # kill mid-stream: uncommitted backlog is discarded
+        p1.join(timeout=30.0)
+        done1 = p1.committed_offset
+        assert 0 < done1 < n_total
+
+        ctrl2 = ControlSource()  # nothing pushed: state comes from ckpt
+        sink2 = _RecordingSink()
+        p2 = DynamicBlockPipeline(
+            FiniteBlockSource(data, block_size=100),
+            ctrl2, sink2, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False, checkpoint=ckpt,
+        )
+        assert p2.restore()
+        assert p2.committed_offset == done1
+        # restored registry still serves both versions; newest wins
+        assert {m.key() for m in p2.registry.served} == {"m_1", "m_2"}
+        p2.run_until_exhausted(timeout=60.0)
+        assert p2.serving_key is not None  # restored metadata re-warmed
+        sink2.assert_offsets_contiguous(start=done1)
+        assert done1 + sink2.total() == n_total
+
+
+class TestReviewRegressions:
+    """Round-3 code-review findings on this module, pinned."""
+
+    def test_del_readd_same_version_new_document_swaps(self, tmp_path):
+        """Del('m',1) + Add('m',1, different doc) must adopt the NEW
+        compiled model even though the (name, version) key is unchanged
+        — adoption is judged per compiled instance."""
+        a, b = _gbms(tmp_path, ("a", 3, 3), ("b", 17, 4))
+        rng = np.random.default_rng(7)
+        data = rng.normal(size=(512, F)).astype(np.float32)
+        ctrl = ControlSource()
+        sink = _RecordingSink()
+        pipe = DynamicBlockPipeline(
+            CyclingBlockSource(data, block_size=64),
+            ctrl, sink, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False,
+        )
+        ctrl.push(AddMessage("m", 1, a, timestamp=1.0))
+        pipe.start()
+        try:
+            _wait(lambda: pipe.serving_key == "m_1", timeout=60.0)
+            model_a = pipe._current.model
+            ctrl.push(DelMessage("m", 1, timestamp=2.0))
+            ctrl.push(AddMessage("m", 1, b, timestamp=3.0))
+            _wait(
+                lambda: pipe._current is not None
+                and pipe._current.model is not model_a,
+                timeout=60.0,
+                msg="re-Add with a new document never swapped in",
+            )
+            assert pipe.serving_key == "m_1"  # same id, new weights
+        finally:
+            pipe.stop()
+            pipe.join(timeout=30.0)
+        sink.assert_offsets_contiguous()
+
+    def test_run_until_exhausted_bounded_when_nothing_servable(
+        self, tmp_path
+    ):
+        """A finite stream with no servable model must not hang the
+        drain: the hold is bounded, the loop gives up, records stay
+        uncommitted (replayable)."""
+        rng = np.random.default_rng(8)
+        data = rng.normal(size=(200, F)).astype(np.float32)
+        sink = _RecordingSink()
+        pipe = DynamicBlockPipeline(
+            FiniteBlockSource(data, block_size=50),
+            ControlSource(), sink, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False,
+            drain_hold_timeout_s=1.0,
+        )
+        t0 = time.monotonic()
+        pipe.run_until_exhausted(timeout=30.0)
+        assert time.monotonic() - t0 < 20.0
+        for t in pipe._threads:
+            assert not t.is_alive()
+        assert sink.total() == 0
+        assert pipe.committed_offset == 0  # nothing falsely committed
+
+    def test_arity_quarantine_cleared_by_registry_change(self, tmp_path):
+        """A corrected document re-Added under the same (name, version)
+        must serve — the quarantine resets on any registry change."""
+        bad, good = _gbms(tmp_path, ("bad", 3, 3, 6), ("good", 3, 3))
+        rng = np.random.default_rng(9)
+        data = rng.normal(size=(256, F)).astype(np.float32)
+        ctrl = ControlSource()
+        sink = _RecordingSink()
+        pipe = DynamicBlockPipeline(
+            CyclingBlockSource(data, block_size=64),
+            ctrl, sink, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False,
+        )
+        ctrl.push(AddMessage("m", 1, bad, timestamp=1.0))
+        pipe.start()
+        try:
+            _wait(
+                lambda: pipe.metrics.counter("arity_rejected_models").get()
+                >= 1,
+                msg="mismatched model never rejected",
+            )
+            ctrl.push(DelMessage("m", 1, timestamp=2.0))
+            ctrl.push(AddMessage("m", 1, good, timestamp=3.0))
+            _wait(lambda: pipe.serving_key == "m_1", timeout=60.0,
+                  msg="corrected re-Add stayed quarantined")
+            _wait(lambda: sink.total() > 0)
+        finally:
+            pipe.stop()
+            pipe.join(timeout=30.0)
+
+
+class TestIdleStreamControl:
+    def test_ring_idle_bounded_drain(self):
+        """drain(idle_timeout_us>=0) returns empty on an open, starved
+        ring instead of parking forever — both ring implementations."""
+        from flink_jpmml_tpu.runtime import native
+        from flink_jpmml_tpu.runtime.block import _PyRing
+
+        rings = [_PyRing(64, 4, 16)]
+        if native.available():
+            rings.append(native.NativeRing(64, 4, 16))
+        for ring in rings:
+            t0 = time.monotonic()
+            X, offs = ring.drain(1000, 30_000)
+            dt = time.monotonic() - t0
+            assert X.shape[0] == 0 and offs.shape[0] == 0
+            assert 0.01 < dt < 2.0, f"idle drain took {dt:.3f}s"
+            ring.close()
+
+    def test_control_applies_on_idle_stream(self, tmp_path):
+        """No records flowing at all: Add must still kick the background
+        warm and the pipeline must adopt the model (the review found the
+        score thread parked in ring.drain, deaf to control)."""
+        from flink_jpmml_tpu.runtime.block import BlockSource
+
+        (v1,) = _gbms(tmp_path, ("v1", 3, 3))
+
+        class _Starved(BlockSource):
+            def poll(self):
+                time.sleep(0.001)
+                return None
+
+        ctrl = ControlSource()
+        sink = _RecordingSink()
+        pipe = DynamicBlockPipeline(
+            _Starved(), ctrl, sink, name="m", arity=F, batch_size=B,
+            config=_cfg(), use_native=False,
+        )
+        pipe.start()
+        try:
+            time.sleep(0.2)  # score thread parked on the starved ring
+            ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+            _wait(
+                lambda: pipe.serving_key == "m_1",
+                timeout=60.0,
+                msg="Add never applied while the stream was idle",
+            )
+            assert sink.total() == 0  # adopted with zero records flowing
+        finally:
+            pipe.stop()
+            pipe.join(timeout=30.0)
