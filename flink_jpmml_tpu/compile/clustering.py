@@ -16,6 +16,37 @@ from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
 
+# per-field comparison codes (spec: compareFunction on ComparisonMeasure,
+# overridable per ClusteringField)
+_CMP_CODES = {"absDiff": 0, "gaussSim": 1, "delta": 2, "equal": 3}
+
+
+def resolve_compare(model: ir.ClusteringModelIR):
+    """→ (codes i32[D], gauss_s f32[D]): per-field compare function and
+    gaussSim similarityScale. Shared by the lowering and the oracle so
+    the two cannot diverge."""
+    D = len(model.clustering_fields)
+    codes = np.zeros((D,), np.int32)
+    scale = np.ones((D,), np.float32)
+    for i, cf in enumerate(model.clustering_fields):
+        name = cf.compare_function or model.measure.compare_function
+        code = _CMP_CODES.get(name)
+        if code is None:
+            raise ModelCompilationException(
+                f"unsupported compareFunction {name!r} on field "
+                f"{cf.field!r} (supported: {', '.join(_CMP_CODES)})"
+            )
+        codes[i] = code
+        if name == "gaussSim":
+            if cf.similarity_scale is None or cf.similarity_scale <= 0:
+                raise ModelCompilationException(
+                    f"gaussSim on field {cf.field!r} needs a positive "
+                    "similarityScale"
+                )
+            scale[i] = cf.similarity_scale
+    return codes, scale
+
+
 def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
     if model.model_class != "centerBased":
         raise ModelCompilationException(
@@ -25,16 +56,13 @@ def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
         raise ModelCompilationException(
             f"unsupported ComparisonMeasure kind {model.measure.kind!r}"
         )
-    if model.measure.compare_function not in ("absDiff",):
-        raise ModelCompilationException(
-            f"unsupported compareFunction {model.measure.compare_function!r}"
-        )
-    for cf in model.clustering_fields:
-        if cf.compare_function not in (None, "absDiff"):
-            raise ModelCompilationException(
-                f"unsupported per-field compareFunction {cf.compare_function!r}"
-            )
+    cmp_codes, gauss_s = resolve_compare(model)
     metric = model.measure.metric
+    mink_p = float(model.measure.minkowski_p)
+    if metric == "minkowski" and mink_p <= 0:
+        raise ModelCompilationException(
+            f"minkowski needs a positive p-parameter, got {mink_p}"
+        )
 
     cols = np.asarray(
         [ctx.column(cf.field) for cf in model.clustering_fields], np.int32
@@ -52,19 +80,42 @@ def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
         c.cluster_id or c.name or str(i + 1) for i, c in enumerate(model.clusters)
     )
     params = {"centers": centers, "weights": weights}
+    all_absdiff = bool((cmp_codes == 0).all())
+    ln2 = float(np.log(2.0))
 
     def fn(p, X, M):
         xs = X[:, cols]  # [B, D]
         missing = jnp.any(M[:, cols], axis=1)
-        diffs = jnp.abs(xs[:, None, :] - p["centers"][None, :, :]) * p["weights"]
+        delta = xs[:, None, :] - p["centers"][None, :, :]  # [B, K, D]
+        if all_absdiff:
+            c = jnp.abs(delta)
+        else:
+            ad = jnp.abs(delta)
+            eq = delta == 0.0
+            gs = jnp.exp(-ln2 * delta * delta / (gauss_s * gauss_s))
+            c = jnp.where(
+                cmp_codes == 1, gs,
+                jnp.where(
+                    cmp_codes == 2, jnp.where(eq, 0.0, 1.0),
+                    jnp.where(cmp_codes == 3, jnp.where(eq, 1.0, 0.0), ad),
+                ),
+            )
+        # spec aggregation: distance = (Σ_i w_i · c_i^p)^(1/p-ish per
+        # metric) — the weight multiplies the powered comparison
+        w = p["weights"]
         if metric == "squaredEuclidean":
-            d = jnp.sum(diffs * diffs, axis=-1)
+            d = jnp.sum(w * c * c, axis=-1)
         elif metric == "euclidean":
-            d = jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
+            d = jnp.sqrt(jnp.sum(w * c * c, axis=-1))
         elif metric == "cityBlock":
-            d = jnp.sum(diffs, axis=-1)
+            d = jnp.sum(w * c, axis=-1)
         elif metric == "chebychev":
-            d = jnp.max(diffs, axis=-1)
+            d = jnp.max(w * c, axis=-1)
+        elif metric == "minkowski":
+            d = jnp.power(
+                jnp.sum(w * jnp.power(jnp.abs(c), mink_p), axis=-1),
+                1.0 / mink_p,
+            )
         else:
             raise ModelCompilationException(f"unsupported metric {metric!r}")
         label_idx = jnp.argmin(d, axis=1).astype(jnp.int32)

@@ -297,6 +297,104 @@ def apply_targets(out: ModelOutput, targets: Tuple[ir.Target, ...]) -> ModelOutp
     return out._replace(value=apply_targets_value(out.value, targets))
 
 
+_TREAT_CODES = {"asIs": 0, "asMissing": 1, "returnInvalid": 2, "asValue": 3}
+
+
+def extract_invalid_policy(
+    dd: "ir.DataDictionary", schema: "ir.MiningSchema", ctx: "LowerCtx"
+):
+    """DataDictionary validity + ``invalidValueTreatment`` per raw input
+    column → policy dict for the jitted sanitize stage, or None when no
+    active field can ever be invalid (no declared category table, no
+    Intervals — the common case pays nothing).
+
+    Host-side encoding marks an undeclared category as ``+inf``
+    (prepare.encode_cell); continuous out-of-Interval values are detected
+    on-device. Keys: ``treat`` i32[F] (0 asIs, 1 asMissing,
+    2 returnInvalid — the spec default — 3 asValue), ``repl`` f32[F],
+    ``has_cat`` bool[F], and when any Intervals exist ``lo``/``hi``
+    f32[F, I] with ``lo_open``/``hi_open`` bool[F, I] (±inf padded) and
+    ``has_ivl`` bool[F]."""
+    F = ctx.n_fields
+    has_cat = np.zeros((F,), bool)
+    cat_n = np.zeros((F,), np.float32)  # declared categories per column
+    intervals: dict = {}
+    for f in dd.fields:
+        j = ctx.field_index.get(f.name)
+        if j is None:
+            continue
+        if f.is_categorical and f.dtype == "string" and f.values:
+            has_cat[j] = True
+            cat_n[j] = len(f.values)
+        if f.intervals:
+            intervals[j] = f.intervals
+    if not has_cat.any() and not intervals:
+        return None
+    treat = np.full((F,), _TREAT_CODES["returnInvalid"], np.int32)
+    repl = np.zeros((F,), np.float32)
+    for mf in schema.fields:
+        j = ctx.field_index.get(mf.name)
+        if j is None:
+            continue
+        code = _TREAT_CODES.get(mf.invalid_value_treatment)
+        if code is None:
+            raise ModelCompilationException(
+                f"unsupported invalidValueTreatment "
+                f"{mf.invalid_value_treatment!r} on field {mf.name!r}"
+            )
+        treat[j] = code
+        # the replacement only matters (and is only encodable) for
+        # columns that can actually be invalid — a declared category
+        # table or Intervals
+        if code == _TREAT_CODES["asValue"] and (
+            has_cat[j] or j in intervals
+        ):
+            if mf.invalid_value_replacement is None:
+                raise ModelCompilationException(
+                    f"invalidValueTreatment='asValue' on {mf.name!r} "
+                    "needs invalidValueReplacement"
+                )
+            repl[j] = ctx.encode(mf.name, mf.invalid_value_replacement)
+            if math.isnan(repl[j]):
+                # an undeclared category as the replacement would write
+                # NaN into X with M=False — silently wrong scores
+                raise ModelCompilationException(
+                    f"invalidValueReplacement "
+                    f"{mf.invalid_value_replacement!r} on {mf.name!r} is "
+                    "itself not a declared value"
+                )
+    policy = {
+        "treat": treat, "repl": repl, "has_cat": has_cat, "cat_n": cat_n,
+    }
+    if intervals:
+        I = max(len(v) for v in intervals.values())
+        lo = np.full((F, I), -np.inf, np.float32)
+        hi = np.full((F, I), np.inf, np.float32)
+        lo_open = np.zeros((F, I), bool)
+        hi_open = np.zeros((F, I), bool)
+        has_ivl = np.zeros((F,), bool)
+        for j, ivs in intervals.items():
+            has_ivl[j] = True
+            # padded slots keep (-inf, inf) closed — they would accept
+            # everything, so mask them out instead of letting them match
+            for k in range(len(ivs), I):
+                lo[j, k] = np.inf  # empty interval: matches nothing
+                hi[j, k] = -np.inf
+            for k, iv in enumerate(ivs):
+                if iv.left is not None:
+                    lo[j, k] = iv.left
+                    lo_open[j, k] = iv.closure.startswith("open")
+                if iv.right is not None:
+                    hi[j, k] = iv.right
+                    hi_open[j, k] = iv.closure.endswith("Open")
+        policy.update(
+            lo=lo, hi=hi, lo_open=lo_open, hi_open=hi_open, has_ivl=has_ivl
+        )
+    else:
+        policy["has_ivl"] = None
+    return policy
+
+
 def extract_missing_replacements(
     schema: "ir.MiningSchema", ctx: "LowerCtx"
 ) -> Tuple[np.ndarray, np.ndarray]:

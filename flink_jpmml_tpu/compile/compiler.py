@@ -28,6 +28,7 @@ from flink_jpmml_tpu.compile.common import (
     ModelOutput,
     apply_targets,
     build_codecs,
+    extract_invalid_policy,
     extract_missing_replacements,
 )
 from flink_jpmml_tpu.compile.exprs import lower_expression
@@ -37,6 +38,10 @@ from flink_jpmml_tpu.compile.regression import lower_regression
 from flink_jpmml_tpu.compile.trees import lower_tree
 from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
 from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.pmml.outputs import (
+    compute_outputs,
+    validate_output_fields,
+)
 from flink_jpmml_tpu.utils.config import CompileConfig
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
@@ -78,6 +83,7 @@ class CompiledModel:
     _doc: Optional[ir.PmmlDocument] = None
     _config: Optional[CompileConfig] = None
     _quantized: object = _UNSET
+    output_fields: Tuple[ir.OutputField, ...] = ()  # top-level <Output>
 
     @property
     def is_classification(self) -> bool:
@@ -176,7 +182,27 @@ class CompiledModel:
                 probabilities = [
                     dict(zip(self.labels, row.tolist())) for row in P
                 ]
-        return decode_batch(value.tolist(), valid.tolist(), labels, probabilities)
+        preds = decode_batch(
+            value.tolist(), valid.tolist(), labels, probabilities
+        )
+        if self.output_fields:
+            # top-level <Output> post-processing (pmml/outputs.py): only
+            # documents that declare it pay this host-side per-record step
+            preds = [
+                p
+                if p.is_empty
+                else dataclasses.replace(
+                    p,
+                    outputs=compute_outputs(
+                        self.output_fields,
+                        p.score.value,
+                        p.target.label if p.target else None,
+                        p.target.probabilities if p.target else None,
+                    ),
+                )
+                for p in preds
+            ]
+        return preds
 
 
 def compile_pmml(
@@ -235,9 +261,53 @@ def compile_pmml(
     )
     any_repl = bool(has_repl.any())
     targets = doc.targets
+    # DataDictionary validity × invalidValueTreatment (None = nothing can
+    # be invalid; the sanitize stage compiles away entirely)
+    ivp = extract_invalid_policy(
+        doc.data_dictionary, doc.model.mining_schema, raw_ctx
+    )
 
     def full_fn(params, X, M):
         X = X.astype(jnp.float32)
+        lane_bad = None
+        if ivp is not None:
+            # a categorical cell is invalid unless it holds an exact code
+            # in [0, n_declared): covers the +inf marker that
+            # prepare.encode_cell emits for undeclared *strings* AND
+            # out-of-table pre-encoded codes on the dense-vector path
+            # (oracle-parity: both are returnInvalid by default)
+            inv = (
+                ivp["has_cat"][None, :]
+                & ~M
+                & (
+                    (X < 0)
+                    | (X >= ivp["cat_n"][None, :])
+                    | (X != jnp.round(X))
+                )
+            )
+            if ivp["has_ivl"] is not None:
+                xk = X[:, :, None]
+                ge = jnp.where(
+                    ivp["lo_open"][None], xk > ivp["lo"][None],
+                    xk >= ivp["lo"][None],
+                )
+                le = jnp.where(
+                    ivp["hi_open"][None], xk < ivp["hi"][None],
+                    xk <= ivp["hi"][None],
+                )
+                in_any = jnp.any(ge & le, axis=-1)
+                inv = inv | (ivp["has_ivl"][None, :] & ~in_any & ~M)
+            treat = ivp["treat"][None, :]
+            X = jnp.where(inv & (treat == 3), ivp["repl"][None, :], X)
+            M = M | (inv & (treat == 1))
+            lane_bad = jnp.any(inv & (treat == 2), axis=1)
+            # asIs / asMissing / returnInvalid categorical markers become
+            # a never-match code: not missing, equal/isIn to nothing —
+            # exactly "use the (undeclared) value as is"
+            X = jnp.where(
+                inv & ivp["has_cat"][None, :] & (treat != 3), -2.0, X
+            )
+            X = jnp.where(M, 0.0, X)
         if any_repl:
             use = M & has_repl[None, :]
             X = jnp.where(use, repl[None, :], X)
@@ -249,7 +319,10 @@ def compile_pmml(
             )
             M = jnp.concatenate([M, miss[:, None]], axis=1)
         out = lowered.fn(params, X, M)
-        return apply_targets(out, targets)
+        out = apply_targets(out, targets)
+        if lane_bad is not None:
+            out = out._replace(valid=out.valid & ~lane_bad)
+        return out
 
     donate_args = (
         config.donate_batches if donate is None else donate
@@ -258,6 +331,7 @@ def compile_pmml(
         full_fn, donate_argnums=(1, 2) if donate_args else ()
     )
 
+    validate_output_fields(doc.output_fields)
     name = getattr(doc.model, "model_name", None)
     return CompiledModel(
         field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
@@ -268,4 +342,5 @@ def compile_pmml(
         model_name=name,
         _doc=doc,
         _config=config,
+        output_fields=doc.output_fields,
     )

@@ -15,12 +15,16 @@ lastPrediction, nullPrediction); no match triggers noTrueChildStrategy.
 Predicates evaluate in three-valued logic per the PMML truth tables.
 
 Layout: every node's C child predicates are flattened to at most K
-sub-predicates (Simple / SimpleSet / True / False) plus a combiner code —
-single-level compounds only; nested CompoundPredicates are rejected. All
-tables are [T, N, C, K]-padded and the hop loop gathers per (record,
-tree) lane, so whole ensembles of irregular trees still evaluate as one
-jitted program. This path trades throughput for generality; the canonical
-backends remain the hot path and are preferred automatically.
+sub-predicates (Simple / SimpleSet / True / False) plus a combiner code.
+Single-level compounds keep their native combiner; arbitrarily nested
+and/or/xor compounds lower exactly to a DNF combiner (strong-Kleene
+normal form with per-literal negation — see _flatten_predicate); only
+nested *surrogates* are rejected (their positional UNKNOWN filtering
+does not distribute). All tables are [T, N, C, K]-padded and the hop
+loop gathers per (record, tree) lane, so whole ensembles of irregular
+trees still evaluate as one jitted program. This path trades throughput
+for generality; the canonical backends remain the hot path and are
+preferred automatically.
 """
 
 from __future__ import annotations
@@ -52,25 +56,46 @@ _OPS = {
     "isMissing": _P_IS_MISSING, "isNotMissing": _P_IS_NOT_MISSING,
 }
 
-# combiner codes
-_C_AND, _C_OR, _C_XOR, _C_SURROGATE = 0, 1, 2, 3
+# combiner codes. _C_DNF evaluates OR-over-AND-terms: each sub-predicate
+# slot carries a term id, slots AND within their term (strong-Kleene),
+# terms OR across — the normal form arbitrary nested and/or/xor compounds
+# lower to (see _flatten_predicate).
+_C_AND, _C_OR, _C_XOR, _C_SURROGATE, _C_DNF = 0, 1, 2, 3, 4
 
 _STRATEGIES = {"none": 0, "defaultChild": 1, "lastPrediction": 2,
                "nullPrediction": 3}
 
+# DNF expansion guards: a pathological deeply-xor-nested document could
+# blow up exponentially; reject it loudly instead of compiling forever
+_DNF_MAX_TERMS = 32
+_DNF_MAX_LITERALS = 256
+
+# sub-predicate tuple: (col, op, value, set_codes, negate, term_id)
+_Sub = Tuple[int, int, float, Tuple[float, ...], bool, int]
+
+
+class _NegWrap:
+    def __init__(self, inner: ir.Predicate):
+        self.inner = inner
+
 
 def _flatten_predicate(
     pred: ir.Predicate, ctx: LowerCtx
-) -> Tuple[int, List[Tuple[int, int, float, Tuple[float, ...]]]]:
-    """predicate → (combiner, [(col, op, value, set_codes), ...]).
+) -> Tuple[int, List[_Sub]]:
+    """predicate → (combiner, [(col, op, value, set_codes, neg, term)]).
 
-    Simple predicates become a one-element AND; nested compounds raise.
+    Simple predicates become a one-element AND. Single-level compounds
+    keep their native combiner. Nested and/or/xor compounds lower to
+    ``_C_DNF`` via exact strong-Kleene normal-form expansion; nested
+    surrogates are rejected.
     """
-    def leaf(p) -> Tuple[int, int, float, Tuple[float, ...]]:
+    def leaf(p, negated: bool, term: int) -> _Sub:
         if isinstance(p, ir.TruePredicate):
-            return 0, _P_TRUE, 0.0, ()
+            return (0, _P_FALSE if negated else _P_TRUE, 0.0, (), False,
+                    term)
         if isinstance(p, ir.FalsePredicate):
-            return 0, _P_FALSE, 0.0, ()
+            return (0, _P_TRUE if negated else _P_FALSE, 0.0, (), False,
+                    term)
         if isinstance(p, ir.SimplePredicate):
             if p.operator not in _OPS:
                 raise ModelCompilationException(
@@ -78,38 +103,141 @@ def _flatten_predicate(
                 )
             op = _OPS[p.operator]
             if op in (_P_IS_MISSING, _P_IS_NOT_MISSING):
-                return ctx.column(p.field), op, 0.0, ()
-            return ctx.column(p.field), op, ctx.encode(p.field, p.value), ()
+                if negated:  # ¬isMissing ≡ isNotMissing and vice versa
+                    op = (
+                        _P_IS_NOT_MISSING
+                        if op == _P_IS_MISSING
+                        else _P_IS_MISSING
+                    )
+                return ctx.column(p.field), op, 0.0, (), False, term
+            return (
+                ctx.column(p.field), op, ctx.encode(p.field, p.value), (),
+                negated, term,
+            )
         if isinstance(p, ir.SimpleSetPredicate):
             codes = tuple(ctx.encode(p.field, v) for v in p.values)
-            op = _P_IN if p.boolean_operator == "isIn" else _P_NOT_IN
+            is_in = (p.boolean_operator == "isIn") != negated
+            op = _P_IN if is_in else _P_NOT_IN
             if not codes:
                 # empty set: isIn {} ≡ false, isNotIn {} ≡ true
-                return 0, _P_FALSE if op == _P_IN else _P_TRUE, 0.0, ()
-            return ctx.column(p.field), op, 0.0, codes
+                return (0, _P_FALSE if is_in else _P_TRUE, 0.0, (), False,
+                        term)
+            return ctx.column(p.field), op, 0.0, codes, False, term
         raise ModelCompilationException(
             f"unsupported predicate {type(p).__name__} inside a compound"
         )
 
     if isinstance(pred, ir.CompoundPredicate):
+        has_nested = any(
+            isinstance(p, ir.CompoundPredicate) for p in pred.predicates
+        )
         comb = {"and": _C_AND, "or": _C_OR, "xor": _C_XOR,
                 "surrogate": _C_SURROGATE}.get(pred.boolean_operator)
         if comb is None:
             raise ModelCompilationException(
                 f"unsupported CompoundPredicate {pred.boolean_operator!r}"
             )
-        subs = []
-        for p in pred.predicates:
-            if isinstance(p, ir.CompoundPredicate):
-                raise ModelCompilationException(
-                    "nested CompoundPredicates have no vectorized lowering "
-                    "(flatten the document or use the oracle)"
-                )
-            subs.append(leaf(p))
-        if not subs:
+        if not pred.predicates:
             raise ModelCompilationException("empty CompoundPredicate")
-        return comb, subs
-    return _C_AND, [leaf(pred)]
+        if not has_nested:
+            subs = [leaf(p, False, 0) for p in pred.predicates]
+            return comb, subs
+        if comb == _C_SURROGATE:
+            raise ModelCompilationException(
+                "surrogate CompoundPredicates with compound children "
+                "have no vectorized lowering; restructure the document "
+                "or use the oracle"
+            )
+        terms = _dnf_terms(pred)
+        subs = []
+        for tid, t in enumerate(terms):
+            if not t:
+                # an empty AND term is vacuously TRUE (whole DNF is TRUE)
+                subs.append((0, _P_TRUE, 0.0, (), False, tid))
+                continue
+            for lit, negd in t:
+                subs.append(leaf(lit, negd, tid))
+        if len(subs) > _DNF_MAX_LITERALS:
+            raise ModelCompilationException(
+                f"nested CompoundPredicate expands past "
+                f"{_DNF_MAX_LITERALS} literals; restructure the document "
+                "or use the oracle"
+            )
+        if not subs:  # DNF with zero terms ≡ FALSE
+            return _C_AND, [(0, _P_FALSE, 0.0, (), False, 0)]
+        return _C_DNF, subs
+    return _C_AND, [leaf(pred, False, 0)]
+
+
+def _dnf_terms(pred: ir.Predicate):
+    """DNF of a (possibly _NegWrap-containing) predicate tree."""
+
+    def walk(p, neg: bool):
+        if isinstance(p, _NegWrap):
+            return walk(p.inner, not neg)
+        if isinstance(p, ir.TruePredicate):
+            return [] if neg else [[]]
+        if isinstance(p, ir.FalsePredicate):
+            return [[]] if neg else []
+        if not isinstance(p, ir.CompoundPredicate):
+            return [[(p, neg)]]
+        op = p.boolean_operator
+        kids = list(p.predicates)
+        if not kids:
+            raise ModelCompilationException("empty CompoundPredicate")
+        if op == "surrogate":
+            raise ModelCompilationException(
+                "surrogate CompoundPredicates nested inside and/or/xor "
+                "have no vectorized lowering (positional UNKNOWN "
+                "filtering does not distribute); restructure the "
+                "document or use the oracle"
+            )
+        if op == "xor":
+            acc = kids[0]
+            for k in kids[1:]:
+                acc = ir.CompoundPredicate(
+                    boolean_operator="or",
+                    predicates=(
+                        ir.CompoundPredicate(
+                            boolean_operator="and",
+                            predicates=(acc, _NegWrap(k)),
+                        ),
+                        ir.CompoundPredicate(
+                            boolean_operator="and",
+                            predicates=(_NegWrap(acc), k),
+                        ),
+                    ),
+                )
+            return walk(acc, neg)
+        if op not in ("and", "or"):
+            raise ModelCompilationException(
+                f"unsupported CompoundPredicate {op!r}"
+            )
+        effective_and = (op == "and") != neg
+        child_dnfs = [walk(k, neg) for k in kids]
+        if effective_and:
+            terms = [[]]
+            for dnf in child_dnfs:
+                terms = [a + b for a in terms for b in dnf]
+                if len(terms) > _DNF_MAX_TERMS:
+                    raise ModelCompilationException(
+                        f"nested CompoundPredicate expands past "
+                        f"{_DNF_MAX_TERMS} DNF terms; restructure the "
+                        "document or use the oracle"
+                    )
+            return terms
+        out = []
+        for dnf in child_dnfs:
+            out.extend(dnf)
+        if len(out) > _DNF_MAX_TERMS:
+            raise ModelCompilationException(
+                f"nested CompoundPredicate expands past "
+                f"{_DNF_MAX_TERMS} DNF terms; restructure the document "
+                "or use the oracle"
+            )
+        return out
+
+    return walk(pred, False)
 
 
 class _Flat:
@@ -188,6 +316,8 @@ def pack_general(
     pop = np.full((T, N, C, K), float(_P_FALSE), np.float32)  # pad: never T
     pval = np.zeros((T, N, C, K), np.float32)
     pact = np.zeros((T, N, C, K), np.float32)
+    pneg = np.zeros((T, N, C, K), np.float32)
+    pterm = np.zeros((T, N, C, K), np.float32)
     # padded child slots must evaluate FALSE: an empty AND is vacuously
     # TRUE in the three-valued combiner, an empty OR is FALSE — pad with OR
     pcomb = np.full((T, N, C), float(_C_OR), np.float32)
@@ -204,6 +334,8 @@ def pack_general(
     rop = np.full((T, K), float(_P_FALSE), np.float32)
     rval = np.zeros((T, K), np.float32)
     ract = np.zeros((T, K), np.float32)
+    rneg = np.zeros((T, K), np.float32)
+    rterm = np.zeros((T, K), np.float32)
     rsets = np.full((T, K, KS), np.nan, np.float32) if KS else None
 
     labels: Tuple[str, ...] = ()
@@ -224,21 +356,27 @@ def pack_general(
         # but its *value* is then null (interp._node_result returns None)
         valnull = np.zeros((T, N), np.float32)
 
-    def fill_pred(comb_arr, col_a, op_a, val_a, act_a, set_a, where, pred):
+    def fill_pred(
+        comb_arr, col_a, op_a, val_a, act_a, neg_a, term_a, set_a, where,
+        pred,
+    ):
         comb, subs = pred
         comb_arr[where] = comb
-        for k, (c_, o_, v_, s_) in enumerate(subs):
+        for k, (c_, o_, v_, s_, n_, t_) in enumerate(subs):
             col_a[where + (k,)] = c_
             op_a[where + (k,)] = o_
             val_a[where + (k,)] = v_
             act_a[where + (k,)] = 1.0
+            neg_a[where + (k,)] = 1.0 if n_ else 0.0
+            term_a[where + (k,)] = t_
             if s_ and set_a is not None:
                 set_a[where + (k,)][: len(s_)] = s_
 
     for ti, fl in enumerate(flats):
         # root predicate
         fill_pred(
-            rcomb, rcol, rop, rval, ract, rsets, (ti,), fl.rows[0]["pred"]
+            rcomb, rcol, rop, rval, ract, rneg, rterm, rsets, (ti,),
+            fl.rows[0]["pred"],
         )
         for ni, row in enumerate(fl.rows):
             children = row["children"]
@@ -249,7 +387,7 @@ def pack_general(
             for c, ci in enumerate(children):
                 child_idx[ti, ni, c] = ci
                 fill_pred(
-                    pcomb, pcol, pop, pval, pact, psets,
+                    pcomb, pcol, pop, pval, pact, pneg, pterm, psets,
                     (ti, ni, c), fl.rows[ci]["pred"],
                 )
             for c in range(len(children), C):
@@ -276,10 +414,11 @@ def pack_general(
 
     params: Dict[str, np.ndarray] = {
         "pcol": pcol, "pop": pop, "pval": pval, "pact": pact,
+        "pneg": pneg, "pterm": pterm,
         "pcomb": pcomb, "child_idx": child_idx, "dchild": dchild,
         "is_leaf": is_leaf, "scored": scored,
         "rcomb": rcomb, "rcol": rcol, "rop": rop, "rval": rval,
-        "ract": ract,
+        "ract": ract, "rneg": rneg, "rterm": rterm,
         "strat": np.asarray(strat_codes, np.float32),
         "ntc_last": np.asarray(ntc_last, np.float32),
     }
@@ -295,15 +434,22 @@ def pack_general(
     meta = {
         "T": T, "N": N, "C": C, "K": K, "KS": KS, "depth": depth,
         "labels": labels, "classification": classification,
+        # static: whether any node actually lowers to the DNF combiner —
+        # when none does, the eval skips the O(K²) term-matrix entirely
+        "has_dnf": bool(
+            (pcomb == _C_DNF).any() or (rcomb == _C_DNF).any()
+        ),
     }
     return params, meta
 
 
-def _sub_pred_eval(x, m, op, val, member):
+def _sub_pred_eval(x, m, op, val, member, neg=None):
     """One padded sub-predicate slot → (isT, isU) three-valued bools.
 
     ``x``/``m`` are the gathered feature value / missing mask, ``op`` the
-    opcode lane, ``member`` the set-membership lane (or None).
+    opcode lane, ``member`` the set-membership lane (or None); ``neg``
+    applies strong-Kleene negation (T↔F, U fixed) — produced by the DNF
+    lowering of nested compounds.
     """
     lt = x < val
     le = x <= val
@@ -331,13 +477,17 @@ def _sub_pred_eval(x, m, op, val, member):
         jnp.where(op == _P_IS_MISSING, m,
         jnp.where(op == _P_IS_NOT_MISSING, ~m, cmp & ~m))),
     )
+    if neg is not None:
+        isT = jnp.where(neg > 0.5, ~isT & ~isU, isT)
     return isT, isU
 
 
-def _combine(comb, isT, isU, act):
+def _combine(comb, isT, isU, act, term=None):
     """PMML three-valued combiners over the K axis (last axis).
 
     ``isT``/``isU``/``act`` are [..., K]; returns ([...] isT, [...] isU).
+    ``term`` carries the DNF term id per slot for the ``_C_DNF``
+    combiner (OR over AND-terms — the lowering of nested compounds).
     """
     known = act > 0.5
     t = isT & known
@@ -374,6 +524,20 @@ def _combine(comb, isT, isU, act):
         jnp.where(comb == _C_OR, or_U,
         jnp.where(comb == _C_XOR, xor_U, sur_U)),
     )
+    if term is not None:
+        # DNF: strong-Kleene AND within each term id, OR across terms.
+        # Padded slots drop out via `known`; an all-padding term id is
+        # empty → F, which the OR ignores.
+        tid = jnp.arange(K, dtype=term.dtype)
+        in_term = (term[..., :, None] == tid) & known[..., :, None]
+        termF = jnp.any(f[..., :, None] & in_term, axis=-2)  # [..., Kt]
+        termU = jnp.any(u[..., :, None] & in_term, axis=-2) & ~termF
+        nonempty = jnp.any(in_term, axis=-2)
+        termT = nonempty & ~termF & ~termU
+        dnf_T = jnp.any(termT, axis=-1)
+        dnf_U = ~dnf_T & jnp.any(termU, axis=-1)
+        outT = jnp.where(comb == _C_DNF, dnf_T, outT)
+        outU = jnp.where(comb == _C_DNF, dnf_U, outU)
     return outT, outU
 
 
@@ -387,6 +551,7 @@ def make_general_eval(params: Dict[str, np.ndarray], meta: dict):
     T, N, C, K = meta["T"], meta["N"], meta["C"], meta["K"]
     depth = meta["depth"]
     has_sets = "psets" in params
+    has_dnf = meta.get("has_dnf", True)
 
     def child_truth(p, X, M, g, c):
         """(isT, isU) of child c's predicate at nodes g [B,T]."""
@@ -396,6 +561,12 @@ def make_general_eval(params: Dict[str, np.ndarray], meta: dict):
         op = jnp.take(p["pop"].reshape(flatsz, K), gc, axis=0)
         val = jnp.take(p["pval"].reshape(flatsz, K), gc, axis=0)
         act = jnp.take(p["pact"].reshape(flatsz, K), gc, axis=0)
+        neg = jnp.take(p["pneg"].reshape(flatsz, K), gc, axis=0)
+        term = (
+            jnp.take(p["pterm"].reshape(flatsz, K), gc, axis=0)
+            if has_dnf
+            else None
+        )
         comb = jnp.take(p["pcomb"].reshape(flatsz), gc)
         B = X.shape[0]
         x = jnp.take_along_axis(
@@ -411,8 +582,8 @@ def make_general_eval(params: Dict[str, np.ndarray], meta: dict):
                 p["psets"].reshape(flatsz, K, KS), gc, axis=0
             )  # [B,T,K,KS]
             member = jnp.any(x[..., None] == sets, axis=-1)
-        isT, isU = _sub_pred_eval(x, m, op, val, member)
-        return _combine(comb, isT, isU, act)
+        isT, isU = _sub_pred_eval(x, m, op, val, member, neg)
+        return _combine(comb, isT, isU, act, term)
 
     def root_truth(p, X, M):
         col = p["rcol"]  # [T,K]
@@ -431,8 +602,11 @@ def make_general_eval(params: Dict[str, np.ndarray], meta: dict):
             member = jnp.any(
                 x[..., None] == p["rsets"][None], axis=-1
             )
-        isT, isU = _sub_pred_eval(x, m, op, val, member)
-        return _combine(p["rcomb"][None], isT, isU, act)
+        isT, isU = _sub_pred_eval(x, m, op, val, member, p["rneg"][None])
+        return _combine(
+            p["rcomb"][None], isT, isU, act,
+            p["rterm"][None] if has_dnf else None,
+        )
 
     def fn(p: dict, X: jnp.ndarray, M: jnp.ndarray):
         B = X.shape[0]

@@ -65,7 +65,68 @@ def lower_neural_network(model: ir.NeuralNetworkIR, ctx: LowerCtx) -> Lowered:
                     )
                 W[index[src], j] = w
         act_name = layer.activation or model.activation_function
-        if act_name not in _ACTIVATIONS:
+        act_spec: dict = {"kind": "plain", "name": act_name}
+        if act_name == "threshold":
+            # out = 1 if z > threshold else 0 (cut from layer, else model)
+            thr = (
+                layer.threshold
+                if layer.threshold is not None
+                else model.threshold
+            )
+            act_spec = {"kind": "threshold", "thr": float(thr)}
+        elif act_name == "radialBasis":
+            # RBF neuron: the Con weights are the center; per the spec
+            #   z_j = Σ_i (w_ij − x_i)²
+            #   out = exp(fanIn_j · ln(altitude_j) − z_j / (2·width_j²))
+            # width resolves Neuron → Layer → Network (required), altitude
+            # likewise (default 1.0); bias is unused.
+            widths = np.zeros((len(layer.neurons),), np.float32)
+            alts = np.zeros((len(layer.neurons),), np.float32)
+            fanin = np.zeros((len(layer.neurons),), np.float32)
+            conn = np.zeros((len(prev_ids), len(layer.neurons)), np.float32)
+            index2 = {nid: i for i, nid in enumerate(prev_ids)}
+            for j, neuron in enumerate(layer.neurons):
+                w = (
+                    neuron.width
+                    if neuron.width is not None
+                    else (
+                        layer.width
+                        if layer.width is not None
+                        else model.width
+                    )
+                )
+                if w is None or w <= 0:
+                    raise ModelCompilationException(
+                        f"radialBasis neuron {neuron.neuron_id!r} has no "
+                        "positive width (Neuron/NeuralLayer/NeuralNetwork)"
+                    )
+                widths[j] = w
+                a = (
+                    neuron.altitude
+                    if neuron.altitude is not None
+                    else (
+                        layer.altitude
+                        if layer.altitude is not None
+                        else model.altitude
+                    )
+                )
+                if a <= 0:
+                    raise ModelCompilationException(
+                        f"radialBasis neuron {neuron.neuron_id!r} has "
+                        f"non-positive altitude {a}"
+                    )
+                alts[j] = a
+                fanin[j] = len(neuron.weights)
+                for src, _w in neuron.weights:
+                    conn[index2[src], j] = 1.0
+            act_spec = {
+                "kind": "rbf",
+                "widths": widths,
+                "log_alt": np.log(alts).astype(np.float32),
+                "fanin": fanin,
+                "conn": conn,
+            }
+        elif act_name not in _ACTIVATIONS:
             raise ModelCompilationException(
                 f"unsupported activation {act_name!r}"
             )
@@ -78,7 +139,7 @@ def lower_neural_network(model: ir.NeuralNetworkIR, ctx: LowerCtx) -> Lowered:
                 f"unsupported layer normalization {norm!r}"
             )
         layer_weights.append((W, b))
-        layer_acts.append(act_name)
+        layer_acts.append(act_spec)
         layer_norms.append(norm)
         prev_ids = [n.neuron_id for n in layer.neurons]
         all_ids_per_layer.append(prev_ids)
@@ -94,10 +155,28 @@ def lower_neural_network(model: ir.NeuralNetworkIR, ctx: LowerCtx) -> Lowered:
         missing = misses[0]
         for m2 in misses[1:]:
             missing = missing | m2
-        for i, act_name in enumerate(layer_acts):
+        for i, spec in enumerate(layer_acts):
             lp = p[f"l{i}"]
-            z = jnp.dot(h, lp["W"], precision=HIGHEST) + lp["b"]
-            h = _ACTIVATIONS[act_name](z)
+            if spec["kind"] == "rbf":
+                # z_j = Σ_i conn_ij (w_ij − h_i)², expanded so the MXU
+                # carries it: colsum(conn·W²) − 2 h@(conn·W) + h²@conn
+                W_, conn = lp["W"], spec["conn"]
+                cw = conn * W_
+                z = (
+                    jnp.sum(cw * W_, axis=0)[None, :]
+                    - 2.0 * jnp.dot(h, cw, precision=HIGHEST)
+                    + jnp.dot(h * h, conn, precision=HIGHEST)
+                )
+                h = jnp.exp(
+                    spec["fanin"] * spec["log_alt"]
+                    - z / (2.0 * spec["widths"] * spec["widths"])
+                )
+            else:
+                z = jnp.dot(h, lp["W"], precision=HIGHEST) + lp["b"]
+                if spec["kind"] == "threshold":
+                    h = (z > spec["thr"]).astype(jnp.float32)
+                else:
+                    h = _ACTIVATIONS[spec["name"]](z)
             if layer_norms[i] == "softmax":
                 h = softmax(h)
             elif layer_norms[i] == "simplemax":

@@ -35,15 +35,19 @@ class FieldSpace:
         return len(self.fields)
 
     def encode_cell(self, field: str, v: Value) -> float:
-        """One raw value → float code; NaN encodes 'missing'."""
+        """One raw value → float code; NaN encodes 'missing', +inf marks
+        an *invalid* (undeclared) category — the compiled sanitize stage
+        applies the mining schema's invalidValueTreatment to it
+        (compiler.full_fn; spec default returnInvalid)."""
         if v is None:
             return math.nan
         if isinstance(v, str):
             codec = self.codecs.get(field)
             if codec is not None:
-                # undeclared category → missing; no numeric fallback (it
-                # would alias a numeric-looking string onto a code)
-                return codec.get(v, math.nan)
+                # undeclared category → invalid marker; no numeric
+                # fallback (it would alias a numeric-looking string onto
+                # a code)
+                return codec.get(v, math.inf)
             try:
                 return float(v)
             except ValueError:

@@ -46,6 +46,7 @@ from flink_jpmml_tpu.compile.common import (
     LowerCtx,
     apply_targets_value,
     build_codecs,
+    extract_invalid_policy,
     extract_missing_replacements,
 )
 from flink_jpmml_tpu.compile.trees import (
@@ -284,6 +285,10 @@ def build_quantized_scorer(
     if doc.transformations.derived_fields:
         # derived-field preprocessing isn't folded into the rank wire
         return None
+    if doc.output_fields:
+        # top-level <Output> post-processing happens in CompiledModel
+        # .decode; the wire's decode path doesn't carry it
+        return None
     matched = _match_ensemble(doc)
     if matched is None:
         return None
@@ -295,6 +300,14 @@ def build_quantized_scorer(
         codecs=build_codecs(doc.data_dictionary),
         config=config,
     )
+    # the rank wire bypasses compiler.full_fn's sanitize stage: any doc
+    # whose fields can be *invalid* (declared category tables, Intervals)
+    # must stay on the f32 path for invalidValueTreatment semantics
+    if (
+        extract_invalid_policy(doc.data_dictionary, doc.model.mining_schema, ctx)
+        is not None
+    ):
+        return None
     try:
         canons, classification, depth = _canonicalize_forest(trees, ctx)
     except ModelCompilationException:

@@ -68,11 +68,14 @@ class Prediction:
     ``score`` is total: either a :class:`Score` or :class:`EmptyScore`.
     ``target`` carries the decoded class label / per-class probabilities for
     classification models (``None`` for pure regression / clustering outputs
-    where ``score`` already says everything).
+    where ``score`` already says everything). ``outputs`` carries the
+    document's top-level <Output> field values when it declares any
+    (pmml/outputs.py), ``None`` otherwise.
     """
 
     score: ScoreLike
     target: Optional[Target] = None
+    outputs: Optional[Mapping[str, Any]] = None
 
     @property
     def is_empty(self) -> bool:

@@ -45,6 +45,7 @@ class EvalResult:
     value: Optional[float] = None
     label: Optional[str] = None
     probabilities: Dict[str, float] = dc_field(default_factory=dict)
+    outputs: Dict[str, object] = dc_field(default_factory=dict)
 
     @property
     def is_missing(self) -> bool:
@@ -235,13 +236,26 @@ def _apply_function(fn: str, args: List[float]) -> Optional[float]:
 
 def evaluate(doc: ir.PmmlDocument, record: Record) -> EvalResult:
     """Score one record through the document, applying DataDictionary value
-    sanitization, mining-schema missing-value replacement and Targets
-    rescaling — the oracle's public entry."""
-    rec = _sanitize_categoricals(doc.data_dictionary, record)
+    sanitization + mining-schema invalidValueTreatment, missing-value
+    replacement and Targets rescaling — the oracle's public entry."""
+    rec, invalid = _apply_invalid_treatment(
+        doc.data_dictionary, doc.model.mining_schema, record
+    )
+    if invalid:
+        # returnInvalid: the record's result is invalid — an EmptyScore
+        # lane under the totality contract (C5), never an exception
+        return EvalResult()
     rec = _apply_missing_replacement(doc.model.mining_schema, rec)
     rec = _apply_transformations(doc.transformations, rec)
     res = _eval_model(doc.model, rec)
-    return _apply_targets(doc.targets, res)
+    res = _apply_targets(doc.targets, res)
+    if doc.output_fields and not res.is_missing:
+        from flink_jpmml_tpu.pmml.outputs import compute_outputs
+
+        res.outputs = compute_outputs(
+            doc.output_fields, res.value, res.label, res.probabilities
+        )
+    return res
 
 
 def _apply_transformations(
@@ -258,34 +272,76 @@ def _apply_transformations(
     return out
 
 
-def _sanitize_categoricals(dd: ir.DataDictionary, record: Record) -> Record:
-    """DataDictionary-declared string categoricals: an undeclared string
-    value is *invalid* → treated as missing (matching the compiled path's
-    codec behavior); a float value is interpreted as a pre-encoded category
-    code (the dense-vector convention) and decoded back to its category."""
-    decl = {
+def _apply_invalid_treatment(
+    dd: ir.DataDictionary, schema: ir.MiningSchema, record: Record
+) -> Tuple[Record, bool]:
+    """DataDictionary validity + mining-schema ``invalidValueTreatment``.
+
+    A value is *invalid* when the string categorical is undeclared (the
+    DataField lists valid Values) or a continuous value falls outside the
+    DataField's declared Intervals. Per the schema's treatment —
+    ``returnInvalid`` (the spec default): the whole record's result is
+    invalid; ``asMissing``: the cell becomes missing; ``asIs``: the raw
+    value is kept (an undeclared category then simply matches no
+    predicate); ``asValue``: the cell takes ``invalidValueReplacement``.
+    Float inputs on declared string categoricals are the dense-vector
+    convention (pre-encoded codes) and decode back; out-of-table codes
+    are invalid too. → (possibly-rewritten record, record_is_invalid).
+    """
+    decl_cat = {
         f.name: f.values
         for f in dd.fields
         if f.is_categorical and f.dtype == "string" and f.values
     }
-    if not decl:
-        return record
+    decl_ivl = {
+        f.name: f.intervals for f in dd.fields if f.intervals
+    }
+    if not decl_cat and not decl_ivl:
+        return record, False
+    treat = {
+        f.name: (f.invalid_value_treatment, f.invalid_value_replacement)
+        for f in schema.fields
+    }
     out = dict(record)
-    for name, values in decl.items():
+    invalid_record = False
+    for name in set(decl_cat) | set(decl_ivl):
         if name not in out:
             continue
         v = out[name]
         if _is_missing(v):
             continue
-        if isinstance(v, str):
-            if v not in values:
-                out[name] = None
-        elif not math.isfinite(v):
-            out[name] = None
+        is_invalid = False
+        if name in decl_cat:
+            values = decl_cat[name]
+            if isinstance(v, str):
+                is_invalid = v not in values
+            elif not math.isfinite(v):
+                is_invalid = True
+            else:
+                idx = int(v)
+                if 0 <= idx < len(values) and idx == v:
+                    out[name] = values[idx]
+                    v = out[name]
+                else:
+                    is_invalid = True
         else:
-            idx = int(v)
-            out[name] = values[idx] if 0 <= idx < len(values) and idx == v else None
-    return out
+            f = _as_float(v)
+            if f is not None and not any(
+                iv.contains(f) for iv in decl_ivl[name]
+            ):
+                is_invalid = True
+        if not is_invalid:
+            continue
+        mode, repl = treat.get(name, ("returnInvalid", None))
+        if mode == "asIs":
+            continue  # keep the raw value
+        if mode == "asMissing":
+            out[name] = None
+        elif mode == "asValue":
+            out[name] = repl if repl is not None else None
+        else:  # returnInvalid (spec default)
+            invalid_record = True
+    return out, invalid_record
 
 
 def _apply_missing_replacement(schema: ir.MiningSchema, record: Record) -> Record:
@@ -515,13 +571,55 @@ def _eval_neural_network(model: ir.NeuralNetworkIR, record: Record) -> EvalResul
         acts[ni.neuron_id] = v
     for layer in model.layers:
         fn_name = layer.activation or model.activation_function
-        fn = _ACTIVATIONS.get(fn_name)
-        if fn is None:
-            raise ModelCompilationException(f"unsupported activation {fn_name!r}")
         zs = {}
-        for n in layer.neurons:
-            z = n.bias + sum(acts[src] * w for src, w in n.weights)
-            zs[n.neuron_id] = fn(z)
+        if fn_name == "threshold":
+            thr = (
+                layer.threshold
+                if layer.threshold is not None
+                else model.threshold
+            )
+            for n in layer.neurons:
+                z = n.bias + sum(acts[src] * w for src, w in n.weights)
+                zs[n.neuron_id] = 1.0 if z > thr else 0.0
+        elif fn_name == "radialBasis":
+            for n in layer.neurons:
+                width = (
+                    n.width
+                    if n.width is not None
+                    else (
+                        layer.width
+                        if layer.width is not None
+                        else model.width
+                    )
+                )
+                if width is None or width <= 0:
+                    raise ModelCompilationException(
+                        f"radialBasis neuron {n.neuron_id!r} has no "
+                        "positive width"
+                    )
+                alt = (
+                    n.altitude
+                    if n.altitude is not None
+                    else (
+                        layer.altitude
+                        if layer.altitude is not None
+                        else model.altitude
+                    )
+                )
+                z = sum((w - acts[src]) ** 2 for src, w in n.weights)
+                zs[n.neuron_id] = math.exp(
+                    len(n.weights) * math.log(alt)
+                    - z / (2.0 * width * width)
+                )
+        else:
+            fn = _ACTIVATIONS.get(fn_name)
+            if fn is None:
+                raise ModelCompilationException(
+                    f"unsupported activation {fn_name!r}"
+                )
+            for n in layer.neurons:
+                z = n.bias + sum(acts[src] * w for src, w in n.weights)
+                zs[n.neuron_id] = fn(z)
         norm = layer.normalization or (
             model.normalization_method if layer is model.layers[-1] else "none"
         )
@@ -583,6 +681,8 @@ def _denorm_continuous(y: float, expr: ir.NormContinuous) -> float:
 
 
 def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
+    from flink_jpmml_tpu.compile.clustering import resolve_compare
+
     xs: List[Optional[float]] = []
     weights: List[float] = []
     for cf in model.clustering_fields:
@@ -590,30 +690,56 @@ def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
         weights.append(cf.weight)
     if any(x is None for x in xs):
         return EvalResult()
+    cmp_codes, gauss_s = resolve_compare(model)
+    mink_p = float(model.measure.minkowski_p)
     best_idx, best_dist = -1, math.inf
+    dists: List[float] = []
     for i, cl in enumerate(model.clusters):
         if len(cl.center) != len(xs):
             raise ModelCompilationException(
                 f"cluster {i} center arity {len(cl.center)} != fields {len(xs)}"
             )
-        diffs = [w * abs(x - c) for x, c, w in zip(xs, cl.center, weights)]
+        cs = []
+        for j, (x, z) in enumerate(zip(xs, cl.center)):
+            code = int(cmp_codes[j])
+            if code == 1:  # gaussSim: exp(−ln2·(x−z)²/s²)
+                s = float(gauss_s[j])
+                cs.append(math.exp(-math.log(2.0) * (x - z) ** 2 / (s * s)))
+            elif code == 2:  # delta
+                cs.append(0.0 if x == z else 1.0)
+            elif code == 3:  # equal
+                cs.append(1.0 if x == z else 0.0)
+            else:  # absDiff
+                cs.append(abs(x - z))
         m = model.measure.metric
+        # spec aggregation: the field weight multiplies the *powered*
+        # comparison (Σ w·c², not Σ (w·c)²)
         if m == "squaredEuclidean":
-            d = sum(dd * dd for dd in diffs)
+            d = sum(w * c * c for w, c in zip(weights, cs))
         elif m == "euclidean":
-            d = math.sqrt(sum(dd * dd for dd in diffs))
+            d = math.sqrt(sum(w * c * c for w, c in zip(weights, cs)))
         elif m == "cityBlock":
-            d = sum(diffs)
+            d = sum(w * c for w, c in zip(weights, cs))
         elif m == "chebychev":
-            d = max(diffs)
+            d = max(w * c for w, c in zip(weights, cs))
+        elif m == "minkowski":
+            d = sum(
+                w * abs(c) ** mink_p for w, c in zip(weights, cs)
+            ) ** (1.0 / mink_p)
         else:
             raise ModelCompilationException(f"unsupported metric {m!r}")
+        dists.append(d)
         if d < best_dist:
             best_idx, best_dist = i, d
-    cl = model.clusters[best_idx]
-    label = cl.cluster_id or cl.name or str(best_idx + 1)
-    return EvalResult(value=float(best_idx), label=label,
-                      probabilities={"distance": best_dist})
+    labels = [
+        cl.cluster_id or cl.name or str(i + 1)
+        for i, cl in enumerate(model.clusters)
+    ]
+    # per-cluster distances keyed by cluster label — the same shape the
+    # compiled decode exposes (target.probabilities), so top-level
+    # <Output> probability fields agree between the two paths
+    return EvalResult(value=float(best_idx), label=labels[best_idx],
+                      probabilities=dict(zip(labels, dists)))
 
 
 # --- MiningModel -----------------------------------------------------------

@@ -26,11 +26,39 @@ ORDINAL = "ordinal"
 
 
 @dataclass(frozen=True)
+class Interval:
+    """Declared valid range of a continuous DataField (PMML <Interval>).
+
+    ``closure`` ∈ openOpen | openClosed | closedOpen | closedClosed;
+    a missing margin means unbounded on that side."""
+
+    closure: str
+    left: Optional[float] = None
+    right: Optional[float] = None
+
+    def contains(self, x: float) -> bool:
+        if self.left is not None:
+            if self.closure.startswith("open"):
+                if not x > self.left:
+                    return False
+            elif not x >= self.left:
+                return False
+        if self.right is not None:
+            if self.closure.endswith("Open"):
+                if not x < self.right:
+                    return False
+            elif not x <= self.right:
+                return False
+        return True
+
+
+@dataclass(frozen=True)
 class DataField:
     name: str
     optype: str  # continuous | categorical | ordinal
     dtype: str  # double | float | integer | string | boolean
     values: Tuple[str, ...] = ()  # declared categories, in document order
+    intervals: Tuple[Interval, ...] = ()  # declared valid ranges
 
     @property
     def is_categorical(self) -> bool:
@@ -57,6 +85,7 @@ class MiningField:
     usage_type: str = "active"  # active | target | predicted | supplementary
     missing_value_replacement: Optional[str] = None
     invalid_value_treatment: str = "returnInvalid"
+    invalid_value_replacement: Optional[str] = None  # for asValue
 
 
 @dataclass(frozen=True)
@@ -277,6 +306,8 @@ class Neuron:
     neuron_id: str
     bias: float
     weights: Tuple[Tuple[str, float], ...]  # (from_neuron_id, weight)
+    width: Optional[float] = None  # radialBasis RBF width override
+    altitude: Optional[float] = None  # radialBasis altitude override
 
 
 @dataclass(frozen=True)
@@ -284,6 +315,9 @@ class NeuralLayer:
     neurons: Tuple[Neuron, ...]
     activation: Optional[str] = None  # overrides model default
     normalization: Optional[str] = None  # softmax | simplemax
+    threshold: Optional[float] = None  # threshold activation cut
+    width: Optional[float] = None
+    altitude: Optional[float] = None
 
 
 @dataclass(frozen=True)
@@ -302,6 +336,9 @@ class NeuralNetworkIR:
     outputs: Tuple[NeuralOutput, ...]
     normalization_method: str = "none"
     model_name: Optional[str] = None
+    threshold: float = 0.0  # threshold-activation cut (spec default 0)
+    width: Optional[float] = None  # radialBasis defaults
+    altitude: float = 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -320,14 +357,16 @@ class Cluster:
 class ClusteringField:
     field: str
     weight: float = 1.0
-    compare_function: Optional[str] = None  # absDiff | delta | …
+    compare_function: Optional[str] = None  # absDiff | gaussSim | delta | equal
+    similarity_scale: Optional[float] = None  # gaussSim scale s
 
 
 @dataclass(frozen=True)
 class ComparisonMeasure:
     kind: str  # distance | similarity
-    metric: str  # squaredEuclidean euclidean cityBlock chebychev
+    metric: str  # squaredEuclidean euclidean cityBlock chebychev minkowski
     compare_function: str = "absDiff"
+    minkowski_p: float = 2.0  # <minkowski p-parameter=…/>
 
 
 @dataclass(frozen=True)
@@ -356,11 +395,17 @@ ModelIR = Union[
 
 @dataclass(frozen=True)
 class OutputField:
-    """Subset of PMML <Output>: feature exported by a segment (for modelChain)."""
+    """PMML <Output>/<OutputField>: post-processing of the model result.
+
+    Used both per-segment (modelChain wiring) and at the document top
+    level. ``feature``: predictedValue | probability (``target_value``
+    picks the class; absent = the winner's) | transformedValue (whose
+    ``expression`` may reference previously computed output fields)."""
 
     name: str
     feature: str = "predictedValue"  # predictedValue | probability | …
     target_value: Optional[str] = None
+    expression: Optional[Expression] = None  # transformedValue only
 
 
 @dataclass(frozen=True)
@@ -415,6 +460,7 @@ class PmmlDocument:
     transformations: TransformationDictionary
     model: ModelIR
     targets: Tuple[Target, ...] = ()
+    output_fields: Tuple[OutputField, ...] = ()  # top-level <Output>
 
     @property
     def active_fields(self) -> Tuple[str, ...]:

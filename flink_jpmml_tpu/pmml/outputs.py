@@ -1,0 +1,86 @@
+"""Top-level <Output> post-processing, shared by the compiled decode path
+and the oracle interpreter (one implementation — the two cannot diverge).
+
+Reference parity: JPMML exposes OutputFields alongside the target on every
+evaluation result; the reference's users read them off the result map
+(SURVEY.md §1 C1). Here they land as the ``outputs`` mapping on
+:class:`~flink_jpmml_tpu.models.prediction.Prediction` (compiled) and
+:class:`~flink_jpmml_tpu.pmml.interp.EvalResult` (oracle).
+
+Features: ``predictedValue`` (the label for classification, the numeric
+value otherwise), ``probability`` (``value`` attribute picks the class;
+absent = the winning label's), and ``transformedValue`` whose expression
+is evaluated over the *previously declared output fields* (the common
+use: rescale/link the predicted value). Expressions referencing raw input
+fields are not supported on the compiled path — inputs are gone by
+decode time — and therefore rejected for both paths at validation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+_FEATURES = ("predictedValue", "probability", "transformedValue")
+
+
+def _expr_field_refs(expr: ir.Expression) -> set:
+    refs = set()
+    if isinstance(expr, ir.FieldRef):
+        refs.add(expr.field)
+    elif isinstance(expr, ir.Apply):
+        for a in expr.args:
+            refs |= _expr_field_refs(a)
+    elif isinstance(expr, (ir.NormContinuous, ir.NormDiscrete)):
+        refs.add(expr.field)
+    return refs
+
+
+def validate_output_fields(
+    output_fields: Sequence[ir.OutputField],
+) -> None:
+    """Compile-time validation: known features; transformedValue
+    expressions may reference only previously declared output fields."""
+    seen: set = set()
+    for of in output_fields:
+        if of.feature not in _FEATURES:
+            raise ModelCompilationException(
+                f"unsupported OutputField feature {of.feature!r} "
+                f"(supported: {', '.join(_FEATURES)})"
+            )
+        if of.feature == "transformedValue":
+            refs = _expr_field_refs(of.expression)
+            unknown = refs - seen
+            if unknown:
+                raise ModelCompilationException(
+                    f"OutputField {of.name!r}: transformedValue may only "
+                    f"reference previously declared output fields; "
+                    f"{sorted(unknown)} are not "
+                    f"(inputs are not available at decode time)"
+                )
+        seen.add(of.name)
+
+
+def compute_outputs(
+    output_fields: Sequence[ir.OutputField],
+    value: Optional[float],
+    label: Optional[str],
+    probabilities: Optional[Mapping[str, float]],
+) -> Dict[str, object]:
+    """One record's model result → its <Output> field values, in
+    declaration order (later transformedValues see earlier outputs)."""
+    from flink_jpmml_tpu.pmml.interp import eval_expression
+
+    probs = probabilities or {}
+    out: Dict[str, object] = {}
+    for of in output_fields:
+        if of.feature == "predictedValue":
+            out[of.name] = label if label is not None else value
+        elif of.feature == "probability":
+            key = of.target_value if of.target_value is not None else label
+            out[of.name] = probs.get(key) if key is not None else None
+        else:  # transformedValue (validated)
+            out[of.name] = eval_expression(of.expression, out)
+    return out

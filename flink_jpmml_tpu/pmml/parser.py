@@ -120,6 +120,7 @@ def parse_pmml(xml_text: str) -> ir.PmmlDocument:
 
     model = _parse_model(model_elem)
     targets = _parse_targets(_child(model_elem, "Targets"))
+    output_fields = _parse_output(_child(model_elem, "Output"))
     return ir.PmmlDocument(
         version=version,
         header=header,
@@ -127,7 +128,40 @@ def parse_pmml(xml_text: str) -> ir.PmmlDocument:
         transformations=transformations,
         model=model,
         targets=targets,
+        output_fields=output_fields,
     )
+
+
+def _parse_output(out_elem: Optional[ET.Element]) -> tuple:
+    """Top-level <Output>: predictedValue / probability / transformedValue
+    (whose expression child may reference previously declared output
+    fields)."""
+    if out_elem is None:
+        return ()
+    out = []
+    for of in _children(out_elem, "OutputField"):
+        feature = of.get("feature", "predictedValue")
+        expr = None
+        if feature == "transformedValue":
+            for c in of:
+                parsed = _try_parse_expression(c)
+                if parsed is not None:
+                    expr = parsed
+                    break
+            if expr is None:
+                raise ModelLoadingException(
+                    f"OutputField {of.get('name')!r}: transformedValue "
+                    "needs an expression child"
+                )
+        out.append(
+            ir.OutputField(
+                name=of.get("name", ""),
+                feature=feature,
+                target_value=of.get("value"),
+                expression=expr,
+            )
+        )
+    return tuple(out)
 
 
 def parse_pmml_file(path: str) -> ir.PmmlDocument:
@@ -161,12 +195,24 @@ def _parse_data_dictionary(elem: ET.Element) -> ir.DataDictionary:
             v.get("value", "") for v in _children(df, "Value")
             if v.get("property", "valid") == "valid"
         )
+        intervals = []
+        for iv in _children(df, "Interval"):
+            left = iv.get("leftMargin")
+            right = iv.get("rightMargin")
+            intervals.append(
+                ir.Interval(
+                    closure=iv.get("closure", "closedClosed"),
+                    left=float(left) if left is not None else None,
+                    right=float(right) if right is not None else None,
+                )
+            )
         fields.append(
             ir.DataField(
                 name=df.get("name", ""),
                 optype=df.get("optype", "continuous"),
                 dtype=df.get("dataType", "double"),
                 values=values,
+                intervals=tuple(intervals),
             )
         )
     return ir.DataDictionary(fields=tuple(fields))
@@ -182,6 +228,7 @@ def _parse_mining_schema(elem: ET.Element) -> ir.MiningSchema:
                 usage_type=mf.get("usageType", "active"),
                 missing_value_replacement=mf.get("missingValueReplacement"),
                 invalid_value_treatment=mf.get("invalidValueTreatment", "returnInvalid"),
+                invalid_value_replacement=mf.get("invalidValueReplacement"),
             )
         )
     return ir.MiningSchema(fields=tuple(fields))
@@ -495,6 +542,16 @@ def _parse_neural_network(elem: ET.Element) -> ir.NeuralNetworkIR:
                     neuron_id=n.get("id", ""),
                     bias=_float(n, "bias", 0.0),
                     weights=weights,
+                    width=(
+                        float(n.get("width"))
+                        if n.get("width") is not None
+                        else None
+                    ),
+                    altitude=(
+                        float(n.get("altitude"))
+                        if n.get("altitude") is not None
+                        else None
+                    ),
                 )
             )
         layers.append(
@@ -502,6 +559,21 @@ def _parse_neural_network(elem: ET.Element) -> ir.NeuralNetworkIR:
                 neurons=tuple(neurons),
                 activation=nl.get("activationFunction"),
                 normalization=nl.get("normalizationMethod"),
+                threshold=(
+                    float(nl.get("threshold"))
+                    if nl.get("threshold") is not None
+                    else None
+                ),
+                width=(
+                    float(nl.get("width"))
+                    if nl.get("width") is not None
+                    else None
+                ),
+                altitude=(
+                    float(nl.get("altitude"))
+                    if nl.get("altitude") is not None
+                    else None
+                ),
             )
         )
     outputs = []
@@ -523,6 +595,13 @@ def _parse_neural_network(elem: ET.Element) -> ir.NeuralNetworkIR:
         outputs=tuple(outputs),
         normalization_method=elem.get("normalizationMethod", "none"),
         model_name=elem.get("modelName"),
+        threshold=float(elem.get("threshold", 0.0)),
+        width=(
+            float(elem.get("width"))
+            if elem.get("width") is not None
+            else None
+        ),
+        altitude=float(elem.get("altitude", 1.0)),
     )
 
 
@@ -539,6 +618,7 @@ def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
         "euclidean": "euclidean",
         "cityBlock": "cityBlock",
         "chebychev": "chebychev",
+        "minkowski": "minkowski",
     }
     metric = metric_map.get(_local(metric_elem.tag))
     if metric is None:
@@ -550,6 +630,11 @@ def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
             field=cf.get("field", ""),
             weight=_float(cf, "fieldWeight", 1.0),
             compare_function=cf.get("compareFunction"),
+            similarity_scale=(
+                float(cf.get("similarityScale"))
+                if cf.get("similarityScale") is not None
+                else None
+            ),
         )
         for cf in _children(elem, "ClusteringField")
     )
@@ -571,6 +656,7 @@ def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
             kind=cm.get("kind", "distance"),
             metric=metric,
             compare_function=cm.get("compareFunction", "absDiff"),
+            minkowski_p=_float(metric_elem, "p-parameter", 2.0),
         ),
         clustering_fields=fields,
         clusters=clusters,

@@ -345,7 +345,7 @@ class TestClusteringGolden:
         for i, rec in enumerate(recs):
             o = evaluate(doc, rec)
             assert D[i].min() == pytest.approx(
-                o.probabilities["distance"], rel=1e-4
+                o.probabilities[o.label], rel=1e-4
             )
 
     def test_kmeans_missing(self, assets_dir):

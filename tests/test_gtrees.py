@@ -8,6 +8,7 @@ parity here closes the "real-world R/rpart export" gap.
 
 import itertools
 
+import numpy as np
 import pytest
 
 from flink_jpmml_tpu.compile import compile_pmml
@@ -195,7 +196,10 @@ class TestGeneralShapes:
         for strategy in ("none", "nullPrediction"):
             _check(_doc(body, strategy=strategy), _grid())
 
-    def test_nested_compound_rejected(self):
+    def test_nested_compound_compiles_and_matches_oracle(self):
+        # r2 rejected these; r3 lowers nested and/or/xor exactly via the
+        # strong-Kleene DNF expansion (full coverage in
+        # test_trees_extended.TestNestedCompoundPredicates)
         body = """<Node id="0"><True/>
           <Node id="1" score="1.0">
             <CompoundPredicate booleanOperator="and">
@@ -208,10 +212,23 @@ class TestGeneralShapes:
           </Node>
           <Node id="2" score="2.0"><True/></Node>
         </Node>"""
-        from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+        from flink_jpmml_tpu.pmml.interp import evaluate as _oeval
 
-        with pytest.raises(ModelCompilationException, match="nested"):
-            compile_pmml(_doc(body))
+        doc = _doc(body)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(4)
+        recs = []
+        for _ in range(100):
+            rec = {}
+            for f in ("a", "b", "c"):
+                if rng.random() > 0.25:
+                    rec[f] = float(rng.normal())
+            recs.append(rec)
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = _oeval(doc, rec)
+            assert o.is_missing == p.is_empty, rec
+            if not o.is_missing:
+                assert p.score.value == pytest.approx(o.value), rec
 
 
 class TestGeneralClassification:

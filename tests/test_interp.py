@@ -260,7 +260,7 @@ class TestClustering:
         r = evaluate(doc, {f"f{i}": v for i, v in enumerate(c0)})
         assert r.value == 2.0
         assert r.label == "3"
-        assert r.probabilities["distance"] == pytest.approx(0.0)
+        assert r.probabilities[r.label] == pytest.approx(0.0)
 
     def test_missing_field_empty(self, assets_dir):
         doc = parse_pmml_file(str(assets_dir / "kmeans.pmml"))

@@ -1,0 +1,609 @@
+"""invalidValueTreatment semantics (VERDICT r2 missing #3 / r3 task):
+DataDictionary validity (declared category Values; continuous Intervals)
+× mining-schema treatment (returnInvalid — the spec default — asMissing,
+asIs, asValue), golden-diffed compiled vs oracle."""
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+
+def _doc_xml(treatment_attr="", interval="", cat_values=True, x_attr=None):
+    values = (
+        '<Value value="red"/><Value value="green"/><Value value="blue"/>'
+        if cat_values
+        else ""
+    )
+    return f"""<PMML version="4.3"><DataDictionary>
+      <DataField name="color" optype="categorical" dataType="string">
+        {values}</DataField>
+      <DataField name="x" optype="continuous" dataType="double">
+        {interval}</DataField>
+      </DataDictionary>
+      <TreeModel functionName="regression" missingValueStrategy="none">
+      <MiningSchema>
+        <MiningField name="color" {treatment_attr}/>
+        <MiningField name="x" {x_attr if x_attr is not None else treatment_attr}/>
+      </MiningSchema>
+      <Node id="r"><True/>
+        <Node id="a" score="10">
+          <SimplePredicate field="color" operator="equal" value="red"/></Node>
+        <Node id="b" score="20">
+          <SimplePredicate field="x" operator="greaterThan" value="0"/></Node>
+        <Node id="c" score="30"><True/></Node>
+      </Node></TreeModel></PMML>"""
+
+
+def _assert_parity(doc, records):
+    cm = compile_pmml(doc)
+    preds = cm.score_records(records)
+    for rec, p in zip(records, preds):
+        o = evaluate(doc, rec)
+        assert o.is_missing == p.is_empty, (rec, o, p)
+        if not o.is_missing:
+            assert p.score.value == pytest.approx(o.value, rel=1e-5), rec
+
+
+class TestCategoricalInvalid:
+    def test_default_return_invalid(self):
+        doc = parse_pmml(_doc_xml())
+        recs = [
+            {"color": "red", "x": 1.0},      # valid → 10
+            {"color": "violet", "x": 1.0},   # invalid → EMPTY
+            {"color": "green", "x": 1.0},    # valid → 20
+            {"x": 1.0},                      # missing color → 20
+        ]
+        _assert_parity(doc, recs)
+        o = evaluate(doc, recs[1])
+        assert o.is_missing  # returnInvalid = empty result
+
+    def test_as_missing(self):
+        doc = parse_pmml(_doc_xml('invalidValueTreatment="asMissing"'))
+        recs = [
+            {"color": "violet", "x": -1.0},  # invalid→missing → else branch
+            {"color": "violet", "x": 2.0},
+        ]
+        _assert_parity(doc, recs)
+        assert evaluate(doc, recs[0]).value == 30.0
+        assert evaluate(doc, recs[1]).value == 20.0
+
+    def test_as_is_matches_nothing_but_not_missing(self):
+        doc = parse_pmml(_doc_xml('invalidValueTreatment="asIs"'))
+        recs = [
+            {"color": "violet", "x": 2.0},   # ≠ red, not missing → 20
+            {"color": "violet", "x": -2.0},  # → 30
+        ]
+        _assert_parity(doc, recs)
+        assert evaluate(doc, recs[0]).value == 20.0
+        assert evaluate(doc, recs[1]).value == 30.0
+
+    def test_as_value_replaces(self):
+        doc = parse_pmml(
+            _doc_xml(
+                'invalidValueTreatment="asValue" '
+                'invalidValueReplacement="red"'
+            )
+        )
+        recs = [{"color": "violet", "x": 2.0}]  # violet→red → 10
+        _assert_parity(doc, recs)
+        assert evaluate(doc, recs[0]).value == 10.0
+
+
+class TestIntervalInvalid:
+    IVL = '<Interval closure="closedClosed" leftMargin="-5" rightMargin="5"/>'
+
+    def test_out_of_interval_default_invalid(self):
+        doc = parse_pmml(_doc_xml(interval=self.IVL))
+        recs = [
+            {"color": "red", "x": 3.0},    # in range → 10
+            {"color": "green", "x": 7.0},  # out of range → EMPTY
+            {"color": "green", "x": -7.0},
+            {"color": "green"},            # x missing: never invalid → 30
+        ]
+        _assert_parity(doc, recs)
+        assert evaluate(doc, recs[1]).is_missing
+        assert not evaluate(doc, recs[3]).is_missing
+
+    def test_open_closure_boundaries(self):
+        ivl = (
+            '<Interval closure="openClosed" leftMargin="0" rightMargin="5"/>'
+        )
+        doc = parse_pmml(_doc_xml(interval=ivl))
+        recs = [
+            {"color": "red", "x": 0.0},  # open left: 0 is invalid
+            {"color": "red", "x": 5.0},  # closed right: valid
+            {"color": "red", "x": 0.1},
+        ]
+        _assert_parity(doc, recs)
+        assert evaluate(doc, recs[0]).is_missing
+        assert not evaluate(doc, recs[1]).is_missing
+
+    def test_multiple_intervals_union(self):
+        ivl = (
+            '<Interval closure="closedClosed" leftMargin="0" rightMargin="1"/>'
+            '<Interval closure="closedClosed" leftMargin="10" rightMargin="11"/>'
+        )
+        doc = parse_pmml(_doc_xml(interval=ivl))
+        recs = [
+            {"color": "red", "x": 0.5},
+            {"color": "red", "x": 10.5},
+            {"color": "red", "x": 5.0},  # in the gap → invalid
+        ]
+        _assert_parity(doc, recs)
+        assert evaluate(doc, recs[2]).is_missing
+
+    def test_interval_as_missing(self):
+        doc = parse_pmml(
+            _doc_xml(
+                'invalidValueTreatment="asMissing"', interval=self.IVL
+            )
+        )
+        recs = [{"color": "blue", "x": 99.0}]  # → missing x → 30
+        _assert_parity(doc, recs)
+        assert evaluate(doc, recs[0]).value == 30.0
+
+    def test_interval_as_value(self):
+        # the numeric replacement goes on x only — a numeric replacement
+        # on the categorical color column is (correctly) a compile error
+        doc = parse_pmml(
+            _doc_xml(
+                interval=self.IVL,
+                x_attr='invalidValueTreatment="asValue" '
+                       'invalidValueReplacement="1"',
+            )
+        )
+        recs = [{"color": "blue", "x": 99.0}]  # 99→1 → x>0 → 20
+        _assert_parity(doc, recs)
+        assert evaluate(doc, recs[0]).value == 20.0
+
+
+class TestWireAndBatchBehavior:
+    def test_quantized_wire_disabled_under_invalid_policy(self, tmp_path):
+        # a GBM whose fields declare Intervals must stay on the f32 path
+        # (the rank wire bypasses the sanitize stage)
+        from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
+
+        xml = """<PMML version="4.3"><DataDictionary>
+          <DataField name="f0" optype="continuous" dataType="double">
+            <Interval closure="closedClosed" leftMargin="-10" rightMargin="10"/>
+          </DataField></DataDictionary>
+          <TreeModel functionName="regression">
+          <MiningSchema><MiningField name="f0"/></MiningSchema>
+          <Node id="r"><True/>
+            <Node id="l" score="1"><SimplePredicate field="f0"
+              operator="lessThan" value="0"/></Node>
+            <Node id="rr" score="2"><True/></Node>
+          </Node></TreeModel></PMML>"""
+        doc = parse_pmml(xml)
+        assert build_quantized_scorer(doc) is None
+        # while a plain doc (no Values/Intervals) keeps the wire
+        from assets.generate import gen_gbm
+        from flink_jpmml_tpu.pmml import parse_pmml_file
+
+        plain = parse_pmml_file(gen_gbm(str(tmp_path), n_trees=5, depth=3,
+                                        n_features=4))
+        assert build_quantized_scorer(plain) is not None
+
+    def test_mixed_batch_lanes_independent(self):
+        # one invalid lane must not poison its neighbors
+        doc = parse_pmml(_doc_xml())
+        recs = [
+            {"color": "red", "x": 1.0},
+            {"color": "martian", "x": 1.0},
+            {"color": "blue", "x": -1.0},
+        ]
+        cm = compile_pmml(doc)
+        preds = cm.score_records(recs)
+        assert [p.is_empty for p in preds] == [False, True, False]
+        assert preds[0].score.value == 10.0
+        assert preds[2].score.value == 30.0
+
+
+def _nn_xml(layer_attrs, neuron_extra=None, net_attrs="", last_identity=True):
+    """Tiny 2-input regression NN: one custom layer (2 neurons) then an
+    identity output neuron summing them."""
+    neuron_extra = neuron_extra or ["", ""]
+    last = (
+        '<NeuralLayer activationFunction="identity">'
+        '<Neuron id="o" bias="0">'
+        '<Con from="h0" weight="1"/><Con from="h1" weight="1"/>'
+        "</Neuron></NeuralLayer>"
+        if last_identity
+        else ""
+    )
+    out_neuron = "o" if last_identity else "h0"
+    return f"""<PMML version="4.3"><DataDictionary>
+      <DataField name="a" optype="continuous" dataType="double"/>
+      <DataField name="b" optype="continuous" dataType="double"/>
+      <DataField name="y" optype="continuous" dataType="double"/>
+      </DataDictionary>
+      <NeuralNetwork functionName="regression"
+          activationFunction="identity" {net_attrs}>
+      <MiningSchema><MiningField name="y" usageType="target"/>
+        <MiningField name="a"/><MiningField name="b"/></MiningSchema>
+      <NeuralInputs>
+        <NeuralInput id="i0"><DerivedField optype="continuous"
+          dataType="double"><FieldRef field="a"/></DerivedField></NeuralInput>
+        <NeuralInput id="i1"><DerivedField optype="continuous"
+          dataType="double"><FieldRef field="b"/></DerivedField></NeuralInput>
+      </NeuralInputs>
+      <NeuralLayer {layer_attrs}>
+        <Neuron id="h0" bias="0.5" {neuron_extra[0]}>
+          <Con from="i0" weight="1.0"/><Con from="i1" weight="-2.0"/></Neuron>
+        <Neuron id="h1" bias="-1.0" {neuron_extra[1]}>
+          <Con from="i0" weight="0.5"/><Con from="i1" weight="3.0"/></Neuron>
+      </NeuralLayer>
+      {last}
+      <NeuralOutputs><NeuralOutput outputNeuron="{out_neuron}">
+        <DerivedField optype="continuous" dataType="double">
+        <FieldRef field="y"/></DerivedField></NeuralOutput></NeuralOutputs>
+      </NeuralNetwork></PMML>"""
+
+
+class TestNeuralActivations:
+    """threshold and radialBasis activations (VERDICT r2 missing #3):
+    compiled vs oracle vs hand-computed spec formulas."""
+
+    def _parity(self, xml, n=64, seed=0):
+        import math
+
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(seed)
+        recs = [
+            {"a": float(x), "b": float(y)}
+            for x, y in rng.normal(0, 1.5, size=(n, 2))
+        ]
+        preds = cm.score_records(recs)
+        for rec, p in zip(recs, preds):
+            o = evaluate(doc, rec)
+            assert not p.is_empty and o.value is not None
+            assert p.score.value == pytest.approx(o.value, rel=1e-4,
+                                                  abs=1e-5), rec
+        return doc
+
+    def test_threshold_layer_default_cut(self):
+        doc = self._parity(_nn_xml('activationFunction="threshold"'))
+        # hand check: z0 = .5 + a − 2b ; z1 = −1 + .5a + 3b ; cut 0
+        o = evaluate(doc, {"a": 1.0, "b": 0.0})
+        assert o.value == (1.0 if 1.5 > 0 else 0.0) + (1.0 if -0.5 > 0 else 0.0)
+        assert o.value == 1.0
+
+    def test_threshold_layer_custom_cut(self):
+        doc = self._parity(
+            _nn_xml('activationFunction="threshold" threshold="2.0"')
+        )
+        o = evaluate(doc, {"a": 3.0, "b": 0.0})
+        # z0 = 3.5 > 2 → 1 ; z1 = 0.5 > 2 → 0
+        assert o.value == 1.0
+
+    def test_radial_basis_layer(self):
+        import math
+
+        doc = self._parity(
+            _nn_xml(
+                'activationFunction="radialBasis"',
+                neuron_extra=['width="1.5"', 'width="0.8"'],
+            )
+        )
+        # spec formula, hand-computed: out_j = exp(fanIn·ln(alt) −
+        # Σ(w−x)²/(2·width²)); alt defaults 1 → exp(−z/(2w²)); bias unused
+        a, b = 0.3, -0.7
+        z0 = (1.0 - a) ** 2 + (-2.0 - b) ** 2
+        z1 = (0.5 - a) ** 2 + (3.0 - b) ** 2
+        expect = math.exp(-z0 / (2 * 1.5**2)) + math.exp(-z1 / (2 * 0.8**2))
+        o = evaluate(doc, {"a": a, "b": b})
+        assert o.value == pytest.approx(expect, rel=1e-9)
+
+    def test_radial_basis_altitude_and_layer_width(self):
+        import math
+
+        doc = self._parity(
+            _nn_xml(
+                'activationFunction="radialBasis" width="2.0" altitude="1.7"'
+            )
+        )
+        a, b = -0.2, 0.4
+        z0 = (1.0 - a) ** 2 + (-2.0 - b) ** 2
+        z1 = (0.5 - a) ** 2 + (3.0 - b) ** 2
+        la = math.log(1.7)
+        expect = math.exp(2 * la - z0 / 8.0) + math.exp(2 * la - z1 / 8.0)
+        o = evaluate(doc, {"a": a, "b": b})
+        assert o.value == pytest.approx(expect, rel=1e-6)
+
+    def test_radial_basis_without_width_rejected(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        doc = parse_pmml(_nn_xml('activationFunction="radialBasis"'))
+        with pytest.raises(ModelCompilationException, match="width"):
+            compile_pmml(doc)
+
+
+def _clustering_xml(measure, cfields):
+    return f"""<PMML version="4.3"><DataDictionary>
+      <DataField name="u" optype="continuous" dataType="double"/>
+      <DataField name="v" optype="continuous" dataType="double"/>
+      </DataDictionary>
+      <ClusteringModel functionName="clustering" modelClass="centerBased"
+          numberOfClusters="3">
+      <MiningSchema><MiningField name="u"/><MiningField name="v"/>
+      </MiningSchema>
+      {measure}
+      {cfields}
+      <Cluster id="c1"><Array n="2" type="real">0 0</Array></Cluster>
+      <Cluster id="c2"><Array n="2" type="real">2 1</Array></Cluster>
+      <Cluster id="c3"><Array n="2" type="real">-1 3</Array></Cluster>
+      </ClusteringModel></PMML>"""
+
+
+class TestClusteringCompareFunctions:
+    """compareFunctions beyond absDiff + the minkowski metric (VERDICT r2
+    missing #3): gaussSim / delta / equal per measure or per field,
+    golden-diffed compiled vs oracle and spot-checked by hand."""
+
+    def _parity(self, xml, n=100, seed=0):
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(seed)
+        recs = [
+            {"u": float(a), "v": float(b)}
+            for a, b in rng.normal(0.5, 2.0, size=(n, 2))
+        ]
+        # a few exact center hits so delta/equal branch both ways
+        recs += [{"u": 0.0, "v": 0.0}, {"u": 2.0, "v": 1.0},
+                 {"u": 2.0, "v": 3.0}]
+        preds = cm.score_records(recs)
+        for rec, p in zip(recs, preds):
+            o = evaluate(doc, rec)
+            assert p.target.label == o.label, (rec, p.target.label, o.label)
+        return doc
+
+    def test_gauss_sim_per_field(self):
+        cf = ('<ClusteringField field="u" compareFunction="gaussSim" '
+              'similarityScale="1.5"/>'
+              '<ClusteringField field="v" compareFunction="gaussSim" '
+              'similarityScale="0.7"/>')
+        self._parity(_clustering_xml(
+            '<ComparisonMeasure kind="distance"><cityBlock/>'
+            "</ComparisonMeasure>", cf))
+
+    def test_delta_and_equal_mixed(self):
+        cf = ('<ClusteringField field="u" compareFunction="delta"/>'
+              '<ClusteringField field="v" compareFunction="absDiff"/>')
+        self._parity(_clustering_xml(
+            '<ComparisonMeasure kind="distance"><squaredEuclidean/>'
+            "</ComparisonMeasure>", cf))
+
+    def test_measure_level_compare_function(self):
+        cf = ('<ClusteringField field="u"/>'
+              '<ClusteringField field="v"/>')
+        self._parity(_clustering_xml(
+            '<ComparisonMeasure kind="distance" compareFunction="delta">'
+            "<cityBlock/></ComparisonMeasure>", cf))
+
+    def test_minkowski_metric(self):
+        import math
+
+        cf = ('<ClusteringField field="u" fieldWeight="2.0"/>'
+              '<ClusteringField field="v"/>')
+        doc = self._parity(_clustering_xml(
+            '<ComparisonMeasure kind="distance">'
+            '<minkowski p-parameter="3"/></ComparisonMeasure>', cf))
+        # hand check vs the spec formula: d = (Σ w·|x−z|^p)^(1/p)
+        o = evaluate(doc, {"u": 1.0, "v": 1.0})
+        d1 = (2.0 * 1.0**3 + 1.0**3) ** (1 / 3)          # vs (0,0)
+        d2 = (2.0 * 1.0**3 + 0.0**3) ** (1 / 3)          # vs (2,1)
+        d3 = (2.0 * 2.0**3 + 2.0**3) ** (1 / 3)          # vs (-1,3)
+        assert min((d1, d2, d3)) == d2
+        assert o.label == "c2"
+        assert o.probabilities[o.label] == pytest.approx(d2)
+
+    def test_field_weight_multiplies_powered_comparison(self):
+        # Σ w·c², not Σ (w·c)² — spec/JPMML semantics
+        cf = ('<ClusteringField field="u" fieldWeight="9.0"/>'
+              '<ClusteringField field="v"/>')
+        doc = self._parity(_clustering_xml(
+            '<ComparisonMeasure kind="distance"><squaredEuclidean/>'
+            "</ComparisonMeasure>", cf))
+        o = evaluate(doc, {"u": 1.0, "v": 0.0})
+        # vs c1 (0,0): 9·1² + 0 = 9 ; with the wrong (w·c)² it would be 81
+        assert o.probabilities[o.label] == pytest.approx(9.0)
+
+    def test_gauss_sim_without_scale_rejected(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        cf = ('<ClusteringField field="u" compareFunction="gaussSim"/>'
+              '<ClusteringField field="v"/>')
+        doc = parse_pmml(_clustering_xml(
+            '<ComparisonMeasure kind="distance"><cityBlock/>'
+            "</ComparisonMeasure>", cf))
+        with pytest.raises(ModelCompilationException, match="similarityScale"):
+            compile_pmml(doc)
+
+
+class TestTopLevelOutput:
+    """Top-level <Output> (VERDICT r2 missing #3): predictedValue /
+    probability / transformedValue on standalone models, identical between
+    the compiled decode and the oracle (one shared implementation)."""
+
+    CLS_XML = """<PMML version="4.3"><DataDictionary>
+      <DataField name="f" optype="continuous" dataType="double"/>
+      <DataField name="y" optype="categorical" dataType="string">
+        <Value value="no"/><Value value="yes"/></DataField>
+      </DataDictionary>
+      <RegressionModel functionName="classification"
+          normalizationMethod="softmax">
+      <MiningSchema><MiningField name="y" usageType="target"/>
+        <MiningField name="f"/></MiningSchema>
+      <Output>
+        <OutputField name="pred" feature="predictedValue"/>
+        <OutputField name="p_yes" feature="probability" value="yes"/>
+        <OutputField name="p_win" feature="probability"/>
+        <OutputField name="double_p" feature="transformedValue">
+          <Apply function="*"><FieldRef field="p_yes"/>
+            <Constant>2.0</Constant></Apply>
+        </OutputField>
+      </Output>
+      <RegressionTable intercept="0.2" targetCategory="yes">
+        <NumericPredictor name="f" coefficient="1.3"/></RegressionTable>
+      <RegressionTable intercept="0" targetCategory="no"/>
+      </RegressionModel></PMML>"""
+
+    def test_classification_outputs_parity(self):
+        doc = parse_pmml(self.CLS_XML)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(2)
+        recs = [{"f": float(v)} for v in rng.normal(0, 2, size=40)]
+        preds = cm.score_records(recs)
+        for rec, p in zip(recs, preds):
+            o = evaluate(doc, rec)
+            assert p.outputs is not None and o.outputs
+            assert p.outputs["pred"] == o.outputs["pred"] == o.label
+            assert p.outputs["p_yes"] == pytest.approx(
+                o.outputs["p_yes"], rel=1e-4
+            )
+            assert p.outputs["p_win"] == pytest.approx(
+                o.probabilities[o.label], rel=1e-4
+            )
+            assert p.outputs["double_p"] == pytest.approx(
+                2.0 * p.outputs["p_yes"], rel=1e-6
+            )
+
+    def test_regression_predicted_and_transformed(self):
+        xml = """<PMML version="4.3"><DataDictionary>
+          <DataField name="f" optype="continuous" dataType="double"/>
+          <DataField name="y" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <RegressionModel functionName="regression">
+          <MiningSchema><MiningField name="y" usageType="target"/>
+            <MiningField name="f"/></MiningSchema>
+          <Output>
+            <OutputField name="raw" feature="predictedValue"/>
+            <OutputField name="scaled" feature="transformedValue">
+              <Apply function="+"><Apply function="*">
+                <FieldRef field="raw"/><Constant>10.0</Constant></Apply>
+                <Constant>5.0</Constant></Apply>
+            </OutputField>
+          </Output>
+          <RegressionTable intercept="1.0">
+            <NumericPredictor name="f" coefficient="2.0"/></RegressionTable>
+          </RegressionModel></PMML>"""
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        p = cm.score_records([{"f": 3.0}])[0]
+        o = evaluate(doc, {"f": 3.0})
+        assert p.score.value == pytest.approx(7.0)
+        assert p.outputs["raw"] == pytest.approx(7.0)
+        assert p.outputs["scaled"] == pytest.approx(75.0)
+        assert o.outputs["scaled"] == pytest.approx(75.0)
+
+    def test_transformed_value_may_not_reference_inputs(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        xml = """<PMML version="4.3"><DataDictionary>
+          <DataField name="f" optype="continuous" dataType="double"/>
+          <DataField name="y" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <RegressionModel functionName="regression">
+          <MiningSchema><MiningField name="y" usageType="target"/>
+            <MiningField name="f"/></MiningSchema>
+          <Output>
+            <OutputField name="bad" feature="transformedValue">
+              <FieldRef field="f"/>
+            </OutputField>
+          </Output>
+          <RegressionTable intercept="1.0"/>
+          </RegressionModel></PMML>"""
+        with pytest.raises(ModelCompilationException, match="previously"):
+            compile_pmml(parse_pmml(xml))
+
+    def test_output_disables_rank_wire(self, tmp_path):
+        from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
+        from flink_jpmml_tpu.pmml import parse_pmml_file
+        from assets.generate import gen_gbm
+        import pathlib
+
+        plain_path = gen_gbm(str(tmp_path), n_trees=4, depth=3, n_features=4)
+        text = pathlib.Path(plain_path).read_text()
+        # inject a top-level Output into the GBM document
+        with_out = text.replace(
+            "<Segmentation",
+            '<Output><OutputField name="pred" feature="predictedValue"/>'
+            "</Output><Segmentation",
+            1,
+        )
+        doc = parse_pmml(with_out)
+        assert doc.output_fields
+        assert build_quantized_scorer(doc) is None
+        cm = compile_pmml(doc)
+        p = cm.score_records([{f"f{j}": 0.1 * j for j in range(4)}])[0]
+        assert p.outputs["pred"] == pytest.approx(p.score.value)
+
+
+class TestReviewRegressions:
+    def test_dense_path_out_of_table_code_is_invalid(self):
+        """Pre-encoded category codes outside the declared table must hit
+        the same returnInvalid default as undeclared strings — on both
+        paths (review: the compiled path only caught the string marker)."""
+        doc = parse_pmml(_doc_xml())
+        cm = compile_pmml(doc)
+        # color codes: valid 0/1/2 — 7.0 and 1.5 are out-of-table
+        vecs = np.array(
+            [[0.0, 1.0], [7.0, 1.0], [1.5, 1.0], [2.0, 1.0]], np.float32
+        )
+        preds = cm.score_dense(vecs)
+        assert [p.is_empty for p in preds] == [False, True, True, False]
+        for row, p in zip(vecs, preds):
+            o = evaluate(doc, {"color": float(row[0]), "x": float(row[1])})
+            assert o.is_missing == p.is_empty, row
+
+    def test_as_value_with_undeclared_replacement_rejected(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        doc = parse_pmml(
+            _doc_xml(
+                'invalidValueTreatment="asValue" '
+                'invalidValueReplacement="chartreuse"'
+            )
+        )
+        with pytest.raises(ModelCompilationException, match="declared"):
+            compile_pmml(doc)
+
+    def test_clustering_output_probability_parity(self):
+        """Top-level <Output> probability on a clustering model: the
+        per-cluster distance map must be keyed identically on both paths
+        (review: the oracle used a magic 'distance' key)."""
+        cf = '<ClusteringField field="u"/><ClusteringField field="v"/>'
+        xml = _clustering_xml(
+            '<ComparisonMeasure kind="distance"><squaredEuclidean/>'
+            "</ComparisonMeasure>", cf,
+        ).replace(
+            '<Cluster id="c1"',
+            '<Output><OutputField name="win_d" feature="probability"/>'
+            '</Output><Cluster id="c1"',
+            1,
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(3)
+        recs = [
+            {"u": float(a), "v": float(b)}
+            for a, b in rng.normal(0.5, 2.0, size=(30, 2))
+        ]
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert o.outputs["win_d"] is not None
+            assert p.outputs["win_d"] == pytest.approx(
+                o.outputs["win_d"], rel=1e-4
+            ), rec
