@@ -35,6 +35,8 @@ from flink_jpmml_tpu.compile.exprs import lower_expression
 from flink_jpmml_tpu.compile.mining import lower_mining
 from flink_jpmml_tpu.compile.neural import lower_neural_network
 from flink_jpmml_tpu.compile.regression import lower_regression
+from flink_jpmml_tpu.compile.ruleset import lower_ruleset
+from flink_jpmml_tpu.compile.scorecard import lower_scorecard
 from flink_jpmml_tpu.compile.trees import lower_tree
 from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
 from flink_jpmml_tpu.pmml import ir
@@ -58,6 +60,10 @@ def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
         return lower_neural_network(model, ctx)
     if isinstance(model, ir.ClusteringModelIR):
         return lower_clustering(model, ctx)
+    if isinstance(model, ir.ScorecardIR):
+        return lower_scorecard(model, ctx)
+    if isinstance(model, ir.RuleSetIR):
+        return lower_ruleset(model, ctx)
     if isinstance(model, ir.MiningModelIR):
         return lower_mining(model, ctx)
     raise ModelCompilationException(
@@ -84,6 +90,9 @@ class CompiledModel:
     _config: Optional[CompileConfig] = None
     _quantized: object = _UNSET
     output_fields: Tuple[ir.OutputField, ...] = ()  # top-level <Output>
+    # scorecard reason codes: (ReasonCodeMeta, n_characteristics) when the
+    # document declares useReasonCodes and the metadata is complete
+    _reason: Optional[tuple] = None
 
     @property
     def is_classification(self) -> bool:
@@ -188,6 +197,16 @@ class CompiledModel:
         if self.output_fields:
             # top-level <Output> post-processing (pmml/outputs.py): only
             # documents that declare it pay this host-side per-record step
+            rc_rows = None
+            if self._reason is not None and any(
+                of.feature == "reasonCode" for of in self.output_fields
+            ):
+                meta, C = self._reason
+                P = np.asarray(out.probs)[:n]  # [B, 2C]: partials ∥ attr
+                rc_rows = [
+                    meta.rank(P[i, :C], P[i, C:].astype(np.int32))
+                    for i in range(P.shape[0])
+                ]
             preds = [
                 p
                 if p.is_empty
@@ -198,9 +217,12 @@ class CompiledModel:
                         p.score.value,
                         p.target.label if p.target else None,
                         p.target.probabilities if p.target else None,
+                        reason_codes=(
+                            rc_rows[i] if rc_rows is not None else None
+                        ),
                     ),
                 )
-                for p in preds
+                for i, p in enumerate(preds)
             ]
         return preds
 
@@ -332,6 +354,22 @@ def compile_pmml(
     )
 
     validate_output_fields(doc.output_fields)
+    reason = None
+    if isinstance(doc.model, ir.ScorecardIR) and doc.model.use_reason_codes:
+        from flink_jpmml_tpu.compile.scorecard import ReasonCodeMeta
+
+        wants_rc = any(
+            of.feature == "reasonCode" for of in doc.output_fields
+        )
+        try:
+            reason = (
+                ReasonCodeMeta(doc.model),
+                len(doc.model.characteristics),
+            )
+        except ModelCompilationException:
+            if wants_rc:
+                raise  # requested but the metadata is incomplete
+            reason = None
     name = getattr(doc.model, "model_name", None)
     return CompiledModel(
         field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
@@ -343,4 +381,5 @@ def compile_pmml(
         _doc=doc,
         _config=config,
         output_fields=doc.output_fields,
+        _reason=reason,
     )

@@ -46,6 +46,7 @@ class EvalResult:
     label: Optional[str] = None
     probabilities: Dict[str, float] = dc_field(default_factory=dict)
     outputs: Dict[str, object] = dc_field(default_factory=dict)
+    reason_codes: Tuple[str, ...] = ()  # scorecard, ranked worst-first
 
     @property
     def is_missing(self) -> bool:
@@ -253,7 +254,11 @@ def evaluate(doc: ir.PmmlDocument, record: Record) -> EvalResult:
         from flink_jpmml_tpu.pmml.outputs import compute_outputs
 
         res.outputs = compute_outputs(
-            doc.output_fields, res.value, res.label, res.probabilities
+            doc.output_fields,
+            res.value,
+            res.label,
+            res.probabilities,
+            reason_codes=res.reason_codes,
         )
     return res
 
@@ -288,13 +293,22 @@ def _apply_invalid_treatment(
     convention (pre-encoded codes) and decode back; out-of-table codes
     are invalid too. → (possibly-rewritten record, record_is_invalid).
     """
+    # scope: ACTIVE mining fields only — the compiled sanitize stage
+    # operates on the active-field space, and a declared-but-inactive
+    # column (extra data, the target) must never invalidate a record
+    active = set(schema.active_fields)
     decl_cat = {
         f.name: f.values
         for f in dd.fields
-        if f.is_categorical and f.dtype == "string" and f.values
+        if f.name in active
+        and f.is_categorical
+        and f.dtype == "string"
+        and f.values
     }
     decl_ivl = {
-        f.name: f.intervals for f in dd.fields if f.intervals
+        f.name: f.intervals
+        for f in dd.fields
+        if f.name in active and f.intervals
     }
     if not decl_cat and not decl_ivl:
         return record, False
@@ -382,9 +396,85 @@ def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
         return _eval_neural_network(model, record)
     if isinstance(model, ir.ClusteringModelIR):
         return _eval_clustering(model, record)
+    if isinstance(model, ir.ScorecardIR):
+        return _eval_scorecard(model, record)
+    if isinstance(model, ir.RuleSetIR):
+        return _eval_ruleset(model, record)
     if isinstance(model, ir.MiningModelIR):
         return _eval_mining(model, record)
     raise ModelCompilationException(f"unsupported model {type(model).__name__}")
+
+
+# --- Scorecard -------------------------------------------------------------
+
+
+def _eval_scorecard(model: ir.ScorecardIR, record: Record) -> EvalResult:
+    total = model.initial_score
+    partials: List[float] = []
+    attr_idx: List[int] = []
+    for ch in model.characteristics:
+        chosen = None
+        for ai, at in enumerate(ch.attributes):
+            if eval_predicate(at.predicate, record) is True:
+                chosen = (ai, at)
+                break
+        if chosen is None:
+            # no attribute matched: the result is invalid (totality C5)
+            return EvalResult()
+        partials.append(chosen[1].partial_score)
+        attr_idx.append(chosen[0])
+        total += chosen[1].partial_score
+    res = EvalResult(value=total)
+    if model.use_reason_codes:
+        from flink_jpmml_tpu.compile.scorecard import ReasonCodeMeta
+
+        try:
+            meta = ReasonCodeMeta(model)
+        except ModelCompilationException:
+            # incomplete codes/baselines: surfaced at compile time iff an
+            # Output actually requests reason codes
+            return res
+        res.reason_codes = tuple(meta.rank(partials, attr_idx))
+    return res
+
+
+# --- RuleSet ---------------------------------------------------------------
+
+
+def _eval_ruleset(model: ir.RuleSetIR, record: Record) -> EvalResult:
+    fired = [
+        r for r in model.rules
+        if eval_predicate(r.predicate, record) is True
+    ]
+    if not fired:
+        if model.default_score is None:
+            return EvalResult()
+        return EvalResult(
+            value=model.default_confidence, label=model.default_score
+        )
+    m = model.selection_method
+    if m == "firstHit":
+        r = fired[0]
+        return EvalResult(value=r.confidence, label=r.score)
+    if m == "weightedMax":
+        r = max(fired, key=lambda rr: rr.weight)  # ties: first wins
+        return EvalResult(value=r.confidence, label=r.score)
+    if m == "weightedSum":
+        labels: List[str] = []
+        for r in model.rules:
+            if r.score not in labels:
+                labels.append(r.score)
+        totals = {s: 0.0 for s in labels}
+        for r in fired:
+            totals[r.score] += r.weight
+        best = labels[0]
+        for s in labels:  # first-appearance order breaks ties
+            if totals[s] > totals[best]:
+                best = s
+        return EvalResult(value=totals[best] / len(fired), label=best)
+    raise ModelCompilationException(
+        f"unsupported RuleSelectionMethod {m!r}"
+    )
 
 
 # --- TreeModel -------------------------------------------------------------

@@ -381,6 +381,70 @@ class ClusteringModelIR:
 
 
 # ---------------------------------------------------------------------------
+# Scorecard
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ScorecardAttribute:
+    """One bin of a Characteristic: first-true predicate wins its
+    partialScore (UNKNOWN predicates don't match — scorecard documents
+    bin missing values with explicit isMissing attributes)."""
+
+    predicate: Predicate
+    partial_score: float
+    reason_code: Optional[str] = None  # overrides the characteristic's
+
+
+@dataclass(frozen=True)
+class Characteristic:
+    name: Optional[str]
+    attributes: Tuple[ScorecardAttribute, ...]
+    reason_code: Optional[str] = None
+    baseline_score: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class ScorecardIR:
+    function_name: str  # regression
+    mining_schema: MiningSchema
+    characteristics: Tuple[Characteristic, ...]
+    initial_score: float = 0.0
+    use_reason_codes: bool = False
+    reason_code_algorithm: str = "pointsBelow"  # | pointsAbove
+    baseline_score: Optional[float] = None  # model-level default
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# RuleSet
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SimpleRule:
+    predicate: Predicate
+    score: str
+    rule_id: Optional[str] = None
+    weight: float = 1.0
+    confidence: float = 1.0
+
+
+@dataclass(frozen=True)
+class RuleSetIR:
+    """PMML RuleSet with flat SimpleRules (nested CompoundRules are
+    flattened by the parser into first-hit order)."""
+
+    function_name: str  # classification (regression scores also legal)
+    mining_schema: MiningSchema
+    rules: Tuple[SimpleRule, ...]
+    selection_method: str  # firstHit | weightedSum | weightedMax
+    default_score: Optional[str] = None
+    default_confidence: float = 0.0
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
 # MiningModel (ensembles / stacking)
 # ---------------------------------------------------------------------------
 
@@ -389,6 +453,8 @@ ModelIR = Union[
     RegressionModelIR,
     NeuralNetworkIR,
     ClusteringModelIR,
+    ScorecardIR,
+    RuleSetIR,
     "MiningModelIR",
 ]
 
@@ -406,6 +472,7 @@ class OutputField:
     feature: str = "predictedValue"  # predictedValue | probability | …
     target_value: Optional[str] = None
     expression: Optional[Expression] = None  # transformedValue only
+    rank: int = 1  # reasonCode: 1-based rank into the worst-first list
 
 
 @dataclass(frozen=True)

@@ -23,7 +23,9 @@ from typing import Dict, Mapping, Optional, Sequence, Tuple
 from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
-_FEATURES = ("predictedValue", "probability", "transformedValue")
+_FEATURES = (
+    "predictedValue", "probability", "transformedValue", "reasonCode",
+)
 
 
 def _expr_field_refs(expr: ir.Expression) -> set:
@@ -68,12 +70,16 @@ def compute_outputs(
     value: Optional[float],
     label: Optional[str],
     probabilities: Optional[Mapping[str, float]],
+    reason_codes: Optional[Sequence[str]] = None,
 ) -> Dict[str, object]:
     """One record's model result → its <Output> field values, in
-    declaration order (later transformedValues see earlier outputs)."""
+    declaration order (later transformedValues see earlier outputs).
+    ``reason_codes`` is the scorecard's ranked worst-first list (rank
+    attribute is 1-based; out-of-range → None)."""
     from flink_jpmml_tpu.pmml.interp import eval_expression
 
     probs = probabilities or {}
+    rcs = reason_codes or ()
     out: Dict[str, object] = {}
     for of in output_fields:
         if of.feature == "predictedValue":
@@ -81,6 +87,10 @@ def compute_outputs(
         elif of.feature == "probability":
             key = of.target_value if of.target_value is not None else label
             out[of.name] = probs.get(key) if key is not None else None
+        elif of.feature == "reasonCode":
+            out[of.name] = (
+                rcs[of.rank - 1] if 0 < of.rank <= len(rcs) else None
+            )
         else:  # transformedValue (validated)
             out[of.name] = eval_expression(of.expression, out)
     return out

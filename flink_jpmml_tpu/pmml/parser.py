@@ -26,6 +26,8 @@ _MODEL_TAGS = (
     "RegressionModel",
     "NeuralNetwork",
     "ClusteringModel",
+    "Scorecard",
+    "RuleSetModel",
     "MiningModel",
 )
 
@@ -159,6 +161,7 @@ def _parse_output(out_elem: Optional[ET.Element]) -> tuple:
                 feature=feature,
                 target_value=of.get("value"),
                 expression=expr,
+                rank=int(of.get("rank", 1)),
             )
         )
     return tuple(out)
@@ -444,9 +447,121 @@ def _parse_model(elem: ET.Element) -> ir.ModelIR:
         return _parse_neural_network(elem)
     if tag == "ClusteringModel":
         return _parse_clustering_model(elem)
+    if tag == "Scorecard":
+        return _parse_scorecard(elem)
+    if tag == "RuleSetModel":
+        return _parse_ruleset_model(elem)
     if tag == "MiningModel":
         return _parse_mining_model(elem)
     raise ModelLoadingException(f"unsupported model element <{tag}>")
+
+
+def _parse_scorecard(elem: ET.Element) -> ir.ScorecardIR:
+    chars_elem = _req_child(elem, "Characteristics")
+    characteristics = []
+    for ch in _children(chars_elem, "Characteristic"):
+        attributes = []
+        for at in _children(ch, "Attribute"):
+            ps = at.get("partialScore")
+            if ps is None:
+                if _child(at, "ComplexPartialScore") is not None:
+                    raise ModelLoadingException(
+                        "ComplexPartialScore is not supported; use "
+                        "partialScore attributes"
+                    )
+                raise ModelLoadingException(
+                    f"Attribute in characteristic {ch.get('name')!r} has "
+                    "no partialScore"
+                )
+            attributes.append(
+                ir.ScorecardAttribute(
+                    predicate=_find_predicate(at),
+                    partial_score=float(ps),
+                    reason_code=at.get("reasonCode"),
+                )
+            )
+        if not attributes:
+            raise ModelLoadingException(
+                f"Characteristic {ch.get('name')!r} has no Attributes"
+            )
+        bs = ch.get("baselineScore")
+        characteristics.append(
+            ir.Characteristic(
+                name=ch.get("name"),
+                attributes=tuple(attributes),
+                reason_code=ch.get("reasonCode"),
+                baseline_score=float(bs) if bs is not None else None,
+            )
+        )
+    if not characteristics:
+        raise ModelLoadingException("Scorecard has no Characteristics")
+    bs = elem.get("baselineScore")
+    return ir.ScorecardIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=_parse_mining_schema(elem),
+        characteristics=tuple(characteristics),
+        initial_score=float(elem.get("initialScore", 0.0)),
+        use_reason_codes=elem.get("useReasonCodes", "true") == "true",
+        reason_code_algorithm=elem.get(
+            "reasonCodeAlgorithm", "pointsBelow"
+        ),
+        baseline_score=float(bs) if bs is not None else None,
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_ruleset_model(elem: ET.Element) -> ir.RuleSetIR:
+    rs = _req_child(elem, "RuleSet")
+    sel_elems = list(_children(rs, "RuleSelectionMethod"))
+    if not sel_elems:
+        raise ModelLoadingException("RuleSet has no RuleSelectionMethod")
+    # the first listed criterion is the active one (PMML: evaluators use
+    # the first they support; ours supports all three)
+    selection = sel_elems[0].get("criterion", "firstHit")
+
+    rules: list = []
+
+    def walk(container: ET.Element, ancestors: tuple) -> None:
+        """Flatten SimpleRule/CompoundRule nesting: a nested rule fires
+        iff all ancestor CompoundRule predicates AND its own are true —
+        expressed as an and-compound, preserving document (first-hit)
+        order."""
+        for c in container:
+            tag = _local(c.tag)
+            if tag == "SimpleRule":
+                pred = _find_predicate(c)
+                if ancestors:
+                    pred = ir.CompoundPredicate(
+                        boolean_operator="and",
+                        predicates=ancestors + (pred,),
+                    )
+                score = c.get("score")
+                if score is None:
+                    raise ModelLoadingException("SimpleRule has no score")
+                rules.append(
+                    ir.SimpleRule(
+                        predicate=pred,
+                        score=score,
+                        rule_id=c.get("id"),
+                        weight=_float(c, "weight", 1.0),
+                        confidence=_float(c, "confidence", 1.0),
+                    )
+                )
+            elif tag == "CompoundRule":
+                walk(c, ancestors + (_find_predicate(c),))
+
+    walk(rs, ())
+    if not rules:
+        raise ModelLoadingException("RuleSet has no rules")
+    return ir.RuleSetIR(
+        function_name=elem.get("functionName", "classification"),
+        mining_schema=_parse_mining_schema(elem),
+        rules=tuple(rules),
+        selection_method=selection,
+        default_score=rs.get("defaultScore"),
+        default_confidence=_float(rs, "defaultConfidence", 0.0),
+        model_name=elem.get("modelName"),
+    )
 
 
 def _parse_tree_model(elem: ET.Element) -> ir.TreeModelIR:

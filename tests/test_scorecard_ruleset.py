@@ -1,0 +1,285 @@
+"""Scorecard and RuleSetModel families: the reference scores any
+JPMML-supported model class (SURVEY.md §1 C1), so these close real model
+-family gaps. Golden-diffed compiled vs oracle vs hand-computed values."""
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+SCORECARD = """<PMML version="4.3"><DataDictionary>
+  <DataField name="age" optype="continuous" dataType="double"/>
+  <DataField name="income" optype="continuous" dataType="double"/>
+  <DataField name="score" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <Scorecard functionName="regression" initialScore="100"
+      useReasonCodes="true" reasonCodeAlgorithm="pointsBelow"
+      baselineScore="25">
+  <MiningSchema><MiningField name="score" usageType="target"/>
+    <MiningField name="age"/><MiningField name="income"/></MiningSchema>
+  <Output>
+    <OutputField name="sc" feature="predictedValue"/>
+    <OutputField name="rc1" feature="reasonCode" rank="1"/>
+    <OutputField name="rc2" feature="reasonCode" rank="2"/>
+  </Output>
+  <Characteristics>
+    <Characteristic name="ageCh" reasonCode="AGE" baselineScore="30">
+      <Attribute partialScore="10">
+        <SimplePredicate field="age" operator="isMissing"/></Attribute>
+      <Attribute partialScore="40" reasonCode="AGE_YOUNG">
+        <SimplePredicate field="age" operator="lessThan" value="30"/>
+      </Attribute>
+      <Attribute partialScore="20"><True/></Attribute>
+    </Characteristic>
+    <Characteristic name="incomeCh" reasonCode="INC">
+      <Attribute partialScore="5">
+        <CompoundPredicate booleanOperator="or">
+          <SimplePredicate field="income" operator="isMissing"/>
+          <SimplePredicate field="income" operator="lessThan" value="1000"/>
+        </CompoundPredicate></Attribute>
+      <Attribute partialScore="35"><True/></Attribute>
+    </Characteristic>
+  </Characteristics></Scorecard></PMML>"""
+
+
+class TestScorecard:
+    def test_hand_computed_scores(self):
+        doc = parse_pmml(SCORECARD)
+        cm = compile_pmml(doc)
+        cases = [
+            # (record, expected = 100 + age partial + income partial)
+            ({"age": 25.0, "income": 5000.0}, 100 + 40 + 35),
+            ({"age": 45.0, "income": 500.0}, 100 + 20 + 5),
+            ({"income": 5000.0}, 100 + 10 + 35),          # age missing
+            ({"age": 30.0}, 100 + 20 + 5),                # income missing
+        ]
+        preds = cm.score_records([r for r, _ in cases])
+        for (rec, want), p in zip(cases, preds):
+            o = evaluate(doc, rec)
+            assert o.value == want, rec
+            assert p.score.value == pytest.approx(want), rec
+
+    def test_parity_randomized(self):
+        doc = parse_pmml(SCORECARD)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(0)
+        recs = []
+        for _ in range(200):
+            rec = {}
+            if rng.random() > 0.2:
+                rec["age"] = float(rng.uniform(15, 80))
+            if rng.random() > 0.2:
+                rec["income"] = float(rng.uniform(0, 9000))
+            recs.append(rec)
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert not p.is_empty and o.value is not None
+            assert p.score.value == pytest.approx(o.value), rec
+
+    def test_reason_codes_ranked_points_below(self):
+        doc = parse_pmml(SCORECARD)
+        cm = compile_pmml(doc)
+        # age=45 → AGE partial 20 (baseline 30, diff 10)
+        # income=5000 → INC partial 35 (baseline 25, diff −10)
+        rec = {"age": 45.0, "income": 5000.0}
+        p = cm.score_records([rec])[0]
+        o = evaluate(doc, rec)
+        assert o.reason_codes == ("AGE", "INC")
+        assert p.outputs["rc1"] == "AGE"
+        assert p.outputs["rc2"] == "INC"
+        # young age picks the attribute-level override code
+        rec2 = {"age": 20.0, "income": 500.0}
+        p2 = cm.score_records([rec2])[0]
+        o2 = evaluate(doc, rec2)
+        # age partial 40 (diff −10), income partial 5 (diff 20): INC first
+        assert o2.reason_codes == ("INC", "AGE_YOUNG")
+        assert p2.outputs["rc1"] == "INC"
+        assert p2.outputs["rc2"] == "AGE_YOUNG"
+
+    def test_unmatched_characteristic_is_empty_lane(self):
+        xml = """<PMML version="4.3"><DataDictionary>
+          <DataField name="x" optype="continuous" dataType="double"/>
+          <DataField name="score" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <Scorecard functionName="regression" initialScore="0"
+              useReasonCodes="false">
+          <MiningSchema><MiningField name="score" usageType="target"/>
+            <MiningField name="x"/></MiningSchema>
+          <Characteristics><Characteristic name="c">
+            <Attribute partialScore="1">
+              <SimplePredicate field="x" operator="greaterThan" value="0"/>
+            </Attribute>
+          </Characteristic></Characteristics></Scorecard></PMML>"""
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        preds = cm.score_records([{"x": 1.0}, {"x": -1.0}, {}])
+        assert [p.is_empty for p in preds] == [False, True, True]
+        assert evaluate(doc, {"x": -1.0}).is_missing
+        assert preds[0].score.value == 1.0
+
+
+RULESET = """<PMML version="4.3"><DataDictionary>
+  <DataField name="a" optype="continuous" dataType="double"/>
+  <DataField name="b" optype="continuous" dataType="double"/>
+  <DataField name="cls" optype="categorical" dataType="string">
+    <Value value="lo"/><Value value="mid"/><Value value="hi"/></DataField>
+  </DataDictionary>
+  <RuleSetModel functionName="classification">
+  <MiningSchema><MiningField name="cls" usageType="target"/>
+    <MiningField name="a"/><MiningField name="b"/></MiningSchema>
+  <RuleSet defaultScore="mid" defaultConfidence="0.3">
+    <RuleSelectionMethod criterion="{criterion}"/>
+    <SimpleRule id="r1" score="hi" weight="2.0" confidence="0.9">
+      <SimplePredicate field="a" operator="greaterThan" value="1"/>
+    </SimpleRule>
+    <CompoundRule>
+      <SimplePredicate field="b" operator="greaterThan" value="0"/>
+      <SimpleRule id="r2" score="lo" weight="3.0" confidence="0.8">
+        <SimplePredicate field="a" operator="lessThan" value="0"/>
+      </SimpleRule>
+      <SimpleRule id="r3" score="hi" weight="1.5" confidence="0.7">
+        <True/>
+      </SimpleRule>
+    </CompoundRule>
+    <SimpleRule id="r4" score="lo" weight="0.5" confidence="0.6">
+      <SimplePredicate field="b" operator="lessOrEqual" value="0"/>
+    </SimpleRule>
+  </RuleSet></RuleSetModel></PMML>"""
+
+
+class TestRuleSet:
+    def _doc(self, criterion):
+        return parse_pmml(RULESET.format(criterion=criterion))
+
+    def _parity(self, criterion, n=200, seed=1):
+        doc = self._doc(criterion)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(seed)
+        recs = []
+        for _ in range(n):
+            rec = {}
+            if rng.random() > 0.2:
+                rec["a"] = float(rng.normal())
+            if rng.random() > 0.2:
+                rec["b"] = float(rng.normal())
+            recs.append(rec)
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert not p.is_empty  # defaultScore keeps every lane total
+            assert p.target.label == o.label, (criterion, rec)
+            assert p.score.value == pytest.approx(o.value, rel=1e-5), (
+                criterion, rec,
+            )
+        return doc
+
+    def test_first_hit(self):
+        doc = self._parity("firstHit")
+        # a>1 fires r1 regardless of b
+        o = evaluate(doc, {"a": 2.0, "b": 1.0})
+        assert o.label == "hi" and o.value == pytest.approx(0.9)
+        # nested compound rule: b>0 AND a<0 → r2
+        o = evaluate(doc, {"a": -1.0, "b": 1.0})
+        assert o.label == "lo" and o.value == pytest.approx(0.8)
+        # nothing fires (a missing, b missing) → default
+        o = evaluate(doc, {})
+        assert o.label == "mid" and o.value == pytest.approx(0.3)
+
+    def test_weighted_sum(self):
+        doc = self._parity("weightedSum")
+        # a=2, b=1: r1 (hi, 2.0) + r3 (hi, 1.5) fire → hi total 3.5 over
+        # 2 fired rules
+        o = evaluate(doc, {"a": 2.0, "b": 1.0})
+        assert o.label == "hi"
+        assert o.value == pytest.approx(3.5 / 2)
+
+    def test_weighted_max(self):
+        doc = self._parity("weightedMax")
+        # a=-1, b=1: r2 (lo, w3.0) and r3 (hi, w1.5) fire → r2 wins
+        o = evaluate(doc, {"a": -1.0, "b": 1.0})
+        assert o.label == "lo" and o.value == pytest.approx(0.8)
+
+    def test_no_default_goes_empty(self):
+        xml = RULESET.format(criterion="firstHit").replace(
+            ' defaultScore="mid" defaultConfidence="0.3"', ""
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        p = cm.score_records([{}])[0]
+        assert p.is_empty
+        assert evaluate(doc, {}).is_missing
+
+
+class TestReviewRegressions:
+    def test_ragged_characteristic_unmatched_is_invalid(self):
+        """A characteristic with fewer attributes than the widest one
+        must still yield an invalid lane when nothing matches (review:
+        padded slots vacuously matched)."""
+        xml = """<PMML version="4.3"><DataDictionary>
+          <DataField name="x" optype="continuous" dataType="double"/>
+          <DataField name="y" optype="continuous" dataType="double"/>
+          <DataField name="score" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <Scorecard functionName="regression" initialScore="0"
+              useReasonCodes="false">
+          <MiningSchema><MiningField name="score" usageType="target"/>
+            <MiningField name="x"/><MiningField name="y"/></MiningSchema>
+          <Characteristics>
+            <Characteristic name="wide">
+              <Attribute partialScore="1">
+                <SimplePredicate field="x" operator="lessThan" value="0"/>
+              </Attribute>
+              <Attribute partialScore="2">
+                <SimplePredicate field="x" operator="lessThan" value="5"/>
+              </Attribute>
+              <Attribute partialScore="3"><True/></Attribute>
+            </Characteristic>
+            <Characteristic name="narrow">
+              <Attribute partialScore="10">
+                <SimplePredicate field="y" operator="greaterThan" value="0"/>
+              </Attribute>
+            </Characteristic>
+          </Characteristics></Scorecard></PMML>"""
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        recs = [
+            {"x": 1.0, "y": 1.0},   # both match → 2 + 10
+            {"x": 1.0, "y": -1.0},  # narrow unmatched → EMPTY
+            {"x": 9.0, "y": 2.0},   # 3 + 10
+        ]
+        preds = cm.score_records(recs)
+        for rec, p in zip(recs, preds):
+            o = evaluate(doc, rec)
+            assert o.is_missing == p.is_empty, rec
+        assert [p.is_empty for p in preds] == [False, True, False]
+        assert preds[0].score.value == pytest.approx(12.0)
+        assert preds[2].score.value == pytest.approx(13.0)
+
+    def test_inactive_declared_fields_never_invalidate(self):
+        """Extra declared columns (incl. a categorical target with
+        values) in the record must not trip returnInvalid on either path
+        (review: the oracle sanitized ALL DataDictionary fields)."""
+        xml = """<PMML version="4.3"><DataDictionary>
+          <DataField name="f" optype="continuous" dataType="double"/>
+          <DataField name="extra" optype="categorical" dataType="string">
+            <Value value="p"/><Value value="q"/></DataField>
+          <DataField name="y" optype="categorical" dataType="string">
+            <Value value="no"/><Value value="yes"/></DataField>
+          </DataDictionary>
+          <RegressionModel functionName="classification"
+              normalizationMethod="softmax">
+          <MiningSchema><MiningField name="y" usageType="target"/>
+            <MiningField name="f"/></MiningSchema>
+          <RegressionTable intercept="0.5" targetCategory="yes">
+            <NumericPredictor name="f" coefficient="1.0"/></RegressionTable>
+          <RegressionTable intercept="0" targetCategory="no"/>
+          </RegressionModel></PMML>"""
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"f": 1.0, "extra": "undeclared!", "y": "maybe"}
+        o = evaluate(doc, rec)
+        assert not o.is_missing  # inactive fields never invalidate
+        p = cm.score_records([rec])[0]
+        assert not p.is_empty
+        assert p.target.label == o.label
