@@ -31,7 +31,9 @@ from flink_jpmml_tpu.compile.common import (
     extract_invalid_policy,
     extract_missing_replacements,
 )
+from flink_jpmml_tpu.compile.bayes import lower_naive_bayes
 from flink_jpmml_tpu.compile.exprs import lower_expression
+from flink_jpmml_tpu.compile.glm import lower_general_regression
 from flink_jpmml_tpu.compile.mining import lower_mining
 from flink_jpmml_tpu.compile.neural import lower_neural_network
 from flink_jpmml_tpu.compile.regression import lower_regression
@@ -64,6 +66,10 @@ def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
         return lower_scorecard(model, ctx)
     if isinstance(model, ir.RuleSetIR):
         return lower_ruleset(model, ctx)
+    if isinstance(model, ir.GeneralRegressionIR):
+        return lower_general_regression(model, ctx)
+    if isinstance(model, ir.NaiveBayesIR):
+        return lower_naive_bayes(model, ctx)
     if isinstance(model, ir.MiningModelIR):
         return lower_mining(model, ctx)
     raise ModelCompilationException(

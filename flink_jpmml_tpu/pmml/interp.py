@@ -21,6 +21,7 @@ Missing-value semantics follow DMG PMML 4.x:
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Mapping, Optional, Tuple, Union
@@ -400,6 +401,10 @@ def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
         return _eval_scorecard(model, record)
     if isinstance(model, ir.RuleSetIR):
         return _eval_ruleset(model, record)
+    if isinstance(model, ir.GeneralRegressionIR):
+        return _eval_general_regression(model, record)
+    if isinstance(model, ir.NaiveBayesIR):
+        return _eval_naive_bayes(model, record)
     if isinstance(model, ir.MiningModelIR):
         return _eval_mining(model, record)
     raise ModelCompilationException(f"unsupported model {type(model).__name__}")
@@ -426,16 +431,24 @@ def _eval_scorecard(model: ir.ScorecardIR, record: Record) -> EvalResult:
         total += chosen[1].partial_score
     res = EvalResult(value=total)
     if model.use_reason_codes:
-        from flink_jpmml_tpu.compile.scorecard import ReasonCodeMeta
-
-        try:
-            meta = ReasonCodeMeta(model)
-        except ModelCompilationException:
-            # incomplete codes/baselines: surfaced at compile time iff an
-            # Output actually requests reason codes
-            return res
-        res.reason_codes = tuple(meta.rank(partials, attr_idx))
+        meta = _scorecard_reason_meta(model)
+        if meta is not None:
+            res.reason_codes = tuple(meta.rank(partials, attr_idx))
     return res
+
+
+@functools.lru_cache(maxsize=64)
+def _scorecard_reason_meta(model: ir.ScorecardIR):
+    """Per-document ReasonCodeMeta, built once (the IR is frozen and
+    hashable) — not per record. None when codes/baselines are
+    incomplete; that is surfaced at compile time iff an Output actually
+    requests reason codes."""
+    from flink_jpmml_tpu.compile.scorecard import ReasonCodeMeta
+
+    try:
+        return ReasonCodeMeta(model)
+    except ModelCompilationException:
+        return None
 
 
 # --- RuleSet ---------------------------------------------------------------
@@ -830,6 +843,161 @@ def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
     # <Output> probability fields agree between the two paths
     return EvalResult(value=float(best_idx), label=labels[best_idx],
                       probabilities=dict(zip(labels, dists)))
+
+
+# --- GeneralRegressionModel ------------------------------------------------
+
+
+def _glm_inverse_link(name, eta, power=None):
+    if name in (None, "identity"):
+        return eta
+    if name == "log":
+        return math.exp(eta)
+    if name == "logit":
+        return 1.0 / (1.0 + math.exp(-eta))
+    if name == "cloglog":
+        return 1.0 - math.exp(-math.exp(eta))
+    if name == "loglog":
+        return math.exp(-math.exp(-eta))
+    if name == "probit":
+        return 0.5 * (1.0 + math.erf(eta / math.sqrt(2.0)))
+    if name == "inverse":
+        return 1.0 / eta
+    if name == "cauchit":
+        return 0.5 + math.atan(eta) / math.pi
+    if name == "power":
+        if power is None or power == 0:
+            raise ModelCompilationException(
+                "power link needs a non-zero linkParameter"
+            )
+        return eta ** (1.0 / power)
+    raise ModelCompilationException(f"unsupported linkFunction {name!r}")
+
+
+def _eval_general_regression(
+    model: ir.GeneralRegressionIR, record: Record
+) -> EvalResult:
+    factor_set = set(model.factors)
+    x: Dict[str, float] = {p: 1.0 for p in model.parameters}
+    for cell in model.pp_cells:
+        v = record.get(cell.predictor)
+        if _is_missing(v):
+            return EvalResult()  # GLMs have no missing-value routing
+        if cell.predictor in factor_set:
+            x[cell.parameter] *= (
+                1.0 if _values_equal(v, cell.value) else 0.0
+            )
+        else:
+            f = _as_float(v)
+            if f is None:
+                return EvalResult()
+            try:
+                expo = float(cell.value)
+            except ValueError:
+                raise ModelCompilationException(
+                    f"covariate PPCell value {cell.value!r} is not a "
+                    "number (exponent)"
+                ) from None
+            try:
+                # math.pow (not **): a negative base with a fractional
+                # exponent must become NaN like the compiled jnp.power,
+                # never a complex number
+                x[cell.parameter] *= math.pow(f, expo)
+            except (ValueError, OverflowError):
+                x[cell.parameter] *= float("nan")
+
+    if model.model_type == "multinomialLogistic":
+        cats: List[str] = []
+        for c in model.p_cells:
+            if c.target_category is not None and c.target_category not in cats:
+                cats.append(c.target_category)
+        ref = model.target_reference_category
+        if ref is None:
+            # parse_pmml resolves this for top-level models; only a
+            # hand-built IR can reach here unresolved
+            raise ModelCompilationException(
+                "multinomialLogistic needs targetReferenceCategory"
+            )
+        if ref in cats:
+            cats.remove(ref)
+        etas = {c: 0.0 for c in cats}
+        for c in model.p_cells:
+            if c.target_category in etas:
+                etas[c.target_category] += c.beta * x[c.parameter]
+        all_cats = cats + [ref]
+        zs = [etas[c] for c in cats] + [0.0]
+        mz = max(zs)
+        es = [math.exp(z - mz) for z in zs]
+        s = sum(es)
+        probs = {c: e / s for c, e in zip(all_cats, es)}
+        label = max(all_cats, key=lambda c: probs[c])
+        return EvalResult(
+            value=probs[label], label=label, probabilities=probs
+        )
+
+    eta = sum(c.beta * x[c.parameter] for c in model.p_cells)
+    link = (
+        model.link_function
+        if model.model_type == "generalizedLinear"
+        else "identity"
+    )
+    return EvalResult(
+        value=_glm_inverse_link(link, eta, model.link_power)
+    )
+
+
+# --- NaiveBayes ------------------------------------------------------------
+
+
+def _eval_naive_bayes(model: ir.NaiveBayesIR, record: Record) -> EvalResult:
+    labels = [v for v, _ in model.target_counts]
+    totals = {v: c for v, c in model.target_counts}
+    if any(c <= 0 for c in totals.values()):
+        # same typed validation as the lowering — never a raw math
+        # domain error out of the oracle
+        raise ModelCompilationException(
+            "BayesOutput target counts must all be positive"
+        )
+    L = {t: math.log(totals[t]) for t in labels}
+    thr = model.threshold
+    for bi in model.inputs:
+        v = record.get(bi.field)
+        if _is_missing(v):
+            continue  # missing inputs drop their term
+        if isinstance(bi, ir.BayesCategoricalInput):
+            row = None
+            for value, counts in bi.counts:
+                if _values_equal(v, value):
+                    row = dict(counts)
+                    break
+            if row is None:
+                continue  # unknown input value: term dropped
+            for t in labels:
+                p = row.get(t, 0.0) / totals[t]
+                if p <= 0 and thr <= 0:
+                    raise ModelCompilationException(
+                        f"BayesInput {bi.field!r}: zero conditional "
+                        "probability with no positive model threshold"
+                    )
+                L[t] += math.log(p if p > 0 else thr)
+        else:
+            f = _as_float(v)
+            if f is None:
+                continue
+            stats = {tv: (m, var) for tv, m, var in bi.stats}
+            for t in labels:
+                if t not in stats:
+                    continue
+                m, var = stats[t]
+                L[t] += -0.5 * math.log(2.0 * math.pi * var) - (
+                    (f - m) ** 2 / (2.0 * var)
+                )
+    mz = max(L.values())
+    es = {t: math.exp(L[t] - mz) for t in labels}
+    s = sum(es.values())
+    probs = {t: e / s for t, e in es.items()}
+    label = max(labels, key=lambda t: probs[t])
+    return EvalResult(value=probs[label], label=label, probabilities=probs)
 
 
 # --- MiningModel -----------------------------------------------------------

@@ -445,6 +445,80 @@ class RuleSetIR:
 
 
 # ---------------------------------------------------------------------------
+# GeneralRegressionModel
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PPCell:
+    """One predictor→parameter contribution: for a covariate, ``value``
+    is the exponent; for a factor, the category the indicator matches."""
+
+    predictor: str
+    parameter: str
+    value: str
+
+
+@dataclass(frozen=True)
+class PCell:
+    parameter: str
+    beta: float
+    target_category: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class GeneralRegressionIR:
+    """GLM family: x_p = Π covariate^exponent × Π [factor == category];
+    η_t = Σ_p β_{t,p} x_p; link applies per modelType."""
+
+    function_name: str
+    mining_schema: MiningSchema
+    model_type: str  # regression | generalLinear | generalizedLinear |
+    #                  multinomialLogistic
+    parameters: Tuple[str, ...]  # parameter names, document order
+    factors: Tuple[str, ...]  # categorical predictors
+    covariates: Tuple[str, ...]  # continuous predictors
+    pp_cells: Tuple[PPCell, ...]
+    p_cells: Tuple[PCell, ...]
+    link_function: Optional[str] = None  # generalizedLinear
+    link_power: Optional[float] = None  # for power link
+    target_reference_category: Optional[str] = None
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# NaiveBayes
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BayesCategoricalInput:
+    """Per input category: counts of each target value (PairCounts)."""
+
+    field: str
+    counts: Tuple[Tuple[str, Tuple[Tuple[str, float], ...]], ...]
+    # ((input_value, ((target_value, count), ...)), ...)
+
+
+@dataclass(frozen=True)
+class BayesContinuousInput:
+    """Gaussian class-conditional density per target value."""
+
+    field: str
+    stats: Tuple[Tuple[str, float, float], ...]  # (target, mean, variance)
+
+
+@dataclass(frozen=True)
+class NaiveBayesIR:
+    function_name: str  # classification
+    mining_schema: MiningSchema
+    inputs: Tuple[Union[BayesCategoricalInput, BayesContinuousInput], ...]
+    target_counts: Tuple[Tuple[str, float], ...]  # (target value, count)
+    threshold: float  # replaces zero/absent conditional probabilities
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
 # MiningModel (ensembles / stacking)
 # ---------------------------------------------------------------------------
 
@@ -455,6 +529,8 @@ ModelIR = Union[
     ClusteringModelIR,
     ScorecardIR,
     RuleSetIR,
+    GeneralRegressionIR,
+    NaiveBayesIR,
     "MiningModelIR",
 ]
 

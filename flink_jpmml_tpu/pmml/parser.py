@@ -28,6 +28,8 @@ _MODEL_TAGS = (
     "ClusteringModel",
     "Scorecard",
     "RuleSetModel",
+    "GeneralRegressionModel",
+    "NaiveBayesModel",
     "MiningModel",
 )
 
@@ -121,6 +123,7 @@ def parse_pmml(xml_text: str) -> ir.PmmlDocument:
         )
 
     model = _parse_model(model_elem)
+    model = _resolve_glm_reference(model, data_dictionary)
     targets = _parse_targets(_child(model_elem, "Targets"))
     output_fields = _parse_output(_child(model_elem, "Output"))
     return ir.PmmlDocument(
@@ -131,6 +134,48 @@ def parse_pmml(xml_text: str) -> ir.PmmlDocument:
         model=model,
         targets=targets,
         output_fields=output_fields,
+    )
+
+
+def _resolve_glm_reference(model, dd: ir.DataDictionary):
+    """multinomialLogistic without targetReferenceCategory: resolve it to
+    the target DataField's last declared value (the R multinom
+    convention) once at parse time, so the oracle and the lowering read
+    the same resolved attribute. Recurses into MiningModel segments."""
+    import dataclasses
+
+    if isinstance(model, ir.MiningModelIR):
+        seg = model.segmentation
+        if seg is None:
+            return model
+        new_segs = tuple(
+            dataclasses.replace(
+                s, model=_resolve_glm_reference(s.model, dd)
+            )
+            for s in seg.segments
+        )
+        if all(a.model is b.model for a, b in zip(new_segs, seg.segments)):
+            return model
+        return dataclasses.replace(
+            model,
+            segmentation=dataclasses.replace(seg, segments=new_segs),
+        )
+    if (
+        not isinstance(model, ir.GeneralRegressionIR)
+        or model.model_type != "multinomialLogistic"
+        or model.target_reference_category is not None
+    ):
+        return model
+    target = model.mining_schema.target_field
+    if target is not None and target in dd:
+        values = dd.field(target).values
+        if values:
+            return dataclasses.replace(
+                model, target_reference_category=values[-1]
+            )
+    raise ModelLoadingException(
+        "multinomialLogistic needs targetReferenceCategory or a target "
+        "DataField with declared values"
     )
 
 
@@ -451,9 +496,132 @@ def _parse_model(elem: ET.Element) -> ir.ModelIR:
         return _parse_scorecard(elem)
     if tag == "RuleSetModel":
         return _parse_ruleset_model(elem)
+    if tag == "GeneralRegressionModel":
+        return _parse_general_regression(elem)
+    if tag == "NaiveBayesModel":
+        return _parse_naive_bayes(elem)
     if tag == "MiningModel":
         return _parse_mining_model(elem)
     raise ModelLoadingException(f"unsupported model element <{tag}>")
+
+
+def _parse_general_regression(elem: ET.Element) -> ir.GeneralRegressionIR:
+    params = tuple(
+        p.get("name", "")
+        for p in _children(_req_child(elem, "ParameterList"), "Parameter")
+    )
+    fl = _child(elem, "FactorList")
+    factors = tuple(
+        p.get("name", "") for p in _children(fl, "Predictor")
+    ) if fl is not None else ()
+    cl = _child(elem, "CovariateList")
+    covariates = tuple(
+        p.get("name", "") for p in _children(cl, "Predictor")
+    ) if cl is not None else ()
+    pp = _child(elem, "PPMatrix")
+    pp_cells = tuple(
+        ir.PPCell(
+            predictor=c.get("predictorName", ""),
+            parameter=c.get("parameterName", ""),
+            value=c.get("value", "1"),
+        )
+        for c in _children(pp, "PPCell")
+    ) if pp is not None else ()
+    pm = _req_child(elem, "ParamMatrix")
+    p_cells = []
+    for c in _children(pm, "PCell"):
+        beta = c.get("beta")
+        if beta is None:
+            # required attribute: a silently-zeroed coefficient is a
+            # silently-wrong model
+            raise ModelLoadingException(
+                f"PCell for parameter {c.get('parameterName')!r} has no "
+                "beta"
+            )
+        p_cells.append(
+            ir.PCell(
+                parameter=c.get("parameterName", ""),
+                beta=float(beta),
+                target_category=c.get("targetCategory"),
+            )
+        )
+    p_cells = tuple(p_cells)
+    lp = elem.get("linkParameter")
+    return ir.GeneralRegressionIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=_parse_mining_schema(elem),
+        model_type=elem.get("modelType", "generalLinear"),
+        parameters=params,
+        factors=factors,
+        covariates=covariates,
+        pp_cells=pp_cells,
+        p_cells=p_cells,
+        link_function=elem.get("linkFunction"),
+        link_power=float(lp) if lp is not None else None,
+        target_reference_category=elem.get("targetReferenceCategory"),
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_naive_bayes(elem: ET.Element) -> ir.NaiveBayesIR:
+    inputs = []
+    bi_elem = _req_child(elem, "BayesInputs")
+    for bi in _children(bi_elem, "BayesInput"):
+        field = bi.get("fieldName", "")
+        stats = _child(bi, "TargetValueStats")
+        if stats is not None:
+            rows = []
+            for tv in _children(stats, "TargetValueStat"):
+                g = _child(tv, "GaussianDistribution")
+                if g is None:
+                    raise ModelLoadingException(
+                        f"BayesInput {field!r}: only GaussianDistribution "
+                        "TargetValueStats are supported"
+                    )
+                mean = g.get("mean")
+                var = g.get("variance")
+                if mean is None or var is None:
+                    raise ModelLoadingException(
+                        f"BayesInput {field!r}: GaussianDistribution "
+                        "needs both mean and variance"
+                    )
+                rows.append((tv.get("value", ""), float(mean), float(var)))
+            inputs.append(
+                ir.BayesContinuousInput(field=field, stats=tuple(rows))
+            )
+            continue
+        pairs = []
+        for pv in _children(bi, "PairCounts"):
+            tvc = _req_child(pv, "TargetValueCounts")
+            counts = tuple(
+                (c.get("value", ""), _float(c, "count", 0.0))
+                for c in _children(tvc, "TargetValueCount")
+            )
+            pairs.append((pv.get("value", ""), counts))
+        if not pairs:
+            raise ModelLoadingException(
+                f"BayesInput {field!r} has neither TargetValueStats nor "
+                "PairCounts"
+            )
+        inputs.append(
+            ir.BayesCategoricalInput(field=field, counts=tuple(pairs))
+        )
+    bo = _req_child(elem, "BayesOutput")
+    tvc = _req_child(bo, "TargetValueCounts")
+    target_counts = tuple(
+        (c.get("value", ""), _float(c, "count", 0.0))
+        for c in _children(tvc, "TargetValueCount")
+    )
+    if not target_counts:
+        raise ModelLoadingException("BayesOutput has no TargetValueCounts")
+    return ir.NaiveBayesIR(
+        function_name=elem.get("functionName", "classification"),
+        mining_schema=_parse_mining_schema(elem),
+        inputs=tuple(inputs),
+        target_counts=target_counts,
+        threshold=float(elem.get("threshold", 0.0)),
+        model_name=elem.get("modelName"),
+    )
 
 
 def _parse_scorecard(elem: ET.Element) -> ir.ScorecardIR:

@@ -1,0 +1,336 @@
+"""GeneralRegressionModel and NaiveBayesModel families, golden-diffed
+compiled vs oracle vs hand-computed values (R glm / multinom export
+shapes)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+GLM = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x1" optype="continuous" dataType="double"/>
+  <DataField name="x2" optype="continuous" dataType="double"/>
+  <DataField name="color" optype="categorical" dataType="string">
+    <Value value="red"/><Value value="blue"/></DataField>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <GeneralRegressionModel functionName="regression"
+      modelType="{model_type}" {link_attr}>
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="x1"/><MiningField name="x2"/>
+    <MiningField name="color"/></MiningSchema>
+  <ParameterList>
+    <Parameter name="p0" label="intercept"/>
+    <Parameter name="p1"/>
+    <Parameter name="p2"/>
+    <Parameter name="p3"/>
+  </ParameterList>
+  <FactorList><Predictor name="color"/></FactorList>
+  <CovariateList><Predictor name="x1"/><Predictor name="x2"/>
+  </CovariateList>
+  <PPMatrix>
+    <PPCell value="1" predictorName="x1" parameterName="p1"/>
+    <PPCell value="2" predictorName="x2" parameterName="p2"/>
+    <PPCell value="red" predictorName="color" parameterName="p3"/>
+    <PPCell value="1" predictorName="x1" parameterName="p3"/>
+  </PPMatrix>
+  <ParamMatrix>
+    <PCell parameterName="p0" beta="0.5"/>
+    <PCell parameterName="p1" beta="2.0"/>
+    <PCell parameterName="p2" beta="-1.0"/>
+    <PCell parameterName="p3" beta="3.0"/>
+  </ParamMatrix>
+  </GeneralRegressionModel></PMML>"""
+
+
+def _eta(x1, x2, color):
+    # p0=1 (intercept); p1=x1; p2=x2²; p3=[color==red]·x1
+    return (
+        0.5 + 2.0 * x1 - 1.0 * x2 * x2 + 3.0 * (1.0 if color == "red" else 0.0) * x1
+    )
+
+
+class TestGeneralRegression:
+    def _parity(self, xml, n=150, seed=0):
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(seed)
+        recs = [
+            {
+                "x1": float(a),
+                "x2": float(b),
+                "color": str(rng.choice(["red", "blue"])),
+            }
+            for a, b in rng.normal(0, 1, size=(n, 2))
+        ]
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert not p.is_empty and o.value is not None
+            # f32 device vs f64 oracle: link tails (cloglog/probit near
+            # saturation) cost a few ulps more than the linear case
+            assert p.score.value == pytest.approx(
+                o.value, rel=2e-3, abs=1e-6
+            ), rec
+            if o.label is not None:
+                assert p.target.label == o.label, rec
+        return doc
+
+    def test_general_linear_hand_values(self):
+        doc = self._parity(GLM.format(model_type="generalLinear",
+                                      link_attr=""))
+        o = evaluate(doc, {"x1": 1.0, "x2": 2.0, "color": "red"})
+        assert o.value == pytest.approx(_eta(1.0, 2.0, "red"))
+        o = evaluate(doc, {"x1": -0.5, "x2": 1.0, "color": "blue"})
+        assert o.value == pytest.approx(_eta(-0.5, 1.0, "blue"))
+
+    @pytest.mark.parametrize("link,inv", [
+        ("log", math.exp),
+        ("logit", lambda e: 1 / (1 + math.exp(-e))),
+        ("cloglog", lambda e: 1 - math.exp(-math.exp(e))),
+        ("probit", lambda e: 0.5 * (1 + math.erf(e / math.sqrt(2)))),
+        ("cauchit", lambda e: 0.5 + math.atan(e) / math.pi),
+    ])
+    def test_generalized_links(self, link, inv):
+        doc = self._parity(GLM.format(
+            model_type="generalizedLinear",
+            link_attr=f'linkFunction="{link}"',
+        ))
+        e = _eta(0.3, -0.4, "red")
+        o = evaluate(doc, {"x1": 0.3, "x2": -0.4, "color": "red"})
+        assert o.value == pytest.approx(inv(e), rel=1e-6)
+
+    def test_missing_predictor_is_empty_lane(self):
+        doc = parse_pmml(GLM.format(model_type="generalLinear",
+                                    link_attr=""))
+        cm = compile_pmml(doc)
+        preds = cm.score_records([
+            {"x1": 1.0, "x2": 1.0, "color": "red"},
+            {"x2": 1.0, "color": "red"},  # x1 missing
+            {"x1": 1.0, "x2": 1.0},       # color missing
+        ])
+        assert [p.is_empty for p in preds] == [False, True, True]
+        assert evaluate(doc, {"x2": 1.0, "color": "red"}).is_missing
+
+
+MULTINOMIAL = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  <DataField name="species" optype="categorical" dataType="string">
+    <Value value="a"/><Value value="b"/><Value value="c"/></DataField>
+  </DataDictionary>
+  <GeneralRegressionModel functionName="classification"
+      modelType="multinomialLogistic">
+  <MiningSchema><MiningField name="species" usageType="target"/>
+    <MiningField name="x"/></MiningSchema>
+  <ParameterList><Parameter name="p0"/><Parameter name="p1"/>
+  </ParameterList>
+  <CovariateList><Predictor name="x"/></CovariateList>
+  <PPMatrix><PPCell value="1" predictorName="x" parameterName="p1"/>
+  </PPMatrix>
+  <ParamMatrix>
+    <PCell targetCategory="a" parameterName="p0" beta="0.2"/>
+    <PCell targetCategory="a" parameterName="p1" beta="1.5"/>
+    <PCell targetCategory="b" parameterName="p0" beta="-0.3"/>
+    <PCell targetCategory="b" parameterName="p1" beta="-0.8"/>
+  </ParamMatrix>
+  </GeneralRegressionModel></PMML>"""
+
+
+class TestMultinomialLogistic:
+    def test_reference_category_softmax(self):
+        doc = parse_pmml(MULTINOMIAL)
+        # reference resolves to the target's last declared value: "c"
+        assert doc.model.target_reference_category == "c"
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(1)
+        recs = [{"x": float(v)} for v in rng.normal(0, 2, size=100)]
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert p.target.label == o.label, rec
+            for k in ("a", "b", "c"):
+                assert p.target.probabilities[k] == pytest.approx(
+                    o.probabilities[k], rel=1e-4, abs=1e-6
+                )
+        # hand check at x = 1: eta_a = 1.7, eta_b = -1.1, eta_c = 0
+        x = 1.0
+        za, zb, zc = 0.2 + 1.5 * x, -0.3 - 0.8 * x, 0.0
+        s = math.exp(za) + math.exp(zb) + math.exp(zc)
+        o = evaluate(doc, {"x": x})
+        assert o.probabilities["a"] == pytest.approx(math.exp(za) / s)
+        assert o.label == "a"
+
+
+NAIVE_BAYES = """<PMML version="4.3"><DataDictionary>
+  <DataField name="outlook" optype="categorical" dataType="string">
+    <Value value="sunny"/><Value value="rain"/></DataField>
+  <DataField name="temp" optype="continuous" dataType="double"/>
+  <DataField name="play" optype="categorical" dataType="string">
+    <Value value="yes"/><Value value="no"/></DataField>
+  </DataDictionary>
+  <NaiveBayesModel functionName="classification" threshold="0.001">
+  <MiningSchema><MiningField name="play" usageType="target"/>
+    <MiningField name="outlook" invalidValueTreatment="asIs"/>
+    <MiningField name="temp"/></MiningSchema>
+  <BayesInputs>
+    <BayesInput fieldName="outlook">
+      <PairCounts value="sunny"><TargetValueCounts>
+        <TargetValueCount value="yes" count="6"/>
+        <TargetValueCount value="no" count="1"/>
+      </TargetValueCounts></PairCounts>
+      <PairCounts value="rain"><TargetValueCounts>
+        <TargetValueCount value="yes" count="4"/>
+        <TargetValueCount value="no" count="9"/>
+      </TargetValueCounts></PairCounts>
+    </BayesInput>
+    <BayesInput fieldName="temp">
+      <TargetValueStats>
+        <TargetValueStat value="yes"><GaussianDistribution
+          mean="22.0" variance="9.0"/></TargetValueStat>
+        <TargetValueStat value="no"><GaussianDistribution
+          mean="10.0" variance="16.0"/></TargetValueStat>
+      </TargetValueStats>
+    </BayesInput>
+  </BayesInputs>
+  <BayesOutput fieldName="play"><TargetValueCounts>
+    <TargetValueCount value="yes" count="10"/>
+    <TargetValueCount value="no" count="10"/>
+  </TargetValueCounts></BayesOutput>
+  </NaiveBayesModel></PMML>"""
+
+
+class TestNaiveBayes:
+    def test_parity_and_hand_value(self):
+        doc = parse_pmml(NAIVE_BAYES)
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(2)
+        recs = []
+        for _ in range(150):
+            rec = {}
+            if rng.random() > 0.2:
+                rec["outlook"] = str(rng.choice(["sunny", "rain", "fog"]))
+            if rng.random() > 0.2:
+                rec["temp"] = float(rng.uniform(-5, 35))
+            recs.append(rec)
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert not p.is_empty
+            assert p.target.label == o.label, rec
+            for k in ("yes", "no"):
+                assert p.target.probabilities[k] == pytest.approx(
+                    o.probabilities[k], rel=1e-4, abs=1e-6
+                )
+        # hand computation: sunny, temp 20
+        def gauss(x, m, v):
+            return math.exp(-((x - m) ** 2) / (2 * v)) / math.sqrt(
+                2 * math.pi * v
+            )
+
+        l_yes = 10 * (6 / 10) * gauss(20.0, 22.0, 9.0)
+        l_no = 10 * (1 / 10) * gauss(20.0, 10.0, 16.0)
+        o = evaluate(doc, {"outlook": "sunny", "temp": 20.0})
+        assert o.label == "yes"
+        assert o.probabilities["yes"] == pytest.approx(
+            l_yes / (l_yes + l_no), rel=1e-6
+        )
+
+    def test_all_missing_scores_priors(self):
+        doc = parse_pmml(NAIVE_BAYES)
+        cm = compile_pmml(doc)
+        # equal priors (10/10): argmax tie → first label on both paths
+        p = cm.score_records([{}])[0]
+        o = evaluate(doc, {})
+        assert not p.is_empty and o.label == p.target.label == "yes"
+        assert p.target.probabilities["yes"] == pytest.approx(0.5)
+
+    def test_zero_count_takes_threshold(self):
+        xml = NAIVE_BAYES.replace('value="no" count="1"', 'value="no" count="0"')
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"outlook": "sunny"}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        # P(sunny|no) = 0 → threshold 0.001
+        l_yes, l_no = 10 * 0.6, 10 * 0.001
+        assert o.probabilities["no"] == pytest.approx(
+            l_no / (l_yes + l_no), rel=1e-6
+        )
+        assert p.target.probabilities["no"] == pytest.approx(
+            o.probabilities["no"], rel=1e-4
+        )
+
+
+class TestReviewRegressions:
+    def test_multinomial_glm_in_mining_segment_resolves_reference(self):
+        """A multinomialLogistic GLM nested in a MiningModel segment must
+        resolve its reference category at parse time like a top-level
+        one (review: the oracle raised while the compiled path scored)."""
+        inner = MULTINOMIAL.split("<GeneralRegressionModel", 1)[1]
+        inner = "<GeneralRegressionModel" + inner.rsplit("</PMML>", 1)[0]
+        xml = MULTINOMIAL.split("<GeneralRegressionModel", 1)[0] + f"""
+          <MiningModel functionName="classification">
+          <MiningSchema><MiningField name="species" usageType="target"/>
+            <MiningField name="x"/></MiningSchema>
+          <Segmentation multipleModelMethod="selectFirst">
+            <Segment><True/>{inner}</Segment>
+          </Segmentation></MiningModel></PMML>"""
+        doc = parse_pmml(xml)
+        seg_model = doc.model.segmentation.segments[0].model
+        assert seg_model.target_reference_category == "c"
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(5)
+        for v in rng.normal(0, 2, size=30):
+            rec = {"x": float(v)}
+            o = evaluate(doc, rec)  # must not raise
+            p = cm.score_records([rec])[0]
+            assert p.target.label == o.label
+
+    def test_negative_base_fractional_exponent_is_nan_not_complex(self):
+        xml = GLM.format(model_type="generalLinear", link_attr="").replace(
+            '<PPCell value="2" predictorName="x2" parameterName="p2"/>',
+            '<PPCell value="0.5" predictorName="x2" parameterName="p2"/>',
+        )
+        doc = parse_pmml(xml)
+        o = evaluate(doc, {"x1": 1.0, "x2": -2.0, "color": "blue"})
+        assert not isinstance(o.value, complex)
+        assert o.value != o.value  # NaN, matching jnp.power
+
+    def test_duplicate_pcells_sum_on_both_paths(self):
+        xml = GLM.format(model_type="generalLinear", link_attr="").replace(
+            '<PCell parameterName="p1" beta="2.0"/>',
+            '<PCell parameterName="p1" beta="2.0"/>'
+            '<PCell parameterName="p1" beta="3.0"/>',
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"x1": 1.0, "x2": 0.0, "color": "blue"}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.value == pytest.approx(0.5 + 5.0)  # betas summed
+        assert p.score.value == pytest.approx(o.value)
+
+    def test_missing_beta_rejected_at_parse(self):
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        xml = GLM.format(model_type="generalLinear", link_attr="").replace(
+            '<PCell parameterName="p1" beta="2.0"/>',
+            '<PCell parameterName="p1"/>',
+        )
+        with pytest.raises(ModelLoadingException, match="beta"):
+            parse_pmml(xml)
+
+    def test_zero_count_without_threshold_typed_error_on_both_paths(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        xml = NAIVE_BAYES.replace(' threshold="0.001"', "").replace(
+            'value="no" count="1"', 'value="no" count="0"'
+        )
+        doc = parse_pmml(xml)
+        with pytest.raises(ModelCompilationException, match="threshold"):
+            compile_pmml(doc)
+        with pytest.raises(ModelCompilationException, match="threshold"):
+            evaluate(doc, {"outlook": "sunny"})
