@@ -39,6 +39,7 @@ from flink_jpmml_tpu.compile.neural import lower_neural_network
 from flink_jpmml_tpu.compile.regression import lower_regression
 from flink_jpmml_tpu.compile.ruleset import lower_ruleset
 from flink_jpmml_tpu.compile.scorecard import lower_scorecard
+from flink_jpmml_tpu.compile.svm import lower_svm
 from flink_jpmml_tpu.compile.trees import lower_tree
 from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
 from flink_jpmml_tpu.pmml import ir
@@ -70,6 +71,8 @@ def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
         return lower_general_regression(model, ctx)
     if isinstance(model, ir.NaiveBayesIR):
         return lower_naive_bayes(model, ctx)
+    if isinstance(model, ir.SvmModelIR):
+        return lower_svm(model, ctx)
     if isinstance(model, ir.MiningModelIR):
         return lower_mining(model, ctx)
     raise ModelCompilationException(

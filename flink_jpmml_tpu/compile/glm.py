@@ -70,23 +70,20 @@ def inverse_link(name, eta, power=None):
 
 def _resolve_categories(model: ir.GeneralRegressionIR, ctx: LowerCtx):
     """multinomialLogistic target categories (document order from the
-    ParamMatrix) + the reference category pinned at η = 0."""
+    ParamMatrix) + the reference category pinned at η = 0. The parser
+    resolves a missing targetReferenceCategory at load time
+    (parse_pmml._resolve_glm_reference, including segment-nested GLMs),
+    so one convention lives in one place — here it is simply required,
+    exactly like the oracle."""
     cats: list = []
     for c in model.p_cells:
         if c.target_category is not None and c.target_category not in cats:
             cats.append(c.target_category)
     ref = model.target_reference_category
     if ref is None:
-        # convention: the target's last declared value (R multinom)
-        target = model.mining_schema.target_field
-        for name, codec in ctx.codecs.items():
-            if name == target and codec:
-                ref = max(codec, key=codec.get)
-        if ref is None:
-            raise ModelCompilationException(
-                "multinomialLogistic needs targetReferenceCategory or a "
-                "target DataField with declared values"
-            )
+        raise ModelCompilationException(
+            "multinomialLogistic needs targetReferenceCategory"
+        )
     if ref in cats:
         cats.remove(ref)
     return cats, ref
@@ -137,6 +134,10 @@ def lower_general_regression(
         T = len(cats)
         beta = np.zeros((P, T), np.float32)
         for c in model.p_cells:
+            if c.parameter not in pidx:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
             if c.target_category is None:
                 raise ModelCompilationException(
                     "multinomialLogistic PCell without targetCategory"
@@ -150,6 +151,10 @@ def lower_general_regression(
         labels = ()
         beta = np.zeros((P, 1), np.float32)
         for c in model.p_cells:
+            if c.parameter not in pidx:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
             if c.target_category is not None:
                 raise ModelCompilationException(
                     f"modelType {model.model_type!r} with per-category "

@@ -405,6 +405,8 @@ def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
         return _eval_general_regression(model, record)
     if isinstance(model, ir.NaiveBayesIR):
         return _eval_naive_bayes(model, record)
+    if isinstance(model, ir.SvmModelIR):
+        return _eval_svm(model, record)
     if isinstance(model, ir.MiningModelIR):
         return _eval_mining(model, record)
     raise ModelCompilationException(f"unsupported model {type(model).__name__}")
@@ -437,18 +439,32 @@ def _eval_scorecard(model: ir.ScorecardIR, record: Record) -> EvalResult:
     return res
 
 
-@functools.lru_cache(maxsize=64)
+_reason_meta_cache: dict = {}  # id(model) -> (weakref, meta|None)
+
+
 def _scorecard_reason_meta(model: ir.ScorecardIR):
-    """Per-document ReasonCodeMeta, built once (the IR is frozen and
-    hashable) — not per record. None when codes/baselines are
-    incomplete; that is surfaced at compile time iff an Output actually
-    requests reason codes."""
+    """Per-document ReasonCodeMeta, built once per model *instance* —
+    identity-keyed with a weakref cleanup, so swapped-out served models
+    are never pinned and no per-record re-hash of the IR tree happens.
+    None when codes/baselines are incomplete; that is surfaced at
+    compile time iff an Output actually requests reason codes."""
+    import weakref
+
     from flink_jpmml_tpu.compile.scorecard import ReasonCodeMeta
 
+    key = id(model)
+    hit = _reason_meta_cache.get(key)
+    if hit is not None and hit[0]() is model:
+        return hit[1]
     try:
-        return ReasonCodeMeta(model)
+        meta = ReasonCodeMeta(model)
     except ModelCompilationException:
-        return None
+        meta = None
+    ref = weakref.ref(
+        model, lambda _r, _k=key: _reason_meta_cache.pop(_k, None)
+    )
+    _reason_meta_cache[key] = (ref, meta)
+    return meta
 
 
 # --- RuleSet ---------------------------------------------------------------
@@ -862,6 +878,9 @@ def _glm_inverse_link(name, eta, power=None):
     if name == "probit":
         return 0.5 * (1.0 + math.erf(eta / math.sqrt(2.0)))
     if name == "inverse":
+        # η = 0 → signed infinity, matching the compiled 1/±0.0
+        if eta == 0:
+            return math.copysign(math.inf, eta)
         return 1.0 / eta
     if name == "cauchit":
         return 0.5 + math.atan(eta) / math.pi
@@ -870,7 +889,12 @@ def _glm_inverse_link(name, eta, power=None):
             raise ModelCompilationException(
                 "power link needs a non-zero linkParameter"
             )
-        return eta ** (1.0 / power)
+        try:
+            # math.pow, not **: a negative η with fractional 1/power must
+            # be NaN like the compiled jnp.power, never complex
+            return math.pow(eta, 1.0 / power)
+        except (ValueError, OverflowError):
+            return float("nan")
     raise ModelCompilationException(f"unsupported linkFunction {name!r}")
 
 
@@ -922,6 +946,10 @@ def _eval_general_regression(
             cats.remove(ref)
         etas = {c: 0.0 for c in cats}
         for c in model.p_cells:
+            if c.parameter not in x:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
             if c.target_category in etas:
                 etas[c.target_category] += c.beta * x[c.parameter]
         all_cats = cats + [ref]
@@ -935,7 +963,20 @@ def _eval_general_regression(
             value=probs[label], label=label, probabilities=probs
         )
 
-    eta = sum(c.beta * x[c.parameter] for c in model.p_cells)
+    eta = 0.0
+    for c in model.p_cells:
+        if c.target_category is not None:
+            # same typed rejection as the lowering — summing per-category
+            # betas into one eta would be a plausible-looking wrong score
+            raise ModelCompilationException(
+                f"modelType {model.model_type!r} with per-category "
+                "PCells — use multinomialLogistic"
+            )
+        if c.parameter not in x:
+            raise ModelCompilationException(
+                f"PCell references unknown parameter {c.parameter!r}"
+            )
+        eta += c.beta * x[c.parameter]
     link = (
         model.link_function
         if model.model_type == "generalizedLinear"
@@ -998,6 +1039,96 @@ def _eval_naive_bayes(model: ir.NaiveBayesIR, record: Record) -> EvalResult:
     probs = {t: e / s for t, e in es.items()}
     label = max(labels, key=lambda t: probs[t])
     return EvalResult(value=probs[label], label=label, probabilities=probs)
+
+
+# --- SupportVectorMachine --------------------------------------------------
+
+
+def _svm_kernel_value(kernel: ir.SvmKernel, x: List[float], s) -> float:
+    dot = sum(a * b for a, b in zip(x, s))
+    if kernel.kind == "linear":
+        return dot
+    if kernel.kind == "polynomial":
+        return (kernel.gamma * dot + kernel.coef0) ** kernel.degree
+    if kernel.kind == "sigmoid":
+        return math.tanh(kernel.gamma * dot + kernel.coef0)
+    if kernel.kind == "radialBasis":
+        d2 = sum((a - b) ** 2 for a, b in zip(x, s))
+        return math.exp(-kernel.gamma * d2)
+    raise ModelCompilationException(
+        f"unsupported SVM kernel {kernel.kind!r}"
+    )
+
+
+def _eval_svm(model: ir.SvmModelIR, record: Record) -> EvalResult:
+    xs: List[float] = []
+    for f in model.vector_fields:
+        v = _as_float(record.get(f))
+        if v is None:
+            return EvalResult()  # SVMs have no missing-value routing
+        xs.append(v)
+    coords = {vid: c for vid, c in model.vectors}
+    kv = {
+        vid: _svm_kernel_value(model.kernel, xs, c)
+        for vid, c in coords.items()
+    }
+    fs = []
+    for m in model.machines:
+        f = m.intercept
+        for vid, alpha in zip(m.vector_ids, m.coefficients):
+            if vid not in kv:
+                raise ModelCompilationException(
+                    f"SupportVector references unknown vectorId {vid!r}"
+                )
+            f += alpha * kv[vid]
+        fs.append(f)
+
+    if model.function_name != "classification":
+        return EvalResult(value=fs[0])
+
+    labels: List[str] = []
+    for m in model.machines:
+        for cat in (m.target_category, m.alternate_target_category):
+            if cat is not None and cat not in labels:
+                labels.append(cat)
+    if model.classification_method == "OneAgainstOne":
+        counts = {c: 0.0 for c in labels}
+        for m, f in zip(model.machines, fs):
+            if (
+                m.target_category is None
+                or m.alternate_target_category is None
+            ):
+                # same typed rejection as the lowering
+                raise ModelCompilationException(
+                    "OneAgainstOne machines need targetCategory and "
+                    "alternateTargetCategory"
+                )
+            thr = m.threshold if m.threshold is not None else model.threshold
+            # f < threshold votes targetCategory (module convention —
+            # see compile/svm.py docstring)
+            winner = (
+                m.target_category
+                if f < thr
+                else m.alternate_target_category
+            )
+            counts[winner] += 1.0
+        label = labels[0]
+        for c in labels:  # document order breaks ties
+            if counts[c] > counts[label]:
+                label = c
+        total = sum(counts.values())
+        probs = {c: counts[c] / total for c in labels}
+        return EvalResult(value=probs[label], label=label,
+                          probabilities=probs)
+    # OneAgainstAll: smallest decision value wins
+    scores = {c: math.inf for c in labels}
+    for m, f in zip(model.machines, fs):
+        scores[m.target_category] = min(scores[m.target_category], f)
+    label = labels[0]
+    for c in labels:
+        if scores[c] < scores[label]:
+            label = c
+    return EvalResult(value=scores[label], label=label)
 
 
 # --- MiningModel -----------------------------------------------------------

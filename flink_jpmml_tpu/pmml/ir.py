@@ -519,6 +519,44 @@ class NaiveBayesIR:
 
 
 # ---------------------------------------------------------------------------
+# SupportVectorMachine
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SvmKernel:
+    kind: str  # linear | polynomial | radialBasis | sigmoid
+    gamma: float = 1.0
+    coef0: float = 0.0
+    degree: float = 1.0
+
+
+@dataclass(frozen=True)
+class SvmMachine:
+    """One decision function: f(x) = Σ αᵢ·K(svᵢ, x) + b."""
+
+    vector_ids: Tuple[str, ...]
+    coefficients: Tuple[float, ...]
+    intercept: float
+    target_category: Optional[str] = None
+    alternate_target_category: Optional[str] = None
+    threshold: Optional[float] = None  # overrides the model's
+
+
+@dataclass(frozen=True)
+class SvmModelIR:
+    function_name: str  # classification | regression
+    mining_schema: MiningSchema
+    kernel: SvmKernel
+    vector_fields: Tuple[str, ...]
+    vectors: Tuple[Tuple[str, Tuple[float, ...]], ...]  # (id, dense coords)
+    machines: Tuple[SvmMachine, ...]
+    classification_method: str = "OneAgainstOne"  # | OneAgainstAll
+    threshold: float = 0.0
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
 # MiningModel (ensembles / stacking)
 # ---------------------------------------------------------------------------
 
@@ -531,6 +569,7 @@ ModelIR = Union[
     RuleSetIR,
     GeneralRegressionIR,
     NaiveBayesIR,
+    SvmModelIR,
     "MiningModelIR",
 ]
 

@@ -30,6 +30,7 @@ _MODEL_TAGS = (
     "RuleSetModel",
     "GeneralRegressionModel",
     "NaiveBayesModel",
+    "SupportVectorMachineModel",
     "MiningModel",
 )
 
@@ -500,9 +501,141 @@ def _parse_model(elem: ET.Element) -> ir.ModelIR:
         return _parse_general_regression(elem)
     if tag == "NaiveBayesModel":
         return _parse_naive_bayes(elem)
+    if tag == "SupportVectorMachineModel":
+        return _parse_svm(elem)
     if tag == "MiningModel":
         return _parse_mining_model(elem)
     raise ModelLoadingException(f"unsupported model element <{tag}>")
+
+
+_SVM_KERNELS = {
+    "LinearKernelType": "linear",
+    "PolynomialKernelType": "polynomial",
+    "RadialBasisKernelType": "radialBasis",
+    "SigmoidKernelType": "sigmoid",
+}
+
+
+def _parse_svm(elem: ET.Element) -> ir.SvmModelIR:
+    kernel = None
+    for c in elem:
+        kind = _SVM_KERNELS.get(_local(c.tag))
+        if kind is not None:
+            kernel = ir.SvmKernel(
+                kind=kind,
+                gamma=_float(c, "gamma", 1.0),
+                coef0=_float(c, "coef0", 0.0),
+                degree=_float(c, "degree", 1.0),
+            )
+            break
+    if kernel is None:
+        raise ModelLoadingException(
+            "SupportVectorMachineModel has no kernel element"
+        )
+    vd = _req_child(elem, "VectorDictionary")
+    vf = _req_child(vd, "VectorFields")
+    fields = tuple(
+        f.get("field", "")
+        for f in vf
+        if _local(f.tag) in ("FieldRef", "CategoricalPredictor")
+    )
+    if any(_local(f.tag) == "CategoricalPredictor" for f in vf):
+        raise ModelLoadingException(
+            "CategoricalPredictor vector fields are not supported"
+        )
+    D = len(fields)
+    vectors = []
+    for vi in _children(vd, "VectorInstance"):
+        vid = vi.get("id", "")
+        arr = _child(vi, "Array")
+        if arr is not None:
+            coords = _parse_real_array(arr)
+        else:
+            sp = _child(vi, "REAL-SparseArray")
+            if sp is None:
+                raise ModelLoadingException(
+                    f"VectorInstance {vid!r} has neither Array nor "
+                    "REAL-SparseArray"
+                )
+            dense = [0.0] * D
+            idx_elem = _child(sp, "Indices")
+            ent_elem = _child(sp, "REAL-Entries")
+            idxs = (
+                [int(t) for t in (idx_elem.text or "").split()]
+                if idx_elem is not None
+                else []
+            )
+            vals = (
+                [float(t) for t in (ent_elem.text or "").split()]
+                if ent_elem is not None
+                else []
+            )
+            if len(idxs) != len(vals):
+                raise ModelLoadingException(
+                    f"VectorInstance {vid!r}: {len(idxs)} indices vs "
+                    f"{len(vals)} entries"
+                )
+            for i, v in zip(idxs, vals):
+                if not 1 <= i <= D:  # PMML sparse indices are 1-based
+                    raise ModelLoadingException(
+                        f"VectorInstance {vid!r}: index {i} out of "
+                        f"[1, {D}]"
+                    )
+                dense[i - 1] = v
+            coords = tuple(dense)
+        if len(coords) != D:
+            raise ModelLoadingException(
+                f"VectorInstance {vid!r} has {len(coords)} coords, "
+                f"expected {D}"
+            )
+        vectors.append((vid, coords))
+    machines = []
+    for svm in _children(elem, "SupportVectorMachine"):
+        sv_elem = _req_child(svm, "SupportVectors")
+        vector_ids = tuple(
+            sv.get("vectorId", "")
+            for sv in _children(sv_elem, "SupportVector")
+        )
+        co_elem = _req_child(svm, "Coefficients")
+        coeffs = tuple(
+            _float(co, "value", 0.0)
+            for co in _children(co_elem, "Coefficient")
+        )
+        if len(coeffs) != len(vector_ids):
+            raise ModelLoadingException(
+                f"SupportVectorMachine: {len(coeffs)} coefficients vs "
+                f"{len(vector_ids)} support vectors"
+            )
+        thr = svm.get("threshold")
+        machines.append(
+            ir.SvmMachine(
+                vector_ids=vector_ids,
+                coefficients=coeffs,
+                intercept=_float(co_elem, "absoluteValue", 0.0),
+                target_category=svm.get("targetCategory"),
+                alternate_target_category=svm.get(
+                    "alternateTargetCategory"
+                ),
+                threshold=float(thr) if thr is not None else None,
+            )
+        )
+    if not machines:
+        raise ModelLoadingException(
+            "SupportVectorMachineModel has no SupportVectorMachine"
+        )
+    return ir.SvmModelIR(
+        function_name=elem.get("functionName", "classification"),
+        mining_schema=_parse_mining_schema(elem),
+        kernel=kernel,
+        vector_fields=fields,
+        vectors=tuple(vectors),
+        machines=tuple(machines),
+        classification_method=elem.get(
+            "classificationMethod", "OneAgainstOne"
+        ),
+        threshold=float(elem.get("threshold", 0.0)),
+        model_name=elem.get("modelName"),
+    )
 
 
 def _parse_general_regression(elem: ET.Element) -> ir.GeneralRegressionIR:

@@ -1,0 +1,287 @@
+"""SupportVectorMachineModel family: kernels, OneAgainstOne voting,
+OneAgainstAll, regression, sparse vectors — compiled vs oracle vs
+hand-computed decision functions."""
+
+import math
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+
+def _svm_xml(kernel, machines, function="classification", method=None,
+             extra_attrs=""):
+    m_attr = (
+        f' classificationMethod="{method}"' if method is not None else ""
+    )
+    return f"""<PMML version="4.3"><DataDictionary>
+      <DataField name="x1" optype="continuous" dataType="double"/>
+      <DataField name="x2" optype="continuous" dataType="double"/>
+      <DataField name="y" optype="categorical" dataType="string">
+        <Value value="A"/><Value value="B"/><Value value="C"/></DataField>
+      </DataDictionary>
+      <SupportVectorMachineModel functionName="{function}"{m_attr}
+          {extra_attrs}>
+      <MiningSchema><MiningField name="y" usageType="target"/>
+        <MiningField name="x1"/><MiningField name="x2"/></MiningSchema>
+      {kernel}
+      <VectorDictionary numberOfVectors="3">
+        <VectorFields numberOfFields="2">
+          <FieldRef field="x1"/><FieldRef field="x2"/></VectorFields>
+        <VectorInstance id="v1"><Array n="2" type="real">1 0</Array>
+        </VectorInstance>
+        <VectorInstance id="v2"><Array n="2" type="real">0 1</Array>
+        </VectorInstance>
+        <VectorInstance id="v3">
+          <REAL-SparseArray n="2"><Indices>1 2</Indices>
+            <REAL-Entries>-1 -1</REAL-Entries></REAL-SparseArray>
+        </VectorInstance>
+      </VectorDictionary>
+      {machines}
+      </SupportVectorMachineModel></PMML>"""
+
+
+_PAIR_MACHINES = """
+  <SupportVectorMachine targetCategory="A" alternateTargetCategory="B">
+    <SupportVectors numberOfSupportVectors="2">
+      <SupportVector vectorId="v1"/><SupportVector vectorId="v2"/>
+    </SupportVectors>
+    <Coefficients absoluteValue="0.1">
+      <Coefficient value="1.0"/><Coefficient value="-0.5"/>
+    </Coefficients>
+  </SupportVectorMachine>
+  <SupportVectorMachine targetCategory="A" alternateTargetCategory="C">
+    <SupportVectors numberOfSupportVectors="2">
+      <SupportVector vectorId="v1"/><SupportVector vectorId="v3"/>
+    </SupportVectors>
+    <Coefficients absoluteValue="-0.2">
+      <Coefficient value="0.7"/><Coefficient value="0.3"/>
+    </Coefficients>
+  </SupportVectorMachine>
+  <SupportVectorMachine targetCategory="B" alternateTargetCategory="C">
+    <SupportVectors numberOfSupportVectors="2">
+      <SupportVector vectorId="v2"/><SupportVector vectorId="v3"/>
+    </SupportVectors>
+    <Coefficients absoluteValue="0.0">
+      <Coefficient value="-0.8"/><Coefficient value="0.6"/>
+    </Coefficients>
+  </SupportVectorMachine>"""
+
+KERNELS = {
+    "linear": ("<LinearKernelType/>", lambda d, n2: d),
+    "polynomial": (
+        '<PolynomialKernelType gamma="0.5" coef0="1" degree="3"/>',
+        lambda d, n2: (0.5 * d + 1.0) ** 3,
+    ),
+    "sigmoid": (
+        '<SigmoidKernelType gamma="0.7" coef0="-0.2"/>',
+        lambda d, n2: math.tanh(0.7 * d - 0.2),
+    ),
+    "radialBasis": (
+        '<RadialBasisKernelType gamma="0.4"/>',
+        lambda d, n2: math.exp(-0.4 * n2),
+    ),
+}
+
+SVS = {"v1": (1.0, 0.0), "v2": (0.0, 1.0), "v3": (-1.0, -1.0)}
+
+
+def _kval(kname, x, s):
+    d = x[0] * s[0] + x[1] * s[1]
+    n2 = (x[0] - s[0]) ** 2 + (x[1] - s[1]) ** 2
+    return KERNELS[kname][1](d, n2)
+
+
+class TestSvmKernelsVoting:
+    @pytest.mark.parametrize("kname", list(KERNELS))
+    def test_one_against_one_parity(self, kname):
+        doc = parse_pmml(_svm_xml(KERNELS[kname][0], _PAIR_MACHINES))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(0)
+        recs = [
+            {"x1": float(a), "x2": float(b)}
+            for a, b in rng.normal(0, 1.5, size=(150, 2))
+        ]
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert not p.is_empty
+            assert p.target.label == o.label, (kname, rec)
+            assert p.score.value == pytest.approx(o.value, rel=1e-4), rec
+
+    @pytest.mark.parametrize("kname", list(KERNELS))
+    def test_hand_computed_decision(self, kname):
+        doc = parse_pmml(_svm_xml(KERNELS[kname][0], _PAIR_MACHINES))
+        x = (0.4, -0.9)
+        # machine AB: f = 1.0·K(v1) − 0.5·K(v2) + 0.1
+        f_ab = (
+            1.0 * _kval(kname, x, SVS["v1"])
+            - 0.5 * _kval(kname, x, SVS["v2"])
+            + 0.1
+        )
+        f_ac = (
+            0.7 * _kval(kname, x, SVS["v1"])
+            + 0.3 * _kval(kname, x, SVS["v3"])
+            - 0.2
+        )
+        f_bc = (
+            -0.8 * _kval(kname, x, SVS["v2"])
+            + 0.6 * _kval(kname, x, SVS["v3"])
+        )
+        votes = {"A": 0, "B": 0, "C": 0}
+        votes["A" if f_ab < 0 else "B"] += 1
+        votes["A" if f_ac < 0 else "C"] += 1
+        votes["B" if f_bc < 0 else "C"] += 1
+        want = max(("A", "B", "C"), key=lambda c: votes[c])
+        o = evaluate(doc, {"x1": x[0], "x2": x[1]})
+        assert o.label == want, (kname, votes)
+
+    def test_one_against_all(self):
+        machines = """
+          <SupportVectorMachine targetCategory="A">
+            <SupportVectors numberOfSupportVectors="1">
+              <SupportVector vectorId="v1"/></SupportVectors>
+            <Coefficients absoluteValue="0.0">
+              <Coefficient value="1.0"/></Coefficients>
+          </SupportVectorMachine>
+          <SupportVectorMachine targetCategory="B">
+            <SupportVectors numberOfSupportVectors="1">
+              <SupportVector vectorId="v2"/></SupportVectors>
+            <Coefficients absoluteValue="0.0">
+              <Coefficient value="1.0"/></Coefficients>
+          </SupportVectorMachine>
+        """
+        doc = parse_pmml(_svm_xml(
+            "<LinearKernelType/>", machines, method="OneAgainstAll"
+        ))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(1)
+        recs = [
+            {"x1": float(a), "x2": float(b)}
+            for a, b in rng.normal(0, 2, size=(100, 2))
+        ]
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert p.target.label == o.label, rec
+        # smallest decision value wins: x=(5,0) → f_A=5, f_B=0 → B
+        assert evaluate(doc, {"x1": 5.0, "x2": 0.0}).label == "B"
+
+    def test_regression_svm(self):
+        machines = """
+          <SupportVectorMachine>
+            <SupportVectors numberOfSupportVectors="3">
+              <SupportVector vectorId="v1"/><SupportVector vectorId="v2"/>
+              <SupportVector vectorId="v3"/></SupportVectors>
+            <Coefficients absoluteValue="0.25">
+              <Coefficient value="1.5"/><Coefficient value="-2.0"/>
+              <Coefficient value="0.5"/></Coefficients>
+          </SupportVectorMachine>
+        """
+        doc = parse_pmml(_svm_xml(
+            '<RadialBasisKernelType gamma="0.3"/>', machines,
+            function="regression",
+        ))
+        cm = compile_pmml(doc)
+        x = (0.2, 0.7)
+        want = 0.25 + sum(
+            a * math.exp(
+                -0.3 * ((x[0] - s[0]) ** 2 + (x[1] - s[1]) ** 2)
+            )
+            for a, s in zip(
+                (1.5, -2.0, 0.5), (SVS["v1"], SVS["v2"], SVS["v3"])
+            )
+        )
+        o = evaluate(doc, {"x1": x[0], "x2": x[1]})
+        p = cm.score_records([{"x1": x[0], "x2": x[1]}])[0]
+        assert o.value == pytest.approx(want, rel=1e-9)
+        assert p.score.value == pytest.approx(want, rel=1e-5)
+
+    def test_missing_vector_field_empty_lane(self):
+        doc = parse_pmml(_svm_xml("<LinearKernelType/>", _PAIR_MACHINES))
+        cm = compile_pmml(doc)
+        preds = cm.score_records([{"x1": 1.0, "x2": 1.0}, {"x1": 1.0}])
+        assert [p.is_empty for p in preds] == [False, True]
+        assert evaluate(doc, {"x1": 1.0}).is_missing
+
+    def test_machine_threshold_override(self):
+        machines = _PAIR_MACHINES.replace(
+            '<SupportVectorMachine targetCategory="A" '
+            'alternateTargetCategory="B">',
+            '<SupportVectorMachine targetCategory="A" '
+            'alternateTargetCategory="B" threshold="0.5">',
+            1,
+        )
+        doc = parse_pmml(_svm_xml(
+            "<LinearKernelType/>", machines, extra_attrs='threshold="0.1"'
+        ))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(2)
+        recs = [
+            {"x1": float(a), "x2": float(b)}
+            for a, b in rng.normal(0, 1, size=(80, 2))
+        ]
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert p.target.label == o.label, rec
+
+
+class TestReviewRegressions:
+    def test_power_link_negative_eta_nan_both_paths(self):
+        from tests.test_glm_bayes import GLM
+
+        xml = GLM.format(
+            model_type="generalizedLinear",
+            link_attr='linkFunction="power" linkParameter="2"',
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"x1": -5.0, "x2": 0.0, "color": "blue"}  # eta = 0.5-10 < 0
+        o = evaluate(doc, rec)
+        assert not isinstance(o.value, complex)
+        assert o.value != o.value  # NaN
+        p = cm.score_records([rec])[0]
+        # NaN value collapses identically on the decode side
+        assert p.is_empty == (o.value != o.value) or p.score.value != p.score.value
+
+    def test_inverse_link_zero_eta_inf_not_crash(self):
+        from tests.test_glm_bayes import GLM
+
+        xml = GLM.format(
+            model_type="generalizedLinear", link_attr='linkFunction="inverse"'
+        ).replace('<PCell parameterName="p0" beta="0.5"/>',
+                  '<PCell parameterName="p0" beta="0.0"/>')
+        doc = parse_pmml(xml)
+        o = evaluate(doc, {"x1": 0.0, "x2": 0.0, "color": "blue"})
+        assert o.value == math.inf  # no ZeroDivisionError
+
+    def test_one_against_one_missing_alternate_typed_error(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        machines = _PAIR_MACHINES.replace(
+            ' alternateTargetCategory="B"', "", 1
+        )
+        doc = parse_pmml(_svm_xml("<LinearKernelType/>", machines))
+        with pytest.raises(ModelCompilationException, match="OneAgainstOne"):
+            compile_pmml(doc)
+        with pytest.raises(ModelCompilationException, match="OneAgainstOne"):
+            evaluate(doc, {"x1": 1.0, "x2": 1.0})
+
+    def test_unknown_pcell_parameter_typed_error_both_paths(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+        from tests.test_glm_bayes import GLM
+
+        xml = GLM.format(model_type="generalLinear", link_attr="").replace(
+            '<PCell parameterName="p1" beta="2.0"/>',
+            '<PCell parameterName="typo" beta="2.0"/>',
+        )
+        doc = parse_pmml(xml)
+        with pytest.raises(ModelCompilationException, match="typo"):
+            compile_pmml(doc)
+        with pytest.raises(ModelCompilationException, match="typo"):
+            evaluate(doc, {"x1": 1.0, "x2": 1.0, "color": "red"})
