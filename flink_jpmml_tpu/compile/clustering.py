@@ -21,15 +21,16 @@ from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 _CMP_CODES = {"absDiff": 0, "gaussSim": 1, "delta": 2, "equal": 3}
 
 
-def resolve_compare(model: ir.ClusteringModelIR):
-    """→ (codes i32[D], gauss_s f32[D]): per-field compare function and
-    gaussSim similarityScale. Shared by the lowering and the oracle so
-    the two cannot diverge."""
-    D = len(model.clustering_fields)
+def resolve_compare_fields(fields, measure: ir.ComparisonMeasure):
+    """→ (codes i32[D], gauss_s f32[D]) for any per-field sequence with
+    ``field``/``compare_function``/``similarity_scale`` attributes
+    (ClusteringField, KNNInput). Shared by the lowerings and the oracle
+    so they cannot diverge."""
+    D = len(fields)
     codes = np.zeros((D,), np.int32)
     scale = np.ones((D,), np.float32)
-    for i, cf in enumerate(model.clustering_fields):
-        name = cf.compare_function or model.measure.compare_function
+    for i, cf in enumerate(fields):
+        name = cf.compare_function or measure.compare_function
         code = _CMP_CODES.get(name)
         if code is None:
             raise ModelCompilationException(
@@ -47,6 +48,62 @@ def resolve_compare(model: ir.ClusteringModelIR):
     return codes, scale
 
 
+def resolve_compare(model: ir.ClusteringModelIR):
+    return resolve_compare_fields(model.clustering_fields, model.measure)
+
+
+def make_distance(
+    measure: ir.ComparisonMeasure,
+    cmp_codes: np.ndarray,
+    gauss_s: np.ndarray,
+    weights: np.ndarray,
+):
+    """→ f(xs [B,D], centers [K,D]) -> distances [B,K] under the spec
+    aggregation (the field weight multiplies the powered comparison).
+    Shared by the clustering and nearest-neighbor lowerings."""
+    metric = measure.metric
+    mink_p = float(measure.minkowski_p)
+    if metric == "minkowski" and mink_p <= 0:
+        raise ModelCompilationException(
+            f"minkowski needs a positive p-parameter, got {mink_p}"
+        )
+    all_absdiff = bool((cmp_codes == 0).all())
+    ln2 = float(np.log(2.0))
+
+    def dist(xs, centers):
+        delta = xs[:, None, :] - centers[None, :, :]  # [B, K, D]
+        if all_absdiff:
+            c = jnp.abs(delta)
+        else:
+            ad = jnp.abs(delta)
+            eq = delta == 0.0
+            gs = jnp.exp(-ln2 * delta * delta / (gauss_s * gauss_s))
+            c = jnp.where(
+                cmp_codes == 1, gs,
+                jnp.where(
+                    cmp_codes == 2, jnp.where(eq, 0.0, 1.0),
+                    jnp.where(cmp_codes == 3, jnp.where(eq, 1.0, 0.0), ad),
+                ),
+            )
+        w = weights
+        if metric == "squaredEuclidean":
+            return jnp.sum(w * c * c, axis=-1)
+        if metric == "euclidean":
+            return jnp.sqrt(jnp.sum(w * c * c, axis=-1))
+        if metric == "cityBlock":
+            return jnp.sum(w * c, axis=-1)
+        if metric == "chebychev":
+            return jnp.max(w * c, axis=-1)
+        if metric == "minkowski":
+            return jnp.power(
+                jnp.sum(w * jnp.power(jnp.abs(c), mink_p), axis=-1),
+                1.0 / mink_p,
+            )
+        raise ModelCompilationException(f"unsupported metric {metric!r}")
+
+    return dist
+
+
 def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
     if model.model_class != "centerBased":
         raise ModelCompilationException(
@@ -57,13 +114,6 @@ def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
             f"unsupported ComparisonMeasure kind {model.measure.kind!r}"
         )
     cmp_codes, gauss_s = resolve_compare(model)
-    metric = model.measure.metric
-    mink_p = float(model.measure.minkowski_p)
-    if metric == "minkowski" and mink_p <= 0:
-        raise ModelCompilationException(
-            f"minkowski needs a positive p-parameter, got {mink_p}"
-        )
-
     cols = np.asarray(
         [ctx.column(cf.field) for cf in model.clustering_fields], np.int32
     )
@@ -79,45 +129,13 @@ def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
     labels = tuple(
         c.cluster_id or c.name or str(i + 1) for i, c in enumerate(model.clusters)
     )
-    params = {"centers": centers, "weights": weights}
-    all_absdiff = bool((cmp_codes == 0).all())
-    ln2 = float(np.log(2.0))
+    params = {"centers": centers}
+    dist = make_distance(model.measure, cmp_codes, gauss_s, weights)
 
     def fn(p, X, M):
         xs = X[:, cols]  # [B, D]
         missing = jnp.any(M[:, cols], axis=1)
-        delta = xs[:, None, :] - p["centers"][None, :, :]  # [B, K, D]
-        if all_absdiff:
-            c = jnp.abs(delta)
-        else:
-            ad = jnp.abs(delta)
-            eq = delta == 0.0
-            gs = jnp.exp(-ln2 * delta * delta / (gauss_s * gauss_s))
-            c = jnp.where(
-                cmp_codes == 1, gs,
-                jnp.where(
-                    cmp_codes == 2, jnp.where(eq, 0.0, 1.0),
-                    jnp.where(cmp_codes == 3, jnp.where(eq, 1.0, 0.0), ad),
-                ),
-            )
-        # spec aggregation: distance = (Σ_i w_i · c_i^p)^(1/p-ish per
-        # metric) — the weight multiplies the powered comparison
-        w = p["weights"]
-        if metric == "squaredEuclidean":
-            d = jnp.sum(w * c * c, axis=-1)
-        elif metric == "euclidean":
-            d = jnp.sqrt(jnp.sum(w * c * c, axis=-1))
-        elif metric == "cityBlock":
-            d = jnp.sum(w * c, axis=-1)
-        elif metric == "chebychev":
-            d = jnp.max(w * c, axis=-1)
-        elif metric == "minkowski":
-            d = jnp.power(
-                jnp.sum(w * jnp.power(jnp.abs(c), mink_p), axis=-1),
-                1.0 / mink_p,
-            )
-        else:
-            raise ModelCompilationException(f"unsupported metric {metric!r}")
+        d = dist(xs, p["centers"])
         label_idx = jnp.argmin(d, axis=1).astype(jnp.int32)
         return ModelOutput(
             value=label_idx.astype(jnp.float32),

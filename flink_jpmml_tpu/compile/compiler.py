@@ -34,6 +34,7 @@ from flink_jpmml_tpu.compile.common import (
 from flink_jpmml_tpu.compile.bayes import lower_naive_bayes
 from flink_jpmml_tpu.compile.exprs import lower_expression
 from flink_jpmml_tpu.compile.glm import lower_general_regression
+from flink_jpmml_tpu.compile.knn import lower_knn
 from flink_jpmml_tpu.compile.mining import lower_mining
 from flink_jpmml_tpu.compile.neural import lower_neural_network
 from flink_jpmml_tpu.compile.regression import lower_regression
@@ -73,6 +74,8 @@ def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
         return lower_naive_bayes(model, ctx)
     if isinstance(model, ir.SvmModelIR):
         return lower_svm(model, ctx)
+    if isinstance(model, ir.NearestNeighborIR):
+        return lower_knn(model, ctx)
     if isinstance(model, ir.MiningModelIR):
         return lower_mining(model, ctx)
     raise ModelCompilationException(

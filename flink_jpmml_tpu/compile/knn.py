@@ -1,0 +1,131 @@
+"""NearestNeighborModel → JAX: full distance matrix + top-k aggregation.
+
+Reference parity: JPMML scores KNN documents (SURVEY.md §1 C1). The
+distance machinery is the clustering module's (same compareFunctions,
+same spec weighting) over the inline training table; the k smallest
+distances vote (classification: majorityVote / weightedMajorityVote
+with 1/d weights) or average (regression: average / median /
+weightedAverage).
+
+Tie conventions, identical in the oracle: neighbor selection uses
+``lax.top_k`` over negated distances, which prefers the earlier
+training row on equal distance (oracle: stable argsort); vote ties
+break to the class label whose first supporting neighbor appears
+earliest in the training table (oracle mirrors via label-index argmax).
+Weighted variants use 1/(d+ε) with ε=1e-9 against zero distances.
+A record missing any KNN input is an invalid lane (no missing-value
+routing — totality C5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.clustering import (
+    make_distance,
+    resolve_compare_fields,
+)
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+_EPS = 1e-9
+
+
+def lower_knn(model: ir.NearestNeighborIR, ctx: LowerCtx) -> Lowered:
+    if model.measure.kind != "distance":
+        raise ModelCompilationException(
+            f"unsupported ComparisonMeasure kind {model.measure.kind!r}"
+        )
+    cols = np.asarray([ctx.column(i.field) for i in model.inputs], np.int32)
+    weights = np.asarray([i.weight for i in model.inputs], np.float32)
+    cmp_codes, gauss_s = resolve_compare_fields(
+        model.inputs, model.measure
+    )
+    dist = make_distance(model.measure, cmp_codes, gauss_s, weights)
+    S = np.asarray(model.instances, np.float32)  # [N, D]
+    k = model.n_neighbors
+    classification = model.function_name == "classification"
+
+    if classification:
+        if model.categorical_scoring not in (
+            "majorityVote", "weightedMajorityVote",
+        ):
+            raise ModelCompilationException(
+                f"unsupported categoricalScoringMethod "
+                f"{model.categorical_scoring!r}"
+            )
+        labels: list = []
+        for t in model.targets:
+            if t not in labels:
+                labels.append(t)
+        lab_of = np.asarray(
+            [labels.index(t) for t in model.targets], np.int32
+        )
+        weighted = model.categorical_scoring == "weightedMajorityVote"
+    else:
+        if model.continuous_scoring not in (
+            "average", "median", "weightedAverage",
+        ):
+            raise ModelCompilationException(
+                f"unsupported continuousScoringMethod "
+                f"{model.continuous_scoring!r}"
+            )
+        labels = []
+        try:
+            yvals = np.asarray([float(t) for t in model.targets], np.float32)
+        except ValueError:
+            raise ModelCompilationException(
+                "regression KNN needs numeric training targets"
+            ) from None
+
+    L = len(labels)
+    params = {"S": S}
+    if classification:
+        params["lab"] = lab_of.astype(np.float32)
+    else:
+        params["y"] = yvals
+
+    def fn(p, X, M):
+        missing = jnp.any(M[:, cols], axis=1)
+        xs = X[:, cols]
+        d = dist(xs, p["S"])  # [B, N]
+        # top_k on negated distances: earlier rows win exact ties
+        neg_top, idx = jax.lax.top_k(-d, k)  # [B, k]
+        dk = -neg_top
+        if classification:
+            labk = jnp.take(p["lab"], idx).astype(jnp.int32)  # [B, k]
+            w = 1.0 / (dk + _EPS) if weighted else jnp.ones_like(dk)
+            onehot = (
+                labk[..., None] == jnp.arange(L)[None, None, :]
+            ).astype(jnp.float32)
+            votes = jnp.sum(onehot * w[..., None], axis=1)  # [B, L]
+            lab = jnp.argmax(votes, axis=1).astype(jnp.int32)
+            probs = votes / jnp.maximum(
+                jnp.sum(votes, axis=1, keepdims=True), _EPS
+            )
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            return ModelOutput(
+                value=value.astype(jnp.float32),
+                valid=~missing,
+                probs=probs,
+                label_idx=lab,
+            )
+        yk = jnp.take(p["y"], idx)  # [B, k]
+        if model.continuous_scoring == "average":
+            value = jnp.mean(yk, axis=1)
+        elif model.continuous_scoring == "median":
+            value = jnp.median(yk, axis=1)
+        else:  # weightedAverage
+            w = 1.0 / (dk + _EPS)
+            value = jnp.sum(yk * w, axis=1) / jnp.sum(w, axis=1)
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=~missing,
+            probs=None,
+            label_idx=None,
+        )
+
+    return Lowered(fn=fn, params=params, labels=tuple(labels))

@@ -407,6 +407,8 @@ def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
         return _eval_naive_bayes(model, record)
     if isinstance(model, ir.SvmModelIR):
         return _eval_svm(model, record)
+    if isinstance(model, ir.NearestNeighborIR):
+        return _eval_knn(model, record)
     if isinstance(model, ir.MiningModelIR):
         return _eval_mining(model, record)
     raise ModelCompilationException(f"unsupported model {type(model).__name__}")
@@ -1049,7 +1051,12 @@ def _svm_kernel_value(kernel: ir.SvmKernel, x: List[float], s) -> float:
     if kernel.kind == "linear":
         return dot
     if kernel.kind == "polynomial":
-        return (kernel.gamma * dot + kernel.coef0) ** kernel.degree
+        try:
+            # math.pow: negative base with fractional degree must be NaN
+            # like the compiled jnp.power, never complex
+            return math.pow(kernel.gamma * dot + kernel.coef0, kernel.degree)
+        except (ValueError, OverflowError):
+            return float("nan")
     if kernel.kind == "sigmoid":
         return math.tanh(kernel.gamma * dot + kernel.coef0)
     if kernel.kind == "radialBasis":
@@ -1084,6 +1091,12 @@ def _eval_svm(model: ir.SvmModelIR, record: Record) -> EvalResult:
         fs.append(f)
 
     if model.function_name != "classification":
+        if len(model.machines) != 1:
+            # same typed rejection as the lowering
+            raise ModelCompilationException(
+                f"regression SVM needs exactly one machine, got "
+                f"{len(model.machines)}"
+            )
         return EvalResult(value=fs[0])
 
     labels: List[str] = []
@@ -1123,12 +1136,126 @@ def _eval_svm(model: ir.SvmModelIR, record: Record) -> EvalResult:
     # OneAgainstAll: smallest decision value wins
     scores = {c: math.inf for c in labels}
     for m, f in zip(model.machines, fs):
+        if m.target_category is None:
+            raise ModelCompilationException(
+                "OneAgainstAll machines need targetCategory"
+            )
         scores[m.target_category] = min(scores[m.target_category], f)
     label = labels[0]
     for c in labels:
         if scores[c] < scores[label]:
             label = c
     return EvalResult(value=scores[label], label=label)
+
+
+# --- NearestNeighbor -------------------------------------------------------
+
+
+def _knn_field_compare(ki: ir.KnnInput, measure, x: float, s: float) -> float:
+    """Pure-math per-field comparison — independent of the compiled
+    distance code, like the clustering oracle, so compiled-vs-oracle
+    parity still catches lowering bugs."""
+    name = ki.compare_function or measure.compare_function
+    if name == "gaussSim":
+        sc = ki.similarity_scale
+        if sc is None or sc <= 0:
+            raise ModelCompilationException(
+                f"gaussSim on field {ki.field!r} needs a positive "
+                "similarityScale"
+            )
+        return math.exp(-math.log(2.0) * (x - s) ** 2 / (sc * sc))
+    if name == "delta":
+        return 0.0 if x == s else 1.0
+    if name == "equal":
+        return 1.0 if x == s else 0.0
+    if name == "absDiff":
+        return abs(x - s)
+    raise ModelCompilationException(
+        f"unsupported compareFunction {name!r} on field {ki.field!r}"
+    )
+
+
+def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
+    if model.measure.kind != "distance":
+        raise ModelCompilationException(
+            f"unsupported ComparisonMeasure kind {model.measure.kind!r}"
+        )
+    xs: List[float] = []
+    for ki in model.inputs:
+        v = _as_float(record.get(ki.field))
+        if v is None:
+            return EvalResult()  # no missing-value routing
+        xs.append(v)
+    metric = model.measure.metric
+    mink_p = model.measure.minkowski_p
+    ds: List[float] = []
+    for inst in model.instances:
+        terms = [
+            (ki.weight, _knn_field_compare(ki, model.measure, x, s))
+            for ki, x, s in zip(model.inputs, xs, inst)
+        ]
+        if metric == "squaredEuclidean":
+            d = sum(w * c * c for w, c in terms)
+        elif metric == "euclidean":
+            d = math.sqrt(sum(w * c * c for w, c in terms))
+        elif metric == "cityBlock":
+            d = sum(w * c for w, c in terms)
+        elif metric == "chebychev":
+            d = max(w * c for w, c in terms)
+        elif metric == "minkowski":
+            d = sum(w * abs(c) ** mink_p for w, c in terms) ** (1.0 / mink_p)
+        else:
+            raise ModelCompilationException(
+                f"unsupported metric {metric!r}"
+            )
+        ds.append(d)
+    order = sorted(range(len(ds)), key=lambda i: (ds[i], i))[
+        : model.n_neighbors
+    ]
+    eps = 1e-9
+    if model.function_name == "classification":
+        if model.categorical_scoring not in (
+            "majorityVote", "weightedMajorityVote",
+        ):
+            raise ModelCompilationException(
+                f"unsupported categoricalScoringMethod "
+                f"{model.categorical_scoring!r}"
+            )
+        labels: List[str] = []
+        for t in model.targets:
+            if t not in labels:
+                labels.append(t)
+        weighted = model.categorical_scoring == "weightedMajorityVote"
+        votes = {c: 0.0 for c in labels}
+        for i in order:
+            w = 1.0 / (ds[i] + eps) if weighted else 1.0
+            votes[model.targets[i]] += w
+        label = labels[0]
+        for c in labels:  # first-appearance order breaks ties
+            if votes[c] > votes[label]:
+                label = c
+        total = sum(votes.values())
+        probs = {c: votes[c] / max(total, eps) for c in labels}
+        return EvalResult(value=probs[label], label=label,
+                          probabilities=probs)
+    m = model.continuous_scoring
+    if m not in ("average", "median", "weightedAverage"):
+        raise ModelCompilationException(
+            f"unsupported continuousScoringMethod {m!r}"
+        )
+    yk = [float(model.targets[i]) for i in order]
+    if m == "average":
+        value = sum(yk) / len(yk)
+    elif m == "median":
+        ys = sorted(yk)
+        n = len(ys)
+        value = (
+            ys[n // 2] if n % 2 else 0.5 * (ys[n // 2 - 1] + ys[n // 2])
+        )
+    else:  # weightedAverage
+        ws = [1.0 / (ds[i] + eps) for i in order]
+        value = sum(y * w for y, w in zip(yk, ws)) / sum(ws)
+    return EvalResult(value=value)
 
 
 # --- MiningModel -----------------------------------------------------------

@@ -557,6 +557,36 @@ class SvmModelIR:
 
 
 # ---------------------------------------------------------------------------
+# NearestNeighborModel (KNN)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KnnInput:
+    field: str
+    weight: float = 1.0
+    compare_function: Optional[str] = None
+    similarity_scale: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class NearestNeighborIR:
+    """KNN over inline training instances: k smallest comparison-measure
+    distances vote/average the stored target values."""
+
+    function_name: str  # classification | regression
+    mining_schema: MiningSchema
+    n_neighbors: int
+    measure: ComparisonMeasure
+    inputs: Tuple[KnnInput, ...]
+    instances: Tuple[Tuple[float, ...], ...]  # [N][D] feature rows
+    targets: Tuple[str, ...]  # [N] target values (labels or numerics)
+    continuous_scoring: str = "average"  # | median | weightedAverage
+    categorical_scoring: str = "majorityVote"  # | weightedMajorityVote
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
 # MiningModel (ensembles / stacking)
 # ---------------------------------------------------------------------------
 
@@ -570,6 +600,7 @@ ModelIR = Union[
     GeneralRegressionIR,
     NaiveBayesIR,
     SvmModelIR,
+    NearestNeighborIR,
     "MiningModelIR",
 ]
 

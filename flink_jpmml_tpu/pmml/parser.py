@@ -31,6 +31,7 @@ _MODEL_TAGS = (
     "GeneralRegressionModel",
     "NaiveBayesModel",
     "SupportVectorMachineModel",
+    "NearestNeighborModel",
     "MiningModel",
 )
 
@@ -503,9 +504,132 @@ def _parse_model(elem: ET.Element) -> ir.ModelIR:
         return _parse_naive_bayes(elem)
     if tag == "SupportVectorMachineModel":
         return _parse_svm(elem)
+    if tag == "NearestNeighborModel":
+        return _parse_nearest_neighbor(elem)
     if tag == "MiningModel":
         return _parse_mining_model(elem)
     raise ModelLoadingException(f"unsupported model element <{tag}>")
+
+
+def _parse_comparison_measure(cm: ET.Element) -> ir.ComparisonMeasure:
+    metric_elem = None
+    for c in cm:
+        if _local(c.tag) == "Extension":  # Extension* precedes the metric
+            continue
+        metric_elem = c
+        break
+    if metric_elem is None:
+        raise ModelLoadingException("ComparisonMeasure has no metric child")
+    metric_map = {
+        "squaredEuclidean": "squaredEuclidean",
+        "euclidean": "euclidean",
+        "cityBlock": "cityBlock",
+        "chebychev": "chebychev",
+        "minkowski": "minkowski",
+    }
+    metric = metric_map.get(_local(metric_elem.tag))
+    if metric is None:
+        raise ModelLoadingException(
+            f"unsupported comparison metric <{_local(metric_elem.tag)}>"
+        )
+    return ir.ComparisonMeasure(
+        kind=cm.get("kind", "distance"),
+        metric=metric,
+        compare_function=cm.get("compareFunction", "absDiff"),
+        minkowski_p=_float(metric_elem, "p-parameter", 2.0),
+    )
+
+
+def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
+    schema = _parse_mining_schema(elem)
+    measure = _parse_comparison_measure(_req_child(elem, "ComparisonMeasure"))
+    inputs = tuple(
+        ir.KnnInput(
+            field=ki.get("field", ""),
+            weight=_float(ki, "fieldWeight", 1.0),
+            compare_function=ki.get("compareFunction"),
+            similarity_scale=(
+                float(ki.get("similarityScale"))
+                if ki.get("similarityScale") is not None
+                else None
+            ),
+        )
+        for ki in _children(_req_child(elem, "KNNInputs"), "KNNInput")
+    )
+    if not inputs:
+        raise ModelLoadingException("KNNInputs has no KNNInput elements")
+    ti = _req_child(elem, "TrainingInstances")
+    ifields = {
+        f.get("field", ""): f.get("column", f.get("field", ""))
+        for f in _children(_req_child(ti, "InstanceFields"), "InstanceField")
+    }
+    target = schema.target_field
+    if target is None:
+        raise ModelLoadingException(
+            "NearestNeighborModel needs a target MiningField"
+        )
+    for ki in inputs:
+        if ki.field not in ifields:
+            raise ModelLoadingException(
+                f"KNNInput {ki.field!r} has no InstanceField column"
+            )
+    if target not in ifields:
+        raise ModelLoadingException(
+            f"target {target!r} has no InstanceField column"
+        )
+    table = _child(ti, "InlineTable")
+    if table is None:
+        raise ModelLoadingException(
+            "only InlineTable TrainingInstances are supported"
+        )
+    instances = []
+    targets = []
+    for row in _children(table, "row"):
+        cells = {_local(c.tag): (c.text or "").strip() for c in row}
+        coords = []
+        for ki in inputs:
+            col = ifields[ki.field]
+            if col not in cells:
+                raise ModelLoadingException(
+                    f"training row missing column {col!r}"
+                )
+            try:
+                coords.append(float(cells[col]))
+            except ValueError:
+                raise ModelLoadingException(
+                    f"non-numeric training value {cells[col]!r} in "
+                    f"column {col!r}"
+                ) from None
+        tcol = ifields[target]
+        if tcol not in cells:
+            raise ModelLoadingException(
+                f"training row missing target column {tcol!r}"
+            )
+        instances.append(tuple(coords))
+        targets.append(cells[tcol])
+    if not instances:
+        raise ModelLoadingException("TrainingInstances has no rows")
+    k = int(elem.get("numberOfNeighbors", 3))
+    if not 1 <= k <= len(instances):
+        raise ModelLoadingException(
+            f"numberOfNeighbors {k} out of [1, {len(instances)}]"
+        )
+    return ir.NearestNeighborIR(
+        function_name=elem.get("functionName", "classification"),
+        mining_schema=schema,
+        n_neighbors=k,
+        measure=measure,
+        inputs=inputs,
+        instances=tuple(instances),
+        targets=tuple(targets),
+        continuous_scoring=elem.get(
+            "continuousScoringMethod", "average"
+        ),
+        categorical_scoring=elem.get(
+            "categoricalScoringMethod", "majorityVote"
+        ),
+        model_name=elem.get("modelName"),
+    )
 
 
 _SVM_KERNELS = {
@@ -1022,25 +1146,7 @@ def _parse_neural_network(elem: ET.Element) -> ir.NeuralNetworkIR:
 
 
 def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
-    cm = _req_child(elem, "ComparisonMeasure")
-    metric_elem = None
-    for c in cm:
-        metric_elem = c
-        break
-    if metric_elem is None:
-        raise ModelLoadingException("ComparisonMeasure has no metric child")
-    metric_map = {
-        "squaredEuclidean": "squaredEuclidean",
-        "euclidean": "euclidean",
-        "cityBlock": "cityBlock",
-        "chebychev": "chebychev",
-        "minkowski": "minkowski",
-    }
-    metric = metric_map.get(_local(metric_elem.tag))
-    if metric is None:
-        raise ModelLoadingException(
-            f"unsupported comparison metric <{_local(metric_elem.tag)}>"
-        )
+    measure = _parse_comparison_measure(_req_child(elem, "ComparisonMeasure"))
     fields = tuple(
         ir.ClusteringField(
             field=cf.get("field", ""),
@@ -1068,12 +1174,7 @@ def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
         function_name=elem.get("functionName", "clustering"),
         mining_schema=_parse_mining_schema(elem),
         model_class=elem.get("modelClass", "centerBased"),
-        measure=ir.ComparisonMeasure(
-            kind=cm.get("kind", "distance"),
-            metric=metric,
-            compare_function=cm.get("compareFunction", "absDiff"),
-            minkowski_p=_float(metric_elem, "p-parameter", 2.0),
-        ),
+        measure=measure,
         clustering_fields=fields,
         clusters=clusters,
         model_name=elem.get("modelName"),

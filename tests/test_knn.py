@@ -1,0 +1,203 @@
+"""NearestNeighborModel family: top-k selection, voting/averaging
+methods, inline training tables — compiled vs oracle vs hand-computed."""
+
+import math
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+ROWS = [
+    # (u, v, cls, yval)
+    (0.0, 0.0, "a", 1.0),
+    (1.0, 0.0, "a", 2.0),
+    (0.0, 1.0, "b", 3.0),
+    (1.0, 1.0, "b", 4.0),
+    (2.0, 2.0, "c", 10.0),
+    (2.5, 2.5, "c", 12.0),
+]
+
+
+def _knn_xml(function="classification", k=3, attrs="", target="cls",
+             measure='<ComparisonMeasure kind="distance">'
+                     "<squaredEuclidean/></ComparisonMeasure>"):
+    rows = "".join(
+        f"<row><u>{u}</u><v>{v}</v><cls>{c}</cls><yv>{y}</yv></row>"
+        for u, v, c, y in ROWS
+    )
+    return f"""<PMML version="4.3"><DataDictionary>
+      <DataField name="u" optype="continuous" dataType="double"/>
+      <DataField name="v" optype="continuous" dataType="double"/>
+      <DataField name="cls" optype="categorical" dataType="string">
+        <Value value="a"/><Value value="b"/><Value value="c"/></DataField>
+      <DataField name="yv" optype="continuous" dataType="double"/>
+      </DataDictionary>
+      <NearestNeighborModel functionName="{function}"
+          numberOfNeighbors="{k}" {attrs}>
+      <MiningSchema><MiningField name="{target}" usageType="target"/>
+        <MiningField name="u"/><MiningField name="v"/></MiningSchema>
+      {measure}
+      <KNNInputs><KNNInput field="u"/><KNNInput field="v"/></KNNInputs>
+      <TrainingInstances>
+        <InstanceFields>
+          <InstanceField field="u" column="u"/>
+          <InstanceField field="v" column="v"/>
+          <InstanceField field="{target}" column="{target if target == 'cls' else 'yv'}"/>
+        </InstanceFields>
+        <InlineTable>{rows}</InlineTable>
+      </TrainingInstances>
+      </NearestNeighborModel></PMML>"""
+
+
+def _parity(doc, n=150, seed=0, spread=1.5):
+    cm = compile_pmml(doc)
+    rng = np.random.default_rng(seed)
+    recs = [
+        {"u": float(a), "v": float(b)}
+        for a, b in rng.normal(1.0, spread, size=(n, 2))
+    ]
+    for rec, p in zip(recs, cm.score_records(recs)):
+        o = evaluate(doc, rec)
+        assert not p.is_empty and not o.is_missing
+        if o.label is not None:
+            assert p.target.label == o.label, rec
+        assert p.score.value == pytest.approx(o.value, rel=1e-4,
+                                              abs=1e-6), rec
+    return cm
+
+
+class TestKnn:
+    def test_majority_vote_hand_case(self):
+        doc = parse_pmml(_knn_xml())
+        _parity(doc)
+        # query (0.1, 0.1): 3 nearest are rows 0 (a), 1 (a), 2 (b) → a
+        o = evaluate(doc, {"u": 0.1, "v": 0.1})
+        assert o.label == "a"
+        assert o.probabilities["a"] == pytest.approx(2 / 3)
+
+    def test_weighted_majority_vote(self):
+        doc = parse_pmml(_knn_xml(
+            attrs='categoricalScoringMethod="weightedMajorityVote"'
+        ))
+        _parity(doc)
+        # query very near row 2 (b): its 1/d vote dominates two a's
+        o = evaluate(doc, {"u": 0.05, "v": 0.95})
+        assert o.label == "b"
+
+    def test_regression_average_and_weighted(self):
+        doc = parse_pmml(_knn_xml(function="regression", target="yv"))
+        _parity(doc)
+        # query (0,0): neighbors rows 0,1,2 → mean(1,2,3) = 2
+        assert evaluate(doc, {"u": 0.0, "v": 0.0}).value == pytest.approx(2.0)
+
+        doc_w = parse_pmml(_knn_xml(
+            function="regression", target="yv",
+            attrs='continuousScoringMethod="weightedAverage"',
+        ))
+        _parity(doc_w)
+        # exactly on row 0: 1/(0+eps) weight pins the value to 1.0
+        assert evaluate(doc_w, {"u": 0.0, "v": 0.0}).value == pytest.approx(
+            1.0, abs=1e-5
+        )
+
+    def test_regression_median(self):
+        doc = parse_pmml(_knn_xml(
+            function="regression", target="yv",
+            attrs='continuousScoringMethod="median"',
+        ))
+        _parity(doc)
+        assert evaluate(doc, {"u": 0.0, "v": 0.0}).value == pytest.approx(2.0)
+
+    def test_k1_exact_match_and_missing(self):
+        doc = parse_pmml(_knn_xml(k=1))
+        cm = _parity(doc)
+        o = evaluate(doc, {"u": 2.5, "v": 2.5})
+        assert o.label == "c" and o.probabilities["c"] == 1.0
+        preds = cm.score_records([{"u": 1.0}])
+        assert preds[0].is_empty
+        assert evaluate(doc, {"u": 1.0}).is_missing
+
+    def test_minkowski_measure_with_knn(self):
+        doc = parse_pmml(_knn_xml(
+            measure='<ComparisonMeasure kind="distance">'
+                    '<minkowski p-parameter="3"/></ComparisonMeasure>'
+        ))
+        _parity(doc)
+
+    def test_tie_prefers_earlier_training_row(self):
+        # query equidistant from rows 1 (a) and 2 (b) with k=1: the
+        # earlier row wins on both paths
+        doc = parse_pmml(_knn_xml(k=1))
+        cm = compile_pmml(doc)
+        rec = {"u": 0.5, "v": 0.5}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.label == p.target.label == "a"  # row 0 is nearest... or
+        # equidistant set {0,1,2,3} all at d=0.5 → row 0 (a) wins
+
+
+class TestReviewRegressions:
+    def test_similarity_kind_rejected_both_paths(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        doc = parse_pmml(_knn_xml(
+            measure='<ComparisonMeasure kind="similarity">'
+                    "<squaredEuclidean/></ComparisonMeasure>"
+        ))
+        with pytest.raises(ModelCompilationException, match="similarity"):
+            compile_pmml(doc)
+        with pytest.raises(ModelCompilationException, match="similarity"):
+            evaluate(doc, {"u": 0.0, "v": 0.0})
+
+    def test_unknown_scoring_method_rejected_both_paths(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        doc = parse_pmml(_knn_xml(
+            function="regression", target="yv",
+            attrs='continuousScoringMethod="weightedMedian"',
+        ))
+        with pytest.raises(ModelCompilationException, match="weightedMedian"):
+            compile_pmml(doc)
+        with pytest.raises(ModelCompilationException, match="weightedMedian"):
+            evaluate(doc, {"u": 0.0, "v": 0.0})
+
+    def test_extension_before_metric_accepted(self):
+        doc = parse_pmml(_knn_xml(
+            measure='<ComparisonMeasure kind="distance">'
+                    '<Extension extender="x" name="n" value="v"/>'
+                    "<squaredEuclidean/></ComparisonMeasure>"
+        ))
+        assert doc.model.measure.metric == "squaredEuclidean"
+        _parity(doc, n=40)
+
+    def test_polynomial_kernel_fractional_degree_nan_not_complex(self):
+        from tests.test_svm import _svm_xml, _PAIR_MACHINES
+
+        xml = _svm_xml(
+            '<PolynomialKernelType gamma="1" coef0="-5" degree="0.5"/>',
+            _PAIR_MACHINES,
+        )
+        doc = parse_pmml(xml)
+        o = evaluate(doc, {"x1": 0.0, "x2": 0.0})  # dot=0 → base −5 < 0
+        assert not isinstance(o.value, complex)
+
+    def test_regression_svm_multiple_machines_rejected_both_paths(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+        from tests.test_svm import _svm_xml, _PAIR_MACHINES
+
+        doc = parse_pmml(_svm_xml(
+            "<LinearKernelType/>", _PAIR_MACHINES, function="regression"
+        ))
+        with pytest.raises(ModelCompilationException, match="exactly one"):
+            compile_pmml(doc)
+        with pytest.raises(ModelCompilationException, match="exactly one"):
+            evaluate(doc, {"x1": 1.0, "x2": 1.0})
