@@ -76,6 +76,10 @@ def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
         return lower_svm(model, ctx)
     if isinstance(model, ir.NearestNeighborIR):
         return lower_knn(model, ctx)
+    if isinstance(model, ir.AnomalyDetectionIR):
+        from flink_jpmml_tpu.compile.anomaly import lower_anomaly
+
+        return lower_anomaly(model, ctx)
     if isinstance(model, ir.MiningModelIR):
         return lower_mining(model, ctx)
     raise ModelCompilationException(

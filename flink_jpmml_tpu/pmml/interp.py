@@ -409,6 +409,8 @@ def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
         return _eval_svm(model, record)
     if isinstance(model, ir.NearestNeighborIR):
         return _eval_knn(model, record)
+    if isinstance(model, ir.AnomalyDetectionIR):
+        return _eval_anomaly(model, record)
     if isinstance(model, ir.MiningModelIR):
         return _eval_mining(model, record)
     raise ModelCompilationException(f"unsupported model {type(model).__name__}")
@@ -1188,6 +1190,11 @@ def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
         xs.append(v)
     metric = model.measure.metric
     mink_p = model.measure.minkowski_p
+    if metric == "minkowski" and mink_p <= 0:
+        # same typed rejection as the lowering (make_distance)
+        raise ModelCompilationException(
+            f"minkowski needs a positive p-parameter, got {mink_p}"
+        )
     ds: List[float] = []
     for inst in model.instances:
         terms = [
@@ -1243,7 +1250,13 @@ def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
         raise ModelCompilationException(
             f"unsupported continuousScoringMethod {m!r}"
         )
-    yk = [float(model.targets[i]) for i in order]
+    try:
+        yk = [float(model.targets[i]) for i in order]
+    except ValueError:
+        # same typed rejection as the lowering
+        raise ModelCompilationException(
+            "regression KNN needs numeric training targets"
+        ) from None
     if m == "average":
         value = sum(yk) / len(yk)
     elif m == "median":
@@ -1256,6 +1269,19 @@ def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
         ws = [1.0 / (ds[i] + eps) for i in order]
         value = sum(y * w for y, w in zip(yk, ws)) / sum(ws)
     return EvalResult(value=value)
+
+
+# --- AnomalyDetection ------------------------------------------------------
+
+
+def _eval_anomaly(model: ir.AnomalyDetectionIR, record: Record) -> EvalResult:
+    from flink_jpmml_tpu.compile.anomaly import iforest_c
+
+    res = _eval_model(model.inner, record)
+    if model.algorithm_type != "iforest" or res.value is None:
+        return res
+    c = iforest_c(model.sample_data_size)
+    return EvalResult(value=2.0 ** (-res.value / c))
 
 
 # --- MiningModel -----------------------------------------------------------

@@ -587,6 +587,28 @@ class NearestNeighborIR:
 
 
 # ---------------------------------------------------------------------------
+# AnomalyDetectionModel (PMML 4.4)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AnomalyDetectionIR:
+    """Wraps an inner model whose raw score becomes the anomaly score.
+
+    ``iforest``: the inner ensemble's mean path length s normalizes to
+    2^(−s/c(n)) with n = sampleDataSize and c(n) the average BST
+    unsuccessful-search depth. ``ocsvm``/``other``: the inner value
+    passes through."""
+
+    function_name: str  # regression
+    mining_schema: MiningSchema
+    algorithm_type: str  # iforest | ocsvm | other
+    inner: "ModelIR"
+    sample_data_size: Optional[int] = None
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
 # MiningModel (ensembles / stacking)
 # ---------------------------------------------------------------------------
 
@@ -601,6 +623,7 @@ ModelIR = Union[
     NaiveBayesIR,
     SvmModelIR,
     NearestNeighborIR,
+    AnomalyDetectionIR,
     "MiningModelIR",
 ]
 

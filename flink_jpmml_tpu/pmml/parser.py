@@ -32,6 +32,7 @@ _MODEL_TAGS = (
     "NaiveBayesModel",
     "SupportVectorMachineModel",
     "NearestNeighborModel",
+    "AnomalyDetectionModel",
     "MiningModel",
 )
 
@@ -506,9 +507,47 @@ def _parse_model(elem: ET.Element) -> ir.ModelIR:
         return _parse_svm(elem)
     if tag == "NearestNeighborModel":
         return _parse_nearest_neighbor(elem)
+    if tag == "AnomalyDetectionModel":
+        return _parse_anomaly_detection(elem)
     if tag == "MiningModel":
         return _parse_mining_model(elem)
     raise ModelLoadingException(f"unsupported model element <{tag}>")
+
+
+def _parse_anomaly_detection(elem: ET.Element) -> ir.AnomalyDetectionIR:
+    algo = elem.get("algorithmType", "other")
+    if algo not in ("iforest", "ocsvm", "other"):
+        raise ModelLoadingException(
+            f"unsupported algorithmType {algo!r} (supported: iforest, "
+            "ocsvm, other)"
+        )
+    inner_elem = None
+    for c in elem:
+        if _local(c.tag) in _MODEL_TAGS:
+            inner_elem = c
+            break
+    if inner_elem is None:
+        raise ModelLoadingException(
+            "AnomalyDetectionModel has no embedded model"
+        )
+    sds = _opt_float(elem, "sampleDataSize")
+    if algo == "iforest":
+        if sds is None:
+            raise ModelLoadingException(
+                "iforest AnomalyDetectionModel needs sampleDataSize"
+            )
+        if int(sds) < 2:
+            raise ModelLoadingException(
+                f"sampleDataSize must be >= 2, got {sds}"
+            )
+    return ir.AnomalyDetectionIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=_parse_mining_schema(elem),
+        algorithm_type=algo,
+        inner=_parse_model(inner_elem),
+        sample_data_size=int(sds) if sds is not None else None,
+        model_name=elem.get("modelName"),
+    )
 
 
 def _parse_comparison_measure(cm: ET.Element) -> ir.ComparisonMeasure:
@@ -548,11 +587,7 @@ def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
             field=ki.get("field", ""),
             weight=_float(ki, "fieldWeight", 1.0),
             compare_function=ki.get("compareFunction"),
-            similarity_scale=(
-                float(ki.get("similarityScale"))
-                if ki.get("similarityScale") is not None
-                else None
-            ),
+            similarity_scale=_opt_float(ki, "similarityScale"),
         )
         for ki in _children(_req_child(elem, "KNNInputs"), "KNNInput")
     )
@@ -609,7 +644,7 @@ def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
         targets.append(cells[tcol])
     if not instances:
         raise ModelLoadingException("TrainingInstances has no rows")
-    k = int(elem.get("numberOfNeighbors", 3))
+    k = int(_float(elem, "numberOfNeighbors", 3))
     if not 1 <= k <= len(instances):
         raise ModelLoadingException(
             f"numberOfNeighbors {k} out of [1, {len(instances)}]"
@@ -730,7 +765,7 @@ def _parse_svm(elem: ET.Element) -> ir.SvmModelIR:
                 f"SupportVectorMachine: {len(coeffs)} coefficients vs "
                 f"{len(vector_ids)} support vectors"
             )
-        thr = svm.get("threshold")
+        thr = _opt_float(svm, "threshold")
         machines.append(
             ir.SvmMachine(
                 vector_ids=vector_ids,
@@ -740,7 +775,7 @@ def _parse_svm(elem: ET.Element) -> ir.SvmModelIR:
                 alternate_target_category=svm.get(
                     "alternateTargetCategory"
                 ),
-                threshold=float(thr) if thr is not None else None,
+                threshold=thr,
             )
         )
     if not machines:
@@ -757,7 +792,7 @@ def _parse_svm(elem: ET.Element) -> ir.SvmModelIR:
         classification_method=elem.get(
             "classificationMethod", "OneAgainstOne"
         ),
-        threshold=float(elem.get("threshold", 0.0)),
+        threshold=_float(elem, "threshold", 0.0),
         model_name=elem.get("modelName"),
     )
 
@@ -803,7 +838,7 @@ def _parse_general_regression(elem: ET.Element) -> ir.GeneralRegressionIR:
             )
         )
     p_cells = tuple(p_cells)
-    lp = elem.get("linkParameter")
+    lp = _opt_float(elem, "linkParameter")
     return ir.GeneralRegressionIR(
         function_name=elem.get("functionName", "regression"),
         mining_schema=_parse_mining_schema(elem),
@@ -814,7 +849,7 @@ def _parse_general_regression(elem: ET.Element) -> ir.GeneralRegressionIR:
         pp_cells=pp_cells,
         p_cells=p_cells,
         link_function=elem.get("linkFunction"),
-        link_power=float(lp) if lp is not None else None,
+        link_power=lp,
         target_reference_category=elem.get("targetReferenceCategory"),
         model_name=elem.get("modelName"),
     )
@@ -876,7 +911,7 @@ def _parse_naive_bayes(elem: ET.Element) -> ir.NaiveBayesIR:
         mining_schema=_parse_mining_schema(elem),
         inputs=tuple(inputs),
         target_counts=target_counts,
-        threshold=float(elem.get("threshold", 0.0)),
+        threshold=_float(elem, "threshold", 0.0),
         model_name=elem.get("modelName"),
     )
 
@@ -1152,11 +1187,7 @@ def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
             field=cf.get("field", ""),
             weight=_float(cf, "fieldWeight", 1.0),
             compare_function=cf.get("compareFunction"),
-            similarity_scale=(
-                float(cf.get("similarityScale"))
-                if cf.get("similarityScale") is not None
-                else None
-            ),
+            similarity_scale=_opt_float(cf, "similarityScale"),
         )
         for cf in _children(elem, "ClusteringField")
     )

@@ -1,0 +1,132 @@
+"""AnomalyDetectionModel (PMML 4.4): the sklearn IsolationForest export
+shape — inner path-length forest + 2^(−s/c(n)) normalization."""
+
+import math
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.compile.anomaly import iforest_c
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+
+
+def _iforest_xml(algo='algorithmType="iforest" sampleDataSize="256"'):
+    # two path-length "trees" averaged by the inner MiningModel
+    def tree(thr, short, long_):
+        return f"""<Segment><True/>
+          <TreeModel functionName="regression">
+            <MiningSchema><MiningField name="s" usageType="target"/>
+              <MiningField name="x"/></MiningSchema>
+            <Node id="0"><True/>
+              <Node id="1" score="{short}">
+                <SimplePredicate field="x" operator="greaterThan"
+                  value="{thr}"/></Node>
+              <Node id="2" score="{long_}"><True/></Node>
+            </Node></TreeModel></Segment>"""
+    return f"""<PMML version="4.4"><DataDictionary>
+      <DataField name="x" optype="continuous" dataType="double"/>
+      <DataField name="s" optype="continuous" dataType="double"/>
+      </DataDictionary>
+      <AnomalyDetectionModel functionName="regression" {algo}>
+      <MiningSchema><MiningField name="s" usageType="target"/>
+        <MiningField name="x"/></MiningSchema>
+      <MiningModel functionName="regression">
+        <MiningSchema><MiningField name="s" usageType="target"/>
+          <MiningField name="x"/></MiningSchema>
+        <Segmentation multipleModelMethod="average">
+          {tree(3.0, 2.0, 9.0)}{tree(2.5, 3.0, 8.0)}
+        </Segmentation></MiningModel>
+      </AnomalyDetectionModel></PMML>"""
+
+
+class TestAnomalyDetection:
+    def test_iforest_normalization_hand_computed(self):
+        doc = parse_pmml(_iforest_xml())
+        cm = compile_pmml(doc)
+        c = iforest_c(256)
+        cases = [
+            (5.0, (2.0 + 3.0) / 2),   # short paths → anomalous
+            (0.0, (9.0 + 8.0) / 2),   # long paths → normal
+            (2.7, (9.0 + 3.0) / 2),
+        ]
+        recs = [{"x": x} for x, _ in cases]
+        for (x, mean_path), p in zip(cases, cm.score_records(recs)):
+            want = 2.0 ** (-mean_path / c)
+            o = evaluate(doc, {"x": x})
+            assert o.value == pytest.approx(want, rel=1e-9)
+            assert p.score.value == pytest.approx(want, rel=1e-5)
+        # shorter mean path ⇒ more anomalous ⇒ higher score
+        scores = [evaluate(doc, {"x": x}).value for x, _ in cases]
+        assert scores[0] > scores[2] > scores[1]
+
+    def test_other_algorithm_passes_through(self):
+        doc = parse_pmml(_iforest_xml(algo='algorithmType="other"'))
+        cm = compile_pmml(doc)
+        o = evaluate(doc, {"x": 5.0})
+        assert o.value == pytest.approx(2.5)  # raw inner average
+        p = cm.score_records([{"x": 5.0}])[0]
+        assert p.score.value == pytest.approx(2.5, rel=1e-5)
+
+    def test_iforest_requires_sample_data_size(self):
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        with pytest.raises(ModelLoadingException, match="sampleDataSize"):
+            parse_pmml(_iforest_xml(algo='algorithmType="iforest"'))
+
+    def test_parity_randomized(self):
+        doc = parse_pmml(_iforest_xml())
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(0)
+        recs = [{"x": float(v)} for v in rng.normal(2.5, 2.0, size=120)]
+        for rec, p in zip(recs, cm.score_records(recs)):
+            o = evaluate(doc, rec)
+            assert p.score.value == pytest.approx(o.value, rel=1e-5), rec
+
+
+class TestTypedErrors:
+    def test_garbage_numeric_attributes_are_loading_errors(self):
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        with pytest.raises(ModelLoadingException, match="not a number"):
+            parse_pmml(_iforest_xml(
+                algo='algorithmType="iforest" sampleDataSize="lots"'
+            ))
+        from tests.test_knn import _knn_xml
+
+        bad_k = _knn_xml().replace(
+            'numberOfNeighbors="3"', 'numberOfNeighbors="few"'
+        )
+        with pytest.raises(ModelLoadingException, match="not a number"):
+            parse_pmml(bad_k)
+
+    def test_minkowski_nonpositive_p_typed_both_paths(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+        from tests.test_knn import _knn_xml
+
+        doc = parse_pmml(_knn_xml(
+            measure='<ComparisonMeasure kind="distance">'
+                    '<minkowski p-parameter="0"/></ComparisonMeasure>'
+        ))
+        with pytest.raises(ModelCompilationException, match="p-parameter"):
+            compile_pmml(doc)
+        with pytest.raises(ModelCompilationException, match="p-parameter"):
+            evaluate(doc, {"u": 0.0, "v": 0.0})
+
+    def test_non_numeric_regression_targets_typed_both_paths(self):
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+        from tests.test_knn import _knn_xml
+
+        xml = _knn_xml(function="regression", target="yv").replace(
+            "<yv>1.0</yv>", "<yv>oops</yv>", 1
+        )
+        doc = parse_pmml(xml)
+        with pytest.raises(ModelCompilationException, match="numeric"):
+            compile_pmml(doc)
+        with pytest.raises(ModelCompilationException, match="numeric"):
+            evaluate(doc, {"u": 0.0, "v": 0.0})
