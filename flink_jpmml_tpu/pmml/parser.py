@@ -85,6 +85,21 @@ def _opt_float(elem: ET.Element, attr: str) -> Optional[float]:
     return _float(elem, attr)
 
 
+def _int(elem: ET.Element, attr: str, default: Optional[int] = None) -> int:
+    """INT-NUMBER attribute: typed rejection for garbage, NaN/inf AND
+    non-integer values (silently truncating "3.9" would score with a
+    different k than a conforming evaluator)."""
+    v = _float(elem, attr, None if default is None else float(default))
+    import math as _math
+
+    if not _math.isfinite(v) or v != int(v):
+        raise ModelLoadingException(
+            f"<{_local(elem.tag)}> attribute {attr}={elem.get(attr)!r} is "
+            "not an integer"
+        )
+    return int(v)
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -530,13 +545,17 @@ def _parse_anomaly_detection(elem: ET.Element) -> ir.AnomalyDetectionIR:
         raise ModelLoadingException(
             "AnomalyDetectionModel has no embedded model"
         )
-    sds = _opt_float(elem, "sampleDataSize")
+    sds = (
+        _int(elem, "sampleDataSize")
+        if elem.get("sampleDataSize") is not None
+        else None
+    )
     if algo == "iforest":
         if sds is None:
             raise ModelLoadingException(
                 "iforest AnomalyDetectionModel needs sampleDataSize"
             )
-        if int(sds) < 2:
+        if sds < 2:
             raise ModelLoadingException(
                 f"sampleDataSize must be >= 2, got {sds}"
             )
@@ -545,7 +564,7 @@ def _parse_anomaly_detection(elem: ET.Element) -> ir.AnomalyDetectionIR:
         mining_schema=_parse_mining_schema(elem),
         algorithm_type=algo,
         inner=_parse_model(inner_elem),
-        sample_data_size=int(sds) if sds is not None else None,
+        sample_data_size=sds,
         model_name=elem.get("modelName"),
     )
 
@@ -644,7 +663,7 @@ def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
         targets.append(cells[tcol])
     if not instances:
         raise ModelLoadingException("TrainingInstances has no rows")
-    k = int(_float(elem, "numberOfNeighbors", 3))
+    k = _int(elem, "numberOfNeighbors", 3)
     if not 1 <= k <= len(instances):
         raise ModelLoadingException(
             f"numberOfNeighbors {k} out of [1, {len(instances)}]"

@@ -130,3 +130,17 @@ class TestTypedErrors:
             compile_pmml(doc)
         with pytest.raises(ModelCompilationException, match="numeric"):
             evaluate(doc, {"u": 0.0, "v": 0.0})
+
+    def test_nan_inf_and_fractional_int_attributes_rejected(self):
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+        from tests.test_knn import _knn_xml
+
+        for bad in ("NaN", "Infinity", "3.9"):
+            with pytest.raises(ModelLoadingException, match="integer"):
+                parse_pmml(_iforest_xml(
+                    algo=f'algorithmType="iforest" sampleDataSize="{bad}"'
+                ))
+            with pytest.raises(ModelLoadingException, match="integer"):
+                parse_pmml(_knn_xml().replace(
+                    'numberOfNeighbors="3"', f'numberOfNeighbors="{bad}"'
+                ))
