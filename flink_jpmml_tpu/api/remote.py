@@ -37,7 +37,14 @@ from typing import Optional, Tuple
 
 from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
 
-_REMOTE_SCHEMES = ("http", "https", "gs", "s3")
+_REMOTE_SCHEMES = ("http", "https", "gs", "s3", "hdfs")
+
+# WebHDFS REST port when the hdfs:// URI carries none (Hadoop 3 NameNode
+# default); override per deployment with FJT_WEBHDFS_PORT. URIs copied
+# from Hadoop configs usually carry the NameNode *RPC* port — those map
+# to the REST default rather than speaking HTTP at a protobuf endpoint.
+_WEBHDFS_DEFAULT_PORT = 9870
+_HDFS_RPC_PORTS = (8020, 9000)
 
 
 def is_remote(path: str) -> bool:
@@ -82,6 +89,33 @@ def _write_atomic(path: str, data: bytes) -> None:
         raise
 
 
+def _serve_stale_or_raise(
+    uri: str, local: str, meta_path: str, err, token: str
+) -> Tuple[str, str]:
+    """Outage policy, shared by every scheme: a cached copy is served
+    stale (loudly — an operator must be able to tell workers are running
+    a possibly-outdated model, like the reference's workers kept serving
+    through DFS blips); no cache → typed error."""
+    if os.path.exists(local):
+        warnings.warn(
+            f"model source {uri!r} unreachable ({err}); serving the "
+            "possibly-stale cached copy",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return local, token
+    raise ModelLoadingException(f"cannot fetch model {uri!r}: {err}") from err
+
+
+def _commit_cache(
+    local: str, meta_path: str, token: str, data: bytes, uri: str
+) -> Tuple[str, str]:
+    """Atomic bytes+meta write, shared by the token-validated schemes."""
+    _write_atomic(local, data)
+    _write_atomic(meta_path, json.dumps({"token": token, "uri": uri}).encode())
+    return local, token
+
+
 def fetch(uri: str, timeout_s: float = 30.0) -> Tuple[str, str]:
     """Resolve ``uri`` to a local file; → (local_path, version_token).
 
@@ -95,6 +129,8 @@ def fetch(uri: str, timeout_s: float = 30.0) -> Tuple[str, str]:
         return _fetch_gs(parts)
     if parts.scheme == "s3":
         return _fetch_s3(parts)
+    if parts.scheme == "hdfs":
+        return _fetch_hdfs(parts, timeout_s)
     if parts.scheme == "file":
         local = urllib.request.url2pathname(parts.path)
         return local, str(_mtime(local))
@@ -127,24 +163,10 @@ def _fetch_http(uri: str, timeout_s: float) -> Tuple[str, str]:
             f"HTTP {e.code} fetching model {uri!r}"
         ) from e
     except (urllib.error.URLError, OSError, TimeoutError) as e:
-        if os.path.exists(local):
-            # remote unreachable but a cached copy exists: serve stale —
-            # the reference's workers likewise kept serving the loaded
-            # model through DFS blips. Loudly: an operator must be able to
-            # tell that workers are running a possibly-outdated model.
-            warnings.warn(
-                f"model source {uri!r} unreachable ({e}); serving the "
-                "possibly-stale cached copy",
-                RuntimeWarning,
-                stacklevel=2,
-            )
-            return (
-                local,
-                meta.get("etag") or meta.get("last_modified") or "stale",
-            )
-        raise ModelLoadingException(
-            f"cannot fetch model {uri!r}: {e}"
-        ) from e
+        return _serve_stale_or_raise(
+            uri, local, meta_path, e,
+            meta.get("etag") or meta.get("last_modified") or "stale",
+        )
     _write_atomic(local, data)
     new_meta = {
         "etag": headers.get("ETag"),
@@ -158,6 +180,58 @@ def _fetch_http(uri: str, timeout_s: float) -> Tuple[str, str]:
         or hashlib.sha256(data).hexdigest()[:16]
     )
     return local, token
+
+
+def _fetch_hdfs(parts, timeout_s: float) -> Tuple[str, str]:
+    """``hdfs://namenode[:port]/path`` via the WebHDFS REST gateway —
+    no Hadoop client dependency, plain HTTP against the NameNode:
+    GETFILESTATUS supplies the cache validator (modificationTime+length);
+    OPEN streams the bytes (follows the DataNode redirect). The REST port
+    defaults to 9870 (Hadoop 3) and can be overridden with
+    ``FJT_WEBHDFS_PORT`` when the URI gives only the RPC authority."""
+    uri = urllib.parse.urlunsplit(parts)
+    local, meta_path = _cache_paths(uri)
+    host = parts.hostname or "localhost"
+    try:
+        env_port = os.environ.get("FJT_WEBHDFS_PORT")
+        if env_port is not None:
+            port = int(env_port)  # explicit override always wins
+        else:
+            port = parts.port  # urlsplit defers validation to here
+            if port is None or port in _HDFS_RPC_PORTS:
+                port = _WEBHDFS_DEFAULT_PORT
+    except ValueError as e:
+        raise ModelLoadingException(
+            f"invalid WebHDFS port for {uri!r}: {e}"
+        ) from e
+    base = f"http://{host}:{port}/webhdfs/v1{parts.path}"
+    try:
+        with urllib.request.urlopen(
+            base + "?op=GETFILESTATUS", timeout=timeout_s
+        ) as resp:
+            status = json.load(resp).get("FileStatus", {})
+        token = (
+            f"{status.get('modificationTime', 0)}-{status.get('length', 0)}"
+        )
+        meta = _read_meta(meta_path)
+        if os.path.exists(local) and meta.get("token") == token:
+            return local, token
+        with urllib.request.urlopen(
+            base + "?op=OPEN", timeout=timeout_s
+        ) as resp:  # urllib follows the DataNode 307 redirect
+            data = resp.read()
+    except urllib.error.HTTPError as e:
+        raise ModelLoadingException(
+            f"WebHDFS {e.code} fetching model {uri!r}"
+        ) from e
+    except (
+        urllib.error.URLError, OSError, TimeoutError, json.JSONDecodeError,
+    ) as e:
+        return _serve_stale_or_raise(
+            uri, local, meta_path, e,
+            _read_meta(meta_path).get("token") or "stale",
+        )
+    return _commit_cache(local, meta_path, token, data, uri)
 
 
 def _fetch_gs(parts) -> Tuple[str, str]:
@@ -186,9 +260,7 @@ def _fetch_gs(parts) -> Tuple[str, str]:
         raise ModelLoadingException(
             f"gs fetch failed for {uri!r}: {e}"
         ) from e
-    _write_atomic(local, data)
-    _write_atomic(meta_path, json.dumps({"token": token, "uri": uri}).encode())
-    return local, token
+    return _commit_cache(local, meta_path, token, data, uri)
 
 
 def _fetch_s3(parts) -> Tuple[str, str]:
@@ -218,6 +290,4 @@ def _fetch_s3(parts) -> Tuple[str, str]:
         raise ModelLoadingException(
             f"s3 fetch failed for {uri!r}: {e}"
         ) from e
-    _write_atomic(local, body)
-    _write_atomic(meta_path, json.dumps({"token": token, "uri": uri}).encode())
-    return local, token
+    return _commit_cache(local, meta_path, token, body, uri)

@@ -192,3 +192,65 @@ class TestShardedPipeline:
         exp = ref.finish(ref.submit(records[:10]))
         for a, b in zip(sink.items[:10], exp):
             assert a.target.label == b.target.label
+
+
+class TestNewFamiliesSharded:
+    """P1 breadth: every round-3 model family scores identically under
+    the 8-device data-parallel mesh (batch axis sharded, params
+    replicated)."""
+
+    def _check(self, doc, arity, seed=0, B=64):
+        from flink_jpmml_tpu.pmml import parse_pmml
+
+        cm = compile_pmml(doc if not isinstance(doc, str) else parse_pmml(doc))
+        mesh = make_mesh(MeshConfig(data=8, model=1))
+        sm = dp_sharded(cm, mesh)
+        rng = np.random.default_rng(seed)
+        X = rng.normal(0.5, 1.2, size=(B, arity)).astype(np.float32)
+        M = np.zeros((B, arity), bool)
+        ref = cm.predict(X, M)
+        out = sm.predict(X, M)
+        np.testing.assert_allclose(
+            np.asarray(out.value), np.asarray(ref.value),
+            rtol=1e-5, atol=1e-6,
+        )
+        if ref.label_idx is not None:
+            np.testing.assert_array_equal(
+                np.asarray(out.label_idx), np.asarray(ref.label_idx)
+            )
+        assert len(out.value.sharding.device_set) == 8
+
+    def test_scorecard_sharded(self):
+        from tests.test_scorecard_ruleset import SCORECARD
+
+        self._check(SCORECARD, 2)
+
+    def test_ruleset_sharded(self):
+        from tests.test_scorecard_ruleset import RULESET
+
+        self._check(RULESET.format(criterion="weightedSum"), 2)
+
+    def test_glm_multinomial_sharded(self):
+        from tests.test_glm_bayes import MULTINOMIAL
+
+        self._check(MULTINOMIAL, 1)
+
+    def test_naive_bayes_sharded(self):
+        from tests.test_glm_bayes import NAIVE_BAYES
+
+        self._check(NAIVE_BAYES, 2)
+
+    def test_svm_sharded(self):
+        from tests.test_svm import _svm_xml, _PAIR_MACHINES, KERNELS
+
+        self._check(_svm_xml(KERNELS["radialBasis"][0], _PAIR_MACHINES), 2)
+
+    def test_knn_sharded(self):
+        from tests.test_knn import _knn_xml
+
+        self._check(_knn_xml(), 2)
+
+    def test_anomaly_sharded(self):
+        from tests.test_anomaly import _iforest_xml
+
+        self._check(_iforest_xml(), 1)

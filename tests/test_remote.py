@@ -167,3 +167,126 @@ class TestDynamicServingRemote:
         out = sc.finish(sc.submit([("rm", {"a": 2.0})]))
         (p, _e) = out[0]
         assert p.score.value == pytest.approx(7.0 + 0.5 * 2.0)
+
+
+class _WebHdfsHandler(http.server.BaseHTTPRequestHandler):
+    """Minimal WebHDFS NameNode stub: GETFILESTATUS + OPEN over one
+    in-memory file, counting operations."""
+
+    content = b""
+    mtime = 1000
+    stats = {"status": 0, "open": 0}
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        cls = type(self)
+        if "op=GETFILESTATUS" in self.path:
+            cls.stats["status"] += 1
+            body = (
+                '{"FileStatus": {"modificationTime": %d, "length": %d, '
+                '"type": "FILE"}}' % (cls.mtime, len(cls.content))
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif "op=OPEN" in self.path:
+            cls.stats["open"] += 1
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(cls.content)))
+            self.end_headers()
+            self.wfile.write(cls.content)
+        else:
+            self.send_response(400)
+            self.end_headers()
+
+
+@pytest.fixture()
+def webhdfs(tmp_path, monkeypatch):
+    monkeypatch.setenv("FJT_MODEL_CACHE", str(tmp_path / "cache"))
+    _WebHdfsHandler.content = _CONST_XML.format(c=4.0).encode()
+    _WebHdfsHandler.mtime = 1000
+    _WebHdfsHandler.stats = {"status": 0, "open": 0}
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _WebHdfsHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestHdfsFetch:
+    def test_webhdfs_fetch_and_score(self, webhdfs):
+        clear_model_cache()
+        uri = f"hdfs://127.0.0.1:{webhdfs}/models/const.pmml"
+        m = ModelReader(uri).load()
+        p = m.score_records([{"a": 2.0}])[0]
+        assert p.score.value == pytest.approx(5.0)
+        assert _WebHdfsHandler.stats == {"status": 1, "open": 1}
+
+    def test_unchanged_file_revalidates_without_download(self, webhdfs):
+        clear_model_cache()
+        uri = f"hdfs://127.0.0.1:{webhdfs}/models/const.pmml"
+        remote.fetch(uri)
+        remote.fetch(uri)
+        assert _WebHdfsHandler.stats["status"] == 2
+        assert _WebHdfsHandler.stats["open"] == 1  # cache hit, no re-read
+
+    def test_changed_mtime_redownloads(self, webhdfs):
+        clear_model_cache()
+        uri = f"hdfs://127.0.0.1:{webhdfs}/models/const.pmml"
+        _, tok1 = remote.fetch(uri)
+        _WebHdfsHandler.content = _CONST_XML.format(c=9.0).encode()
+        _WebHdfsHandler.mtime = 2000
+        local, tok2 = remote.fetch(uri)
+        assert tok1 != tok2
+        assert _WebHdfsHandler.stats["open"] == 2
+        assert b"9.0" in pathlib.Path(local).read_bytes()
+
+    def test_outage_serves_stale_with_warning(self, webhdfs):
+        clear_model_cache()
+        uri = f"hdfs://127.0.0.1:{webhdfs}/models/const.pmml"
+        local, _ = remote.fetch(uri)
+        # unreachable port: stale cache + RuntimeWarning
+        dead = f"hdfs://127.0.0.1:1/models/const.pmml"
+        with pytest.warns(RuntimeWarning, match="stale"):
+            # seed the dead URI's cache entry by copying the good one
+            lp, _ = remote._cache_paths(dead)
+            pathlib.Path(lp).write_bytes(pathlib.Path(local).read_bytes())
+            got, tok = remote.fetch(dead, timeout_s=0.5)
+        assert got == lp and tok == "stale"
+
+    def test_unreachable_without_cache_typed_error(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv("FJT_MODEL_CACHE", str(tmp_path / "c2"))
+        with pytest.raises(ModelLoadingException, match="cannot fetch"):
+            remote.fetch("hdfs://127.0.0.1:1/nope.pmml", timeout_s=0.5)
+
+
+class TestHdfsPortResolution:
+    def test_rpc_port_maps_to_rest_default(self, monkeypatch):
+        # hdfs://nn:8020/... must NOT speak HTTP at 8020; with no env
+        # override it targets the REST default — unreachable here, and
+        # with no cache that is a typed error mentioning the REST port
+        monkeypatch.setenv("FJT_MODEL_CACHE", "/tmp/fjt-nonexistent-cache-x")
+        with pytest.raises(ModelLoadingException, match="cannot fetch"):
+            remote.fetch("hdfs://127.0.0.1:8020/m.pmml", timeout_s=0.3)
+
+    def test_env_override_always_wins(self, webhdfs, monkeypatch):
+        clear_model_cache()
+        monkeypatch.setenv("FJT_WEBHDFS_PORT", str(webhdfs))
+        # URI carries the RPC port; the env override routes to the stub
+        local, tok = remote.fetch(
+            f"hdfs://127.0.0.1:8020/models/const.pmml"
+        )
+        assert pathlib.Path(local).exists() and tok
+
+    def test_bad_ports_typed_errors(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("FJT_MODEL_CACHE", str(tmp_path))
+        with pytest.raises(ModelLoadingException, match="port"):
+            remote.fetch("hdfs://nn:80x0/m.pmml", timeout_s=0.3)
+        monkeypatch.setenv("FJT_WEBHDFS_PORT", "default")
+        with pytest.raises(ModelLoadingException, match="port"):
+            remote.fetch("hdfs://nn/m.pmml", timeout_s=0.3)
