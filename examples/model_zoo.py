@@ -213,6 +213,64 @@ IFOREST = """<PMML version="4.4"><DataDictionary>
   </AnomalyDetectionModel></PMML>"""
 
 
+GP = """<PMML version="4.3"><DataDictionary>
+  <DataField name="f0" optype="continuous" dataType="double"/>
+  <DataField name="f1" optype="continuous" dataType="double"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <GaussianProcessModel functionName="regression">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="f0"/><MiningField name="f1"/></MiningSchema>
+  <RadialBasisKernel gamma="1.0" noiseVariance="0.1" lambda="1.0"/>
+  <TrainingInstances recordCount="3">
+    <InstanceFields>
+      <InstanceField field="f0" column="f0"/>
+      <InstanceField field="f1" column="f1"/>
+      <InstanceField field="y" column="y"/>
+    </InstanceFields>
+    <InlineTable>
+      <row><f0>0</f0><f1>0</f1><y>1.0</y></row>
+      <row><f0>1</f0><f1>1</f1><y>-0.5</y></row>
+      <row><f0>-1</f0><f1>0.5</f1><y>2.0</y></row>
+    </InlineTable>
+  </TrainingInstances></GaussianProcessModel></PMML>"""
+
+BASELINE_Z = """<PMML version="4.2"><DataDictionary>
+  <DataField name="f0" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <BaselineModel functionName="regression">
+  <MiningSchema><MiningField name="f0"/></MiningSchema>
+  <TestDistributions field="f0" testStatistic="zValue">
+    <Baseline><GaussianDistribution mean="0.5" variance="1.44"/></Baseline>
+  </TestDistributions></BaselineModel></PMML>"""
+
+ASSOC = """<PMML version="4.2"><DataDictionary>
+  <DataField name="beer" optype="continuous" dataType="double"/>
+  <DataField name="chips" optype="continuous" dataType="double"/>
+  <DataField name="wine" optype="continuous" dataType="double"/>
+  <DataField name="bread" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <AssociationModel functionName="associationRules"
+      numberOfTransactions="1000" numberOfItems="4"
+      minimumSupport="0.1" minimumConfidence="0.5"
+      numberOfItemsets="4" numberOfRules="2">
+  <MiningSchema>
+    <MiningField name="beer"/><MiningField name="chips"/>
+    <MiningField name="wine"/><MiningField name="bread"/>
+  </MiningSchema>
+  <Item id="1" value="beer"/><Item id="2" value="chips"/>
+  <Item id="3" value="wine"/><Item id="4" value="bread"/>
+  <Itemset id="s1"><ItemRef itemRef="1"/></Itemset>
+  <Itemset id="s2"><ItemRef itemRef="2"/></Itemset>
+  <Itemset id="s3"><ItemRef itemRef="3"/></Itemset>
+  <Itemset id="s4"><ItemRef itemRef="4"/></Itemset>
+  <AssociationRule id="r1" support="0.4" confidence="0.7"
+      antecedent="s1" consequent="s2"/>
+  <AssociationRule id="r2" support="0.3" confidence="0.8"
+      antecedent="s3" consequent="s4"/>
+  </AssociationModel></PMML>"""
+
+
 def main() -> None:
     workdir = tempfile.mkdtemp(prefix="fjt-zoo-")
     rng = np.random.default_rng(7)
@@ -236,6 +294,9 @@ def main() -> None:
         ("SupportVectorMachineModel", SVM, 2),
         ("NearestNeighborModel (KNN)", KNN, 2),
         ("AnomalyDetectionModel (iforest)", IFOREST, 2),
+        ("GaussianProcessModel (RBF)", GP, 2),
+        ("BaselineModel (zValue)", BASELINE_Z, 1),
+        ("AssociationModel (baskets)", ASSOC, 4),
     ]
     for i, (name, xml, arity) in enumerate(inline):
         path = str(pathlib.Path(workdir, f"zoo_{i}.pmml"))

@@ -80,6 +80,18 @@ def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
         from flink_jpmml_tpu.compile.anomaly import lower_anomaly
 
         return lower_anomaly(model, ctx)
+    if isinstance(model, ir.GaussianProcessIR):
+        from flink_jpmml_tpu.compile.gp import lower_gp
+
+        return lower_gp(model, ctx)
+    if isinstance(model, ir.BaselineIR):
+        from flink_jpmml_tpu.compile.baseline import lower_baseline
+
+        return lower_baseline(model, ctx)
+    if isinstance(model, ir.AssociationIR):
+        from flink_jpmml_tpu.compile.assoc import lower_association
+
+        return lower_association(model, ctx)
     if isinstance(model, ir.MiningModelIR):
         return lower_mining(model, ctx)
     raise ModelCompilationException(
@@ -109,6 +121,11 @@ class CompiledModel:
     # scorecard reason codes: (ReasonCodeMeta, n_characteristics) when the
     # document declares useReasonCodes and the metadata is complete
     _reason: Optional[tuple] = None
+    # association: per-rule metadata (ruleFeature-keyed dicts, document
+    # order) + the static confidence/support ranking, feeding
+    # <Output feature="ruleValue"> fields at decode
+    _rule_meta: Optional[Tuple[dict, ...]] = None
+    _rule_order: Optional[Tuple[int, ...]] = None
 
     @property
     def is_classification(self) -> bool:
@@ -202,7 +219,9 @@ class CompiledModel:
         if self.is_classification and out.label_idx is not None:
             idx = np.asarray(out.label_idx)[:n]
             labels = [self.labels[i] for i in idx]
-            if out.probs is not None:
+            # association: probs is the fired-rule mask, not class
+            # probabilities — consumed below for ruleValue ranking
+            if out.probs is not None and self._rule_meta is None:
                 P = np.asarray(out.probs)[:n]
                 probabilities = [
                     dict(zip(self.labels, row.tolist())) for row in P
@@ -210,6 +229,15 @@ class CompiledModel:
         preds = decode_batch(
             value.tolist(), valid.tolist(), labels, probabilities
         )
+        if self._rule_meta is not None and not self.output_fields:
+            # oracle parity: with no <Output> declared, the association
+            # winner's metadata is still surfaced (interp.py does the same)
+            idx = np.asarray(out.label_idx)[:n]
+            preds = [
+                p if p.is_empty
+                else dataclasses.replace(p, outputs=self._rule_meta[idx[i]])
+                for i, p in enumerate(preds)
+            ]
         if self.output_fields:
             # top-level <Output> post-processing (pmml/outputs.py): only
             # documents that declare it pay this host-side per-record step
@@ -223,6 +251,21 @@ class CompiledModel:
                     meta.rank(P[i, :C], P[i, C:].astype(np.int32))
                     for i in range(P.shape[0])
                 ]
+            rank_rows = None
+            if self._rule_meta is not None and out.probs is not None and any(
+                of.feature == "ruleValue" for of in self.output_fields
+            ):
+                # fired mask (document order) → ranked fired-rule metadata
+                # via the static confidence/support order
+                fired = np.asarray(out.probs)[:n] > 0.5
+                rank_rows = [
+                    tuple(
+                        self._rule_meta[j]
+                        for j in self._rule_order
+                        if fired[i, j]
+                    )
+                    for i in range(fired.shape[0])
+                ]
             preds = [
                 p
                 if p.is_empty
@@ -235,6 +278,9 @@ class CompiledModel:
                         p.target.probabilities if p.target else None,
                         reason_codes=(
                             rc_rows[i] if rc_rows is not None else None
+                        ),
+                        rule_ranking=(
+                            rank_rows[i] if rank_rows is not None else None
                         ),
                     ),
                 )
@@ -386,6 +432,16 @@ def compile_pmml(
             if wants_rc:
                 raise  # requested but the metadata is incomplete
             reason = None
+    rule_meta = rule_order = None
+    if isinstance(doc.model, ir.AssociationIR):
+        from flink_jpmml_tpu.pmml.interp import rule_meta_dict
+
+        rules = doc.model.rules
+        rule_meta = tuple(rule_meta_dict(r) for r in rules)
+        rule_order = tuple(sorted(
+            range(len(rules)),
+            key=lambda i: (-rules[i].confidence, -rules[i].support, i),
+        ))
     name = getattr(doc.model, "model_name", None)
     return CompiledModel(
         field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
@@ -398,4 +454,6 @@ def compile_pmml(
         _config=config,
         output_fields=doc.output_fields,
         _reason=reason,
+        _rule_meta=rule_meta,
+        _rule_order=rule_order,
     )

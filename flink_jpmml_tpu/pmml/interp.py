@@ -21,10 +21,11 @@ Missing-value semantics follow DMG PMML 4.x:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import math
 from dataclasses import dataclass, field as dc_field
-from typing import Dict, List, Mapping, Optional, Tuple, Union
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
@@ -48,6 +49,8 @@ class EvalResult:
     probabilities: Dict[str, float] = dc_field(default_factory=dict)
     outputs: Dict[str, object] = dc_field(default_factory=dict)
     reason_codes: Tuple[str, ...] = ()  # scorecard, ranked worst-first
+    # association: fired rules' metadata best-first (rank-k ruleValue)
+    rule_ranking: Tuple[Dict[str, object], ...] = ()
 
     @property
     def is_missing(self) -> bool:
@@ -260,6 +263,8 @@ def evaluate(doc: ir.PmmlDocument, record: Record) -> EvalResult:
             res.label,
             res.probabilities,
             reason_codes=res.reason_codes,
+            # association: the fired-rule ranking feeds ruleValue fields
+            rule_ranking=res.rule_ranking,
         )
     return res
 
@@ -385,7 +390,9 @@ def _apply_targets(targets: Tuple[ir.Target, ...], res: EvalResult) -> EvalResul
         v = float(math.ceil(v))
     elif t.cast_integer == "floor":
         v = float(math.floor(v))
-    return EvalResult(value=v, label=res.label, probabilities=res.probabilities)
+    # rescale the value only — every other result facet (outputs,
+    # reason codes, rule ranking) rides through unchanged
+    return dataclasses.replace(res, value=v)
 
 
 def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
@@ -409,6 +416,12 @@ def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
         return _eval_svm(model, record)
     if isinstance(model, ir.NearestNeighborIR):
         return _eval_knn(model, record)
+    if isinstance(model, ir.GaussianProcessIR):
+        return _eval_gp(model, record)
+    if isinstance(model, ir.BaselineIR):
+        return _eval_baseline(model, record)
+    if isinstance(model, ir.AssociationIR):
+        return _eval_association(model, record)
     if isinstance(model, ir.AnomalyDetectionIR):
         return _eval_anomaly(model, record)
     if isinstance(model, ir.MiningModelIR):
@@ -1272,6 +1285,126 @@ def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
 
 
 # --- AnomalyDetection ------------------------------------------------------
+
+
+def _gp_kernel_value(
+    kernel: ir.GpKernel, x: List[float], z: Sequence[float]
+) -> float:
+    lam = list(kernel.lambdas)
+    if len(lam) == 1:
+        lam = lam * len(x)
+    if kernel.kind == "radialBasis":
+        s = sum((a - b) ** 2 for a, b in zip(x, z))
+        return kernel.gamma * math.exp(-s / (2.0 * lam[0] ** 2))
+    if kernel.kind == "ARDSquaredExponential":
+        s = sum(((a - b) / l) ** 2 for a, b, l in zip(x, z, lam))
+        return kernel.gamma * math.exp(-0.5 * s)
+    if kernel.kind == "absoluteExponential":
+        s = sum(abs(a - b) / l for a, b, l in zip(x, z, lam))
+        return kernel.gamma * math.exp(-s)
+    if kernel.kind == "generalizedExponential":
+        s = sum(
+            (abs(a - b) / l) ** kernel.degree for a, b, l in zip(x, z, lam)
+        )
+        return kernel.gamma * math.exp(-s)
+    raise ModelCompilationException(f"unsupported GP kernel {kernel.kind!r}")
+
+
+@functools.lru_cache(maxsize=64)
+def _gp_alpha(model: ir.GaussianProcessIR) -> Tuple[float, ...]:
+    """α = (K + σ²I)⁻¹ y, cached per (hashable, frozen) model — the solve
+    is record-independent, exactly the quantity the lowering precomputes."""
+    import numpy as _np
+
+    X = _np.asarray(model.instances, _np.float64)
+    y = _np.asarray(model.targets, _np.float64)
+    N = X.shape[0]
+    K = _np.empty((N, N), _np.float64)
+    for i in range(N):
+        for j in range(N):
+            K[i, j] = _gp_kernel_value(model.kernel, list(X[i]), X[j])
+    try:
+        alpha = _np.linalg.solve(
+            K + model.kernel.noise_variance * _np.eye(N), y
+        )
+    except _np.linalg.LinAlgError:
+        # same typed rejection as the lowering (compile/gp.py)
+        raise ModelCompilationException(
+            "GP kernel matrix K + noiseVariance*I is singular; increase "
+            "noiseVariance or deduplicate training instances"
+        ) from None
+    return tuple(float(a) for a in alpha)
+
+
+def _eval_gp(model: ir.GaussianProcessIR, record: Record) -> EvalResult:
+    xs: List[float] = []
+    for f in model.inputs:
+        v = _as_float(record.get(f))
+        if v is None:
+            return EvalResult()  # GP kernels have no missing-value routing
+        xs.append(v)
+    alpha = _gp_alpha(model)
+    return EvalResult(value=sum(
+        a * _gp_kernel_value(model.kernel, xs, z)
+        for a, z in zip(alpha, model.instances)
+    ))
+
+
+def _eval_baseline(model: ir.BaselineIR, record: Record) -> EvalResult:
+    x = _as_float(record.get(model.field))
+    if x is None:
+        return EvalResult()
+    b = model.baseline
+    return EvalResult(value=(x - b.mean) / math.sqrt(b.variance))
+
+
+def rule_meta_dict(r: ir.AssociationRule) -> Dict[str, object]:
+    """One rule's metadata, keyed by ruleFeature name (pmml/outputs.py) —
+    the single definition both the oracle and the compiled decode use."""
+    return {
+        "consequent": " ".join(r.consequent),
+        "antecedent": " ".join(r.antecedent),
+        "rule": f"{{{' '.join(r.antecedent)}}}->"
+                f"{{{' '.join(r.consequent)}}}",
+        "ruleId": r.rule_id,
+        "confidence": r.confidence,
+        "support": r.support,
+        "lift": r.lift,
+    }
+
+
+def _eval_association(model: ir.AssociationIR, record: Record) -> EvalResult:
+    basket = set()
+    for item in model.items:
+        v = _as_float(record.get(item))
+        if v is not None and v > 0.5:
+            basket.add(item)
+    fired = []  # (sort key, rule)
+    for i, r in enumerate(model.rules):
+        if not set(r.antecedent) <= basket:
+            continue
+        cons_in = set(r.consequent) <= basket
+        # JPMML-parity criteria: "rule" needs the whole rule in the
+        # basket; "recommendation" only the antecedent;
+        # "exclusiveRecommendation" (the spec default) additionally
+        # requires the consequent NOT fully present yet
+        if model.criterion == "rule" and not cons_in:
+            continue
+        if model.criterion == "exclusiveRecommendation" and cons_in:
+            continue
+        fired.append(((-r.confidence, -r.support, i), r))
+    if not fired:
+        return EvalResult()
+    fired.sort(key=lambda t: t[0])
+    best = fired[0][1]
+    res = EvalResult(
+        value=best.confidence, label=" ".join(best.consequent)
+    )
+    # winner metadata surfaced as-is when the document declares no
+    # Output; the full ranking feeds rank-k ruleValue fields
+    res.outputs = rule_meta_dict(best)
+    res.rule_ranking = tuple(rule_meta_dict(r) for _, r in fired)
+    return res
 
 
 def _eval_anomaly(model: ir.AnomalyDetectionIR, record: Record) -> EvalResult:

@@ -609,6 +609,113 @@ class AnomalyDetectionIR:
 
 
 # ---------------------------------------------------------------------------
+# GaussianProcessModel (PMML 4.3)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GpKernel:
+    """One of the four PMML 4.3 GP kernels.
+
+    ``kind``: radialBasis | ARDSquaredExponential | absoluteExponential |
+    generalizedExponential. ``lambdas`` holds the length-scale(s): one
+    value for the isotropic radialBasis kernel, per-dimension for the
+    others (a single value broadcasts)."""
+
+    kind: str
+    gamma: float = 1.0
+    noise_variance: float = 1.0
+    lambdas: Tuple[float, ...] = (1.0,)
+    degree: float = 1.0  # generalizedExponential only
+
+
+@dataclass(frozen=True)
+class GaussianProcessIR:
+    """GP regression: μ(x) = k(x, X)ᵀ (K + σ²I)⁻¹ y.
+
+    The training instances and targets are stored in the document; the
+    regularized inverse is precomputed at compile time (host), leaving a
+    kernel-row evaluation + one matvec on the device."""
+
+    function_name: str  # regression
+    mining_schema: MiningSchema
+    kernel: GpKernel
+    inputs: Tuple[str, ...]  # feature fields, instance-column order
+    instances: Tuple[Tuple[float, ...], ...]  # [N][D] training rows
+    targets: Tuple[float, ...]  # [N] training target values
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# BaselineModel
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BaselineDistribution:
+    """A parametric baseline: gaussian (mean, variance), poisson (mean),
+    or uniform (lower, upper)."""
+
+    kind: str  # gaussian | poisson | uniform
+    mean: float = 0.0
+    variance: float = 1.0
+    lower: float = 0.0
+    upper: float = 1.0
+
+
+@dataclass(frozen=True)
+class BaselineIR:
+    """BaselineModel/TestDistributions with the ``zValue`` statistic:
+    score = (x − μ₀) / σ₀ under the baseline distribution (Poisson:
+    σ₀² = μ₀). Stateless per record — CUSUM (windowed) is rejected at
+    parse time."""
+
+    function_name: str  # regression
+    mining_schema: MiningSchema
+    field: str
+    baseline: BaselineDistribution
+    test_statistic: str = "zValue"
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# AssociationModel
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AssociationRule:
+    """antecedent ⊆ basket ⇒ consequent, with the mined statistics."""
+
+    antecedent: Tuple[str, ...]  # item values
+    consequent: Tuple[str, ...]
+    support: float
+    confidence: float
+    lift: Optional[float] = None
+    rule_id: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class AssociationIR:
+    """Association rules over multi-hot basket records.
+
+    The streaming input contract is one active MiningField per item in
+    ``items`` (value > 0.5 ⇔ the item is in the record's basket) — the
+    fixed-width, TPU-native framing of the reference's group-valued
+    transaction field. A rule *fires* when its antecedent is a subset of
+    the basket; the per-criterion winner (rule / recommendation /
+    exclusiveRecommendation) ranks fired rules by confidence, then
+    support, then document order."""
+
+    function_name: str  # associationRules
+    mining_schema: MiningSchema
+    items: Tuple[str, ...]  # item values, document order
+    rules: Tuple[AssociationRule, ...]
+    criterion: str = "rule"  # | recommendation | exclusiveRecommendation
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
 # MiningModel (ensembles / stacking)
 # ---------------------------------------------------------------------------
 
@@ -624,6 +731,9 @@ ModelIR = Union[
     SvmModelIR,
     NearestNeighborIR,
     AnomalyDetectionIR,
+    GaussianProcessIR,
+    BaselineIR,
+    AssociationIR,
     "MiningModelIR",
 ]
 
@@ -642,6 +752,7 @@ class OutputField:
     target_value: Optional[str] = None
     expression: Optional[Expression] = None  # transformedValue only
     rank: int = 1  # reasonCode: 1-based rank into the worst-first list
+    rule_feature: Optional[str] = None  # ruleValue (association) only
 
 
 @dataclass(frozen=True)

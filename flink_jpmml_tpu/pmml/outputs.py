@@ -25,6 +25,13 @@ from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
 _FEATURES = (
     "predictedValue", "probability", "transformedValue", "reasonCode",
+    "ruleValue",
+)
+
+# ruleFeature attribute → key in the winning-rule metadata mapping
+_RULE_FEATURES = (
+    "consequent", "antecedent", "rule", "ruleId",
+    "confidence", "support", "lift",
 )
 
 
@@ -52,6 +59,11 @@ def validate_output_fields(
                 f"unsupported OutputField feature {of.feature!r} "
                 f"(supported: {', '.join(_FEATURES)})"
             )
+        if of.feature == "ruleValue" and of.rule_feature not in _RULE_FEATURES:
+            raise ModelCompilationException(
+                f"unsupported ruleFeature {of.rule_feature!r} "
+                f"(supported: {', '.join(_RULE_FEATURES)})"
+            )
         if of.feature == "transformedValue":
             refs = _expr_field_refs(of.expression)
             unknown = refs - seen
@@ -71,11 +83,14 @@ def compute_outputs(
     label: Optional[str],
     probabilities: Optional[Mapping[str, float]],
     reason_codes: Optional[Sequence[str]] = None,
+    rule_ranking: Optional[Sequence[Mapping[str, object]]] = None,
 ) -> Dict[str, object]:
     """One record's model result → its <Output> field values, in
     declaration order (later transformedValues see earlier outputs).
     ``reason_codes`` is the scorecard's ranked worst-first list (rank
-    attribute is 1-based; out-of-range → None)."""
+    attribute is 1-based; out-of-range → None). ``rule_ranking`` is the
+    association fired-rule metadata best-first; a ruleValue field's
+    ``rank`` indexes it the same way."""
     from flink_jpmml_tpu.pmml.interp import eval_expression
 
     probs = probabilities or {}
@@ -90,6 +105,13 @@ def compute_outputs(
         elif of.feature == "reasonCode":
             out[of.name] = (
                 rcs[of.rank - 1] if 0 < of.rank <= len(rcs) else None
+            )
+        elif of.feature == "ruleValue":
+            rr = rule_ranking or ()
+            out[of.name] = (
+                rr[of.rank - 1].get(of.rule_feature)
+                if 0 < of.rank <= len(rr)
+                else None
             )
         else:  # transformedValue (validated)
             out[of.name] = eval_expression(of.expression, out)

@@ -11,7 +11,7 @@ names, which is what makes one parser cover all 4.x minor versions.
 from __future__ import annotations
 
 import xml.etree.ElementTree as ET
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.exceptions import (
@@ -33,6 +33,9 @@ _MODEL_TAGS = (
     "SupportVectorMachineModel",
     "NearestNeighborModel",
     "AnomalyDetectionModel",
+    "GaussianProcessModel",
+    "BaselineModel",
+    "AssociationModel",
     "MiningModel",
 )
 
@@ -225,6 +228,11 @@ def _parse_output(out_elem: Optional[ET.Element]) -> tuple:
                 target_value=of.get("value"),
                 expression=expr,
                 rank=int(of.get("rank", 1)),
+                rule_feature=(
+                    of.get("ruleFeature", "consequent")
+                    if feature == "ruleValue"
+                    else None
+                ),
             )
         )
     return tuple(out)
@@ -524,9 +532,246 @@ def _parse_model(elem: ET.Element) -> ir.ModelIR:
         return _parse_nearest_neighbor(elem)
     if tag == "AnomalyDetectionModel":
         return _parse_anomaly_detection(elem)
+    if tag == "GaussianProcessModel":
+        return _parse_gaussian_process(elem)
+    if tag == "BaselineModel":
+        return _parse_baseline(elem)
+    if tag == "AssociationModel":
+        return _parse_association(elem)
     if tag == "MiningModel":
         return _parse_mining_model(elem)
     raise ModelLoadingException(f"unsupported model element <{tag}>")
+
+
+_GP_KERNELS = {
+    "RadialBasisKernel": "radialBasis",
+    "ARDSquaredExponentialKernel": "ARDSquaredExponential",
+    "AbsoluteExponentialKernel": "absoluteExponential",
+    "GeneralizedExponentialKernel": "generalizedExponential",
+}
+
+
+def _parse_gaussian_process(elem: ET.Element) -> ir.GaussianProcessIR:
+    schema = _parse_mining_schema(elem)
+    kernel = None
+    for c in elem:
+        kind = _GP_KERNELS.get(_local(c.tag))
+        if kind is None:
+            continue
+        lambdas: Tuple[float, ...] = (1.0,)
+        la = _child(c, "Lambda")
+        if la is not None:
+            arr = _child(la, "Array")
+            if arr is None:
+                raise ModelLoadingException("Lambda has no Array child")
+            lambdas = _parse_real_array(arr)
+        elif c.get("lambda") is not None:
+            lambdas = (_float(c, "lambda"),)
+        if any(v <= 0 for v in lambdas):
+            raise ModelLoadingException("GP length-scales must be positive")
+        if kind == "radialBasis" and len(lambdas) != 1:
+            # the isotropic kernel has ONE length-scale (scalar ``lambda``
+            # attribute); a per-dimension array is the ARD kernel's job —
+            # accepting it here would score differently compiled vs oracle
+            raise ModelLoadingException(
+                "RadialBasisKernel takes a single lambda; use "
+                "ARDSquaredExponentialKernel for per-dimension length-scales"
+            )
+        kernel = ir.GpKernel(
+            kind=kind,
+            gamma=_float(c, "gamma", 1.0),
+            noise_variance=_float(c, "noiseVariance", 1.0),
+            lambdas=lambdas,
+            degree=_float(c, "degree", 1.0),
+        )
+        break
+    if kernel is None:
+        raise ModelLoadingException(
+            "GaussianProcessModel has no supported kernel element "
+            f"(supported: {', '.join(_GP_KERNELS)})"
+        )
+    if kernel.noise_variance < 0:
+        raise ModelLoadingException("noiseVariance must be >= 0")
+    target = schema.target_field
+    if target is None:
+        raise ModelLoadingException(
+            "GaussianProcessModel needs a target MiningField"
+        )
+    inputs = schema.active_fields
+    instances, raw_targets = _parse_training_instances(
+        _req_child(elem, "TrainingInstances"), inputs, target
+    )
+    try:
+        targets = tuple(float(t) for t in raw_targets)
+    except ValueError:
+        raise ModelLoadingException(
+            "non-numeric GP training target value"
+        ) from None
+    D = len(inputs)
+    if len(kernel.lambdas) not in (1, D):
+        raise ModelLoadingException(
+            f"Lambda has {len(kernel.lambdas)} entries for {D} inputs"
+        )
+    return ir.GaussianProcessIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=schema,
+        kernel=kernel,
+        inputs=inputs,
+        instances=tuple(instances),
+        targets=tuple(targets),
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_baseline(elem: ET.Element) -> ir.BaselineIR:
+    td = _child(elem, "TestDistributions")
+    if td is None:
+        raise ModelLoadingException("BaselineModel has no TestDistributions")
+    stat = td.get("testStatistic", "zValue")
+    if stat != "zValue":
+        raise ModelLoadingException(
+            f"unsupported testStatistic {stat!r} (supported: zValue; "
+            "CUSUM/chiSquare are windowed/multi-record and don't fit the "
+            "per-record streaming contract)"
+        )
+    base = _child(td, "Baseline")
+    if base is None:
+        raise ModelLoadingException("TestDistributions has no Baseline")
+    dist = None
+    for c in base:
+        tag = _local(c.tag)
+        if tag == "GaussianDistribution":
+            variance = _float(c, "variance", 1.0)
+            if variance <= 0:
+                raise ModelLoadingException("variance must be positive")
+            dist = ir.BaselineDistribution(
+                kind="gaussian", mean=_float(c, "mean", 0.0),
+                variance=variance,
+            )
+        elif tag == "PoissonDistribution":
+            mean = _float(c, "mean")
+            if mean <= 0:
+                raise ModelLoadingException("Poisson mean must be positive")
+            dist = ir.BaselineDistribution(
+                kind="poisson", mean=mean, variance=mean
+            )
+        elif tag == "UniformDistribution":
+            lower = _float(c, "lower", 0.0)
+            upper = _float(c, "upper", 1.0)
+            if upper <= lower:
+                raise ModelLoadingException("uniform upper must be > lower")
+            # zValue over a uniform baseline: mean (l+u)/2, var (u−l)²/12
+            dist = ir.BaselineDistribution(
+                kind="uniform",
+                mean=(lower + upper) / 2.0,
+                variance=(upper - lower) ** 2 / 12.0,
+                lower=lower, upper=upper,
+            )
+        if dist is not None:
+            break
+    if dist is None:
+        raise ModelLoadingException(
+            "Baseline has no supported distribution (Gaussian, Poisson, "
+            "Uniform)"
+        )
+    field = td.get("field")
+    if not field:
+        raise ModelLoadingException("TestDistributions needs a field")
+    return ir.BaselineIR(
+        function_name=elem.get("functionName", "regression"),
+        mining_schema=_parse_mining_schema(elem),
+        field=field,
+        baseline=dist,
+        test_statistic=stat,
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_association(elem: ET.Element) -> ir.AssociationIR:
+    schema = _parse_mining_schema(elem)
+    items: dict = {}  # item id → value
+    for it in _children(elem, "Item"):
+        iid = it.get("id")
+        value = it.get("value")
+        if iid is None or value is None:
+            raise ModelLoadingException("Item needs id and value")
+        items[iid] = value
+    itemsets: dict = {}  # itemset id → tuple of item values
+    for iset in _children(elem, "Itemset"):
+        sid = iset.get("id")
+        if sid is None:
+            raise ModelLoadingException("Itemset needs an id")
+        refs = []
+        for ref in _children(iset, "ItemRef"):
+            rid = ref.get("itemRef")
+            if rid not in items:
+                raise ModelLoadingException(
+                    f"ItemRef {rid!r} has no matching Item"
+                )
+            refs.append(items[rid])
+        itemsets[sid] = tuple(refs)
+    rules = []
+    for r in _children(elem, "AssociationRule"):
+        ante = r.get("antecedent")
+        cons = r.get("consequent")
+        if ante not in itemsets or cons not in itemsets:
+            raise ModelLoadingException(
+                "AssociationRule antecedent/consequent must reference "
+                "declared Itemsets"
+            )
+        if not itemsets[cons]:
+            # oracle and compiled paths must agree the document is
+            # invalid — rejecting here keeps them consistent
+            raise ModelLoadingException(
+                f"AssociationRule consequent {cons!r} is an empty Itemset"
+            )
+        rules.append(ir.AssociationRule(
+            antecedent=itemsets[ante],
+            consequent=itemsets[cons],
+            support=_float(r, "support"),
+            confidence=_float(r, "confidence"),
+            lift=_opt_float(r, "lift"),
+            rule_id=r.get("id"),
+        ))
+    if not rules:
+        raise ModelLoadingException("AssociationModel has no rules")
+    item_values = tuple(items[k] for k in items)
+    # the streaming input contract: every item must be an active field
+    # (multi-hot basket columns); a reference-style group-valued single
+    # field cannot be fixed-width batched
+    missing = [v for v in item_values if v not in schema.active_fields]
+    if missing:
+        raise ModelLoadingException(
+            "AssociationModel items must each be an active MiningField "
+            f"(multi-hot basket contract); missing: {missing[:5]}"
+        )
+    # the ranking criterion rides the model's <Output>: an OutputField's
+    # ``algorithm`` attribute (JPMML convention), whose spec default —
+    # also used when the document declares no Output at all — is
+    # exclusiveRecommendation
+    criterion = "exclusiveRecommendation"
+    out = _child(elem, "Output")
+    if out is not None:
+        for of in _children(out, "OutputField"):
+            algo = of.get("algorithm")
+            if algo is None:
+                continue
+            if algo not in (
+                "rule", "recommendation", "exclusiveRecommendation"
+            ):
+                raise ModelLoadingException(
+                    f"unsupported association algorithm {algo!r}"
+                )
+            criterion = algo
+            break
+    return ir.AssociationIR(
+        function_name=elem.get("functionName", "associationRules"),
+        mining_schema=schema,
+        items=item_values,
+        rules=tuple(rules),
+        criterion=criterion,
+        model_name=elem.get("modelName"),
+    )
 
 
 def _parse_anomaly_detection(elem: ET.Element) -> ir.AnomalyDetectionIR:
@@ -598,6 +843,64 @@ def _parse_comparison_measure(cm: ET.Element) -> ir.ComparisonMeasure:
     )
 
 
+def _parse_training_instances(
+    ti: ET.Element,
+    feature_fields: Sequence[str],
+    target_field: str,
+) -> Tuple[Tuple[Tuple[float, ...], ...], Tuple[str, ...]]:
+    """Shared TrainingInstances/InstanceFields/InlineTable walk (KNN, GP).
+
+    → (feature rows as float tuples in ``feature_fields`` order, raw
+    target strings). Every feature field and the target must have an
+    InstanceField column; only InlineTable bodies are supported."""
+    ifields = {
+        f.get("field", ""): f.get("column", f.get("field", ""))
+        for f in _children(_req_child(ti, "InstanceFields"), "InstanceField")
+    }
+    for f in feature_fields:
+        if f not in ifields:
+            raise ModelLoadingException(
+                f"field {f!r} has no InstanceField column"
+            )
+    if target_field not in ifields:
+        raise ModelLoadingException(
+            f"target {target_field!r} has no InstanceField column"
+        )
+    table = _child(ti, "InlineTable")
+    if table is None:
+        raise ModelLoadingException(
+            "only InlineTable TrainingInstances are supported"
+        )
+    instances = []
+    targets = []
+    for row in _children(table, "row"):
+        cells = {_local(c.tag): (c.text or "").strip() for c in row}
+        coords = []
+        for f in feature_fields:
+            col = ifields[f]
+            if col not in cells:
+                raise ModelLoadingException(
+                    f"training row missing column {col!r}"
+                )
+            try:
+                coords.append(float(cells[col]))
+            except ValueError:
+                raise ModelLoadingException(
+                    f"non-numeric training value {cells[col]!r} in "
+                    f"column {col!r}"
+                ) from None
+        tcol = ifields[target_field]
+        if tcol not in cells:
+            raise ModelLoadingException(
+                f"training row missing target column {tcol!r}"
+            )
+        instances.append(tuple(coords))
+        targets.append(cells[tcol])
+    if not instances:
+        raise ModelLoadingException("TrainingInstances has no rows")
+    return tuple(instances), tuple(targets)
+
+
 def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
     schema = _parse_mining_schema(elem)
     measure = _parse_comparison_measure(_req_child(elem, "ComparisonMeasure"))
@@ -612,57 +915,16 @@ def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
     )
     if not inputs:
         raise ModelLoadingException("KNNInputs has no KNNInput elements")
-    ti = _req_child(elem, "TrainingInstances")
-    ifields = {
-        f.get("field", ""): f.get("column", f.get("field", ""))
-        for f in _children(_req_child(ti, "InstanceFields"), "InstanceField")
-    }
     target = schema.target_field
     if target is None:
         raise ModelLoadingException(
             "NearestNeighborModel needs a target MiningField"
         )
-    for ki in inputs:
-        if ki.field not in ifields:
-            raise ModelLoadingException(
-                f"KNNInput {ki.field!r} has no InstanceField column"
-            )
-    if target not in ifields:
-        raise ModelLoadingException(
-            f"target {target!r} has no InstanceField column"
-        )
-    table = _child(ti, "InlineTable")
-    if table is None:
-        raise ModelLoadingException(
-            "only InlineTable TrainingInstances are supported"
-        )
-    instances = []
-    targets = []
-    for row in _children(table, "row"):
-        cells = {_local(c.tag): (c.text or "").strip() for c in row}
-        coords = []
-        for ki in inputs:
-            col = ifields[ki.field]
-            if col not in cells:
-                raise ModelLoadingException(
-                    f"training row missing column {col!r}"
-                )
-            try:
-                coords.append(float(cells[col]))
-            except ValueError:
-                raise ModelLoadingException(
-                    f"non-numeric training value {cells[col]!r} in "
-                    f"column {col!r}"
-                ) from None
-        tcol = ifields[target]
-        if tcol not in cells:
-            raise ModelLoadingException(
-                f"training row missing target column {tcol!r}"
-            )
-        instances.append(tuple(coords))
-        targets.append(cells[tcol])
-    if not instances:
-        raise ModelLoadingException("TrainingInstances has no rows")
+    instances, targets = _parse_training_instances(
+        _req_child(elem, "TrainingInstances"),
+        [ki.field for ki in inputs],
+        target,
+    )
     k = _int(elem, "numberOfNeighbors", 3)
     if not 1 <= k <= len(instances):
         raise ModelLoadingException(

@@ -1,0 +1,366 @@
+"""GaussianProcessModel, BaselineModel, AssociationModel families:
+compiled vs oracle vs hand-computed golden values."""
+
+import dataclasses
+import math
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+# ---------------------------------------------------------------------------
+# GaussianProcessModel
+# ---------------------------------------------------------------------------
+
+GP = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x1" optype="continuous" dataType="double"/>
+  <DataField name="x2" optype="continuous" dataType="double"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <GaussianProcessModel functionName="regression">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="x1"/><MiningField name="x2"/></MiningSchema>
+  {kernel}
+  <TrainingInstances recordCount="4">
+    <InstanceFields>
+      <InstanceField field="x1" column="x1"/>
+      <InstanceField field="x2" column="x2"/>
+      <InstanceField field="y" column="y"/>
+    </InstanceFields>
+    <InlineTable>
+      <row><x1>0.0</x1><x2>0.0</x2><y>1.0</y></row>
+      <row><x1>1.0</x1><x2>0.5</x2><y>-0.5</y></row>
+      <row><x1>-0.5</x1><x2>1.5</x2><y>2.0</y></row>
+      <row><x1>0.7</x1><x2>-1.0</x2><y>0.3</y></row>
+    </InlineTable>
+  </TrainingInstances>
+  </GaussianProcessModel></PMML>"""
+
+TRAIN_X = np.array(
+    [[0.0, 0.0], [1.0, 0.5], [-0.5, 1.5], [0.7, -1.0]], np.float64
+)
+TRAIN_Y = np.array([1.0, -0.5, 2.0, 0.3], np.float64)
+
+
+def _hand_kernel(kind, a, b, gamma, lam, degree=1.0):
+    lam = np.asarray(lam, np.float64)
+    d = np.asarray(a, np.float64) - np.asarray(b, np.float64)
+    if kind == "sq":
+        return gamma * math.exp(-0.5 * float(((d / lam) ** 2).sum()))
+    if kind == "abs":
+        return gamma * math.exp(-float((np.abs(d) / lam).sum()))
+    return gamma * math.exp(-float(((np.abs(d) / lam) ** degree).sum()))
+
+
+def _hand_gp(kind, x, gamma, noise, lam, degree=1.0):
+    N = TRAIN_X.shape[0]
+    K = np.array(
+        [
+            [
+                _hand_kernel(kind, TRAIN_X[i], TRAIN_X[j], gamma, lam, degree)
+                for j in range(N)
+            ]
+            for i in range(N)
+        ]
+    )
+    alpha = np.linalg.solve(K + noise * np.eye(N), TRAIN_Y)
+    ks = np.array(
+        [_hand_kernel(kind, x, TRAIN_X[i], gamma, lam, degree) for i in range(N)]
+    )
+    return float(ks @ alpha)
+
+
+class TestGaussianProcess:
+    def _parity(self, kernel_xml, kind, gamma, noise, lam, degree=1.0, n=64):
+        doc = parse_pmml(GP.format(kernel=kernel_xml))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(3)
+        X = rng.normal(0, 1, size=(n, 2))
+        recs = [{"x1": float(a), "x2": float(b)} for a, b in X]
+        preds = cm.score_records(recs)
+        for rec, p, x in zip(recs, preds, X):
+            o = evaluate(doc, rec)
+            hand = _hand_gp(kind, x, gamma, noise, lam, degree)
+            assert not p.is_empty
+            assert o.value == pytest.approx(hand, rel=1e-9)
+            assert p.score.value == pytest.approx(hand, rel=2e-4, abs=1e-5)
+
+    def test_radial_basis(self):
+        self._parity(
+            '<RadialBasisKernel gamma="2.0" noiseVariance="0.1" '
+            'lambda="1.3"/>',
+            "sq", 2.0, 0.1, [1.3, 1.3],
+        )
+
+    def test_ard_squared_exponential(self):
+        self._parity(
+            '<ARDSquaredExponentialKernel gamma="1.5" noiseVariance="0.2">'
+            '<Lambda><Array n="2" type="real">0.8 2.0</Array></Lambda>'
+            "</ARDSquaredExponentialKernel>",
+            "sq", 1.5, 0.2, [0.8, 2.0],
+        )
+
+    def test_absolute_exponential(self):
+        self._parity(
+            '<AbsoluteExponentialKernel gamma="1.0" noiseVariance="0.05">'
+            '<Lambda><Array n="2" type="real">1.0 0.5</Array></Lambda>'
+            "</AbsoluteExponentialKernel>",
+            "abs", 1.0, 0.05, [1.0, 0.5],
+        )
+
+    def test_generalized_exponential(self):
+        self._parity(
+            '<GeneralizedExponentialKernel gamma="1.2" noiseVariance="0.1" '
+            'degree="1.5"><Lambda><Array n="2" type="real">1.1 0.9</Array>'
+            "</Lambda></GeneralizedExponentialKernel>",
+            "gen", 1.2, 0.1, [1.1, 0.9], degree=1.5,
+        )
+
+    def test_missing_input_empty_lane(self):
+        doc = parse_pmml(GP.format(
+            kernel='<RadialBasisKernel gamma="1.0" noiseVariance="0.1" '
+                   'lambda="1.0"/>'
+        ))
+        cm = compile_pmml(doc)
+        p = cm.score_records([{"x1": 0.5, "x2": None}])[0]
+        assert p.is_empty
+        assert evaluate(doc, {"x1": 0.5, "x2": None}).value is None
+
+    def test_bad_documents(self):
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(GP.format(kernel=""))  # no kernel element
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(GP.format(
+                kernel='<RadialBasisKernel gamma="1" noiseVariance="0.1" '
+                       'lambda="-2"/>'
+            ))
+        # the isotropic kernel must not accept a per-dimension Lambda
+        # (compiled/oracle would disagree on which scale applies)
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(GP.format(
+                kernel='<RadialBasisKernel gamma="1" noiseVariance="0.1">'
+                       '<Lambda><Array n="2" type="real">0.5 3.0</Array>'
+                       "</Lambda></RadialBasisKernel>"
+            ))
+        # a typo'd InstanceField leaves an active field without a column:
+        # rejected, never silently dropped from the kernel inputs
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(GP.format(
+                kernel='<RadialBasisKernel gamma="1" noiseVariance="0.1" '
+                       'lambda="1"/>'
+            ).replace('<InstanceField field="x1" column="x1"/>',
+                      '<InstanceField field="x_1" column="x1"/>'))
+
+
+# ---------------------------------------------------------------------------
+# BaselineModel
+# ---------------------------------------------------------------------------
+
+BASELINE = """<PMML version="4.2"><DataDictionary>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <BaselineModel functionName="regression">
+  <MiningSchema><MiningField name="x"/></MiningSchema>
+  <TestDistributions field="x" testStatistic="zValue">
+    <Baseline>{dist}</Baseline>
+  </TestDistributions>
+  </BaselineModel></PMML>"""
+
+
+class TestBaseline:
+    @pytest.mark.parametrize(
+        "dist,mean,sd",
+        [
+            ('<GaussianDistribution mean="5.0" variance="4.0"/>', 5.0, 2.0),
+            ('<PoissonDistribution mean="9.0"/>', 9.0, 3.0),
+            (
+                '<UniformDistribution lower="2.0" upper="8.0"/>',
+                5.0,
+                math.sqrt(36.0 / 12.0),
+            ),
+        ],
+    )
+    def test_zvalue(self, dist, mean, sd):
+        doc = parse_pmml(BASELINE.format(dist=dist))
+        cm = compile_pmml(doc)
+        xs = [0.0, 3.5, 5.0, 11.25]
+        preds = cm.score_records([{"x": v} for v in xs])
+        for v, p in zip(xs, preds):
+            hand = (v - mean) / sd
+            assert p.score.value == pytest.approx(hand, rel=1e-6, abs=1e-6)
+            assert evaluate(doc, {"x": v}).value == pytest.approx(hand)
+
+    def test_missing_and_rejections(self):
+        doc = parse_pmml(BASELINE.format(
+            dist='<GaussianDistribution mean="0" variance="1"/>'
+        ))
+        cm = compile_pmml(doc)
+        assert cm.score_records([{"x": None}])[0].is_empty
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(BASELINE.format(dist="").replace(
+                'testStatistic="zValue"', 'testStatistic="CUSUM"'
+            ))
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(BASELINE.format(
+                dist='<GaussianDistribution mean="0" variance="0"/>'
+            ))
+
+
+# ---------------------------------------------------------------------------
+# AssociationModel
+# ---------------------------------------------------------------------------
+
+ASSOC = """<PMML version="4.2"><DataDictionary>
+  <DataField name="beer" optype="continuous" dataType="double"/>
+  <DataField name="chips" optype="continuous" dataType="double"/>
+  <DataField name="wine" optype="continuous" dataType="double"/>
+  <DataField name="bread" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <AssociationModel functionName="associationRules"
+      numberOfTransactions="1000" numberOfItems="4"
+      minimumSupport="0.1" minimumConfidence="0.5"
+      numberOfItemsets="5" numberOfRules="3">
+  <MiningSchema>
+    <MiningField name="beer"/><MiningField name="chips"/>
+    <MiningField name="wine"/><MiningField name="bread"/>
+  </MiningSchema>
+  <Item id="1" value="beer"/><Item id="2" value="chips"/>
+  <Item id="3" value="wine"/><Item id="4" value="bread"/>
+  <Itemset id="s1"><ItemRef itemRef="1"/></Itemset>
+  <Itemset id="s2"><ItemRef itemRef="2"/></Itemset>
+  <Itemset id="s3"><ItemRef itemRef="1"/><ItemRef itemRef="2"/></Itemset>
+  <Itemset id="s4"><ItemRef itemRef="3"/></Itemset>
+  <Itemset id="s5"><ItemRef itemRef="4"/></Itemset>
+  <AssociationRule id="r1" support="0.4" confidence="0.7"
+      antecedent="s1" consequent="s2"/>
+  <AssociationRule id="r2" support="0.2" confidence="0.9"
+      antecedent="s3" consequent="s5"/>
+  <AssociationRule id="r3" support="0.3" confidence="0.7"
+      antecedent="s4" consequent="s1"/>
+  </AssociationModel></PMML>"""
+
+
+def _basket(**kw):
+    rec = {"beer": 0.0, "chips": 0.0, "wine": 0.0, "bread": 0.0}
+    rec.update({k: 1.0 for k in kw if kw[k]})
+    return rec
+
+
+class TestAssociation:
+    def _one(self, cm, doc, rec):
+        p = cm.score_records([rec])[0]
+        o = evaluate(doc, rec)
+        if p.is_empty:
+            assert o.value is None
+            return None
+        assert p.score.value == pytest.approx(o.value, rel=1e-6)
+        assert p.target.label == o.label
+        if not doc.output_fields:
+            # no <Output>: both paths surface the winner's rule metadata
+            assert p.outputs == o.outputs
+        return p
+
+    def test_firing_and_ranking(self):
+        # spec-default criterion: exclusiveRecommendation
+        doc = parse_pmml(ASSOC)
+        assert doc.model.criterion == "exclusiveRecommendation"
+        cm = compile_pmml(doc)
+        # {beer}: only r1 fires (chips not yet held) → chips @ 0.7
+        p = self._one(cm, doc, _basket(beer=1))
+        assert p.target.label == "chips" and p.score.value == pytest.approx(0.7)
+        # {beer, chips}: r1 excluded (consequent already held), r2 fires
+        p = self._one(cm, doc, _basket(beer=1, chips=1))
+        assert p.target.label == "bread" and p.score.value == pytest.approx(0.9)
+        # {wine}: r3 → beer
+        p = self._one(cm, doc, _basket(wine=1))
+        assert p.target.label == "beer"
+        # {beer, wine}: r3 excluded (beer already held) → r1 → chips
+        p = self._one(cm, doc, _basket(beer=1, wine=1))
+        assert p.target.label == "chips"
+        # empty basket: nothing fires → empty lane
+        assert self._one(cm, doc, _basket()) is None
+
+    def test_criteria(self):
+        # JPMML-parity semantics per criterion on basket {beer, chips}:
+        # r1 beer→chips: "rule" needs the whole rule in the basket (it
+        # is) and r2's consequent bread is absent, so "rule" picks r1;
+        # "recommendation" ignores consequents → highest-confidence r2;
+        # "exclusiveRecommendation" drops r1 (consequent held) → r2
+        doc = parse_pmml(ASSOC)
+        basket = _basket(beer=1, chips=1)
+        for criterion, expect, conf in (
+            ("rule", "chips", 0.7),
+            ("recommendation", "bread", 0.9),
+            ("exclusiveRecommendation", "bread", 0.9),
+        ):
+            m = dataclasses.replace(doc.model, criterion=criterion)
+            d = dataclasses.replace(doc, model=m)
+            p = self._one(compile_pmml(d), d, basket)
+            assert p.target.label == expect, criterion
+            assert p.score.value == pytest.approx(conf), criterion
+        # "rule" on {beer} alone: consequent chips missing → nothing fires
+        m = dataclasses.replace(doc.model, criterion="rule")
+        d = dataclasses.replace(doc, model=m)
+        assert self._one(compile_pmml(d), d, _basket(beer=1)) is None
+
+    def test_missing_columns_read_absent(self):
+        doc = parse_pmml(ASSOC)
+        cm = compile_pmml(doc)
+        rec = {"beer": 1.0, "chips": None, "wine": None, "bread": None}
+        p = self._one(cm, doc, rec)
+        assert p is not None and p.target.label == "chips"
+
+    def test_items_must_be_fields(self):
+        bad = ASSOC.replace('<MiningField name="bread"/>', "")
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(bad)
+
+    def test_empty_consequent_rejected_at_parse(self):
+        bad = ASSOC.replace(
+            '<Itemset id="s5"><ItemRef itemRef="4"/></Itemset>',
+            '<Itemset id="s5"/>',
+        )
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(bad)
+
+    def test_criterion_from_output_algorithm(self):
+        # the ranking criterion rides <Output><OutputField algorithm=…>
+        xml = ASSOC.replace(
+            "</AssociationModel>",
+            '<Output><OutputField name="rec" feature="ruleValue" '
+            'algorithm="exclusiveRecommendation" ruleFeature="consequent"/>'
+            "</Output></AssociationModel>",
+        )
+        doc = parse_pmml(xml)
+        assert doc.model.criterion == "exclusiveRecommendation"
+        cm = compile_pmml(doc)
+        # {beer, chips}: r1 excluded (consequent chips already in basket),
+        # r2 fires → bread
+        p = self._one(cm, doc, _basket(beer=1, chips=1))
+        assert p.target.label == "bread"
+        assert p.outputs["rec"] == "bread"
+
+    def test_rule_value_outputs_parity(self):
+        xml = ASSOC.replace(
+            "</AssociationModel>",
+            "<Output>"
+            '<OutputField name="rid" feature="ruleValue" ruleFeature="ruleId"/>'
+            '<OutputField name="sup" feature="ruleValue" ruleFeature="support"/>'
+            '<OutputField name="ante" feature="ruleValue" ruleFeature="antecedent"/>'
+            '<OutputField name="rl" feature="ruleValue" ruleFeature="rule"/>'
+            "</Output></AssociationModel>",
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = _basket(beer=1, chips=1)  # r2 wins
+        p = cm.score_records([rec])[0]
+        o = evaluate(doc, rec)
+        assert p.outputs == o.outputs
+        assert p.outputs["rid"] == "r2"
+        assert p.outputs["sup"] == pytest.approx(0.2)
+        assert p.outputs["ante"] == "beer chips"
+        assert p.outputs["rl"] == "{beer chips}->{bread}"

@@ -254,3 +254,24 @@ class TestNewFamiliesSharded:
         from tests.test_anomaly import _iforest_xml
 
         self._check(_iforest_xml(), 1)
+
+    def test_gp_sharded(self):
+        from tests.test_gp_baseline_assoc import GP
+
+        self._check(GP.format(
+            kernel='<RadialBasisKernel gamma="1.5" noiseVariance="0.1" '
+                   'lambda="1.1"/>'
+        ), 2)
+
+    def test_baseline_sharded(self):
+        from tests.test_gp_baseline_assoc import BASELINE
+
+        self._check(BASELINE.format(
+            dist='<GaussianDistribution mean="2.0" variance="9.0"/>'
+        ), 1)
+
+    def test_association_sharded(self):
+        from tests.test_gp_baseline_assoc import ASSOC
+
+        # integer-ish basket indicators: >0.5 ⇔ in basket
+        self._check(ASSOC, 4, seed=5)
