@@ -101,8 +101,16 @@ def _run_child(args, force_cpu: bool, timeout_s: float):
             _child_cmd(args, force_cpu),
             capture_output=True, text=True, timeout=timeout_s, env=env,
         )
-    except subprocess.TimeoutExpired:
-        return None, f"measurement exceeded {timeout_s:.0f}s"
+    except subprocess.TimeoutExpired as e:
+        # the killed child's stderr tail says WHERE it wedged (stage
+        # stamps + FJT_BENCH_TRACE faulthandler dumps land there)
+        tail = ""
+        if e.stderr:
+            err = e.stderr
+            if isinstance(err, bytes):
+                err = err.decode("utf-8", "replace")
+            tail = ": " + err.strip()[-400:]
+        return None, f"measurement exceeded {timeout_s:.0f}s{tail}"
     except OSError as e:
         return None, f"child spawn failed: {e}"
     for ln in reversed((r.stdout or "").strip().splitlines()):
@@ -183,6 +191,22 @@ def main() -> None:
 
     metric = f"gbm{args.trees}_records_per_sec_per_chip"
 
+    # stage stamps + optional periodic all-thread stack dumps: a wedged
+    # device interaction (tunneled TPU) becomes diagnosable from the
+    # parent's captured stderr instead of an opaque timeout
+    trace = bool(os.environ.get("FJT_BENCH_TRACE"))
+    if trace:
+        import faulthandler
+
+        faulthandler.dump_traceback_later(60, repeat=True, file=sys.stderr)
+    t_start = time.time()
+
+    def stage(msg: str) -> None:
+        print(f"[bench +{time.time() - t_start:6.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    stage("importing jax")
+
     import jax
 
     if args.force_cpu:
@@ -194,6 +218,7 @@ def main() -> None:
     import numpy as np
 
     backend = jax.default_backend()
+    stage(f"backend resolved: {backend}")
 
     def quantiles(lats):
         if not lats:
@@ -250,6 +275,7 @@ def main() -> None:
             n_features=args.features,
         )
     doc = parse_pmml_file(pmml)
+    stage("model generated + parsed")
 
     B, C, F = args.batch, args.chunk, args.features
     K = B // C  # batch was normalized to a multiple of chunk above
@@ -260,6 +286,7 @@ def main() -> None:
     ]
 
     cm = compile_pmml(doc, batch_size=C)
+    stage("lowered (host)")
 
     if args.block_pipeline:
         # the production path: f32 blocks → C++ ring → bucketizer →
@@ -350,7 +377,9 @@ def main() -> None:
     enc_pool = ThreadPoolExecutor(max_workers=2)
 
     # warm: compile + first transfers (excluded from the measurement)
+    stage("warmup: first compile + transfers")
     warm = np.asarray(run(params, jax.device_put(encode(pool_f32[0]))))
+    stage("warm done; measuring")
     assert warm.shape == (B,) and np.isfinite(
         warm.astype(np.float32)
     ).all(), "warmup produced non-finite scores"
@@ -375,9 +404,14 @@ def main() -> None:
             encoded.append(
                 enc_pool.submit(encode, pool_f32[(i + PRE) % len(pool_f32)])
             )
-            inflight.append(
-                (run(params, jax.device_put(Xq)), time.perf_counter())
-            )
+            out = run(params, jax.device_put(Xq))
+            # queue the D2H copy now so the later np.asarray finds it done
+            # (overlaps the readback with the next batch's host work)
+            try:
+                out.copy_to_host_async()
+            except AttributeError:
+                pass
+            inflight.append((out, time.perf_counter()))
             i += 1
         while len(inflight) > (args.window if now < deadline else 0):
             out, t_sub = inflight.popleft()
@@ -388,20 +422,31 @@ def main() -> None:
     enc_pool.shutdown(wait=False)
     rate = done_records / dt
     p50, p99 = quantiles(lats)
+    stage(f"pipelined measurement done: {rate:,.0f} rec/s")
 
     # pure device-side rate: batch already resident, no host link in the
-    # loop — separates chip capability from the (possibly tunneled) link
+    # loop — separates chip capability from the (possibly tunneled) link.
+    # Completion-counted with a 2-deep in-flight window: an unthrottled
+    # dispatch loop would queue minutes of executions on a slow backend
+    # and then hang in the final block_until_ready (the round-3 bench
+    # timeout on both TPU and CPU was exactly that).
     Xq_dev = jax.device_put(encode(pool_f32[0]))
     jax.block_until_ready(run(params, Xq_dev))
     reps = 0
-    out = None
+    pending = collections.deque()
     t1 = time.perf_counter()
     dev_deadline = t1 + min(3.0, args.seconds)
-    while time.perf_counter() < dev_deadline:
-        out = run(params, Xq_dev)
-        reps += 1
-    jax.block_until_ready(out)
+    while True:
+        dispatching = time.perf_counter() < dev_deadline
+        if not dispatching and not pending:
+            break
+        if dispatching:
+            pending.append(run(params, Xq_dev))
+        while len(pending) > (2 if dispatching else 0):
+            jax.block_until_ready(pending.popleft())
+            reps += 1
     dev_rate = reps * B / (time.perf_counter() - t1)
+    stage(f"device-resident measurement done: {dev_rate:,.0f} rec/s")
 
     line = {
         "metric": metric,

@@ -88,20 +88,21 @@ class QuantizedWire:
     def bytes_per_record(self) -> int:
         return len(self.fields) * np.dtype(self.dtype).itemsize
 
-    def _flat_tables(self):
-        """(cuts_flat f32, offsets i32[F+1]) for the native bucketizer."""
-        cached = getattr(self, "_flat_cache", None)
+    def _pow2_tables(self):
+        """(+inf-padded [F, L] f32 table, L) for the lockstep bucketizer;
+        L = next power of two ≥ the longest per-feature cut table. Ranks
+        are unchanged by +inf pads (a pad is never < any finite x)."""
+        cached = getattr(self, "_pow2_cache", None)
         if cached is None:
-            offs = np.zeros((len(self.cuts) + 1,), np.int32)
+            m = max((len(c) for c in self.cuts), default=0)
+            L = 1
+            while L < max(m, 1):
+                L <<= 1
+            padded = np.full((len(self.cuts), L), np.inf, np.float32)
             for j, c in enumerate(self.cuts):
-                offs[j + 1] = offs[j] + len(c)
-            flat = (
-                np.concatenate(self.cuts).astype(np.float32)
-                if offs[-1]
-                else np.empty((0,), np.float32)
-            )
-            cached = (flat, offs)
-            object.__setattr__(self, "_flat_cache", cached)
+                padded[j, : len(c)] = c
+            cached = (np.ascontiguousarray(padded), L)
+            object.__setattr__(self, "_pow2_cache", cached)
         return cached
 
     def encode(
@@ -116,11 +117,11 @@ class QuantizedWire:
         """
         from flink_jpmml_tpu.runtime import native
 
-        flat, offs = self._flat_tables()
-        out = native.bucketize(
+        padded, L = self._pow2_tables()
+        out = native.bucketize_pow2(
             X,
-            flat,
-            offs,
+            padded,
+            L,
             self.repl,
             self.has_repl.astype(np.uint8),
             self.dtype,

@@ -123,8 +123,8 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,  # idle_timeout_us (-1 = wait indefinitely)
         ]
         for name, code_t in (
-            ("fjt_bucketize_u8", ctypes.c_uint8),
-            ("fjt_bucketize_u16", ctypes.c_uint16),
+            ("fjt_bucketize_pow2_u8", ctypes.c_uint8),
+            ("fjt_bucketize_pow2_u16", ctypes.c_uint16),
         ):
             fn = getattr(lib, name)
             fn.restype = None
@@ -132,8 +132,8 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_float),   # X
                 ctypes.c_uint64,                  # n
                 ctypes.c_uint32,                  # f
-                ctypes.POINTER(ctypes.c_float),   # cuts
-                ctypes.POINTER(ctypes.c_int32),   # offs
+                ctypes.POINTER(ctypes.c_float),   # cuts [f*L], +inf padded
+                ctypes.c_uint32,                  # L (power of two)
                 ctypes.POINTER(ctypes.c_float),   # repl
                 ctypes.POINTER(ctypes.c_uint8),   # has_repl
                 ctypes.POINTER(ctypes.c_uint8),   # mask (nullable)
@@ -224,21 +224,20 @@ class NativeRing:
             self._handle = None
 
 
-def bucketize(
+def bucketize_pow2(
     X: np.ndarray,
-    cuts_flat: np.ndarray,
-    offs: np.ndarray,
+    cuts_padded: np.ndarray,
+    L: int,
     repl: np.ndarray,
     has_repl: np.ndarray,
     out_dtype,
     mask: Optional[np.ndarray] = None,
     n_threads: int = 0,
 ) -> Optional[np.ndarray]:
-    """Multithreaded rank-wire featurization (see fjt_bucketize_* in C++).
-
-    Returns the [n, f] code array, or None when the native library is
-    unavailable (caller falls back to the numpy searchsorted path in
-    :meth:`flink_jpmml_tpu.compile.qtrees.QuantizedWire.encode`).
+    """Lockstep rank-wire featurization over +inf-padded [f, L] tables
+    (L a power of two) — ~2x the ragged-table path on one core because
+    the per-feature binary-search loads pipeline instead of serializing.
+    Same results as :func:`bucketize`; None when the library is missing.
     """
     lib = _load()
     if lib is None:
@@ -246,7 +245,11 @@ def bucketize(
     X = np.ascontiguousarray(X, np.float32)
     n, f = X.shape
     out = np.empty((n, f), out_dtype)
-    fn = lib.fjt_bucketize_u8 if out.itemsize == 1 else lib.fjt_bucketize_u16
+    fn = (
+        lib.fjt_bucketize_pow2_u8
+        if out.itemsize == 1
+        else lib.fjt_bucketize_pow2_u16
+    )
     code_t = ctypes.c_uint8 if out.itemsize == 1 else ctypes.c_uint16
     if mask is not None:
         mask = np.ascontiguousarray(mask, np.uint8)
@@ -257,8 +260,8 @@ def bucketize(
         X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         n,
         f,
-        cuts_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cuts_padded.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        L,
         repl.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         has_repl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         mask_ptr,

@@ -264,6 +264,26 @@ class TestWireFormat:
 
 
 class TestNativeBucketizer:
+    """The lockstep pow2 kernel (the ONE native encode path) vs the numpy
+    searchsorted reference — the same parity the fallback in
+    QuantizedWire.encode guarantees."""
+
+    @staticmethod
+    def _numpy_ref(w, X, M=None):
+        Xr = np.asarray(X, np.float32)
+        miss = np.isnan(Xr)
+        if M is not None:
+            miss = miss | M
+        if w.has_repl.any():
+            use = miss & w.has_repl[None, :]
+            Xr = np.where(use, w.repl[None, :], Xr)
+            miss = miss & ~w.has_repl[None, :]
+        ref = np.empty(Xr.shape, w.dtype)
+        for j, cuts in enumerate(w.cuts):
+            ref[:, j] = np.searchsorted(cuts, Xr[:, j], side="left")
+        ref[miss] = w.sentinel
+        return ref
+
     def test_native_matches_numpy(self, tmp_path):
         from flink_jpmml_tpu.runtime import native
 
@@ -271,21 +291,37 @@ class TestNativeBucketizer:
             pytest.skip(f"native plane unavailable: {native.build_error()}")
         doc = _gbm(tmp_path, n_trees=30, depth=5, n_features=12)
         q = build_quantized_scorer(doc)
+        w = q.wire
         rng = np.random.default_rng(8)
         X = _rand_X(rng, 4096, 12, missing_rate=0.15)
-        flat, offs = q.wire._flat_tables()
-        got = native.bucketize(
-            X, flat, offs, q.wire.repl,
-            q.wire.has_repl.astype(np.uint8), q.wire.dtype,
+        # edge rows: exact cut hits, +/-inf, all-NaN
+        X[0, :] = [w.cuts[j][0] if len(w.cuts[j]) else 0.0 for j in range(12)]
+        X[1, :] = np.inf
+        X[2, :] = -np.inf
+        X[3, :] = np.nan
+        padded, L = w._pow2_tables()
+        assert L & (L - 1) == 0  # power of two
+        got = native.bucketize_pow2(
+            X, padded, L, w.repl, w.has_repl.astype(np.uint8), w.dtype
         )
-        # numpy reference (force the fallback path)
-        Xr = np.asarray(X, np.float32)
-        miss = np.isnan(Xr)
-        exp = np.empty(Xr.shape, q.wire.dtype)
-        for j, cuts in enumerate(q.wire.cuts):
-            exp[:, j] = np.searchsorted(cuts, Xr[:, j], side="left")
-        exp[miss] = q.wire.sentinel
-        np.testing.assert_array_equal(got, exp)
+        np.testing.assert_array_equal(got, self._numpy_ref(w, X))
+
+    def test_native_randomized_table_shapes(self, tmp_path):
+        """Sweep ensemble shapes so L covers several powers of two."""
+        from flink_jpmml_tpu.runtime import native
+
+        if not native.available():
+            pytest.skip("native plane unavailable")
+        rng = np.random.default_rng(11)
+        for trees, depth, f in ((1, 2, 3), (5, 3, 4), (60, 6, 6)):
+            doc = _gbm(tmp_path, n_trees=trees, depth=depth, n_features=f)
+            w = build_quantized_scorer(doc).wire
+            X = _rand_X(rng, 512, f, missing_rate=0.2)
+            padded, L = w._pow2_tables()
+            got = native.bucketize_pow2(
+                X, padded, L, w.repl, w.has_repl.astype(np.uint8), w.dtype
+            )
+            np.testing.assert_array_equal(got, self._numpy_ref(w, X))
 
     def test_native_mask_and_single_thread(self, tmp_path):
         from flink_jpmml_tpu.runtime import native
@@ -294,17 +330,18 @@ class TestNativeBucketizer:
             pytest.skip("native plane unavailable")
         doc = _gbm(tmp_path)
         q = build_quantized_scorer(doc)
+        w = q.wire
         X = np.zeros((8, 8), np.float32)
         M = np.zeros((8, 8), bool)
         M[2, 3] = True
-        flat, offs = q.wire._flat_tables()
-        got = native.bucketize(
-            X, flat, offs, q.wire.repl,
-            q.wire.has_repl.astype(np.uint8), q.wire.dtype,
+        padded, L = w._pow2_tables()
+        got = native.bucketize_pow2(
+            X, padded, L, w.repl, w.has_repl.astype(np.uint8), w.dtype,
             mask=M, n_threads=1,
         )
-        assert got[2, 3] == q.wire.sentinel
-        assert (got[0] != q.wire.sentinel).all()
+        assert got[2, 3] == w.sentinel
+        assert (got[0] != w.sentinel).all()
+        np.testing.assert_array_equal(got, self._numpy_ref(w, X, M))
 
 
 def _forest_xml(method="majorityVote", weighted=False, n_trees=7, seed=21):
