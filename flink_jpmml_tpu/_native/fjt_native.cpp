@@ -193,8 +193,11 @@ uint32_t fjt_ring_drain(Ring* r, float* out, uint64_t* out_offsets,
 // host keeps ahead of the device at >1M records/s.
 //
 //   X        [n, f] row-major f32
-//   cuts     [f, L] per-feature sorted cut tables, +inf-padded to a
-//            shared power-of-two length L
+//   cuts     two layouts, one per entry-point family:
+//            fjt_bucketize_*      — ragged: concatenated per-feature sorted
+//                                   tables + offs[f+1] int32 offsets
+//            fjt_bucketize_pow2_* — [f, L] rows, +inf-padded to a shared
+//                                   power-of-two length L (no offs)
 //   repl     [f] f32 missing-value replacement (used where has_repl)
 //   has_repl [f] u8
 //   mask     [n, f] u8 missing mask, may be null (NaN always = missing)
@@ -202,6 +205,80 @@ uint32_t fjt_ring_drain(Ring* r, float* out, uint64_t* out_offsets,
 // ---------------------------------------------------------------------------
 
 namespace {
+
+// Shared row-range fan-out: clamp thread count (spawn/join costs ~100us a
+// thread — keep >=4096 rows each) and run `rows` over [0, n) partitions.
+template <typename RowsFn>
+void fan_out_rows(uint64_t n, uint32_t n_threads, const RowsFn& rows) {
+    if (n_threads == 0) {
+        unsigned hw = std::thread::hardware_concurrency();
+        n_threads = hw ? hw : 4;
+    }
+    uint64_t max_useful = (n + 4095) / 4096;
+    if (n_threads > max_useful) n_threads = static_cast<uint32_t>(max_useful);
+    if (n_threads == 0) n_threads = 1;
+    if (n_threads <= 1) {
+        rows(uint64_t(0), n);
+        return;
+    }
+    std::vector<std::thread> ts;
+    ts.reserve(n_threads);
+    uint64_t per = (n + n_threads - 1) / n_threads;
+    for (uint32_t t = 0; t < n_threads; ++t) {
+        uint64_t b = t * per, e = b + per < n ? b + per : n;
+        if (b >= e) break;
+        ts.emplace_back(rows, b, e);
+    }
+    for (auto& t : ts) t.join();
+}
+
+template <typename Code>
+void bucketize_rows(const float* X, uint64_t row_begin, uint64_t row_end,
+                    uint32_t f, const float* cuts, const int32_t* offs,
+                    const float* repl, const uint8_t* has_repl,
+                    const uint8_t* mask, Code* out) {
+    const Code sentinel = static_cast<Code>(~Code(0));
+    for (uint64_t i = row_begin; i < row_end; ++i) {
+        const float* row = X + i * f;
+        const uint8_t* mrow = mask ? mask + i * f : nullptr;
+        Code* orow = out + i * f;
+        for (uint32_t j = 0; j < f; ++j) {
+            float x = row[j];
+            bool miss = (x != x) || (mrow && mrow[j]);
+            if (miss) {
+                if (has_repl[j]) {
+                    x = repl[j];
+                } else {
+                    orow[j] = sentinel;
+                    continue;
+                }
+            }
+            // branchless lower_bound: rank = #{c < x}. The `* half` form
+            // compiles to cmov — no data-dependent branches, which is worth
+            // ~5x on random inputs (every branch would mispredict).
+            const float* start = cuts + offs[j];
+            const float* lo = start;
+            uint32_t len = static_cast<uint32_t>(offs[j + 1] - offs[j]);
+            while (len > 1) {
+                uint32_t half = len / 2;
+                lo += (lo[half - 1] < x) * half;
+                len -= half;
+            }
+            orow[j] = static_cast<Code>((lo - start) + (len && lo[0] < x));
+        }
+    }
+}
+
+template <typename Code>
+void bucketize_impl(const float* X, uint64_t n, uint32_t f, const float* cuts,
+                    const int32_t* offs, const float* repl,
+                    const uint8_t* has_repl, const uint8_t* mask, Code* out,
+                    uint32_t n_threads) {
+    fan_out_rows(n, n_threads, [&](uint64_t b, uint64_t e) {
+        bucketize_rows<Code>(X, b, e, f, cuts, offs, repl, has_repl, mask,
+                             out);
+    });
+}
 
 // Lockstep variant over power-of-two padded tables (cuts[j*L .. j*L+L),
 // padded with +inf which never counts toward a rank). The per-feature
@@ -255,30 +332,10 @@ void bucketize_pow2_impl(const float* X, uint64_t n, uint32_t f,
                          const float* cuts, uint32_t L, const float* repl,
                          const uint8_t* has_repl, const uint8_t* mask,
                          Code* out, uint32_t n_threads) {
-    if (n_threads == 0) {
-        unsigned hw = std::thread::hardware_concurrency();
-        n_threads = hw ? hw : 4;
-    }
-    // spawn/join costs ~100us per thread — keep >=4096 rows per thread so
-    // small batches never pay more in thread churn than in ranking work
-    uint64_t max_useful = (n + 4095) / 4096;
-    if (n_threads > max_useful) n_threads = static_cast<uint32_t>(max_useful);
-    if (n_threads == 0) n_threads = 1;
-    if (n_threads <= 1) {
-        bucketize_rows_pow2<Code>(X, 0, n, f, cuts, L, repl, has_repl, mask,
+    fan_out_rows(n, n_threads, [&](uint64_t b, uint64_t e) {
+        bucketize_rows_pow2<Code>(X, b, e, f, cuts, L, repl, has_repl, mask,
                                   out);
-        return;
-    }
-    std::vector<std::thread> ts;
-    ts.reserve(n_threads);
-    uint64_t per = (n + n_threads - 1) / n_threads;
-    for (uint32_t t = 0; t < n_threads; ++t) {
-        uint64_t b = t * per, e = b + per < n ? b + per : n;
-        if (b >= e) break;
-        ts.emplace_back(bucketize_rows_pow2<Code>, X, b, e, f, cuts, L, repl,
-                        has_repl, mask, out);
-    }
-    for (auto& t : ts) t.join();
+    });
 }
 
 }  // namespace
@@ -299,6 +356,23 @@ void fjt_bucketize_pow2_u16(const float* X, uint64_t n, uint32_t f,
                             uint16_t* out, uint32_t n_threads) {
     bucketize_pow2_impl<uint16_t>(X, n, f, cuts, L, repl, has_repl, mask, out,
                                   n_threads);
+}
+
+void fjt_bucketize_u8(const float* X, uint64_t n, uint32_t f,
+                      const float* cuts, const int32_t* offs,
+                      const float* repl, const uint8_t* has_repl,
+                      const uint8_t* mask, uint8_t* out, uint32_t n_threads) {
+    bucketize_impl<uint8_t>(X, n, f, cuts, offs, repl, has_repl, mask, out,
+                            n_threads);
+}
+
+void fjt_bucketize_u16(const float* X, uint64_t n, uint32_t f,
+                       const float* cuts, const int32_t* offs,
+                       const float* repl, const uint8_t* has_repl,
+                       const uint8_t* mask, uint16_t* out,
+                       uint32_t n_threads) {
+    bucketize_impl<uint16_t>(X, n, f, cuts, offs, repl, has_repl, mask, out,
+                             n_threads);
 }
 
 }  // extern "C"

@@ -88,20 +88,49 @@ class QuantizedWire:
     def bytes_per_record(self) -> int:
         return len(self.fields) * np.dtype(self.dtype).itemsize
 
+    def _flat_tables(self):
+        """(cuts_flat f32, offsets i32[F+1]) for the ragged bucketizer."""
+        cached = getattr(self, "_flat_cache", None)
+        if cached is None:
+            offs = np.zeros((len(self.cuts) + 1,), np.int32)
+            for j, c in enumerate(self.cuts):
+                offs[j + 1] = offs[j] + len(c)
+            flat = (
+                np.concatenate(self.cuts).astype(np.float32)
+                if offs[-1]
+                else np.empty((0,), np.float32)
+            )
+            cached = (flat, offs)
+            object.__setattr__(self, "_flat_cache", cached)
+        return cached
+
     def _pow2_tables(self):
-        """(+inf-padded [F, L] f32 table, L) for the lockstep bucketizer;
-        L = next power of two ≥ the longest per-feature cut table. Ranks
-        are unchanged by +inf pads (a pad is never < any finite x)."""
+        """(+inf-padded [F, L] f32 table, L) for the lockstep bucketizer,
+        or None when the padding blowup says the ragged path wins.
+
+        L = next power of two ≥ the longest per-feature cut table; ranks
+        are unchanged by +inf pads (a pad is never < any finite x). The
+        lockstep kernel makes EVERY feature pay L-depth rounds and
+        L-width memory, so it only pays off when cut counts are roughly
+        balanced (GBM exports are); one 4096-cut feature among tiny ones
+        would make every probe slower AND blow the padded table out of
+        L2 — those models take the ragged kernel."""
         cached = getattr(self, "_pow2_cache", None)
         if cached is None:
             m = max((len(c) for c in self.cuts), default=0)
+            total = sum(len(c) for c in self.cuts)
             L = 1
             while L < max(m, 1):
                 L <<= 1
-            padded = np.full((len(self.cuts), L), np.inf, np.float32)
-            for j, c in enumerate(self.cuts):
-                padded[j, : len(c)] = c
-            cached = (np.ascontiguousarray(padded), L)
+            n_f = max(len(self.cuts), 1)
+            blowup = (n_f * L) / max(total, 1)
+            if blowup > 4.0 and L > 64:
+                cached = (None, 0)  # skewed: ragged path
+            else:
+                padded = np.full((n_f, L), np.inf, np.float32)
+                for j, c in enumerate(self.cuts):
+                    padded[j, : len(c)] = c
+                cached = (np.ascontiguousarray(padded), L)
             object.__setattr__(self, "_pow2_cache", cached)
         return cached
 
@@ -118,15 +147,19 @@ class QuantizedWire:
         from flink_jpmml_tpu.runtime import native
 
         padded, L = self._pow2_tables()
-        out = native.bucketize_pow2(
-            X,
-            padded,
-            L,
-            self.repl,
-            self.has_repl.astype(np.uint8),
-            self.dtype,
-            mask=M,
-        )
+        if padded is not None:
+            out = native.bucketize_pow2(
+                X, padded, L,
+                self.repl, self.has_repl.astype(np.uint8), self.dtype,
+                mask=M,
+            )
+        else:  # skewed cut tables: ragged kernel (see _pow2_tables)
+            flat, offs = self._flat_tables()
+            out = native.bucketize(
+                X, flat, offs,
+                self.repl, self.has_repl.astype(np.uint8), self.dtype,
+                mask=M,
+            )
         if out is not None:
             return out
         X = np.asarray(X, np.float32)

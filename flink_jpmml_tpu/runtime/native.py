@@ -123,6 +123,24 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,  # idle_timeout_us (-1 = wait indefinitely)
         ]
         for name, code_t in (
+            ("fjt_bucketize_u8", ctypes.c_uint8),
+            ("fjt_bucketize_u16", ctypes.c_uint16),
+        ):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_float),   # X
+                ctypes.c_uint64,                  # n
+                ctypes.c_uint32,                  # f
+                ctypes.POINTER(ctypes.c_float),   # cuts (ragged, concat)
+                ctypes.POINTER(ctypes.c_int32),   # offs [f+1]
+                ctypes.POINTER(ctypes.c_float),   # repl
+                ctypes.POINTER(ctypes.c_uint8),   # has_repl
+                ctypes.POINTER(ctypes.c_uint8),   # mask (nullable)
+                ctypes.POINTER(code_t),           # out
+                ctypes.c_uint32,                  # n_threads
+            ]
+        for name, code_t in (
             ("fjt_bucketize_pow2_u8", ctypes.c_uint8),
             ("fjt_bucketize_pow2_u16", ctypes.c_uint16),
         ):
@@ -224,6 +242,51 @@ class NativeRing:
             self._handle = None
 
 
+def bucketize(
+    X: np.ndarray,
+    cuts_flat: np.ndarray,
+    offs: np.ndarray,
+    repl: np.ndarray,
+    has_repl: np.ndarray,
+    out_dtype,
+    mask: Optional[np.ndarray] = None,
+    n_threads: int = 0,
+) -> Optional[np.ndarray]:
+    """Ragged-table rank-wire featurization (branchless per-feature
+    lower_bound). The skew-robust fallback: memory and per-feature
+    search depth follow each feature's OWN cut count, so one long table
+    doesn't tax the others (cf. :func:`bucketize_pow2`). Returns the
+    [n, f] code array, or None when the native library is unavailable
+    (caller falls back to numpy searchsorted — identical semantics).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    X = np.ascontiguousarray(X, np.float32)
+    n, f = X.shape
+    out = np.empty((n, f), out_dtype)
+    fn = lib.fjt_bucketize_u8 if out.itemsize == 1 else lib.fjt_bucketize_u16
+    code_t = ctypes.c_uint8 if out.itemsize == 1 else ctypes.c_uint16
+    if mask is not None:
+        mask = np.ascontiguousarray(mask, np.uint8)
+        mask_ptr = mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    else:
+        mask_ptr = ctypes.POINTER(ctypes.c_uint8)()
+    fn(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        f,
+        cuts_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        repl.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        has_repl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mask_ptr,
+        out.ctypes.data_as(ctypes.POINTER(code_t)),
+        n_threads,
+    )
+    return out
+
+
 def bucketize_pow2(
     X: np.ndarray,
     cuts_padded: np.ndarray,
@@ -235,9 +298,12 @@ def bucketize_pow2(
     n_threads: int = 0,
 ) -> Optional[np.ndarray]:
     """Lockstep rank-wire featurization over +inf-padded [f, L] tables
-    (L a power of two) — ~2x the ragged-table path on one core because
-    the per-feature binary-search loads pipeline instead of serializing.
-    Same results as :func:`bucketize`; None when the library is missing.
+    (L a power of two) — ~1.3-2x the ragged path on one core when cut
+    counts are balanced, because the per-feature binary-search loads
+    pipeline instead of serializing. Every feature pays L-depth rounds
+    and L-width memory, so heavily skewed tables belong on
+    :func:`bucketize` instead (QuantizedWire.encode picks). Same results
+    as :func:`bucketize`; None when the library is missing.
     """
     lib = _load()
     if lib is None:

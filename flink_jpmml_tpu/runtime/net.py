@@ -5,9 +5,11 @@ the reference gets network ingestion from Flink's connector ecosystem
 (SURVEY.md §2 EXT-A). This module is the in-tree equivalent: a
 deliberately tiny Kafka-style *pull* protocol — offset-addressed fetch
 over TCP with length-prefixed frames — so sources get exact resume
-semantics without an external broker. A real Kafka consumer would slot in
-behind the same Source/BlockSource interfaces; this protocol is what the
-tests, examples and kill/resume drills run against.
+semantics without an external broker. The real Kafka-wire counterpart
+lives in :mod:`flink_jpmml_tpu.runtime.kafka` (actual binary protocol:
+Fetch v4, magic-2 record batches, CRC32C) behind the same
+Source/BlockSource interfaces; this simpler protocol remains for
+low-dependency drills and as the block-frame push server.
 
 Protocol (little-endian):
   client → server on connect:  magic ``b"FJT1"`` + u64 start_offset

@@ -323,6 +323,35 @@ class TestNativeBucketizer:
             )
             np.testing.assert_array_equal(got, self._numpy_ref(w, X))
 
+    def test_skewed_tables_take_ragged_path(self):
+        """One huge cut table among tiny ones: the pow2 dispatch bails
+        (padding blowup) and the ragged kernel produces identical ranks."""
+        from flink_jpmml_tpu.compile.qtrees import QuantizedWire
+        from flink_jpmml_tpu.runtime import native
+
+        if not native.available():
+            pytest.skip("native plane unavailable")
+        rng = np.random.default_rng(4)
+        F = 8
+        cuts = (np.sort(rng.normal(0, 5, size=900)).astype(np.float32),) + tuple(
+            np.sort(rng.normal(0, 5, size=int(k))).astype(np.float32)
+            for k in rng.integers(1, 4, size=F - 1)
+        )
+        w = QuantizedWire(
+            fields=tuple(f"f{i}" for i in range(F)),
+            cuts=cuts,
+            dtype=np.uint16,
+            sentinel=65535,
+            repl=np.zeros(F, np.float32),
+            has_repl=np.zeros(F, bool),
+        )
+        padded, L = w._pow2_tables()
+        assert padded is None  # skew heuristic chose ragged
+        X = rng.normal(0, 5, size=(2048, F)).astype(np.float32)
+        X[0, 0] = np.nan
+        got = w.encode(X)
+        np.testing.assert_array_equal(got, self._numpy_ref(w, X))
+
     def test_native_mask_and_single_thread(self, tmp_path):
         from flink_jpmml_tpu.runtime import native
 
