@@ -271,6 +271,104 @@ ASSOC = """<PMML version="4.2"><DataDictionary>
   </AssociationModel></PMML>"""
 
 
+TIMESERIES = """<PMML version="4.3"><DataDictionary>
+  <DataField name="h" optype="continuous" dataType="integer"/>
+  <DataField name="sales" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TimeSeriesModel functionName="timeSeries" bestFit="ExponentialSmoothing">
+  <MiningSchema><MiningField name="sales" usageType="target"/>
+    <MiningField name="h"/></MiningSchema>
+  <ExponentialSmoothing>
+    <Level alpha="0.3" smoothedValue="120.5"/>
+    <Trend_ExpoSmooth trend="damped_trend" gamma="0.1" smoothedValue="2.5"
+        phi="0.85"/>
+    <Seasonality_ExpoSmooth type="multiplicative" period="4" gamma="0.2">
+      <Array n="4" type="real">1.1 0.9 1.05 0.95</Array>
+    </Seasonality_ExpoSmooth>
+  </ExponentialSmoothing></TimeSeriesModel></PMML>"""
+
+BAYESNET = """<PMML version="4.3"><DataDictionary>
+  <DataField name="rain" optype="categorical" dataType="string">
+    <Value value="yes"/><Value value="no"/></DataField>
+  <DataField name="sprinkler" optype="categorical" dataType="string">
+    <Value value="on"/><Value value="off"/></DataField>
+  <DataField name="grass" optype="categorical" dataType="string">
+    <Value value="wet"/><Value value="dry"/></DataField>
+  </DataDictionary>
+  <BayesianNetworkModel functionName="classification">
+  <MiningSchema><MiningField name="rain" usageType="target"/>
+    <MiningField name="sprinkler"/><MiningField name="grass"/></MiningSchema>
+  <BayesianNetworkNodes>
+    <DiscreteNode name="rain">
+      <ValueProbability value="yes" probability="0.2"/>
+      <ValueProbability value="no" probability="0.8"/>
+    </DiscreteNode>
+    <DiscreteNode name="sprinkler">
+      <DiscreteConditionalProbability>
+        <ParentValue parent="rain" value="yes"/>
+        <ValueProbability value="on" probability="0.01"/>
+        <ValueProbability value="off" probability="0.99"/>
+      </DiscreteConditionalProbability>
+      <DiscreteConditionalProbability>
+        <ParentValue parent="rain" value="no"/>
+        <ValueProbability value="on" probability="0.4"/>
+        <ValueProbability value="off" probability="0.6"/>
+      </DiscreteConditionalProbability>
+    </DiscreteNode>
+    <DiscreteNode name="grass">
+      <DiscreteConditionalProbability>
+        <ParentValue parent="sprinkler" value="on"/>
+        <ParentValue parent="rain" value="yes"/>
+        <ValueProbability value="wet" probability="0.99"/>
+        <ValueProbability value="dry" probability="0.01"/>
+      </DiscreteConditionalProbability>
+      <DiscreteConditionalProbability>
+        <ParentValue parent="sprinkler" value="on"/>
+        <ParentValue parent="rain" value="no"/>
+        <ValueProbability value="wet" probability="0.9"/>
+        <ValueProbability value="dry" probability="0.1"/>
+      </DiscreteConditionalProbability>
+      <DiscreteConditionalProbability>
+        <ParentValue parent="sprinkler" value="off"/>
+        <ParentValue parent="rain" value="yes"/>
+        <ValueProbability value="wet" probability="0.8"/>
+        <ValueProbability value="dry" probability="0.2"/>
+      </DiscreteConditionalProbability>
+      <DiscreteConditionalProbability>
+        <ParentValue parent="sprinkler" value="off"/>
+        <ParentValue parent="rain" value="no"/>
+        <ValueProbability value="wet" probability="0.0"/>
+        <ValueProbability value="dry" probability="1.0"/>
+      </DiscreteConditionalProbability>
+    </DiscreteNode>
+  </BayesianNetworkNodes></BayesianNetworkModel></PMML>"""
+
+TEXTMODEL = """<PMML version="4.2"><DataDictionary>
+  <DataField name="ball" optype="continuous" dataType="double"/>
+  <DataField name="goal" optype="continuous" dataType="double"/>
+  <DataField name="oven" optype="continuous" dataType="double"/>
+  <DataField name="salt" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TextModel functionName="classification" numberOfTerms="4"
+      numberOfDocuments="2">
+  <MiningSchema>
+    <MiningField name="ball"/><MiningField name="goal"/>
+    <MiningField name="oven"/><MiningField name="salt"/>
+  </MiningSchema>
+  <TextDictionary><Array n="4" type="string">ball goal oven salt</Array>
+  </TextDictionary>
+  <TextCorpus><TextDocument id="sports"/><TextDocument id="cooking"/>
+  </TextCorpus>
+  <DocumentTermMatrix><Matrix>
+    <Array n="4" type="real">5 3 0 0</Array>
+    <Array n="4" type="real">0 0 4 6</Array>
+  </Matrix></DocumentTermMatrix>
+  <TextModelNormalization localTermWeights="logarithmic"
+      globalTermWeights="none" documentNormalization="cosine"/>
+  <TextModelSimilarity similarityType="cosine"/>
+  </TextModel></PMML>"""
+
+
 def main() -> None:
     workdir = tempfile.mkdtemp(prefix="fjt-zoo-")
     rng = np.random.default_rng(7)
@@ -297,6 +395,9 @@ def main() -> None:
         ("GaussianProcessModel (RBF)", GP, 2),
         ("BaselineModel (zValue)", BASELINE_Z, 1),
         ("AssociationModel (baskets)", ASSOC, 4),
+        ("TimeSeriesModel (Holt-Winters)", TIMESERIES, 1),
+        ("BayesianNetworkModel (sprinkler)", BAYESNET, 2),
+        ("TextModel (tf-idf cosine)", TEXTMODEL, 4),
     ]
     for i, (name, xml, arity) in enumerate(inline):
         path = str(pathlib.Path(workdir, f"zoo_{i}.pmml"))
@@ -308,9 +409,15 @@ def main() -> None:
         env = StreamEnvironment(
             RuntimeConfig(batch=BatchConfig(size=32, deadline_us=2000))
         )
-        vectors = rng.normal(0.5, 1.2, size=(64, arity)).astype(
-            np.float32
-        ).tolist()
+        if "Bayesian" in name:
+            # categorical inputs ride the dense path as value CODES
+            vectors = rng.integers(0, 2, size=(64, arity)).astype(
+                np.float32
+            ).tolist()
+        else:
+            vectors = rng.normal(0.5, 1.2, size=(64, arity)).astype(
+                np.float32
+            ).tolist()
         sink = env.from_collection(vectors).evaluate(
             ModelReader(path)
         ).collect()

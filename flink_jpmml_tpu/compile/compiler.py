@@ -92,6 +92,18 @@ def lower_model(model: ir.ModelIR, ctx: LowerCtx) -> Lowered:
         from flink_jpmml_tpu.compile.assoc import lower_association
 
         return lower_association(model, ctx)
+    if isinstance(model, ir.TimeSeriesIR):
+        from flink_jpmml_tpu.compile.timeseries import lower_time_series
+
+        return lower_time_series(model, ctx)
+    if isinstance(model, ir.BayesianNetworkIR):
+        from flink_jpmml_tpu.compile.bayesnet import lower_bayesian_network
+
+        return lower_bayesian_network(model, ctx)
+    if isinstance(model, ir.TextModelIR):
+        from flink_jpmml_tpu.compile.textmodel import lower_text_model
+
+        return lower_text_model(model, ctx)
     if isinstance(model, ir.MiningModelIR):
         return lower_mining(model, ctx)
     raise ModelCompilationException(

@@ -418,6 +418,12 @@ def _eval_model(model: ir.ModelIR, record: Record) -> EvalResult:
         return _eval_knn(model, record)
     if isinstance(model, ir.GaussianProcessIR):
         return _eval_gp(model, record)
+    if isinstance(model, ir.TimeSeriesIR):
+        return _eval_time_series(model, record)
+    if isinstance(model, ir.BayesianNetworkIR):
+        return _eval_bayesian_network(model, record)
+    if isinstance(model, ir.TextModelIR):
+        return _eval_text_model(model, record)
     if isinstance(model, ir.BaselineIR):
         return _eval_baseline(model, record)
     if isinstance(model, ir.AssociationIR):
@@ -1348,6 +1354,166 @@ def _eval_gp(model: ir.GaussianProcessIR, record: Record) -> EvalResult:
         a * _gp_kernel_value(model.kernel, xs, z)
         for a, z in zip(alpha, model.instances)
     ))
+
+
+def text_local_weight(v: List[float], kind: str) -> List[float]:
+    """PMML TextModelNormalization local term weights, shared by the
+    oracle and (semantically) the lowering's golden tests."""
+    if kind == "termFrequency":
+        return list(v)
+    if kind == "binary":
+        return [1.0 if x > 0 else 0.0 for x in v]
+    if kind == "logarithmic":
+        return [math.log10(1.0 + x) for x in v]
+    # augmentedNormalizedTermFrequency
+    m = max(v) if v else 0.0
+    if m <= 0:
+        return [0.0] * len(v)
+    return [0.5 + 0.5 * x / m if x > 0 else 0.0 for x in v]
+
+
+def _text_weight(vec, model: ir.TextModelIR, idf) -> List[float]:
+    w = [
+        a * b
+        for a, b in zip(text_local_weight(vec, model.local_weight), idf)
+    ]
+    if model.doc_normalization == "cosine":
+        n = math.sqrt(sum(x * x for x in w))
+        if n > 0:
+            w = [x / n for x in w]
+    return w
+
+
+@functools.lru_cache(maxsize=64)
+def _text_corpus_weights(model: ir.TextModelIR):
+    """(idf, weighted DTM rows) — model constants, computed once per
+    (hashable, frozen) model rather than per record."""
+    D = len(model.doc_ids)
+    if model.global_weight == "inverseDocumentFrequency":
+        idf = tuple(
+            math.log10(D / dj) if dj else 0.0
+            for dj in (
+                sum(1 for row in model.dtm if row[j] > 0)
+                for j in range(len(model.terms))
+            )
+        )
+    else:
+        idf = (1.0,) * len(model.terms)
+    rows = tuple(
+        tuple(_text_weight(list(row), model, idf)) for row in model.dtm
+    )
+    return idf, rows
+
+
+def _eval_text_model(model: ir.TextModelIR, record: Record) -> EvalResult:
+    q = []
+    for t in model.terms:
+        x = _as_float(record.get(t))
+        q.append(x if x is not None and x > 0 else 0.0)  # missing = 0
+
+    idf, doc_rows = _text_corpus_weights(model)
+    qw = _text_weight(q, model, idf)
+    nq = math.sqrt(sum(x * x for x in qw))
+    scores = {}
+    for did, dw in zip(model.doc_ids, doc_rows):
+        if model.similarity == "cosine":
+            nd = math.sqrt(sum(x * x for x in dw))
+            dot = sum(a * b for a, b in zip(qw, dw))
+            scores[did] = dot / (nq * nd) if nq > 0 and nd > 0 else 0.0
+        else:  # euclidean distance
+            scores[did] = math.sqrt(
+                sum((a - b) ** 2 for a, b in zip(qw, dw))
+            )
+    pick = max if model.similarity == "cosine" else min
+    win = pick(scores, key=scores.get)
+    return EvalResult(
+        value=scores[win], label=win, probabilities=scores
+    )
+
+
+def _eval_bayesian_network(
+    model: ir.BayesianNetworkIR, record: Record
+) -> EvalResult:
+    by_name = {n.name: n for n in model.nodes}
+    tnode = by_name[model.target]
+
+    def observed(name: str) -> Optional[str]:
+        v = record.get(name)
+        if _is_missing(v):
+            return None
+        node = by_name[name]
+        for val in node.values:
+            if _values_equal(v, val):
+                return val
+        return None  # unknown category: unmatchable
+
+    def row_probs(node: ir.BnNode, overrides: Dict[str, str]):
+        """CPT row whose parent config matches the (observed/overridden)
+        parent values; None when any parent is missing/unmatched."""
+        want = []
+        for p in node.parents:
+            val = overrides.get(p) if p in overrides else observed(p)
+            if val is None:
+                return None
+            want.append(val)
+        for config, probs in node.cpt:
+            if list(config) == want:
+                return probs
+        return None
+
+    # state-independent lookups hoisted out of the per-state loop
+    t_probs = row_probs(tnode, {})
+    if t_probs is None:
+        return EvalResult()
+    children = [
+        c
+        for c in model.nodes
+        if c.name != model.target and model.target in c.parents
+    ]
+    child_obs = {}
+    for child in children:
+        obs = observed(child.name)
+        if obs is None:
+            return EvalResult()
+        child_obs[child.name] = child.values.index(obs)
+
+    scores = []
+    for si, state in enumerate(tnode.values):
+        p = t_probs[si]
+        for child in children:
+            cprobs = row_probs(child, {model.target: state})
+            if cprobs is None:
+                return EvalResult()
+            p *= cprobs[child_obs[child.name]]
+        scores.append(p)
+    total = sum(scores)
+    if total <= 0:
+        return EvalResult()
+    probs_n = [s / total for s in scores]
+    wi = max(range(len(probs_n)), key=lambda i: probs_n[i])
+    return EvalResult(
+        value=probs_n[wi],
+        label=tnode.values[wi],
+        probabilities=dict(zip(tnode.values, probs_n)),
+    )
+
+
+def _eval_time_series(model: ir.TimeSeriesIR, record: Record) -> EvalResult:
+    hv = _as_float(record.get(model.horizon_field))
+    if hv is None:
+        return EvalResult()
+    h = max(int(round(hv)), 1)
+    s = model.smoothing
+    y = s.level
+    if s.trend_type == "additive":
+        y += h * s.trend
+    elif s.trend_type == "damped_trend":
+        # Σ_{i=1..h} φ^i = φ(1−φ^h)/(1−φ)
+        y += s.trend * s.phi * (1.0 - s.phi ** h) / (1.0 - s.phi)
+    if s.seasonal_type != "none":
+        factor = s.seasonal[(h - 1) % s.period]
+        y = y + factor if s.seasonal_type == "additive" else y * factor
+    return EvalResult(value=y)
 
 
 def _eval_baseline(model: ir.BaselineIR, record: Record) -> EvalResult:

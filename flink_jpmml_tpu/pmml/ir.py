@@ -716,6 +716,111 @@ class AssociationIR:
 
 
 # ---------------------------------------------------------------------------
+# TextModel
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TextModelIR:
+    """Document-similarity scoring over a term-frequency input.
+
+    The streaming contract is one active MiningField per term in
+    ``terms`` (the record's term counts; missing = 0). Scoring weights
+    the query and the stored DocumentTermMatrix rows identically
+    (local × global term weights, optional cosine document
+    normalization) and predicts the most similar corpus document —
+    label = its id, value = the similarity (cosine) or distance
+    (euclidean), per-document scores in ``probabilities``."""
+
+    function_name: str  # classification
+    mining_schema: MiningSchema
+    terms: Tuple[str, ...]
+    doc_ids: Tuple[str, ...]
+    dtm: Tuple[Tuple[float, ...], ...]  # [D][T] raw counts
+    local_weight: str = "termFrequency"  # | binary | logarithmic |
+    #                                       augmentedNormalizedTermFrequency
+    global_weight: str = "none"  # | inverseDocumentFrequency
+    doc_normalization: str = "none"  # | cosine
+    similarity: str = "cosine"  # | euclidean
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# BayesianNetworkModel (discrete)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BnNode:
+    """One discrete node: P(name | parents) as explicit CPT rows.
+
+    ``cpt`` holds one row per parent configuration: (parent values in
+    ``parents`` order, per-state probabilities aligned with ``values``).
+    Root nodes have ``parents == ()`` and a single row with an empty
+    config."""
+
+    name: str
+    values: Tuple[str, ...]
+    parents: Tuple[str, ...] = ()
+    cpt: Tuple[Tuple[Tuple[str, ...], Tuple[float, ...]], ...] = ()
+
+
+@dataclass(frozen=True)
+class BayesianNetworkIR:
+    """Discrete Bayesian network scored under the streaming contract:
+    every non-target node is an observed active field (fully observed
+    Markov blanket), so the target posterior is closed form —
+
+        P(t | e) ∝ P(t | pa(t)) · Π_{c : t ∈ pa(c)} P(c_obs | pa(c), t)
+
+    — all other factors are observed constants and cancel. Lanes with a
+    missing or unmatchable observation score empty (C5)."""
+
+    function_name: str  # classification
+    mining_schema: MiningSchema
+    nodes: Tuple[BnNode, ...]
+    target: str
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesModel (ExponentialSmoothing)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ExponentialSmoothingIR:
+    """Fitted smoothing state: the document stores the final level/trend
+    and one period of seasonal factors; scoring is a pure forecast."""
+
+    level: float
+    trend: float = 0.0
+    trend_type: str = "none"  # none | additive | damped_trend
+    phi: float = 1.0  # damped_trend decay
+    seasonal_type: str = "none"  # none | additive | multiplicative
+    period: int = 0
+    seasonal: Tuple[float, ...] = ()  # [period], next slot first
+
+
+@dataclass(frozen=True)
+class TimeSeriesIR:
+    """Forecast-at-horizon scoring: the record's ``horizon_field`` value
+    h (integer ≥ 1) selects the h-step-ahead forecast
+
+        ŷ(h) = level (+ h·trend | + trend·φ(1−φ^h)/(1−φ))
+                     (± / × seasonal[(h−1) mod period])
+
+    — the per-record framing of the reference's lead-time evaluation
+    (temporal state lives in the document, not the stream)."""
+
+    function_name: str  # timeSeries
+    mining_schema: MiningSchema
+    smoothing: ExponentialSmoothingIR
+    horizon_field: str
+    model_name: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
 # MiningModel (ensembles / stacking)
 # ---------------------------------------------------------------------------
 
@@ -734,6 +839,9 @@ ModelIR = Union[
     GaussianProcessIR,
     BaselineIR,
     AssociationIR,
+    TimeSeriesIR,
+    BayesianNetworkIR,
+    TextModelIR,
     "MiningModelIR",
 ]
 

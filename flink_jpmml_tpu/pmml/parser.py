@@ -36,6 +36,9 @@ _MODEL_TAGS = (
     "GaussianProcessModel",
     "BaselineModel",
     "AssociationModel",
+    "TimeSeriesModel",
+    "BayesianNetworkModel",
+    "TextModel",
     "MiningModel",
 )
 
@@ -538,9 +541,301 @@ def _parse_model(elem: ET.Element) -> ir.ModelIR:
         return _parse_baseline(elem)
     if tag == "AssociationModel":
         return _parse_association(elem)
+    if tag == "TimeSeriesModel":
+        return _parse_time_series(elem)
+    if tag == "BayesianNetworkModel":
+        return _parse_bayesian_network(elem)
+    if tag == "TextModel":
+        return _parse_text_model(elem)
     if tag == "MiningModel":
         return _parse_mining_model(elem)
     raise ModelLoadingException(f"unsupported model element <{tag}>")
+
+
+_TEXT_LOCAL = (
+    "termFrequency", "binary", "logarithmic",
+    "augmentedNormalizedTermFrequency",
+)
+_TEXT_GLOBAL = ("none", "inverseDocumentFrequency")
+
+
+def _parse_text_model(elem: ET.Element) -> ir.TextModelIR:
+    schema = _parse_mining_schema(elem)
+    td = _child(elem, "TextDictionary")
+    if td is None:
+        raise ModelLoadingException("TextModel has no TextDictionary")
+    arr = _child(td, "Array")
+    if arr is None:
+        raise ModelLoadingException("TextDictionary needs an Array of terms")
+    terms = tuple(_parse_string_array(arr))
+    if not terms:
+        raise ModelLoadingException("TextDictionary is empty")
+    corpus = _child(elem, "TextCorpus")
+    if corpus is None:
+        raise ModelLoadingException("TextModel has no TextCorpus")
+    doc_ids = tuple(
+        d.get("id") or d.get("name") or f"doc{i}"
+        for i, d in enumerate(_children(corpus, "TextDocument"))
+    )
+    if not doc_ids:
+        raise ModelLoadingException("TextCorpus has no TextDocument entries")
+    if len(set(doc_ids)) != len(doc_ids):
+        # duplicate ids would collapse in the oracle's per-id score map
+        # while the compiled path keeps every row — reject up front
+        raise ModelLoadingException("TextCorpus has duplicate document ids")
+    dtm_elem = _child(elem, "DocumentTermMatrix")
+    if dtm_elem is None:
+        raise ModelLoadingException("TextModel has no DocumentTermMatrix")
+    matrix = _child(dtm_elem, "Matrix")
+    if matrix is None:
+        raise ModelLoadingException("DocumentTermMatrix needs a Matrix")
+    rows = tuple(
+        _parse_real_array(a) for a in _children(matrix, "Array")
+    )
+    if len(rows) != len(doc_ids) or any(len(r) != len(terms) for r in rows):
+        raise ModelLoadingException(
+            f"DocumentTermMatrix shape {len(rows)}x"
+            f"{len(rows[0]) if rows else 0} != documents x terms "
+            f"{len(doc_ids)}x{len(terms)}"
+        )
+    local = "termFrequency"
+    global_w = "none"
+    doc_norm = "none"
+    norm = _child(elem, "TextModelNormalization")
+    if norm is not None:
+        local = norm.get("localTermWeights", "termFrequency")
+        global_w = norm.get("globalTermWeights", "none")
+        doc_norm = norm.get("documentNormalization", "none")
+        if local not in _TEXT_LOCAL:
+            raise ModelLoadingException(
+                f"unsupported localTermWeights {local!r}"
+            )
+        if global_w not in _TEXT_GLOBAL:
+            raise ModelLoadingException(
+                f"unsupported globalTermWeights {global_w!r}"
+            )
+        if doc_norm not in ("none", "cosine"):
+            raise ModelLoadingException(
+                f"unsupported documentNormalization {doc_norm!r}"
+            )
+    sim = "cosine"
+    sim_elem = _child(elem, "TextModelSimilarity")
+    if sim_elem is not None:
+        sim = sim_elem.get("similarityType", "cosine")
+        if sim not in ("cosine", "euclidean"):
+            raise ModelLoadingException(
+                f"unsupported similarityType {sim!r}"
+            )
+    # streaming contract: every term is an active field (term counts)
+    missing = [t for t in terms if t not in schema.active_fields]
+    if missing:
+        raise ModelLoadingException(
+            "TextModel terms must each be an active MiningField (term-"
+            f"count contract); missing: {missing[:5]}"
+        )
+    return ir.TextModelIR(
+        function_name=elem.get("functionName", "classification"),
+        mining_schema=schema,
+        terms=terms,
+        doc_ids=doc_ids,
+        dtm=rows,
+        local_weight=local,
+        global_weight=global_w,
+        doc_normalization=doc_norm,
+        similarity=sim,
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_bayesian_network(elem: ET.Element) -> ir.BayesianNetworkIR:
+    schema = _parse_mining_schema(elem)
+    target = schema.target_field
+    if target is None:
+        raise ModelLoadingException(
+            "BayesianNetworkModel needs a target MiningField"
+        )
+    nodes_elem = _child(elem, "BayesianNetworkNodes")
+    if nodes_elem is None:
+        raise ModelLoadingException(
+            "BayesianNetworkModel has no BayesianNetworkNodes"
+        )
+    nodes = []
+    for ne in _children(nodes_elem, "DiscreteNode"):
+        name = ne.get("name")
+        if not name:
+            raise ModelLoadingException("DiscreteNode needs a name")
+        rows = []
+        parents: Tuple[str, ...] = ()
+        root_probs = []
+        for c in ne:
+            tag = _local(c.tag)
+            if tag == "ValueProbability":  # root-node shorthand
+                root_probs.append(
+                    (c.get("value", ""), _float(c, "probability"))
+                )
+            elif tag == "DiscreteConditionalProbability":
+                config = tuple(
+                    (pv.get("parent", ""), pv.get("value", ""))
+                    for pv in _children(c, "ParentValue")
+                )
+                row_parents = tuple(p for p, _ in config)
+                if not parents:
+                    parents = row_parents
+                elif parents != row_parents:
+                    raise ModelLoadingException(
+                        f"DiscreteNode {name!r}: inconsistent ParentValue "
+                        "ordering across rows"
+                    )
+                probs = tuple(
+                    (vp.get("value", ""), _float(vp, "probability"))
+                    for vp in _children(c, "ValueProbability")
+                )
+                rows.append((tuple(v for _, v in config), probs))
+        if root_probs:
+            if rows:
+                raise ModelLoadingException(
+                    f"DiscreteNode {name!r}: mixing root ValueProbability "
+                    "with conditional rows"
+                )
+            rows = [((), tuple(root_probs))]
+        if not rows:
+            raise ModelLoadingException(
+                f"DiscreteNode {name!r} has no probability rows"
+            )
+        values = tuple(v for v, _ in rows[0][1])
+        if len(set(values)) != len(values) or not values:
+            raise ModelLoadingException(
+                f"DiscreteNode {name!r}: duplicate or empty value list"
+            )
+        cpt = []
+        for config, probs in rows:
+            if tuple(v for v, _ in probs) != values:
+                raise ModelLoadingException(
+                    f"DiscreteNode {name!r}: rows disagree on the value "
+                    "list/order"
+                )
+            p = tuple(pr for _, pr in probs)
+            if any(x < 0 for x in p):
+                raise ModelLoadingException(
+                    f"DiscreteNode {name!r}: negative probability"
+                )
+            cpt.append((config, p))
+        nodes.append(ir.BnNode(
+            name=name, values=values, parents=parents, cpt=tuple(cpt)
+        ))
+    if not nodes:
+        raise ModelLoadingException("BayesianNetworkNodes has no nodes")
+    by_name = {n.name: n for n in nodes}
+    if target not in by_name:
+        raise ModelLoadingException(
+            f"target {target!r} is not a declared DiscreteNode"
+        )
+    for n in nodes:
+        for p in n.parents:
+            if p not in by_name:
+                raise ModelLoadingException(
+                    f"DiscreteNode {n.name!r}: unknown parent {p!r}"
+                )
+    # fully-observed contract: every non-target node is an active field
+    observed = set(schema.active_fields)
+    unobserved = [
+        n.name for n in nodes if n.name != target and n.name not in observed
+    ]
+    if unobserved:
+        raise ModelLoadingException(
+            "BayesianNetworkModel requires every non-target node to be an "
+            f"active MiningField (fully-observed contract); hidden: "
+            f"{unobserved[:5]} — marginalizing hidden nodes is not "
+            "supported"
+        )
+    return ir.BayesianNetworkIR(
+        function_name=elem.get("functionName", "classification"),
+        mining_schema=schema,
+        nodes=tuple(nodes),
+        target=target,
+        model_name=elem.get("modelName"),
+    )
+
+
+def _parse_time_series(elem: ET.Element) -> ir.TimeSeriesIR:
+    best_fit = elem.get("bestFit", "ExponentialSmoothing")
+    if best_fit != "ExponentialSmoothing":
+        raise ModelLoadingException(
+            f"unsupported TimeSeriesModel bestFit {best_fit!r} "
+            "(supported: ExponentialSmoothing)"
+        )
+    es = _child(elem, "ExponentialSmoothing")
+    if es is None:
+        raise ModelLoadingException(
+            "TimeSeriesModel has no ExponentialSmoothing element"
+        )
+    lvl = _child(es, "Level")
+    if lvl is None or lvl.get("smoothedValue") is None:
+        raise ModelLoadingException("Level needs a smoothedValue")
+    level = _float(lvl, "smoothedValue")
+    trend = 0.0
+    trend_type = "none"
+    phi = 1.0
+    tr = _child(es, "Trend_ExpoSmooth")
+    if tr is not None:
+        trend_type = tr.get("trend", "additive")
+        if trend_type not in ("additive", "damped_trend"):
+            raise ModelLoadingException(
+                f"unsupported trend {trend_type!r} (supported: additive, "
+                "damped_trend)"
+            )
+        trend = _float(tr, "smoothedValue", 0.0)
+        phi = _float(tr, "phi", 1.0)
+        if trend_type == "damped_trend" and not 0.0 < phi < 1.0:
+            raise ModelLoadingException(
+                f"damped_trend needs 0 < phi < 1, got {phi}"
+            )
+    seasonal_type = "none"
+    period = 0
+    seasonal: Tuple[float, ...] = ()
+    se = _child(es, "Seasonality_ExpoSmooth")
+    if se is not None:
+        seasonal_type = se.get("type", "additive")
+        if seasonal_type not in ("additive", "multiplicative"):
+            raise ModelLoadingException(
+                f"unsupported seasonality type {seasonal_type!r}"
+            )
+        period = _int(se, "period")
+        arr = _child(se, "Array")
+        if arr is None:
+            raise ModelLoadingException(
+                "Seasonality_ExpoSmooth needs an Array of factors"
+            )
+        seasonal = _parse_real_array(arr)
+        if period < 2:
+            raise ModelLoadingException(
+                f"seasonal period must be >= 2, got {period}"
+            )
+        if len(seasonal) != period:
+            raise ModelLoadingException(
+                f"seasonal Array length {len(seasonal)} != period {period}"
+            )
+    schema = _parse_mining_schema(elem)
+    if not schema.active_fields:
+        raise ModelLoadingException(
+            "TimeSeriesModel needs one active MiningField carrying the "
+            "forecast horizon (integer >= 1)"
+        )
+    return ir.TimeSeriesIR(
+        function_name=elem.get("functionName", "timeSeries"),
+        mining_schema=schema,
+        smoothing=ir.ExponentialSmoothingIR(
+            level=level,
+            trend=trend,
+            trend_type=trend_type,
+            phi=phi,
+            seasonal_type=seasonal_type,
+            period=period,
+            seasonal=seasonal,
+        ),
+        horizon_field=schema.active_fields[0],
+        model_name=elem.get("modelName"),
+    )
 
 
 _GP_KERNELS = {

@@ -275,3 +275,14 @@ class TestNewFamiliesSharded:
 
         # integer-ish basket indicators: >0.5 ⇔ in basket
         self._check(ASSOC, 4, seed=5)
+
+    def test_timeseries_sharded(self):
+        from tests.test_timeseries import TS, TREND_DAMPED, SEASONAL_MUL
+
+        self._check(TS.format(trend=TREND_DAMPED, seasonal=SEASONAL_MUL), 1)
+
+    def test_textmodel_sharded(self):
+        from tests.test_textmodel import _xml
+
+        self._check(_xml("logarithmic", "inverseDocumentFrequency",
+                         "cosine", "cosine"), 4)

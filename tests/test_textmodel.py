@@ -1,0 +1,164 @@
+"""TextModel: weighted document similarity, compiled vs oracle vs hand
+math across local/global weights, normalization and similarity types."""
+
+import math
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+TEXT = """<PMML version="4.2"><DataDictionary>
+  <DataField name="ball" optype="continuous" dataType="double"/>
+  <DataField name="goal" optype="continuous" dataType="double"/>
+  <DataField name="oven" optype="continuous" dataType="double"/>
+  <DataField name="salt" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TextModel functionName="classification" numberOfTerms="4"
+      numberOfDocuments="3">
+  <MiningSchema>
+    <MiningField name="ball"/><MiningField name="goal"/>
+    <MiningField name="oven"/><MiningField name="salt"/>
+  </MiningSchema>
+  <TextDictionary><Array n="4" type="string">ball goal oven salt</Array>
+  </TextDictionary>
+  <TextCorpus>
+    <TextDocument id="sports"/><TextDocument id="cooking"/>
+    <TextDocument id="mixed"/>
+  </TextCorpus>
+  <DocumentTermMatrix><Matrix>
+    <Array n="4" type="real">5 3 0 0</Array>
+    <Array n="4" type="real">0 0 4 6</Array>
+    <Array n="4" type="real">2 1 1 2</Array>
+  </Matrix></DocumentTermMatrix>
+  {normalization}
+  {similarity}
+  </TextModel></PMML>"""
+
+DTM = np.array([[5, 3, 0, 0], [0, 0, 4, 6], [2, 1, 1, 2]], float)
+DOCS = ["sports", "cooking", "mixed"]
+
+
+def _hand_scores(q, local="termFrequency", glob="none", doc_norm="none",
+                 sim="cosine"):
+    def lw(v):
+        v = np.maximum(np.asarray(v, float), 0.0)
+        if local == "binary":
+            return (v > 0).astype(float)
+        if local == "logarithmic":
+            return np.log10(1.0 + v)
+        if local == "augmentedNormalizedTermFrequency":
+            m = v.max()
+            return np.where((v > 0) & (m > 0), 0.5 + 0.5 * v / max(m, 1e-30), 0.0)
+        return v
+
+    if glob == "inverseDocumentFrequency":
+        dj = (DTM > 0).sum(axis=0)
+        idf = np.where(dj > 0, np.log10(len(DOCS) / np.maximum(dj, 1)), 0.0)
+    else:
+        idf = np.ones(4)
+
+    def w(v):
+        x = lw(v) * idf
+        if doc_norm == "cosine":
+            n = np.linalg.norm(x)
+            if n > 0:
+                x = x / n
+        return x
+
+    qw = w(q)
+    out = {}
+    for did, row in zip(DOCS, DTM):
+        dw = w(row)
+        if sim == "cosine":
+            nq, nd = np.linalg.norm(qw), np.linalg.norm(dw)
+            out[did] = float(qw @ dw / (nq * nd)) if nq > 0 and nd > 0 else 0.0
+        else:
+            out[did] = float(np.linalg.norm(qw - dw))
+    return out
+
+
+def _xml(local=None, glob=None, doc_norm=None, sim=None):
+    norm = ""
+    if local or glob or doc_norm:
+        norm = (
+            f'<TextModelNormalization '
+            f'localTermWeights="{local or "termFrequency"}" '
+            f'globalTermWeights="{glob or "none"}" '
+            f'documentNormalization="{doc_norm or "none"}"/>'
+        )
+    s = f'<TextModelSimilarity similarityType="{sim}"/>' if sim else ""
+    return TEXT.format(normalization=norm, similarity=s)
+
+
+class TestTextModel:
+    @pytest.mark.parametrize(
+        "local,glob,doc_norm,sim",
+        [
+            (None, None, None, None),  # all defaults: tf / none / cosine
+            ("binary", None, None, "cosine"),
+            ("logarithmic", "inverseDocumentFrequency", None, "cosine"),
+            ("augmentedNormalizedTermFrequency", None, "cosine", "cosine"),
+            ("termFrequency", "inverseDocumentFrequency", "cosine",
+             "euclidean"),
+        ],
+    )
+    def test_similarity_parity(self, local, glob, doc_norm, sim):
+        doc = parse_pmml(_xml(local, glob, doc_norm, sim))
+        cm = compile_pmml(doc)
+        rng = np.random.default_rng(5)
+        queries = [rng.integers(0, 6, size=4).astype(float) for _ in range(20)]
+        queries.append(np.array([3.0, 2.0, 0.0, 0.0]))  # clearly sports
+        recs = [
+            dict(zip(("ball", "goal", "oven", "salt"), q.tolist()))
+            for q in queries
+        ]
+        preds = cm.score_records(recs)
+        for q, rec, p in zip(queries, recs, preds):
+            hand = _hand_scores(
+                q, local or "termFrequency", glob or "none",
+                doc_norm or "none", sim or "cosine",
+            )
+            o = evaluate(doc, rec)
+            for did in DOCS:
+                assert o.probabilities[did] == pytest.approx(
+                    hand[did], abs=1e-9
+                )
+                assert p.target.probabilities[did] == pytest.approx(
+                    hand[did], abs=1e-4
+                )
+            assert p.target.label == o.label
+
+    def test_sports_query_wins(self):
+        doc = parse_pmml(_xml())
+        cm = compile_pmml(doc)
+        p = cm.score_records([{"ball": 4, "goal": 2, "oven": 0, "salt": 0}])[0]
+        assert p.target.label == "sports"
+        assert evaluate(
+            doc, {"ball": 4, "goal": 2, "oven": 0, "salt": 0}
+        ).label == "sports"
+
+    def test_missing_counts_read_zero(self):
+        doc = parse_pmml(_xml())
+        cm = compile_pmml(doc)
+        rec = {"ball": 4.0, "goal": None, "oven": None, "salt": None}
+        p = cm.score_records([rec])[0]
+        o = evaluate(doc, rec)
+        assert not p.is_empty and p.target.label == o.label
+
+    def test_rejections(self):
+        with pytest.raises(ModelLoadingException, match="shape"):
+            parse_pmml(_xml().replace(
+                '<Array n="4" type="real">2 1 1 2</Array>', ""
+            ))
+        with pytest.raises(ModelLoadingException, match="active MiningField"):
+            parse_pmml(_xml().replace('<MiningField name="salt"/>', ""))
+        with pytest.raises(ModelLoadingException, match="localTermWeights"):
+            parse_pmml(_xml(local="squareRoot"))
+        with pytest.raises(ModelLoadingException, match="duplicate"):
+            parse_pmml(_xml().replace(
+                '<TextDocument id="cooking"/>', '<TextDocument id="sports"/>'
+            ))

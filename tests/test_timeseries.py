@@ -1,0 +1,109 @@
+"""TimeSeriesModel (ExponentialSmoothing): compiled vs oracle vs
+hand-computed Holt-Winters forecasts."""
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.pmml.interp import evaluate
+from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+TS = """<PMML version="4.3"><DataDictionary>
+  <DataField name="h" optype="continuous" dataType="integer"/>
+  <DataField name="sales" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TimeSeriesModel functionName="timeSeries" bestFit="ExponentialSmoothing">
+  <MiningSchema><MiningField name="sales" usageType="target"/>
+    <MiningField name="h"/></MiningSchema>
+  <ExponentialSmoothing>
+    <Level alpha="0.3" smoothedValue="120.5"/>
+    {trend}
+    {seasonal}
+  </ExponentialSmoothing>
+  </TimeSeriesModel></PMML>"""
+
+TREND_ADD = '<Trend_ExpoSmooth trend="additive" gamma="0.1" smoothedValue="2.5"/>'
+TREND_DAMPED = (
+    '<Trend_ExpoSmooth trend="damped_trend" gamma="0.1" smoothedValue="2.5" '
+    'phi="0.8"/>'
+)
+SEASONAL_ADD = (
+    '<Seasonality_ExpoSmooth type="additive" period="4" gamma="0.2">'
+    '<Array n="4" type="real">5.0 -3.0 1.5 -3.5</Array>'
+    "</Seasonality_ExpoSmooth>"
+)
+SEASONAL_MUL = (
+    '<Seasonality_ExpoSmooth type="multiplicative" period="4" gamma="0.2">'
+    '<Array n="4" type="real">1.1 0.9 1.05 0.95</Array>'
+    "</Seasonality_ExpoSmooth>"
+)
+
+
+def _hand(h, trend="none", seasonal="none"):
+    y = 120.5
+    if trend == "additive":
+        y += h * 2.5
+    elif trend == "damped":
+        y += 2.5 * sum(0.8 ** i for i in range(1, h + 1))
+    if seasonal == "add":
+        y += [5.0, -3.0, 1.5, -3.5][(h - 1) % 4]
+    elif seasonal == "mul":
+        y *= [1.1, 0.9, 1.05, 0.95][(h - 1) % 4]
+    return y
+
+
+class TestExponentialSmoothing:
+    @pytest.mark.parametrize(
+        "trend_xml,seasonal_xml,trend,seasonal",
+        [
+            ("", "", "none", "none"),
+            (TREND_ADD, "", "additive", "none"),
+            (TREND_DAMPED, "", "damped", "none"),
+            (TREND_ADD, SEASONAL_ADD, "additive", "add"),
+            (TREND_DAMPED, SEASONAL_MUL, "damped", "mul"),
+        ],
+    )
+    def test_forecast_parity(self, trend_xml, seasonal_xml, trend, seasonal):
+        doc = parse_pmml(TS.format(trend=trend_xml, seasonal=seasonal_xml))
+        cm = compile_pmml(doc)
+        hs = [1, 2, 3, 4, 5, 9, 13]
+        preds = cm.score_records([{"h": h} for h in hs])
+        for h, p in zip(hs, preds):
+            hand = _hand(h, trend, seasonal)
+            o = evaluate(doc, {"h": h})
+            assert o.value == pytest.approx(hand, rel=1e-12)
+            assert p.score.value == pytest.approx(hand, rel=1e-5)
+
+    def test_horizon_rounding_and_floor(self):
+        doc = parse_pmml(TS.format(trend=TREND_ADD, seasonal=""))
+        cm = compile_pmml(doc)
+        # fractional horizons round; nonpositive clamp to 1
+        for hv, h in ((2.4, 2), (2.6, 3), (0.0, 1), (-5.0, 1)):
+            p = cm.score_records([{"h": hv}])[0]
+            assert p.score.value == pytest.approx(_hand(h, "additive"))
+            assert evaluate(doc, {"h": hv}).value == pytest.approx(
+                _hand(h, "additive")
+            )
+
+    def test_missing_horizon_empty(self):
+        doc = parse_pmml(TS.format(trend="", seasonal=""))
+        cm = compile_pmml(doc)
+        assert cm.score_records([{"h": None}])[0].is_empty
+        assert evaluate(doc, {"h": None}).value is None
+
+    def test_rejections(self):
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(TS.format(trend="", seasonal="").replace(
+                'bestFit="ExponentialSmoothing"', 'bestFit="ARIMA"'
+            ))
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(TS.format(
+                trend=TREND_DAMPED.replace('phi="0.8"', 'phi="1.5"'),
+                seasonal="",
+            ))
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(TS.format(
+                trend="",
+                seasonal=SEASONAL_ADD.replace('period="4"', 'period="3"'),
+            ))
