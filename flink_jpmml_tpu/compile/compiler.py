@@ -138,6 +138,10 @@ class CompiledModel:
     # <Output feature="ruleValue"> fields at decode
     _rule_meta: Optional[Tuple[dict, ...]] = None
     _rule_order: Optional[Tuple[int, ...]] = None
+    # embedded <ModelVerification> vectors + the target name they may
+    # reference (verify() replays them; ModelReader gates loads on it)
+    _verification: Optional[ir.ModelVerification] = None
+    _target_field: Optional[str] = None
 
     @property
     def is_classification(self) -> bool:
@@ -196,6 +200,23 @@ class CompiledModel:
             self._doc = None
             self._config = None
         return self._quantized
+
+    @property
+    def has_verification(self) -> bool:
+        return self._verification is not None
+
+    def verify(self) -> List[str]:
+        """Replay the document's embedded ModelVerification records.
+
+        → mismatch descriptions; empty = verified (or nothing embedded).
+        The JPMML ``Evaluator.verify()`` contract (SURVEY.md §1 C1/C2):
+        callers that require a verified model raise
+        ModelVerificationException on a non-empty result (ModelReader
+        does, by default, when the document embeds vectors).
+        """
+        from flink_jpmml_tpu.compile.verify import run_verification
+
+        return run_verification(self, self._target_field)
 
     def warmup(self) -> "CompiledModel":
         """Force compilation (and params transfer) ahead of the hot path."""
@@ -468,4 +489,6 @@ def compile_pmml(
         _reason=reason,
         _rule_meta=rule_meta,
         _rule_order=rule_order,
+        _verification=doc.verification,
+        _target_field=doc.target_field,
     )

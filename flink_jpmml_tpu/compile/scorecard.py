@@ -116,11 +116,20 @@ def lower_scorecard(model: ir.ScorecardIR, ctx: LowerCtx) -> Lowered:
     psets = np.full((C, A, K, KS), np.nan, np.float32) if KS else None
     partial = np.zeros((C, A), np.float32)
 
+    # ComplexPartialScore slots: (ci, ai, lowered expression) — their
+    # per-record values overwrite the static partial plane in fn
+    expr_slots = []
     for ci, ch in enumerate(model.characteristics):
         for ai, at in enumerate(ch.attributes):
             comb, subs = flat[ci][ai]
             pcomb[ci, ai] = comb
             partial[ci, ai] = at.partial_score
+            if at.partial_expr is not None:
+                from flink_jpmml_tpu.compile.exprs import lower_expression
+
+                expr_slots.append(
+                    (ci, ai, lower_expression(at.partial_expr, ctx))
+                )
             for k, (c_, o_, v_, s_, n_, t_) in enumerate(subs):
                 pcol[ci, ai, k] = c_
                 pop[ci, ai, k] = o_
@@ -156,13 +165,28 @@ def lower_scorecard(model: ir.ScorecardIR, ctx: LowerCtx) -> Lowered:
         )  # [B, C, A]; UNKNOWN attributes simply don't match
         matched = jnp.any(attrT, axis=-1)  # [B, C]
         first = jnp.argmax(attrT, axis=-1)  # first True (argmax on bools)
+        partial_dyn = jnp.broadcast_to(p["partial"][None], (B, C, A))
+        expr_bad = None  # [B, C, A] chosen-slot poison for failed exprs
+        if expr_slots:
+            expr_bad = jnp.zeros((B, C, A), bool)
+            for ci, ai, efn in expr_slots:
+                v, miss = efn(X, M)
+                partial_dyn = partial_dyn.at[:, ci, ai].set(
+                    jnp.where(miss, 0.0, v.astype(jnp.float32))
+                )
+                expr_bad = expr_bad.at[:, ci, ai].set(miss)
         chosen = jnp.take_along_axis(
-            jnp.broadcast_to(p["partial"][None], (B, C, A)),
-            first[..., None],
-            axis=-1,
+            partial_dyn, first[..., None], axis=-1
         )[..., 0]  # [B, C]
         value = init + jnp.sum(chosen, axis=-1)
         valid = jnp.all(matched, axis=-1)
+        if expr_bad is not None:
+            # a chosen attribute whose ComplexPartialScore failed to
+            # compute empties the lane (oracle parity)
+            chosen_bad = jnp.take_along_axis(
+                expr_bad, first[..., None], axis=-1
+            )[..., 0]
+            valid = valid & ~jnp.any(chosen_bad, axis=-1)
         # decode-side payload: per-characteristic partials + chosen
         # attribute index (for attribute-level reason codes)
         probs = jnp.concatenate(

@@ -451,9 +451,17 @@ def _eval_scorecard(model: ir.ScorecardIR, record: Record) -> EvalResult:
         if chosen is None:
             # no attribute matched: the result is invalid (totality C5)
             return EvalResult()
-        partials.append(chosen[1].partial_score)
+        if chosen[1].partial_expr is not None:
+            ps = eval_expression(chosen[1].partial_expr, record)
+            if ps is None:
+                # ComplexPartialScore failed to compute on the chosen
+                # attribute — the record's score is undefined
+                return EvalResult()
+        else:
+            ps = chosen[1].partial_score
+        partials.append(ps)
         attr_idx.append(chosen[0])
-        total += chosen[1].partial_score
+        total += ps
     res = EvalResult(value=total)
     if model.use_reason_codes:
         meta = _scorecard_reason_meta(model)

@@ -389,11 +389,16 @@ class ClusteringModelIR:
 class ScorecardAttribute:
     """One bin of a Characteristic: first-true predicate wins its
     partialScore (UNKNOWN predicates don't match — scorecard documents
-    bin missing values with explicit isMissing attributes)."""
+    bin missing values with explicit isMissing attributes).
+
+    ``partial_expr`` (ComplexPartialScore) computes the partial from the
+    record instead of the static ``partial_score``; a failed/missing
+    computation on a chosen attribute empties the lane."""
 
     predicate: Predicate
     partial_score: float
     reason_code: Optional[str] = None  # overrides the characteristic's
+    partial_expr: Optional[Expression] = None
 
 
 @dataclass(frozen=True)
@@ -889,6 +894,33 @@ class MiningModelIR:
 
 
 # ---------------------------------------------------------------------------
+# ModelVerification
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class VerificationField:
+    """One column of the embedded verification table. ``field`` is an
+    active input, the target (expected predicted value/label), or a
+    ``probability(<class>)`` expectation."""
+
+    field: str
+    column: str
+    precision: float = 1e-6  # relative tolerance for numeric expectations
+    zero_threshold: float = 1e-16  # |expected| below this compares absolutely
+
+
+@dataclass(frozen=True)
+class ModelVerification:
+    """Producer-embedded test vectors: inputs + expected outputs. The
+    loader replays them through the compiled model and rejects the
+    document on mismatch (the JPMML verification contract)."""
+
+    fields: Tuple[VerificationField, ...]
+    records: Tuple[Tuple[Tuple[str, str], ...], ...]  # rows of (column, raw)
+
+
+# ---------------------------------------------------------------------------
 # Targets (output rescaling) + document root
 # ---------------------------------------------------------------------------
 
@@ -916,6 +948,7 @@ class PmmlDocument:
     model: ModelIR
     targets: Tuple[Target, ...] = ()
     output_fields: Tuple[OutputField, ...] = ()  # top-level <Output>
+    verification: Optional[ModelVerification] = None
 
     @property
     def active_fields(self) -> Tuple[str, ...]:

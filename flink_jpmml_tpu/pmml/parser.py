@@ -150,6 +150,9 @@ def parse_pmml(xml_text: str) -> ir.PmmlDocument:
     model = _resolve_glm_reference(model, data_dictionary)
     targets = _parse_targets(_child(model_elem, "Targets"))
     output_fields = _parse_output(_child(model_elem, "Output"))
+    verification = _parse_model_verification(
+        _child(model_elem, "ModelVerification")
+    )
     return ir.PmmlDocument(
         version=version,
         header=header,
@@ -158,6 +161,7 @@ def parse_pmml(xml_text: str) -> ir.PmmlDocument:
         model=model,
         targets=targets,
         output_fields=output_fields,
+        verification=verification,
     )
 
 
@@ -239,6 +243,51 @@ def _parse_output(out_elem: Optional[ET.Element]) -> tuple:
             )
         )
     return tuple(out)
+
+
+def _parse_model_verification(
+    elem: Optional[ET.Element],
+) -> Optional[ir.ModelVerification]:
+    if elem is None:
+        return None
+    vf = _child(elem, "VerificationFields")
+    if vf is None:
+        raise ModelLoadingException(
+            "ModelVerification has no VerificationFields"
+        )
+    fields = []
+    for f in _children(vf, "VerificationField"):
+        name = f.get("field")
+        if not name:
+            raise ModelLoadingException("VerificationField needs a field")
+        fields.append(ir.VerificationField(
+            field=name,
+            # the column attribute may carry a namespace prefix
+            # ("data:x1"); the row cells are matched by local name
+            column=(f.get("column") or name).split(":")[-1],
+            precision=_float(f, "precision", 1e-6),
+            zero_threshold=_float(f, "zeroThreshold", 1e-16),
+        ))
+    if not fields:
+        raise ModelLoadingException(
+            "VerificationFields has no VerificationField entries"
+        )
+    table = _child(elem, "InlineTable")
+    if table is None:
+        raise ModelLoadingException(
+            "ModelVerification needs an InlineTable"
+        )
+    records = tuple(
+        tuple(
+            (_local(c.tag), (c.text or "").strip()) for c in row
+        )
+        for row in _children(table, "row")
+    )
+    if not records:
+        raise ModelLoadingException(
+            "ModelVerification InlineTable has no rows"
+        )
+    return ir.ModelVerification(fields=tuple(fields), records=records)
 
 
 def parse_pmml_file(path: str) -> ir.PmmlDocument:
@@ -1499,21 +1548,28 @@ def _parse_scorecard(elem: ET.Element) -> ir.ScorecardIR:
         attributes = []
         for at in _children(ch, "Attribute"):
             ps = at.get("partialScore")
-            if ps is None:
-                if _child(at, "ComplexPartialScore") is not None:
+            expr = None
+            cps = _child(at, "ComplexPartialScore")
+            if cps is not None:
+                for c in cps:
+                    expr = _try_parse_expression(c)
+                    if expr is not None:
+                        break
+                if expr is None:
                     raise ModelLoadingException(
-                        "ComplexPartialScore is not supported; use "
-                        "partialScore attributes"
+                        "ComplexPartialScore needs an expression child"
                     )
+            if ps is None and expr is None:
                 raise ModelLoadingException(
                     f"Attribute in characteristic {ch.get('name')!r} has "
-                    "no partialScore"
+                    "no partialScore or ComplexPartialScore"
                 )
             attributes.append(
                 ir.ScorecardAttribute(
                     predicate=_find_predicate(at),
-                    partial_score=float(ps),
+                    partial_score=float(ps) if ps is not None else 0.0,
                     reason_code=at.get("reasonCode"),
+                    partial_expr=expr,
                 )
             )
         if not attributes:

@@ -50,3 +50,8 @@ class ExtractionException(FlinkJpmmlTpuError):
 
 class CheckpointException(FlinkJpmmlTpuError):
     """Writing or restoring a runtime checkpoint failed."""
+
+
+class ModelVerificationException(ModelLoadingException):
+    """The document's embedded ModelVerification records disagree with
+    the compiled model's output — the model must not serve."""

@@ -283,3 +283,85 @@ class TestReviewRegressions:
         p = cm.score_records([rec])[0]
         assert not p.is_empty
         assert p.target.label == o.label
+
+
+COMPLEX_SC = """<PMML version="4.3"><DataDictionary>
+  <DataField name="bal" optype="continuous" dataType="double"/>
+  <DataField name="score" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <Scorecard functionName="regression" initialScore="50"
+      useReasonCodes="false">
+  <MiningSchema><MiningField name="score" usageType="target"/>
+    <MiningField name="bal"/></MiningSchema>
+  <Characteristics>
+    <Characteristic name="balCh">
+      <Attribute>
+        <SimplePredicate field="bal" operator="greaterOrEqual" value="0"/>
+        <ComplexPartialScore>
+          <Apply function="*"><Constant>0.1</Constant>
+            <FieldRef field="bal"/></Apply>
+        </ComplexPartialScore>
+      </Attribute>
+      <Attribute>
+        <True/>
+        <ComplexPartialScore>
+          <Apply function="ln"><FieldRef field="bal"/></Apply>
+        </ComplexPartialScore>
+      </Attribute>
+    </Characteristic>
+  </Characteristics></Scorecard></PMML>"""
+
+
+class TestComplexPartialScore:
+    def test_computed_partial_parity(self):
+        doc = parse_pmml(COMPLEX_SC)
+        cm = compile_pmml(doc)
+        for bal in (0.0, 120.0, 7.5):
+            rec = {"bal": bal}
+            hand = 50.0 + 0.1 * bal
+            o = evaluate(doc, rec)
+            p = cm.score_records([rec])[0]
+            assert o.value == pytest.approx(hand)
+            assert p.score.value == pytest.approx(hand, rel=1e-5)
+
+    def test_failed_expression_empties_lane(self):
+        # bal < 0: first attribute doesn't match; the fallback computes
+        # ln(bal) which fails for negatives -> empty lane on BOTH paths
+        doc = parse_pmml(COMPLEX_SC)
+        cm = compile_pmml(doc)
+        rec = {"bal": -5.0}
+        assert evaluate(doc, rec).value is None
+        assert cm.score_records([rec])[0].is_empty
+        # ... while a positive bal through the SAME fallback branch works
+        # (exercise ln on the matched-second-attribute path)
+        doc2 = parse_pmml(COMPLEX_SC.replace(
+            'operator="greaterOrEqual" value="0"',
+            'operator="greaterOrEqual" value="1000"',
+        ))
+        cm2 = compile_pmml(doc2)
+        import math
+
+        rec2 = {"bal": 20.0}
+        hand = 50.0 + math.log(20.0)
+        assert evaluate(doc2, rec2).value == pytest.approx(hand)
+        assert cm2.score_records([rec2])[0].score.value == pytest.approx(
+            hand, rel=1e-5
+        )
+
+    def test_mixed_static_and_complex(self):
+        xml = COMPLEX_SC.replace(
+            "<Attribute>\n        <SimplePredicate",
+            '<Attribute partialScore="99">\n        <SimplePredicate',
+            1,
+        ).replace(
+            "<ComplexPartialScore>\n          <Apply function=\"*\"><Constant>0.1</Constant>\n            <FieldRef field=\"bal\"/></Apply>\n        </ComplexPartialScore>\n      </Attribute>",
+            "</Attribute>",
+            1,
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"bal": 3.0}
+        assert evaluate(doc, rec).value == pytest.approx(149.0)
+        assert cm.score_records([rec])[0].score.value == pytest.approx(
+            149.0, rel=1e-6
+        )

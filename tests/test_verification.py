@@ -1,0 +1,209 @@
+"""<ModelVerification>: embedded test-vector replay at load time —
+passing documents serve, mismatching documents are refused."""
+
+import pathlib
+
+import pytest
+
+from flink_jpmml_tpu.api import ModelReader
+from flink_jpmml_tpu.api.reader import clear_model_cache
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml
+from flink_jpmml_tpu.utils.exceptions import (
+    ModelLoadingException,
+    ModelVerificationException,
+)
+
+# regression model: y = 2*x1 - 3*x2 + 0.5
+REG = """<PMML version="4.3" xmlns:data="http://example.com/data">
+  <DataDictionary>
+  <DataField name="x1" optype="continuous" dataType="double"/>
+  <DataField name="x2" optype="continuous" dataType="double"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <RegressionModel functionName="regression">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="x1"/><MiningField name="x2"/></MiningSchema>
+  <RegressionTable intercept="0.5">
+    <NumericPredictor name="x1" coefficient="2.0"/>
+    <NumericPredictor name="x2" coefficient="-3.0"/>
+  </RegressionTable>
+  <ModelVerification recordCount="2" fieldCount="3">
+    <VerificationFields>
+      <VerificationField field="x1" column="data:x1"/>
+      <VerificationField field="x2" column="data:x2"/>
+      <VerificationField field="y" column="data:y" precision="1E-5"/>
+    </VerificationFields>
+    <InlineTable>
+      <row><data:x1>1.0</data:x1><data:x2>2.0</data:x2>
+        <data:y>{y1}</data:y></row>
+      <row><data:x1>-0.5</data:x1><data:x2>0.25</data:x2>
+        <data:y>{y2}</data:y></row>
+    </InlineTable>
+  </ModelVerification>
+  </RegressionModel></PMML>"""
+
+# classification: verify label + per-class probability
+CLS = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  <DataField name="cls" optype="categorical" dataType="string">
+    <Value value="pos"/><Value value="neg"/></DataField>
+  </DataDictionary>
+  <RegressionModel functionName="classification"
+      normalizationMethod="softmax">
+  <MiningSchema><MiningField name="cls" usageType="target"/>
+    <MiningField name="x"/></MiningSchema>
+  <RegressionTable intercept="0.0" targetCategory="pos">
+    <NumericPredictor name="x" coefficient="1.0"/>
+  </RegressionTable>
+  <RegressionTable intercept="0.0" targetCategory="neg"/>
+  <ModelVerification recordCount="1" fieldCount="3">
+    <VerificationFields>
+      <VerificationField field="x" column="x"/>
+      <VerificationField field="cls" column="cls"/>
+      <VerificationField field="probability(pos)" column="p_pos"
+          precision="1E-4"/>
+    </VerificationFields>
+    <InlineTable>
+      <row><x>2.0</x><cls>{label}</cls><p_pos>{p}</p_pos></row>
+    </InlineTable>
+  </ModelVerification>
+  </RegressionModel></PMML>"""
+
+
+def _write(tmp_path, xml, name="m.pmml"):
+    p = pathlib.Path(tmp_path, name)
+    p.write_text(xml)
+    return str(p)
+
+
+class TestModelVerification:
+    def test_correct_vectors_load(self, tmp_path):
+        clear_model_cache()
+        path = _write(tmp_path, REG.format(y1="-3.5", y2="-1.25"))
+        cm = ModelReader(path).load()
+        assert cm.has_verification and cm.verify() == []
+
+    def test_wrong_expectation_refused(self, tmp_path):
+        clear_model_cache()
+        path = _write(tmp_path, REG.format(y1="-3.5", y2="7.0"))
+        with pytest.raises(ModelVerificationException, match="row 1"):
+            ModelReader(path).load()
+        # an explicit opt-out still loads (operator override)
+        cm = ModelReader(path).load(verify=False)
+        assert len(cm.verify()) == 1
+        # verification failures are load failures for callers that catch
+        # the typed hierarchy
+        assert issubclass(ModelVerificationException, ModelLoadingException)
+
+    def test_precision_window(self, tmp_path):
+        clear_model_cache()
+        # expected off by 1e-7 relative: inside 1e-5 precision
+        path = _write(tmp_path, REG.format(y1="-3.4999998", y2="-1.25"))
+        assert ModelReader(path).load().verify() == []
+
+    def test_classification_label_and_probability(self, tmp_path):
+        import math
+
+        clear_model_cache()
+        p_pos = 1.0 / (1.0 + math.exp(-2.0))
+        path = _write(
+            tmp_path, CLS.format(label="pos", p=f"{p_pos:.6f}")
+        )
+        assert ModelReader(path).load().verify() == []
+        clear_model_cache()
+        bad = _write(
+            tmp_path, CLS.format(label="neg", p=f"{p_pos:.6f}"), "bad.pmml"
+        )
+        with pytest.raises(ModelVerificationException, match="label"):
+            ModelReader(bad).load()
+
+    def test_unknown_expectation_column(self, tmp_path):
+        doc = parse_pmml(REG.format(y1="-3.5", y2="-1.25").replace(
+            'field="y" column="data:y"', 'field="zzz" column="data:y"'
+        ))
+        cm = compile_pmml(doc)
+        assert any("not an input" in p for p in cm.verify())
+
+    def test_malformed_verification_rejected(self):
+        with pytest.raises(ModelLoadingException):
+            parse_pmml(REG.format(y1="1", y2="1").replace(
+                "<VerificationFields>", "<VerificationFields/>"
+            ).replace(
+                '<VerificationField field="x1" column="data:x1"/>', ""
+            ).replace(
+                '<VerificationField field="x2" column="data:x2"/>', ""
+            ).replace(
+                '<VerificationField field="y" column="data:y" '
+                'precision="1E-5"/>', ""
+            ).replace("</VerificationFields>", ""))
+
+
+CAT = """<PMML version="4.3"><DataDictionary>
+  <DataField name="grade" optype="categorical" dataType="string">
+    <Value value="2"/><Value value="4"/></DataField>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <RegressionModel functionName="regression">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="grade"/></MiningSchema>
+  <RegressionTable intercept="1.0">
+    <CategoricalPredictor name="grade" value="4" coefficient="10.0"/>
+  </RegressionTable>
+  <ModelVerification recordCount="2" fieldCount="2">
+    <VerificationFields>
+      <VerificationField field="grade" column="grade"/>
+      <VerificationField field="y" column="y"/>
+    </VerificationFields>
+    <InlineTable>
+      <row><grade>4</grade><y>11.0</y></row>
+      <row><grade>2</grade><y>1.0</y></row>
+    </InlineTable>
+  </ModelVerification>
+  </RegressionModel></PMML>"""
+
+NUMLABEL = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  <DataField name="cls" optype="categorical" dataType="string">
+    <Value value="0"/><Value value="1"/></DataField>
+  </DataDictionary>
+  <RegressionModel functionName="classification"
+      normalizationMethod="softmax">
+  <MiningSchema><MiningField name="cls" usageType="target"/>
+    <MiningField name="x"/></MiningSchema>
+  <RegressionTable intercept="0.0" targetCategory="1">
+    <NumericPredictor name="x" coefficient="1.0"/>
+  </RegressionTable>
+  <RegressionTable intercept="0.0" targetCategory="0"/>
+  <ModelVerification recordCount="1" fieldCount="2">
+    <VerificationFields>
+      <VerificationField field="x" column="x"/>
+      <VerificationField field="cls" column="cls"/>
+    </VerificationFields>
+    <InlineTable><row><x>3.0</x><cls>1</cls></row></InlineTable>
+  </ModelVerification>
+  </RegressionModel></PMML>"""
+
+
+class TestVerificationEdgeCases:
+    def test_numeric_looking_categorical_input(self, tmp_path):
+        # category "4" must ride the codec, not float-coerce past it
+        clear_model_cache()
+        path = _write(tmp_path, CAT)
+        assert ModelReader(path).load().verify() == []
+
+    def test_numeric_class_label_expectation(self, tmp_path):
+        # classification predictedValue compares as the LABEL "1", never
+        # against the winning probability
+        clear_model_cache()
+        path = _write(tmp_path, NUMLABEL)
+        assert ModelReader(path).load().verify() == []
+
+    def test_cache_does_not_bypass_verification(self, tmp_path):
+        clear_model_cache()
+        path = _write(tmp_path, REG.format(y1="-3.5", y2="7.0"))
+        # operator override loads (and caches) the failing model...
+        ModelReader(path).load(verify=False)
+        # ...but a default load must STILL refuse it, cache hit or not
+        with pytest.raises(ModelVerificationException):
+            ModelReader(path).load()
