@@ -38,6 +38,8 @@ _MODEL_TYPES = (
     "generalLinear",
     "generalizedLinear",
     "multinomialLogistic",
+    "ordinalMultinomial",
+    "CoxRegression",
 )
 
 
@@ -128,7 +130,47 @@ def lower_general_regression(
         used[c] = True
 
     multinomial = model.model_type == "multinomialLogistic"
-    if multinomial:
+    ordinal = model.model_type == "ordinalMultinomial"
+    cox = model.model_type == "CoxRegression"
+    if cox:
+        if not model.baseline_cells or model.end_time_variable is None:
+            raise ModelCompilationException(
+                "CoxRegression needs endTimeVariable and "
+                "BaseCumHazardTables"
+            )
+        cox_tcol = ctx.column(model.end_time_variable)
+        used[cox_tcol] = True  # a missing end time empties the lane
+    if ordinal:
+        # cumulative-link model: per-category thresholds for the first
+        # C−1 categories + shared slopes, P(y ≤ c_j) = g⁻¹(η_j), class
+        # probabilities as successive differences
+        cats_o = list(model.target_categories)
+        if len(cats_o) < 2:
+            raise ModelCompilationException(
+                "ordinalMultinomial needs resolved target_categories "
+                "(parse_pmml fills them from the target DataField)"
+            )
+        labels = tuple(cats_o)
+        J = len(cats_o) - 1  # thresholds
+        beta = np.zeros((P, J), np.float32)
+        for c in model.p_cells:
+            if c.parameter not in pidx:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
+            if c.target_category is None:
+                beta[pidx[c.parameter], :] += c.beta  # shared slope
+            elif c.target_category in cats_o[:-1]:
+                beta[
+                    pidx[c.parameter], cats_o.index(c.target_category)
+                ] += c.beta
+            else:
+                raise ModelCompilationException(
+                    f"ordinalMultinomial PCell targets "
+                    f"{c.target_category!r} — the LAST category carries "
+                    "no threshold"
+                )
+    elif multinomial:
         cats, ref = _resolve_categories(model, ctx)
         labels = tuple(cats) + (ref,)
         T = len(cats)
@@ -167,7 +209,17 @@ def lower_general_regression(
         else "identity"
     )
     inverse_link(link, jnp.zeros(()), model.link_power)  # validate now
+    if ordinal:
+        inverse_link(model.cumulative_link, jnp.zeros(()))
     params = {"beta": beta}
+    if cox:
+        # step function as a searchsorted index into [0, H₀(t₁)…H₀(t_K)]
+        times = np.asarray([t for t, _ in model.baseline_cells], np.float32)
+        haz = np.asarray(
+            [0.0] + [h for _, h in model.baseline_cells], np.float32
+        )
+        params["cox_times"] = times
+        params["cox_haz"] = haz
 
     def fn(p, X, M):
         B = X.shape[0]
@@ -185,6 +237,20 @@ def lower_general_regression(
             ind = (X[:, col] == jnp.float32(code)).astype(jnp.float32)
             x = x.at[:, pi].multiply(ind)
         eta = jnp.dot(x, p["beta"])  # [B, T or 1]
+        if ordinal:
+            cum = inverse_link(model.cumulative_link, eta)  # [B, J]
+            lead = cum[:, :1]
+            mids = cum[:, 1:] - cum[:, :-1]
+            last = 1.0 - cum[:, -1:]
+            probs = jnp.concatenate([lead, mids, last], axis=1)
+            lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            return ModelOutput(
+                value=value.astype(jnp.float32),
+                valid=~missing,
+                probs=probs.astype(jnp.float32),
+                label_idx=lab,
+            )
         if multinomial:
             full = jnp.concatenate(
                 [eta, jnp.zeros((B, 1), jnp.float32)], axis=1
@@ -199,6 +265,23 @@ def lower_general_regression(
                 valid=~missing,
                 probs=probs,
                 label_idx=lab,
+            )
+        if cox:
+            # H₀(t): largest baseline time ≤ t (0 before the first)
+            t = X[:, cox_tcol]
+            idx = jnp.searchsorted(p["cox_times"], t, side="right")
+            h0 = jnp.take(p["cox_haz"], idx)
+            surv = jnp.exp(-h0 * jnp.exp(eta[:, 0]))
+            valid = ~missing
+            if model.max_time is not None:
+                # the fitted baseline covers [0, maxTime]; beyond it the
+                # hazard is undefined — empty lane, not extrapolation
+                valid = valid & (t <= jnp.float32(model.max_time))
+            return ModelOutput(
+                value=surv.astype(jnp.float32),
+                valid=valid,
+                probs=None,
+                label_idx=None,
             )
         mu = inverse_link(link, eta[:, 0], model.link_power)
         return ModelOutput(

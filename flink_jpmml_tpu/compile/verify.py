@@ -35,10 +35,24 @@ def _as_float(raw: str) -> Optional[float]:
         return None
 
 
+# The replay target is the float32 compiled path while producers compute
+# expectations in double precision; the spec defaults (precision 1e-6,
+# zeroThreshold 1e-16) are tighter than f32 arithmetic can honor (a long
+# ensemble sum accumulates ~1e-5 relative; f32 softmax turns an exact 0
+# into ~1e-8). Tolerances are floored to f32-realistic values so correct
+# models with default-tolerance vectors aren't refused; stricter-than-
+# floor producer values still apply above the floor.
+_F32_PRECISION_FLOOR = 1e-4
+_F32_ZERO_FLOOR = 1e-6
+
+
 def _num_close(got: float, exp: float, vf: ir.VerificationField) -> bool:
-    if abs(exp) <= vf.zero_threshold:
-        return abs(got) <= vf.zero_threshold
-    return abs(got - exp) <= vf.precision * abs(exp)
+    zero = max(vf.zero_threshold, _F32_ZERO_FLOOR)
+    if abs(exp) <= zero:
+        return abs(got) <= zero
+    return abs(got - exp) <= max(
+        vf.precision, _F32_PRECISION_FLOOR
+    ) * abs(exp)
 
 
 def run_verification(model, target_field: Optional[str]) -> List[str]:

@@ -961,6 +961,82 @@ def _eval_general_regression(
             except (ValueError, OverflowError):
                 x[cell.parameter] *= float("nan")
 
+    if model.model_type == "CoxRegression":
+        if not model.baseline_cells or model.end_time_variable is None:
+            raise ModelCompilationException(
+                "CoxRegression needs endTimeVariable and "
+                "BaseCumHazardTables"
+            )
+        t = _as_float(record.get(model.end_time_variable))
+        if t is None:
+            return EvalResult()
+        if model.max_time is not None and t > model.max_time:
+            # the fitted baseline covers [0, maxTime]; beyond it the
+            # hazard is undefined — empty lane, not extrapolation
+            return EvalResult()
+        eta = 0.0
+        for c in model.p_cells:
+            if c.target_category is not None:
+                raise ModelCompilationException(
+                    "CoxRegression PCells take no targetCategory"
+                )
+            if c.parameter not in x:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
+            eta += c.beta * x[c.parameter]
+        # step lookup: largest baseline time <= t (before the first
+        # event time the baseline hazard is 0); beyond maxTime the
+        # hazard stays at the last cell (no extrapolation)
+        h0 = 0.0
+        for time_, haz in model.baseline_cells:
+            if time_ <= t:
+                h0 = haz
+            else:
+                break
+        surv = math.exp(-h0 * math.exp(eta))
+        return EvalResult(value=surv)
+
+    if model.model_type == "ordinalMultinomial":
+        cats_o = list(model.target_categories)
+        if len(cats_o) < 2:
+            raise ModelCompilationException(
+                "ordinalMultinomial needs resolved target_categories "
+                "(parse_pmml fills them from the target DataField)"
+            )
+        shared = 0.0
+        thresh = {c: 0.0 for c in cats_o[:-1]}
+        for c in model.p_cells:
+            if c.parameter not in x:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
+            if c.target_category is None:
+                shared += c.beta * x[c.parameter]
+            elif c.target_category in thresh:
+                thresh[c.target_category] += c.beta * x[c.parameter]
+            else:
+                raise ModelCompilationException(
+                    f"ordinalMultinomial PCell targets {c.target_category!r}"
+                    " — the LAST category carries no threshold"
+                )
+        # cumulative link: P(y <= c_j) = g⁻¹(α_j + shared)
+        cum = [
+            _glm_inverse_link(
+                model.cumulative_link, thresh[c] + shared, None
+            )
+            for c in cats_o[:-1]
+        ]
+        probs_l = [cum[0]]
+        for j in range(1, len(cum)):
+            probs_l.append(cum[j] - cum[j - 1])
+        probs_l.append(1.0 - cum[-1])
+        probs = dict(zip(cats_o, probs_l))
+        label = max(cats_o, key=lambda c: probs[c])
+        return EvalResult(
+            value=probs[label], label=label, probabilities=probs
+        )
+
     if model.model_type == "multinomialLogistic":
         cats: List[str] = []
         for c in model.p_cells:

@@ -488,6 +488,15 @@ class GeneralRegressionIR:
     link_function: Optional[str] = None  # generalizedLinear
     link_power: Optional[float] = None  # for power link
     target_reference_category: Optional[str] = None
+    # ordinalMultinomial: cumulative-link name + the ordered category
+    # list (the target DataField's declared order, resolved at parse)
+    cumulative_link: str = "logit"
+    target_categories: Tuple[str, ...] = ()
+    # CoxRegression: the record's time field + the fitted baseline
+    # cumulative-hazard step function (time, H₀) sorted by time
+    end_time_variable: Optional[str] = None
+    baseline_cells: Tuple[Tuple[float, float], ...] = ()
+    max_time: Optional[float] = None
     model_name: Optional[str] = None
 
 

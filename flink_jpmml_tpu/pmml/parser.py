@@ -188,9 +188,24 @@ def _resolve_glm_reference(model, dd: ir.DataDictionary):
             model,
             segmentation=dataclasses.replace(seg, segments=new_segs),
         )
+    if not isinstance(model, ir.GeneralRegressionIR):
+        return model
+    if model.model_type == "ordinalMultinomial":
+        # the cumulative-link model needs the target's ORDERED category
+        # list; the declared DataField order carries the ordinality
+        target = model.mining_schema.target_field
+        if target is not None and target in dd:
+            values = dd.field(target).values
+            if len(values) >= 2:
+                return dataclasses.replace(
+                    model, target_categories=tuple(values)
+                )
+        raise ModelLoadingException(
+            "ordinalMultinomial needs a target DataField with >= 2 "
+            "declared values (their order defines the ordinal scale)"
+        )
     if (
-        not isinstance(model, ir.GeneralRegressionIR)
-        or model.model_type != "multinomialLogistic"
+        model.model_type != "multinomialLogistic"
         or model.target_reference_category is not None
     ):
         return model
@@ -1464,6 +1479,7 @@ def _parse_general_regression(elem: ET.Element) -> ir.GeneralRegressionIR:
         )
     p_cells = tuple(p_cells)
     lp = _opt_float(elem, "linkParameter")
+    _cox = _parse_base_cum_hazard(elem)
     return ir.GeneralRegressionIR(
         function_name=elem.get("functionName", "regression"),
         mining_schema=_parse_mining_schema(elem),
@@ -1476,8 +1492,36 @@ def _parse_general_regression(elem: ET.Element) -> ir.GeneralRegressionIR:
         link_function=elem.get("linkFunction"),
         link_power=lp,
         target_reference_category=elem.get("targetReferenceCategory"),
+        cumulative_link=elem.get("cumulativeLinkFunction", "logit"),
+        end_time_variable=elem.get("endTimeVariable"),
+        baseline_cells=_cox[0],
+        max_time=_cox[1],
         model_name=elem.get("modelName"),
     )
+
+
+def _parse_base_cum_hazard(elem: ET.Element):
+    """CoxRegression <BaseCumHazardTables>: flat BaselineCell rows →
+    (((time, cumHazard), …) sorted by time, maxTime). Stratified tables
+    (BaselineStratum / baselineStrataVariable) are rejected."""
+    tables = _child(elem, "BaseCumHazardTables")
+    if tables is None:
+        return (), None
+    if elem.get("baselineStrataVariable") or _child(
+        tables, "BaselineStratum"
+    ) is not None:
+        raise ModelLoadingException(
+            "stratified BaseCumHazardTables are not supported"
+        )
+    cells = []
+    for c in _children(tables, "BaselineCell"):
+        cells.append((_float(c, "time"), _float(c, "cumHazard")))
+    if not cells:
+        raise ModelLoadingException(
+            "BaseCumHazardTables has no BaselineCell rows"
+        )
+    cells.sort(key=lambda t: t[0])
+    return tuple(cells), _opt_float(tables, "maxTime")
 
 
 def _parse_naive_bayes(elem: ET.Element) -> ir.NaiveBayesIR:

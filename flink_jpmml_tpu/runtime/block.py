@@ -453,13 +453,28 @@ class BlockPipelineBase:
             while True:
                 if self._stop.is_set() and not self._drain_all:
                     break  # stop(): skip the uncommitted backlog
+                # with work in flight the first-record wait must be
+                # bounded: an indefinitely-blocked drain on a paused
+                # feed would pin completed batches uncommitted (and
+                # their offsets unsaved) until new data arrives
+                idle_us = (
+                    min(batch_cfg.deadline_us, 20_000)
+                    if in_flight and self._IDLE_WAIT_US < 0
+                    else self._IDLE_WAIT_US
+                )
                 X, offsets = self._ring.drain(
-                    batch_cfg.deadline_us, self._IDLE_WAIT_US
+                    batch_cfg.deadline_us, idle_us
                 )
                 n = X.shape[0]
                 if n == 0:
                     if self._ring.closed:
                         break
+                    # idle stream: the in-flight window would otherwise
+                    # hold completed batches uncommitted until NEW data
+                    # arrives — unbounded tail latency (and a stuck
+                    # committed_offset) on a paused feed. Flush it.
+                    while in_flight:
+                        _finish_one()
                     self._on_idle()
                     continue
                 handle = self._acquire(_drain_inflight_one)

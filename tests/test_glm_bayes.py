@@ -334,3 +334,163 @@ class TestReviewRegressions:
             compile_pmml(doc)
         with pytest.raises(ModelCompilationException, match="threshold"):
             evaluate(doc, {"outlook": "sunny"})
+
+
+ORDINAL = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x1" optype="continuous" dataType="double"/>
+  <DataField name="grade" optype="ordinal" dataType="string">
+    <Value value="low"/><Value value="mid"/><Value value="high"/>
+  </DataField></DataDictionary>
+  <GeneralRegressionModel functionName="classification"
+      modelType="ordinalMultinomial" cumulativeLinkFunction="{clink}">
+  <MiningSchema><MiningField name="grade" usageType="target"/>
+    <MiningField name="x1"/></MiningSchema>
+  <ParameterList>
+    <Parameter name="p0" label="threshold"/>
+    <Parameter name="p1"/>
+  </ParameterList>
+  <CovariateList><Predictor name="x1"/></CovariateList>
+  <PPMatrix>
+    <PPCell value="1" predictorName="x1" parameterName="p1"/>
+  </PPMatrix>
+  <ParamMatrix>
+    <PCell parameterName="p0" targetCategory="low" beta="-1.0"/>
+    <PCell parameterName="p0" targetCategory="mid" beta="1.5"/>
+    <PCell parameterName="p1" beta="0.8"/>
+  </ParamMatrix>
+  </GeneralRegressionModel></PMML>"""
+
+
+class TestOrdinalMultinomial:
+    @staticmethod
+    def _inv(clink, eta):
+        import math
+
+        if clink == "logit":
+            return 1.0 / (1.0 + math.exp(-eta))
+        if clink == "probit":
+            return 0.5 * (1.0 + math.erf(eta / math.sqrt(2.0)))
+        if clink == "cloglog":
+            return 1.0 - math.exp(-math.exp(eta))
+        raise AssertionError(clink)
+
+    @pytest.mark.parametrize("clink", ["logit", "probit", "cloglog"])
+    def test_cumulative_link_parity(self, clink):
+        from flink_jpmml_tpu.pmml import parse_pmml
+
+        doc = parse_pmml(ORDINAL.format(clink=clink))
+        cm = compile_pmml(doc)
+        for x1 in (-2.0, -0.5, 0.0, 0.7, 3.0):
+            rec = {"x1": x1}
+            shared = 0.8 * x1
+            c1 = self._inv(clink, -1.0 + shared)  # P(y <= low)
+            c2 = self._inv(clink, 1.5 + shared)  # P(y <= mid)
+            hand = {"low": c1, "mid": c2 - c1, "high": 1.0 - c2}
+            o = evaluate(doc, rec)
+            p = cm.score_records([rec])[0]
+            for cat, exp in hand.items():
+                assert o.probabilities[cat] == pytest.approx(exp, abs=1e-12)
+                assert p.target.probabilities[cat] == pytest.approx(
+                    exp, abs=2e-5
+                )
+            win = max(hand, key=hand.get)
+            assert o.label == win and p.target.label == win
+
+    def test_missing_input_and_rejections(self):
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+            ModelLoadingException,
+        )
+
+        doc = parse_pmml(ORDINAL.format(clink="logit"))
+        cm = compile_pmml(doc)
+        assert cm.score_records([{"x1": None}])[0].is_empty
+        assert evaluate(doc, {"x1": None}).value is None
+        # no declared target values -> no ordinal scale
+        with pytest.raises(ModelLoadingException, match="declared values"):
+            parse_pmml(ORDINAL.format(clink="logit").replace(
+                '<Value value="low"/><Value value="mid"/>'
+                '<Value value="high"/>', ""
+            ))
+        # a threshold on the LAST category is meaningless
+        import dataclasses
+
+        bad = dataclasses.replace(doc, model=dataclasses.replace(
+            doc.model,
+            p_cells=doc.model.p_cells + (
+                type(doc.model.p_cells[0])(
+                    parameter="p0", beta=9.9, target_category="high"
+                ),
+            ),
+        ))
+        with pytest.raises(ModelCompilationException, match="LAST"):
+            compile_pmml(bad)
+
+
+COX = """<PMML version="4.3"><DataDictionary>
+  <DataField name="age" optype="continuous" dataType="double"/>
+  <DataField name="t" optype="continuous" dataType="double"/>
+  <DataField name="surv" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <GeneralRegressionModel functionName="regression"
+      modelType="CoxRegression" endTimeVariable="t">
+  <MiningSchema><MiningField name="surv" usageType="target"/>
+    <MiningField name="age"/><MiningField name="t"/></MiningSchema>
+  <ParameterList><Parameter name="p1"/></ParameterList>
+  <CovariateList><Predictor name="age"/></CovariateList>
+  <PPMatrix>
+    <PPCell value="1" predictorName="age" parameterName="p1"/>
+  </PPMatrix>
+  <ParamMatrix><PCell parameterName="p1" beta="0.03"/></ParamMatrix>
+  <BaseCumHazardTables maxTime="10">
+    <BaselineCell time="1" cumHazard="0.05"/>
+    <BaselineCell time="3" cumHazard="0.12"/>
+    <BaselineCell time="7" cumHazard="0.30"/>
+  </BaseCumHazardTables>
+  </GeneralRegressionModel></PMML>"""
+
+
+class TestCoxRegression:
+    def test_survival_parity(self):
+        import math
+
+        from flink_jpmml_tpu.pmml import parse_pmml
+
+        doc = parse_pmml(COX)
+        cm = compile_pmml(doc)
+        h0 = {0.5: 0.0, 1.0: 0.05, 2.9: 0.05, 3.0: 0.12, 6.0: 0.12,
+              7.5: 0.30, 10.0: 0.30}
+        for t, h in h0.items():
+            for age in (30.0, 55.0):
+                rec = {"age": age, "t": t}
+                hand = math.exp(-h * math.exp(0.03 * age))
+                o = evaluate(doc, rec)
+                p = cm.score_records([rec])[0]
+                assert o.value == pytest.approx(hand, rel=1e-12), (t, age)
+                assert p.score.value == pytest.approx(hand, rel=1e-5), (t, age)
+
+    def test_missing_and_rejections(self):
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        doc = parse_pmml(COX)
+        cm = compile_pmml(doc)
+        assert cm.score_records([{"age": 40.0, "t": None}])[0].is_empty
+        assert evaluate(doc, {"age": 40.0, "t": None}).value is None
+        # beyond maxTime the baseline is undefined: empty, no extrapolation
+        assert cm.score_records([{"age": 40.0, "t": 10.5}])[0].is_empty
+        assert evaluate(doc, {"age": 40.0, "t": 10.5}).value is None
+        with pytest.raises(ModelLoadingException, match="strat"):
+            parse_pmml(COX.replace(
+                'endTimeVariable="t"',
+                'endTimeVariable="t" baselineStrataVariable="s"',
+            ))
+        with pytest.raises(ModelLoadingException, match="BaselineCell"):
+            parse_pmml(COX.replace(
+                '<BaselineCell time="1" cumHazard="0.05"/>', ""
+            ).replace(
+                '<BaselineCell time="3" cumHazard="0.12"/>', ""
+            ).replace(
+                '<BaselineCell time="7" cumHazard="0.30"/>', ""
+            ))

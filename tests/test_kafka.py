@@ -283,3 +283,42 @@ class TestKillResume:
             src.close()
         finally:
             broker2.close()
+
+
+class TestIdleCommit:
+    def test_paused_feed_commits_tail_batch(self, tmp_path):
+        """A feed that stops mid-stream must not pin the final partial
+        batch uncommitted in the in-flight window: committed_offset has
+        to reach the high watermark WITHOUT stop() being called."""
+        doc = parse_pmml_file(
+            gen_gbm(str(tmp_path), n_trees=5, depth=3, n_features=4)
+        )
+        cm = compile_pmml(doc, batch_size=64)
+        N = 200  # 3 full batches of 64 + a 8-record tail
+        data = np.random.default_rng(9).normal(size=(N, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="pause")
+        try:
+            broker.append_rows(data)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "pause", n_cols=4, max_wait_ms=10
+            )
+            done = []
+            pipe = BlockPipeline(
+                src, cm, lambda out, n, off: done.append(n),
+                RuntimeConfig(batch=BatchConfig(size=64, deadline_us=2000)),
+            )
+            pipe.start()
+            deadline = time.monotonic() + 15.0
+            while pipe.committed_offset < N and time.monotonic() < deadline:
+                time.sleep(0.01)
+            committed = pipe.committed_offset  # BEFORE stop
+            pipe.stop()
+            pipe.join(timeout=10.0)
+            src.close()
+            assert committed == N, (
+                f"paused feed left offset at {committed} (<{N}); the "
+                "in-flight window was not flushed on idle"
+            )
+            assert sum(done) == N
+        finally:
+            broker.close()

@@ -207,3 +207,30 @@ class TestVerificationEdgeCases:
         # ...but a default load must STILL refuse it, cache hit or not
         with pytest.raises(ModelVerificationException):
             ModelReader(path).load()
+
+
+class TestDefaultTolerances:
+    def test_spec_default_precision_passes_f32_outputs(self, tmp_path):
+        """Producer-default tolerances (precision 1e-6, zeroThreshold
+        1e-16) must not refuse a correct model over float32 arithmetic:
+        the replay floors them to f32-realistic values."""
+        clear_model_cache()
+        xml = REG.format(y1="-3.5", y2="-1.25").replace(
+            ' precision="1E-5"', ""
+        )
+        # an expectation off by ~4e-5 relative: fails the raw 1e-6
+        # default but sits inside the f32 floor
+        xml = xml.replace("-3.5</data:y>", "-3.50011</data:y>")
+        path = _write(tmp_path, xml)
+        assert ModelReader(path).load().verify() == []
+        # a genuinely wrong expectation still fails through the floor
+        clear_model_cache()
+        bad = _write(
+            tmp_path,
+            REG.format(y1="-3.51", y2="-1.25").replace(
+                ' precision="1E-5"', ""
+            ),
+            "bad.pmml",
+        )
+        with pytest.raises(ModelVerificationException):
+            ModelReader(bad).load()
