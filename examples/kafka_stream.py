@@ -63,11 +63,22 @@ def main() -> None:
             src, cm, sink, cfg, checkpoint=CheckpointManager(ckdir)
         )
 
+    def wait_until(pipe, target, timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while pipe.committed_offset < target:
+            err = getattr(pipe, "_error", None)
+            if err is not None:
+                raise RuntimeError(f"pipeline failed: {err!r}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"stalled at offset {pipe.committed_offset} (<{target})"
+                )
+            time.sleep(0.005)
+
     # first run: stop mid-stream
     src1, pipe1 = make_pipe()
     pipe1.start()
-    while pipe1.committed_offset < N // 3:
-        time.sleep(0.005)
+    wait_until(pipe1, N // 3)
     pipe1.stop()
     pipe1.join(timeout=30.0)
     src1.close()
@@ -79,8 +90,7 @@ def main() -> None:
     print(f"run 2 resumes at offset {pipe2.committed_offset}")
     t0 = time.perf_counter()
     pipe2.start()
-    while pipe2.committed_offset < N:
-        time.sleep(0.01)
+    wait_until(pipe2, N)
     pipe2.stop()
     pipe2.join(timeout=30.0)
     src2.close()

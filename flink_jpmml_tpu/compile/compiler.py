@@ -142,6 +142,9 @@ class CompiledModel:
     # reference (verify() replays them; ModelReader gates loads on it)
     _verification: Optional[ir.ModelVerification] = None
     _target_field: Optional[str] = None
+    # selectAll: segment ids, decoding probs = [values ∥ active] into
+    # the per-segment outputs mapping
+    _segment_ids: Optional[Tuple[str, ...]] = None
 
     @property
     def is_classification(self) -> bool:
@@ -269,6 +272,19 @@ class CompiledModel:
             preds = [
                 p if p.is_empty
                 else dataclasses.replace(p, outputs=self._rule_meta[idx[i]])
+                for i, p in enumerate(preds)
+            ]
+        if self._segment_ids is not None and not self.output_fields:
+            # selectAll: probs = [values ∥ active mask]; surface every
+            # active segment's value (None where inactive), oracle parity
+            S = len(self._segment_ids)
+            P = np.asarray(out.probs)[:n]
+            preds = [
+                p if p.is_empty
+                else dataclasses.replace(p, outputs={"segments": {
+                    sid: (float(P[i, j]) if P[i, S + j] > 0.5 else None)
+                    for j, sid in enumerate(self._segment_ids)
+                }})
                 for i, p in enumerate(preds)
             ]
         if self.output_fields:
@@ -475,6 +491,15 @@ def compile_pmml(
             range(len(rules)),
             key=lambda i: (-rules[i].confidence, -rules[i].support, i),
         ))
+    segment_ids = None
+    if (
+        isinstance(doc.model, ir.MiningModelIR)
+        and doc.model.segmentation.multiple_model_method == "selectAll"
+    ):
+        segment_ids = tuple(
+            s.segment_id or str(i)
+            for i, s in enumerate(doc.model.segmentation.segments)
+        )
     name = getattr(doc.model, "model_name", None)
     return CompiledModel(
         field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
@@ -491,4 +516,5 @@ def compile_pmml(
         _rule_order=rule_order,
         _verification=doc.verification,
         _target_field=doc.target_field,
+        _segment_ids=segment_ids,
     )

@@ -55,6 +55,8 @@ def lower_mining(model: ir.MiningModelIR, ctx: LowerCtx) -> Lowered:
         return _lower_chain(segments, ctx)
     if method == "selectFirst":
         return _lower_select_first(segments, ctx)
+    if method == "selectAll":
+        return _lower_select_all(segments, ctx)
     if method not in _AGG_METHODS:
         raise ModelCompilationException(
             f"unsupported multipleModelMethod {method!r}"
@@ -209,6 +211,64 @@ def _lower_select_first(
         )
 
     return Lowered(fn=fn, params=params, labels=labels)
+
+
+def _lower_select_all(
+    segments: Tuple[ir.Segment, ...], ctx: LowerCtx
+) -> Lowered:
+    """Every active segment's value is surfaced: ``probs`` carries
+    [values ∥ active-mask] as ``[B, 2S]``; the decode side
+    (CompiledModel._segment_ids) turns it into the per-segment outputs
+    mapping. Scalar ``value`` = first active segment's (oracle parity).
+    Regression segments only — a multi-label collection doesn't fit one
+    Prediction."""
+    for s in segments:
+        if s.model.function_name != "regression":
+            raise ModelCompilationException(
+                "selectAll supports regression segments only"
+            )
+    lows = _lower_segments(segments, ctx)
+    if any(l.labels for l in lows):
+        raise ModelCompilationException(
+            "selectAll supports regression segments only"
+        )
+    pred_fns = [
+        None
+        if isinstance(s.predicate, ir.TruePredicate)
+        else lower_predicate(s.predicate, ctx)
+        for s in segments
+    ]
+    params = {f"s{i}": l.params for i, l in enumerate(lows)}
+    S = len(segments)
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        values = []
+        active = []
+        for i, l in enumerate(lows):
+            o = l.fn(p[f"s{i}"], X, M)
+            a = (
+                o.valid
+                if pred_fns[i] is None
+                else o.valid & pred_fns[i](X, M).is_true
+            )
+            values.append(jnp.where(a, o.value, 0.0))
+            active.append(a)
+        V = jnp.stack(values, axis=1)  # [B, S]
+        A = jnp.stack(active, axis=1)  # [B, S]
+        first = jnp.argmax(A, axis=1)
+        value = jnp.take_along_axis(V, first[:, None], axis=1)[:, 0]
+        probs = jnp.concatenate(
+            [V, A.astype(jnp.float32)], axis=1
+        )  # [B, 2S] decode payload
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=jnp.any(A, axis=1),
+            probs=probs,
+            label_idx=None,
+        )
+
+    return Lowered(fn=fn, params=params, labels=())
 
 
 def _lower_aggregate(

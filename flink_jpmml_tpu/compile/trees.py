@@ -1004,5 +1004,13 @@ def lower_tree_ensemble(
 
 
 def lower_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
-    """A standalone TreeModel is an ensemble of one."""
+    """A standalone TreeModel is an ensemble of one — except the
+    fractional-membership strategies, whose weighted-path walk lives in
+    wtrees.py (boolean path matrices cannot express them)."""
+    if model.missing_value_strategy in (
+        "weightedConfidence", "aggregateNodes"
+    ):
+        from flink_jpmml_tpu.compile.wtrees import lower_weighted_tree
+
+        return lower_weighted_tree(model, ctx)
     return lower_tree_ensemble([model], [1.0], "single", ctx)

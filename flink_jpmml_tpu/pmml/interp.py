@@ -558,7 +558,94 @@ def _node_result(node: ir.TreeNode, function_name: str) -> EvalResult:
     return EvalResult(value=v)
 
 
-_TREE_STRATEGIES = ("none", "defaultChild", "lastPrediction", "nullPrediction")
+_TREE_STRATEGIES = (
+    "none", "defaultChild", "lastPrediction", "nullPrediction",
+    "weightedConfidence", "aggregateNodes",
+)
+
+
+def _eval_tree_weighted(
+    model: ir.TreeModelIR, record: Record
+) -> EvalResult:
+    """weightedConfidence / aggregateNodes: an UNKNOWN split routes into
+    every viable child weighted by recordCount share; leaves aggregate
+    weight-normalized (see compile/wtrees.py for the shared semantics)."""
+    strategy = model.missing_value_strategy
+    classification = model.function_name == "classification"
+    if strategy == "weightedConfidence" and not classification:
+        raise ModelCompilationException(
+            "weightedConfidence applies to classification trees"
+        )
+    if strategy == "aggregateNodes" and classification:
+        raise ModelCompilationException(
+            "aggregateNodes applies to regression trees"
+        )
+    leaves: List[Tuple[float, ir.TreeNode]] = []
+
+    def walk(n: ir.TreeNode, w: float) -> None:
+        if n.is_leaf:
+            leaves.append((w, n))
+            return
+        results = [
+            (c, eval_predicate(c.predicate, record)) for c in n.children
+        ]
+        for c, r in results:
+            if r is True:
+                walk(c, w)
+                return
+        viable = [(c, r) for c, r in results if r is None]
+        if not viable:
+            return  # dead end: this weight is lost
+        rcs = []
+        for c, _ in viable:
+            if c.record_count is None:
+                raise ModelCompilationException(
+                    f"{strategy} needs recordCount on every child node "
+                    f"(missing on node {c.node_id!r})"
+                )
+            rcs.append(max(float(c.record_count), 0.0))
+        tot = sum(rcs)
+        if tot <= 0:
+            return
+        for (c, _), rc in zip(viable, rcs):
+            walk(c, w * rc / tot)
+
+    if eval_predicate(model.root.predicate, record) is not True:
+        return EvalResult()
+    walk(model.root, 1.0)
+    total = sum(w for w, _ in leaves)
+    if total <= 0:
+        return EvalResult()
+    if classification:
+        agg: Dict[str, float] = {}
+        for w, leaf in leaves:
+            if not leaf.score_distribution:
+                raise ModelCompilationException(
+                    "weightedConfidence needs a ScoreDistribution on "
+                    "every leaf"
+                )
+            t = sum(sd.record_count for sd in leaf.score_distribution)
+            for sd in leaf.score_distribution:
+                conf = (
+                    sd.confidence
+                    if sd.confidence is not None
+                    else (sd.record_count / t if t > 0 else 0.0)
+                )
+                agg[sd.value] = agg.get(sd.value, 0.0) + w * conf
+        probs = {k: v / total for k, v in agg.items()}
+        label = max(probs, key=lambda k: probs[k])
+        return EvalResult(
+            value=probs[label], label=label, probabilities=probs
+        )
+    s = 0.0
+    for w, leaf in leaves:
+        v = _as_float(leaf.score)
+        if v is None:
+            raise ModelCompilationException(
+                "aggregateNodes needs a numeric score on every leaf"
+            )
+        s += w * v
+    return EvalResult(value=s / total)
 
 
 def _eval_tree(model: ir.TreeModelIR, record: Record) -> EvalResult:
@@ -567,6 +654,10 @@ def _eval_tree(model: ir.TreeModelIR, record: Record) -> EvalResult:
             f"unsupported missingValueStrategy {model.missing_value_strategy!r} "
             f"(supported: {', '.join(_TREE_STRATEGIES)})"
         )
+    if model.missing_value_strategy in (
+        "weightedConfidence", "aggregateNodes"
+    ):
+        return _eval_tree_weighted(model, record)
     node = model.root
     if eval_predicate(node.predicate, record) is not True:
         return EvalResult()
@@ -1702,6 +1793,32 @@ def _eval_mining(model: ir.MiningModelIR, record: Record) -> EvalResult:
             if eval_predicate(seg.predicate, record) is True:
                 return _eval_model(seg.model, record)
         return EvalResult()
+
+    if method == "selectAll":
+        # every active segment's result is surfaced (regression only:
+        # a multi-label collection doesn't fit one Prediction); the
+        # scalar value is the FIRST active segment's, the full mapping
+        # rides ``outputs["segments"]`` — mirroring the compiled decode
+        seg_values: Dict[str, object] = {}
+        first = None
+        for i, seg in enumerate(segments):
+            if seg.model.function_name != "regression":
+                raise ModelCompilationException(
+                    "selectAll supports regression segments only"
+                )
+            sid = seg.segment_id or str(i)
+            if eval_predicate(seg.predicate, record) is not True:
+                seg_values[sid] = None
+                continue
+            r = _eval_model(seg.model, record)
+            seg_values[sid] = r.value
+            if first is None and r.value is not None:
+                first = r.value
+        if first is None:
+            return EvalResult()
+        res = EvalResult(value=first)
+        res.outputs = {"segments": seg_values}
+        return res
 
     # aggregate methods over active segments
     results: List[Tuple[float, EvalResult]] = []

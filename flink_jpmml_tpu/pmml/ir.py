@@ -915,8 +915,10 @@ class VerificationField:
 
     field: str
     column: str
-    precision: float = 1e-6  # relative tolerance for numeric expectations
-    zero_threshold: float = 1e-16  # |expected| below this compares absolutely
+    # None = attribute absent from the document: the replay applies its
+    # f32-realistic defaults; an explicit producer value is used as-is
+    precision: Optional[float] = None
+    zero_threshold: Optional[float] = None
 
 
 @dataclass(frozen=True)

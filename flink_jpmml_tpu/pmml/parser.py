@@ -280,8 +280,8 @@ def _parse_model_verification(
             # the column attribute may carry a namespace prefix
             # ("data:x1"); the row cells are matched by local name
             column=(f.get("column") or name).split(":")[-1],
-            precision=_float(f, "precision", 1e-6),
-            zero_threshold=_float(f, "zeroThreshold", 1e-16),
+            precision=_opt_float(f, "precision"),
+            zero_threshold=_opt_float(f, "zeroThreshold"),
         ))
     if not fields:
         raise ModelLoadingException(

@@ -621,6 +621,8 @@ class MiniKafkaBroker:
         self.host, self.port = self._srv.getsockname()[:2]
         self._closing = False
         self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._conns_mu = threading.Lock()
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -650,6 +652,17 @@ class MiniKafkaBroker:
             self._srv.close()
         except OSError:
             pass
+        # close accepted connections too: a serve thread parked in recv
+        # would otherwise hold the port in ESTABLISHED/CLOSE_WAIT and
+        # make an immediate same-port restart fail with EADDRINUSE
+        # (SO_REUSEADDR only forgives TIME_WAIT)
+        with self._conns_mu:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
         with self._mu:
             self._mu.notify_all()
 
@@ -669,6 +682,8 @@ class MiniKafkaBroker:
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._conns_mu:
+            self._conns.append(conn)
         try:
             while not self._closing:
                 hdr = self._recv_exact(conn, 4)

@@ -250,10 +250,11 @@ class TestKillResume:
         broker.append_rows(data[:250])
         got = []
         pos = 0
-        deadline = time.monotonic() + 15.0
+        deadline = time.monotonic() + 30.0
         while pos < 250 and time.monotonic() < deadline:
             polled = src.poll()
             if polled is None:
+                time.sleep(0.005)
                 continue
             got.append(polled)
             pos += polled[1].shape[0]
@@ -266,10 +267,11 @@ class TestKillResume:
         broker2 = MiniKafkaBroker(topic="r", port=port)
         try:
             broker2.append_rows(data)
-            deadline = time.monotonic() + 15.0
+            deadline = time.monotonic() + 30.0
             while pos < 400 and time.monotonic() < deadline:
                 polled = src.poll()
                 if polled is None:
+                    time.sleep(0.005)
                     continue
                 off, blk = polled
                 assert off == pos  # resumed at exactly the next offset

@@ -125,3 +125,132 @@ class TestLastPrediction:
             </Segmentation>
           </MiningModel></PMML>"""
         _check(parse_pmml(xml), RECORDS)
+
+
+import pytest
+
+WEIGHTED_CONF = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  <DataField name="cls" optype="categorical" dataType="string">
+    <Value value="a"/><Value value="b"/></DataField>
+  </DataDictionary>
+  <TreeModel functionName="classification"
+      missingValueStrategy="weightedConfidence">
+  <MiningSchema><MiningField name="cls" usageType="target"/>
+    <MiningField name="x"/></MiningSchema>
+  <Node id="0" recordCount="100"><True/>
+    <Node id="L" recordCount="60" score="a">
+      <SimplePredicate field="x" operator="lessThan" value="0"/>
+      <ScoreDistribution value="a" recordCount="45"/>
+      <ScoreDistribution value="b" recordCount="15"/>
+    </Node>
+    <Node id="R" recordCount="40" score="b">
+      <SimplePredicate field="x" operator="greaterOrEqual" value="0"/>
+      <ScoreDistribution value="a" recordCount="8"/>
+      <ScoreDistribution value="b" recordCount="32"/>
+    </Node>
+  </Node></TreeModel></PMML>"""
+
+AGG_NODES = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TreeModel functionName="regression"
+      missingValueStrategy="aggregateNodes">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="x"/></MiningSchema>
+  <Node id="0" recordCount="10"><True/>
+    <Node id="L" recordCount="7" score="2.0">
+      <SimplePredicate field="x" operator="lessThan" value="1"/></Node>
+    <Node id="R" recordCount="3" score="10.0">
+      <SimplePredicate field="x" operator="greaterOrEqual" value="1"/></Node>
+  </Node></TreeModel></PMML>"""
+
+
+class TestWeightedStrategies:
+    def test_weighted_confidence_observed_and_missing(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        doc = parse_pmml(WEIGHTED_CONF)
+        cm = compile_pmml(doc)
+        # observed: deterministic leaf confidences
+        for x, exp_a in ((-1.0, 45 / 60), (2.0, 8 / 40)):
+            o = evaluate(doc, {"x": x})
+            p = cm.score_records([{"x": x}])[0]
+            assert o.probabilities["a"] == pytest.approx(exp_a)
+            assert p.target.probabilities["a"] == pytest.approx(
+                exp_a, abs=1e-5
+            )
+        # missing x: both leaves weighted 60/40 by recordCount
+        exp_a = 0.6 * (45 / 60) + 0.4 * (8 / 40)
+        o = evaluate(doc, {"x": None})
+        p = cm.score_records([{"x": None}])[0]
+        assert o.probabilities["a"] == pytest.approx(exp_a)
+        assert o.label == "a"  # 0.53 vs 0.47
+        assert p.target.probabilities["a"] == pytest.approx(exp_a, abs=1e-5)
+        assert p.target.label == "a"
+
+    def test_aggregate_nodes_observed_and_missing(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        doc = parse_pmml(AGG_NODES)
+        cm = compile_pmml(doc)
+        for x, exp in ((0.0, 2.0), (5.0, 10.0)):
+            assert evaluate(doc, {"x": x}).value == pytest.approx(exp)
+            assert cm.score_records([{"x": x}])[0].score.value == (
+                pytest.approx(exp, rel=1e-6)
+            )
+        exp = 0.7 * 2.0 + 0.3 * 10.0
+        assert evaluate(doc, {"x": None}).value == pytest.approx(exp)
+        assert cm.score_records([{"x": None}])[0].score.value == (
+            pytest.approx(exp, rel=1e-5)
+        )
+
+    def test_nested_partial_missing(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        # second level splits on a different field: missing only below
+        xml = AGG_NODES.replace(
+            '<Node id="L" recordCount="7" score="2.0">\n      '
+            '<SimplePredicate field="x" operator="lessThan" value="1"/></Node>',
+            '<Node id="L" recordCount="7">\n      '
+            '<SimplePredicate field="x" operator="lessThan" value="1"/>\n'
+            '      <Node id="LL" recordCount="5" score="1.0">\n        '
+            '<SimplePredicate field="z" operator="lessThan" value="0"/></Node>\n'
+            '      <Node id="LR" recordCount="2" score="4.0">\n        '
+            '<SimplePredicate field="z" operator="greaterOrEqual" value="0"/>'
+            "</Node>\n    </Node>",
+        ).replace(
+            "<DataDictionary>",
+            '<DataDictionary><DataField name="z" optype="continuous" '
+            'dataType="double"/>',
+        ).replace(
+            '<MiningField name="x"/>',
+            '<MiningField name="x"/><MiningField name="z"/>',
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        # x observed (goes left), z missing: leaves LL/LR weighted 5/2
+        exp = (5 / 7) * 1.0 + (2 / 7) * 4.0
+        rec = {"x": 0.0, "z": None}
+        assert evaluate(doc, rec).value == pytest.approx(exp)
+        assert cm.score_records([rec])[0].score.value == pytest.approx(
+            exp, rel=1e-5
+        )
+
+    def test_requires_record_count(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        xml = AGG_NODES.replace(' recordCount="7"', "")
+        with pytest.raises(ModelCompilationException, match="recordCount"):
+            compile_pmml(parse_pmml(xml))

@@ -334,3 +334,82 @@ class TestNestedCompoundPredicates:
         doc = parse_pmml(_nested_tree_xml(pred))
         cm = compile_pmml(doc)
         _assert_match(cm, doc, _nested_records(5))
+
+
+SELECT_ALL = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <MiningModel functionName="regression">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="x"/></MiningSchema>
+  <Segmentation multipleModelMethod="selectAll">
+    <Segment id="lo"><SimplePredicate field="x" operator="lessThan"
+        value="5"/>
+      <TreeModel functionName="regression">
+        <MiningSchema><MiningField name="y" usageType="target"/>
+          <MiningField name="x"/></MiningSchema>
+        <Node id="0" score="1.5"><True/></Node></TreeModel></Segment>
+    <Segment id="hi"><SimplePredicate field="x" operator="greaterOrEqual"
+        value="2"/>
+      <TreeModel functionName="regression">
+        <MiningSchema><MiningField name="y" usageType="target"/>
+          <MiningField name="x"/></MiningSchema>
+        <Node id="0" score="7.25"><True/></Node></TreeModel></Segment>
+  </Segmentation></MiningModel></PMML>"""
+
+
+class TestSelectAll:
+    def test_per_segment_results(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        doc = parse_pmml(SELECT_ALL)
+        cm = compile_pmml(doc)
+        cases = {
+            1.0: {"lo": 1.5, "hi": None},   # only lo active
+            3.0: {"lo": 1.5, "hi": 7.25},   # both
+            9.0: {"lo": None, "hi": 7.25},  # only hi
+        }
+        for x, segs in cases.items():
+            rec = {"x": x}
+            o = evaluate(doc, rec)
+            p = cm.score_records([rec])[0]
+            first = next(v for v in segs.values() if v is not None)
+            assert o.value == pytest.approx(first)
+            assert p.score.value == pytest.approx(first, rel=1e-6)
+            assert o.outputs["segments"] == segs
+            got = p.outputs["segments"]
+            for sid, exp in segs.items():
+                if exp is None:
+                    assert got[sid] is None
+                else:
+                    assert got[sid] == pytest.approx(exp, rel=1e-6)
+
+    def test_none_active_is_empty(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        bad = SELECT_ALL.replace('value="5"', 'value="-99"').replace(
+            'value="2"', 'value="100"'
+        )
+        doc = parse_pmml(bad)
+        cm = compile_pmml(doc)
+        assert evaluate(doc, {"x": 0.0}).value is None
+        assert cm.score_records([{"x": 0.0}])[0].is_empty
+
+    def test_classification_segments_rejected(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        xml = SELECT_ALL.replace(
+            '<TreeModel functionName="regression">',
+            '<TreeModel functionName="classification">',
+        )
+        with pytest.raises(ModelCompilationException, match="regression"):
+            compile_pmml(parse_pmml(xml))
