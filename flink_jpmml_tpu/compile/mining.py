@@ -65,7 +65,15 @@ def lower_mining(model: ir.MiningModelIR, ctx: LowerCtx) -> Lowered:
     all_true = all(
         isinstance(s.predicate, ir.TruePredicate) for s in segments
     )
-    all_trees = all(isinstance(s.model, ir.TreeModelIR) for s in segments)
+    all_trees = all(
+        isinstance(s.model, ir.TreeModelIR)
+        # fractional-membership strategies take the weighted-path walk
+        # (wtrees.py) via the generic per-segment route — the fused
+        # boolean-path ensemble backends cannot express them
+        and s.model.missing_value_strategy
+        not in ("weightedConfidence", "aggregateNodes")
+        for s in segments
+    )
     if all_true and all_trees:
         classification = segments[0].model.function_name == "classification"
         fused_ok = (
@@ -313,7 +321,11 @@ def _lower_aggregate(
                     if pred_fns[i] is None
                     else pred_fns[i](X, M).is_true
                 )
-                glb = maps[i][o.label_idx] if maps[i].size else o.label_idx
+                glb = (
+                    jnp.take(jnp.asarray(maps[i]), o.label_idx)
+                    if maps[i].size
+                    else o.label_idx
+                )
                 w = weights[i] if method == "weightedMajorityVote" else 1.0
                 onehot = jax.nn.one_hot(glb, C, dtype=jnp.float32)
                 # invalid/inactive segments abstain (oracle: excluded from

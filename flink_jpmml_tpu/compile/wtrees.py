@@ -65,6 +65,10 @@ def _leaf_payload(model: ir.TreeModelIR):
                 if sd.value not in labels:
                     labels.append(sd.value)
         conf = np.zeros((len(leaves), len(labels)), np.float32)
+        # the leaf's score attribute is the DETERMINISTIC-path winner
+        # (it may legally disagree with the max confidence); −1 = no
+        # score declared, fall back to the confidence argmax
+        leaf_label = np.full((len(leaves),), -1, np.int32)
         for li, leaf in enumerate(leaves):
             tot = sum(sd.record_count for sd in leaf.score_distribution)
             for sd in leaf.score_distribution:
@@ -74,7 +78,9 @@ def _leaf_payload(model: ir.TreeModelIR):
                     else (sd.record_count / tot if tot > 0 else 0.0)
                 )
                 conf[li, labels.index(sd.value)] = c
-        return leaves, tuple(labels), conf
+            if leaf.score is not None and leaf.score in labels:
+                leaf_label[li] = labels.index(leaf.score)
+        return leaves, tuple(labels), (conf, leaf_label)
     vals = np.zeros((len(leaves),), np.float32)
     for li, leaf in enumerate(leaves):
         if leaf.score is None:
@@ -102,6 +108,8 @@ def lower_weighted_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
             "aggregateNodes applies to regression trees"
         )
     leaves, labels, payload = _leaf_payload(model)
+    if classification:
+        payload, leaf_label = payload
     leaf_index = {id(leaf): i for i, leaf in enumerate(leaves)}
     root_pred = lower_predicate(model.root.predicate, ctx)
 
@@ -129,6 +137,8 @@ def lower_weighted_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
 
     prewalk(model.root)
     params: dict = {"payload": payload}
+    if classification:
+        params["leaf_label"] = leaf_label
 
     def fn(p, X, M):
         B = X.shape[0]
@@ -178,6 +188,18 @@ def lower_weighted_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
         if classification:
             probs = jnp.matmul(W, p["payload"]) / tz  # [B, C]
             lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+            # deterministic path (all weight on one leaf): the leaf's
+            # score attribute wins, exactly like the boolean-path
+            # backends — it may legally disagree with the max confidence
+            wmax_leaf = jnp.argmax(W, axis=1)
+            det = (
+                jnp.take_along_axis(W, wmax_leaf[:, None], axis=1)[:, 0]
+                >= total - 1e-6
+            )
+            det_lab = jnp.take(p["leaf_label"], wmax_leaf)
+            lab = jnp.where(det & (det_lab >= 0), det_lab, lab).astype(
+                jnp.int32
+            )
             value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
             return ModelOutput(
                 value=value.astype(jnp.float32),

@@ -633,9 +633,16 @@ def _eval_tree_weighted(
                 )
                 agg[sd.value] = agg.get(sd.value, 0.0) + w * conf
         probs = {k: v / total for k, v in agg.items()}
-        label = max(probs, key=lambda k: probs[k])
+        # deterministic path (all weight on one leaf): the leaf's score
+        # attribute wins — exactly like the non-weighted strategies; it
+        # may legally disagree with the max confidence
+        wbest, lbest = max(leaves, key=lambda t: t[0])
+        if wbest >= total - 1e-12 and lbest.score is not None:
+            label = lbest.score
+        else:
+            label = max(probs, key=lambda k: probs[k])
         return EvalResult(
-            value=probs[label], label=label, probabilities=probs
+            value=probs.get(label), label=label, probabilities=probs
         )
     s = 0.0
     for w, leaf in leaves:

@@ -132,7 +132,7 @@ def parse_pmml(xml_text: str) -> ir.PmmlDocument:
     header = _parse_header(_child(root, "Header"))
     dd_elem = _req_child(root, "DataDictionary")
     data_dictionary = _parse_data_dictionary(dd_elem)
-    transformations = _parse_transformation_dictionary(
+    transformations, user_fns = _parse_transformation_dictionary(
         _child(root, "TransformationDictionary")
     )
 
@@ -148,6 +148,19 @@ def parse_pmml(xml_text: str) -> ir.PmmlDocument:
 
     model = _parse_model(model_elem)
     model = _resolve_glm_reference(model, data_dictionary)
+    # the top-level model's LocalTransformations extend the
+    # TransformationDictionary chain (TD fields first, so LT fields may
+    # reference them; both may call TD-defined functions). Segment-
+    # nested LocalTransformations are rejected in _parse_mining_model.
+    lt = _child(model_elem, "LocalTransformations")
+    if lt is not None:
+        local_dfs = tuple(
+            _expand_derived_field(_parse_derived_field(df), user_fns)
+            for df in _children(lt, "DerivedField")
+        )
+        transformations = ir.TransformationDictionary(
+            derived_fields=transformations.derived_fields + local_dfs
+        )
     targets = _parse_targets(_child(model_elem, "Targets"))
     output_fields = _parse_output(_child(model_elem, "Output"))
     verification = _parse_model_verification(
@@ -375,13 +388,106 @@ def _parse_mining_schema(elem: ET.Element) -> ir.MiningSchema:
     return ir.MiningSchema(fields=tuple(fields))
 
 
-def _parse_transformation_dictionary(
-    elem: Optional[ET.Element],
-) -> ir.TransformationDictionary:
+def _parse_transformation_dictionary(elem: Optional[ET.Element]):
+    """→ (TransformationDictionary, user-function table for reuse by
+    the model's LocalTransformations)."""
     if elem is None:
-        return ir.TransformationDictionary()
-    dfs = tuple(_parse_derived_field(df) for df in _children(elem, "DerivedField"))
-    return ir.TransformationDictionary(derived_fields=dfs)
+        return ir.TransformationDictionary(), {}
+    # DefineFunctions expand at parse time: every Apply of a user
+    # function inlines the (already-expanded) body with ParameterFields
+    # substituted by the argument expressions — downstream (oracle and
+    # lowering) only ever sees built-ins. Non-recursive by construction:
+    # a body can only call functions defined before it.
+    fns: dict = {}
+    for df in _children(elem, "DefineFunction"):
+        name = df.get("name")
+        if not name:
+            raise ModelLoadingException("DefineFunction needs a name")
+        params = [
+            pf.get("name", "")
+            for pf in _children(df, "ParameterField")
+        ]
+        body = None
+        for c in df:
+            if _local(c.tag) == "ParameterField":
+                continue
+            body = _try_parse_expression(c)
+            if body is not None:
+                break
+        if body is None:
+            raise ModelLoadingException(
+                f"DefineFunction {name!r} has no supported expression body"
+            )
+        fns[name] = (tuple(params), _expand_user_fns(body, fns))
+    dfs = tuple(
+        _expand_derived_field(_parse_derived_field(df), fns)
+        for df in _children(elem, "DerivedField")
+    )
+    return ir.TransformationDictionary(derived_fields=dfs), fns
+
+
+def _expand_derived_field(df: ir.DerivedField, fns: dict) -> ir.DerivedField:
+    import dataclasses
+
+    if not fns:
+        return df
+    return dataclasses.replace(
+        df, expression=_expand_user_fns(df.expression, fns)
+    )
+
+
+def _expand_user_fns(expr: ir.Expression, fns: dict) -> ir.Expression:
+    """Inline user-function Applies (bodies are pre-expanded)."""
+    import dataclasses
+
+    if isinstance(expr, ir.Apply):
+        args = tuple(_expand_user_fns(a, fns) for a in expr.args)
+        if expr.function in fns:
+            params, body = fns[expr.function]
+            if len(args) != len(params):
+                raise ModelLoadingException(
+                    f"function {expr.function!r} takes {len(params)} "
+                    f"argument(s), got {len(args)}"
+                )
+            out = _substitute_params(body, dict(zip(params, args)))
+            if expr.map_missing_to is not None:
+                # the call site's mapMissingTo fires when the *function
+                # result* is missing: wrap the inlined body in a no-op
+                # Apply that carries it (never clobber the body's own)
+                out = ir.Apply(
+                    function="+",
+                    args=(out, ir.Constant(0.0)),
+                    map_missing_to=expr.map_missing_to,
+                )
+            return out
+        return dataclasses.replace(expr, args=args)
+    return expr
+
+
+def _substitute_params(
+    expr: ir.Expression, sub: dict
+) -> ir.Expression:
+    """ParameterField references (FieldRefs by name) → argument exprs."""
+    import dataclasses
+
+    if isinstance(expr, ir.FieldRef):
+        return sub.get(expr.field, expr)
+    if isinstance(expr, ir.Apply):
+        return dataclasses.replace(
+            expr,
+            args=tuple(_substitute_params(a, sub) for a in expr.args),
+        )
+    if isinstance(expr, (ir.NormContinuous, ir.NormDiscrete)):
+        if expr.field in sub:
+            arg = sub[expr.field]
+            if not isinstance(arg, ir.FieldRef):
+                raise ModelLoadingException(
+                    "a ParameterField used as a Norm* field must be "
+                    "bound to a FieldRef argument"
+                )
+            return dataclasses.replace(expr, field=arg.field)
+        return expr
+    return expr
 
 
 def _parse_derived_field(elem: ET.Element) -> ir.DerivedField:
@@ -1900,6 +2006,12 @@ def _parse_mining_model(elem: ET.Element) -> ir.MiningModelIR:
         if model_elem is None:
             raise ModelLoadingException(
                 f"Segment {s.get('id')!r} has no supported embedded model"
+            )
+        if _child(model_elem, "LocalTransformations") is not None:
+            raise ModelLoadingException(
+                "LocalTransformations inside MiningModel segments are "
+                "not supported (top-level model LocalTransformations "
+                "and the TransformationDictionary are)"
             )
         out_fields = []
         out_elem = _child(model_elem, "Output")

@@ -674,6 +674,17 @@ class MiniKafkaBroker:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            # register BEFORE spawning, and re-check _closing after: a
+            # close() racing this accept must still find (or beat) the
+            # connection in _conns so no socket outlives the broker
+            with self._conns_mu:
+                self._conns.append(conn)
+            if self._closing:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             t = threading.Thread(
                 target=self._serve, args=(conn,), daemon=True
             )
@@ -682,8 +693,6 @@ class MiniKafkaBroker:
 
     def _serve(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        with self._conns_mu:
-            self._conns.append(conn)
         try:
             while not self._closing:
                 hdr = self._recv_exact(conn, 4)
@@ -710,6 +719,13 @@ class MiniKafkaBroker:
                 conn.close()
             except OSError:
                 pass
+            # drop the registry entry: a long-lived broker must not
+            # accumulate closed sockets across normal disconnects
+            with self._conns_mu:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:

@@ -118,3 +118,127 @@ class TestDerivedFields:
         preds = cm.score_records([{"b": 1.0}])
         res = evaluate(doc, {"b": 1.0})
         assert preds[0].is_empty == (res.value is None)
+
+
+import pytest
+
+DEFINE_FN = """<PMML version="4.3"><DataDictionary>
+  <DataField name="c" optype="continuous" dataType="double"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TransformationDictionary>
+    <DefineFunction name="c2f">
+      <ParameterField name="t"/>
+      <Apply function="+">
+        <Apply function="*"><FieldRef field="t"/><Constant>1.8</Constant>
+        </Apply><Constant>32</Constant></Apply>
+    </DefineFunction>
+    <DefineFunction name="f2k">
+      <ParameterField name="t"/>
+      <Apply function="*">
+        <Apply function="+"><Apply function="c2f"><FieldRef field="t"/>
+          </Apply><Constant>459.67</Constant></Apply>
+        <Constant>0.5555555555555556</Constant></Apply>
+    </DefineFunction>
+    <DerivedField name="kelvin" optype="continuous" dataType="double">
+      <Apply function="f2k"><FieldRef field="c"/></Apply>
+    </DerivedField>
+  </TransformationDictionary>
+  <RegressionModel functionName="regression">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="c"/></MiningSchema>
+  <RegressionTable intercept="0.0">
+    <NumericPredictor name="kelvin" coefficient="1.0"/>
+  </RegressionTable></RegressionModel></PMML>"""
+
+LOCAL_TX = """<PMML version="4.3"><DataDictionary>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TransformationDictionary>
+    <DerivedField name="x2" optype="continuous" dataType="double">
+      <Apply function="*"><FieldRef field="x"/><FieldRef field="x"/></Apply>
+    </DerivedField>
+  </TransformationDictionary>
+  <RegressionModel functionName="regression">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="x"/></MiningSchema>
+  <LocalTransformations>
+    <DerivedField name="lx" optype="continuous" dataType="double">
+      <Apply function="+"><FieldRef field="x2"/><Constant>1</Constant>
+      </Apply>
+    </DerivedField>
+  </LocalTransformations>
+  <RegressionTable intercept="0.5">
+    <NumericPredictor name="lx" coefficient="2.0"/>
+  </RegressionTable></RegressionModel></PMML>"""
+
+
+class TestDefineFunction:
+    def test_nested_user_functions_inline(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        doc = parse_pmml(DEFINE_FN)
+        cm = compile_pmml(doc)
+        for c in (-40.0, 0.0, 25.0, 100.0):
+            hand = ((c * 1.8 + 32) + 459.67) * 0.5555555555555556
+            assert evaluate(doc, {"c": c}).value == pytest.approx(hand)
+            assert cm.score_records([{"c": c}])[0].score.value == (
+                pytest.approx(hand, rel=1e-5)
+            )
+
+    def test_arity_mismatch_rejected(self):
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        bad = DEFINE_FN.replace(
+            '<Apply function="c2f"><FieldRef field="t"/>\n          </Apply>',
+            '<Apply function="c2f"><FieldRef field="t"/>'
+            "<Constant>1</Constant></Apply>",
+        )
+        with pytest.raises(ModelLoadingException, match="argument"):
+            parse_pmml(bad)
+
+
+class TestLocalTransformations:
+    def test_local_fields_see_dictionary_fields(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        doc = parse_pmml(LOCAL_TX)
+        cm = compile_pmml(doc)
+        for x in (0.0, 1.5, -2.0):
+            hand = 0.5 + 2.0 * (x * x + 1)
+            assert evaluate(doc, {"x": x}).value == pytest.approx(hand)
+            assert cm.score_records([{"x": x}])[0].score.value == (
+                pytest.approx(hand, rel=1e-6)
+            )
+
+    def test_segment_local_transformations_rejected(self):
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        xml = """<PMML version="4.3"><DataDictionary>
+          <DataField name="x" optype="continuous" dataType="double"/>
+          <DataField name="y" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <MiningModel functionName="regression">
+          <MiningSchema><MiningField name="y" usageType="target"/>
+            <MiningField name="x"/></MiningSchema>
+          <Segmentation multipleModelMethod="sum">
+            <Segment><True/>
+              <RegressionModel functionName="regression">
+                <MiningSchema><MiningField name="y" usageType="target"/>
+                  <MiningField name="x"/></MiningSchema>
+                <LocalTransformations>
+                  <DerivedField name="q" optype="continuous"
+                      dataType="double"><FieldRef field="x"/></DerivedField>
+                </LocalTransformations>
+                <RegressionTable intercept="1.0"/>
+              </RegressionModel></Segment>
+          </Segmentation></MiningModel></PMML>"""
+        with pytest.raises(ModelLoadingException, match="LocalTransformations"):
+            parse_pmml(xml)

@@ -254,3 +254,59 @@ class TestWeightedStrategies:
         xml = AGG_NODES.replace(' recordCount="7"', "")
         with pytest.raises(ModelCompilationException, match="recordCount"):
             compile_pmml(parse_pmml(xml))
+
+
+class TestWeightedStrategyEdges:
+    def test_deterministic_path_uses_leaf_score(self):
+        """A leaf whose score attr disagrees with its max confidence:
+        on a fully-observed path weightedConfidence must behave exactly
+        like the boolean backends (leaf score wins)."""
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        xml = WEIGHTED_CONF.replace(
+            '<ScoreDistribution value="a" recordCount="45"/>\n      '
+            '<ScoreDistribution value="b" recordCount="15"/>',
+            '<ScoreDistribution value="a" recordCount="24"/>\n      '
+            '<ScoreDistribution value="b" recordCount="36"/>',
+        )  # leaf L: score="a" but b has higher confidence
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"x": -1.0}  # deterministic: leaf L
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.label == "a" and p.target.label == "a"
+        # fractional path still aggregates and argmaxes
+        exp_a = 0.6 * (24 / 60) + 0.4 * (8 / 40)
+        o = evaluate(doc, {"x": None})
+        p = cm.score_records([{"x": None}])[0]
+        assert o.label == "b" == p.target.label
+        assert o.probabilities["a"] == pytest.approx(exp_a)
+
+    def test_ensemble_of_weighted_trees(self):
+        """A majorityVote ensemble of all-True weightedConfidence trees
+        must route through the per-segment path, not the fused one."""
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        tree = WEIGHTED_CONF[
+            WEIGHTED_CONF.index('<TreeModel'):
+            WEIGHTED_CONF.index('</TreeModel>') + len('</TreeModel>')
+        ]
+        xml = WEIGHTED_CONF[:WEIGHTED_CONF.index('<TreeModel')].replace(
+            "<TreeModel", ""
+        ) + f"""<MiningModel functionName="classification">
+  <MiningSchema><MiningField name="cls" usageType="target"/>
+    <MiningField name="x"/></MiningSchema>
+  <Segmentation multipleModelMethod="majorityVote">
+    <Segment><True/>{tree}</Segment>
+    <Segment><True/>{tree}</Segment>
+  </Segmentation></MiningModel></PMML>"""
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)  # must not raise
+        for rec in ({"x": -1.0}, {"x": 2.0}, {"x": None}):
+            o = evaluate(doc, rec)
+            p = cm.score_records([rec])[0]
+            assert p.target.label == o.label, rec
