@@ -104,16 +104,69 @@ def make_distance(
     return dist
 
 
+def similarity_params(measure: ir.ComparisonMeasure):
+    """Binary-similarity (numerator, denominator) weights over the
+    per-pair contingency counts (a = 1∧1, b = 1∧0, c = 0∧1, d = 0∧0) —
+    one definition shared by the lowerings and the oracle:
+
+        simpleMatching (a+d)/(a+b+c+d)   jaccard a/(a+b+c)
+        tanimoto (a+d)/(a+2(b+c)+d)      binarySimilarity per c/d params
+    """
+    m = measure.metric
+    if m == "simpleMatching":
+        return (1, 0, 0, 1), (1, 1, 1, 1)
+    if m == "jaccard":
+        return (1, 0, 0, 0), (1, 1, 1, 0)
+    if m == "tanimoto":
+        return (1, 0, 0, 1), (1, 2, 2, 1)
+    if m == "binarySimilarity":
+        if len(measure.binary_params) != 8:
+            raise ModelCompilationException(
+                "binarySimilarity needs its eight c/d parameters"
+            )
+        c00, c01, c10, c11, d00, d01, d10, d11 = measure.binary_params
+        # contingency order here is (a=11, b=10, c=01, d=00)
+        return (c11, c10, c01, c00), (d11, d10, d01, d00)
+    raise ModelCompilationException(
+        f"unsupported similarity metric {m!r}"
+    )
+
+
+def make_similarity(measure: ir.ComparisonMeasure, weights: np.ndarray):
+    """→ f(xs [B,D], refs [K,D]) -> similarities [B,K]. Fields are
+    binary (value > 0.5 ⇔ set, the framework's multi-hot convention);
+    field weights scale each pair's contribution to every count. The
+    whole thing is four masked matmuls — MXU-shaped."""
+    num, den = similarity_params(measure)
+
+    def sim(xs, refs):
+        x = (xs > 0.5).astype(jnp.float32) * weights[None, :]
+        xc = (xs <= 0.5).astype(jnp.float32) * weights[None, :]
+        z = (refs > 0.5).astype(jnp.float32)
+        zc = (refs <= 0.5).astype(jnp.float32)
+        a = x @ z.T  # both set
+        b = x @ zc.T  # record only
+        c = xc @ z.T  # reference only
+        d = xc @ zc.T  # neither
+        numer = num[0] * a + num[1] * b + num[2] * c + num[3] * d
+        denom = den[0] * a + den[1] * b + den[2] * c + den[3] * d
+        return jnp.where(denom > 0, numer / jnp.maximum(denom, 1e-30), 0.0)
+
+    return sim
+
+
 def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
     if model.model_class != "centerBased":
         raise ModelCompilationException(
             f"unsupported ClusteringModel class {model.model_class!r}"
         )
-    if model.measure.kind != "distance":
-        raise ModelCompilationException(
-            f"unsupported ComparisonMeasure kind {model.measure.kind!r}"
-        )
-    cmp_codes, gauss_s = resolve_compare(model)
+    similarity = model.measure.kind == "similarity"
+    # compare functions only shape the DISTANCE path; resolving them for
+    # a similarity measure could spuriously reject (e.g. an irrelevant
+    # gaussSim without similarityScale) models the oracle accepts
+    cmp_codes = gauss_s = None
+    if not similarity:
+        cmp_codes, gauss_s = resolve_compare(model)
     cols = np.asarray(
         [ctx.column(cf.field) for cf in model.clustering_fields], np.int32
     )
@@ -130,17 +183,22 @@ def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
         c.cluster_id or c.name or str(i + 1) for i, c in enumerate(model.clusters)
     )
     params = {"centers": centers}
-    dist = make_distance(model.measure, cmp_codes, gauss_s, weights)
+    score = (
+        make_similarity(model.measure, weights)
+        if similarity
+        else make_distance(model.measure, cmp_codes, gauss_s, weights)
+    )
 
     def fn(p, X, M):
         xs = X[:, cols]  # [B, D]
         missing = jnp.any(M[:, cols], axis=1)
-        d = dist(xs, p["centers"])
-        label_idx = jnp.argmin(d, axis=1).astype(jnp.int32)
+        d = score(xs, p["centers"])
+        pick = jnp.argmax if similarity else jnp.argmin
+        label_idx = pick(d, axis=1).astype(jnp.int32)
         return ModelOutput(
             value=label_idx.astype(jnp.float32),
             valid=~missing,
-            probs=d,  # per-cluster distances (oracle exposes the winner's)
+            probs=d,  # per-cluster distances/similarities
             label_idx=label_idx,
         )
 

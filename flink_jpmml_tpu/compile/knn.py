@@ -25,6 +25,7 @@ import numpy as np
 
 from flink_jpmml_tpu.compile.clustering import (
     make_distance,
+    make_similarity,
     resolve_compare_fields,
 )
 from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
@@ -35,16 +36,18 @@ _EPS = 1e-9
 
 
 def lower_knn(model: ir.NearestNeighborIR, ctx: LowerCtx) -> Lowered:
-    if model.measure.kind != "distance":
-        raise ModelCompilationException(
-            f"unsupported ComparisonMeasure kind {model.measure.kind!r}"
-        )
+    similarity = model.measure.kind == "similarity"
     cols = np.asarray([ctx.column(i.field) for i in model.inputs], np.int32)
     weights = np.asarray([i.weight for i in model.inputs], np.float32)
-    cmp_codes, gauss_s = resolve_compare_fields(
-        model.inputs, model.measure
-    )
-    dist = make_distance(model.measure, cmp_codes, gauss_s, weights)
+    if similarity:
+        # binary-similarity neighbors: the k LARGEST similarities win;
+        # "weighted" variants weight by the similarity itself
+        dist = make_similarity(model.measure, weights)
+    else:
+        cmp_codes, gauss_s = resolve_compare_fields(
+            model.inputs, model.measure
+        )
+        dist = make_distance(model.measure, cmp_codes, gauss_s, weights)
     S = np.asarray(model.instances, np.float32)  # [N, D]
     k = model.n_neighbors
     classification = model.function_name == "classification"
@@ -92,12 +95,18 @@ def lower_knn(model: ir.NearestNeighborIR, ctx: LowerCtx) -> Lowered:
         missing = jnp.any(M[:, cols], axis=1)
         xs = X[:, cols]
         d = dist(xs, p["S"])  # [B, N]
-        # top_k on negated distances: earlier rows win exact ties
-        neg_top, idx = jax.lax.top_k(-d, k)  # [B, k]
-        dk = -neg_top
+        # top_k prefers earlier rows on exact ties; similarity ranks
+        # descending, distance ascending (negated)
+        best, idx = jax.lax.top_k(d if similarity else -d, k)  # [B, k]
+        dk = best if similarity else -best
         if classification:
             labk = jnp.take(p["lab"], idx).astype(jnp.int32)  # [B, k]
-            w = 1.0 / (dk + _EPS) if weighted else jnp.ones_like(dk)
+            if not weighted:
+                w = jnp.ones_like(dk)
+            elif similarity:
+                w = dk
+            else:
+                w = 1.0 / (dk + _EPS)
             onehot = (
                 labk[..., None] == jnp.arange(L)[None, None, :]
             ).astype(jnp.float32)
@@ -119,8 +128,18 @@ def lower_knn(model: ir.NearestNeighborIR, ctx: LowerCtx) -> Lowered:
         elif model.continuous_scoring == "median":
             value = jnp.median(yk, axis=1)
         else:  # weightedAverage
-            w = 1.0 / (dk + _EPS)
-            value = jnp.sum(yk * w, axis=1) / jnp.sum(w, axis=1)
+            w = dk if similarity else 1.0 / (dk + _EPS)
+            tw = jnp.sum(w, axis=1)
+            value = jnp.sum(yk * w, axis=1) / jnp.maximum(tw, _EPS)
+            if similarity:
+                # all-zero similarity weights: undefined average (the
+                # oracle empties the lane; 0/0 must not ship as valid)
+                return ModelOutput(
+                    value=value.astype(jnp.float32),
+                    valid=~missing & (tw > 0),
+                    probs=None,
+                    label_idx=None,
+                )
         return ModelOutput(
             value=value.astype(jnp.float32),
             valid=~missing,

@@ -64,6 +64,11 @@ def _leaf_payload(model: ir.TreeModelIR):
             for sd in leaf.score_distribution:
                 if sd.value not in labels:
                     labels.append(sd.value)
+        for leaf in leaves:
+            # a leaf's score attribute may legally be absent from every
+            # distribution; it still names a class (confidence 0)
+            if leaf.score is not None and leaf.score not in labels:
+                labels.append(leaf.score)
         conf = np.zeros((len(leaves), len(labels)), np.float32)
         # the leaf's score attribute is the DETERMINISTIC-path winner
         # (it may legally disagree with the max confidence); −1 = no

@@ -632,6 +632,11 @@ def _eval_tree_weighted(
                     else (sd.record_count / t if t > 0 else 0.0)
                 )
                 agg[sd.value] = agg.get(sd.value, 0.0) + w * conf
+        # every leaf's score attribute joins the label space (it may
+        # legally be absent from the distributions; its confidence is 0)
+        for _, leaf in leaves:
+            if leaf.score is not None:
+                agg.setdefault(leaf.score, 0.0)
         probs = {k: v / total for k, v in agg.items()}
         # deterministic path (all weight on one leaf): the leaf's score
         # attribute wins — exactly like the non-weighted strategies; it
@@ -928,6 +933,33 @@ def _denorm_continuous(y: float, expr: ir.NormContinuous) -> float:
 # --- ClusteringModel -------------------------------------------------------
 
 
+def _binary_similarity(
+    measure: ir.ComparisonMeasure,
+    xs: List[float],
+    zs,
+    weights: List[float],
+) -> float:
+    """Shared binary-similarity math (see compile/clustering.py
+    similarity_params): weighted contingency counts → ratio."""
+    from flink_jpmml_tpu.compile.clustering import similarity_params
+
+    num, den = similarity_params(measure)
+    a = b = c = d = 0.0
+    for x, z, w in zip(xs, zs, weights):
+        xb, zb = x > 0.5, z > 0.5
+        if xb and zb:
+            a += w
+        elif xb:
+            b += w
+        elif zb:
+            c += w
+        else:
+            d += w
+    numer = num[0] * a + num[1] * b + num[2] * c + num[3] * d
+    denom = den[0] * a + den[1] * b + den[2] * c + den[3] * d
+    return numer / denom if denom > 0 else 0.0
+
+
 def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
     from flink_jpmml_tpu.compile.clustering import resolve_compare
 
@@ -938,6 +970,20 @@ def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
         weights.append(cf.weight)
     if any(x is None for x in xs):
         return EvalResult()
+    if model.measure.kind == "similarity":
+        sims = [
+            _binary_similarity(model.measure, xs, cl.center, weights)
+            for cl in model.clusters
+        ]
+        best_idx = max(range(len(sims)), key=lambda i: sims[i])
+        labels = [
+            cl.cluster_id or cl.name or str(i + 1)
+            for i, cl in enumerate(model.clusters)
+        ]
+        return EvalResult(
+            value=float(best_idx), label=labels[best_idx],
+            probabilities=dict(zip(labels, sims)),
+        )
     cmp_codes, gauss_s = resolve_compare(model)
     mink_p = float(model.measure.minkowski_p)
     best_idx, best_dist = -1, math.inf
@@ -1379,10 +1425,7 @@ def _knn_field_compare(ki: ir.KnnInput, measure, x: float, s: float) -> float:
 
 
 def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
-    if model.measure.kind != "distance":
-        raise ModelCompilationException(
-            f"unsupported ComparisonMeasure kind {model.measure.kind!r}"
-        )
+    similarity = model.measure.kind == "similarity"
     xs: List[float] = []
     for ki in model.inputs:
         v = _as_float(record.get(ki.field))
@@ -1391,6 +1434,17 @@ def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
         xs.append(v)
     metric = model.measure.metric
     mink_p = model.measure.minkowski_p
+    if similarity:
+        # binary-similarity neighbors: the k LARGEST similarities win
+        ws = [ki.weight for ki in model.inputs]
+        ds = [
+            _binary_similarity(model.measure, xs, inst, ws)
+            for inst in model.instances
+        ]
+        order = sorted(range(len(ds)), key=lambda i: (-ds[i], i))[
+            : model.n_neighbors
+        ]
+        return _knn_aggregate(model, ds, order, similarity=True)
     if metric == "minkowski" and mink_p <= 0:
         # same typed rejection as the lowering (make_distance)
         raise ModelCompilationException(
@@ -1420,7 +1474,23 @@ def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
     order = sorted(range(len(ds)), key=lambda i: (ds[i], i))[
         : model.n_neighbors
     ]
+    return _knn_aggregate(model, ds, order, similarity=False)
+
+
+def _knn_aggregate(
+    model: ir.NearestNeighborIR,
+    ds: List[float],
+    order: List[int],
+    similarity: bool,
+) -> EvalResult:
+    """Top-k aggregation shared by the distance and similarity paths;
+    "weighted" variants weight by 1/(d+eps) (distance) or the
+    similarity itself."""
     eps = 1e-9
+
+    def nb_weight(i: int) -> float:
+        return ds[i] if similarity else 1.0 / (ds[i] + eps)
+
     if model.function_name == "classification":
         if model.categorical_scoring not in (
             "majorityVote", "weightedMajorityVote",
@@ -1436,8 +1506,7 @@ def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
         weighted = model.categorical_scoring == "weightedMajorityVote"
         votes = {c: 0.0 for c in labels}
         for i in order:
-            w = 1.0 / (ds[i] + eps) if weighted else 1.0
-            votes[model.targets[i]] += w
+            votes[model.targets[i]] += nb_weight(i) if weighted else 1.0
         label = labels[0]
         for c in labels:  # first-appearance order breaks ties
             if votes[c] > votes[label]:
@@ -1467,8 +1536,13 @@ def _eval_knn(model: ir.NearestNeighborIR, record: Record) -> EvalResult:
             ys[n // 2] if n % 2 else 0.5 * (ys[n // 2 - 1] + ys[n // 2])
         )
     else:  # weightedAverage
-        ws = [1.0 / (ds[i] + eps) for i in order]
-        value = sum(y * w for y, w in zip(yk, ws)) / sum(ws)
+        ws = [nb_weight(i) for i in order]
+        tw = sum(ws)
+        if tw <= 0:
+            # similarity path: a record sharing no set bit with any
+            # neighbor has all-zero weights — undefined average, empty
+            return EvalResult()
+        value = sum(y * w for y, w in zip(yk, ws)) / tw
     return EvalResult(value=value)
 
 

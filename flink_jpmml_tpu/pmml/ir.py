@@ -364,9 +364,14 @@ class ClusteringField:
 @dataclass(frozen=True)
 class ComparisonMeasure:
     kind: str  # distance | similarity
-    metric: str  # squaredEuclidean euclidean cityBlock chebychev minkowski
+    metric: str  # distance: squaredEuclidean euclidean cityBlock chebychev
+    #            minkowski; similarity: simpleMatching jaccard tanimoto
+    #            binarySimilarity
     compare_function: str = "absDiff"
     minkowski_p: float = 2.0  # <minkowski p-parameter=…/>
+    # binarySimilarity numerator/denominator weights over the (a,b,c,d)
+    # contingency counts: (c00, c01, c10, c11, d00, d01, d10, d11)
+    binary_params: Tuple[float, ...] = ()
 
 
 @dataclass(frozen=True)

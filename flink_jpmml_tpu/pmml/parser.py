@@ -1255,6 +1255,12 @@ def _parse_anomaly_detection(elem: ET.Element) -> ir.AnomalyDetectionIR:
         raise ModelLoadingException(
             "AnomalyDetectionModel has no embedded model"
         )
+    if _child(inner_elem, "LocalTransformations") is not None:
+        raise ModelLoadingException(
+            "LocalTransformations inside an AnomalyDetectionModel's "
+            "embedded model are not supported (use the "
+            "TransformationDictionary)"
+        )
     sds = (
         _int(elem, "sampleDataSize")
         if elem.get("sampleDataSize") is not None
@@ -1288,23 +1294,41 @@ def _parse_comparison_measure(cm: ET.Element) -> ir.ComparisonMeasure:
         break
     if metric_elem is None:
         raise ModelLoadingException("ComparisonMeasure has no metric child")
-    metric_map = {
-        "squaredEuclidean": "squaredEuclidean",
-        "euclidean": "euclidean",
-        "cityBlock": "cityBlock",
-        "chebychev": "chebychev",
-        "minkowski": "minkowski",
-    }
-    metric = metric_map.get(_local(metric_elem.tag))
-    if metric is None:
+    distance_metrics = (
+        "squaredEuclidean", "euclidean", "cityBlock", "chebychev",
+        "minkowski",
+    )
+    similarity_metrics = (
+        "simpleMatching", "jaccard", "tanimoto", "binarySimilarity",
+    )
+    tag = _local(metric_elem.tag)
+    if tag in distance_metrics:
+        kind = "distance"
+    elif tag in similarity_metrics:
+        kind = "similarity"
+    else:
         raise ModelLoadingException(
-            f"unsupported comparison metric <{_local(metric_elem.tag)}>"
+            f"unsupported comparison metric <{tag}>"
+        )
+    declared = cm.get("kind")
+    if declared is not None and declared != kind:
+        raise ModelLoadingException(
+            f"ComparisonMeasure kind {declared!r} does not match metric "
+            f"<{tag}> ({kind})"
+        )
+    binary_params: Tuple[float, ...] = ()
+    if tag == "binarySimilarity":
+        binary_params = tuple(
+            _float(metric_elem, f"{g}{ij}-parameter")
+            for g in ("c", "d")
+            for ij in ("00", "01", "10", "11")
         )
     return ir.ComparisonMeasure(
-        kind=cm.get("kind", "distance"),
-        metric=metric,
+        kind=kind,
+        metric=tag,
         compare_function=cm.get("compareFunction", "absDiff"),
         minkowski_p=_float(metric_elem, "p-parameter", 2.0),
+        binary_params=binary_params,
     )
 
 

@@ -140,19 +140,17 @@ class TestKnn:
 
 
 class TestReviewRegressions:
-    def test_similarity_kind_rejected_both_paths(self):
-        from flink_jpmml_tpu.utils.exceptions import (
-            ModelCompilationException,
-        )
+    def test_similarity_kind_with_distance_metric_rejected(self):
+        # similarity measures are now supported (TestBinarySimilarity);
+        # what stays invalid is declaring kind="similarity" over a
+        # distance metric — caught at parse, one error for both paths
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
 
-        doc = parse_pmml(_knn_xml(
-            measure='<ComparisonMeasure kind="similarity">'
-                    "<squaredEuclidean/></ComparisonMeasure>"
-        ))
-        with pytest.raises(ModelCompilationException, match="similarity"):
-            compile_pmml(doc)
-        with pytest.raises(ModelCompilationException, match="similarity"):
-            evaluate(doc, {"u": 0.0, "v": 0.0})
+        with pytest.raises(ModelLoadingException, match="kind"):
+            parse_pmml(_knn_xml(
+                measure='<ComparisonMeasure kind="similarity">'
+                        "<squaredEuclidean/></ComparisonMeasure>"
+            ))
 
     def test_unknown_scoring_method_rejected_both_paths(self):
         from flink_jpmml_tpu.utils.exceptions import (
@@ -201,3 +199,130 @@ class TestReviewRegressions:
             compile_pmml(doc)
         with pytest.raises(ModelCompilationException, match="exactly one"):
             evaluate(doc, {"x1": 1.0, "x2": 1.0})
+
+
+SIM_CLUSTER = """<PMML version="4.3"><DataDictionary>
+  <DataField name="b0" optype="continuous" dataType="double"/>
+  <DataField name="b1" optype="continuous" dataType="double"/>
+  <DataField name="b2" optype="continuous" dataType="double"/>
+  <DataField name="b3" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <ClusteringModel functionName="clustering" modelClass="centerBased"
+      numberOfClusters="2">
+  <MiningSchema>
+    <MiningField name="b0"/><MiningField name="b1"/>
+    <MiningField name="b2"/><MiningField name="b3"/>
+  </MiningSchema>
+  <ComparisonMeasure kind="similarity"><{metric}{params}/>
+  </ComparisonMeasure>
+  <ClusteringField field="b0"/><ClusteringField field="b1"/>
+  <ClusteringField field="b2"/><ClusteringField field="b3"/>
+  <Cluster id="c1"><Array n="4" type="real">1 1 0 0</Array></Cluster>
+  <Cluster id="c2"><Array n="4" type="real">0 1 1 1</Array></Cluster>
+  </ClusteringModel></PMML>"""
+
+
+def _hand_sim(metric, x, z, params=None):
+    a = sum(1 for xi, zi in zip(x, z) if xi > 0.5 and zi > 0.5)
+    b = sum(1 for xi, zi in zip(x, z) if xi > 0.5 and zi <= 0.5)
+    c = sum(1 for xi, zi in zip(x, z) if xi <= 0.5 and zi > 0.5)
+    d = sum(1 for xi, zi in zip(x, z) if xi <= 0.5 and zi <= 0.5)
+    if metric == "simpleMatching":
+        return (a + d) / (a + b + c + d)
+    if metric == "jaccard":
+        return a / (a + b + c) if a + b + c else 0.0
+    if metric == "tanimoto":
+        return (a + d) / (a + 2 * (b + c) + d)
+    c00, c01, c10, c11, d00, d01, d10, d11 = params
+    num = c11 * a + c10 * b + c01 * c + c00 * d
+    den = d11 * a + d10 * b + d01 * c + d00 * d
+    return num / den if den else 0.0
+
+
+class TestBinarySimilarity:
+    @pytest.mark.parametrize(
+        "metric,params",
+        [
+            ("simpleMatching", None),
+            ("jaccard", None),
+            ("tanimoto", None),
+            ("binarySimilarity",
+             (0.5, 0.0, 0.0, 2.0, 1.0, 1.0, 1.0, 1.0)),
+        ],
+    )
+    def test_clustering_similarity_parity(self, metric, params):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        pstr = ""
+        if params is not None:
+            names = ["c00", "c01", "c10", "c11", "d00", "d01", "d10", "d11"]
+            pstr = "".join(
+                f' {n}-parameter="{v}"' for n, v in zip(names, params)
+            )
+        doc = parse_pmml(SIM_CLUSTER.format(metric=metric, params=pstr))
+        cm = compile_pmml(doc)
+        centers = [(1, 1, 0, 0), (0, 1, 1, 1)]
+        for basket in ((1, 1, 0, 0), (0, 1, 1, 0), (1, 0, 1, 1), (0, 0, 0, 0)):
+            rec = dict(zip(("b0", "b1", "b2", "b3"), map(float, basket)))
+            hand = [_hand_sim(metric, basket, z, params) for z in centers]
+            o = evaluate(doc, rec)
+            p = cm.score_records([rec])[0]
+            assert o.probabilities["c1"] == pytest.approx(hand[0])
+            assert o.probabilities["c2"] == pytest.approx(hand[1])
+            assert p.target.probabilities["c1"] == pytest.approx(
+                hand[0], abs=1e-6
+            )
+            win = "c1" if hand[0] >= hand[1] else "c2"
+            assert o.label == win and p.target.label == win, (metric, basket)
+
+    def test_knn_similarity_votes(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        xml = _knn_xml(
+            measure='<ComparisonMeasure kind="similarity"><jaccard/>'
+                    "</ComparisonMeasure>"
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        import numpy as np
+
+        rng = np.random.default_rng(6)
+        for _ in range(20):
+            rec = {
+                f: float(v)
+                for f, v in zip(doc.active_fields, rng.integers(0, 2, size=2))
+            }
+            o = evaluate(doc, rec)
+            p = cm.score_records([rec])[0]
+            assert p.target.label == o.label, rec
+
+    def test_kind_metric_mismatch_rejected(self):
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        bad = SIM_CLUSTER.format(metric="euclidean", params="")
+        with pytest.raises(ModelLoadingException, match="kind"):
+            parse_pmml(bad)
+
+    def test_zero_similarity_weighted_average_empty(self):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        xml = _knn_xml(
+            function="regression", target="yv",
+            attrs='continuousScoringMethod="weightedAverage"',
+            measure='<ComparisonMeasure kind="similarity"><jaccard/>'
+                    "</ComparisonMeasure>",
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        # a record with no set bits shares nothing with any neighbor:
+        # all similarities 0 -> undefined weighted average -> empty lane
+        rec = {f: 0.0 for f in doc.active_fields}
+        assert evaluate(doc, rec).value is None
+        assert cm.score_records([rec])[0].is_empty

@@ -310,3 +310,26 @@ class TestWeightedStrategyEdges:
             o = evaluate(doc, rec)
             p = cm.score_records([rec])[0]
             assert p.target.label == o.label, rec
+
+    def test_leaf_score_outside_distributions(self):
+        """A leaf score absent from every ScoreDistribution still names
+        the class: deterministic paths return it (confidence 0) on both
+        engines."""
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        xml = WEIGHTED_CONF.replace(
+            '<Node id="L" recordCount="60" score="a">',
+            '<Node id="L" recordCount="60" score="other">',
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"x": -1.0}  # deterministic: leaf L
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.label == "other" == p.target.label
+        assert o.probabilities["other"] == pytest.approx(0.0)
+        assert p.target.probabilities["other"] == pytest.approx(
+            0.0, abs=1e-6
+        )
