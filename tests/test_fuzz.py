@@ -238,3 +238,92 @@ class TestFuzzMining:
         doc = _doc(model)
         recs = _rand_records(rng, 32)
         _assert_parity(doc, recs, f"mining {method} seed={seed}")
+
+
+def _rand_regression_model(rng):
+    classification = bool(rng.random() < 0.4)
+
+    def table(target_category=None):
+        nps = tuple(
+            ir.NumericPredictor(
+                name=str(f),
+                coefficient=float(np.round(rng.normal(0, 2), 3)),
+                exponent=int(rng.choice([1, 1, 1, 2])),
+            )
+            for f in rng.choice(FIELDS, size=rng.integers(1, 4),
+                                replace=False)
+        )
+        cps = tuple(
+            ir.CategoricalPredictor(
+                name="color",
+                value=str(v),
+                coefficient=float(np.round(rng.normal(0, 1), 3)),
+            )
+            for v in rng.choice(CAT_VALUES, size=rng.integers(0, 3),
+                                replace=False)
+        )
+        return ir.RegressionTable(
+            intercept=float(np.round(rng.normal(0, 1), 3)),
+            numeric_predictors=nps,
+            categorical_predictors=cps,
+            target_category=target_category,
+        )
+
+    if classification:
+        tables = tuple(table(c) for c in ("pos", "neg"))
+        return ir.RegressionModelIR(
+            function_name="classification",
+            mining_schema=_schema(),
+            tables=tables,
+            normalization_method=str(rng.choice(["softmax", "simplemax", "none"])),
+        )
+    return ir.RegressionModelIR(
+        function_name="regression",
+        mining_schema=_schema(),
+        tables=(table(),),
+        normalization_method=str(rng.choice(["none", "logit", "exp"])),
+    )
+
+
+class TestFuzzRegression:
+    @pytest.mark.parametrize("seed", range(15))
+    def test_random_regression_parity(self, seed):
+        rng = np.random.default_rng(3000 + seed)
+        doc = _doc(_rand_regression_model(rng))
+        recs = _rand_records(rng, 40)
+        _assert_parity(doc, recs, f"regression seed={seed}")
+
+
+class TestFuzzScorecard:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_scorecard_parity(self, seed):
+        rng = np.random.default_rng(4000 + seed)
+        chars = []
+        for ci in range(int(rng.integers(1, 4))):
+            attrs = [
+                ir.ScorecardAttribute(
+                    predicate=_rand_predicate(rng, 1),
+                    partial_score=float(np.round(rng.normal(0, 20), 1)),
+                )
+                for _ in range(int(rng.integers(1, 4)))
+            ]
+            # catch-all keeps most lanes valid; drop it sometimes to
+            # exercise the no-match -> empty contract
+            if rng.random() < 0.8:
+                attrs.append(ir.ScorecardAttribute(
+                    predicate=ir.TruePredicate(),
+                    partial_score=float(np.round(rng.normal(0, 5), 1)),
+                ))
+            chars.append(ir.Characteristic(
+                name=f"ch{ci}", attributes=tuple(attrs)
+            ))
+        model = ir.ScorecardIR(
+            function_name="regression",
+            mining_schema=_schema(),
+            characteristics=tuple(chars),
+            initial_score=float(np.round(rng.normal(100, 20), 1)),
+            use_reason_codes=False,
+        )
+        doc = _doc(model)
+        recs = _rand_records(rng, 40)
+        _assert_parity(doc, recs, f"scorecard seed={seed}")
