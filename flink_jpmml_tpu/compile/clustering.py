@@ -57,10 +57,14 @@ def make_distance(
     cmp_codes: np.ndarray,
     gauss_s: np.ndarray,
     weights: np.ndarray,
+    mv_q=None,
 ):
-    """→ f(xs [B,D], centers [K,D]) -> distances [B,K] under the spec
-    aggregation (the field weight multiplies the powered comparison).
-    Shared by the clustering and nearest-neighbor lowerings."""
+    """→ f(xs [B,D], centers [K,D][, miss [B,D]]) -> distances [B,K]
+    under the spec aggregation (the field weight multiplies the powered
+    comparison). Shared by the clustering and nearest-neighbor
+    lowerings. With ``mv_q`` (MissingValueWeights) and a ``miss`` mask,
+    missing fields' terms drop out and sum-based metrics rescale by
+    Σq / Σ_nonmissing q (chebychev is a max, not a sum — no rescale)."""
     metric = measure.metric
     mink_p = float(measure.minkowski_p)
     if metric == "minkowski" and mink_p <= 0:
@@ -69,8 +73,9 @@ def make_distance(
         )
     all_absdiff = bool((cmp_codes == 0).all())
     ln2 = float(np.log(2.0))
+    q_total = float(np.sum(mv_q)) if mv_q is not None else 0.0
 
-    def dist(xs, centers):
+    def dist(xs, centers, miss=None):
         delta = xs[:, None, :] - centers[None, :, :]  # [B, K, D]
         if all_absdiff:
             c = jnp.abs(delta)
@@ -86,17 +91,29 @@ def make_distance(
                 ),
             )
         w = weights
+        adjust = None
+        if miss is not None:
+            keep = (~miss).astype(jnp.float32)  # [B, D]
+            c = c * keep[:, None, :]  # dropped terms contribute 0
+            q_nonmiss = jnp.sum(keep * mv_q[None, :], axis=-1)  # [B]
+            adjust = (
+                q_total / jnp.maximum(q_nonmiss, 1e-30)
+            )[:, None]  # [B, 1]
+
+        def scaled(s):
+            return s if adjust is None else s * adjust
+
         if metric == "squaredEuclidean":
-            return jnp.sum(w * c * c, axis=-1)
+            return scaled(jnp.sum(w * c * c, axis=-1))
         if metric == "euclidean":
-            return jnp.sqrt(jnp.sum(w * c * c, axis=-1))
+            return jnp.sqrt(scaled(jnp.sum(w * c * c, axis=-1)))
         if metric == "cityBlock":
-            return jnp.sum(w * c, axis=-1)
+            return scaled(jnp.sum(w * c, axis=-1))
         if metric == "chebychev":
             return jnp.max(w * c, axis=-1)
         if metric == "minkowski":
             return jnp.power(
-                jnp.sum(w * jnp.power(jnp.abs(c), mink_p), axis=-1),
+                scaled(jnp.sum(w * jnp.power(jnp.abs(c), mink_p), axis=-1)),
                 1.0 / mink_p,
             )
         raise ModelCompilationException(f"unsupported metric {metric!r}")
@@ -183,21 +200,39 @@ def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
         c.cluster_id or c.name or str(i + 1) for i, c in enumerate(model.clusters)
     )
     params = {"centers": centers}
+    mv_q = (
+        np.asarray(model.missing_value_weights, np.float32)
+        if model.missing_value_weights and not similarity
+        else None
+    )
     score = (
         make_similarity(model.measure, weights)
         if similarity
-        else make_distance(model.measure, cmp_codes, gauss_s, weights)
+        else make_distance(
+            model.measure, cmp_codes, gauss_s, weights, mv_q=mv_q
+        )
     )
 
     def fn(p, X, M):
         xs = X[:, cols]  # [B, D]
-        missing = jnp.any(M[:, cols], axis=1)
-        d = score(xs, p["centers"])
+        miss = M[:, cols]
+        if mv_q is not None:
+            # opted-in adjustment: a lane is invalid only when NO
+            # weighted evidence remains (all missing, or every
+            # non-missing field carries weight 0)
+            d = score(xs, p["centers"], miss)
+            qn = jnp.sum(
+                (~miss).astype(jnp.float32) * mv_q[None, :], axis=1
+            )
+            valid = qn > 0
+        else:
+            d = score(xs, p["centers"])
+            valid = ~jnp.any(miss, axis=1)
         pick = jnp.argmax if similarity else jnp.argmin
         label_idx = pick(d, axis=1).astype(jnp.int32)
         return ModelOutput(
             value=label_idx.astype(jnp.float32),
-            valid=~missing,
+            valid=valid,
             probs=d,  # per-cluster distances/similarities
             label_idx=label_idx,
         )

@@ -968,8 +968,19 @@ def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
     for cf in model.clustering_fields:
         xs.append(_as_float(record.get(cf.field)))
         weights.append(cf.weight)
+    mvw = model.missing_value_weights
+    adjust = 1.0
     if any(x is None for x in xs):
-        return EvalResult()
+        # MissingValueWeights opts into adjustment: missing terms drop
+        # out and sum metrics rescale by Σq / Σ_nonmissing q; without
+        # the element (or under similarity) a missing field stays a
+        # strict empty lane
+        if not mvw or model.measure.kind == "similarity":
+            return EvalResult()
+        q_nonmiss = sum(q for q, x in zip(mvw, xs) if x is not None)
+        if q_nonmiss <= 0:
+            return EvalResult()  # no weighted evidence at all
+        adjust = sum(mvw) / q_nonmiss
     if model.measure.kind == "similarity":
         sims = [
             _binary_similarity(model.measure, xs, cl.center, weights)
@@ -995,6 +1006,9 @@ def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
             )
         cs = []
         for j, (x, z) in enumerate(zip(xs, cl.center)):
+            if x is None:
+                cs.append(None)  # dropped term (MissingValueWeights)
+                continue
             code = int(cmp_codes[j])
             if code == 1:  # gaussSim: exp(−ln2·(x−z)²/s²)
                 s = float(gauss_s[j])
@@ -1005,20 +1019,24 @@ def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
                 cs.append(1.0 if x == z else 0.0)
             else:  # absDiff
                 cs.append(abs(x - z))
+        terms = [
+            (w, c) for w, c in zip(weights, cs) if c is not None
+        ]
         m = model.measure.metric
         # spec aggregation: the field weight multiplies the *powered*
-        # comparison (Σ w·c², not Σ (w·c)²)
+        # comparison (Σ w·c², not Σ (w·c)²); ``adjust`` rescales the
+        # sums when missing terms dropped out (chebychev is a max)
         if m == "squaredEuclidean":
-            d = sum(w * c * c for w, c in zip(weights, cs))
+            d = adjust * sum(w * c * c for w, c in terms)
         elif m == "euclidean":
-            d = math.sqrt(sum(w * c * c for w, c in zip(weights, cs)))
+            d = math.sqrt(adjust * sum(w * c * c for w, c in terms))
         elif m == "cityBlock":
-            d = sum(w * c for w, c in zip(weights, cs))
+            d = adjust * sum(w * c for w, c in terms)
         elif m == "chebychev":
-            d = max(w * c for w, c in zip(weights, cs))
+            d = max(w * c for w, c in terms)
         elif m == "minkowski":
-            d = sum(
-                w * abs(c) ** mink_p for w, c in zip(weights, cs)
+            d = (
+                adjust * sum(w * abs(c) ** mink_p for w, c in terms)
             ) ** (1.0 / mink_p)
         else:
             raise ModelCompilationException(f"unsupported metric {m!r}")

@@ -382,6 +382,10 @@ class ClusteringModelIR:
     measure: ComparisonMeasure
     clustering_fields: Tuple[ClusteringField, ...]
     clusters: Tuple[Cluster, ...]
+    # <MissingValueWeights>: opts into missing-field adjustment — terms
+    # for missing fields drop out and sum-based metrics rescale by
+    # Σq / Σ_nonmissing q. Empty = strict (any missing ⇒ empty lane).
+    missing_value_weights: Tuple[float, ...] = ()
     model_name: Optional[str] = None
 
 

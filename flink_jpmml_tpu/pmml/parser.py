@@ -2007,6 +2007,25 @@ def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
     )
     if not clusters:
         raise ModelLoadingException("ClusteringModel has no Cluster elements")
+    mvw: tuple = ()
+    mvw_elem = _child(elem, "MissingValueWeights")
+    if mvw_elem is not None:
+        arr = _child(mvw_elem, "Array")
+        if arr is None:
+            raise ModelLoadingException(
+                "MissingValueWeights needs an Array"
+            )
+        mvw = _parse_real_array(arr)
+        if len(mvw) != len(fields):
+            raise ModelLoadingException(
+                f"MissingValueWeights length {len(mvw)} != clustering "
+                f"fields {len(fields)}"
+            )
+        if any(q < 0 for q in mvw) or sum(mvw) <= 0:
+            raise ModelLoadingException(
+                "MissingValueWeights must be non-negative with a "
+                "positive sum"
+            )
     return ir.ClusteringModelIR(
         function_name=elem.get("functionName", "clustering"),
         mining_schema=_parse_mining_schema(elem),
@@ -2014,6 +2033,7 @@ def _parse_clustering_model(elem: ET.Element) -> ir.ClusteringModelIR:
         measure=measure,
         clustering_fields=fields,
         clusters=clusters,
+        missing_value_weights=mvw,
         model_name=elem.get("modelName"),
     )
 

@@ -497,3 +497,104 @@ class TestNeuralActivations:
                 [pred] = cm.score_records([{"a": a}])
                 exp = evaluate(doc, {"a": a})
                 assert abs(pred.score.value - exp.value) < 1e-5, (act, a)
+
+
+MVW_KMEANS = """<PMML version="4.3"><DataDictionary>
+  <DataField name="a" optype="continuous" dataType="double"/>
+  <DataField name="b" optype="continuous" dataType="double"/>
+  <DataField name="c" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <ClusteringModel functionName="clustering" modelClass="centerBased"
+      numberOfClusters="2">
+  <MiningSchema><MiningField name="a"/><MiningField name="b"/>
+    <MiningField name="c"/></MiningSchema>
+  <ComparisonMeasure kind="distance"><squaredEuclidean/>
+  </ComparisonMeasure>
+  <ClusteringField field="a"/><ClusteringField field="b"/>
+  <ClusteringField field="c"/>
+  <MissingValueWeights><Array n="3" type="real">1 2 1</Array>
+  </MissingValueWeights>
+  <Cluster id="c1"><Array n="3" type="real">0 0 0</Array></Cluster>
+  <Cluster id="c2"><Array n="3" type="real">4 4 4</Array></Cluster>
+  </ClusteringModel></PMML>"""
+
+
+class TestMissingValueWeights:
+    def test_adjusted_distance_parity(self):
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        doc = parse_pmml(MVW_KMEANS)
+        cm = compile_pmml(doc)
+        # b missing: terms over (a, c); adjust = (1+2+1)/(1+1) = 2
+        rec = {"a": 1.0, "b": None, "c": 2.0}
+        hand = {
+            "c1": 2.0 * (1.0 + 4.0),
+            "c2": 2.0 * (9.0 + 4.0),
+        }
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.probabilities["c1"] == pytest.approx(hand["c1"])
+        assert o.probabilities["c2"] == pytest.approx(hand["c2"])
+        assert o.label == "c1" == p.target.label
+        assert p.target.probabilities["c2"] == pytest.approx(
+            hand["c2"], rel=1e-6
+        )
+        # fully observed: no adjustment
+        rec = {"a": 3.0, "b": 3.0, "c": 3.0}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.label == "c2" == p.target.label
+        assert o.probabilities["c1"] == pytest.approx(27.0)
+        # all missing: still an empty lane
+        rec = {"a": None, "b": None, "c": None}
+        assert evaluate(doc, rec).value is None
+        assert cm.score_records([rec])[0].is_empty
+
+    def test_without_weights_stays_strict(self):
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        xml = MVW_KMEANS.replace(
+            "<MissingValueWeights><Array n=\"3\" type=\"real\">1 2 1"
+            "</Array>\n  </MissingValueWeights>", ""
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"a": 1.0, "b": None, "c": 2.0}
+        assert evaluate(doc, rec).value is None
+        assert cm.score_records([rec])[0].is_empty
+
+    def test_bad_weights_rejected(self):
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        with pytest.raises(ModelLoadingException, match="length"):
+            parse_pmml(MVW_KMEANS.replace(
+                '<Array n="3" type="real">1 2 1</Array>',
+                '<Array n="2" type="real">1 2</Array>',
+            ))
+
+    def test_zero_weight_evidence_empty_both_paths(self):
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        # field b carries ALL the weight; with b missing the remaining
+        # evidence is weightless -> empty on both engines
+        xml = MVW_KMEANS.replace(
+            '<Array n="3" type="real">1 2 1</Array>',
+            '<Array n="3" type="real">0 2 0</Array>',
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"a": 1.0, "b": None, "c": 2.0}
+        assert evaluate(doc, rec).value is None
+        assert cm.score_records([rec])[0].is_empty
+        # with b present everything scores
+        assert not cm.score_records([{"a": 1.0, "b": 0.0, "c": 2.0}])[0].is_empty
+
+    def test_negative_or_zero_sum_weights_rejected(self):
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        for arr in ("-1 2 1", "0 0 0"):
+            with pytest.raises(ModelLoadingException, match="negative|positive"):
+                parse_pmml(MVW_KMEANS.replace(
+                    '<Array n="3" type="real">1 2 1</Array>',
+                    f'<Array n="3" type="real">{arr}</Array>',
+                ))
