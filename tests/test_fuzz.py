@@ -183,6 +183,13 @@ def _assert_parity(doc, recs, where):
                 f"{ctx}: label {p.target.label!r} != {o.label!r}"
             )
         if o.value is not None:
+            if abs(o.value) > 3e38:
+                # beyond float32 range: the compiled engine can only
+                # represent it as inf — same sign is the contract
+                assert np.isinf(p.score.value) and (
+                    np.sign(p.score.value) == np.sign(o.value)
+                ), f"{ctx}: f32-overflow sign {p.score.value!r} vs {o.value!r}"
+                continue
             assert p.score.value == pytest.approx(
                 o.value, rel=2e-4, abs=2e-5
             ), f"{ctx}: value {p.score.value!r} != {o.value!r}"
@@ -327,3 +334,122 @@ class TestFuzzScorecard:
         doc = _doc(model)
         recs = _rand_records(rng, 40)
         _assert_parity(doc, recs, f"scorecard seed={seed}")
+
+
+def _rand_nn_model(rng):
+    """Random regression MLP: FieldRef inputs → 1-2 hidden layers →
+    one output neuron mapped straight to the target."""
+    acts = ["logistic", "tanh", "identity", "rectifier", "arctan",
+            "cosine", "sine", "exponential", "reciprocal", "square"]
+    inputs = tuple(
+        ir.NeuralInput(
+            neuron_id=f"in{i}",
+            derived_field=ir.DerivedField(
+                name=f"in{i}", optype="continuous", dtype="double",
+                expression=ir.FieldRef(field=f),
+            ),
+        )
+        for i, f in enumerate(FIELDS)
+    )
+    prev = [ni.neuron_id for ni in inputs]
+    layers = []
+    nid = 0
+    for _ in range(int(rng.integers(1, 3))):
+        width = int(rng.integers(2, 5))
+        neurons = []
+        for _ in range(width):
+            neurons.append(ir.Neuron(
+                neuron_id=f"h{nid}",
+                bias=float(np.round(rng.normal(0, 0.5), 3)),
+                weights=tuple(
+                    (p, float(np.round(rng.normal(0, 1), 3)))
+                    for p in prev
+                ),
+            ))
+            nid += 1
+        layers.append(ir.NeuralLayer(
+            neurons=tuple(neurons),
+            activation=str(rng.choice(acts)),
+        ))
+        prev = [n.neuron_id for n in neurons]
+    out_neuron = ir.Neuron(
+        neuron_id="out0",
+        bias=float(np.round(rng.normal(0, 0.5), 3)),
+        weights=tuple(
+            (p, float(np.round(rng.normal(0, 1), 3))) for p in prev
+        ),
+    )
+    layers.append(ir.NeuralLayer(
+        neurons=(out_neuron,), activation="identity"
+    ))
+    outputs = (
+        ir.NeuralOutput(
+            output_neuron="out0",
+            derived_field=ir.DerivedField(
+                name="y", optype="continuous", dtype="double",
+                expression=ir.FieldRef(field="y"),
+            ),
+        ),
+    )
+    return ir.NeuralNetworkIR(
+        function_name="regression",
+        mining_schema=_schema(),
+        activation_function="logistic",
+        inputs=inputs,
+        layers=tuple(layers),
+        outputs=outputs,
+    )
+
+
+class TestFuzzNeural:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_mlp_parity(self, seed):
+        rng = np.random.default_rng(5000 + seed)
+        doc = _doc(_rand_nn_model(rng))
+        recs = _rand_records(rng, 32)
+        _assert_parity(doc, recs, f"nn seed={seed}")
+
+
+def _rand_glm_model(rng):
+    n_params = int(rng.integers(2, 5))
+    params = tuple(f"p{i}" for i in range(n_params))
+    pp = []
+    for i, pname in enumerate(params[1:], 1):
+        # each non-intercept parameter: 1-2 covariate cells and maybe a
+        # factor indicator
+        for f in rng.choice(FIELDS, size=rng.integers(1, 3), replace=False):
+            pp.append(ir.PPCell(
+                predictor=str(f), parameter=pname,
+                value=str(int(rng.choice([1, 1, 2]))),
+            ))
+        if rng.random() < 0.4:
+            pp.append(ir.PPCell(
+                predictor="color", parameter=pname,
+                value=str(rng.choice(CAT_VALUES)),
+            ))
+    p_cells = tuple(
+        ir.PCell(parameter=p, beta=float(np.round(rng.normal(0, 1), 3)))
+        for p in params
+    )
+    link = str(rng.choice(["identity", "log", "logit", "cloglog",
+                           "probit", "cauchit"]))
+    return ir.GeneralRegressionIR(
+        function_name="regression",
+        mining_schema=_schema(),
+        model_type="generalizedLinear",
+        parameters=params,
+        factors=("color",),
+        covariates=FIELDS,
+        pp_cells=tuple(pp),
+        p_cells=p_cells,
+        link_function=link,
+    )
+
+
+class TestFuzzGlm:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_glm_parity(self, seed):
+        rng = np.random.default_rng(6000 + seed)
+        doc = _doc(_rand_glm_model(rng))
+        recs = _rand_records(rng, 32)
+        _assert_parity(doc, recs, f"glm seed={seed}")
