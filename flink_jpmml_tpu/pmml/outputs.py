@@ -25,7 +25,7 @@ from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
 _FEATURES = (
     "predictedValue", "probability", "transformedValue", "reasonCode",
-    "ruleValue",
+    "ruleValue", "entityId", "affinity",
 )
 
 # ruleFeature attribute → key in the winning-rule metadata mapping
@@ -100,6 +100,16 @@ def compute_outputs(
         if of.feature == "predictedValue":
             out[of.name] = label if label is not None else value
         elif of.feature == "probability":
+            key = of.target_value if of.target_value is not None else label
+            out[of.name] = probs.get(key) if key is not None else None
+        elif of.feature == "entityId":
+            # the winning entity's identifier: cluster id / class label /
+            # nearest-neighbor target — the decoded label in every family
+            out[of.name] = label
+        elif of.feature == "affinity":
+            # the requested entity's comparison score (the ``value``
+            # attribute picks one; absent = the winner's) from the
+            # per-entity score mapping, where the family surfaces one
             key = of.target_value if of.target_value is not None else label
             out[of.name] = probs.get(key) if key is not None else None
         elif of.feature == "reasonCode":

@@ -598,3 +598,41 @@ class TestMissingValueWeights:
                     '<Array n="3" type="real">1 2 1</Array>',
                     f'<Array n="3" type="real">{arr}</Array>',
                 ))
+
+
+class TestEntityOutputs:
+    def test_cluster_entity_id_and_affinity(self):
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        xml = MVW_KMEANS.replace(
+            "<MiningSchema>",
+            '<Output><OutputField name="cluster" feature="entityId"/>'
+            '<OutputField name="dist" feature="affinity"/></Output>'
+            "<MiningSchema>",
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"a": 1.0, "b": 0.5, "c": 0.5}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.outputs["cluster"] == "c1" == p.outputs["cluster"]
+        hand = 1.0 + 0.25 + 0.25  # squaredEuclidean to (0,0,0)
+        assert o.outputs["dist"] == pytest.approx(hand)
+        assert p.outputs["dist"] == pytest.approx(hand, rel=1e-6)
+
+    def test_affinity_value_attribute_picks_entity(self):
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        xml = MVW_KMEANS.replace(
+            "<MiningSchema>",
+            '<Output><OutputField name="d2" feature="affinity" value="c2"/>'
+            "</Output><MiningSchema>",
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"a": 1.0, "b": 0.5, "c": 0.5}  # winner c1; ask for c2
+        hand = (1 - 4) ** 2 + (0.5 - 4) ** 2 + (0.5 - 4) ** 2
+        assert evaluate(doc, rec).outputs["d2"] == pytest.approx(hand)
+        assert cm.score_records([rec])[0].outputs["d2"] == pytest.approx(
+            hand, rel=1e-6
+        )
