@@ -39,18 +39,6 @@ if _os.environ.get("FJT_PLATFORM"):
 
     _jax.config.update("jax_platforms", _os.environ["FJT_PLATFORM"])
 
-if _os.environ.get("FJT_XLA_CACHE"):
-    # Opt-in persistent XLA compilation cache: a restarted worker warms
-    # its served models from disk instead of recompiling (C7's
-    # recover-fast story; the 500-tree GBM costs ~20-40s to compile
-    # cold). Points jax's official cache at the given directory.
-    import jax as _jax
-
-    _jax.config.update(
-        "jax_compilation_cache_dir", _os.environ["FJT_XLA_CACHE"]
-    )
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
 from flink_jpmml_tpu.models.prediction import (  # noqa: F401
     EmptyScore,
     Prediction,

@@ -1,0 +1,1 @@
+"""C++ sources for the native data plane (built on import, cached)."""

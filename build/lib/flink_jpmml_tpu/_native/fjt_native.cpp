@@ -1,0 +1,378 @@
+// fjt_native: host-side data plane for the streaming runtime.
+//
+// Replaces the per-record Python queue on the hot ingest path (the
+// reference's data plane was Flink's Netty stack with credit-based
+// backpressure; SURVEY.md §3 row D1). This is a bounded MPSC ring of
+// fixed-arity float32 records guarded by a mutex + condvars:
+//
+//  - producers push single records or contiguous blocks (blocking with
+//    backpressure or non-blocking);
+//  - the consumer drains fill-or-deadline micro-batches *directly into a
+//    caller-provided contiguous buffer* that numpy wraps zero-copy, so no
+//    Python object per record ever exists on this path;
+//  - close() wakes everyone; drains return what remains.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libfjt_native.so fjt_native.cpp -lpthread
+// Bound via ctypes (flink_jpmml_tpu/runtime/native.py) — no pybind11 in the
+// image, and the ABI below is deliberately C-plain for that reason.
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+using namespace std::chrono;
+
+namespace {
+
+struct Ring {
+    uint32_t capacity;   // records
+    uint32_t arity;      // floats per record
+    float*   data;       // capacity * arity floats
+    uint64_t* offsets;   // per-record source offset (resume bookkeeping)
+    uint32_t head = 0;   // next slot to pop
+    uint32_t count = 0;  // records in the ring
+    bool     closed = false;
+    std::mutex mu;
+    std::condition_variable not_full;
+    std::condition_variable not_empty;
+};
+
+inline uint32_t slot(const Ring* r, uint32_t logical) {
+    uint32_t s = r->head + logical;
+    if (s >= r->capacity) s -= r->capacity;
+    return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+Ring* fjt_ring_create(uint32_t capacity, uint32_t arity) {
+    if (capacity == 0 || arity == 0) return nullptr;
+    Ring* r = new (std::nothrow) Ring();
+    if (!r) return nullptr;
+    r->capacity = capacity;
+    r->arity = arity;
+    r->data = new (std::nothrow) float[(size_t)capacity * arity];
+    r->offsets = new (std::nothrow) uint64_t[capacity];
+    if (!r->data || !r->offsets) {
+        delete[] r->data;
+        delete[] r->offsets;
+        delete r;
+        return nullptr;
+    }
+    return r;
+}
+
+void fjt_ring_destroy(Ring* r) {
+    if (!r) return;
+    delete[] r->data;
+    delete[] r->offsets;
+    delete r;
+}
+
+void fjt_ring_close(Ring* r) {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+    r->not_empty.notify_all();
+    r->not_full.notify_all();
+}
+
+uint32_t fjt_ring_size(Ring* r) {
+    std::lock_guard<std::mutex> lk(r->mu);
+    return r->count;
+}
+
+int fjt_ring_closed(Ring* r) {
+    std::lock_guard<std::mutex> lk(r->mu);
+    return r->closed ? 1 : 0;
+}
+
+// Push a contiguous block of n records (n*arity floats) with consecutive
+// source offsets starting at first_offset. Blocks until all records are in
+// (backpressure) or timeout_us elapses. Returns the number of records
+// pushed; -1 (as UINT32_MAX) never — closed ring returns what fit.
+uint32_t fjt_ring_push_block(Ring* r, const float* recs, uint64_t first_offset,
+                             uint32_t n, int64_t timeout_us) {
+    uint32_t pushed = 0;
+    auto deadline = steady_clock::now() + microseconds(timeout_us);
+    std::unique_lock<std::mutex> lk(r->mu);
+    while (pushed < n) {
+        while (r->count == r->capacity && !r->closed) {
+            if (timeout_us >= 0) {
+                if (r->not_full.wait_until(lk, deadline) == std::cv_status::timeout)
+                    return pushed;
+            } else {
+                r->not_full.wait(lk);
+            }
+        }
+        if (r->closed) return pushed;
+        uint32_t room = r->capacity - r->count;
+        uint32_t take = n - pushed < room ? n - pushed : room;
+        for (uint32_t i = 0; i < take; ++i) {
+            uint32_t s = slot(r, r->count + i);
+            std::memcpy(r->data + (size_t)s * r->arity,
+                        recs + (size_t)(pushed + i) * r->arity,
+                        r->arity * sizeof(float));
+            r->offsets[s] = first_offset + pushed + i;
+        }
+        r->count += take;
+        pushed += take;
+        r->not_empty.notify_one();
+    }
+    return pushed;
+}
+
+// Fill-or-deadline drain into out (max_n*arity floats) + out_offsets
+// (max_n u64). Blocks until >=1 record (or closed) — bounded by
+// idle_timeout_us when >= 0 (0 records returned on expiry: lets a
+// consumer with control-plane work, e.g. the dynamic serving pipeline's
+// Add/Del polling, wake up on an idle stream; -1 waits indefinitely).
+// Once records flow, keeps taking until max_n or deadline_us after the
+// first take. Returns records drained (0 => closed-and-empty or idle
+// bound expired).
+uint32_t fjt_ring_drain(Ring* r, float* out, uint64_t* out_offsets,
+                        uint32_t max_n, int64_t deadline_us,
+                        int64_t idle_timeout_us) {
+    std::unique_lock<std::mutex> lk(r->mu);
+    auto idle_deadline = steady_clock::now() + microseconds(idle_timeout_us);
+    while (r->count == 0) {
+        if (r->closed) return 0;
+        if (idle_timeout_us >= 0) {
+            if (r->not_empty.wait_until(lk, idle_deadline) ==
+                    std::cv_status::timeout ||
+                (r->count == 0 && steady_clock::now() >= idle_deadline))
+                if (r->count == 0) return 0;
+        } else {
+            r->not_empty.wait_for(lk, milliseconds(100));
+        }
+    }
+    uint32_t drained = 0;
+    auto deadline = steady_clock::now() + microseconds(deadline_us);
+    for (;;) {
+        uint32_t take = r->count < max_n - drained ? r->count : max_n - drained;
+        for (uint32_t i = 0; i < take; ++i) {
+            uint32_t s = slot(r, i);
+            std::memcpy(out + (size_t)(drained + i) * r->arity,
+                        r->data + (size_t)s * r->arity,
+                        r->arity * sizeof(float));
+            out_offsets[drained + i] = r->offsets[s];
+        }
+        r->head = slot(r, take);
+        r->count -= take;
+        drained += take;
+        if (take) r->not_full.notify_all();
+        if (drained >= max_n) break;
+        if (r->count == 0) {
+            if (r->closed) break;
+            if (r->not_empty.wait_until(lk, deadline) == std::cv_status::timeout)
+                break;
+            if (r->count == 0 && r->closed) break;
+            if (steady_clock::now() >= deadline) break;
+        }
+    }
+    return drained;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Rank-wire bucketizer (compile/qtrees.py QuantizedWire.encode fast path).
+//
+// Maps each f32 feature value to its rank among that feature's model split
+// cuts — rank = #{c in cuts[j] : c < x} — producing the uint8/uint16 codes
+// the quantized TPU kernel compares against. This is host featurization
+// (the reference does the analogous prepare/coerce per record in
+// JPMML-Evaluator's FieldValue prep; SURVEY.md §4.1), multithreaded so the
+// host keeps ahead of the device at >1M records/s.
+//
+//   X        [n, f] row-major f32
+//   cuts     two layouts, one per entry-point family:
+//            fjt_bucketize_*      — ragged: concatenated per-feature sorted
+//                                   tables + offs[f+1] int32 offsets
+//            fjt_bucketize_pow2_* — [f, L] rows, +inf-padded to a shared
+//                                   power-of-two length L (no offs)
+//   repl     [f] f32 missing-value replacement (used where has_repl)
+//   has_repl [f] u8
+//   mask     [n, f] u8 missing mask, may be null (NaN always = missing)
+//   out      [n, f] codes; sentinel = max value of the code type
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Shared row-range fan-out: clamp thread count (spawn/join costs ~100us a
+// thread — keep >=4096 rows each) and run `rows` over [0, n) partitions.
+template <typename RowsFn>
+void fan_out_rows(uint64_t n, uint32_t n_threads, const RowsFn& rows) {
+    if (n_threads == 0) {
+        unsigned hw = std::thread::hardware_concurrency();
+        n_threads = hw ? hw : 4;
+    }
+    uint64_t max_useful = (n + 4095) / 4096;
+    if (n_threads > max_useful) n_threads = static_cast<uint32_t>(max_useful);
+    if (n_threads == 0) n_threads = 1;
+    if (n_threads <= 1) {
+        rows(uint64_t(0), n);
+        return;
+    }
+    std::vector<std::thread> ts;
+    ts.reserve(n_threads);
+    uint64_t per = (n + n_threads - 1) / n_threads;
+    for (uint32_t t = 0; t < n_threads; ++t) {
+        uint64_t b = t * per, e = b + per < n ? b + per : n;
+        if (b >= e) break;
+        ts.emplace_back(rows, b, e);
+    }
+    for (auto& t : ts) t.join();
+}
+
+template <typename Code>
+void bucketize_rows(const float* X, uint64_t row_begin, uint64_t row_end,
+                    uint32_t f, const float* cuts, const int32_t* offs,
+                    const float* repl, const uint8_t* has_repl,
+                    const uint8_t* mask, Code* out) {
+    const Code sentinel = static_cast<Code>(~Code(0));
+    for (uint64_t i = row_begin; i < row_end; ++i) {
+        const float* row = X + i * f;
+        const uint8_t* mrow = mask ? mask + i * f : nullptr;
+        Code* orow = out + i * f;
+        for (uint32_t j = 0; j < f; ++j) {
+            float x = row[j];
+            bool miss = (x != x) || (mrow && mrow[j]);
+            if (miss) {
+                if (has_repl[j]) {
+                    x = repl[j];
+                } else {
+                    orow[j] = sentinel;
+                    continue;
+                }
+            }
+            // branchless lower_bound: rank = #{c < x}. The `* half` form
+            // compiles to cmov — no data-dependent branches, which is worth
+            // ~5x on random inputs (every branch would mispredict).
+            const float* start = cuts + offs[j];
+            const float* lo = start;
+            uint32_t len = static_cast<uint32_t>(offs[j + 1] - offs[j]);
+            while (len > 1) {
+                uint32_t half = len / 2;
+                lo += (lo[half - 1] < x) * half;
+                len -= half;
+            }
+            orow[j] = static_cast<Code>((lo - start) + (len && lo[0] < x));
+        }
+    }
+}
+
+template <typename Code>
+void bucketize_impl(const float* X, uint64_t n, uint32_t f, const float* cuts,
+                    const int32_t* offs, const float* repl,
+                    const uint8_t* has_repl, const uint8_t* mask, Code* out,
+                    uint32_t n_threads) {
+    fan_out_rows(n, n_threads, [&](uint64_t b, uint64_t e) {
+        bucketize_rows<Code>(X, b, e, f, cuts, offs, repl, has_repl, mask,
+                             out);
+    });
+}
+
+// Lockstep variant over power-of-two padded tables (cuts[j*L .. j*L+L),
+// padded with +inf which never counts toward a rank). The per-feature
+// binary searches form f independent load-compare chains; executed
+// feature-after-feature each chain's ~log2(L) dependent loads serialize,
+// but interleaving them level-by-level keeps ~f independent loads in
+// flight per round, which on a single host core (the deployment reality
+// behind the tunneled-TPU bench) is worth ~1.3-2x.
+template <typename Code>
+void bucketize_rows_pow2(const float* X, uint64_t row_begin, uint64_t row_end,
+                         uint32_t f, const float* cuts, uint32_t L,
+                         const float* repl, const uint8_t* has_repl,
+                         const uint8_t* mask, Code* out) {
+    const Code sentinel = static_cast<Code>(~Code(0));
+    std::vector<uint32_t> pos(f);
+    std::vector<float> xv(f);
+    std::vector<uint8_t> miss(f);
+    for (uint64_t i = row_begin; i < row_end; ++i) {
+        const float* row = X + i * f;
+        const uint8_t* mrow = mask ? mask + i * f : nullptr;
+        Code* orow = out + i * f;
+        for (uint32_t j = 0; j < f; ++j) {
+            float x = row[j];
+            bool m = (x != x) || (mrow && mrow[j]);
+            if (m && has_repl[j]) {
+                x = repl[j];
+                m = false;
+            }
+            // NaN compares false against every cut, so a missing lane
+            // rides the rounds harmlessly and is overwritten at the end
+            miss[j] = m;
+            xv[j] = x;
+            pos[j] = 0;
+        }
+        for (uint32_t half = L >> 1; half >= 1; half >>= 1) {
+            for (uint32_t j = 0; j < f; ++j) {
+                const float* t = cuts + static_cast<uint64_t>(j) * L;
+                pos[j] += (t[pos[j] + half - 1] < xv[j]) * half;
+            }
+        }
+        for (uint32_t j = 0; j < f; ++j) {
+            const float* t = cuts + static_cast<uint64_t>(j) * L;
+            uint32_t r = pos[j] + (t[pos[j]] < xv[j]);
+            orow[j] = miss[j] ? sentinel : static_cast<Code>(r);
+        }
+    }
+}
+
+template <typename Code>
+void bucketize_pow2_impl(const float* X, uint64_t n, uint32_t f,
+                         const float* cuts, uint32_t L, const float* repl,
+                         const uint8_t* has_repl, const uint8_t* mask,
+                         Code* out, uint32_t n_threads) {
+    fan_out_rows(n, n_threads, [&](uint64_t b, uint64_t e) {
+        bucketize_rows_pow2<Code>(X, b, e, f, cuts, L, repl, has_repl, mask,
+                                  out);
+    });
+}
+
+}  // namespace
+
+extern "C" {
+
+void fjt_bucketize_pow2_u8(const float* X, uint64_t n, uint32_t f,
+                           const float* cuts, uint32_t L, const float* repl,
+                           const uint8_t* has_repl, const uint8_t* mask,
+                           uint8_t* out, uint32_t n_threads) {
+    bucketize_pow2_impl<uint8_t>(X, n, f, cuts, L, repl, has_repl, mask, out,
+                                 n_threads);
+}
+
+void fjt_bucketize_pow2_u16(const float* X, uint64_t n, uint32_t f,
+                            const float* cuts, uint32_t L, const float* repl,
+                            const uint8_t* has_repl, const uint8_t* mask,
+                            uint16_t* out, uint32_t n_threads) {
+    bucketize_pow2_impl<uint16_t>(X, n, f, cuts, L, repl, has_repl, mask, out,
+                                  n_threads);
+}
+
+void fjt_bucketize_u8(const float* X, uint64_t n, uint32_t f,
+                      const float* cuts, const int32_t* offs,
+                      const float* repl, const uint8_t* has_repl,
+                      const uint8_t* mask, uint8_t* out, uint32_t n_threads) {
+    bucketize_impl<uint8_t>(X, n, f, cuts, offs, repl, has_repl, mask, out,
+                            n_threads);
+}
+
+void fjt_bucketize_u16(const float* X, uint64_t n, uint32_t f,
+                       const float* cuts, const int32_t* offs,
+                       const float* repl, const uint8_t* has_repl,
+                       const uint8_t* mask, uint16_t* out,
+                       uint32_t n_threads) {
+    bucketize_impl<uint16_t>(X, n, f, cuts, offs, repl, has_repl, mask, out,
+                             n_threads);
+}
+
+}  // extern "C"

@@ -1,0 +1,4 @@
+"""User-facing API surface (SURVEY.md section 8 step 6)."""
+
+from flink_jpmml_tpu.api.reader import ModelReader, clear_model_cache  # noqa: F401
+from flink_jpmml_tpu.api.stream import EvaluatedStream, Stream, StreamEnvironment  # noqa: F401

@@ -1,0 +1,97 @@
+"""ModelReader: the path-not-model distribution contract (capability C2).
+
+Reference parity: ``ModelReader(path)`` (SURVEY.md §3 row B3, §4.4
+[UNVERIFIED]) — the PMML document never travels through the job graph; only
+its *path* does, and every worker loads it independently in the operator's
+``open()`` hook. Here the reader is a tiny pickleable handle; ``load()``
+parses + compiles at the worker, with a process-level cache keyed by
+(path, version-token, batch size) so repeated opens (restarts, multiple
+pipelines) compile once — the idempotent-reload property C7 depends on.
+
+Paths may be remote — ``http(s)://``, ``gs://``, ``s3://`` (SURVEY.md §1
+C1: the reference read from any Flink filesystem): :mod:`.remote` resolves
+them to a validated local cache copy, and its version token (ETag /
+generation / mtime) takes the cache-key slot mtime fills for local files,
+so a *changed* remote model recompiles and an unchanged one doesn't.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from flink_jpmml_tpu.api import remote
+from flink_jpmml_tpu.compile import CompiledModel, compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.utils.config import CompileConfig
+from flink_jpmml_tpu.utils.exceptions import ModelVerificationException
+
+_cache_lock = threading.Lock()
+_cache: Dict[Tuple, CompiledModel] = {}
+_verified: set = set()  # cache keys whose models passed verification
+
+
+@dataclass(frozen=True)
+class ModelReader:
+    path: str
+
+    def load(
+        self,
+        batch_size: Optional[int] = None,
+        config: Optional[CompileConfig] = None,
+        warmup: bool = False,
+        verify: bool = True,
+    ) -> CompiledModel:
+        """``verify=True`` (default) replays any embedded
+        <ModelVerification> vectors through the compiled model and
+        raises :class:`ModelVerificationException` on mismatch — a model
+        whose own test vectors fail must not serve (JPMML's
+        ``Evaluator.verify()`` contract). Documents without embedded
+        vectors load unconditionally."""
+        local_path, token = remote.fetch(self.path)
+        key = (
+            self.path if remote.is_remote(self.path)
+            else os.path.abspath(local_path),
+            token,
+            batch_size,
+            config,
+        )
+        with _cache_lock:
+            cached = _cache.get(key)
+            cached_verified = key in _verified
+        if cached is not None:
+            # the cache may hold a model first loaded with verify=False
+            # (operator override): a verify=True load must still replay
+            # the vectors before handing it out
+            if verify and cached.has_verification and not cached_verified:
+                self._verify(cached)
+                with _cache_lock:
+                    _verified.add(key)
+            return cached
+        doc = parse_pmml_file(local_path)
+        model = compile_pmml(doc, batch_size=batch_size, config=config)
+        if verify and model.has_verification:
+            self._verify(model)
+        if warmup:
+            model.warmup()
+        with _cache_lock:
+            _cache[key] = model
+            if verify:
+                _verified.add(key)
+        return model
+
+    def _verify(self, model: CompiledModel) -> None:
+        problems = model.verify()
+        if problems:
+            raise ModelVerificationException(
+                f"{self.path}: {len(problems)} ModelVerification "
+                f"mismatch(es): " + "; ".join(problems[:5])
+            )
+
+
+def clear_model_cache() -> None:
+    with _cache_lock:
+        _cache.clear()
+        _verified.clear()

@@ -1,0 +1,454 @@
+"""Deterministic PMML fixture generators for the five BASELINE configs.
+
+Reference parity: the ``flink-jpmml-assets`` module shipped PMML fixture files
+used by tests and examples (SURVEY.md §3 row D1 [UNVERIFIED]; §1 C8). The
+reference mount was empty, so fixtures are generated — seeded, so every run
+writes byte-identical documents:
+
+1. ``iris_lr.pmml``        — RegressionModel, softmax classification (config 1)
+2. ``gbm_<T>.pmml``        — MiningModel sum of T regression TreeModels with
+                             defaultChild missing handling + Targets rescale
+                             (config 2; T=500 is the headline benchmark model)
+3. ``mlp_<I>x<H>x<C>.pmml``— NeuralNetwork classification (config 3)
+4. ``kmeans.pmml``         — ClusteringModel, squaredEuclidean (config 4)
+5. ``stacked.pmml``        — MiningModel modelChain: GBM → logit calibration
+                             (config 5)
+
+Plus negative fixtures: ``malformed.pmml`` (truncated XML),
+``unsupported_version.pmml`` (PMML 3.2), ``no_model.pmml``.
+"""
+
+from __future__ import annotations
+
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+XMLNS = "http://www.dmg.org/PMML-4_3"
+VERSION = "4.3"
+
+
+def _pmml_root() -> ET.Element:
+    root = ET.Element("PMML", {"xmlns": XMLNS, "version": VERSION})
+    header = ET.SubElement(root, "Header", {"description": "flink_jpmml_tpu fixture"})
+    ET.SubElement(header, "Application", {"name": "flink_jpmml_tpu.assets"})
+    return root
+
+
+def _data_dictionary(root: ET.Element, fields, target=None, target_values=()):
+    dd = ET.SubElement(root, "DataDictionary")
+    for name in fields:
+        ET.SubElement(
+            dd, "DataField", {"name": name, "optype": "continuous", "dataType": "double"}
+        )
+    if target is not None:
+        tf = ET.SubElement(
+            dd,
+            "DataField",
+            {"name": target, "optype": "categorical", "dataType": "string"},
+        )
+        for v in target_values:
+            ET.SubElement(tf, "Value", {"value": v})
+    return dd
+
+
+def _mining_schema(model: ET.Element, fields, target=None):
+    ms = ET.SubElement(model, "MiningSchema")
+    if target is not None:
+        ET.SubElement(ms, "MiningField", {"name": target, "usageType": "target"})
+    for name in fields:
+        ET.SubElement(ms, "MiningField", {"name": name, "usageType": "active"})
+    return ms
+
+
+def _write(root: ET.Element, path: str) -> str:
+    ET.indent(root)
+    ET.ElementTree(root).write(path, encoding="utf-8", xml_declaration=True)
+    return path
+
+
+def _fmt(x: float) -> str:
+    return repr(float(np.float64(x)))
+
+
+# ---------------------------------------------------------------------------
+# Config 1: Iris logistic regression
+# ---------------------------------------------------------------------------
+
+IRIS_FIELDS = ("sepal_length", "sepal_width", "petal_length", "petal_width")
+IRIS_CLASSES = ("setosa", "versicolor", "virginica")
+
+
+def gen_iris_lr(out_dir: str, seed: int = 7) -> str:
+    rng = np.random.default_rng(seed)
+    root = _pmml_root()
+    _data_dictionary(root, IRIS_FIELDS, "species", IRIS_CLASSES)
+    model = ET.SubElement(
+        root,
+        "RegressionModel",
+        {
+            "modelName": "iris-lr",
+            "functionName": "classification",
+            "normalizationMethod": "softmax",
+        },
+    )
+    _mining_schema(model, IRIS_FIELDS, "species")
+    coefs = rng.normal(0.0, 1.0, size=(len(IRIS_CLASSES), len(IRIS_FIELDS)))
+    intercepts = rng.normal(0.0, 0.5, size=len(IRIS_CLASSES))
+    for ci, cls in enumerate(IRIS_CLASSES):
+        table = ET.SubElement(
+            model,
+            "RegressionTable",
+            {"intercept": _fmt(intercepts[ci]), "targetCategory": cls},
+        )
+        for fi, f in enumerate(IRIS_FIELDS):
+            ET.SubElement(
+                table,
+                "NumericPredictor",
+                {"name": f, "coefficient": _fmt(coefs[ci, fi])},
+            )
+    return _write(root, os.path.join(out_dir, "iris_lr.pmml"))
+
+
+# ---------------------------------------------------------------------------
+# Config 2: GBM — MiningModel sum of regression TreeModels
+# ---------------------------------------------------------------------------
+
+
+def _gen_tree_nodes(
+    parent, rng, n_features, depth, node_counter, value_scale, grids=None
+):
+    """Complete binary tree of the given depth under ``parent``: each split
+    puts complementary (lessThan t, greaterOrEqual t) predicates on the two
+    children; ``defaultChild`` points left; depth-1 children carry scores.
+
+    ``grids`` (optional, [n_features, n_bins]) restricts each feature's
+    thresholds to a fixed per-feature value grid, mirroring histogram-
+    trained GBMs (LightGBM / XGBoost-hist bin boundaries)."""
+    if depth < 1:
+        raise ValueError(f"tree depth must be >= 1, got {depth}")
+    feat = int(rng.integers(0, n_features))
+    if grids is not None:
+        thr = float(grids[feat][int(rng.integers(0, len(grids[feat])))])
+    else:
+        thr = float(rng.normal(0.0, 1.0))
+    left_id = str(next(node_counter))
+    right_id = str(next(node_counter))
+    for nid, op in ((left_id, "lessThan"), (right_id, "greaterOrEqual")):
+        node = ET.SubElement(parent, "Node", {"id": nid})
+        ET.SubElement(
+            node,
+            "SimplePredicate",
+            {"field": f"f{feat}", "operator": op, "value": _fmt(thr)},
+        )
+        if depth == 1:
+            node.set("score", _fmt(rng.normal(0.0, value_scale)))
+        else:
+            _gen_tree_nodes(
+                node, rng, n_features, depth - 1, node_counter, value_scale,
+                grids,
+            )
+    parent.set("defaultChild", left_id)
+
+
+def _counter():
+    i = 0
+    while True:
+        yield i
+        i += 1
+
+
+def gen_gbm(
+    out_dir: str,
+    n_trees: int = 500,
+    depth: int = 6,
+    n_features: int = 32,
+    seed: int = 11,
+    base_score: float = 0.5,
+    hist_bins: int | None = 254,
+    name: str | None = None,
+) -> str:
+    """500-tree GBM fixture (BASELINE config 2).
+
+    ``hist_bins`` (default 254) draws each feature's split thresholds from a
+    fixed per-feature grid of that many values, like histogram-trained GBMs
+    (LightGBM ``max_bin``/XGBoost ``tree_method=hist`` models, whose splits
+    always land on bin boundaries). This keeps the model eligible for the
+    uint8 rank wire (qtrees.py). ``hist_bins=None`` draws unrestricted
+    continuous thresholds instead."""
+    rng = np.random.default_rng(seed)
+    grids = (
+        np.sort(rng.normal(0.0, 1.0, size=(n_features, hist_bins)), axis=1)
+        if hist_bins is not None
+        else None
+    )
+    fields = tuple(f"f{i}" for i in range(n_features))
+    root = _pmml_root()
+    _data_dictionary(root, fields)
+    mm = ET.SubElement(
+        root,
+        "MiningModel",
+        {"modelName": f"gbm-{n_trees}", "functionName": "regression"},
+    )
+    _mining_schema(mm, fields)
+    targets = ET.SubElement(mm, "Targets")
+    ET.SubElement(targets, "Target", {"rescaleConstant": _fmt(base_score)})
+    seg = ET.SubElement(mm, "Segmentation", {"multipleModelMethod": "sum"})
+    for t in range(n_trees):
+        s = ET.SubElement(seg, "Segment", {"id": str(t)})
+        ET.SubElement(s, "True")
+        tree = ET.SubElement(
+            s,
+            "TreeModel",
+            {
+                "functionName": "regression",
+                "missingValueStrategy": "defaultChild",
+                "splitCharacteristic": "binarySplit",
+            },
+        )
+        _mining_schema(tree, fields)
+        root_node = ET.SubElement(tree, "Node", {"id": "r"})
+        ET.SubElement(root_node, "True")
+        _gen_tree_nodes(
+            root_node, rng, n_features, depth, _counter(), 0.1, grids
+        )
+    fname = name or f"gbm_{n_trees}.pmml"
+    return _write(root, os.path.join(out_dir, fname))
+
+
+# ---------------------------------------------------------------------------
+# Config 3: MLP NeuralNetwork
+# ---------------------------------------------------------------------------
+
+
+def gen_mlp(
+    out_dir: str,
+    n_inputs: int = 784,
+    hidden: tuple = (256,),
+    n_classes: int = 10,
+    seed: int = 13,
+    name: str | None = None,
+) -> str:
+    rng = np.random.default_rng(seed)
+    fields = tuple(f"x{i}" for i in range(n_inputs))
+    classes = tuple(str(c) for c in range(n_classes))
+    root = _pmml_root()
+    _data_dictionary(root, fields, "digit", classes)
+    nn = ET.SubElement(
+        root,
+        "NeuralNetwork",
+        {
+            "modelName": "mlp",
+            "functionName": "classification",
+            "activationFunction": "rectifier",
+            "normalizationMethod": "softmax",
+        },
+    )
+    _mining_schema(nn, fields, "digit")
+    inputs = ET.SubElement(nn, "NeuralInputs")
+    for i, f in enumerate(fields):
+        ni = ET.SubElement(inputs, "NeuralInput", {"id": f"in{i}"})
+        df = ET.SubElement(
+            ni, "DerivedField", {"optype": "continuous", "dataType": "double"}
+        )
+        ET.SubElement(df, "FieldRef", {"field": f})
+    prev_ids = [f"in{i}" for i in range(n_inputs)]
+    sizes = list(hidden) + [n_classes]
+    for li, width in enumerate(sizes):
+        is_output = li == len(sizes) - 1
+        attrs = {}
+        if is_output:
+            attrs["activationFunction"] = "identity"
+        layer = ET.SubElement(nn, "NeuralLayer", attrs)
+        scale = 1.0 / np.sqrt(len(prev_ids))
+        w = rng.normal(0.0, scale, size=(width, len(prev_ids)))
+        b = rng.normal(0.0, 0.1, size=width)
+        ids = []
+        for j in range(width):
+            nid = f"l{li}n{j}"
+            neuron = ET.SubElement(
+                layer, "Neuron", {"id": nid, "bias": _fmt(b[j])}
+            )
+            for k, src in enumerate(prev_ids):
+                ET.SubElement(
+                    neuron, "Con", {"from": src, "weight": _fmt(w[j, k])}
+                )
+            ids.append(nid)
+        prev_ids = ids
+    outs = ET.SubElement(nn, "NeuralOutputs")
+    for j, cls in enumerate(classes):
+        no = ET.SubElement(outs, "NeuralOutput", {"outputNeuron": prev_ids[j]})
+        df = ET.SubElement(
+            no, "DerivedField", {"optype": "categorical", "dataType": "string"}
+        )
+        ET.SubElement(df, "NormDiscrete", {"field": "digit", "value": cls})
+    fname = name or f"mlp_{n_inputs}x{'x'.join(map(str, hidden))}x{n_classes}.pmml"
+    return _write(root, os.path.join(out_dir, fname))
+
+
+# ---------------------------------------------------------------------------
+# Config 4: K-Means clustering
+# ---------------------------------------------------------------------------
+
+
+def gen_kmeans(
+    out_dir: str, k: int = 5, n_features: int = 4, seed: int = 17
+) -> str:
+    rng = np.random.default_rng(seed)
+    fields = tuple(f"f{i}" for i in range(n_features))
+    root = _pmml_root()
+    _data_dictionary(root, fields)
+    cm = ET.SubElement(
+        root,
+        "ClusteringModel",
+        {
+            "modelName": "kmeans",
+            "functionName": "clustering",
+            "modelClass": "centerBased",
+            "numberOfClusters": str(k),
+        },
+    )
+    _mining_schema(cm, fields)
+    measure = ET.SubElement(cm, "ComparisonMeasure", {"kind": "distance"})
+    ET.SubElement(measure, "squaredEuclidean")
+    for f in fields:
+        ET.SubElement(cm, "ClusteringField", {"field": f})
+    centers = rng.normal(0.0, 2.0, size=(k, n_features))
+    for ci in range(k):
+        cl = ET.SubElement(
+            cm, "Cluster", {"id": str(ci + 1), "name": f"cluster-{ci + 1}"}
+        )
+        arr = ET.SubElement(
+            cl, "Array", {"n": str(n_features), "type": "real"}
+        )
+        arr.text = " ".join(_fmt(v) for v in centers[ci])
+    return _write(root, os.path.join(out_dir, "kmeans.pmml"))
+
+
+# ---------------------------------------------------------------------------
+# Config 5: stacked modelChain — GBM → logistic calibration
+# ---------------------------------------------------------------------------
+
+
+def gen_stacked(
+    out_dir: str,
+    n_trees: int = 50,
+    depth: int = 4,
+    n_features: int = 64,
+    seed: int = 23,
+    name: str = "stacked.pmml",
+) -> str:
+    rng = np.random.default_rng(seed)
+    fields = tuple(f"f{i}" for i in range(n_features))
+    root = _pmml_root()
+    _data_dictionary(root, fields)
+    outer = ET.SubElement(
+        root,
+        "MiningModel",
+        {"modelName": "stacked", "functionName": "regression"},
+    )
+    _mining_schema(outer, fields)
+    seg = ET.SubElement(outer, "Segmentation", {"multipleModelMethod": "modelChain"})
+
+    # Segment 1: inner GBM (MiningModel sum of trees) exporting gbm_score
+    s1 = ET.SubElement(seg, "Segment", {"id": "gbm"})
+    ET.SubElement(s1, "True")
+    inner = ET.SubElement(
+        s1, "MiningModel", {"functionName": "regression", "modelName": "inner-gbm"}
+    )
+    out1 = ET.SubElement(inner, "Output")
+    ET.SubElement(
+        out1,
+        "OutputField",
+        {"name": "gbm_score", "feature": "predictedValue"},
+    )
+    _mining_schema(inner, fields)
+    iseg = ET.SubElement(inner, "Segmentation", {"multipleModelMethod": "sum"})
+    for t in range(n_trees):
+        st = ET.SubElement(iseg, "Segment", {"id": f"t{t}"})
+        ET.SubElement(st, "True")
+        tree = ET.SubElement(
+            st,
+            "TreeModel",
+            {
+                "functionName": "regression",
+                "missingValueStrategy": "defaultChild",
+                "splitCharacteristic": "binarySplit",
+            },
+        )
+        _mining_schema(tree, fields)
+        root_node = ET.SubElement(tree, "Node", {"id": "r"})
+        ET.SubElement(root_node, "True")
+        _gen_tree_nodes(root_node, rng, n_features, depth, _counter(), 0.2)
+
+    # Segment 2: logistic calibration over gbm_score
+    s2 = ET.SubElement(seg, "Segment", {"id": "calibrate"})
+    ET.SubElement(s2, "True")
+    lr = ET.SubElement(
+        s2,
+        "RegressionModel",
+        {
+            "functionName": "regression",
+            "normalizationMethod": "logit",
+            "modelName": "calibration",
+        },
+    )
+    ms = ET.SubElement(lr, "MiningSchema")
+    ET.SubElement(ms, "MiningField", {"name": "gbm_score", "usageType": "active"})
+    table = ET.SubElement(lr, "RegressionTable", {"intercept": _fmt(-0.3)})
+    ET.SubElement(
+        table,
+        "NumericPredictor",
+        {"name": "gbm_score", "coefficient": _fmt(1.7)},
+    )
+    return _write(root, os.path.join(out_dir, name))
+
+
+# ---------------------------------------------------------------------------
+# Negative fixtures + entry point
+# ---------------------------------------------------------------------------
+
+
+def gen_negative(out_dir: str) -> None:
+    with open(os.path.join(out_dir, "malformed.pmml"), "w") as f:
+        f.write('<?xml version="1.0"?><PMML version="4.3"><DataDictionary>')
+    with open(os.path.join(out_dir, "unsupported_version.pmml"), "w") as f:
+        f.write(
+            '<?xml version="1.0"?><PMML xmlns="http://www.dmg.org/PMML-3_2" '
+            'version="3.2"><DataDictionary/></PMML>'
+        )
+    with open(os.path.join(out_dir, "no_model.pmml"), "w") as f:
+        f.write(
+            f'<?xml version="1.0"?><PMML xmlns="{XMLNS}" version="4.3">'
+            "<DataDictionary/></PMML>"
+        )
+
+
+def generate_all(out_dir: str, small: bool = True) -> dict:
+    """Write the standard fixture set; ``small=True`` keeps tests fast
+    (tiny GBM/MLP); bench generates its own full-size models."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {
+        "iris_lr": gen_iris_lr(out_dir),
+        "kmeans": gen_kmeans(out_dir),
+        "stacked": gen_stacked(out_dir, n_trees=8, depth=3, n_features=12),
+    }
+    if small:
+        paths["gbm"] = gen_gbm(out_dir, n_trees=16, depth=4, n_features=8,
+                               name="gbm_small.pmml")
+        paths["mlp"] = gen_mlp(out_dir, n_inputs=8, hidden=(16,), n_classes=3,
+                               name="mlp_small.pmml")
+    else:
+        paths["gbm"] = gen_gbm(out_dir, n_trees=500, depth=6, n_features=32)
+        paths["mlp"] = gen_mlp(out_dir)
+    gen_negative(out_dir)
+    return paths
+
+
+if __name__ == "__main__":
+    import sys
+
+    out = sys.argv[1] if len(sys.argv) > 1 else "assets/generated"
+    small = "--full" not in sys.argv
+    print(generate_all(out, small=small))

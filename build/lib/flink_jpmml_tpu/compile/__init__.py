@@ -1,0 +1,4 @@
+"""PMML IR -> JAX lowering (SURVEY.md section 8 step 2): the heart of the framework."""
+
+from flink_jpmml_tpu.compile.compiler import CompiledModel, compile_pmml  # noqa: F401
+from flink_jpmml_tpu.compile.common import ModelOutput  # noqa: F401

@@ -1,0 +1,106 @@
+"""AssociationModel → JAX: rule firing as one 0/1 matmul + ranked pick.
+
+Reference parity: JPMML-Evaluator scores AssociationModel documents
+(SURVEY.md §1 C1) over transaction baskets. The streaming input contract
+here is the fixed-width, TPU-native framing (see ir.AssociationIR): one
+active MiningField per declared item, value > 0.5 ⇔ the item is in the
+record's basket.
+
+Lowering: with basket matrix Xb ∈ {0,1}^[B, I] and antecedent matrix
+A ∈ {0,1}^[R, I], a rule fires iff Xb·Aᵀ equals the antecedent size —
+subset testing as a single matmul. The per-criterion winner
+(rule / recommendation / exclusiveRecommendation) needs the
+consequent∩basket count, a second matmul against the consequent matrix.
+Rules are pre-sorted host-side by (confidence desc, support desc,
+document order); the device picks the first fired rule in that order
+with one argmax. Prediction: value = winning rule's confidence,
+label = its consequent (space-joined); no rule fired ⇒ empty lane.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import (
+    HIGHEST,
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+)
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+def lower_association(model: ir.AssociationIR, ctx: LowerCtx) -> Lowered:
+    items = model.items
+    ipos = {v: i for i, v in enumerate(items)}
+    cols = np.asarray([ctx.column(v) for v in items], np.int32)
+    R, I = len(model.rules), len(items)
+
+    A = np.zeros((R, I), np.float32)  # antecedent membership
+    Cq = np.zeros((R, I), np.float32)  # consequent membership
+    conf = np.zeros((R,), np.float32)
+    for ri, r in enumerate(model.rules):
+        for v in r.antecedent:
+            A[ri, ipos[v]] = 1.0
+        for v in r.consequent:
+            Cq[ri, ipos[v]] = 1.0
+        conf[ri] = r.confidence
+    ante_n = A.sum(axis=1)
+    cons_n = Cq.sum(axis=1)
+    if (cons_n == 0).any():
+        raise ModelCompilationException(
+            "AssociationRule with an empty consequent"
+        )
+
+    # host-side ranking: fired rules are picked in this order on-device
+    order = sorted(
+        range(R),
+        key=lambda i: (-model.rules[i].confidence, -model.rules[i].support, i),
+    )
+    order_a = np.asarray(order, np.int32)
+    criterion = model.criterion
+    if criterion not in ("rule", "recommendation", "exclusiveRecommendation"):
+        raise ModelCompilationException(
+            f"unsupported association criterion {criterion!r}"
+        )
+
+    params = {
+        "A": A, "Cq": Cq,
+        "ante_n": ante_n.astype(np.float32),
+        "cons_n": cons_n.astype(np.float32),
+        "conf": conf,
+        "order": order_a,
+    }
+    labels = tuple(" ".join(r.consequent) for r in model.rules)
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        # missing item columns read as "not in basket" — a basket field
+        # that was never observed cannot assert membership
+        Xb = ((X[:, cols] > 0.5) & ~M[:, cols]).astype(jnp.float32)
+        in_ante = jnp.matmul(Xb, p["A"].T, precision=HIGHEST)  # [B, R]
+        fired = in_ante >= p["ante_n"][None, :] - 0.5
+        if criterion != "recommendation":
+            # JPMML-parity criteria: "rule" = whole rule in the basket;
+            # "recommendation" = antecedent only; "exclusiveRecommendation"
+            # (spec default) = antecedent in, consequent NOT fully in yet
+            in_cons = jnp.matmul(Xb, p["Cq"].T, precision=HIGHEST)
+            cons_in = in_cons >= p["cons_n"][None, :] - 0.5
+            fired = fired & (cons_in if criterion == "rule" else ~cons_in)
+        fired_sorted = jnp.take(fired, p["order"], axis=1)
+        first = jnp.argmax(fired_sorted, axis=1)  # first True in rank order
+        rule_idx = jnp.take(p["order"], first)
+        valid = jnp.any(fired_sorted, axis=1)
+        value = jnp.take(p["conf"], rule_idx)
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=valid,
+            # fired mask in DOCUMENT order: the decode side ranks it with
+            # the same static order to serve rank-k ruleValue fields
+            probs=fired.astype(jnp.float32),
+            label_idx=rule_idx.astype(jnp.int32),
+        )
+
+    return Lowered(fn=fn, params=params, labels=labels)

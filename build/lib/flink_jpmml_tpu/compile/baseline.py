@@ -1,0 +1,43 @@
+"""BaselineModel → JAX: per-record z-value against a parametric baseline.
+
+Reference parity: JPMML-Evaluator scores BaselineModel documents
+(SURVEY.md §1 C1). The ``zValue`` test statistic is stateless per record:
+
+    z = (x − μ₀) / σ₀
+
+with (μ₀, σ₀²) from the declared baseline distribution — Gaussian
+(mean, variance), Poisson (σ₀² = μ₀), or Uniform (μ₀ = (l+u)/2,
+σ₀² = (u−l)²/12). Windowed statistics (CUSUM, chi-square families) are
+multi-record and rejected at parse time (pmml/parser.py), keeping the
+per-record streaming contract honest. A missing test field scores as an
+empty lane.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+
+
+def lower_baseline(model: ir.BaselineIR, ctx: LowerCtx) -> Lowered:
+    col = ctx.column(model.field)
+    mean = float(model.baseline.mean)
+    inv_sd = 1.0 / math.sqrt(model.baseline.variance)
+    params = {
+        "mean": np.float32(mean),
+        "inv_sd": np.float32(inv_sd),
+    }
+
+    def fn(p, X, M):
+        x = X[:, col]
+        return ModelOutput(
+            value=((x - p["mean"]) * p["inv_sd"]).astype(jnp.float32),
+            valid=~M[:, col],
+        )
+
+    return Lowered(fn=fn, params=params)

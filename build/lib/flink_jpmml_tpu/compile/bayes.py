@@ -1,0 +1,151 @@
+"""NaiveBayesModel → JAX: summed log-likelihood tables + argmax.
+
+Reference parity: JPMML scores NaiveBayes documents (SURVEY.md §1 C1).
+Semantics (PMML 4.x):
+
+    L(t) = log count(t) + Σ_i log P(x_i | t)
+
+- categorical input: P = PairCounts count / BayesOutput target count;
+  zero probabilities are replaced by the model ``threshold``;
+- continuous input: Gaussian density from TargetValueStats
+  (mean/variance per target value);
+- a missing input (or an input value with no PairCounts row) simply
+  drops its term — records with everything missing score the priors.
+
+The winner is argmax L; per-class probabilities are the normalized
+likelihoods (softmax over L). Lowering: each categorical input is one
+log-probability table ``[V_i + 1, T]`` (last row = the out-of-table /
+missing zero row) gathered per record; continuous inputs are closed-form
+log-density lanes; everything sums into one ``[B, T]`` plane.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+def lower_naive_bayes(model: ir.NaiveBayesIR, ctx: LowerCtx) -> Lowered:
+    labels = tuple(v for v, _ in model.target_counts)
+    T = len(labels)
+    tpos = {v: i for i, v in enumerate(labels)}
+    totals = np.asarray([c for _, c in model.target_counts], np.float64)
+    if (totals <= 0).any():
+        raise ModelCompilationException(
+            "BayesOutput target counts must all be positive"
+        )
+    thr = model.threshold
+    prior = np.log(totals)  # unnormalized: constants cancel in argmax
+
+    cat_tables: list = []  # (col, codes f32[V], logp f32[V+1, T])
+    cont_rows: list = []  # (col, mean[T], var[T], active[T])
+    for bi in model.inputs:
+        col = ctx.column(bi.field)
+        if isinstance(bi, ir.BayesCategoricalInput):
+            codes = []
+            rows = []
+            for value, counts in bi.counts:
+                codes.append(ctx.encode(bi.field, value))
+                row = np.zeros((T,), np.float64)
+                for tv, cnt in counts:
+                    if tv not in tpos:
+                        raise ModelCompilationException(
+                            f"BayesInput {bi.field!r}: PairCounts target "
+                            f"{tv!r} not in BayesOutput"
+                        )
+                    row[tpos[tv]] = cnt
+                p = row / totals
+                if thr <= 0 and (p <= 0).any():
+                    raise ModelCompilationException(
+                        f"BayesInput {bi.field!r}: zero conditional "
+                        "probability with no positive model threshold"
+                    )
+                # the threshold replaces ZERO probabilities only (spec);
+                # a small positive p stays itself even if below threshold
+                rows.append(np.log(np.where(p > 0, p, thr)))
+            # sentinel last row: out-of-table / missing input drops the
+            # term (contributes 0 to every class)
+            logp = np.zeros((len(rows) + 1, T), np.float32)
+            logp[: len(rows)] = np.asarray(rows, np.float32)
+            cat_tables.append(
+                (col, np.asarray(codes, np.float32), logp)
+            )
+        else:
+            mean = np.zeros((T,), np.float32)
+            var = np.ones((T,), np.float32)
+            active = np.zeros((T,), np.float32)
+            for tv, m_, v_ in bi.stats:
+                if tv not in tpos:
+                    raise ModelCompilationException(
+                        f"BayesInput {bi.field!r}: stats target {tv!r} "
+                        "not in BayesOutput"
+                    )
+                if v_ <= 0:
+                    raise ModelCompilationException(
+                        f"BayesInput {bi.field!r}: non-positive variance "
+                        f"for target {tv!r}"
+                    )
+                mean[tpos[tv]] = m_
+                var[tpos[tv]] = v_
+                active[tpos[tv]] = 1.0
+            cont_rows.append((col, mean, var, active))
+
+    params = {
+        "prior": prior.astype(np.float32),
+        **{
+            f"cat{i}_logp": t[2] for i, t in enumerate(cat_tables)
+        },
+        **{
+            f"cat{i}_codes": t[1] for i, t in enumerate(cat_tables)
+        },
+    }
+    for i, (col, mean, var, active) in enumerate(cont_rows):
+        params[f"g{i}_mean"] = mean
+        params[f"g{i}_var"] = var
+        params[f"g{i}_act"] = active
+    log2pi = float(math.log(2.0 * math.pi))
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        L = jnp.broadcast_to(p["prior"][None, :], (B, T))
+        for i, (col, _codes, _logp) in enumerate(cat_tables):
+            codes = p[f"cat{i}_codes"]
+            logp = p[f"cat{i}_logp"]
+            V = codes.shape[0]
+            x = X[:, col]
+            hit = x[:, None] == codes[None, :]  # [B, V]
+            idx = jnp.where(
+                jnp.any(hit, axis=1) & ~M[:, col],
+                jnp.argmax(hit, axis=1),
+                V,  # sentinel zero row: missing / unknown value
+            )
+            L = L + jnp.take(logp, idx, axis=0)
+        for i, (col, _m, _v, _a) in enumerate(cont_rows):
+            mean = p[f"g{i}_mean"]
+            var = p[f"g{i}_var"]
+            act = p[f"g{i}_act"]
+            x = X[:, col][:, None]
+            logpdf = -0.5 * (log2pi + jnp.log(var))[None, :] - (
+                (x - mean[None, :]) ** 2 / (2.0 * var)[None, :]
+            )
+            drop = M[:, col][:, None] | (act[None, :] < 0.5)
+            L = L + jnp.where(drop, 0.0, logpdf)
+        lab = jnp.argmax(L, axis=1).astype(jnp.int32)
+        m = jnp.max(L, axis=1, keepdims=True)
+        e = jnp.exp(L - m)
+        probs = e / jnp.sum(e, axis=1, keepdims=True)
+        value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=jnp.ones((B,), bool),
+            probs=probs.astype(jnp.float32),
+            label_idx=lab,
+        )
+
+    return Lowered(fn=fn, params=params, labels=labels)

@@ -1,0 +1,173 @@
+"""BayesianNetworkModel (discrete) → JAX: CPT-row matmuls in log space.
+
+Reference parity: PMML 4.3 declares BayesianNetworkModel (SURVEY.md §1
+C1 model-class coverage). Under the streaming contract every non-target
+node is an observed active field (enforced at parse), so the target
+posterior is closed form over its Markov blanket:
+
+    P(t = s | e) ∝ P(t = s | pa(t)) · Π_{c : t ∈ pa(c)} P(c_obs | pa(c), t = s)
+
+Lowering: each factor becomes a CPT-row *match matmul*. For a factor
+with rows r over observed parent configs, ``A[B, r] = Π_j [x_{p_j} =
+config_{r,j}]`` is a product of equality indicators; the log-probability
+contribution is ``(A * logP) @ onehot(rows → target states)`` — three
+small einsums per factor, no gathers over dynamic shapes. Lanes where
+any observation is missing/unknown, or where the matched rows don't
+uniquely cover every state, come out invalid (C5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from flink_jpmml_tpu.compile.common import (
+    HIGHEST,
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+)
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+_TINY = 1e-30  # log(0) guard: exp(log(_TINY)) underflows to ~0 after norm
+
+
+def lower_bayesian_network(
+    model: ir.BayesianNetworkIR, ctx: LowerCtx
+) -> Lowered:
+    by_name = {n.name: n for n in model.nodes}
+    tnode = by_name[model.target]
+    S = len(tnode.values)
+    tpos = {v: i for i, v in enumerate(tnode.values)}
+
+    def code(field: str, value: str) -> float:
+        return ctx.encode(field, value)
+
+    params: dict = {}
+    factors = []  # (kind, names) closures assembled below
+
+    # -- target's own CPT ---------------------------------------------------
+    R = len(tnode.cpt)
+    t_cols = np.asarray(
+        [ctx.column(p) for p in tnode.parents], np.int32
+    )
+    t_cfg = np.zeros((R, max(len(tnode.parents), 1)), np.float32)
+    t_logp = np.zeros((R, S), np.float32)
+    t_pos = np.zeros((R, S), np.float32)
+    for r, (config, probs) in enumerate(tnode.cpt):
+        for j, v in enumerate(config):
+            t_cfg[r, j] = code(tnode.parents[j], v)
+        t_logp[r] = np.log(np.maximum(np.asarray(probs), _TINY))
+        t_pos[r] = (np.asarray(probs) > 0).astype(np.float32)
+    params["t_cfg"] = t_cfg
+    params["t_logp"] = t_logp
+    # exact positivity alongside the clamped logs: a state whose TRUE
+    # probability is zero must decode to exactly 0 (and all-zero lanes
+    # to invalid), matching the oracle — the log(_TINY) clamp alone
+    # cancels in the softmax and would fake a posterior
+    params["t_pos"] = t_pos
+
+    # -- children of the target --------------------------------------------
+    children = []
+    for child in model.nodes:
+        if child.name == model.target or model.target not in child.parents:
+            continue
+        ti = child.parents.index(model.target)
+        other = [p for j, p in enumerate(child.parents) if j != ti]
+        Rc = len(child.cpt)
+        cfg = np.zeros((Rc, max(len(other), 1)), np.float32)
+        onehot = np.zeros((Rc, S), np.float32)
+        logp = np.zeros((Rc, len(child.values)), np.float32)
+        for r, (config, probs) in enumerate(child.cpt):
+            tv = config[ti]
+            if tv not in tpos:
+                raise ModelCompilationException(
+                    f"DiscreteNode {child.name!r}: ParentValue {tv!r} is "
+                    f"not a state of target {model.target!r}"
+                )
+            onehot[r, tpos[tv]] = 1.0
+            k = 0
+            for j, v in enumerate(config):
+                if j == ti:
+                    continue
+                cfg[r, k] = code(child.parents[j], v)
+                k += 1
+            logp[r] = np.log(np.maximum(np.asarray(probs), _TINY))
+        key = f"c{len(children)}"
+        params[f"{key}_cfg"] = cfg
+        params[f"{key}_onehot"] = onehot
+        params[f"{key}_logp"] = logp
+        params[f"{key}_pos"] = np.asarray(
+            [[pr > 0 for pr in probs] for _, probs in child.cpt], np.float32
+        )
+        params[f"{key}_vcodes"] = np.asarray(
+            [code(child.name, v) for v in child.values], np.float32
+        )
+        children.append((
+            key,
+            ctx.column(child.name),
+            np.asarray([ctx.column(p) for p in other], np.int32),
+        ))
+
+    labels = tnode.values
+
+    def row_match(p_cfg, X, M, cols):
+        """[B, R] product of per-parent equality indicators (1 when the
+        factor has no observed parents)."""
+        if cols.shape[0] == 0:
+            return jnp.ones((X.shape[0], p_cfg.shape[0]), jnp.float32)
+        xv = X[:, cols]  # [B, P]
+        ok = ~M[:, cols]
+        eq = (xv[:, None, :] == p_cfg[None, :, : cols.shape[0]]) & ok[
+            :, None, :
+        ]
+        return jnp.all(eq, axis=-1).astype(jnp.float32)
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        A_t = row_match(p["t_cfg"], X, M, t_cols)  # [B, R]
+        valid = jnp.sum(A_t, axis=1) == 1.0
+        logp = jnp.matmul(A_t, p["t_logp"], precision=HIGHEST)  # [B, S]
+        pos = jnp.matmul(A_t, p["t_pos"], precision=HIGHEST)  # [B, S]
+        for key, ccol, ocols in children:
+            A = row_match(p[f"{key}_cfg"], X, M, ocols)  # [B, Rc]
+            # exactly one matching row per target state
+            cover = jnp.matmul(
+                A, p[f"{key}_onehot"], precision=HIGHEST
+            )  # [B, S]
+            valid = valid & jnp.all(cover == 1.0, axis=1)
+            # observed child value → per-row log prob
+            vcodes = p[f"{key}_vcodes"]
+            hit = (X[:, ccol][:, None] == vcodes[None, :]) & ~M[
+                :, ccol
+            ][:, None]
+            valid = valid & jnp.any(hit, axis=1)
+            obs = jnp.argmax(hit, axis=1)  # [B]
+            lp_rows = p[f"{key}_logp"][:, :]  # [Rc, V]
+            lp_obs = jnp.take(lp_rows.T, obs, axis=0)  # [B, Rc]
+            logp = logp + jnp.matmul(
+                A * lp_obs, p[f"{key}_onehot"], precision=HIGHEST
+            )
+            pos_obs = jnp.take(p[f"{key}_pos"].T, obs, axis=0)  # [B, Rc]
+            pos = pos * jnp.matmul(
+                A * pos_obs, p[f"{key}_onehot"], precision=HIGHEST
+            )
+        m = jnp.max(logp, axis=1, keepdims=True)
+        # exact zeros where any factor's true probability was zero — the
+        # clamped logs would otherwise cancel in the softmax and fake a
+        # posterior for impossible evidence
+        e = jnp.exp(logp - m) * pos
+        total = jnp.sum(e, axis=1, keepdims=True)
+        probs = e / jnp.maximum(total, _TINY)
+        valid = valid & (total[:, 0] > 0)
+        lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+        value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=valid,
+            probs=probs.astype(jnp.float32),
+            label_idx=lab,
+        )
+
+    return Lowered(fn=fn, params=params, labels=labels)

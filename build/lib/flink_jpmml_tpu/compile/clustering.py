@@ -1,0 +1,240 @@
+"""ClusteringModel → JAX: batched distance matrix + argmin.
+
+Reference behavior (quick-evaluate over a K-Means PMML, SURVEY.md §1 C3/C8):
+per record, compute the comparison measure against every cluster center and
+emit the winning cluster. Here the whole batch's distance matrix is one
+broadcasted reduction — ``probs`` carries the per-cluster distances.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+# per-field comparison codes (spec: compareFunction on ComparisonMeasure,
+# overridable per ClusteringField)
+_CMP_CODES = {"absDiff": 0, "gaussSim": 1, "delta": 2, "equal": 3}
+
+
+def resolve_compare_fields(fields, measure: ir.ComparisonMeasure):
+    """→ (codes i32[D], gauss_s f32[D]) for any per-field sequence with
+    ``field``/``compare_function``/``similarity_scale`` attributes
+    (ClusteringField, KNNInput). Shared by the lowerings and the oracle
+    so they cannot diverge."""
+    D = len(fields)
+    codes = np.zeros((D,), np.int32)
+    scale = np.ones((D,), np.float32)
+    for i, cf in enumerate(fields):
+        name = cf.compare_function or measure.compare_function
+        code = _CMP_CODES.get(name)
+        if code is None:
+            raise ModelCompilationException(
+                f"unsupported compareFunction {name!r} on field "
+                f"{cf.field!r} (supported: {', '.join(_CMP_CODES)})"
+            )
+        codes[i] = code
+        if name == "gaussSim":
+            if cf.similarity_scale is None or cf.similarity_scale <= 0:
+                raise ModelCompilationException(
+                    f"gaussSim on field {cf.field!r} needs a positive "
+                    "similarityScale"
+                )
+            scale[i] = cf.similarity_scale
+    return codes, scale
+
+
+def resolve_compare(model: ir.ClusteringModelIR):
+    return resolve_compare_fields(model.clustering_fields, model.measure)
+
+
+def make_distance(
+    measure: ir.ComparisonMeasure,
+    cmp_codes: np.ndarray,
+    gauss_s: np.ndarray,
+    weights: np.ndarray,
+    mv_q=None,
+):
+    """→ f(xs [B,D], centers [K,D][, miss [B,D]]) -> distances [B,K]
+    under the spec aggregation (the field weight multiplies the powered
+    comparison). Shared by the clustering and nearest-neighbor
+    lowerings. With ``mv_q`` (MissingValueWeights) and a ``miss`` mask,
+    missing fields' terms drop out and sum-based metrics rescale by
+    Σq / Σ_nonmissing q (chebychev is a max, not a sum — no rescale)."""
+    metric = measure.metric
+    mink_p = float(measure.minkowski_p)
+    if metric == "minkowski" and mink_p <= 0:
+        raise ModelCompilationException(
+            f"minkowski needs a positive p-parameter, got {mink_p}"
+        )
+    all_absdiff = bool((cmp_codes == 0).all())
+    ln2 = float(np.log(2.0))
+    q_total = float(np.sum(mv_q)) if mv_q is not None else 0.0
+
+    def dist(xs, centers, miss=None):
+        delta = xs[:, None, :] - centers[None, :, :]  # [B, K, D]
+        if all_absdiff:
+            c = jnp.abs(delta)
+        else:
+            ad = jnp.abs(delta)
+            eq = delta == 0.0
+            gs = jnp.exp(-ln2 * delta * delta / (gauss_s * gauss_s))
+            c = jnp.where(
+                cmp_codes == 1, gs,
+                jnp.where(
+                    cmp_codes == 2, jnp.where(eq, 0.0, 1.0),
+                    jnp.where(cmp_codes == 3, jnp.where(eq, 1.0, 0.0), ad),
+                ),
+            )
+        w = weights
+        adjust = None
+        if miss is not None:
+            keep = (~miss).astype(jnp.float32)  # [B, D]
+            c = c * keep[:, None, :]  # dropped terms contribute 0
+            q_nonmiss = jnp.sum(keep * mv_q[None, :], axis=-1)  # [B]
+            adjust = (
+                q_total / jnp.maximum(q_nonmiss, 1e-30)
+            )[:, None]  # [B, 1]
+
+        def scaled(s):
+            return s if adjust is None else s * adjust
+
+        if metric == "squaredEuclidean":
+            return scaled(jnp.sum(w * c * c, axis=-1))
+        if metric == "euclidean":
+            return jnp.sqrt(scaled(jnp.sum(w * c * c, axis=-1)))
+        if metric == "cityBlock":
+            return scaled(jnp.sum(w * c, axis=-1))
+        if metric == "chebychev":
+            return jnp.max(w * c, axis=-1)
+        if metric == "minkowski":
+            return jnp.power(
+                scaled(jnp.sum(w * jnp.power(jnp.abs(c), mink_p), axis=-1)),
+                1.0 / mink_p,
+            )
+        raise ModelCompilationException(f"unsupported metric {metric!r}")
+
+    return dist
+
+
+def similarity_params(measure: ir.ComparisonMeasure):
+    """Binary-similarity (numerator, denominator) weights over the
+    per-pair contingency counts (a = 1∧1, b = 1∧0, c = 0∧1, d = 0∧0) —
+    one definition shared by the lowerings and the oracle:
+
+        simpleMatching (a+d)/(a+b+c+d)   jaccard a/(a+b+c)
+        tanimoto (a+d)/(a+2(b+c)+d)      binarySimilarity per c/d params
+    """
+    m = measure.metric
+    if m == "simpleMatching":
+        return (1, 0, 0, 1), (1, 1, 1, 1)
+    if m == "jaccard":
+        return (1, 0, 0, 0), (1, 1, 1, 0)
+    if m == "tanimoto":
+        return (1, 0, 0, 1), (1, 2, 2, 1)
+    if m == "binarySimilarity":
+        if len(measure.binary_params) != 8:
+            raise ModelCompilationException(
+                "binarySimilarity needs its eight c/d parameters"
+            )
+        c00, c01, c10, c11, d00, d01, d10, d11 = measure.binary_params
+        # contingency order here is (a=11, b=10, c=01, d=00)
+        return (c11, c10, c01, c00), (d11, d10, d01, d00)
+    raise ModelCompilationException(
+        f"unsupported similarity metric {m!r}"
+    )
+
+
+def make_similarity(measure: ir.ComparisonMeasure, weights: np.ndarray):
+    """→ f(xs [B,D], refs [K,D]) -> similarities [B,K]. Fields are
+    binary (value > 0.5 ⇔ set, the framework's multi-hot convention);
+    field weights scale each pair's contribution to every count. The
+    whole thing is four masked matmuls — MXU-shaped."""
+    num, den = similarity_params(measure)
+
+    def sim(xs, refs):
+        x = (xs > 0.5).astype(jnp.float32) * weights[None, :]
+        xc = (xs <= 0.5).astype(jnp.float32) * weights[None, :]
+        z = (refs > 0.5).astype(jnp.float32)
+        zc = (refs <= 0.5).astype(jnp.float32)
+        a = x @ z.T  # both set
+        b = x @ zc.T  # record only
+        c = xc @ z.T  # reference only
+        d = xc @ zc.T  # neither
+        numer = num[0] * a + num[1] * b + num[2] * c + num[3] * d
+        denom = den[0] * a + den[1] * b + den[2] * c + den[3] * d
+        return jnp.where(denom > 0, numer / jnp.maximum(denom, 1e-30), 0.0)
+
+    return sim
+
+
+def lower_clustering(model: ir.ClusteringModelIR, ctx: LowerCtx) -> Lowered:
+    if model.model_class != "centerBased":
+        raise ModelCompilationException(
+            f"unsupported ClusteringModel class {model.model_class!r}"
+        )
+    similarity = model.measure.kind == "similarity"
+    # compare functions only shape the DISTANCE path; resolving them for
+    # a similarity measure could spuriously reject (e.g. an irrelevant
+    # gaussSim without similarityScale) models the oracle accepts
+    cmp_codes = gauss_s = None
+    if not similarity:
+        cmp_codes, gauss_s = resolve_compare(model)
+    cols = np.asarray(
+        [ctx.column(cf.field) for cf in model.clustering_fields], np.int32
+    )
+    centers = np.asarray([c.center for c in model.clusters], np.float32)  # [K,D]
+    if centers.shape[1] != cols.size:
+        raise ModelCompilationException(
+            f"cluster center arity {centers.shape[1]} != clustering fields "
+            f"{cols.size}"
+        )
+    weights = np.asarray(
+        [cf.weight for cf in model.clustering_fields], np.float32
+    )
+    labels = tuple(
+        c.cluster_id or c.name or str(i + 1) for i, c in enumerate(model.clusters)
+    )
+    params = {"centers": centers}
+    mv_q = (
+        np.asarray(model.missing_value_weights, np.float32)
+        if model.missing_value_weights and not similarity
+        else None
+    )
+    score = (
+        make_similarity(model.measure, weights)
+        if similarity
+        else make_distance(
+            model.measure, cmp_codes, gauss_s, weights, mv_q=mv_q
+        )
+    )
+
+    def fn(p, X, M):
+        xs = X[:, cols]  # [B, D]
+        miss = M[:, cols]
+        if mv_q is not None:
+            # opted-in adjustment: a lane is invalid only when NO
+            # weighted evidence remains (all missing, or every
+            # non-missing field carries weight 0)
+            d = score(xs, p["centers"], miss)
+            qn = jnp.sum(
+                (~miss).astype(jnp.float32) * mv_q[None, :], axis=1
+            )
+            valid = qn > 0
+        else:
+            d = score(xs, p["centers"])
+            valid = ~jnp.any(miss, axis=1)
+        pick = jnp.argmax if similarity else jnp.argmin
+        label_idx = pick(d, axis=1).astype(jnp.int32)
+        return ModelOutput(
+            value=label_idx.astype(jnp.float32),
+            valid=valid,
+            probs=d,  # per-cluster distances/similarities
+            label_idx=label_idx,
+        )
+
+    return Lowered(fn=fn, params=params, labels=labels)

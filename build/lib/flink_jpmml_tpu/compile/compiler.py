@@ -145,9 +145,6 @@ class CompiledModel:
     # selectAll: segment ids, decoding probs = [values ∥ active] into
     # the per-segment outputs mapping
     _segment_ids: Optional[Tuple[str, ...]] = None
-    # clustering: its probabilities mapping holds per-entity comparison
-    # scores — the entityId/affinity output features read it
-    _entity_scores: bool = False
 
     @property
     def is_classification(self) -> bool:
@@ -334,11 +331,6 @@ class CompiledModel:
                         rule_ranking=(
                             rank_rows[i] if rank_rows is not None else None
                         ),
-                        entity_scores=(
-                            (p.target.probabilities or None)
-                            if self._entity_scores and p.target
-                            else None
-                        ),
                     ),
                 )
                 for i, p in enumerate(preds)
@@ -509,7 +501,6 @@ def compile_pmml(
             for i, s in enumerate(doc.model.segmentation.segments)
         )
     name = getattr(doc.model, "model_name", None)
-    entity_scores = isinstance(doc.model, ir.ClusteringModelIR)
     return CompiledModel(
         field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
         labels=lowered.labels,
@@ -526,5 +517,4 @@ def compile_pmml(
         _verification=doc.verification,
         _target_field=doc.target_field,
         _segment_ids=segment_ids,
-        _entity_scores=entity_scores,
     )

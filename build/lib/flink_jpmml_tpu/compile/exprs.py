@@ -1,0 +1,153 @@
+"""Expression lowering: PMML DerivedField expressions → (value, missing) lanes.
+
+Used by NeuralNetwork inputs and (later) TransformationDictionary-derived
+features. Mirrors :func:`flink_jpmml_tpu.pmml.interp.eval_expression`
+semantics: every expression yields a value lane f32[B] plus a missing lane
+bool[B]; ``mapMissingTo`` substitutes a constant where the input is missing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import LowerCtx
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+ExprFn = Callable[[jnp.ndarray, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]
+
+
+def lower_expression(expr: ir.Expression, ctx: LowerCtx) -> ExprFn:
+    if isinstance(expr, ir.Constant):
+        v = np.float32(expr.value)
+
+        def cfn(X, M):
+            B = X.shape[0]
+            return jnp.full((B,), v), jnp.zeros((B,), bool)
+
+        return cfn
+
+    if isinstance(expr, ir.FieldRef):
+        col = ctx.column(expr.field)
+
+        def ffn(X, M):
+            return X[:, col], M[:, col]
+
+        return ffn
+
+    if isinstance(expr, ir.NormContinuous):
+        col = ctx.column(expr.field)
+        origs = np.asarray([n.orig for n in expr.norms], np.float32)
+        norms = np.asarray([n.norm for n in expr.norms], np.float32)
+        outliers = expr.outliers
+        mm = expr.map_missing_to
+
+        def nfn(X, M):
+            x = X[:, col]
+            miss = M[:, col]
+            # asIs extrapolates; asExtremeValues/asMissingValues clamp (the
+            # latter then masks out-of-range lanes as missing)
+            y = _piecewise(x, origs, norms, extrapolate=(outliers == "asIs"))
+            if outliers == "asMissingValues":
+                miss = miss | (x < origs[0]) | (x > origs[-1])
+            return _with_map_missing(y, miss, mm)
+
+        return nfn
+
+    if isinstance(expr, ir.NormDiscrete):
+        col = ctx.column(expr.field)
+        code = np.float32(ctx.encode(expr.field, expr.value))
+        mm = expr.map_missing_to
+
+        def dfn(X, M):
+            ind = (X[:, col] == code).astype(jnp.float32)
+            return _with_map_missing(ind, M[:, col], mm)
+
+        return dfn
+
+    if isinstance(expr, ir.Apply):
+        arg_fns = [lower_expression(a, ctx) for a in expr.args]
+        fn_name = expr.function
+        mm = expr.map_missing_to
+
+        def afn(X, M):
+            vals, misses = zip(*(f(X, M) for f in arg_fns))
+            miss = jnp.zeros_like(misses[0]) if not misses else misses[0]
+            for m2 in misses[1:]:
+                miss = miss | m2
+            y, extra_missing = _apply(fn_name, vals)
+            return _with_map_missing(y, miss | extra_missing, mm)
+
+        return afn
+
+    raise ModelCompilationException(
+        f"unsupported expression {type(expr).__name__}"
+    )
+
+
+def _with_map_missing(y, miss, map_missing_to):
+    if map_missing_to is not None:
+        y = jnp.where(miss, jnp.float32(map_missing_to), y)
+        miss = jnp.zeros_like(miss)
+    return y, miss
+
+
+def _piecewise(x, origs, norms, extrapolate: bool):
+    """Piecewise-linear map through (origs → norms) control points.
+
+    ``extrapolate=True`` extends the outermost segments (PMML outliers=asIs);
+    otherwise values clamp to the boundary norms (asExtremeValues).
+    """
+    if len(origs) == 2 and extrapolate:
+        slope = (norms[1] - norms[0]) / (origs[1] - origs[0])
+        return norms[0] + (x - origs[0]) * slope
+    y = jnp.interp(x, origs, norms)  # clamps outside the range
+    if extrapolate:
+        lo_slope = (norms[1] - norms[0]) / (origs[1] - origs[0])
+        hi_slope = (norms[-1] - norms[-2]) / (origs[-1] - origs[-2])
+        y = jnp.where(x < origs[0], norms[0] + (x - origs[0]) * lo_slope, y)
+        y = jnp.where(x > origs[-1], norms[-1] + (x - origs[-1]) * hi_slope, y)
+    return y
+
+
+def _apply(fn: str, vals):
+    """→ (value, extra_missing) for the supported built-in functions."""
+    zero_false = jnp.zeros_like(vals[0], dtype=bool)
+    if fn == "+":
+        return vals[0] + vals[1], zero_false
+    if fn == "-":
+        return vals[0] - vals[1], zero_false
+    if fn == "*":
+        return vals[0] * vals[1], zero_false
+    if fn == "/":
+        return jnp.where(vals[1] == 0, 0.0, vals[0] / vals[1]), vals[1] == 0
+    if fn == "min":
+        return jnp.min(jnp.stack(vals), axis=0), zero_false
+    if fn == "max":
+        return jnp.max(jnp.stack(vals), axis=0), zero_false
+    if fn == "pow":
+        return vals[0] ** vals[1], zero_false
+    if fn == "exp":
+        return jnp.exp(vals[0]), zero_false
+    if fn == "ln":
+        return jnp.where(vals[0] > 0, jnp.log(jnp.maximum(vals[0], 1e-38)), 0.0), \
+            vals[0] <= 0
+    if fn == "sqrt":
+        return jnp.sqrt(jnp.maximum(vals[0], 0.0)), vals[0] < 0
+    if fn == "abs":
+        return jnp.abs(vals[0]), zero_false
+    if fn == "floor":
+        return jnp.floor(vals[0]), zero_false
+    if fn == "ceil":
+        return jnp.ceil(vals[0]), zero_false
+    if fn == "threshold":
+        return (vals[0] > vals[1]).astype(jnp.float32), zero_false
+    if fn == "if":
+        cond = vals[0] != 0.0
+        if len(vals) > 2:
+            return jnp.where(cond, vals[1], vals[2]), zero_false
+        return jnp.where(cond, vals[1], 0.0), ~cond
+    raise ModelCompilationException(f"unsupported Apply function {fn!r}")

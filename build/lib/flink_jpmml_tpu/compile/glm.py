@@ -1,0 +1,294 @@
+"""GeneralRegressionModel → JAX: design matrix + β + inverse link.
+
+Reference parity: GeneralRegressionModel is the standard GLM export of R
+and SPSS (glm/multinom), scored by JPMML in the reference's evaluator
+(SURVEY.md §1 C1). Semantics:
+
+    x_p = Π covariate^exponent × Π [factor == category]   (PPMatrix)
+    η_t = Σ_p β_{t,p} · x_p                               (ParamMatrix)
+    μ   = link⁻¹(η)        (generalizedLinear; identity otherwise)
+    multinomialLogistic: softmax over per-category η with the reference
+    category (targetReferenceCategory, else the target's last declared
+    value) pinned at η = 0.
+
+Parameters without PPCells are intercepts. A record missing ANY predictor
+the PPMatrix references scores as an invalid lane (GLMs have no
+missing-value routing; JPMML errors — totality C5 turns that into
+EmptyScore).
+
+Lowering: the design matrix builds as a per-parameter product unrolled at
+trace time (PPMatrix cells are few); η is one matmul against the [P, T]
+β table — MXU-shaped for wide multinomial models.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.stats import norm as jnorm
+
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+_MODEL_TYPES = (
+    "regression",
+    "generalLinear",
+    "generalizedLinear",
+    "multinomialLogistic",
+    "ordinalMultinomial",
+    "CoxRegression",
+)
+
+
+def inverse_link(name, eta, power=None):
+    """μ = link⁻¹(η); shared names with the oracle (see interp)."""
+    if name in (None, "identity"):
+        return eta
+    if name == "log":
+        return jnp.exp(eta)
+    if name == "logit":
+        return 1.0 / (1.0 + jnp.exp(-eta))
+    if name == "cloglog":
+        return 1.0 - jnp.exp(-jnp.exp(eta))
+    if name == "loglog":
+        return jnp.exp(-jnp.exp(-eta))
+    if name == "probit":
+        return jnorm.cdf(eta)
+    if name == "inverse":
+        return 1.0 / eta
+    if name == "cauchit":
+        return 0.5 + jnp.arctan(eta) / math.pi
+    if name == "power":
+        if power is None or power == 0:
+            raise ModelCompilationException(
+                "power link needs a non-zero linkParameter"
+            )
+        return jnp.power(eta, 1.0 / power)
+    raise ModelCompilationException(f"unsupported linkFunction {name!r}")
+
+
+def _resolve_categories(model: ir.GeneralRegressionIR, ctx: LowerCtx):
+    """multinomialLogistic target categories (document order from the
+    ParamMatrix) + the reference category pinned at η = 0. The parser
+    resolves a missing targetReferenceCategory at load time
+    (parse_pmml._resolve_glm_reference, including segment-nested GLMs),
+    so one convention lives in one place — here it is simply required,
+    exactly like the oracle."""
+    cats: list = []
+    for c in model.p_cells:
+        if c.target_category is not None and c.target_category not in cats:
+            cats.append(c.target_category)
+    ref = model.target_reference_category
+    if ref is None:
+        raise ModelCompilationException(
+            "multinomialLogistic needs targetReferenceCategory"
+        )
+    if ref in cats:
+        cats.remove(ref)
+    return cats, ref
+
+
+def lower_general_regression(
+    model: ir.GeneralRegressionIR, ctx: LowerCtx
+) -> Lowered:
+    if model.model_type not in _MODEL_TYPES:
+        raise ModelCompilationException(
+            f"unsupported GeneralRegressionModel modelType "
+            f"{model.model_type!r} (supported: {', '.join(_MODEL_TYPES)})"
+        )
+    P = len(model.parameters)
+    pidx = {p: i for i, p in enumerate(model.parameters)}
+    factor_set = set(model.factors)
+    # per-parameter cell programs, resolved at compile time
+    cov_cells: list = []  # (param, col, exponent)
+    fac_cells: list = []  # (param, col, code)
+    used_cols: set = set()
+    for cell in model.pp_cells:
+        if cell.parameter not in pidx:
+            raise ModelCompilationException(
+                f"PPCell references unknown parameter {cell.parameter!r}"
+            )
+        col = ctx.column(cell.predictor)
+        used_cols.add(col)
+        if cell.predictor in factor_set:
+            code = ctx.encode(cell.predictor, cell.value)
+            fac_cells.append((pidx[cell.parameter], col, code))
+        else:
+            try:
+                expo = float(cell.value)
+            except ValueError:
+                raise ModelCompilationException(
+                    f"covariate PPCell value {cell.value!r} is not a "
+                    "number (exponent)"
+                ) from None
+            cov_cells.append((pidx[cell.parameter], col, expo))
+    used = np.zeros((ctx.n_fields,), bool)
+    for c in used_cols:
+        used[c] = True
+
+    multinomial = model.model_type == "multinomialLogistic"
+    ordinal = model.model_type == "ordinalMultinomial"
+    cox = model.model_type == "CoxRegression"
+    if cox:
+        if not model.baseline_cells or model.end_time_variable is None:
+            raise ModelCompilationException(
+                "CoxRegression needs endTimeVariable and "
+                "BaseCumHazardTables"
+            )
+        cox_tcol = ctx.column(model.end_time_variable)
+        used[cox_tcol] = True  # a missing end time empties the lane
+    if ordinal:
+        # cumulative-link model: per-category thresholds for the first
+        # C−1 categories + shared slopes, P(y ≤ c_j) = g⁻¹(η_j), class
+        # probabilities as successive differences
+        cats_o = list(model.target_categories)
+        if len(cats_o) < 2:
+            raise ModelCompilationException(
+                "ordinalMultinomial needs resolved target_categories "
+                "(parse_pmml fills them from the target DataField)"
+            )
+        labels = tuple(cats_o)
+        J = len(cats_o) - 1  # thresholds
+        beta = np.zeros((P, J), np.float32)
+        for c in model.p_cells:
+            if c.parameter not in pidx:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
+            if c.target_category is None:
+                beta[pidx[c.parameter], :] += c.beta  # shared slope
+            elif c.target_category in cats_o[:-1]:
+                beta[
+                    pidx[c.parameter], cats_o.index(c.target_category)
+                ] += c.beta
+            else:
+                raise ModelCompilationException(
+                    f"ordinalMultinomial PCell targets "
+                    f"{c.target_category!r} — the LAST category carries "
+                    "no threshold"
+                )
+    elif multinomial:
+        cats, ref = _resolve_categories(model, ctx)
+        labels = tuple(cats) + (ref,)
+        T = len(cats)
+        beta = np.zeros((P, T), np.float32)
+        for c in model.p_cells:
+            if c.parameter not in pidx:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
+            if c.target_category is None:
+                raise ModelCompilationException(
+                    "multinomialLogistic PCell without targetCategory"
+                )
+            if c.target_category == ref:
+                continue  # reference η stays 0
+            # += : duplicate PCells for one (parameter, category) sum,
+            # matching the oracle's Σ over all cells
+            beta[pidx[c.parameter], cats.index(c.target_category)] += c.beta
+    else:
+        labels = ()
+        beta = np.zeros((P, 1), np.float32)
+        for c in model.p_cells:
+            if c.parameter not in pidx:
+                raise ModelCompilationException(
+                    f"PCell references unknown parameter {c.parameter!r}"
+                )
+            if c.target_category is not None:
+                raise ModelCompilationException(
+                    f"modelType {model.model_type!r} with per-category "
+                    "PCells — use multinomialLogistic"
+                )
+            beta[pidx[c.parameter], 0] += c.beta  # duplicates sum
+    link = (
+        model.link_function
+        if model.model_type == "generalizedLinear"
+        else "identity"
+    )
+    inverse_link(link, jnp.zeros(()), model.link_power)  # validate now
+    if ordinal:
+        inverse_link(model.cumulative_link, jnp.zeros(()))
+    params = {"beta": beta}
+    if cox:
+        # step function as a searchsorted index into [0, H₀(t₁)…H₀(t_K)]
+        times = np.asarray([t for t, _ in model.baseline_cells], np.float32)
+        haz = np.asarray(
+            [0.0] + [h for _, h in model.baseline_cells], np.float32
+        )
+        params["cox_times"] = times
+        params["cox_haz"] = haz
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        missing = jnp.any(M & used[None, :], axis=1)
+        x = jnp.ones((B, P), jnp.float32)
+        for pi, col, expo in cov_cells:
+            base = X[:, col]
+            contrib = (
+                base
+                if expo == 1.0
+                else jnp.power(base, jnp.float32(expo))
+            )
+            x = x.at[:, pi].multiply(contrib)
+        for pi, col, code in fac_cells:
+            ind = (X[:, col] == jnp.float32(code)).astype(jnp.float32)
+            x = x.at[:, pi].multiply(ind)
+        eta = jnp.dot(x, p["beta"])  # [B, T or 1]
+        if ordinal:
+            cum = inverse_link(model.cumulative_link, eta)  # [B, J]
+            lead = cum[:, :1]
+            mids = cum[:, 1:] - cum[:, :-1]
+            last = 1.0 - cum[:, -1:]
+            probs = jnp.concatenate([lead, mids, last], axis=1)
+            lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            return ModelOutput(
+                value=value.astype(jnp.float32),
+                valid=~missing,
+                probs=probs.astype(jnp.float32),
+                label_idx=lab,
+            )
+        if multinomial:
+            full = jnp.concatenate(
+                [eta, jnp.zeros((B, 1), jnp.float32)], axis=1
+            )
+            m = jnp.max(full, axis=1, keepdims=True)
+            e = jnp.exp(full - m)
+            probs = e / jnp.sum(e, axis=1, keepdims=True)
+            lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            return ModelOutput(
+                value=value.astype(jnp.float32),
+                valid=~missing,
+                probs=probs,
+                label_idx=lab,
+            )
+        if cox:
+            # H₀(t): largest baseline time ≤ t (0 before the first)
+            t = X[:, cox_tcol]
+            idx = jnp.searchsorted(p["cox_times"], t, side="right")
+            h0 = jnp.take(p["cox_haz"], idx)
+            surv = jnp.exp(-h0 * jnp.exp(eta[:, 0]))
+            valid = ~missing
+            if model.max_time is not None:
+                # the fitted baseline covers [0, maxTime]; beyond it the
+                # hazard is undefined — empty lane, not extrapolation
+                valid = valid & (t <= jnp.float32(model.max_time))
+            return ModelOutput(
+                value=surv.astype(jnp.float32),
+                valid=valid,
+                probs=None,
+                label_idx=None,
+            )
+        mu = inverse_link(link, eta[:, 0], model.link_power)
+        return ModelOutput(
+            value=mu.astype(jnp.float32),
+            valid=~missing,
+            probs=None,
+            label_idx=None,
+        )
+
+    return Lowered(fn=fn, params=params, labels=labels)

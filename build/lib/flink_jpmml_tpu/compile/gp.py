@@ -1,0 +1,134 @@
+"""GaussianProcessModel → JAX: precomputed GP weights + kernel matmul.
+
+Reference parity: JPMML-Evaluator scores PMML 4.3 GaussianProcessModel
+documents (SURVEY.md §1 C1). GP regression over stored training data:
+
+    μ(x) = k(x, X)ᵀ (K + σ²I)⁻¹ y
+
+The regularized solve happens once at compile time on the host (float64,
+small N) — the device hot path is a kernel-row evaluation plus one
+matvec against the precomputed α, which for the squared-exponential
+family is three MXU matmuls (the ‖x−z‖² expansion x² + z² − 2xz), not a
+[B, N, D] materialization.
+
+Kernels (PMML 4.3 element → math):
+- RadialBasisKernel:            k = γ·exp(−‖x−z‖² / (2λ²))
+- ARDSquaredExponentialKernel:  k = γ·exp(−½ Σ ((xᵢ−zᵢ)/λᵢ)²)
+- AbsoluteExponentialKernel:    k = γ·exp(−Σ |xᵢ−zᵢ|/λᵢ)
+- GeneralizedExponentialKernel: k = γ·exp(−Σ (|xᵢ−zᵢ|/λᵢ)^degree)
+
+A record missing any kernel input scores as an empty lane (kernels have
+no missing-value routing, same contract as the SVM family).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import (
+    HIGHEST,
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+)
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+def _kernel_matrix_np(
+    kernel: ir.GpKernel, A: np.ndarray, B: np.ndarray
+) -> np.ndarray:
+    """Dense k(A, B) in float64 for the compile-time solve."""
+    lam = np.asarray(kernel.lambdas, np.float64)
+    if lam.shape[0] == 1:
+        lam = np.full((A.shape[1],), lam[0])
+    diff = A[:, None, :] - B[None, :, :]
+    if kernel.kind == "radialBasis":
+        s = (diff ** 2).sum(-1) / (2.0 * kernel.lambdas[0] ** 2)
+    elif kernel.kind == "ARDSquaredExponential":
+        s = 0.5 * ((diff / lam) ** 2).sum(-1)
+    elif kernel.kind == "absoluteExponential":
+        s = (np.abs(diff) / lam).sum(-1)
+    elif kernel.kind == "generalizedExponential":
+        s = ((np.abs(diff) / lam) ** kernel.degree).sum(-1)
+    else:
+        raise ModelCompilationException(
+            f"unsupported GP kernel {kernel.kind!r}"
+        )
+    return kernel.gamma * np.exp(-s)
+
+
+def lower_gp(model: ir.GaussianProcessIR, ctx: LowerCtx) -> Lowered:
+    if model.function_name != "regression":
+        raise ModelCompilationException(
+            "GaussianProcessModel supports functionName=regression only"
+        )
+    cols = np.asarray([ctx.column(f) for f in model.inputs], np.int32)
+    Xtr = np.asarray(model.instances, np.float64)
+    y = np.asarray(model.targets, np.float64)
+    N, D = Xtr.shape
+
+    K = _kernel_matrix_np(model.kernel, Xtr, Xtr)
+    reg = K + model.kernel.noise_variance * np.eye(N)
+    try:
+        alpha = np.linalg.solve(reg, y)
+    except np.linalg.LinAlgError:
+        raise ModelCompilationException(
+            "GP kernel matrix K + noiseVariance*I is singular; increase "
+            "noiseVariance or deduplicate training instances"
+        ) from None
+
+    kern = model.kernel
+    lam = np.asarray(kern.lambdas, np.float32)
+    if lam.shape[0] == 1:
+        lam = np.full((D,), lam[0], np.float32)
+    sq_family = kern.kind in ("radialBasis", "ARDSquaredExponential")
+
+    params = {
+        "alpha": alpha.astype(np.float32),
+        "inv_lam": (1.0 / lam).astype(np.float32),
+    }
+    if sq_family:
+        # pre-scaled training rows: d² = ‖xs‖² + ‖zs‖² − 2·xs·zsᵀ keeps
+        # the [B, N] kernel block on the MXU with no [B, N, D] tensor
+        Zs = (Xtr / lam.astype(np.float64)).astype(np.float32)
+        params["Zs"] = Zs
+        params["Zs_sq"] = (Zs ** 2).sum(-1).astype(np.float32)
+    else:
+        params["Ztr"] = Xtr.astype(np.float32)
+
+    gamma = float(kern.gamma)
+    degree = float(kern.degree)
+    kind = kern.kind
+
+    def fn(p, X, M):
+        Xi = X[:, cols]  # [B, D]
+        valid = ~jnp.any(M[:, cols], axis=1)
+        xs = Xi * p["inv_lam"][None, :]
+        if sq_family:
+            cross = jnp.matmul(
+                xs, p["Zs"].T, precision=HIGHEST
+            )  # [B, N]
+            d2 = (
+                jnp.sum(xs ** 2, axis=1, keepdims=True)
+                + p["Zs_sq"][None, :]
+                - 2.0 * cross
+            )
+            d2 = jnp.maximum(d2, 0.0)  # catastrophic-cancellation guard
+            k_star = gamma * jnp.exp(-0.5 * d2)
+        else:
+            diff = jnp.abs(
+                Xi[:, None, :] - p["Ztr"][None, :, :]
+            ) * p["inv_lam"][None, None, :]
+            if kind == "generalizedExponential":
+                diff = diff ** degree
+            k_star = gamma * jnp.exp(-jnp.sum(diff, axis=-1))
+        value = jnp.matmul(
+            k_star, p["alpha"][:, None], precision=HIGHEST
+        )[:, 0]
+        return ModelOutput(
+            value=value.astype(jnp.float32), valid=valid
+        )
+
+    return Lowered(fn=fn, params=params)

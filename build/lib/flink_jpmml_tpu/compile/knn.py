@@ -1,0 +1,150 @@
+"""NearestNeighborModel → JAX: full distance matrix + top-k aggregation.
+
+Reference parity: JPMML scores KNN documents (SURVEY.md §1 C1). The
+distance machinery is the clustering module's (same compareFunctions,
+same spec weighting) over the inline training table; the k smallest
+distances vote (classification: majorityVote / weightedMajorityVote
+with 1/d weights) or average (regression: average / median /
+weightedAverage).
+
+Tie conventions, identical in the oracle: neighbor selection uses
+``lax.top_k`` over negated distances, which prefers the earlier
+training row on equal distance (oracle: stable argsort); vote ties
+break to the class label whose first supporting neighbor appears
+earliest in the training table (oracle mirrors via label-index argmax).
+Weighted variants use 1/(d+ε) with ε=1e-9 against zero distances.
+A record missing any KNN input is an invalid lane (no missing-value
+routing — totality C5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.clustering import (
+    make_distance,
+    make_similarity,
+    resolve_compare_fields,
+)
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+_EPS = 1e-9
+
+
+def lower_knn(model: ir.NearestNeighborIR, ctx: LowerCtx) -> Lowered:
+    similarity = model.measure.kind == "similarity"
+    cols = np.asarray([ctx.column(i.field) for i in model.inputs], np.int32)
+    weights = np.asarray([i.weight for i in model.inputs], np.float32)
+    if similarity:
+        # binary-similarity neighbors: the k LARGEST similarities win;
+        # "weighted" variants weight by the similarity itself
+        dist = make_similarity(model.measure, weights)
+    else:
+        cmp_codes, gauss_s = resolve_compare_fields(
+            model.inputs, model.measure
+        )
+        dist = make_distance(model.measure, cmp_codes, gauss_s, weights)
+    S = np.asarray(model.instances, np.float32)  # [N, D]
+    k = model.n_neighbors
+    classification = model.function_name == "classification"
+
+    if classification:
+        if model.categorical_scoring not in (
+            "majorityVote", "weightedMajorityVote",
+        ):
+            raise ModelCompilationException(
+                f"unsupported categoricalScoringMethod "
+                f"{model.categorical_scoring!r}"
+            )
+        labels: list = []
+        for t in model.targets:
+            if t not in labels:
+                labels.append(t)
+        lab_of = np.asarray(
+            [labels.index(t) for t in model.targets], np.int32
+        )
+        weighted = model.categorical_scoring == "weightedMajorityVote"
+    else:
+        if model.continuous_scoring not in (
+            "average", "median", "weightedAverage",
+        ):
+            raise ModelCompilationException(
+                f"unsupported continuousScoringMethod "
+                f"{model.continuous_scoring!r}"
+            )
+        labels = []
+        try:
+            yvals = np.asarray([float(t) for t in model.targets], np.float32)
+        except ValueError:
+            raise ModelCompilationException(
+                "regression KNN needs numeric training targets"
+            ) from None
+
+    L = len(labels)
+    params = {"S": S}
+    if classification:
+        params["lab"] = lab_of.astype(np.float32)
+    else:
+        params["y"] = yvals
+
+    def fn(p, X, M):
+        missing = jnp.any(M[:, cols], axis=1)
+        xs = X[:, cols]
+        d = dist(xs, p["S"])  # [B, N]
+        # top_k prefers earlier rows on exact ties; similarity ranks
+        # descending, distance ascending (negated)
+        best, idx = jax.lax.top_k(d if similarity else -d, k)  # [B, k]
+        dk = best if similarity else -best
+        if classification:
+            labk = jnp.take(p["lab"], idx).astype(jnp.int32)  # [B, k]
+            if not weighted:
+                w = jnp.ones_like(dk)
+            elif similarity:
+                w = dk
+            else:
+                w = 1.0 / (dk + _EPS)
+            onehot = (
+                labk[..., None] == jnp.arange(L)[None, None, :]
+            ).astype(jnp.float32)
+            votes = jnp.sum(onehot * w[..., None], axis=1)  # [B, L]
+            lab = jnp.argmax(votes, axis=1).astype(jnp.int32)
+            probs = votes / jnp.maximum(
+                jnp.sum(votes, axis=1, keepdims=True), _EPS
+            )
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            return ModelOutput(
+                value=value.astype(jnp.float32),
+                valid=~missing,
+                probs=probs,
+                label_idx=lab,
+            )
+        yk = jnp.take(p["y"], idx)  # [B, k]
+        if model.continuous_scoring == "average":
+            value = jnp.mean(yk, axis=1)
+        elif model.continuous_scoring == "median":
+            value = jnp.median(yk, axis=1)
+        else:  # weightedAverage
+            w = dk if similarity else 1.0 / (dk + _EPS)
+            tw = jnp.sum(w, axis=1)
+            value = jnp.sum(yk * w, axis=1) / jnp.maximum(tw, _EPS)
+            if similarity:
+                # all-zero similarity weights: undefined average (the
+                # oracle empties the lane; 0/0 must not ship as valid)
+                return ModelOutput(
+                    value=value.astype(jnp.float32),
+                    valid=~missing & (tw > 0),
+                    probs=None,
+                    label_idx=None,
+                )
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=~missing,
+            probs=None,
+            label_idx=None,
+        )
+
+    return Lowered(fn=fn, params=params, labels=tuple(labels))

@@ -1,0 +1,387 @@
+"""MiningModel → JAX: ensemble/stacking composition (SURVEY.md §8 step 2).
+
+Three lowering regimes:
+
+1. **Fused tree-ensemble fast path**: every segment is a canonical TreeModel
+   with a ``<True/>`` predicate (the GBM shape, BASELINE config 2) →
+   :func:`flink_jpmml_tpu.compile.trees.lower_tree_ensemble` packs all trees
+   into one padded tensor family and the whole ensemble is two einsums.
+2. **modelChain** (BASELINE config 5): segments run in sequence, each
+   exporting output fields as new columns of the field space; compiled as a
+   straight-line composition, extending ``X``/``M`` functionally.
+3. **Generic aggregation**: heterogeneous segments lower independently and
+   combine per ``multipleModelMethod`` with vectorized active-segment masks.
+
+Missing semantics match the oracle: a missing result from any *active*
+segment poisons aggregate results; inactive segments (predicate not true)
+are excluded; no active segment ⇒ missing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import (
+    HIGHEST,
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+    lower_predicate,
+)
+from flink_jpmml_tpu.compile.trees import lower_tree_ensemble
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+_AGG_METHODS = (
+    "sum",
+    "average",
+    "weightedAverage",
+    "max",
+    "median",
+    "majorityVote",
+    "weightedMajorityVote",
+)
+
+
+def lower_mining(model: ir.MiningModelIR, ctx: LowerCtx) -> Lowered:
+    method = model.segmentation.multiple_model_method
+    segments = model.segmentation.segments
+
+    if method == "modelChain":
+        return _lower_chain(segments, ctx)
+    if method == "selectFirst":
+        return _lower_select_first(segments, ctx)
+    if method == "selectAll":
+        return _lower_select_all(segments, ctx)
+    if method not in _AGG_METHODS:
+        raise ModelCompilationException(
+            f"unsupported multipleModelMethod {method!r}"
+        )
+
+    all_true = all(
+        isinstance(s.predicate, ir.TruePredicate) for s in segments
+    )
+    all_trees = all(
+        isinstance(s.model, ir.TreeModelIR)
+        # fractional-membership strategies take the weighted-path walk
+        # (wtrees.py) via the generic per-segment route — the fused
+        # boolean-path ensemble backends cannot express them
+        and s.model.missing_value_strategy
+        not in ("weightedConfidence", "aggregateNodes")
+        for s in segments
+    )
+    if all_true and all_trees:
+        classification = segments[0].model.function_name == "classification"
+        fused_ok = (
+            method in ("majorityVote", "weightedMajorityVote")
+            if classification
+            else method in ("sum", "average", "weightedAverage", "max", "median")
+        )
+        if fused_ok:
+            return lower_tree_ensemble(
+                [s.model for s in segments],
+                [s.weight for s in segments],
+                method,
+                ctx,
+            )
+    return _lower_aggregate(segments, method, all_true, ctx)
+
+
+# ---------------------------------------------------------------------------
+
+
+def _lower_segments(segments, ctx) -> List[Lowered]:
+    from flink_jpmml_tpu.compile.compiler import lower_model  # no cycle at import
+
+    return [lower_model(s.model, ctx) for s in segments]
+
+
+def _lower_chain(segments: Tuple[ir.Segment, ...], ctx: LowerCtx) -> Lowered:
+    from flink_jpmml_tpu.compile.compiler import lower_model
+
+    if not isinstance(segments[-1].predicate, ir.TruePredicate):
+        raise ModelCompilationException(
+            "modelChain lowering requires the final segment's predicate to "
+            "be <True/> (per-record final-segment selection is oracle-only)"
+        )
+
+    steps = []  # (pred_fn|None, lowered, [(out_name, feature, prob_col)])
+    cur_ctx = ctx
+    params = {}
+    for i, seg in enumerate(segments):
+        pred_fn = (
+            None
+            if isinstance(seg.predicate, ir.TruePredicate)
+            else lower_predicate(seg.predicate, cur_ctx)
+        )
+        low = lower_model(seg.model, cur_ctx)
+        params[f"s{i}"] = low.params
+        outs = []
+        new_names: List[str] = []
+        new_codecs = {}
+        for of in seg.output_fields:
+            if of.feature == "predictedValue":
+                outs.append((of.name, "predictedValue", None))
+                if low.is_classification:
+                    # downstream predicates compare against the label code
+                    new_codecs[of.name] = {
+                        lbl: float(j) for j, lbl in enumerate(low.labels)
+                    }
+            elif of.feature == "probability":
+                if not low.is_classification or of.target_value is None:
+                    raise ModelCompilationException(
+                        f"OutputField {of.name!r}: probability feature needs "
+                        "a classification segment and a target value"
+                    )
+                outs.append(
+                    (of.name, "probability", low.labels.index(of.target_value))
+                )
+            else:
+                raise ModelCompilationException(
+                    f"unsupported OutputField feature {of.feature!r}"
+                )
+            new_names.append(of.name)
+        steps.append((pred_fn, low, outs))
+        if new_names:
+            cur_ctx = cur_ctx.with_extra_fields(tuple(new_names), new_codecs)
+
+    final_low = steps[-1][1]
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        all_valid = jnp.ones((B,), bool)
+        out: Optional[ModelOutput] = None
+        for i, (pred_fn, low, outs) in enumerate(steps):
+            if pred_fn is None:
+                active = jnp.ones((B,), bool)
+            else:
+                po = pred_fn(X, M)
+                active = po.is_true
+            out = low.fn(p[f"s{i}"], X, M)
+            all_valid = all_valid & (~active | out.valid)
+            for name, feature, prob_col in outs:
+                if feature == "predictedValue":
+                    col = (
+                        out.label_idx.astype(jnp.float32)
+                        if low.is_classification
+                        else out.value
+                    )
+                else:
+                    col = out.probs[:, prob_col]
+                ok = active & out.valid
+                X = jnp.concatenate(
+                    [X, jnp.where(ok, col, 0.0)[:, None]], axis=1
+                )
+                M = jnp.concatenate([M, (~ok)[:, None]], axis=1)
+        return out._replace(valid=out.valid & all_valid)
+
+    return Lowered(fn=fn, params=params, labels=final_low.labels)
+
+
+def _lower_select_first(
+    segments: Tuple[ir.Segment, ...], ctx: LowerCtx
+) -> Lowered:
+    lows = _lower_segments(segments, ctx)
+    pred_fns = [lower_predicate(s.predicate, ctx) for s in segments]
+    labels = lows[0].labels
+    if any(l.labels != labels for l in lows):
+        raise ModelCompilationException(
+            "selectFirst lowering requires all segments to share one label "
+            "space (or all be regression)"
+        )
+    params = {f"s{i}": l.params for i, l in enumerate(lows)}
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        outs = [l.fn(p[f"s{i}"], X, M) for i, l in enumerate(lows)]
+        actives = [pf(X, M).is_true for pf in pred_fns]
+        chosen = jnp.full((B,), -1, jnp.int32)
+        for i in range(len(outs) - 1, -1, -1):
+            chosen = jnp.where(actives[i], i, chosen)
+        value = jnp.zeros((B,), jnp.float32)
+        valid = jnp.zeros((B,), bool)
+        probs = None if not labels else jnp.zeros_like(outs[0].probs)
+        label_idx = None if not labels else jnp.zeros((B,), jnp.int32)
+        for i, o in enumerate(outs):
+            sel = chosen == i
+            value = jnp.where(sel, o.value, value)
+            valid = jnp.where(sel, o.valid, valid)
+            if labels:
+                probs = jnp.where(sel[:, None], o.probs, probs)
+                label_idx = jnp.where(sel, o.label_idx, label_idx)
+        return ModelOutput(
+            value=value, valid=valid & (chosen >= 0), probs=probs,
+            label_idx=label_idx,
+        )
+
+    return Lowered(fn=fn, params=params, labels=labels)
+
+
+def _lower_select_all(
+    segments: Tuple[ir.Segment, ...], ctx: LowerCtx
+) -> Lowered:
+    """Every active segment's value is surfaced: ``probs`` carries
+    [values ∥ active-mask] as ``[B, 2S]``; the decode side
+    (CompiledModel._segment_ids) turns it into the per-segment outputs
+    mapping. Scalar ``value`` = first active segment's (oracle parity).
+    Regression segments only — a multi-label collection doesn't fit one
+    Prediction."""
+    for s in segments:
+        if s.model.function_name != "regression":
+            raise ModelCompilationException(
+                "selectAll supports regression segments only"
+            )
+    lows = _lower_segments(segments, ctx)
+    if any(l.labels for l in lows):
+        raise ModelCompilationException(
+            "selectAll supports regression segments only"
+        )
+    pred_fns = [
+        None
+        if isinstance(s.predicate, ir.TruePredicate)
+        else lower_predicate(s.predicate, ctx)
+        for s in segments
+    ]
+    params = {f"s{i}": l.params for i, l in enumerate(lows)}
+    S = len(segments)
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        values = []
+        active = []
+        for i, l in enumerate(lows):
+            o = l.fn(p[f"s{i}"], X, M)
+            a = (
+                o.valid
+                if pred_fns[i] is None
+                else o.valid & pred_fns[i](X, M).is_true
+            )
+            values.append(jnp.where(a, o.value, 0.0))
+            active.append(a)
+        V = jnp.stack(values, axis=1)  # [B, S]
+        A = jnp.stack(active, axis=1)  # [B, S]
+        first = jnp.argmax(A, axis=1)
+        value = jnp.take_along_axis(V, first[:, None], axis=1)[:, 0]
+        probs = jnp.concatenate(
+            [V, A.astype(jnp.float32)], axis=1
+        )  # [B, 2S] decode payload
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=jnp.any(A, axis=1),
+            probs=probs,
+            label_idx=None,
+        )
+
+    return Lowered(fn=fn, params=params, labels=())
+
+
+def _lower_aggregate(
+    segments: Tuple[ir.Segment, ...],
+    method: str,
+    all_true: bool,
+    ctx: LowerCtx,
+) -> Lowered:
+    lows = _lower_segments(segments, ctx)
+    pred_fns = [
+        None
+        if isinstance(s.predicate, ir.TruePredicate)
+        else lower_predicate(s.predicate, ctx)
+        for s in segments
+    ]
+    weights = np.asarray([s.weight for s in segments], np.float32)
+    params = {f"s{i}": l.params for i, l in enumerate(lows)}
+
+    if method in ("majorityVote", "weightedMajorityVote"):
+        if any(not l.is_classification for l in lows):
+            raise ModelCompilationException(
+                f"{method} requires classification segments"
+            )
+        global_labels: List[str] = []
+        for l in lows:
+            for lbl in l.labels:
+                if lbl not in global_labels:
+                    global_labels.append(lbl)
+        maps = [
+            np.asarray([global_labels.index(lbl) for lbl in l.labels], np.int32)
+            for l in lows
+        ]
+        C = len(global_labels)
+
+        def vfn(p, X, M):
+            B = X.shape[0]
+            votes = jnp.zeros((B, C), jnp.float32)
+            for i, l in enumerate(lows):
+                o = l.fn(p[f"s{i}"], X, M)
+                active = (
+                    jnp.ones((B,), bool)
+                    if pred_fns[i] is None
+                    else pred_fns[i](X, M).is_true
+                )
+                glb = (
+                    jnp.take(jnp.asarray(maps[i]), o.label_idx)
+                    if maps[i].size
+                    else o.label_idx
+                )
+                w = weights[i] if method == "weightedMajorityVote" else 1.0
+                onehot = jax.nn.one_hot(glb, C, dtype=jnp.float32)
+                # invalid/inactive segments abstain (oracle: excluded from
+                # the vote); they do not poison the lane
+                votes = votes + jnp.where(
+                    (active & o.valid)[:, None], onehot * w, 0.0
+                )
+            total = jnp.sum(votes, axis=1, keepdims=True)
+            probs = votes / jnp.maximum(total, 1e-30)
+            label_idx = jnp.argmax(votes, axis=1).astype(jnp.int32)
+            value = jnp.take_along_axis(probs, label_idx[:, None], axis=1)[:, 0]
+            valid = total[:, 0] > 0
+            return ModelOutput(
+                value=value, valid=valid, probs=probs, label_idx=label_idx
+            )
+
+        return Lowered(fn=vfn, params=params, labels=tuple(global_labels))
+
+    if method == "median" and not all_true:
+        raise ModelCompilationException(
+            "median over predicate-gated segments is oracle-only"
+        )
+
+    def afn(p, X, M):
+        B = X.shape[0]
+        vals, valids, actives = [], [], []
+        for i, l in enumerate(lows):
+            o = l.fn(p[f"s{i}"], X, M)
+            active = (
+                jnp.ones((B,), bool)
+                if pred_fns[i] is None
+                else pred_fns[i](X, M).is_true
+            )
+            vals.append(o.value)
+            valids.append(~active | o.valid)
+            actives.append(active)
+        V = jnp.stack(vals, axis=1)  # [B, N]
+        A = jnp.stack(actives, axis=1)
+        ok = jnp.stack(valids, axis=1)
+        count = jnp.sum(A, axis=1)
+        all_ok = jnp.all(ok, axis=1) & (count > 0)
+        Af = A.astype(jnp.float32)
+        if method == "sum":
+            value = jnp.sum(V * Af, axis=1)
+        elif method == "average":
+            value = jnp.sum(V * Af, axis=1) / jnp.maximum(count, 1)
+        elif method == "weightedAverage":
+            wsum = jnp.dot(Af, weights, precision=HIGHEST)
+            value = jnp.sum(V * Af * weights[None, :], axis=1) / jnp.where(
+                wsum == 0, 1.0, wsum
+            )
+            all_ok = all_ok & (wsum != 0)
+        elif method == "max":
+            value = jnp.max(jnp.where(A, V, -jnp.inf), axis=1)
+        else:  # median, all_true guaranteed
+            value = jnp.median(V, axis=1)
+        return ModelOutput(value=value, valid=all_ok)
+
+    return Lowered(fn=afn, params=params)

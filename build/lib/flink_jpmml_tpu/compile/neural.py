@@ -1,0 +1,249 @@
+"""NeuralNetwork → JAX: a dense matmul chain (the MXU path, BASELINE config 3).
+
+PMML expresses networks as per-neuron ``<Con>`` lists; we reassemble them
+into layer weight matrices ``W[in, out]`` + bias ``b[out]`` so the whole
+layer is one matmul. Connections must be strictly layered (every ``Con``
+references the immediately previous layer) — the shape every mainstream MLP
+exporter emits; skip connections raise at compile time.
+
+Missing semantics (matching the oracle): any missing network input makes the
+whole record's result missing.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import HIGHEST, Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.compile.exprs import lower_expression
+from flink_jpmml_tpu.compile.regression import softmax
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+_ACTIVATIONS = {
+    "logistic": lambda z: 1.0 / (1.0 + jnp.exp(-z)),
+    "tanh": jnp.tanh,
+    "identity": lambda z: z,
+    "rectifier": lambda z: jnp.maximum(z, 0.0),
+    # PMML 4.x defines arctan as 2*arctan(Z)/pi (range (-1, 1))
+    "arctan": lambda z: 2.0 * jnp.arctan(z) / jnp.pi,
+    "cosine": jnp.cos,
+    "sine": jnp.sin,
+    "square": lambda z: z * z,
+    "Gauss": lambda z: jnp.exp(-(z * z)),
+    "reciprocal": lambda z: 1.0 / z,
+    "exponential": jnp.exp,
+    "Elliott": lambda z: z / (1.0 + jnp.abs(z)),
+    "elliott": lambda z: z / (1.0 + jnp.abs(z)),  # lenient-case alias
+}
+
+
+def lower_neural_network(model: ir.NeuralNetworkIR, ctx: LowerCtx) -> Lowered:
+    input_fns = [lower_expression(ni.derived_field.expression, ctx)
+                 for ni in model.inputs]
+    prev_ids = [ni.neuron_id for ni in model.inputs]
+
+    layer_weights = []
+    layer_acts = []
+    layer_norms = []
+    all_ids_per_layer = []
+    for li, layer in enumerate(model.layers):
+        index = {nid: i for i, nid in enumerate(prev_ids)}
+        W = np.zeros((len(prev_ids), len(layer.neurons)), np.float32)
+        b = np.zeros((len(layer.neurons),), np.float32)
+        for j, neuron in enumerate(layer.neurons):
+            b[j] = neuron.bias
+            for src, w in neuron.weights:
+                if src not in index:
+                    raise ModelCompilationException(
+                        f"neuron {neuron.neuron_id!r} in layer {li} references "
+                        f"{src!r} which is not in the previous layer — only "
+                        "strictly layered networks lower to the matmul chain"
+                    )
+                W[index[src], j] = w
+        act_name = layer.activation or model.activation_function
+        act_spec: dict = {"kind": "plain", "name": act_name}
+        if act_name == "threshold":
+            # out = 1 if z > threshold else 0 (cut from layer, else model)
+            thr = (
+                layer.threshold
+                if layer.threshold is not None
+                else model.threshold
+            )
+            act_spec = {"kind": "threshold", "thr": float(thr)}
+        elif act_name == "radialBasis":
+            # RBF neuron: the Con weights are the center; per the spec
+            #   z_j = Σ_i (w_ij − x_i)²
+            #   out = exp(fanIn_j · ln(altitude_j) − z_j / (2·width_j²))
+            # width resolves Neuron → Layer → Network (required), altitude
+            # likewise (default 1.0); bias is unused.
+            widths = np.zeros((len(layer.neurons),), np.float32)
+            alts = np.zeros((len(layer.neurons),), np.float32)
+            fanin = np.zeros((len(layer.neurons),), np.float32)
+            conn = np.zeros((len(prev_ids), len(layer.neurons)), np.float32)
+            index2 = {nid: i for i, nid in enumerate(prev_ids)}
+            for j, neuron in enumerate(layer.neurons):
+                w = (
+                    neuron.width
+                    if neuron.width is not None
+                    else (
+                        layer.width
+                        if layer.width is not None
+                        else model.width
+                    )
+                )
+                if w is None or w <= 0:
+                    raise ModelCompilationException(
+                        f"radialBasis neuron {neuron.neuron_id!r} has no "
+                        "positive width (Neuron/NeuralLayer/NeuralNetwork)"
+                    )
+                widths[j] = w
+                a = (
+                    neuron.altitude
+                    if neuron.altitude is not None
+                    else (
+                        layer.altitude
+                        if layer.altitude is not None
+                        else model.altitude
+                    )
+                )
+                if a <= 0:
+                    raise ModelCompilationException(
+                        f"radialBasis neuron {neuron.neuron_id!r} has "
+                        f"non-positive altitude {a}"
+                    )
+                alts[j] = a
+                fanin[j] = len(neuron.weights)
+                for src, _w in neuron.weights:
+                    conn[index2[src], j] = 1.0
+            act_spec = {
+                "kind": "rbf",
+                "widths": widths,
+                "log_alt": np.log(alts).astype(np.float32),
+                "fanin": fanin,
+                "conn": conn,
+            }
+        elif act_name not in _ACTIVATIONS:
+            raise ModelCompilationException(
+                f"unsupported activation {act_name!r}"
+            )
+        is_last = li == len(model.layers) - 1
+        norm = layer.normalization or (
+            model.normalization_method if is_last else "none"
+        )
+        if norm not in ("none", "softmax", "simplemax"):
+            raise ModelCompilationException(
+                f"unsupported layer normalization {norm!r}"
+            )
+        layer_weights.append((W, b))
+        layer_acts.append(act_spec)
+        layer_norms.append(norm)
+        prev_ids = [n.neuron_id for n in layer.neurons]
+        all_ids_per_layer.append(prev_ids)
+
+    out_index = {nid: i for i, nid in enumerate(prev_ids)}
+    params = {
+        f"l{i}": {"W": W, "b": b} for i, (W, b) in enumerate(layer_weights)
+    }
+
+    def run_network(p, X, M) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        vals, misses = zip(*(f(X, M) for f in input_fns))
+        h = jnp.stack(vals, axis=1)  # [B, I]
+        missing = misses[0]
+        for m2 in misses[1:]:
+            missing = missing | m2
+        for i, spec in enumerate(layer_acts):
+            lp = p[f"l{i}"]
+            if spec["kind"] == "rbf":
+                # z_j = Σ_i conn_ij (w_ij − h_i)², expanded so the MXU
+                # carries it: colsum(conn·W²) − 2 h@(conn·W) + h²@conn
+                W_, conn = lp["W"], spec["conn"]
+                cw = conn * W_
+                z = (
+                    jnp.sum(cw * W_, axis=0)[None, :]
+                    - 2.0 * jnp.dot(h, cw, precision=HIGHEST)
+                    + jnp.dot(h * h, conn, precision=HIGHEST)
+                )
+                h = jnp.exp(
+                    spec["fanin"] * spec["log_alt"]
+                    - z / (2.0 * spec["widths"] * spec["widths"])
+                )
+            else:
+                z = jnp.dot(h, lp["W"], precision=HIGHEST) + lp["b"]
+                if spec["kind"] == "threshold":
+                    h = (z > spec["thr"]).astype(jnp.float32)
+                else:
+                    h = _ACTIVATIONS[spec["name"]](z)
+            if layer_norms[i] == "softmax":
+                h = softmax(h)
+            elif layer_norms[i] == "simplemax":
+                s = jnp.sum(h, axis=1, keepdims=True)
+                h = jnp.where(s == 0, h, h / s)
+        return h, missing
+
+    if model.function_name == "classification":
+        labels = []
+        out_cols = []
+        for no in model.outputs:
+            expr = no.derived_field.expression
+            if not isinstance(expr, ir.NormDiscrete):
+                raise ModelCompilationException(
+                    "classification NeuralOutput must map via NormDiscrete"
+                )
+            labels.append(expr.value)
+            if no.output_neuron not in out_index:
+                raise ModelCompilationException(
+                    f"NeuralOutput references unknown neuron "
+                    f"{no.output_neuron!r}"
+                )
+            out_cols.append(out_index[no.output_neuron])
+        out_cols = np.asarray(out_cols, np.int32)
+
+        def cfn(p, X, M):
+            h, missing = run_network(p, X, M)
+            probs = h[:, out_cols]
+            label_idx = jnp.argmax(probs, axis=1).astype(jnp.int32)
+            value = jnp.take_along_axis(probs, label_idx[:, None], axis=1)[:, 0]
+            return ModelOutput(
+                value=value, valid=~missing, probs=probs, label_idx=label_idx
+            )
+
+        return Lowered(fn=cfn, params=params, labels=tuple(labels))
+
+    if not model.outputs:
+        raise ModelCompilationException("regression NeuralNetwork has no outputs")
+    no = model.outputs[0]
+    if no.output_neuron not in out_index:
+        raise ModelCompilationException(
+            f"NeuralOutput references unknown neuron {no.output_neuron!r}"
+        )
+    out_col = out_index[no.output_neuron]
+    expr = no.derived_field.expression
+    if isinstance(expr, ir.NormContinuous):
+        if len(expr.norms) != 2:
+            raise ModelCompilationException(
+                "regression NeuralOutput NormContinuous supports exactly two "
+                "LinearNorm points in the lowering (n-point: oracle only)"
+            )
+        a, b2 = expr.norms
+        denorm_slope = np.float32((b2.orig - a.orig) / (b2.norm - a.norm))
+        denorm = (np.float32(a.orig), np.float32(a.norm), denorm_slope)
+    elif isinstance(expr, ir.FieldRef):
+        denorm = None
+    else:
+        raise ModelCompilationException(
+            f"unsupported NeuralOutput expression {type(expr).__name__}"
+        )
+
+    def rfn(p, X, M):
+        h, missing = run_network(p, X, M)
+        y = h[:, out_col]
+        if denorm is not None:
+            orig0, norm0, slope = denorm
+            y = orig0 + (y - norm0) * slope
+        return ModelOutput(value=y, valid=~missing)
+
+    return Lowered(fn=rfn, params=params)

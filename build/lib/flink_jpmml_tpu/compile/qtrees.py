@@ -1,0 +1,639 @@
+"""Quantized-wire fast path for numeric tree ensembles (the bench hot path).
+
+The dense path-matrix lowering (trees.py) streams ``f32[B, F]`` feature
+batches to the device. For the north-star workload — a 500-tree GBM scored
+over a network stream (BASELINE config 2) — the binding resource is
+host→device *bytes*, not FLOPs: scoring only ever compares each feature
+against the model's own finite set of split thresholds, so a record can be
+shipped as per-feature *threshold ranks* instead of raw floats.
+
+This module builds that wire format:
+
+- **Cut tables.** Every comparison split is normalised to a ``x <= cut``
+  test (``<`` becomes ``<= nextafter(v, -inf)``; ``>``/``>=`` flip the
+  children, which negates the split's path-matrix row and its missing
+  default direction). The sorted unique cuts per feature form the table
+  ``U[f]``; ``rank(x) = #{c in U[f] : c < x}`` and the split against cut
+  ``U[f][i]`` holds iff ``rank(x) <= i``. Integer compares on ranks are
+  therefore *bit-exact* with the float compares of the dense path.
+- **Wire dtype.** ``uint8`` when every feature has <= 254 cuts (histogram-
+  trained GBMs — LightGBM/XGBoost-hist — always satisfy this), else
+  ``uint16``. The top code (255/65535) is the missing-value sentinel. A
+  32-feature record shrinks from 128+32 bytes (f32 + mask) to 32 bytes.
+- **Device kernel.** The same three-einsum structure as trees.py but all
+  intermediates are int8 (sign indicators, path accumulator, leaf one-hot),
+  which cuts HBM traffic ~4x; leaf values contract in a bf16 hi+lo split
+  (exact to ~2^-17 relative) so the MXU stays in fast dtypes without
+  giving up float32-level accuracy.
+
+Reference parity: this accelerates the same evaluation the reference runs
+per record on the CPU via JPMML-Evaluator (SURVEY.md §4.1 hot loop); the
+general f32 path remains the semantic baseline and every model that is not
+an all-numeric-comparison tree ensemble simply reports "not eligible".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile import common, prepare
+from flink_jpmml_tpu.compile.common import (
+    LowerCtx,
+    apply_targets_value,
+    build_codecs,
+    extract_invalid_policy,
+    extract_missing_replacements,
+)
+from flink_jpmml_tpu.compile.trees import (
+    _canon_has_halt,
+    _canonicalize_forest,
+    pack_ensemble,
+)
+from flink_jpmml_tpu.models.prediction import Prediction, decode_batch
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.config import CompileConfig
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+# opcodes from trees.py: 0 '<', 1 '<=', 2 '>', 3 '>='
+_SUPPORTED_OPS = frozenset((0, 1, 2, 3))
+_REGRESSION_METHODS = frozenset(
+    ("single", "sum", "average", "weightedAverage", "max", "median")
+)
+
+
+@dataclass(frozen=True)
+class QuantizedWire:
+    """Host-side featurizer: f32 records → threshold-rank codes.
+
+    ``cuts[j]`` is the sorted cut table of input column ``j`` (possibly
+    empty); ``dtype`` is ``np.uint8`` or ``np.uint16``; ``sentinel`` marks
+    missing values. ``repl``/``has_repl`` fold the model's top-level
+    mining-schema ``missingValueReplacement`` into encoding so the device
+    kernel never needs a mask plane.
+    """
+
+    fields: Tuple[str, ...]
+    cuts: Tuple[np.ndarray, ...]
+    dtype: type
+    sentinel: int
+    repl: np.ndarray  # f32[F]
+    has_repl: np.ndarray  # bool[F]
+
+    @property
+    def bytes_per_record(self) -> int:
+        return len(self.fields) * np.dtype(self.dtype).itemsize
+
+    def _flat_tables(self):
+        """(cuts_flat f32, offsets i32[F+1]) for the ragged bucketizer."""
+        cached = getattr(self, "_flat_cache", None)
+        if cached is None:
+            offs = np.zeros((len(self.cuts) + 1,), np.int32)
+            for j, c in enumerate(self.cuts):
+                offs[j + 1] = offs[j] + len(c)
+            flat = (
+                np.concatenate(self.cuts).astype(np.float32)
+                if offs[-1]
+                else np.empty((0,), np.float32)
+            )
+            cached = (flat, offs)
+            object.__setattr__(self, "_flat_cache", cached)
+        return cached
+
+    def _pow2_tables(self):
+        """(+inf-padded [F, L] f32 table, L) for the lockstep bucketizer,
+        or None when the padding blowup says the ragged path wins.
+
+        L = next power of two ≥ the longest per-feature cut table; ranks
+        are unchanged by +inf pads (a pad is never < any finite x). The
+        lockstep kernel makes EVERY feature pay L-depth rounds and
+        L-width memory, so it only pays off when cut counts are roughly
+        balanced (GBM exports are); one 4096-cut feature among tiny ones
+        would make every probe slower AND blow the padded table out of
+        L2 — those models take the ragged kernel."""
+        cached = getattr(self, "_pow2_cache", None)
+        if cached is None:
+            m = max((len(c) for c in self.cuts), default=0)
+            total = sum(len(c) for c in self.cuts)
+            L = 1
+            while L < max(m, 1):
+                L <<= 1
+            n_f = max(len(self.cuts), 1)
+            blowup = (n_f * L) / max(total, 1)
+            if blowup > 4.0 and L > 64:
+                cached = (None, 0)  # skewed: ragged path
+            else:
+                padded = np.full((n_f, L), np.inf, np.float32)
+                for j, c in enumerate(self.cuts):
+                    padded[j, : len(c)] = c
+                cached = (np.ascontiguousarray(padded), L)
+            object.__setattr__(self, "_pow2_cache", cached)
+        return cached
+
+    def encode(
+        self, X: np.ndarray, M: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """f32[B, F] (+ optional missing mask) → rank codes [B, F].
+
+        NaNs count as missing. Missing cells take the mining-schema
+        replacement value when one is declared, else the sentinel. Uses the
+        multithreaded C++ bucketizer (native/fjt_native.cpp) when built;
+        numpy searchsorted otherwise (identical semantics).
+        """
+        from flink_jpmml_tpu.runtime import native
+
+        padded, L = self._pow2_tables()
+        if padded is not None:
+            out = native.bucketize_pow2(
+                X, padded, L,
+                self.repl, self.has_repl.astype(np.uint8), self.dtype,
+                mask=M,
+            )
+        else:  # skewed cut tables: ragged kernel (see _pow2_tables)
+            flat, offs = self._flat_tables()
+            out = native.bucketize(
+                X, flat, offs,
+                self.repl, self.has_repl.astype(np.uint8), self.dtype,
+                mask=M,
+            )
+        if out is not None:
+            return out
+        X = np.asarray(X, np.float32)
+        miss = np.isnan(X)
+        if M is not None:
+            miss = miss | M
+        if self.has_repl.any():
+            use = miss & self.has_repl[None, :]
+            X = np.where(use, self.repl[None, :], X)
+            miss = miss & ~self.has_repl[None, :]
+        out = np.empty(X.shape, self.dtype)
+        for j, cuts in enumerate(self.cuts):
+            # rank = #{c < x}  (side='left' over the sorted cut table)
+            out[:, j] = np.searchsorted(cuts, X[:, j], side="left")
+        out[miss] = self.sentinel
+        return out
+
+    def encode_records(self, space: prepare.FieldSpace, records) -> np.ndarray:
+        X, M = prepare.from_records(space, records)
+        return self.encode(X, M)
+
+
+@dataclass
+class QuantizedScorer:
+    """Jitted rank-wire scorer for one tree-ensemble model.
+
+    ``predict_wire(Xq)`` runs the device kernel on an encoded batch and
+    returns f32 values (the full aggregate incl. Targets rescale);
+    ``score(X, M)`` is the convenience f32 entry (encode + predict).
+    """
+
+    wire: QuantizedWire
+    params: Dict[str, jnp.ndarray]
+    field_space: prepare.FieldSpace
+    batch_size: Optional[int]
+    n_trees: int
+    _jit_fn: object
+    backend: str = "xla"  # "xla" | "pallas"
+    labels: Tuple[str, ...] = ()  # classification class list; () = regression
+
+    @property
+    def is_classification(self) -> bool:
+        return bool(self.labels)
+
+    def predict_wire(self, Xq):
+        """→ f32 values [B] (regression) or (values, probs, label_idx).
+
+        The ONE place batch-size alignment happens: any batch whose length
+        differs from the compile ``batch_size`` is zero-padded up to a
+        multiple of it — one padded call on the XLA path (bounded retrace
+        per distinct multiple), fixed-grid batch-size chunks on Pallas
+        (whose kernel bakes ``out_shape=(batch_size,)``). Callers pass the
+        encoded batch as-is and trim via ``decode(out, n)``."""
+        n = Xq.shape[0]
+        bs = self.batch_size
+        if bs is not None and n != bs:
+            pad = (-n) % bs
+            if pad:
+                Xq = np.concatenate(
+                    [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)], axis=0
+                )
+            if self.backend == "pallas":
+                outs = [
+                    self._jit_fn(self.params, Xq[i : i + bs])
+                    for i in range(0, Xq.shape[0], bs)
+                ]
+                if isinstance(outs[0], tuple):  # classification triple
+                    return tuple(
+                        jnp.concatenate([o[k] for o in outs], axis=0)
+                        for k in range(len(outs[0]))
+                    )
+                return jnp.concatenate(outs, axis=0)
+        return self._jit_fn(self.params, Xq)
+
+    def score(self, X, M=None) -> List[Prediction]:
+        n = np.asarray(X).shape[0]
+        out = self.predict_wire(self.wire.encode(X, M))
+        return self.decode(out, n)
+
+    def decode(self, out, n: int) -> List[Prediction]:
+        if not self.is_classification:
+            values = np.asarray(out, np.float32)[:n]
+            return decode_batch(values.tolist(), [True] * n, None, None)
+        value, probs, lab = out
+        value = np.asarray(value, np.float32)[:n]
+        P = np.asarray(probs, np.float32)[:n]
+        idx = np.asarray(lab)[:n]
+        lbls = [self.labels[i] for i in idx]
+        pmaps = [dict(zip(self.labels, row.tolist())) for row in P]
+        return decode_batch(value.tolist(), [True] * n, lbls, pmaps)
+
+
+def _split_bf16(v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """f32 → (hi, lo) bf16 pair with hi + lo ≈ v to ~2^-17 relative."""
+    hi = v.astype(jnp.bfloat16)
+    lo = (v - hi.astype(np.float32)).astype(jnp.bfloat16)
+    return np.asarray(hi), np.asarray(lo)
+
+
+def _match_ensemble(
+    doc: ir.PmmlDocument,
+) -> Optional[Tuple[List[ir.TreeModelIR], List[float], str]]:
+    """doc → (trees, weights, method) when the model is a tree ensemble the
+    fast path can take (regression aggregates, or classification single /
+    majority votes); None otherwise."""
+    model = doc.model
+    if isinstance(model, ir.TreeModelIR):
+        return [model], [1.0], "single"
+    if not isinstance(model, ir.MiningModelIR):
+        return None
+    seg = model.segmentation
+    if seg is None:
+        return None
+    method = seg.multiple_model_method
+    if model.function_name == "regression":
+        if method not in _REGRESSION_METHODS:
+            return None
+    elif method not in ("majorityVote", "weightedMajorityVote"):
+        return None
+    trees: List[ir.TreeModelIR] = []
+    weights: List[float] = []
+    for s in seg.segments:
+        if not isinstance(s.predicate, ir.TruePredicate):
+            return None
+        if not isinstance(s.model, ir.TreeModelIR):
+            return None
+        if s.model.function_name != model.function_name:
+            return None
+        trees.append(s.model)
+        weights.append(s.weight)
+    if not trees:
+        return None
+    return trees, weights, method
+
+
+def build_quantized_scorer(
+    doc: ir.PmmlDocument,
+    batch_size: Optional[int] = None,
+    config: Optional[CompileConfig] = None,
+    backend: str = "auto",
+    pallas_interpret: bool = False,
+) -> Optional[QuantizedScorer]:
+    """Try to build the rank-wire fast path for ``doc``.
+
+    Returns None when the model shape is outside the fast path's contract
+    (non-regression, non-tree segments, set/equality splits, missing-value
+    strategies that null predictions, or trees too deep for the dense
+    lowering). Raises only on malformed documents.
+
+    ``backend``: "auto" picks the Pallas VMEM-resident kernel
+    (qtrees_pallas.py) on TPU when eligible (uint8 wire, fixed batch, and
+    a linear regression aggregate or a majority-vote classification
+    forest), the XLA einsum path otherwise; "xla"/"pallas" force one.
+    ``pallas_interpret`` runs the kernel in interpreter mode (CPU tests).
+    """
+    config = config or CompileConfig()
+    if doc.transformations.derived_fields:
+        # derived-field preprocessing isn't folded into the rank wire
+        return None
+    if doc.output_fields:
+        # top-level <Output> post-processing happens in CompiledModel
+        # .decode; the wire's decode path doesn't carry it
+        return None
+    matched = _match_ensemble(doc)
+    if matched is None:
+        return None
+    trees, weights, method = matched
+
+    fields = doc.active_fields
+    ctx = LowerCtx(
+        field_index={f: i for i, f in enumerate(fields)},
+        codecs=build_codecs(doc.data_dictionary),
+        config=config,
+    )
+    # the rank wire bypasses compiler.full_fn's sanitize stage: any doc
+    # whose fields can be *invalid* (declared category tables, Intervals)
+    # must stay on the f32 path for invalidValueTreatment semantics
+    if (
+        extract_invalid_policy(doc.data_dictionary, doc.model.mining_schema, ctx)
+        is not None
+    ):
+        return None
+    try:
+        canons, classification, depth = _canonicalize_forest(trees, ctx)
+    except ModelCompilationException:
+        return None
+    # int8 path sums are bounded by ±depth — beyond 127 the int8 acc/count
+    # would wrap and mis-select leaves, so such trees stay on the f32 path
+    if depth > min(config.max_dense_depth, 127):
+        return None
+    if classification and method not in (
+        "single", "majorityVote", "weightedMajorityVote"
+    ):
+        return None
+    # halting missing-value semantics (lastPrediction / returnLastPrediction)
+    # need the iterative f32 backend; pack_ensemble would raise on them
+    if any(_canon_has_halt(c) for c in canons):
+        return None
+    try:
+        packed = pack_ensemble(canons, classification)
+    except ModelCompilationException:
+        return None
+    p = packed.params
+    if "set_codes" in p or p["mnull"].any():
+        return None
+    T, S, L = packed.n_trees, packed.n_splits, packed.n_leaves
+    ops = packed.opcodes
+    # real split slots lie on >=1 leaf path; padded slots have all-zero rows
+    real = np.abs(p["P"]).sum(axis=2) > 0  # [T, S]
+    if not set(np.unique(ops[real]).tolist()) <= _SUPPORTED_OPS:
+        return None
+    # a codec (string-categorical) field under an order comparison would
+    # compare category codes — semantically fragile; leave to the f32 path
+    if ctx.codecs:
+        codec_cols = {ctx.field_index[f] for f in ctx.codecs if f in ctx.field_index}
+        if any(int(c) in codec_cols for c in np.unique(p["feat"][real])):
+            return None
+
+    thresh = p["thresh"]
+    feat = p["feat"]
+    # normalise every real split to "go_left iff rank <= cut_index"
+    #   '<'  v  → cut nextafter(v,-inf)            '>'  v → cut v, flip
+    #   '<=' v  → cut v                            '>=' v → cut nextafter, flip
+    cut_val = np.where(
+        (ops == 0) | (ops == 3),
+        np.nextafter(thresh, -np.inf, dtype=np.float32),
+        thresh,
+    )
+    flip = (ops == 2) | (ops == 3)
+
+    F = len(fields)
+    cuts: List[np.ndarray] = [np.empty((0,), np.float32) for _ in range(F)]
+    for j in range(F):
+        sel = real & (feat == j)
+        if sel.any():
+            cuts[j] = np.unique(cut_val[sel].astype(np.float32))
+    max_cuts = max((len(c) for c in cuts), default=0)
+    if max_cuts <= 254:
+        dtype, sentinel = np.uint8, 255
+    elif max_cuts <= 65534:
+        dtype, sentinel = np.uint16, 65535
+    else:
+        return None
+
+    # threshold index per split: position of its cut in its feature's table
+    qthr = np.zeros((T, S), dtype)
+    for j in range(F):
+        sel = real & (feat == j)
+        if sel.any():
+            qthr[sel] = np.searchsorted(cuts[j], cut_val[sel]).astype(dtype)
+
+    dleft = (p["dleft"] > 0.5) ^ flip
+    P = p["P"].copy()
+    P[flip] = -P[flip]
+
+    # fold per-tree aggregate coefficients into leaf values where the
+    # aggregate is linear, so one fused einsum produces the final value
+    w = np.asarray(weights, np.float32)
+    fused_linear = False
+    if not classification:
+        vals = p["leaf_values"].astype(np.float32)  # [T, L]
+        if method in ("single", "sum"):
+            fused_linear, coef = True, np.ones((T,), np.float32)
+        elif method == "average":
+            fused_linear, coef = True, np.full((T,), 1.0 / T, np.float32)
+        elif method == "weightedAverage":
+            fused_linear, coef = True, (w / w.sum()).astype(np.float32)
+        else:  # max / median need the per-tree plane
+            fused_linear, coef = False, np.ones((T,), np.float32)
+        vhi, vlo = _split_bf16(vals * coef[:, None])
+    else:
+        labels = packed.labels
+        C = len(labels)
+        leaf_label = np.round(p["leaf_label"]).astype(np.int64)  # [T, L]
+        if method == "single":
+            # per-leaf class distributions + the leaf's own label
+            probs_tbl = p["leaf_probs"].astype(np.float32)  # [T, L, C]
+        else:
+            # each tree votes its leaf's label one-hot, weighted
+            w_eff = (
+                w if method == "weightedMajorityVote"
+                else np.ones((T,), np.float32)
+            )
+            probs_tbl = np.zeros((T, L, C), np.float32)
+            tt, ll = np.meshgrid(
+                np.arange(T), np.arange(L), indexing="ij"
+            )
+            probs_tbl[tt, ll, leaf_label] = 1.0
+            probs_tbl *= w_eff[:, None, None]
+            probs_tbl /= w_eff.sum()
+        phi, plo = _split_bf16(probs_tbl)
+        lab_f = leaf_label.astype(np.float32)
+
+    targets = doc.targets
+    repl, has_repl = extract_missing_replacements(doc.model.mining_schema, ctx)
+
+    wire = QuantizedWire(
+        fields=fields,
+        cuts=tuple(cuts),
+        dtype=dtype,
+        sentinel=sentinel,
+        repl=repl,
+        has_repl=has_repl,
+    )
+
+    params: Dict[str, np.ndarray] = {
+        "feat": feat.astype(np.int32),
+        "qthr": qthr,
+        "dleft": dleft,
+        "P_i8": P.astype(np.int8),
+        "count_i8": p["count"].astype(np.int8),
+    }
+    if not classification:
+        params["vhi"] = vhi
+        params["vlo"] = vlo
+        if not fused_linear:
+            params["vals_f32"] = vals
+    else:
+        params["phi"] = phi
+        params["plo"] = plo
+        params["lab"] = lab_f
+
+    on_cpu = common.backend_is_cpu()
+    sent = dtype(sentinel)
+
+    def _hit(pp, Xq):
+        """[B,T,L] leaf one-hot (f32 on CPU — no int8/bf16 dot kernels
+        there — bf16 on TPU)."""
+        xv = Xq[:, pp["feat"]]  # [B, T, S] rank codes
+        miss = xv == sent
+        go = jnp.where(miss, pp["dleft"], xv <= pp["qthr"])
+        if on_cpu:
+            sign = jnp.where(go, 1.0, -1.0).astype(jnp.float32)
+            acc = jnp.einsum(
+                "bts,tsl->btl", sign, pp["P_i8"].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            return (
+                acc == pp["count_i8"].astype(jnp.float32)[None]
+            ).astype(jnp.float32)
+        sign = jnp.where(go, jnp.int8(1), jnp.int8(-1))
+        acc = jnp.einsum(
+            "bts,tsl->btl", sign, pp["P_i8"],
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.int8)
+        return (acc == pp["count_i8"][None]).astype(jnp.bfloat16)
+
+    def _pair_einsum(spec, hit, hi, lo):
+        """hi+lo bf16 split contraction, f32-accurate."""
+        if on_cpu:
+            h = hi.astype(jnp.float32) + lo.astype(jnp.float32)
+            return jnp.einsum(spec, hit, h)
+        return jnp.einsum(
+            spec, hit, hi, preferred_element_type=jnp.float32
+        ) + jnp.einsum(spec, hit, lo, preferred_element_type=jnp.float32)
+
+    if not classification:
+        def qfn(pp, Xq):
+            hit = _hit(pp, Xq)
+            if fused_linear:
+                value = _pair_einsum("btl,tl->b", hit, pp["vhi"], pp["vlo"])
+            else:
+                per_tree = jnp.einsum(
+                    "btl,tl->bt", hit.astype(jnp.float32), pp["vals_f32"],
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                value = (
+                    jnp.max(per_tree, axis=1)
+                    if method == "max"
+                    else jnp.median(per_tree, axis=1)
+                )
+            value = apply_targets_value(value, targets)
+            return value.astype(jnp.float32)
+    else:
+        def qfn(pp, Xq):
+            hit = _hit(pp, Xq)
+            probs = _pair_einsum("btl,tlc->bc", hit, pp["phi"], pp["plo"])
+            if method == "single":
+                # the label is the leaf's score attribute, not argmax
+                lab = jnp.round(
+                    jnp.einsum(
+                        "btl,tl->b", hit.astype(jnp.float32), pp["lab"],
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+                ).astype(jnp.int32)
+            else:
+                lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            value = apply_targets_value(value, targets)
+            return value.astype(jnp.float32), probs.astype(jnp.float32), lab
+
+    # Pallas VMEM-resident kernel: uint8 wire + fixed batch, with either a
+    # linear regression aggregate (the GBM hot path) or a classification
+    # vote forest (majorityVote — per-leaf class rows contract in-kernel)
+    want_pallas = backend in ("auto", "pallas")
+    pallas_env = (
+        dtype is np.uint8
+        and batch_size is not None
+        and (not on_cpu or pallas_interpret)
+    )
+    pallas_cls = classification and method in (
+        "majorityVote", "weightedMajorityVote"
+    )
+    if want_pallas and pallas_env and (
+        (not classification and fused_linear) or pallas_cls
+    ):
+        from flink_jpmml_tpu.compile import qtrees_pallas
+
+        # contract the same bf16 hi+lo reconstructed tables as the XLA
+        # path (phi+plo / vhi+vlo), not the raw f32 ones — otherwise
+        # argmax tie-breaks on near-equal vote shares could differ
+        # between backends for the same model
+        if classification:
+            vals_tbl = phi.astype(np.float32) + plo.astype(np.float32)
+        else:
+            vals_tbl = vhi.astype(np.float32) + vlo.astype(np.float32)
+        groups = qtrees_pallas.pack_groups(
+            feat=params["feat"].astype(np.int64),
+            qthr=qthr,
+            dleft=np.asarray(dleft),
+            P=params["P_i8"],
+            count=params["count_i8"],
+            vals=vals_tbl,
+            n_fields=F,
+        )
+        raw = qtrees_pallas.build_pallas_fn(
+            groups, batch_size, F, sentinel, interpret=pallas_interpret
+        )
+        if raw is not None:
+            if classification:
+                def pqfn(gp, Xq):
+                    probs = raw(gp, Xq)  # [B, C] vote shares
+                    lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+                    value = jnp.take_along_axis(
+                        probs, lab[:, None], axis=1
+                    )[:, 0]
+                    value = apply_targets_value(value, targets)
+                    return (
+                        value.astype(jnp.float32),
+                        probs.astype(jnp.float32),
+                        lab,
+                    )
+            else:
+                def pqfn(gp, Xq):
+                    return apply_targets_value(raw(gp, Xq), targets).astype(
+                        jnp.float32
+                    )
+
+            return QuantizedScorer(
+                wire=wire,
+                params=jax.device_put(groups),
+                field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
+                batch_size=batch_size,
+                n_trees=T,
+                _jit_fn=jax.jit(
+                    pqfn,
+                    donate_argnums=(1,) if config.donate_batches else (),
+                ),
+                backend="pallas",
+                labels=packed.labels if classification else (),
+            )
+    if backend == "pallas":
+        return None  # forced pallas but not eligible
+
+    jit_fn = jax.jit(qfn, donate_argnums=(1,) if config.donate_batches else ())
+    codecs = ctx.codecs
+
+    return QuantizedScorer(
+        wire=wire,
+        params=jax.device_put(params),
+        field_space=prepare.FieldSpace(fields=fields, codecs=codecs),
+        batch_size=batch_size,
+        n_trees=T,
+        _jit_fn=jit_fn,
+        backend="xla",
+        labels=packed.labels if classification else (),
+    )

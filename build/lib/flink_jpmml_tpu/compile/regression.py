@@ -1,0 +1,149 @@
+"""RegressionModel → JAX: one matmul + link function (SURVEY.md §8 step 2).
+
+The reference evaluated regression tables per record on the CPU inside
+JPMML-Evaluator (SURVEY.md §4.1); here every table is a gathered matmul over
+the batch, and the normalization link (logit/softmax/…) is fused elementwise
+— exactly the shape XLA tiles onto the MXU/VPU.
+
+Missing semantics (matching the oracle, interp.py): a missing *numeric*
+predictor makes that table's value missing (lane invalid); a missing
+*categorical* predictor contributes 0.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import HIGHEST, Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+def _lower_table(table: ir.RegressionTable, ctx: LowerCtx):
+    """One RegressionTable → (params, fn(params, X, M) -> (y, missing))."""
+    num_cols = np.asarray(
+        [ctx.column(p.name) for p in table.numeric_predictors], np.int32
+    )
+    num_exps = np.asarray([p.exponent for p in table.numeric_predictors], np.float32)
+    all_exp_one = bool(np.all(num_exps == 1.0))
+    cat_cols = np.asarray(
+        [ctx.column(p.name) for p in table.categorical_predictors], np.int32
+    )
+
+    params = {
+        "intercept": np.float32(table.intercept),
+        "num_coefs": np.asarray(
+            [p.coefficient for p in table.numeric_predictors], np.float32
+        ),
+        "cat_codes": np.asarray(
+            [ctx.encode(p.name, p.value) for p in table.categorical_predictors],
+            np.float32,
+        ),
+        "cat_coefs": np.asarray(
+            [p.coefficient for p in table.categorical_predictors], np.float32
+        ),
+    }
+
+    def fn(p: dict, X: jnp.ndarray, M: jnp.ndarray):
+        B = X.shape[0]
+        y = jnp.broadcast_to(p["intercept"].astype(jnp.float32), (B,))
+        missing = jnp.zeros((B,), bool)
+        if num_cols.size:
+            xs = X[:, num_cols]  # [B, P] static-index gather
+            if not all_exp_one:
+                xs = xs ** num_exps
+            y = y + jnp.dot(xs, p["num_coefs"], precision=HIGHEST)
+            missing = missing | jnp.any(M[:, num_cols], axis=1)
+        if cat_cols.size:
+            xc = X[:, cat_cols]  # [B, Q]
+            ind = (xc == p["cat_codes"][None, :]) & ~M[:, cat_cols]
+            y = y + jnp.dot(ind.astype(jnp.float32), p["cat_coefs"], precision=HIGHEST)
+        return y, missing
+
+    return params, fn
+
+
+def lower_regression(model: ir.RegressionModelIR, ctx: LowerCtx) -> Lowered:
+    nm = model.normalization_method
+    lowered_tables = [_lower_table(t, ctx) for t in model.tables]
+    params = {f"t{i}": p for i, (p, _) in enumerate(lowered_tables)}
+    table_fns = [f for _, f in lowered_tables]
+
+    if model.function_name == "regression":
+        if nm not in ("none", "identity", "softmax", "logit", "exp",
+                      "cauchit", "cloglog", "loglog", "probit"):
+            raise ModelCompilationException(
+                f"unsupported regression normalization {nm!r}"
+            )
+        t0 = table_fns[0]
+
+        def fn(p, X, M):
+            y, missing = t0(p["t0"], X, M)
+            if nm in ("softmax", "logit"):
+                # PMML: for regression, softmax == logit == sigmoid
+                y = 1.0 / (1.0 + jnp.exp(-y))
+            elif nm == "exp":
+                y = jnp.exp(y)
+            elif nm == "cauchit":
+                y = 0.5 + jnp.arctan(y) / jnp.pi
+            elif nm == "cloglog":
+                y = 1.0 - jnp.exp(-jnp.exp(y))
+            elif nm == "loglog":
+                y = jnp.exp(-jnp.exp(-y))
+            elif nm == "probit":
+                y = 0.5 * (1.0 + jax.scipy.special.erf(y / jnp.sqrt(2.0)))
+            return ModelOutput(value=y, valid=~missing)
+
+        return Lowered(fn=fn, params=params)
+
+    if model.function_name != "classification":
+        raise ModelCompilationException(
+            f"unsupported RegressionModel functionName {model.function_name!r}"
+        )
+
+    labels: Tuple[str, ...] = tuple(
+        t.target_category or str(i) for i, t in enumerate(model.tables)
+    )
+    if nm not in ("none", "identity", "softmax", "simplemax", "logit"):
+        raise ModelCompilationException(
+            f"unsupported classification normalization {nm!r}"
+        )
+    two_tables = len(table_fns) == 2
+
+    def cfn(p, X, M):
+        ys, miss = zip(
+            *(f(p[f"t{i}"], X, M) for i, f in enumerate(table_fns))
+        )
+        Y = jnp.stack(ys, axis=1)  # [B, C]
+        missing = jnp.any(jnp.stack(miss, axis=1), axis=1)
+        if nm == "softmax":
+            probs = softmax(Y)
+        elif nm == "simplemax":
+            s = jnp.sum(Y, axis=1, keepdims=True)
+            probs = jnp.where(s == 0, jnp.nan, Y / s)
+        elif nm == "logit":
+            if two_tables:
+                pr = 1.0 / (1.0 + jnp.exp(-Y[:, 0]))
+                probs = jnp.stack([pr, 1.0 - pr], axis=1)
+            else:
+                probs = 1.0 / (1.0 + jnp.exp(-Y))
+        else:
+            probs = Y
+        label_idx = jnp.argmax(probs, axis=1).astype(jnp.int32)
+        value = jnp.take_along_axis(probs, label_idx[:, None], axis=1)[:, 0]
+        valid = ~missing & ~jnp.isnan(value)
+        return ModelOutput(
+            value=value, valid=valid, probs=probs, label_idx=label_idx
+        )
+
+    return Lowered(fn=cfn, params=params, labels=labels)
+
+
+def softmax(Y: jnp.ndarray) -> jnp.ndarray:
+    m = jnp.max(Y, axis=1, keepdims=True)
+    e = jnp.exp(Y - m)
+    return e / jnp.sum(e, axis=1, keepdims=True)

@@ -1,0 +1,202 @@
+"""Scorecard → JAX: vectorized first-true attribute scan per characteristic.
+
+Reference parity: the reference scores any JPMML-supported model class
+(SURVEY.md §1 C1 "build an evaluator for whatever model class the
+document contains"); scorecards are JPMML's bread-and-butter credit-risk
+format. Semantics: score = initialScore + Σ over Characteristics of the
+partialScore of the first Attribute whose predicate is TRUE (UNKNOWN
+doesn't match — scorecard documents bin missing values with explicit
+isMissing attributes); a characteristic with no matching attribute makes
+the record's result invalid (empty lane, totality C5).
+
+Lowering: every attribute predicate flattens through the general
+predicate tables of gtrees.py (Simple/SimpleSet/True/False, single-level
+or DNF-expanded nested compounds) into ``[C, A, K]`` arrays; one
+evaluation produces the ``[B, C, A]`` truth cube, the first-true scan is
+an argmax, and the per-characteristic chosen partials land in
+``ModelOutput.probs[:, :C]`` with the chosen attribute index in
+``probs[:, C:]`` — the decode side derives ranked reason codes from them
+(pointsBelow/pointsAbove) without a second device readback.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.compile.gtrees import (
+    _C_OR,
+    _combine,
+    _flatten_predicate,
+    _P_FALSE,
+    _sub_pred_eval,
+)
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+class ReasonCodeMeta:
+    """Static reason-code data the decode step needs: per-(c, a) codes,
+    per-characteristic baselines, and the ranking algorithm."""
+
+    def __init__(self, model: ir.ScorecardIR):
+        self.algorithm = model.reason_code_algorithm
+        if self.algorithm not in ("pointsBelow", "pointsAbove"):
+            raise ModelCompilationException(
+                f"unsupported reasonCodeAlgorithm {self.algorithm!r}"
+            )
+        self.codes = []  # [C][A] strings
+        self.baselines = np.zeros((len(model.characteristics),), np.float32)
+        for ci, ch in enumerate(model.characteristics):
+            bs = (
+                ch.baseline_score
+                if ch.baseline_score is not None
+                else model.baseline_score
+            )
+            if bs is None:
+                raise ModelCompilationException(
+                    f"useReasonCodes: characteristic {ch.name!r} has no "
+                    "baselineScore (and the Scorecard declares none)"
+                )
+            self.baselines[ci] = bs
+            row = []
+            for at in ch.attributes:
+                code = at.reason_code or ch.reason_code
+                if code is None:
+                    raise ModelCompilationException(
+                        f"useReasonCodes: characteristic {ch.name!r} has "
+                        "an attribute with no reasonCode (attribute or "
+                        "characteristic level)"
+                    )
+                row.append(code)
+            self.codes.append(row)
+
+    def rank(self, partials: np.ndarray, attr_idx: np.ndarray) -> list:
+        """One record's ([C] partials, [C] chosen attribute) → reason
+        codes ranked worst-first per the algorithm (ties: document
+        order, np.argsort stable)."""
+        diff = (
+            self.baselines - partials
+            if self.algorithm == "pointsBelow"
+            else partials - self.baselines
+        )
+        order = np.argsort(-diff, kind="stable")
+        return [
+            self.codes[c][int(attr_idx[c])] for c in order
+        ]
+
+
+def lower_scorecard(model: ir.ScorecardIR, ctx: LowerCtx) -> Lowered:
+    C = len(model.characteristics)
+    A = max(len(ch.attributes) for ch in model.characteristics)
+    flat = [
+        [_flatten_predicate(at.predicate, ctx) for at in ch.attributes]
+        for ch in model.characteristics
+    ]
+    K = max(len(subs) for row in flat for _, subs in row)
+    KS = max(
+        (len(s[3]) for row in flat for _, subs in row for s in subs),
+        default=0,
+    )
+
+    pcol = np.zeros((C, A, K), np.int32)
+    pop = np.full((C, A, K), float(_P_FALSE), np.float32)
+    pval = np.zeros((C, A, K), np.float32)
+    pact = np.zeros((C, A, K), np.float32)
+    pneg = np.zeros((C, A, K), np.float32)
+    pterm = np.zeros((C, A, K), np.float32)
+    # padded attribute slots (characteristics with fewer than A
+    # attributes) must evaluate FALSE: an empty AND is vacuously TRUE in
+    # the three-valued combiner, an empty OR is FALSE — pad with OR
+    # (same convention as gtrees.pack_general)
+    pcomb = np.full((C, A), float(_C_OR), np.float32)
+    psets = np.full((C, A, K, KS), np.nan, np.float32) if KS else None
+    partial = np.zeros((C, A), np.float32)
+
+    # ComplexPartialScore slots: (ci, ai, lowered expression) — their
+    # per-record values overwrite the static partial plane in fn
+    expr_slots = []
+    for ci, ch in enumerate(model.characteristics):
+        for ai, at in enumerate(ch.attributes):
+            comb, subs = flat[ci][ai]
+            pcomb[ci, ai] = comb
+            partial[ci, ai] = at.partial_score
+            if at.partial_expr is not None:
+                from flink_jpmml_tpu.compile.exprs import lower_expression
+
+                expr_slots.append(
+                    (ci, ai, lower_expression(at.partial_expr, ctx))
+                )
+            for k, (c_, o_, v_, s_, n_, t_) in enumerate(subs):
+                pcol[ci, ai, k] = c_
+                pop[ci, ai, k] = o_
+                pval[ci, ai, k] = v_
+                pact[ci, ai, k] = 1.0
+                pneg[ci, ai, k] = 1.0 if n_ else 0.0
+                pterm[ci, ai, k] = t_
+                if s_ and psets is not None:
+                    psets[ci, ai, k, : len(s_)] = s_
+
+    params = {
+        "pcol": pcol, "pop": pop, "pval": pval, "pact": pact,
+        "pneg": pneg, "pterm": pterm, "pcomb": pcomb,
+        "partial": partial,
+    }
+    if psets is not None:
+        params["psets"] = psets
+    init = float(model.initial_score)
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        cols = p["pcol"].reshape(-1)  # [C*A*K]
+        x = jnp.take(X, cols, axis=1).reshape(B, C, A, K)
+        m = jnp.take(M, cols, axis=1).reshape(B, C, A, K)
+        member = None
+        if "psets" in p:
+            member = jnp.any(x[..., None] == p["psets"][None], axis=-1)
+        isT, isU = _sub_pred_eval(
+            x, m, p["pop"][None], p["pval"][None], member, p["pneg"][None]
+        )
+        attrT, _attrU = _combine(
+            p["pcomb"][None], isT, isU, p["pact"][None], p["pterm"][None]
+        )  # [B, C, A]; UNKNOWN attributes simply don't match
+        matched = jnp.any(attrT, axis=-1)  # [B, C]
+        first = jnp.argmax(attrT, axis=-1)  # first True (argmax on bools)
+        partial_dyn = jnp.broadcast_to(p["partial"][None], (B, C, A))
+        expr_bad = None  # [B, C, A] chosen-slot poison for failed exprs
+        if expr_slots:
+            expr_bad = jnp.zeros((B, C, A), bool)
+            for ci, ai, efn in expr_slots:
+                v, miss = efn(X, M)
+                partial_dyn = partial_dyn.at[:, ci, ai].set(
+                    jnp.where(miss, 0.0, v.astype(jnp.float32))
+                )
+                expr_bad = expr_bad.at[:, ci, ai].set(miss)
+        chosen = jnp.take_along_axis(
+            partial_dyn, first[..., None], axis=-1
+        )[..., 0]  # [B, C]
+        value = init + jnp.sum(chosen, axis=-1)
+        valid = jnp.all(matched, axis=-1)
+        if expr_bad is not None:
+            # a chosen attribute whose ComplexPartialScore failed to
+            # compute empties the lane (oracle parity)
+            chosen_bad = jnp.take_along_axis(
+                expr_bad, first[..., None], axis=-1
+            )[..., 0]
+            valid = valid & ~jnp.any(chosen_bad, axis=-1)
+        # decode-side payload: per-characteristic partials + chosen
+        # attribute index (for attribute-level reason codes)
+        probs = jnp.concatenate(
+            [chosen, first.astype(jnp.float32)], axis=1
+        )  # [B, 2C]
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=valid,
+            probs=probs,
+            label_idx=None,
+        )
+
+    return Lowered(fn=fn, params=params, labels=())

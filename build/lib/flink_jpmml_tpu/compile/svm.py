@@ -1,0 +1,183 @@
+"""SupportVectorMachineModel → JAX: one kernel matmul + coefficient matmul.
+
+Reference parity: JPMML scores SVM documents (SURVEY.md §1 C1). The MXU
+shape is ideal: the kernel matrix K(X, SV) ``[B, N]`` is one (or two, for
+RBF) matmuls against the ``[N, D]`` support-vector table, and every
+machine's decision function contracts through one sparse-in-structure
+``[N, M]`` coefficient matrix:
+
+    f_m(x) = Σ_i α_{m,i} · K(sv_i, x) + b_m        (K over all N vectors)
+
+Kernels: linear ⟨x,s⟩; polynomial (γ⟨x,s⟩+c₀)^d; radialBasis
+exp(−γ‖x−s‖²); sigmoid tanh(γ⟨x,s⟩+c₀).
+
+Decision conventions (documented here AND implemented identically in the
+oracle — the two cannot diverge):
+
+- regression: the single machine's f(x) is the value.
+- classification OneAgainstOne: each machine votes ``targetCategory``
+  when ``f(x) < threshold`` else ``alternateTargetCategory`` (the libsvm
+  pairwise layout JPMML follows); most votes wins, ties break to the
+  category appearing first in the machines' document order.
+- classification OneAgainstAll: machine m scores its targetCategory;
+  the smallest f wins (libsvm one-vs-rest decision values as distances).
+
+A record missing any vector field scores as an invalid lane (SVMs have
+no missing-value routing — totality C5).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+def kernel_fn(kernel: ir.SvmKernel):
+    """→ f(X [B,D], S [N,D]) -> [B,N]; shared contract with the oracle."""
+    kind = kernel.kind
+    g = float(kernel.gamma)
+    c0 = float(kernel.coef0)
+    d = float(kernel.degree)
+
+    def lin(X, S):
+        return jnp.dot(X, S.T)
+
+    if kind == "linear":
+        return lin
+    if kind == "polynomial":
+        return lambda X, S: jnp.power(g * lin(X, S) + c0, d)
+    if kind == "sigmoid":
+        return lambda X, S: jnp.tanh(g * lin(X, S) + c0)
+    if kind == "radialBasis":
+        def rbf(X, S):
+            # ‖x−s‖² expanded so the MXU carries the cross term
+            x2 = jnp.sum(X * X, axis=1, keepdims=True)
+            s2 = jnp.sum(S * S, axis=1)[None, :]
+            return jnp.exp(-g * (x2 - 2.0 * lin(X, S) + s2))
+        return rbf
+    raise ModelCompilationException(f"unsupported SVM kernel {kind!r}")
+
+
+def lower_svm(model: ir.SvmModelIR, ctx: LowerCtx) -> Lowered:
+    cols = np.asarray(
+        [ctx.column(f) for f in model.vector_fields], np.int32
+    )
+    vid_index = {vid: i for i, (vid, _) in enumerate(model.vectors)}
+    S = np.asarray([c for _, c in model.vectors], np.float32)  # [N, D]
+    N = S.shape[0]
+    M = len(model.machines)
+    A = np.zeros((N, M), np.float32)
+    b = np.zeros((M,), np.float32)
+    thr = np.full((M,), float(model.threshold), np.float32)
+    for mi, m in enumerate(model.machines):
+        b[mi] = m.intercept
+        if m.threshold is not None:
+            thr[mi] = m.threshold
+        for vid, alpha in zip(m.vector_ids, m.coefficients):
+            if vid not in vid_index:
+                raise ModelCompilationException(
+                    f"SupportVector references unknown vectorId {vid!r}"
+                )
+            A[vid_index[vid], mi] += alpha
+
+    kfn = kernel_fn(model.kernel)
+    classification = model.function_name == "classification"
+    if classification:
+        labels: list = []
+        for m in model.machines:
+            for cat in (m.target_category, m.alternate_target_category):
+                if cat is not None and cat not in labels:
+                    labels.append(cat)
+        if not labels:
+            raise ModelCompilationException(
+                "classification SVM machines declare no target categories"
+            )
+        one_v_one = model.classification_method == "OneAgainstOne"
+        if one_v_one:
+            tgt = np.zeros((M,), np.int32)
+            alt = np.zeros((M,), np.int32)
+            for mi, m in enumerate(model.machines):
+                if (
+                    m.target_category is None
+                    or m.alternate_target_category is None
+                ):
+                    raise ModelCompilationException(
+                        "OneAgainstOne machines need targetCategory and "
+                        "alternateTargetCategory"
+                    )
+                tgt[mi] = labels.index(m.target_category)
+                alt[mi] = labels.index(m.alternate_target_category)
+        else:
+            tgt = np.zeros((M,), np.int32)
+            for mi, m in enumerate(model.machines):
+                if m.target_category is None:
+                    raise ModelCompilationException(
+                        "OneAgainstAll machines need targetCategory"
+                    )
+                tgt[mi] = labels.index(m.target_category)
+    else:
+        labels = []
+        if M != 1:
+            raise ModelCompilationException(
+                f"regression SVM needs exactly one machine, got {M}"
+            )
+
+    L = len(labels)
+    params = {"S": S, "A": A, "b": b}
+    used = np.zeros((ctx.n_fields,), bool)
+    for c in cols:
+        used[c] = True
+
+    def fn(p, X, M_):
+        missing = jnp.any(M_ & used[None, :], axis=1)
+        x = X[:, cols]  # [B, D]
+        K = kfn(x, p["S"])  # [B, N]
+        f = jnp.dot(K, p["A"]) + p["b"][None, :]  # [B, M]
+        if not classification:
+            return ModelOutput(
+                value=f[:, 0].astype(jnp.float32),
+                valid=~missing,
+                probs=None,
+                label_idx=None,
+            )
+        if one_v_one:
+            votes_t = (f < thr[None, :]).astype(jnp.float32)  # [B, M]
+            onehot_t = jnp.zeros((M, L), jnp.float32).at[
+                jnp.arange(M), tgt
+            ].set(1.0)
+            onehot_a = jnp.zeros((M, L), jnp.float32).at[
+                jnp.arange(M), alt
+            ].set(1.0)
+            counts = jnp.dot(votes_t, onehot_t) + jnp.dot(
+                1.0 - votes_t, onehot_a
+            )  # [B, L]
+            lab = jnp.argmax(counts, axis=1).astype(jnp.int32)
+            probs = counts / jnp.maximum(
+                jnp.sum(counts, axis=1, keepdims=True), 1.0
+            )
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+        else:
+            # OneAgainstAll: smallest decision value wins
+            onehot_t = jnp.zeros((M, L), jnp.float32).at[
+                jnp.arange(M), tgt
+            ].set(1.0)
+            big = jnp.float32(np.finfo(np.float32).max)
+            scores = jnp.min(
+                jnp.where(onehot_t[None] > 0.5, f[:, :, None], big),
+                axis=1,
+            )  # [B, L]
+            lab = jnp.argmin(scores, axis=1).astype(jnp.int32)
+            probs = None
+            value = jnp.take_along_axis(scores, lab[:, None], axis=1)[:, 0]
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=~missing,
+            probs=probs,
+            label_idx=lab,
+        )
+
+    return Lowered(fn=fn, params=params, labels=tuple(labels))

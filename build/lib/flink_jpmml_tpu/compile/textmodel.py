@@ -1,0 +1,117 @@
+"""TextModel → JAX: weighted document-similarity as one matmul.
+
+Reference parity: PMML's TextModel class (SURVEY.md §1 C1 model-class
+coverage). The corpus DocumentTermMatrix is weighted once at compile
+(local × global term weights + optional cosine normalization, float64);
+per batch the query rows get the identical weighting in-graph and the
+similarity against all documents is a single ``[B, T] @ [T, D]`` matmul
+(cosine) or the ‖q−d‖² expansion (euclidean) — MXU-shaped, no per-record
+text handling on the device.
+
+Input contract (ir.TextModelIR): one active field per term carrying the
+record's term count; missing cells read as 0 (an unobserved term is an
+absent term, mirroring the association basket contract), so lanes are
+always valid.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import (
+    HIGHEST,
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+)
+from flink_jpmml_tpu.pmml import ir
+
+
+def _weight_np(rows: np.ndarray, kind: str, idf: np.ndarray,
+               doc_norm: str) -> np.ndarray:
+    if kind == "binary":
+        w = (rows > 0).astype(np.float64)
+    elif kind == "logarithmic":
+        w = np.log10(1.0 + np.maximum(rows, 0.0))
+    elif kind == "augmentedNormalizedTermFrequency":
+        m = rows.max(axis=1, keepdims=True)
+        w = np.where(
+            (rows > 0) & (m > 0), 0.5 + 0.5 * rows / np.maximum(m, 1e-30),
+            0.0,
+        )
+    else:  # termFrequency
+        w = np.maximum(rows, 0.0)
+    w = w * idf[None, :]
+    if doc_norm == "cosine":
+        n = np.linalg.norm(w, axis=1, keepdims=True)
+        w = np.where(n > 0, w / np.maximum(n, 1e-30), 0.0)
+    return w
+
+
+def lower_text_model(model: ir.TextModelIR, ctx: LowerCtx) -> Lowered:
+    cols = np.asarray([ctx.column(t) for t in model.terms], np.int32)
+    dtm = np.asarray(model.dtm, np.float64)
+    D, T = dtm.shape
+    if model.global_weight == "inverseDocumentFrequency":
+        dj = (dtm > 0).sum(axis=0)
+        idf = np.where(dj > 0, np.log10(D / np.maximum(dj, 1)), 0.0)
+    else:
+        idf = np.ones((T,), np.float64)
+    W = _weight_np(dtm, model.local_weight, idf, model.doc_normalization)
+
+    params = {
+        "W": W.astype(np.float32),  # [D, T] weighted corpus
+        "Wsq": (W ** 2).sum(axis=1).astype(np.float32),  # [D]
+        "Wnorm": np.linalg.norm(W, axis=1).astype(np.float32),
+        "idf": idf.astype(np.float32),
+    }
+    local = model.local_weight
+    doc_norm = model.doc_normalization
+    similarity = model.similarity
+    log10 = float(math.log(10.0))
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        q = jnp.where(M[:, cols], 0.0, jnp.maximum(X[:, cols], 0.0))
+        if local == "binary":
+            w = (q > 0).astype(jnp.float32)
+        elif local == "logarithmic":
+            w = jnp.log(1.0 + q) / log10
+        elif local == "augmentedNormalizedTermFrequency":
+            m = jnp.max(q, axis=1, keepdims=True)
+            w = jnp.where(
+                (q > 0) & (m > 0), 0.5 + 0.5 * q / jnp.maximum(m, 1e-30),
+                0.0,
+            )
+        else:
+            w = q
+        w = w * p["idf"][None, :]
+        if doc_norm == "cosine":
+            n = jnp.linalg.norm(w, axis=1, keepdims=True)
+            w = jnp.where(n > 0, w / jnp.maximum(n, 1e-30), 0.0)
+        dots = jnp.matmul(w, p["W"].T, precision=HIGHEST)  # [B, D]
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(w, axis=1, keepdims=True)
+            denom = qn * p["Wnorm"][None, :]
+            scores = jnp.where(denom > 0, dots / jnp.maximum(denom, 1e-30), 0.0)
+            win = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        else:  # euclidean: ‖q−d‖² = ‖q‖² + ‖d‖² − 2 q·d
+            d2 = (
+                jnp.sum(w ** 2, axis=1, keepdims=True)
+                + p["Wsq"][None, :]
+                - 2.0 * dots
+            )
+            scores = jnp.sqrt(jnp.maximum(d2, 0.0))
+            win = jnp.argmin(scores, axis=1).astype(jnp.int32)
+        value = jnp.take_along_axis(scores, win[:, None], axis=1)[:, 0]
+        return ModelOutput(
+            value=value.astype(jnp.float32),
+            valid=jnp.ones((B,), bool),
+            probs=scores.astype(jnp.float32),
+            label_idx=win,
+        )
+
+    return Lowered(fn=fn, params=params, labels=model.doc_ids)

@@ -1,0 +1,60 @@
+"""TimeSeriesModel (ExponentialSmoothing) → JAX: closed-form forecasts.
+
+Reference parity: JPMML-Evaluator scores TimeSeriesModel documents'
+exponential-smoothing state (SURVEY.md §1 C1). The temporal state is in
+the document (final level/trend + one period of seasonal factors); each
+record carries the forecast horizon h (first active MiningField, integer
+≥ 1, rounded), so scoring stays a pure batched function:
+
+    ŷ(h) = level (+ h·trend | + trend·φ(1−φ^h)/(1−φ) for damped_trend)
+                 (+ seasonal[(h−1) mod period]  |  × seasonal[…])
+
+A missing horizon scores as an empty lane. φ^h lowers as exp(h·ln φ)
+(φ ∈ (0,1) guaranteed by the parser), keeping the math branch-free.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.pmml import ir
+
+
+def lower_time_series(model: ir.TimeSeriesIR, ctx: LowerCtx) -> Lowered:
+    col = ctx.column(model.horizon_field)
+    s = model.smoothing
+    params = {
+        "level": np.float32(s.level),
+        "trend": np.float32(s.trend),
+    }
+    if s.seasonal_type != "none":
+        params["seasonal"] = np.asarray(s.seasonal, np.float32)
+    trend_type = s.trend_type
+    seasonal_type = s.seasonal_type
+    period = s.period
+    log_phi = math.log(s.phi) if trend_type == "damped_trend" else 0.0
+    phi_scale = (
+        s.phi / (1.0 - s.phi) if trend_type == "damped_trend" else 0.0
+    )
+
+    def fn(p, X, M):
+        h = jnp.maximum(jnp.round(X[:, col]), 1.0)
+        y = jnp.broadcast_to(p["level"], h.shape)
+        if trend_type == "additive":
+            y = y + h * p["trend"]
+        elif trend_type == "damped_trend":
+            phi_h = jnp.exp(h * log_phi)
+            y = y + p["trend"] * phi_scale * (1.0 - phi_h)
+        if seasonal_type != "none":
+            idx = jnp.mod(h.astype(jnp.int32) - 1, period)
+            factor = jnp.take(p["seasonal"], idx)
+            y = y + factor if seasonal_type == "additive" else y * factor
+        return ModelOutput(
+            value=y.astype(jnp.float32), valid=~M[:, col]
+        )
+
+    return Lowered(fn=fn, params=params)

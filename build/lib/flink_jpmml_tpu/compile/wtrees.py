@@ -1,0 +1,220 @@
+"""Weighted-path tree evaluation: missingValueStrategy weightedConfidence
+(classification) and aggregateNodes (regression).
+
+Reference parity: JPMML routes an UNKNOWN split under these strategies
+into ALL viable children at once, weighting each by its recordCount
+share, and aggregates the reached leaves (SURVEY.md §1 C1). The boolean
+path-matrix lowering (trees.py) cannot express fractional membership, so
+these trees lower here instead: the tree unrolls at trace time and every
+node's weight is
+
+    w(child) = w(node) ·  [first-TRUE child]           when any child is TRUE
+               w(node) ·  rc(child)/Σ rc(viable)       when none is TRUE but
+                                                       some are UNKNOWN
+               0                                       all children FALSE
+
+with viable = not-FALSE children. Leaves aggregate weight-normalized:
+classification sums per-leaf confidences (ScoreDistribution confidence
+attribute, else recordCount proportions), regression sums leaf scores.
+A record whose total reaching weight is zero — dead-end or root miss —
+is an empty lane (C5). Documents must carry recordCount on every child
+of a splittable node (rejected at compile otherwise).
+
+These strategies appear in small handcrafted trees; the trace-time
+unroll is O(nodes) jnp ops, which XLA fuses into a handful of kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_jpmml_tpu.compile.common import (
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+    lower_predicate,
+)
+from flink_jpmml_tpu.pmml import ir
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+
+def _leaf_payload(model: ir.TreeModelIR):
+    """Collect leaves + per-leaf payloads; classification gets the label
+    list and per-leaf confidence rows."""
+    leaves: List[ir.TreeNode] = []
+
+    def walk(n: ir.TreeNode):
+        if n.is_leaf:
+            leaves.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(model.root)
+    if model.function_name == "classification":
+        labels: List[str] = []
+        for leaf in leaves:
+            if not leaf.score_distribution:
+                raise ModelCompilationException(
+                    "weightedConfidence needs a ScoreDistribution on "
+                    "every leaf"
+                )
+            for sd in leaf.score_distribution:
+                if sd.value not in labels:
+                    labels.append(sd.value)
+        for leaf in leaves:
+            # a leaf's score attribute may legally be absent from every
+            # distribution; it still names a class (confidence 0)
+            if leaf.score is not None and leaf.score not in labels:
+                labels.append(leaf.score)
+        conf = np.zeros((len(leaves), len(labels)), np.float32)
+        # the leaf's score attribute is the DETERMINISTIC-path winner
+        # (it may legally disagree with the max confidence); −1 = no
+        # score declared, fall back to the confidence argmax
+        leaf_label = np.full((len(leaves),), -1, np.int32)
+        for li, leaf in enumerate(leaves):
+            tot = sum(sd.record_count for sd in leaf.score_distribution)
+            for sd in leaf.score_distribution:
+                c = (
+                    sd.confidence
+                    if sd.confidence is not None
+                    else (sd.record_count / tot if tot > 0 else 0.0)
+                )
+                conf[li, labels.index(sd.value)] = c
+            if leaf.score is not None and leaf.score in labels:
+                leaf_label[li] = labels.index(leaf.score)
+        return leaves, tuple(labels), (conf, leaf_label)
+    vals = np.zeros((len(leaves),), np.float32)
+    for li, leaf in enumerate(leaves):
+        if leaf.score is None:
+            raise ModelCompilationException(
+                "aggregateNodes needs a score on every leaf"
+            )
+        try:
+            vals[li] = float(leaf.score)
+        except ValueError:
+            raise ModelCompilationException(
+                f"aggregateNodes leaf score {leaf.score!r} is not numeric"
+            ) from None
+    return leaves, (), vals
+
+
+def lower_weighted_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
+    strategy = model.missing_value_strategy
+    classification = model.function_name == "classification"
+    if strategy == "weightedConfidence" and not classification:
+        raise ModelCompilationException(
+            "weightedConfidence applies to classification trees"
+        )
+    if strategy == "aggregateNodes" and classification:
+        raise ModelCompilationException(
+            "aggregateNodes applies to regression trees"
+        )
+    leaves, labels, payload = _leaf_payload(model)
+    if classification:
+        payload, leaf_label = payload
+    leaf_index = {id(leaf): i for i, leaf in enumerate(leaves)}
+    root_pred = lower_predicate(model.root.predicate, ctx)
+
+    # node → lowered child predicates + recordCount shares, fixed at
+    # compile; the per-record weight propagation runs at trace time
+    def prep(n: ir.TreeNode):
+        preds = [lower_predicate(c.predicate, ctx) for c in n.children]
+        rcs = []
+        for c in n.children:
+            if c.record_count is None:
+                raise ModelCompilationException(
+                    f"{strategy} needs recordCount on every child node "
+                    f"(missing on node {c.node_id!r})"
+                )
+            rcs.append(max(float(c.record_count), 0.0))
+        return preds, np.asarray(rcs, np.float32)
+
+    prepped: Dict[int, Tuple] = {}
+
+    def prewalk(n: ir.TreeNode):
+        if not n.is_leaf:
+            prepped[id(n)] = prep(n)
+            for c in n.children:
+                prewalk(c)
+
+    prewalk(model.root)
+    params: dict = {"payload": payload}
+    if classification:
+        params["leaf_label"] = leaf_label
+
+    def fn(p, X, M):
+        B = X.shape[0]
+        L = len(leaves)
+        leaf_w = [jnp.zeros((B,), jnp.float32) for _ in range(L)]
+
+        def walk(n: ir.TreeNode, w):
+            if n.is_leaf:
+                li = leaf_index[id(n)]
+                leaf_w[li] = leaf_w[li] + w
+                return
+            preds, rcs = prepped[id(n)]
+            outs = [pf(X, M) for pf in preds]
+            trues = [o.is_true for o in outs]
+            unknowns = [o.unknown for o in outs]
+            any_true = trues[0]
+            for t in trues[1:]:
+                any_true = any_true | t
+            # viable = not FALSE (true or unknown); the distribution
+            # denominator is data-dependent: Σ rc over viable children
+            viable = [t | u for t, u in zip(trues, unknowns)]
+            denom = jnp.zeros((B,), jnp.float32)
+            for v, rc in zip(viable, rcs):
+                denom = denom + v.astype(jnp.float32) * rc
+            seen_true = jnp.zeros((B,), bool)
+            for c, t, v, rc in zip(n.children, trues, viable, rcs):
+                first_true = t & ~seen_true
+                seen_true = seen_true | t
+                frac = jnp.where(
+                    any_true,
+                    first_true.astype(jnp.float32),
+                    jnp.where(
+                        denom > 0,
+                        v.astype(jnp.float32) * rc
+                        / jnp.maximum(denom, 1e-30),
+                        0.0,
+                    ),
+                )
+                walk(c, w * frac)
+
+        root_ok = root_pred(X, M).is_true
+        walk(model.root, root_ok.astype(jnp.float32))
+        W = jnp.stack(leaf_w, axis=1)  # [B, L]
+        total = jnp.sum(W, axis=1)
+        valid = total > 0
+        tz = jnp.maximum(total, 1e-30)[:, None]
+        if classification:
+            probs = jnp.matmul(W, p["payload"]) / tz  # [B, C]
+            lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
+            # deterministic path (all weight on one leaf): the leaf's
+            # score attribute wins, exactly like the boolean-path
+            # backends — it may legally disagree with the max confidence
+            wmax_leaf = jnp.argmax(W, axis=1)
+            det = (
+                jnp.take_along_axis(W, wmax_leaf[:, None], axis=1)[:, 0]
+                >= total - 1e-6
+            )
+            det_lab = jnp.take(p["leaf_label"], wmax_leaf)
+            lab = jnp.where(det & (det_lab >= 0), det_lab, lab).astype(
+                jnp.int32
+            )
+            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            return ModelOutput(
+                value=value.astype(jnp.float32),
+                valid=valid,
+                probs=probs.astype(jnp.float32),
+                label_idx=lab,
+            )
+        value = jnp.matmul(W, p["payload"][:, None])[:, 0] / tz[:, 0]
+        return ModelOutput(
+            value=value.astype(jnp.float32), valid=valid
+        )
+
+    return Lowered(fn=fn, params=params, labels=labels)

@@ -1,0 +1,7 @@
+"""Domain ADTs: predictions, control-stream messages, model identity.
+
+Mirrors the reference's ``…/models/`` package (SURVEY.md §3 rows B4, C2 —
+expected upstream ``flink-jpmml-scala/src/main/scala/io/radicalbit/flink/pmml/
+scala/models/`` [UNVERIFIED]); re-designed as frozen dataclasses instead of
+Scala sealed ADTs.
+"""

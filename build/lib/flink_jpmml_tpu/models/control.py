@@ -1,0 +1,53 @@
+"""Control-stream protocol for dynamic model serving (capability C6).
+
+Reference parity: ``ServingMessage`` / ``AddMessage`` / ``DelMessage`` in the
+reference's ``…/models/control/`` (SURVEY.md §3 row C2, §4.3 [UNVERIFIED]).
+A control stream of these messages is joined with the event stream; the
+registry applies them in timestamp order (see
+:mod:`flink_jpmml_tpu.serving.managers`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Union
+
+from flink_jpmml_tpu.models.core import ModelId
+
+
+@dataclass(frozen=True)
+class AddMessage:
+    """Start serving ``(name, version)`` from the PMML document at ``path``."""
+
+    name: str
+    version: int
+    path: str
+    timestamp: float
+
+    def __post_init__(self) -> None:
+        # Validate eagerly so a bad message fails at the producer, not later
+        # inside the registry apply step.
+        ModelId(self.name, self.version)
+
+    @property
+    def model_id(self) -> ModelId:
+        return ModelId(self.name, self.version)
+
+
+@dataclass(frozen=True)
+class DelMessage:
+    """Stop serving ``(name, version)``."""
+
+    name: str
+    version: int
+    timestamp: float
+
+    def __post_init__(self) -> None:
+        ModelId(self.name, self.version)
+
+    @property
+    def model_id(self) -> ModelId:
+        return ModelId(self.name, self.version)
+
+
+ServingMessage = Union[AddMessage, DelMessage]

@@ -1,0 +1,1 @@
+"""TPU compute kernels (Pallas) backing the hot lowering paths."""

@@ -1,0 +1,11 @@
+"""Device mesh, sharding, keyed partitioning (SURVEY.md section 8 step 4)."""
+
+from flink_jpmml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh  # noqa: F401
+from flink_jpmml_tpu.parallel.sharding import (  # noqa: F401
+    ShardedModel,
+    TpLinearScorer,
+    dp_sharded,
+    tp_linear,
+)
+from flink_jpmml_tpu.parallel.partitioner import HashPartitioner, stable_hash  # noqa: F401
+from flink_jpmml_tpu.parallel.distributed import global_batch, init_distributed  # noqa: F401

@@ -1,0 +1,98 @@
+"""Multi-host scaffolding: process group init + global batch assembly.
+
+Reference parity (SURVEY.md §3 row D1): the reference rode Flink's runtime —
+Akka/Pekko RPC control plane + Netty data plane. Our distributed substrate
+is ``jax.distributed`` (control plane / KV store) + XLA collectives compiled
+into the scoring graph (data plane): in-slice traffic rides ICI, cross-slice
+DCN, per the mesh axes. Nothing here speaks NCCL/MPI — the collectives are
+emitted by XLA from the shardings.
+
+Single-process (tests, one-host benches) everything degrades to no-ops.
+Multi-host flow per host:
+
+    init_distributed(coordinator, num_processes, process_id)
+    mesh = make_mesh(MeshConfig(data=jax.device_count(), model=1))
+    X_global = global_batch(mesh, X_local, M_local)  # per-host shard → global
+    out = sharded_model.predict(*X_global)
+
+Each host ingests and hash-partitions its own records
+(:mod:`flink_jpmml_tpu.parallel.partitioner`), builds the process-local
+slice of the global micro-batch, and `jax.make_array_from_process_local_data`
+stitches them into one global array without any host gathering the world.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flink_jpmml_tpu.parallel.mesh import DATA_AXIS
+
+_initialized = False
+
+# environment markers that mean "this process is part of a multi-host job"
+# and jax.distributed.initialize() can auto-detect its coordinates
+_MULTIHOST_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host process group (idempotent).
+
+    Explicit coordinates initialize directly. With no arguments, a
+    multi-host environment is auto-detected (TPU pod metadata / coordinator
+    env vars) and ``jax.distributed.initialize()`` runs in auto mode; a
+    plain single-process environment is a no-op returning False, so the
+    same code path runs one-host.
+    """
+    import os
+
+    global _initialized
+    if _initialized:
+        return True
+    if coordinator_address is None and num_processes is None:
+        if not any(v in os.environ for v in _MULTIHOST_ENV_VARS):
+            return False
+        jax.distributed.initialize()  # auto-detect from the environment
+        _initialized = True
+        return True
+    if num_processes == 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def global_batch(
+    mesh: Mesh, X_local: np.ndarray, M_local: np.ndarray
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-host local batch slices → one global batch-sharded array pair.
+
+    The global batch dimension is ``num_processes × local_batch``; each
+    host contributes its slice in process order. Host memory never holds
+    the global batch.
+    """
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    if jax.process_count() == 1:
+        return (
+            jax.device_put(X_local, sharding),
+            jax.device_put(M_local, sharding),
+        )
+    Xg = jax.make_array_from_process_local_data(sharding, X_local)
+    Mg = jax.make_array_from_process_local_data(sharding, M_local)
+    return Xg, Mg

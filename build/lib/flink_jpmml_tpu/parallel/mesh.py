@@ -1,0 +1,60 @@
+"""Device mesh construction (SURVEY.md §8 step 4).
+
+The reference's only parallelism is Flink data parallelism — N operator
+subtasks with replicated models (SURVEY.md §3 P1). Our equivalent is a JAX
+``Mesh`` over the TPU slice with two named axes:
+
+- ``data``:  batch sharding (DP) — each device scores a slice of the
+  micro-batch with replicated params; the padding batcher guarantees the
+  batch divides evenly.
+- ``model``: feature sharding (1-D TP) for wide linear/NN models
+  (BASELINE config 5's 10k-dim sparse scorer) — weight columns split across
+  devices, partials combined with ``psum`` over ICI.
+
+``data × model`` must cover the devices exactly; the default is all-DP.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from flink_jpmml_tpu.utils.config import MeshConfig
+from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    allow_subset: bool = False,
+) -> Mesh:
+    """Build the ``data × model`` mesh.
+
+    ``data * model`` must equal the device count exactly — silently idling
+    devices is a throughput bug, not a convenience; pass ``allow_subset=True``
+    (or an explicit ``devices`` slice) to opt into a partial mesh.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if config is None:
+        # all-DP over every visible device
+        config = MeshConfig(data=len(devs), model=1)
+    need = config.data * config.model
+    if need > len(devs):
+        raise FlinkJpmmlTpuError(
+            f"mesh {config.data}x{config.model} needs {need} devices, "
+            f"only {len(devs)} visible"
+        )
+    if need < len(devs) and not allow_subset:
+        raise FlinkJpmmlTpuError(
+            f"mesh {config.data}x{config.model} covers {need} of "
+            f"{len(devs)} devices — the rest would sit idle; pass "
+            "allow_subset=True (or an explicit devices list) if intentional"
+        )
+    grid = np.asarray(devs[:need]).reshape(config.data, config.model)
+    return Mesh(grid, axis_names=(DATA_AXIS, MODEL_AXIS))

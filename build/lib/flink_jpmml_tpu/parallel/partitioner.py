@@ -1,0 +1,74 @@
+"""Keyed routing: host-side hash partitioning of records to worker lanes.
+
+Reference parity (SURVEY.md §3 P2): Flink's ``keyBy`` hash-partitions the
+stream over the network so all records with one key land on one subtask.
+Our records don't cross a network for in-slice scaling (the mesh scores a
+global batch), but keyed routing is still load-bearing for:
+
+- multi-host ingestion: records hash to (host, pipeline) lanes over DCN;
+- per-key ordering: all records of a key flow through one lane in order;
+- the dynamic scorer's model routing (a special case with key = model id).
+
+The hash is deterministic across processes and runs (stable across restarts
+— required for resume parity), unlike Python's seeded ``hash()``.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Callable, List, Sequence
+
+KeyFn = Callable[[Any], Any]
+
+
+def stable_hash(key: Any) -> int:
+    """Deterministic 32-bit hash of a key (str/bytes/int/float/tuple)."""
+    if isinstance(key, bool):
+        data = b"b1" if key else b"b0"
+    elif isinstance(key, int):
+        # arbitrary-precision: length-prefix the minimal two's-complement
+        # encoding (UUID-sized ints must not overflow a fixed width)
+        nbytes = (key.bit_length() + 8) // 8 or 1
+        data = b"i" + key.to_bytes(nbytes, "little", signed=True)
+    elif isinstance(key, float):
+        import struct
+
+        data = struct.pack("<d", key)
+    elif isinstance(key, bytes):
+        data = key
+    elif isinstance(key, tuple):
+        h = 0x12345678
+        for part in key:
+            h = zlib.crc32(stable_hash(part).to_bytes(4, "little"), h)
+        return h
+    else:
+        data = str(key).encode("utf-8")
+    return zlib.crc32(data)
+
+
+class HashPartitioner:
+    """Assigns records to ``n_lanes`` by stable key hash (Flink keyBy
+    parity). ``partition`` returns per-record lane ids; ``split`` groups a
+    batch into per-lane lists preserving intra-lane order."""
+
+    def __init__(self, n_lanes: int, key_fn: KeyFn = lambda r: r):
+        if n_lanes <= 0:
+            raise ValueError(f"n_lanes must be > 0: {n_lanes}")
+        self._n = n_lanes
+        self._key_fn = key_fn
+
+    @property
+    def n_lanes(self) -> int:
+        return self._n
+
+    def lane(self, record: Any) -> int:
+        return stable_hash(self._key_fn(record)) % self._n
+
+    def partition(self, records: Sequence[Any]) -> List[int]:
+        return [self.lane(r) for r in records]
+
+    def split(self, records: Sequence[Any]) -> List[List[Any]]:
+        lanes: List[List[Any]] = [[] for _ in range(self._n)]
+        for r in records:
+            lanes[self.lane(r)].append(r)
+        return lanes

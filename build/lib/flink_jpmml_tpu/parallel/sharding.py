@@ -1,0 +1,198 @@
+"""Sharded scoring: DP over the batch axis, 1-D TP over wide feature dims.
+
+Reference parity (SURVEY.md §3 P1–P3): Flink ran N subtasks each holding a
+model copy; here one jitted computation spans the mesh —
+
+- :func:`dp_sharded` re-jits any :class:`CompiledModel` with the micro-batch
+  sharded over the ``data`` axis and params replicated. XLA partitions the
+  whole scoring graph; no collectives are needed on the forward path (the
+  batch axis is embarrassingly parallel), so scaling rides ICI bandwidth
+  only for the input scatter / output gather.
+- :func:`tp_linear` is the building block for BASELINE config 5: a wide
+  linear transform whose feature dimension is sharded over the ``model``
+  axis via ``shard_map`` — each device holds a column-slice of W and a
+  feature-slice of X, computes a partial matmul, and ``psum`` combines
+  partials over ICI (the scaling-book 1-D tensor-parallel recipe).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flink_jpmml_tpu.compile.common import HIGHEST, ModelOutput
+from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from flink_jpmml_tpu.utils.exceptions import InputValidationException
+
+
+@dataclass
+class ShardedModel:
+    """A CompiledModel re-jitted for a mesh: same predict contract, batch
+    sharded over ``data``, params replicated."""
+
+    base: CompiledModel
+    mesh: Mesh
+    _jit_fn: object
+    _params_sharded: object
+
+    @property
+    def batch_divisor(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    def predict(self, X, M) -> ModelOutput:
+        if X.shape[0] % self.batch_divisor != 0:
+            raise InputValidationException(
+                f"sharded batch {X.shape[0]} must divide by the data-axis "
+                f"size {self.batch_divisor} (pad the micro-batch)"
+            )
+        return self._jit_fn(self._params_sharded, X, M)
+
+    def decode(self, out: ModelOutput, n: Optional[int] = None):
+        return self.base.decode(out, n)
+
+    @property
+    def field_space(self):
+        return self.base.field_space
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+    @property
+    def labels(self):
+        return self.base.labels
+
+    @property
+    def is_classification(self):
+        return self.base.is_classification
+
+
+def dp_sharded(model: CompiledModel, mesh: Mesh) -> ShardedModel:
+    """Batch-data-parallel scoring over the mesh (replicated params).
+
+    The inner jitted fn is re-wrapped with NamedShardings; XLA SPMD-
+    partitions the traced graph — the einsum/matmul lowerings are untouched.
+    """
+    batch_spec = NamedSharding(mesh, P(DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    def _replicate(x):
+        # make_array_from_callback works when the mesh spans processes
+        # (device_put cannot target non-addressable devices); every host
+        # holds the full params, so any index slice is servable locally
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, repl, lambda idx: arr[idx]
+        )
+
+    params_sharded = jax.tree_util.tree_map(_replicate, model.params)
+    inner = model._jit_fn  # the jitted full_fn(params, X, M)
+    fn = getattr(inner, "__wrapped__", inner)
+    jit_fn = jax.jit(
+        fn,
+        in_shardings=(
+            jax.tree_util.tree_map(lambda _: repl, model.params),
+            batch_spec,
+            batch_spec,
+        ),
+        out_shardings=batch_spec,
+    )
+    return ShardedModel(
+        base=model, mesh=mesh, _jit_fn=jit_fn, _params_sharded=params_sharded
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1-D tensor parallelism for wide linear models (config 5)
+# ---------------------------------------------------------------------------
+
+
+def tp_linear(
+    mesh: Mesh,
+    n_features: int,
+    n_outputs: int,
+):
+    """→ fn(W [F,C] , b [C], X [B,F]) -> [B,C], feature dim sharded.
+
+    ``shard_map`` over the mesh: X is sharded (data: batch, model: feature),
+    W is sharded (model: feature rows); each device computes its partial
+    ``x_shard @ w_shard`` and the partials are ``psum``-reduced over the
+    ``model`` axis (ICI); the result is batch-sharded, feature-replicated —
+    ready for the next (replicated) pipeline stage.
+    """
+    n_model = mesh.shape[MODEL_AXIS]
+    if n_features % n_model != 0:
+        raise InputValidationException(
+            f"feature dim {n_features} must divide by model-axis size "
+            f"{n_model} (pad the feature space)"
+        )
+
+    def _partial_matmul(W, b, X):
+        part = jnp.dot(X, W, precision=HIGHEST)
+        full = jax.lax.psum(part, MODEL_AXIS)
+        return full + b
+
+    fn = jax.shard_map(
+        _partial_matmul,
+        mesh=mesh,
+        in_specs=(
+            P(MODEL_AXIS, None),  # W: feature rows sharded
+            P(),  # b: replicated
+            P(DATA_AXIS, MODEL_AXIS),  # X: batch × feature sharded
+        ),
+        out_specs=P(DATA_AXIS, None),
+    )
+    return fn
+
+
+@dataclass
+class TpLinearScorer:
+    """A feature-sharded logistic/linear scorer for very wide models
+    (BASELINE config 5's 10k-dim sparse LR): ``sigmoid(X @ W + b)`` with W's
+    feature dimension split over the ``model`` axis."""
+
+    mesh: Mesh
+    W: np.ndarray  # [F, C]
+    b: np.ndarray  # [C]
+    link: str = "logit"  # logit | identity | softmax
+
+    def __post_init__(self):
+        from flink_jpmml_tpu.compile.regression import softmax
+
+        F, C = self.W.shape
+        matmul = tp_linear(self.mesh, F, C)
+        link = self.link
+
+        def fn(W, b, X):
+            y = matmul(W, b, X)
+            if link == "logit":
+                return 1.0 / (1.0 + jnp.exp(-y))
+            if link == "softmax":
+                return softmax(y)
+            return y
+
+        self._jit_fn = jax.jit(fn)
+        wspec = NamedSharding(self.mesh, P(MODEL_AXIS, None))
+        self._W = jax.device_put(self.W, wspec)
+        self._b = jax.device_put(self.b, NamedSharding(self.mesh, P()))
+
+    def predict(self, X) -> jnp.ndarray:
+        n_data = self.mesh.shape[DATA_AXIS]
+        if X.ndim != 2 or X.shape[1] != self.W.shape[0]:
+            raise InputValidationException(
+                f"input shape {getattr(X, 'shape', None)} != "
+                f"[batch, {self.W.shape[0]}]"
+            )
+        if X.shape[0] % n_data != 0:
+            raise InputValidationException(
+                f"sharded batch {X.shape[0]} must divide by the data-axis "
+                f"size {n_data} (pad the micro-batch)"
+            )
+        return self._jit_fn(self._W, self._b, X)

@@ -1,0 +1,12 @@
+"""PMML 4.x ingestion: XML parsing, typed IR, reference interpreter.
+
+This package replaces the reference's EXT-B substrate (``jpmml-model`` JAXB
+tree + part of JPMML-Evaluator; SURVEY.md §2 layer EXT-B) with an in-tree
+parser producing a typed IR that the :mod:`flink_jpmml_tpu.compile` package
+lowers to JAX. The :mod:`flink_jpmml_tpu.pmml.interp` module is a slow,
+per-record reference interpreter used as the semantic oracle in golden tests
+(standing in for JPMML-Evaluator, which is JVM-only).
+"""
+
+from flink_jpmml_tpu.pmml.parser import parse_pmml, parse_pmml_file  # noqa: F401
+from flink_jpmml_tpu.pmml.ir import PmmlDocument  # noqa: F401

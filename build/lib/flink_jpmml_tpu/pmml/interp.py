@@ -265,13 +265,6 @@ def evaluate(doc: ir.PmmlDocument, record: Record) -> EvalResult:
             reason_codes=res.reason_codes,
             # association: the fired-rule ranking feeds ruleValue fields
             rule_ranking=res.rule_ranking,
-            # clustering surfaces per-entity comparison scores (its
-            # probabilities mapping holds distances/similarities)
-            entity_scores=(
-                res.probabilities
-                if isinstance(doc.model, ir.ClusteringModelIR)
-                else None
-            ),
         )
     return res
 

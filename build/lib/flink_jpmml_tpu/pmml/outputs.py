@@ -59,11 +59,6 @@ def validate_output_fields(
                 f"unsupported OutputField feature {of.feature!r} "
                 f"(supported: {', '.join(_FEATURES)})"
             )
-        if of.feature in ("entityId", "affinity") and of.rank != 1:
-            raise ModelCompilationException(
-                f"OutputField {of.name!r}: rank-k {of.feature} is not "
-                "supported (rank must be 1)"
-            )
         if of.feature == "ruleValue" and of.rule_feature not in _RULE_FEATURES:
             raise ModelCompilationException(
                 f"unsupported ruleFeature {of.rule_feature!r} "
@@ -89,18 +84,13 @@ def compute_outputs(
     probabilities: Optional[Mapping[str, float]],
     reason_codes: Optional[Sequence[str]] = None,
     rule_ranking: Optional[Sequence[Mapping[str, object]]] = None,
-    entity_scores: Optional[Mapping[str, float]] = None,
 ) -> Dict[str, object]:
     """One record's model result → its <Output> field values, in
     declaration order (later transformedValues see earlier outputs).
     ``reason_codes`` is the scorecard's ranked worst-first list (rank
     attribute is 1-based; out-of-range → None). ``rule_ranking`` is the
     association fired-rule metadata best-first; a ruleValue field's
-    ``rank`` indexes it the same way. ``entity_scores`` is the
-    per-entity comparison-score mapping for families that surface one
-    (clustering distances/similarities); entityId/affinity read it and
-    yield None elsewhere — a class-probability map is NOT a comparison
-    score and must not leak through affinity."""
+    ``rank`` indexes it the same way."""
     from flink_jpmml_tpu.pmml.interp import eval_expression
 
     probs = probabilities or {}
@@ -113,23 +103,15 @@ def compute_outputs(
             key = of.target_value if of.target_value is not None else label
             out[of.name] = probs.get(key) if key is not None else None
         elif of.feature == "entityId":
-            # the winning entity's identifier, only where the family
-            # surfaces entities (clustering: the cluster id)
-            out[of.name] = label if entity_scores is not None else None
+            # the winning entity's identifier: cluster id / class label /
+            # nearest-neighbor target — the decoded label in every family
+            out[of.name] = label
         elif of.feature == "affinity":
             # the requested entity's comparison score (the ``value``
-            # attribute picks one; absent = the winner's)
-            if entity_scores is None:
-                out[of.name] = None
-            else:
-                key = (
-                    of.target_value
-                    if of.target_value is not None
-                    else label
-                )
-                out[of.name] = (
-                    entity_scores.get(key) if key is not None else None
-                )
+            # attribute picks one; absent = the winner's) from the
+            # per-entity score mapping, where the family surfaces one
+            key = of.target_value if of.target_value is not None else label
+            out[of.name] = probs.get(key) if key is not None else None
         elif of.feature == "reasonCode":
             out[of.name] = (
                 rcs[of.rank - 1] if 0 < of.rank <= len(rcs) else None

@@ -1,0 +1,573 @@
+"""Block pipeline: the high-throughput vector path (≥1M rec/s).
+
+The record-object :class:`~flink_jpmml_tpu.runtime.engine.Pipeline` is
+flexible but pays Python-object costs per record — fine for thousands of
+records/sec, fatal for millions. On this path records are contiguous
+float32 *blocks* end to end:
+
+    BlockSource.poll() → [n, F] numpy block
+      → C++ ring (native.NativeRing; Python fallback)  ← backpressure
+      → fill-or-deadline drain into a reused batch buffer
+      → pad → jitted scoring (async dispatch, in-flight window)
+      → sink(outputs)
+
+No Python object per record exists anywhere; the only per-batch host work
+is one memcpy into the ring and one out. This is the "no CPU evaluator in
+the hot path" half of the BASELINE north star made concrete on the host
+side.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from flink_jpmml_tpu.compile import prepare
+from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
+from flink_jpmml_tpu.utils.config import RuntimeConfig
+from flink_jpmml_tpu.utils.exceptions import InputValidationException
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+
+class BlockSource:
+    """poll() → (first_offset, block [n,F]) or None when drained/starved."""
+
+    def poll(self) -> Optional[Tuple[int, np.ndarray]]:
+        raise NotImplementedError
+
+    def seek(self, offset: int) -> None:
+        """Resume hook: next poll starts at this record offset."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support offset seek/resume"
+        )
+
+    @property
+    def exhausted(self) -> bool:
+        return False
+
+
+class CyclingBlockSource(BlockSource):
+    """Cycles over a fixed dataset in blocks forever (bench/load-gen)."""
+
+    def __init__(self, data: np.ndarray, block_size: int):
+        self._data = np.ascontiguousarray(data, np.float32)
+        self._block = block_size
+        self._pos = 0
+        self._offset = 0
+
+    def poll(self):
+        n = self._data.shape[0]
+        if self._pos + self._block <= n:
+            blk = self._data[self._pos : self._pos + self._block]
+            self._pos += self._block
+        else:
+            a = self._data[self._pos :]
+            b = self._data[: self._block - a.shape[0]]
+            blk = np.concatenate([a, b], axis=0)
+            self._pos = self._block - a.shape[0]
+        off = self._offset
+        self._offset += blk.shape[0]
+        return off, blk
+
+    def seek(self, offset: int) -> None:
+        self._offset = offset
+        self._pos = offset % self._data.shape[0]
+
+
+class FiniteBlockSource(BlockSource):
+    def __init__(self, data: np.ndarray, block_size: int):
+        self._data = np.ascontiguousarray(data, np.float32)
+        self._block = block_size
+        self._pos = 0
+
+    def poll(self):
+        if self._pos >= self._data.shape[0]:
+            return None
+        blk = self._data[self._pos : self._pos + self._block]
+        off = self._pos
+        self._pos += blk.shape[0]
+        return off, blk
+
+    def seek(self, offset: int) -> None:
+        self._pos = offset
+
+    @property
+    def exhausted(self) -> bool:
+        return self._pos >= self._data.shape[0]
+
+
+class _PyRing:
+    """Pure-Python fallback with the NativeRing interface (chunk list +
+    condition variables; same fill-or-deadline semantics, more GIL)."""
+
+    def __init__(self, capacity: int, arity: int, batch_size: int):
+        self._cap = capacity
+        self._arity = arity
+        self._chunks: List[Tuple[int, np.ndarray]] = []
+        self._count = 0
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        self._batch = np.zeros((batch_size, arity), np.float32)
+        self._offsets = np.zeros((batch_size,), np.uint64)
+
+    def push_block(self, block, first_offset, timeout_us=-1) -> int:
+        block = np.ascontiguousarray(block, np.float32)
+        pushed = 0
+        deadline = (
+            None if timeout_us < 0 else time.monotonic() + timeout_us / 1e6
+        )
+        with self._not_full:
+            while pushed < block.shape[0]:
+                while self._count >= self._cap and not self._closed:
+                    remaining = (
+                        None if deadline is None else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        return pushed
+                    self._not_full.wait(remaining if remaining else 0.1)
+                if self._closed:
+                    return pushed
+                room = self._cap - self._count
+                take = min(room, block.shape[0] - pushed)
+                self._chunks.append(
+                    (first_offset + pushed, block[pushed : pushed + take])
+                )
+                self._count += take
+                pushed += take
+                self._not_empty.notify()
+        return pushed
+
+    def drain(self, deadline_us: int, idle_timeout_us: int = -1):
+        with self._not_empty:
+            idle_deadline = (
+                None
+                if idle_timeout_us < 0
+                else time.monotonic() + idle_timeout_us / 1e6
+            )
+            while self._count == 0:
+                if self._closed:
+                    return self._batch[:0], self._offsets[:0]
+                if idle_deadline is None:
+                    self._not_empty.wait(0.1)
+                else:
+                    remaining = idle_deadline - time.monotonic()
+                    if remaining <= 0:
+                        # idle bound: empty return on an open ring lets
+                        # the consumer run control-plane work
+                        return self._batch[:0], self._offsets[:0]
+                    self._not_empty.wait(min(remaining, 0.1))
+            deadline = time.monotonic() + deadline_us / 1e6
+            drained = 0
+            max_n = self._batch.shape[0]
+            while drained < max_n:
+                while self._chunks and drained < max_n:
+                    off, chunk = self._chunks[0]
+                    take = min(chunk.shape[0], max_n - drained)
+                    self._batch[drained : drained + take] = chunk[:take]
+                    self._offsets[drained : drained + take] = np.arange(
+                        off, off + take, dtype=np.uint64
+                    )
+                    if take == chunk.shape[0]:
+                        self._chunks.pop(0)
+                    else:
+                        self._chunks[0] = (off + take, chunk[take:])
+                    self._count -= take
+                    drained += take
+                    self._not_full.notify_all()
+                if drained >= max_n or self._closed:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._not_empty.wait(remaining)
+            return self._batch[:drained], self._offsets[:drained]
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    @property
+    def closed(self):
+        with self._lock:
+            return self._closed
+
+    def __len__(self):
+        with self._lock:
+            return self._count
+
+
+def make_ring(capacity: int, arity: int, batch_size: int, native: bool = True):
+    """NativeRing when the C++ plane builds; _PyRing otherwise."""
+    if native:
+        from flink_jpmml_tpu.runtime import native as native_mod
+
+        if native_mod.available():
+            return native_mod.NativeRing(capacity, arity, batch_size)
+    return _PyRing(capacity, arity, batch_size)
+
+
+class BoundScorer:
+    """One servable compiled model bound for block scoring: its (maybe)
+    rank-wire scorer, the ``rank_wire_*``/``f32`` backend tag, and the
+    decode callable (carrying ``model_key``) handed to dynamic sinks.
+    Shared by the static and dynamic pipelines so the probe/backend/
+    decode logic cannot diverge between them."""
+
+    def __init__(self, key: str, model, use_quantized: bool):
+        self.key = key
+        self.model = model
+        probe = getattr(model, "quantized_scorer", None)
+        self.q = probe() if (use_quantized and probe is not None) else None
+        self.backend = (
+            f"rank_wire_{self.q.backend}" if self.q is not None else "f32"
+        )
+
+        def decode(out, n):
+            if self.q is not None:
+                return self.q.decode(out, n)
+            return self.model.decode(out, n)
+
+        decode.model_key = key
+        self.decode = decode
+
+
+class BlockPipelineBase:
+    """Shared machinery of the static and dynamic block pipelines:
+    ingest→ring, lifecycle (start/stop/join/run_*), the ``_drain_all``
+    stop protocol, and the score loop skeleton. Subclass hooks:
+
+    - ``_acquire(finish_one)`` → per-batch scoring handle (or None to
+      abandon the loop — the dynamic pipeline's bounded registry-gap
+      give-up); called with a drained batch pending, between batches.
+    - ``_dispatch(handle, X, n)`` → ``(raw_out, decode_or_None)``, the
+      async device dispatch.
+    - ``_emit(out, n, first_off, decode)`` → deliver to the sink.
+    - ``_on_idle()`` — called when the ring drain returns empty on an
+      open ring; reachable only when ``_IDLE_WAIT_US >= 0`` bounds the
+      drain's wait for a first record (the dynamic pipeline sets it so
+      Add/Del messages apply promptly on an idle stream).
+    """
+
+    _THREAD_TAG = "blk"
+    _IDLE_WAIT_US = -1  # block indefinitely for the first record
+
+    def __init__(
+        self,
+        source: BlockSource,
+        sink: Callable,
+        arity: int,
+        batch_size: int,
+        config: Optional[RuntimeConfig],
+        metrics: Optional[MetricsRegistry],
+        use_native: bool,
+        in_flight: int,
+        checkpoint,
+    ):
+        self._source = source
+        self._sink = sink
+        self._arity = arity
+        self._batch_size = batch_size
+        self._config = config or RuntimeConfig()
+        self.metrics = metrics or MetricsRegistry()
+        self._ring = make_ring(
+            self._config.batch.queue_capacity,
+            arity,
+            batch_size,
+            native=use_native,
+        )
+        self._in_flight_max = max(1, in_flight)
+        # see engine.Pipeline: True only for run_until_exhausted's full
+        # drain; plain stop() discards the uncommitted ring backlog so it
+        # returns promptly under a flooding source
+        self._drain_all = False
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._error: Optional[BaseException] = None
+        self.committed_offset = 0
+        self._ckpt = CheckpointPolicy(
+            checkpoint, self._config.checkpoint_interval_s
+        )
+
+    @property
+    def native(self) -> bool:
+        return not isinstance(self._ring, _PyRing)
+
+    def _ckpt_state(self) -> dict:
+        return {"source_offset": self.committed_offset}
+
+    def restore(self) -> bool:
+        """Resume from the latest checkpoint: seek the source to the last
+        committed record offset (commit happens after sink, C7)."""
+        state = self._ckpt.restore_latest()
+        if state is None:
+            return False
+        off = int(state.get("source_offset", 0))
+        self._source.seek(off)
+        self.committed_offset = off
+        self._restore_extra(state)
+        return True
+
+    def _restore_extra(self, state: dict) -> None:
+        pass
+
+    def start(self):
+        t1 = threading.Thread(
+            target=self._ingest,
+            name=f"fjt-{self._THREAD_TAG}-ingest",
+            daemon=True,
+        )
+        t2 = threading.Thread(
+            target=self._score,
+            name=f"fjt-{self._THREAD_TAG}-score",
+            daemon=True,
+        )
+        self._threads = [t1, t2]
+        t1.start()
+        t2.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._ring.close()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        for t in self._threads:
+            t.join(timeout)
+        if self._error is not None:
+            raise self._error
+
+    def run_for(self, seconds: float) -> None:
+        self.start()
+        time.sleep(seconds)
+        self.stop()
+        self.join(timeout=30.0)
+
+    def run_until_exhausted(self, timeout: float = 60.0) -> None:
+        """Deterministic drain: join the ingest thread (exits once the
+        source is exhausted and fully pushed), then close the ring — the
+        score loop drains the ring's remainder plus its in-flight window
+        before exiting. No sleep-based settle windows."""
+        self.start()
+        deadline = time.monotonic() + timeout
+        ingest = self._threads[0]
+        while ingest.is_alive() and self._error is None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            ingest.join(timeout=min(remaining, 0.05))
+        self._drain_all = True
+        self.stop()
+        self.join(timeout=max(30.0, deadline - time.monotonic()))
+
+    # -- subclass hooks ----------------------------------------------------
+
+    def _acquire(self, finish_one):
+        raise NotImplementedError
+
+    def _dispatch(self, handle, X, n):
+        raise NotImplementedError
+
+    def _emit(self, out, n, first_off, decode) -> None:
+        self._sink(out, n, first_off)
+
+    def _on_idle(self) -> None:
+        pass
+
+    def _dispatch_bound(self, bound: "BoundScorer", X, n):
+        """Shared async dispatch through a :class:`BoundScorer` — the
+        rank wire when eligible (the bucketizer folds NaN→missing during
+        encoding: no separate host-side NaN pass, no f32 mask plane),
+        the f32 path otherwise."""
+        if bound.q is not None:
+            Xq = bound.q.wire.encode(X)
+            return bound.q.predict_wire(Xq)  # async dispatch
+        return self._score_f32(bound.model, X, n)
+
+    def _score_f32(self, model, X, n):
+        """Shared f32 fallback dispatch: NaN cells are the missing
+        convention on this path; one isnan pass builds the mask (any()
+        on bools is cheap), not a scan-then-rescan."""
+        B = model.batch_size
+        Mb = np.isnan(X)
+        if Mb.any():
+            Xb = np.where(Mb, 0.0, X).astype(np.float32)
+        else:
+            Xb, Mb = X, _ZEROS_M.get(n, self._arity)
+        if n < B:
+            Xb, Mb, _ = prepare.pad_batch(Xb, Mb, B)
+        return model.predict(Xb, Mb)  # async dispatch
+
+    # -- internals ---------------------------------------------------------
+
+    def _ingest(self) -> None:
+        records_in = self.metrics.counter("records_in")
+        try:
+            while not self._stop.is_set():
+                polled = self._source.poll()
+                if polled is None:
+                    if self._source.exhausted:
+                        return
+                    time.sleep(0.0005)
+                    continue
+                off, block = polled
+                pushed = 0
+                while pushed < block.shape[0] and not self._stop.is_set():
+                    pushed += self._ring.push_block(
+                        block[pushed:], off + pushed, timeout_us=100_000
+                    )
+                records_in.inc(block.shape[0])
+        except BaseException as e:
+            self._error = e
+            self._stop.set()
+
+    def _score(self) -> None:
+        batch_cfg = self._config.batch
+        records_out = self.metrics.counter("records_out")
+        batches = self.metrics.counter("batches")
+        fill = self.metrics.counter("batch_fill_records")
+        lat = self.metrics.reservoir("batch_latency_s")
+        in_flight: List[Tuple] = []
+
+        def _finish_one():
+            out, n, first_off, t_start, decode = in_flight.pop(0)
+            self._emit(out, n, first_off, decode)
+            lat.observe(time.monotonic() - t_start)
+            records_out.inc(n)
+            self.committed_offset = first_off + n
+            self._ckpt.maybe_save(self._ckpt_state)
+
+        def _drain_inflight_one():
+            """Safe for hooks: finish the oldest in-flight batch if any."""
+            if in_flight:
+                _finish_one()
+
+        try:
+            while True:
+                if self._stop.is_set() and not self._drain_all:
+                    break  # stop(): skip the uncommitted backlog
+                # with work in flight the first-record wait must be
+                # bounded: an indefinitely-blocked drain on a paused
+                # feed would pin completed batches uncommitted (and
+                # their offsets unsaved) until new data arrives
+                idle_us = (
+                    min(batch_cfg.deadline_us, 20_000)
+                    if in_flight and self._IDLE_WAIT_US < 0
+                    else self._IDLE_WAIT_US
+                )
+                X, offsets = self._ring.drain(
+                    batch_cfg.deadline_us, idle_us
+                )
+                n = X.shape[0]
+                if n == 0:
+                    if self._ring.closed:
+                        break
+                    # idle stream: the in-flight window would otherwise
+                    # hold completed batches uncommitted until NEW data
+                    # arrives — unbounded tail latency (and a stuck
+                    # committed_offset) on a paused feed. Flush it.
+                    while in_flight:
+                        _finish_one()
+                    self._on_idle()
+                    continue
+                handle = self._acquire(_drain_inflight_one)
+                if handle is None:
+                    return  # abandoned (records replay from the
+                    # committed offset on restore)
+                t_start = time.monotonic()
+                out, decode = self._dispatch(handle, X, n)
+                in_flight.append(
+                    (out, n, int(offsets[0]) if n else 0, t_start, decode)
+                )
+                batches.inc()
+                fill.inc(n)
+                if len(in_flight) >= self._in_flight_max:
+                    _finish_one()
+            while in_flight:
+                _finish_one()
+            self._ckpt.save_now(self._ckpt_state)  # clean drain → exact resume
+        except BaseException as e:
+            self._error = e
+            self._stop.set()
+
+
+class BlockPipeline(BlockPipelineBase):
+    """source → ring → padded batches → async scoring → sink.
+
+    ``sink(out, n: int, first_offset: int)`` receives raw device outputs
+    (decode is the caller's choice — fetching to host costs a D2H transfer
+    per batch; use :meth:`decode` to turn one into ``Prediction``s). When
+    the model is rank-wire eligible (``use_quantized``, the default) the
+    scoring hop is the quantized path of compile/qtrees.py: the drained f32
+    block is encoded to threshold ranks by the multithreaded C++ bucketizer
+    and ``out`` is the QuantizedScorer output; otherwise ``out`` is a
+    :class:`ModelOutput` from the f32 path. ``backend`` says which engaged
+    and is also recorded in metrics as ``scorer_backend_*``.
+    """
+
+    def __init__(
+        self,
+        source: BlockSource,
+        model: CompiledModel,
+        sink: Callable,
+        config: Optional[RuntimeConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        use_native: bool = True,
+        in_flight: int = 2,
+        use_quantized: bool = True,
+        checkpoint=None,
+    ):
+        if model.batch_size is None:
+            raise InputValidationException(
+                "BlockPipeline needs a fixed-batch compiled model "
+                "(compile_pmml(batch_size=...))"
+            )
+        super().__init__(
+            source=source,
+            sink=sink,
+            arity=model.field_space.arity,
+            batch_size=model.batch_size,
+            config=config,
+            metrics=metrics,
+            use_native=use_native,
+            in_flight=in_flight,
+            checkpoint=checkpoint,
+        )
+        self._bound = BoundScorer("static", model, use_quantized)
+        self.backend = self._bound.backend
+        self.metrics.counter(f"scorer_backend_{self.backend}").inc()
+
+    def decode(self, out, n: int):
+        """Sink-received raw output → ``Prediction`` list (host-side)."""
+        return self._bound.decode(out, n)
+
+    def _acquire(self, finish_one):
+        return self._bound  # one static model: nothing to resolve
+
+    def _dispatch(self, bound, X, n):
+        return self._dispatch_bound(bound, X, n), None
+
+
+class _ZerosMCache:
+    """Reused all-False missing masks (avoid reallocating 256KB per batch)."""
+
+    def __init__(self):
+        self._cache = {}
+
+    def get(self, b: int, f: int) -> np.ndarray:
+        key = (b, f)
+        m = self._cache.get(key)
+        if m is None:
+            m = np.zeros((b, f), bool)
+            self._cache[key] = m
+        return m
+
+
+_ZEROS_M = _ZerosMCache()

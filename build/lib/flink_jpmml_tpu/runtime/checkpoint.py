@@ -1,0 +1,110 @@
+"""Checkpoint/resume for the streaming runtime (capability C7).
+
+The reference inherited checkpointing from Flink barriers and contributed
+only its operator state — the served-models map (SURVEY.md §6). Our runtime
+owns the whole mechanism, but the state is deliberately tiny and JSON-shaped:
+(source offsets, served-model registry, counters). Model *parameters* are
+never checkpointed — models reload from their PMML paths on resume, exactly
+like the reference's idempotent ``open()`` reload (capability C2).
+
+Atomicity: write to a temp file in the same directory, fsync, rename.
+Retention: the last ``keep`` checkpoints are kept for manual rollback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+from flink_jpmml_tpu.utils.exceptions import CheckpointException
+
+_PREFIX = "ckpt-"
+
+
+class CheckpointPolicy:
+    """Interval-gated save/restore shared by the record and block pipelines
+    (one implementation of the timing + enablement logic, so the two
+    engines cannot drift on checkpoint semantics)."""
+
+    def __init__(self, manager: Optional["CheckpointManager"],
+                 interval_s: float):
+        self._mgr = manager
+        self._interval = interval_s
+        self._last = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self._mgr is not None
+
+    def restore_latest(self) -> Optional[Dict[str, Any]]:
+        if self._mgr is None:
+            return None
+        return self._mgr.load_latest()
+
+    def maybe_save(self, state_fn) -> None:
+        if self._mgr is None:
+            return
+        if time.monotonic() - self._last >= self._interval:
+            self.save_now(state_fn)
+
+    def save_now(self, state_fn) -> None:
+        if self._mgr is None:
+            return
+        self._mgr.save(state_fn())
+        self._last = time.monotonic()
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3):
+        self._dir = directory
+        self._keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def save(self, state: Dict[str, Any]) -> str:
+        payload = {"timestamp": time.time(), "state": state}
+        try:
+            fd, tmp = tempfile.mkstemp(prefix=".tmp-", dir=self._dir)
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            path = os.path.join(self._dir, f"{_PREFIX}{int(time.time() * 1e6)}.json")
+            os.rename(tmp, path)
+        except OSError as e:
+            raise CheckpointException(f"cannot write checkpoint: {e}") from e
+        self._gc()
+        return path
+
+    def load_latest(self) -> Optional[Dict[str, Any]]:
+        ckpts = self._list()
+        if not ckpts:
+            return None
+        try:
+            with open(ckpts[-1], "r", encoding="utf-8") as f:
+                return json.load(f)["state"]
+        except (OSError, json.JSONDecodeError, KeyError) as e:
+            raise CheckpointException(
+                f"corrupt checkpoint {ckpts[-1]!r}: {e}"
+            ) from e
+
+    def _list(self):
+        try:
+            names = [
+                n
+                for n in os.listdir(self._dir)
+                if n.startswith(_PREFIX) and n.endswith(".json")
+            ]
+        except OSError as e:
+            raise CheckpointException(f"cannot list checkpoints: {e}") from e
+        return [os.path.join(self._dir, n) for n in sorted(names)]
+
+    def _gc(self) -> None:
+        ckpts = self._list()
+        for p in ckpts[: -self._keep]:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass

@@ -1,0 +1,325 @@
+"""The streaming micro-batch engine: source → batcher → device → sink.
+
+This replaces the reference's Flink operator runtime (SURVEY.md §4.1): where
+Flink called ``flatMap(event)`` per record on a CPU, we run a two-stage host
+pipeline per worker process:
+
+- **ingest thread**: polls the source, stamps each record with (offset,
+  enqueue-time), and puts it on a bounded queue (backpressure point).
+- **scoring loop**: drains fill-or-deadline micro-batches, converts them to
+  ``(X, M)`` tensors, dispatches the jitted scorer **asynchronously** (JAX
+  dispatch returns before the TPU finishes), and keeps a small in-flight
+  window so host prep of batch N+1 overlaps device execution of batch N.
+  Results are decoded and sunk in order; the source offset is committed only
+  after the batch is sunk (at-least-once on restart, like the reference's
+  Flink checkpoint semantics).
+
+Metrics (BASELINE §metrics): records/sec, p50/p99 per-record latency
+(enqueue→sink), batch fill ratio.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_jpmml_tpu.compile import prepare
+from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.models.prediction import Prediction
+from flink_jpmml_tpu.runtime.checkpoint import (
+    CheckpointManager,
+    CheckpointPolicy,
+)
+from flink_jpmml_tpu.runtime.queues import BoundedQueue, Closed
+from flink_jpmml_tpu.runtime.sinks import Sink
+from flink_jpmml_tpu.runtime.sources import Source
+from flink_jpmml_tpu.utils.config import RuntimeConfig
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+from flink_jpmml_tpu.utils.profiling import StageTimer
+
+
+@dataclass
+class _Stamped:
+    offset: int
+    record: Any
+    t_enq: float
+
+
+class Scorer:
+    """Strategy interface: turn a micro-batch of records into outputs.
+
+    ``submit`` must dispatch device work without blocking on it; ``finish``
+    blocks (device→host transfer + decode). Splitting the two lets the engine
+    overlap host batch-prep with device execution.
+    """
+
+    def submit(self, records: Sequence[Any]) -> Any:
+        raise NotImplementedError
+
+    def finish(self, ticket: Any) -> List[Any]:
+        raise NotImplementedError
+
+    def state(self) -> dict:
+        return {}
+
+    def restore(self, state: dict) -> None:
+        pass
+
+
+ExtractFn = Callable[[Sequence[Any]], Tuple[np.ndarray, np.ndarray]]
+EmitFn = Callable[[Sequence[Any], List[Prediction]], List[Any]]
+
+
+class StaticScorer(Scorer):
+    """Single fixed model (the reference's static ``evaluate``, C3).
+
+    ``extract`` turns raw records into an ``(X, M)`` pair — defaults to
+    dict-records via the model's field space; pass a custom one for event
+    objects or pre-stacked vectors. ``emit`` shapes the sink items — defaults
+    to bare ``Prediction``s; the quick-evaluate API uses
+    ``(prediction, record)`` pairs like the reference.
+    """
+
+    def __init__(
+        self,
+        model: CompiledModel,
+        extract: Optional[ExtractFn] = None,
+        emit: Optional[EmitFn] = None,
+        replace_nan: Optional[float] = None,
+        use_quantized: bool = True,
+    ):
+        self._model = model
+        self._replace_nan = replace_nan
+        self._extract = extract or self._extract_records
+        self._emit = emit or (lambda recs, preds: list(preds))
+        # rank-wire fast path (qtrees.py): ships uint8 threshold ranks
+        # instead of f32+mask when the model is an eligible tree ensemble.
+        # ShardedModel (parallel/sharding.py) has no quantized path; it
+        # scores through the same f32 predict contract.
+        probe = getattr(model, "quantized_scorer", None)
+        self._q = probe() if (use_quantized and probe is not None) else None
+        # which scoring backend this scorer engages (surfaced in the
+        # pipeline's metrics as scorer_backend_*)
+        self.backend = (
+            f"rank_wire_{self._q.backend}" if self._q is not None else "f32"
+        )
+
+    def _extract_records(self, records: Sequence[Any]):
+        first = records[0]
+        if isinstance(first, dict):
+            return prepare.from_records(self._model.field_space, records)
+        arr = np.asarray(records, np.float32)
+        return prepare.from_dense(
+            self._model.field_space, arr, self._replace_nan
+        )
+
+    def submit(self, records: Sequence[Any]):
+        X, M = self._extract(records)
+        n = X.shape[0]
+        if self._q is not None:
+            Xq = self._q.wire.encode(X, M)
+            # predict_wire owns batch-size alignment (padding / chunking)
+            out = self._q.predict_wire(Xq)  # async dispatch
+            return ("q", out, records, n)
+        if self._model.batch_size is not None:
+            X, M, _ = prepare.pad_batch(X, M, self._model.batch_size)
+        out = self._model.predict(X, M)  # async dispatch
+        return ("f", out, records, n)
+
+    def finish(self, ticket) -> List[Any]:
+        kind, out, records, n = ticket
+        if kind == "q":
+            preds = self._q.decode(out, n)  # blocks on device
+        else:
+            preds = self._model.decode(out, n)  # blocks on device
+        return self._emit(records, preds)
+
+
+class Pipeline:
+    """One worker's streaming loop. Thread-safe start/stop; join() drains."""
+
+    def __init__(
+        self,
+        source: Source,
+        scorer: Scorer,
+        sink: Sink,
+        config: Optional[RuntimeConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        checkpoint: Optional[CheckpointManager] = None,
+        in_flight: int = 2,
+    ):
+        self._source = source
+        self._scorer = scorer
+        self._sink = sink
+        self._config = config or RuntimeConfig()
+        self.metrics = metrics or MetricsRegistry()
+        backend = getattr(scorer, "backend", None)
+        if backend:
+            self.metrics.counter(f"scorer_backend_{backend}").inc()
+        self._ckpt = CheckpointPolicy(
+            checkpoint, self._config.checkpoint_interval_s
+        )
+        self._in_flight_max = max(1, in_flight)
+        self._queue = BoundedQueue(self._config.batch.queue_capacity)
+        self._stop = threading.Event()
+        # run_until_exhausted sets this: the score loop then consumes the
+        # whole queued backlog after close. A plain stop() leaves it False
+        # — queued-but-uncommitted records are discarded (they replay from
+        # the committed offset on restore), so stop() returns promptly
+        # even under a flooding source instead of draining for minutes
+        # and leaving a busy daemon thread behind at interpreter exit.
+        self._drain_all = False
+        self._ingest_thread: Optional[threading.Thread] = None
+        self._score_thread: Optional[threading.Thread] = None
+        self._committed_offset = 0
+        self._error: Optional[BaseException] = None
+
+    def _ckpt_state(self) -> dict:
+        return {
+            "source_offset": self._committed_offset,
+            "scorer": self._scorer.state(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def restore(self) -> bool:
+        """Resume from the latest checkpoint, if any (capability C7)."""
+        state = self._ckpt.restore_latest()
+        if state is None:
+            return False
+        self._source.seek(state.get("source_offset", 0))
+        self._committed_offset = state.get("source_offset", 0)
+        self._scorer.restore(state.get("scorer", {}))
+        return True
+
+    def start(self) -> "Pipeline":
+        self._ingest_thread = threading.Thread(
+            target=self._ingest_loop, name="fjt-ingest", daemon=True
+        )
+        self._score_thread = threading.Thread(
+            target=self._score_loop, name="fjt-score", daemon=True
+        )
+        self._ingest_thread.start()
+        self._score_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._queue.close()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._ingest_thread:
+            self._ingest_thread.join(timeout)
+        if self._score_thread:
+            self._score_thread.join(timeout)
+        if self._error is not None:
+            raise self._error
+
+    def run_until_exhausted(self, timeout: float = 60.0) -> None:
+        """Test/batch helper: process the whole (finite) source, then stop.
+
+        Deterministic drain (no sleep windows): the ingest thread exits on
+        its own once the source is exhausted and every record is enqueued;
+        only then is the queue closed. ``BoundedQueue.drain`` keeps serving
+        remaining items after close, so the score loop consumes everything
+        in the queue, then its in-flight window, then exits — zero records
+        can be lost regardless of how slow the scorer is.
+        """
+        self.start()
+        deadline = time.monotonic() + timeout
+        assert self._ingest_thread is not None
+        while self._ingest_thread.is_alive() and self._error is None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            self._ingest_thread.join(timeout=min(remaining, 0.05))
+        self._drain_all = True
+        self._stop.set()
+        self._queue.close()
+        self.join(timeout=max(10.0, deadline - time.monotonic()))
+
+    @property
+    def committed_offset(self) -> int:
+        return self._committed_offset
+
+    # -- internals ---------------------------------------------------------
+
+    def _ingest_loop(self) -> None:
+        records_in = self.metrics.counter("records_in")
+        try:
+            while not self._stop.is_set():
+                polled = self._source.poll(1024)
+                if not polled:
+                    if self._source.exhausted:
+                        return
+                    time.sleep(0.001)
+                    continue
+                now = time.monotonic()
+                for offset, rec in polled:
+                    while not self._stop.is_set():
+                        if self._queue.put(
+                            _Stamped(offset, rec, now), timeout=0.1
+                        ):
+                            break
+                records_in.inc(len(polled))
+        except Closed:
+            pass
+        except BaseException as e:  # surface ingestion failures to join()
+            self._error = e
+            self._stop.set()
+
+    def _score_loop(self) -> None:
+        batch_cfg = self._config.batch
+        records_out = self.metrics.counter("records_out")
+        batches = self.metrics.counter("batches")
+        fill = self.metrics.counter("batch_fill_records")
+        lat = self.metrics.reservoir("record_latency_s")
+        in_flight: List[Tuple[Any, List[_Stamped]]] = []
+
+        stages = StageTimer(self.metrics)
+
+        def _finish_one():
+            ticket, stamped = in_flight.pop(0)
+            with stages.stage("readback"):
+                outputs = self._scorer.finish(ticket)
+            with stages.stage("sink"):
+                self._sink.emit(outputs)
+            now = time.monotonic()
+            # sample a handful of lanes, not all (host-side cost control)
+            for s in stamped[:: max(1, len(stamped) // 8)]:
+                lat.observe(now - s.t_enq)
+            records_out.inc(len(stamped))
+            self._committed_offset = stamped[-1].offset
+            self._ckpt.maybe_save(self._ckpt_state)
+
+        try:
+            while True:
+                if self._stop.is_set() and not self._drain_all:
+                    break  # stop(): skip the uncommitted backlog
+                try:
+                    stamped = self._queue.drain(
+                        batch_cfg.size, batch_cfg.deadline_us
+                    )
+                except Closed:
+                    break
+                if not stamped:
+                    continue
+                with stages.stage("featurize_dispatch"):
+                    ticket = self._scorer.submit(
+                        [s.record for s in stamped]
+                    )
+                in_flight.append((ticket, stamped))
+                batches.inc()
+                fill.inc(len(stamped))
+                if len(in_flight) >= self._in_flight_max:
+                    _finish_one()
+            while in_flight:
+                _finish_one()
+            self._ckpt.save_now(self._ckpt_state)
+        except BaseException as e:
+            self._error = e
+            self._stop.set()

@@ -1,0 +1,830 @@
+"""Kafka wire-protocol streaming: real binary-protocol consumer + broker.
+
+BASELINE config 2 puts the north-star GBM on a "Kafka tabular stream"; the
+reference rode Flink's Kafka connector (SURVEY.md §2 EXT-A). Round 2
+shipped a bespoke framed-TCP stand-in (runtime/net.py, honest about not
+being Kafka). This module closes the wire-compatibility gap: a consumer
+speaking the actual Kafka binary protocol — ApiVersions v0, Metadata v1,
+ListOffsets v1, Fetch v4 with magic-v2 record batches (CRC32C, zigzag
+varints) — behind the same ``Source``/``BlockSource`` interfaces, plus an
+in-process ``MiniKafkaBroker`` serving the identical protocol for tests
+and kill/resume drills (the same pattern the FJT1 server plays for the
+bespoke protocol).
+
+Offset domain: Kafka partition offsets ARE record indices, so the engine
+convention (offset k = "k records consumed" = next record index) maps
+1:1 — ``seek(k)`` fetches from Kafka offset ``k`` with no bridging
+arithmetic, and the offset checkpointed after scoring record ``i`` is
+``i + 1`` (see runtime/net.py's domain note; both sources share it).
+
+Scope: single-partition consumption without consumer groups — the
+framework's keyed partitioner (parallel/partitioner.py) routes records to
+workers, so group coordination (JoinGroup/SyncGroup/OffsetCommit) is not
+needed; checkpoints own the offsets (capability C7), which is also the
+exactly-once-correct place for them.
+
+All integers big-endian per the Kafka protocol; record-batch varints are
+protobuf zigzag.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_jpmml_tpu.runtime.block import BlockSource
+from flink_jpmml_tpu.runtime.sources import Polled, Record, Source
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+API_METADATA = 3
+API_VERSIONS = 18
+
+_I8 = struct.Struct(">b")
+_I16 = struct.Struct(">h")
+_I32 = struct.Struct(">i")
+_I64 = struct.Struct(">q")
+_U32 = struct.Struct(">I")
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli) — record-batch checksum. Table-driven; the table is
+# built once at import.
+# ---------------------------------------------------------------------------
+
+_CRC32C_POLY = 0x82F63B78
+_CRC32C_TABLE: List[int] = []
+for _n in range(256):
+    _c = _n
+    for _ in range(8):
+        _c = (_c >> 1) ^ _CRC32C_POLY if _c & 1 else _c >> 1
+    _CRC32C_TABLE.append(_c)
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Zigzag varints (record encoding)
+# ---------------------------------------------------------------------------
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_varint(out: bytearray, n: int) -> None:
+    v = _zigzag(n) & 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _unzigzag(acc), pos
+        shift += 7
+
+
+# ---------------------------------------------------------------------------
+# Primitive readers/writers (big-endian, Kafka classic encoding)
+# ---------------------------------------------------------------------------
+
+
+class _Writer:
+    def __init__(self) -> None:
+        self.b = bytearray()
+
+    def i8(self, v: int) -> "_Writer":
+        self.b += _I8.pack(v)
+        return self
+
+    def i16(self, v: int) -> "_Writer":
+        self.b += _I16.pack(v)
+        return self
+
+    def i32(self, v: int) -> "_Writer":
+        self.b += _I32.pack(v)
+        return self
+
+    def i64(self, v: int) -> "_Writer":
+        self.b += _I64.pack(v)
+        return self
+
+    def string(self, s: Optional[str]) -> "_Writer":
+        if s is None:
+            return self.i16(-1)
+        raw = s.encode()
+        self.i16(len(raw))
+        self.b += raw
+        return self
+
+    def bytes_(self, raw: Optional[bytes]) -> "_Writer":
+        if raw is None:
+            return self.i32(-1)
+        self.i32(len(raw))
+        self.b += raw
+        return self
+
+    def raw(self, raw: bytes) -> "_Writer":
+        self.b += raw
+        return self
+
+
+class _Reader:
+    def __init__(self, buf: bytes) -> None:
+        self.buf = buf
+        self.pos = 0
+
+    def i8(self) -> int:
+        (v,) = _I8.unpack_from(self.buf, self.pos)
+        self.pos += 1
+        return v
+
+    def i16(self) -> int:
+        (v,) = _I16.unpack_from(self.buf, self.pos)
+        self.pos += 2
+        return v
+
+    def i32(self) -> int:
+        (v,) = _I32.unpack_from(self.buf, self.pos)
+        self.pos += 4
+        return v
+
+    def i64(self) -> int:
+        (v,) = _I64.unpack_from(self.buf, self.pos)
+        self.pos += 8
+        return v
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n < 0:
+            return None
+        s = self.buf[self.pos : self.pos + n].decode()
+        self.pos += n
+        return s
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.i32()
+        if n < 0:
+            return None
+        raw = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return raw
+
+
+# ---------------------------------------------------------------------------
+# Record batches (magic v2)
+# ---------------------------------------------------------------------------
+
+
+def encode_record_batch(
+    base_offset: int, values: Sequence[bytes], timestamp_ms: int = 0
+) -> bytes:
+    """values → one magic-2 record batch (null keys, no headers)."""
+    recs = bytearray()
+    for i, v in enumerate(values):
+        body = bytearray()
+        body += _I8.pack(0)  # record attributes
+        write_varint(body, 0)  # timestamp delta
+        write_varint(body, i)  # offset delta
+        write_varint(body, -1)  # null key
+        write_varint(body, len(v))
+        body += v
+        write_varint(body, 0)  # headers count
+        rec = bytearray()
+        write_varint(rec, len(body))
+        rec += body
+        recs += rec
+
+    n = len(values)
+    # the crc covers everything AFTER the crc field
+    post = _Writer()
+    post.i16(0)  # attributes: no compression, CreateTime
+    post.i32(n - 1)  # last offset delta
+    post.i64(timestamp_ms)  # first timestamp
+    post.i64(timestamp_ms)  # max timestamp
+    post.i64(-1)  # producer id
+    post.i16(-1)  # producer epoch
+    post.i32(-1)  # base sequence
+    post.i32(n)
+    post.raw(bytes(recs))
+    crc = crc32c(bytes(post.b))
+
+    w = _Writer()
+    w.i64(base_offset)
+    w.i32(4 + 1 + 4 + len(post.b))  # batch length (after this field)
+    w.i32(-1)  # partition leader epoch
+    w.i8(2)  # magic
+    w.raw(_U32.pack(crc))
+    w.raw(bytes(post.b))
+    return bytes(w.b)
+
+
+def decode_record_batches(buf: bytes) -> List[Tuple[int, bytes]]:
+    """record-set bytes → [(absolute offset, value)] across all batches.
+
+    Tolerates a trailing partial batch (Kafka may truncate at max_bytes)."""
+    out: List[Tuple[int, bytes]] = []
+    pos = 0
+    while pos + 12 <= len(buf):
+        (base_offset,) = _I64.unpack_from(buf, pos)
+        (batch_len,) = _I32.unpack_from(buf, pos + 8)
+        end = pos + 12 + batch_len
+        if batch_len <= 0 or end > len(buf):
+            break  # partial trailing batch
+        magic = buf[pos + 16]
+        if magic != 2:
+            raise ValueError(f"unsupported record-batch magic {magic}")
+        (crc_stored,) = _U32.unpack_from(buf, pos + 17)
+        body = buf[pos + 21 : end]
+        if crc32c(body) != crc_stored:
+            raise ValueError("record batch CRC32C mismatch")
+        r = _Reader(body)
+        r.i16()  # attributes (compression unsupported: we never emit it)
+        r.i32()  # last offset delta
+        r.i64()  # first ts
+        r.i64()  # max ts
+        r.i64()  # producer id
+        r.i16()  # producer epoch
+        r.i32()  # base sequence
+        count = r.i32()
+        p = r.pos
+        for _ in range(count):
+            rec_len, p = read_varint(body, p)
+            rec_end = p + rec_len
+            p += 1  # record attributes
+            _, p = read_varint(body, p)  # timestamp delta
+            off_delta, p = read_varint(body, p)
+            klen, p = read_varint(body, p)
+            if klen > 0:
+                p += klen
+            vlen, p = read_varint(body, p)
+            value = body[p : p + vlen] if vlen >= 0 else b""
+            out.append((base_offset + off_delta, bytes(value)))
+            p = rec_end
+        pos = end
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class KafkaProtocolError(RuntimeError):
+    pass
+
+
+class KafkaClient:
+    """Minimal single-connection Kafka client (consumer side).
+
+    Speaks classic (non-flexible) request versions so the framing works
+    against any broker from 0.11 on: ApiVersions v0, Metadata v1,
+    ListOffsets v1, Fetch v4.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        client_id: str = "fjt-consumer",
+        timeout_s: float = 10.0,
+    ):
+        self.host = host
+        self.port = port
+        self.client_id = client_id
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._corr = 0
+
+    # -- connection management ------------------------------------------
+
+    def connect(self) -> None:
+        self.close()
+        s = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _request(self, api_key: int, api_version: int, body: bytes) -> _Reader:
+        if self._sock is None:
+            self.connect()
+        self._corr += 1
+        hdr = _Writer()
+        hdr.i16(api_key).i16(api_version).i32(self._corr).string(
+            self.client_id
+        )
+        msg = bytes(hdr.b) + body
+        self._sock.sendall(_I32.pack(len(msg)) + msg)
+        raw = self._recv_exact(4)
+        (size,) = _I32.unpack(raw)
+        payload = self._recv_exact(size)
+        r = _Reader(payload)
+        corr = r.i32()
+        if corr != self._corr:
+            raise KafkaProtocolError(
+                f"correlation id mismatch: {corr} != {self._corr}"
+            )
+        return r
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = bytearray()
+        while len(chunks) < n:
+            chunk = self._sock.recv(n - len(chunks))
+            if not chunk:
+                raise ConnectionError("kafka connection closed")
+            chunks += chunk
+        return bytes(chunks)
+
+    # -- protocol calls --------------------------------------------------
+
+    def api_versions(self) -> Dict[int, Tuple[int, int]]:
+        r = self._request(API_VERSIONS, 0, b"")
+        err = r.i16()
+        if err:
+            raise KafkaProtocolError(f"ApiVersions error {err}")
+        out = {}
+        for _ in range(r.i32()):
+            k, lo, hi = r.i16(), r.i16(), r.i16()
+            out[k] = (lo, hi)
+        return out
+
+    def metadata(self, topic: str):
+        """→ (brokers {node: (host, port)}, partitions {index: leader})."""
+        w = _Writer()
+        w.i32(1).string(topic)
+        r = self._request(API_METADATA, 1, bytes(w.b))
+        brokers = {}
+        for _ in range(r.i32()):
+            node = r.i32()
+            host = r.string()
+            port = r.i32()
+            r.string()  # rack
+            brokers[node] = (host, port)
+        r.i32()  # controller id
+        partitions = {}
+        for _ in range(r.i32()):
+            terr = r.i16()
+            name = r.string()
+            r.i8()  # is_internal
+            nparts = r.i32()
+            for _ in range(nparts):
+                perr = r.i16()
+                idx = r.i32()
+                leader = r.i32()
+                for _ in range(r.i32()):
+                    r.i32()  # replicas
+                for _ in range(r.i32()):
+                    r.i32()  # isr
+                if name == topic and not perr:
+                    partitions[idx] = leader
+            if name == topic and terr:
+                raise KafkaProtocolError(
+                    f"Metadata error {terr} for topic {topic!r}"
+                )
+        return brokers, partitions
+
+    def list_offset(
+        self, topic: str, partition: int, timestamp: int
+    ) -> int:
+        """timestamp −2 = earliest, −1 = latest → partition offset."""
+        w = _Writer()
+        w.i32(-1)  # replica id
+        w.i32(1).string(topic).i32(1).i32(partition).i64(timestamp)
+        r = self._request(API_LIST_OFFSETS, 1, bytes(w.b))
+        for _ in range(r.i32()):
+            r.string()  # topic
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                if err:
+                    raise KafkaProtocolError(f"ListOffsets error {err}")
+                r.i64()  # timestamp
+                return r.i64()
+        raise KafkaProtocolError("empty ListOffsets response")
+
+    def fetch(
+        self,
+        topic: str,
+        partition: int,
+        offset: int,
+        max_wait_ms: int = 100,
+        min_bytes: int = 1,
+        max_bytes: int = 4 << 20,
+    ) -> Tuple[int, List[Tuple[int, bytes]]]:
+        """→ (high watermark, [(offset, value)] with offset ≥ requested).
+
+        A batch may start before the requested offset (Kafka returns whole
+        batches); records below it are filtered here, exactly like a real
+        consumer."""
+        w = _Writer()
+        w.i32(-1)  # replica id
+        w.i32(max_wait_ms)
+        w.i32(min_bytes)
+        w.i32(max_bytes)
+        w.i8(0)  # isolation level: read_uncommitted
+        w.i32(1).string(topic)
+        w.i32(1).i32(partition).i64(offset).i32(max_bytes)
+        r = self._request(API_FETCH, 4, bytes(w.b))
+        r.i32()  # throttle time
+        high_watermark = 0
+        records: List[Tuple[int, bytes]] = []
+        for _ in range(r.i32()):
+            r.string()  # topic
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                high_watermark = r.i64()
+                r.i64()  # last stable offset
+                for _ in range(r.i32()):  # aborted transactions
+                    r.i64()
+                    r.i64()
+                record_set = r.bytes_() or b""
+                if err:
+                    raise KafkaProtocolError(f"Fetch error {err}")
+                records.extend(
+                    rec
+                    for rec in decode_record_batches(record_set)
+                    if rec[0] >= offset
+                )
+        return high_watermark, records
+
+
+# ---------------------------------------------------------------------------
+# Sources (engine-facing)
+# ---------------------------------------------------------------------------
+
+
+class _KafkaSourceBase:
+    """Shared fetch/reconnect/seek plumbing for both source shapes."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        topic: str,
+        partition: int = 0,
+        start_offset: int = 0,
+        max_wait_ms: int = 50,
+        reconnect_backoff_s: float = 0.05,
+    ):
+        self._client = KafkaClient(host, port)
+        self._topic = topic
+        self._partition = partition
+        self._next = start_offset  # next Kafka offset to fetch
+        self._max_wait_ms = max_wait_ms
+        self._backoff = reconnect_backoff_s
+        self._eos = False
+
+    def _fetch(self) -> List[Tuple[int, bytes]]:
+        try:
+            _, recs = self._client.fetch(
+                self._topic,
+                self._partition,
+                self._next,
+                max_wait_ms=self._max_wait_ms,
+            )
+        except (OSError, ConnectionError, KafkaProtocolError):
+            # reconnect-at-offset: exactly the consumer resume model —
+            # nothing is lost or duplicated because _next only advances
+            # on successfully decoded records
+            self._client.close()
+            time.sleep(self._backoff)
+            try:
+                self._client.connect()
+            except OSError:
+                return []
+            return []
+        if recs:
+            self._next = recs[-1][0] + 1
+        return recs
+
+    def seek(self, offset: int) -> None:
+        # engine offset k ("k records consumed") == next Kafka offset: the
+        # two domains coincide, no +1 bridging anywhere (cf. net.py header)
+        self._next = offset
+
+    def close(self) -> None:
+        self._client.close()
+
+    @property
+    def exhausted(self) -> bool:
+        return self._eos
+
+
+class KafkaRecordSource(_KafkaSourceBase, Source):
+    """Record-object source: each Kafka message value is one JSON record
+    (or raw bytes via ``decoder``)."""
+
+    def __init__(self, *args, decoder=None, **kw):
+        super().__init__(*args, **kw)
+        import json
+
+        self._decode = decoder or (lambda v: json.loads(v))
+        self._pending: List[Tuple[int, bytes]] = []
+
+    def poll(self, max_n: int) -> Polled:
+        # a fetch may return more than max_n records; the surplus stays
+        # buffered so nothing fetched is ever dropped (the fetch cursor
+        # has already moved past it)
+        if len(self._pending) < max_n:
+            self._pending.extend(self._fetch())
+        take, self._pending = (
+            self._pending[:max_n],
+            self._pending[max_n:],
+        )
+        return [(off + 1, self._decode(value)) for off, value in take]
+
+    def seek(self, offset: int) -> None:
+        self._pending.clear()
+        super().seek(offset)
+
+
+class KafkaBlockSource(_KafkaSourceBase, BlockSource):
+    """Block source: each Kafka message value is one packed f32-LE feature
+    row; a fetch's worth of consecutive rows forms one [n, F] block."""
+
+    def __init__(self, *args, n_cols: int, **kw):
+        super().__init__(*args, **kw)
+        self._cols = n_cols
+
+    def poll(self) -> Optional[Tuple[int, np.ndarray]]:
+        recs = self._fetch()
+        if not recs:
+            return None
+        rows = np.empty((len(recs), self._cols), np.float32)
+        first = recs[0][0]
+        for i, (off, value) in enumerate(recs):
+            if off != first + i:
+                # a gap means a compacted/partial topic — not the tabular
+                # stream contract; resync the block at the gap
+                rows = rows[:i]
+                self._next = off
+                break
+            rows[i] = np.frombuffer(value, np.float32, count=self._cols)
+        if rows.shape[0] == 0:
+            return None
+        return first, rows
+
+
+# ---------------------------------------------------------------------------
+# MiniKafkaBroker (tests / drills)
+# ---------------------------------------------------------------------------
+
+
+class MiniKafkaBroker:
+    """In-process single-topic single-partition broker speaking the same
+    wire protocol the client consumes: ApiVersions v0, Metadata v1,
+    ListOffsets v1, Fetch v0–v4, Produce ignored. The FJT1-server role
+    (runtime/net.py BlockFrameServer), but Kafka-framed — tests and
+    kill/resume drills run against real protocol bytes."""
+
+    def __init__(self, topic: str = "records", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.topic = topic
+        self._log: List[bytes] = []  # value bytes; index == offset
+        self._mu = threading.Condition()
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._closing = False
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._conns_mu = threading.Lock()
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- producer side (in-process) --------------------------------------
+
+    def append(self, *values: bytes) -> int:
+        """→ offset of the first appended value."""
+        with self._mu:
+            first = len(self._log)
+            self._log.extend(values)
+            self._mu.notify_all()
+            return first
+
+    def append_rows(self, rows: np.ndarray) -> int:
+        rows = np.ascontiguousarray(rows, np.float32)
+        return self.append(*(rows[i].tobytes() for i in range(rows.shape[0])))
+
+    @property
+    def high_watermark(self) -> int:
+        with self._mu:
+            return len(self._log)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        # close accepted connections too: a serve thread parked in recv
+        # would otherwise hold the port in ESTABLISHED/CLOSE_WAIT and
+        # make an immediate same-port restart fail with EADDRINUSE
+        # (SO_REUSEADDR only forgives TIME_WAIT)
+        with self._conns_mu:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        with self._mu:
+            self._mu.notify_all()
+
+    # -- server side ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            # register BEFORE spawning, and re-check _closing after: a
+            # close() racing this accept must still find (or beat) the
+            # connection in _conns so no socket outlives the broker
+            with self._conns_mu:
+                self._conns.append(conn)
+            if self._closing:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            t = threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._closing:
+                hdr = self._recv_exact(conn, 4)
+                if hdr is None:
+                    return
+                (size,) = _I32.unpack(hdr)
+                payload = self._recv_exact(conn, size)
+                if payload is None:
+                    return
+                r = _Reader(payload)
+                api_key = r.i16()
+                api_version = r.i16()
+                corr = r.i32()
+                r.string()  # client id
+                body = self._dispatch(api_key, api_version, r)
+                if body is None:
+                    return
+                msg = _I32.pack(corr) + body
+                conn.sendall(_I32.pack(len(msg)) + msg)
+        except (OSError, ConnectionError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            # drop the registry entry: a long-lived broker must not
+            # accumulate closed sockets across normal disconnects
+            with self._conns_mu:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        chunks = bytearray()
+        while len(chunks) < n:
+            try:
+                chunk = conn.recv(n - len(chunks))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            chunks += chunk
+        return bytes(chunks)
+
+    def _dispatch(self, api_key: int, v: int, r: _Reader) -> Optional[bytes]:
+        if api_key == API_VERSIONS:
+            w = _Writer()
+            w.i16(0).i32(4)
+            for k, lo, hi in (
+                (API_FETCH, 0, 4),
+                (API_LIST_OFFSETS, 0, 1),
+                (API_METADATA, 0, 1),
+                (API_VERSIONS, 0, 0),
+            ):
+                w.i16(k).i16(lo).i16(hi)
+            return bytes(w.b)
+        if api_key == API_METADATA:
+            for _ in range(max(r.i32(), 0)):
+                r.string()
+            w = _Writer()
+            w.i32(1)  # brokers
+            w.i32(0).string(self.host).i32(self.port).string(None)
+            w.i32(0)  # controller id
+            w.i32(1)  # topics
+            w.i16(0).string(self.topic).i8(0)
+            w.i32(1)  # partitions
+            w.i16(0).i32(0).i32(0)  # err, index, leader
+            w.i32(1).i32(0)  # replicas
+            w.i32(1).i32(0)  # isr
+            return bytes(w.b)
+        if api_key == API_LIST_OFFSETS:
+            r.i32()  # replica id
+            r.i32()  # topic count (1)
+            r.string()
+            r.i32()  # partition count (1)
+            r.i32()  # partition
+            ts = r.i64()
+            with self._mu:
+                off = 0 if ts == -2 else len(self._log)
+            w = _Writer()
+            w.i32(1).string(self.topic)
+            w.i32(1).i32(0).i16(0).i64(-1).i64(off)
+            return bytes(w.b)
+        if api_key == API_FETCH:
+            r.i32()  # replica id
+            max_wait_ms = r.i32()
+            r.i32()  # min bytes
+            if v >= 3:
+                r.i32()  # max bytes
+            if v >= 4:
+                r.i8()  # isolation level
+            r.i32()  # topic count
+            r.string()
+            r.i32()  # partition count
+            r.i32()  # partition
+            fetch_offset = r.i64()
+            part_max_bytes = r.i32()
+            deadline = time.monotonic() + max_wait_ms / 1000.0
+            with self._mu:
+                while (
+                    len(self._log) <= fetch_offset
+                    and not self._closing
+                    and time.monotonic() < deadline
+                ):
+                    self._mu.wait(
+                        max(deadline - time.monotonic(), 0.001)
+                    )
+                hw = len(self._log)
+                values = []
+                size = 0
+                o = fetch_offset
+                while o < hw:
+                    val = self._log[o]
+                    size += len(val) + 32
+                    if values and size > part_max_bytes:
+                        break
+                    values.append(val)
+                    o += 1
+            record_set = (
+                encode_record_batch(fetch_offset, values) if values else b""
+            )
+            w = _Writer()
+            w.i32(0)  # throttle
+            w.i32(1).string(self.topic)
+            w.i32(1)
+            w.i32(0).i16(0).i64(hw)  # partition, err, high watermark
+            w.i64(hw)  # last stable offset
+            w.i32(0)  # aborted txns
+            w.bytes_(record_set)
+            return bytes(w.b)
+        # unknown api: close the connection (real brokers error; fine here)
+        return None

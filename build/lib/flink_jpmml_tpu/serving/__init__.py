@@ -1,0 +1,5 @@
+"""Dynamic model serving (capability C6): registry, managers, control join."""
+
+from flink_jpmml_tpu.serving.block import DynamicBlockPipeline  # noqa: F401
+from flink_jpmml_tpu.serving.registry import ModelRegistry  # noqa: F401
+from flink_jpmml_tpu.serving.scorer import DynamicScorer, default_route  # noqa: F401

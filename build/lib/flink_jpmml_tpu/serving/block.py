@@ -1,0 +1,239 @@
+"""Dynamic model serving on the block path (C6 × the ≥1M rec/s plane).
+
+The reference's flagship v0.6 capability — swap served models from a
+control stream while traffic flows (SURVEY.md §1 C6, §4.3) — composed
+with its *data plane*. Round 2 shipped the two separately: Add/Del +
+double-buffered swap lived only on the record-object engine
+(thousands/sec), while the production :class:`~flink_jpmml_tpu.runtime
+.block.BlockPipeline` took exactly one static model. This class is the
+composition, built on the shared
+:class:`~flink_jpmml_tpu.runtime.block.BlockPipelineBase` loop:
+
+    BlockSource → ring → drained f32 batches
+                     ↘ control stream (Add/Del) → ModelRegistry
+    batch × current-model → quantized/f32 scoring (async dispatch)
+                          → sink(out, n, first_offset, decode)
+
+Swap protocol (double-buffered, non-draining):
+
+- An ``AddMessage`` starts a *background* parse+compile+jit via the
+  registry's bounded warm pool; the score loop keeps dispatching against
+  the current scorer the whole time — no batch ever waits on a compile.
+- Between batches (never mid-batch) the loop adopts the newest
+  *warm-and-ready* served version whose arity matches the stream.
+  Readiness is judged by the registry's **compiled-model instance**, not
+  by (name, version) alone — a Del + re-Add of the same id with a new
+  document produces a new instance and therefore a fresh adoption, never
+  a stale cache hit. In-flight batches dispatched under the previous
+  version are NOT drained or cancelled: they ride the same FIFO window,
+  get sunk in order, and their offsets commit after sink exactly like
+  static-path batches — offsets stay contiguous across the swap.
+- A ``DelMessage`` of the serving version drops it; the loop falls back
+  to the newest remaining warm version. With nothing servable the loop
+  *holds* the drained batch (ring backpressure upstream) rather than
+  dropping records; on shutdown the hold is bounded
+  (``drain_hold_timeout_s``) and abandoned records simply replay from
+  the committed offset on restore (at-least-once, C7).
+
+The sink gains a 4th argument vs the static path: ``decode``, a callable
+(out, n) → [Prediction] bound to the exact model that scored the batch
+(with ``decode.model_key`` naming it) — after a swap, an in-flight
+batch's raw output must be decoded by the model that produced it, not
+whichever is current at sink time.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from flink_jpmml_tpu.models.control import ServingMessage
+from flink_jpmml_tpu.models.core import ModelId
+from flink_jpmml_tpu.runtime.block import (
+    BlockPipelineBase,
+    BlockSource,
+    BoundScorer,
+)
+from flink_jpmml_tpu.runtime.sources import ControlSource
+from flink_jpmml_tpu.serving.registry import ModelRegistry
+from flink_jpmml_tpu.utils.config import CompileConfig, RuntimeConfig
+from flink_jpmml_tpu.utils.exceptions import InputValidationException
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+
+class DynamicBlockPipeline(BlockPipelineBase):
+    """Block-speed scoring with control-stream model serving.
+
+    ``sink(out, n, first_offset, decode)`` — see module docstring.
+    ``name`` pins which served model name this stream scores (versions of
+    it swap in and out); the newest warm version wins, reference
+    "latest-wins" routing (SURVEY.md §4.3).
+    """
+
+    _THREAD_TAG = "dblk"
+    # bounded wait for the first record: an idle stream still applies
+    # Add/Del and kicks background warms every ~20ms (see _on_idle)
+    _IDLE_WAIT_US = 20_000
+
+    def __init__(
+        self,
+        source: BlockSource,
+        control: ControlSource,
+        sink: Callable,
+        name: str,
+        arity: int,
+        batch_size: int,
+        config: Optional[RuntimeConfig] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        compile_config: Optional[CompileConfig] = None,
+        use_native: bool = True,
+        in_flight: int = 2,
+        use_quantized: bool = True,
+        checkpoint=None,
+        hold_poll_s: float = 0.005,
+        drain_hold_timeout_s: float = 5.0,
+    ):
+        if batch_size <= 0:
+            raise InputValidationException(
+                f"batch_size must be positive: {batch_size}"
+            )
+        super().__init__(
+            source=source,
+            sink=sink,
+            arity=arity,
+            batch_size=batch_size,
+            config=config,
+            metrics=metrics,
+            use_native=use_native,
+            in_flight=in_flight,
+            checkpoint=checkpoint,
+        )
+        self._control = control
+        self._name = name
+        self._use_quantized = use_quantized
+        self._hold_poll_s = hold_poll_s
+        self._drain_hold_timeout_s = drain_hold_timeout_s
+        self.registry = ModelRegistry(
+            batch_size=batch_size, compile_config=compile_config
+        )
+        self._current: Optional[BoundScorer] = None
+        self._rejected: set = set()  # arity-mismatched served ids
+        self.swaps = self.metrics.counter("model_swaps")
+
+    @property
+    def serving_key(self) -> Optional[str]:
+        cur = self._current
+        return cur.key if cur is not None else None
+
+    @property
+    def backend(self) -> Optional[str]:
+        cur = self._current
+        return cur.backend if cur is not None else None
+
+    # -- checkpoint (C7: source offset + served metadata, like the
+    #    reference's checkpointed operator state) --------------------------
+
+    def _ckpt_state(self) -> dict:
+        return {
+            "source_offset": self.committed_offset,
+            "registry": self.registry.state(),
+        }
+
+    def _restore_extra(self, state: dict) -> None:
+        self.registry.restore(state.get("registry", {}))
+
+    # -- model resolution --------------------------------------------------
+
+    def _poll_control(self) -> None:
+        """Drain pending Add/Del messages; adopt the newest warm, arity-
+        matching compiled model when it differs from the current one.
+        Runs between batches only — a batch is never re-routed
+        mid-dispatch."""
+        changed = False
+        while True:
+            msgs = self._control.poll(64)
+            if not msgs:
+                break
+            for _, msg in msgs:
+                if isinstance(msg, ServingMessage):
+                    changed |= self.registry.apply(msg)
+        if changed:
+            # a registry change may supersede any quarantine (a corrected
+            # document can be re-Added under the same name+version)
+            self._rejected.clear()
+        cur = self._current
+        # current version un-served (Del): drop it even with nothing warm
+        if cur is not None:
+            mid = ModelId.from_key(cur.key)
+            if self.registry.resolve(mid.name, mid.version) is None:
+                self._current = None
+                cur = None
+        # the newest warm-and-compiled served version of our name wins;
+        # warmness is judged per *compiled instance*, so a re-Add with a
+        # different document (new instance after its background warm) is
+        # adopted even though the (name, version) key looks unchanged
+        best_mid = None
+        best_model = None
+        for mid in sorted(
+            (m for m in self.registry.served if m.name == self._name),
+            key=lambda m: m.version,
+            reverse=True,
+        ):
+            if mid in self._rejected:
+                continue
+            model = self.registry.model_if_warm(mid)  # kicks warm if cold
+            if model is None:
+                continue
+            if model.field_space.arity != self._arity:
+                # served document doesn't fit this stream's record shape:
+                # quarantine the id (until the registry changes again)
+                self._rejected.add(mid)
+                self.metrics.counter("arity_rejected_models").inc()
+                continue
+            best_mid, best_model = mid, model
+            break
+        if best_model is None:
+            return
+        if cur is not None and cur.model is best_model:
+            return  # already serving exactly this compiled instance
+        # a fresh BoundScorer per adoption — no cache: the quantized
+        # probe is memoized on the CompiledModel so this is cheap, and
+        # nothing pins superseded models (in-flight batches hold their
+        # own decode references until sunk; the registry owns the rest)
+        bound = BoundScorer(best_mid.key(), best_model, self._use_quantized)
+        self._current = bound
+        self.swaps.inc()
+        self.metrics.counter(f"scorer_backend_{bound.backend}").inc()
+
+    # -- BlockPipelineBase hooks ------------------------------------------
+
+    def _on_idle(self) -> None:
+        self._poll_control()  # idle ring: still apply Add/Del promptly
+
+    def _acquire(self, finish_one):
+        self._poll_control()
+        hold_start = time.monotonic()
+        while self._current is None:
+            # hold the batch (never drop it) until something is servable;
+            # in-flight keeps draining meanwhile
+            if self._stop.is_set() or self._ring.closed:
+                if not self._drain_all:
+                    return None
+                # draining shutdown: bounded wait, then give up — the
+                # held batch replays from the committed offset on restore
+                if (
+                    time.monotonic() - hold_start
+                    > self._drain_hold_timeout_s
+                ):
+                    return None
+            finish_one()  # already-dispatched batches keep reaching the
+            # sink while we hold
+            time.sleep(self._hold_poll_s)
+            self._poll_control()
+        return self._current
+
+    def _dispatch(self, bound, X, n):
+        return self._dispatch_bound(bound, X, n), bound.decode
+
+    def _emit(self, out, n, first_off, decode) -> None:
+        self._sink(out, n, first_off, decode)

@@ -1,0 +1,281 @@
+"""Served-model registry: metadata + lazily compiled models (capability C6).
+
+Reference parity (SURVEY.md §4.3): the dynamic co-operator holds a
+checkpointed map ``ModelId → ModelInfo``; model *instances* are loaded
+lazily from their path on the first matching event, never checkpointed.
+Here "loaded" means parsed + compiled to a jitted scorer, via the
+``ModelReader`` compile cache (same path+mtime loads once per process).
+
+Compile stalls are kept off the hot path by **background warming +
+double-buffered swap** (SURVEY.md §8 hard part (d)): an ``AddMessage``
+kicks off a warm thread that parses, compiles *and jits* the new version
+while traffic keeps flowing — the scorer serves unpinned events from the
+newest already-warm version until the new one is ready, then swaps. Only
+the first deployment of a name (nothing warm to fall back to) compiles
+synchronously, and a concurrent warm for the same id is joined rather than
+duplicated.
+
+State for checkpointing is the metadata map alone, as
+``{"name_version": path}`` — JSON-shaped, tiny, resumable (C7). Restore
+re-kicks background warming for every served id so a recovered worker is
+hot before the first event arrives.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+import numpy as np
+
+from flink_jpmml_tpu.api.reader import ModelReader
+from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.models.control import AddMessage, ServingMessage
+from flink_jpmml_tpu.models.core import ModelId, ModelInfo
+from flink_jpmml_tpu.serving import managers
+from flink_jpmml_tpu.utils.config import CompileConfig
+from flink_jpmml_tpu.utils.exceptions import (
+    FlinkJpmmlTpuError,
+    ModelLoadingException,
+)
+
+
+class _WarmTask:
+    """One in-flight background compile: join-able, result-or-error.
+
+    ``info`` pins the exact registration (ModelInfo identity) the warm
+    started from — a Del + re-Add with a different path, or a restore(),
+    creates a *new* ModelInfo, so a stale warm's result/error is never
+    attributed to the new registration."""
+
+    def __init__(self, info: ModelInfo) -> None:
+        self.info = info
+        self.done = threading.Event()
+        self.result: Optional[CompiledModel] = None
+        self.error: Optional[BaseException] = None
+
+
+class ModelRegistry:
+    def __init__(
+        self,
+        batch_size: Optional[int] = None,
+        compile_config: Optional[CompileConfig] = None,
+        async_warmup: bool = True,
+        warm_workers: int = 3,
+        warm_join_timeout_s: float = 300.0,
+    ):
+        self._meta: managers.Metadata = {}
+        self._compiled: Dict[ModelId, CompiledModel] = {}
+        self._warming: Dict[ModelId, _WarmTask] = {}
+        self._warm_failed: Dict[ModelId, BaseException] = {}
+        self._lock = threading.Lock()
+        self._batch_size = batch_size
+        self._compile_config = compile_config
+        self._async = async_warmup
+        # warms run on a small bounded pool, not a thread per model: a
+        # restore() of a registry serving many models must not trigger a
+        # simultaneous parse+compile+jit storm
+        self._warm_workers = max(1, warm_workers)
+        self._warm_pool: Optional[ThreadPoolExecutor] = None
+        # bounded join for in-flight warms (a wedged backend init must
+        # surface as ModelLoadingException, not hang the scoring thread)
+        self._warm_join_timeout_s = warm_join_timeout_s
+
+    @property
+    def async_warmup(self) -> bool:
+        return self._async
+
+    def apply(self, msg: ServingMessage) -> bool:
+        """Apply one control message; returns True if the registry changed.
+        An accepted Add immediately starts warming the new version in the
+        background (parse + compile + jit) so the hot path never pays it."""
+        with self._lock:
+            new_meta, changed = managers.apply_message(self._meta, msg)
+            if changed:
+                removed = set(self._meta) - set(new_meta)
+                self._meta = new_meta
+                for mid in removed:
+                    self._compiled.pop(mid, None)
+                    self._warm_failed.pop(mid, None)
+        if changed and self._async and isinstance(msg, AddMessage):
+            self.ensure_warming(msg.model_id)
+        return changed
+
+    def resolve(
+        self, name: str, version: Optional[int] = None
+    ) -> Optional[ModelId]:
+        """Served id for (name, version); version None → newest served."""
+        with self._lock:
+            if version is not None:
+                mid = ModelId(name, version)
+                return mid if mid in self._meta else None
+            v = managers.latest_version(self._meta, name)
+            return ModelId(name, v) if v >= 0 else None
+
+    def resolve_warm(self, name: str) -> Optional[ModelId]:
+        """Newest *compiled-and-ready* version of ``name`` (the
+        double-buffer fallback target), or None."""
+        with self._lock:
+            versions = [
+                mid.version for mid in self._compiled if mid.name == name
+            ]
+        return ModelId(name, max(versions)) if versions else None
+
+    def model_if_warm(self, mid: ModelId) -> Optional[CompiledModel]:
+        """The compiled model iff it is ready *now* — never compiles, never
+        blocks. A served-but-cold id gets a background warm kicked off."""
+        with self._lock:
+            cached = self._compiled.get(mid)
+            served = mid in self._meta
+            failed = mid in self._warm_failed
+        if cached is not None:
+            return cached
+        if served and not failed and self._async:
+            self.ensure_warming(mid)
+        return None
+
+    def warm_error(self, mid: ModelId) -> Optional[BaseException]:
+        """The recorded background-warm failure for ``mid``, if any."""
+        with self._lock:
+            return self._warm_failed.get(mid)
+
+    def is_warming(self, mid: ModelId) -> bool:
+        with self._lock:
+            return mid in self._warming
+
+    def ensure_warming(self, mid: ModelId) -> None:
+        """Start (once per registration) a background parse+compile+jit
+        for a served id. A warm left over from a superseded registration
+        (same id, different ModelInfo) is replaced, not reused."""
+        with self._lock:
+            info = self._meta.get(mid)
+            if (
+                info is None
+                or mid in self._compiled
+                or mid in self._warm_failed
+            ):
+                return
+            existing = self._warming.get(mid)
+            if existing is not None and existing.info is info:
+                return
+            task = _WarmTask(info)
+            self._warming[mid] = task
+            if self._warm_pool is None:
+                self._warm_pool = ThreadPoolExecutor(
+                    max_workers=self._warm_workers,
+                    thread_name_prefix="fjt-warm",
+                )
+            pool = self._warm_pool
+        pool.submit(self._warm_one, mid, task)
+
+    def _warm_one(self, mid: ModelId, task: _WarmTask) -> None:
+        try:
+            compiled = self._load(task.info)
+            self._prewarm(compiled)
+            task.result = compiled
+            with self._lock:
+                # attribute only to the registration this warm started
+                # from — deleted/re-added/restored ids get a fresh warm
+                if self._meta.get(mid) is task.info:
+                    self._compiled[mid] = compiled
+        except BaseException as e:  # recorded, surfaced via warm_error/model
+            task.error = e
+            with self._lock:
+                if self._meta.get(mid) is task.info:
+                    self._warm_failed[mid] = e
+        finally:
+            with self._lock:
+                if self._warming.get(mid) is task:
+                    del self._warming[mid]
+            task.done.set()
+
+    def _load(self, info: ModelInfo) -> CompiledModel:
+        return ModelReader(info.path).load(
+            batch_size=self._batch_size, config=self._compile_config
+        )
+
+    def _prewarm(self, compiled: CompiledModel) -> None:
+        """Force the actual XLA compile (and the quantized probe) so the
+        first event on this version pays a dispatch, not a compile."""
+        import jax
+
+        q = compiled.quantized_scorer()
+        if q is not None:
+            b = q.batch_size or 1
+            Xq = np.zeros((b, len(q.wire.fields)), q.wire.dtype)
+            jax.block_until_ready(q.predict_wire(Xq))
+        else:
+            compiled.warmup()
+
+    def model(self, mid: ModelId) -> Optional[CompiledModel]:
+        """The compiled model for a served id, compiling if needed (C6
+        'lazy load on first matching event'). Joins an in-flight background
+        warm instead of duplicating it; blocks only when the model is not
+        yet compiled anywhere. Returns None if unserved; raises on a bad
+        path / uncompilable document — callers decide whether that poisons
+        the lane or the stream."""
+        with self._lock:
+            cached = self._compiled.get(mid)
+            info = self._meta.get(mid)
+            task = self._warming.get(mid)
+            failed = self._warm_failed.get(mid)
+        if cached is not None:
+            return cached
+        if info is None:
+            return None
+        if failed is not None:
+            if isinstance(failed, FlinkJpmmlTpuError):
+                raise failed
+            raise ModelLoadingException(
+                f"background compile of {mid.key()} failed: {failed!r}"
+            ) from failed
+        if task is not None and task.info is info:
+            if not task.done.wait(self._warm_join_timeout_s):
+                raise ModelLoadingException(
+                    f"background warm of {mid.key()} did not complete "
+                    f"within {self._warm_join_timeout_s:.0f}s (wedged "
+                    "compile or backend init); model quarantined for now"
+                )
+            if task.error is not None:
+                return self.model(mid)  # re-enter to raise the recorded error
+            return task.result
+        compiled = self._load(info)
+        with self._lock:
+            # attribute only to this registration (see _warm_one)
+            if self._meta.get(mid) is info:
+                self._compiled[mid] = compiled
+        return compiled
+
+    @property
+    def served(self) -> Dict[ModelId, ModelInfo]:
+        with self._lock:
+            return dict(self._meta)
+
+    # -- checkpoint state (C7) --------------------------------------------
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "served": {mid.key(): info.path for mid, info in self._meta.items()}
+            }
+
+    def restore(self, state: dict) -> None:
+        served = state.get("served", {})
+        meta: managers.Metadata = {}
+        for key, path in served.items():
+            try:
+                meta[ModelId.from_key(key)] = ModelInfo(path=path)
+            except (ValueError, TypeError) as e:
+                raise ModelLoadingException(
+                    f"corrupt registry checkpoint entry {key!r}: {e}"
+                ) from e
+        with self._lock:
+            self._meta = meta
+            self._compiled.clear()
+            self._warm_failed.clear()
+        if self._async:
+            # recovered worker: warm everything served so the first event
+            # after resume pays a dispatch, not a compile
+            for mid in meta:
+                self.ensure_warming(mid)

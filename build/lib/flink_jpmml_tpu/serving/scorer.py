@@ -1,0 +1,211 @@
+"""DynamicScorer: the two-input (event ⋈ control) operator, vectorized.
+
+Reference parity (SURVEY.md §4.3): a ``RichCoFlatMapFunction`` joining the
+event stream with a control stream of Add/Del messages, scoring each event
+against its target served model, with the served-metadata map in
+checkpointed operator state. Here the join happens once per *micro-batch*:
+
+1. drain all pending control messages (in arrival order) into the registry;
+2. group the batch's events by their routed ``(name, version)``;
+3. dispatch one device call per distinct model (async), padding each group
+   to the compiled batch shape;
+4. reassemble results in event order; events routed to an unserved model
+   get ``Prediction.empty()`` — totality (C5), never an exception.
+
+Event routing: by default an event is a ``(model_name, record)`` pair or a
+dict with a ``"_model"`` key (optionally ``"_version"``); pass ``route`` to
+override. This replaces the reference's keyed-stream association of events
+to models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_jpmml_tpu.api.reader import ModelReader
+from flink_jpmml_tpu.compile import prepare
+from flink_jpmml_tpu.models.prediction import Prediction
+from flink_jpmml_tpu.runtime.engine import Scorer
+from flink_jpmml_tpu.runtime.sources import ControlSource
+from flink_jpmml_tpu.serving.registry import ModelRegistry
+from flink_jpmml_tpu.utils.config import CompileConfig
+from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
+
+# route(event) -> (name, version|None, record)
+RouteFn = Callable[[Any], Tuple[Optional[str], Optional[int], Any]]
+
+
+def default_route(event: Any) -> Tuple[Optional[str], Optional[int], Any]:
+    if isinstance(event, tuple) and len(event) == 2:
+        return event[0], None, event[1]
+    if isinstance(event, dict) and "_model" in event:
+        payload = {k: v for k, v in event.items() if k not in ("_model", "_version")}
+        return event["_model"], event.get("_version"), payload
+    return None, None, event
+
+
+class DynamicScorer(Scorer):
+    def __init__(
+        self,
+        control: ControlSource,
+        batch_size: int,
+        route: Optional[RouteFn] = None,
+        default_reader: Optional[ModelReader] = None,
+        replace_nan: Optional[float] = None,
+        compile_config: Optional[CompileConfig] = None,
+        emit_pairs: bool = True,
+        emit: Optional[Callable[[Sequence[Any], List[Prediction]], List[Any]]] = None,
+        async_warmup: bool = True,
+    ):
+        """``async_warmup=False`` disables background warming: a newly
+        Added model compiles synchronously inside ``submit`` on its first
+        matching event (the reference's operator-blocking lazy load) —
+        kept for comparison/tests; the default never stalls the batch
+        loop on a compile."""
+        self.registry = ModelRegistry(
+            batch_size=batch_size,
+            compile_config=compile_config,
+            async_warmup=async_warmup,
+        )
+        self._control = control
+        self._route = route or default_route
+        self._default_model = (
+            default_reader.load(batch_size=batch_size, config=compile_config)
+            if default_reader is not None
+            else None
+        )
+        self._replace_nan = replace_nan
+        self._emit_pairs = emit_pairs
+        self._emit = emit
+        # models whose load/compile failed: don't re-attempt every batch;
+        # cleared when the registry changes (a fixed version can be re-Added)
+        self._failed: set = set()
+
+    def _drain_control(self) -> None:
+        while True:
+            msgs = self._control.poll(256)
+            if not msgs:
+                break
+            for _, msg in msgs:
+                if self.registry.apply(msg):
+                    self._failed.clear()
+
+    def submit(self, records: Sequence[Any]):
+        self._drain_control()
+        n = len(records)
+        groups: dict = {}  # model-key -> (CompiledModel, [indices], [payloads])
+        unserved: List[int] = []
+        for i, event in enumerate(records):
+            name, version, payload = self._route(event)
+            model = None
+            if name is None:
+                model = self._default_model
+                key = "__default__"
+            else:
+                mid = self.registry.resolve(name, version)
+                key = mid.key() if mid else None
+                if mid is not None and not self.registry.async_warmup:
+                    # warming disabled: reference-style lazy load — the
+                    # compile happens synchronously in the operator, and
+                    # the batch loop stalls for it (the cost async_warmup
+                    # exists to avoid; see tests/test_async_serving.py SLO)
+                    if mid not in self._failed:
+                        try:
+                            model = self.registry.model(mid)
+                        except FlinkJpmmlTpuError:
+                            self._failed.add(mid)
+                            model = None
+                elif mid is not None:
+                    # double-buffered swap (SURVEY §8(d)): a ready model is
+                    # used as-is; while a *new* version is still compiling
+                    # in the background (or failed to), unpinned events
+                    # keep scoring the newest warm version and pinned-cold
+                    # events go empty — the batch loop never stalls on a
+                    # compile. Only the first deployment of a name (nothing
+                    # warm to serve) blocks, joining the in-flight warm.
+                    if mid not in self._failed:
+                        model = self.registry.model_if_warm(mid)
+                        if (
+                            model is None
+                            and self.registry.warm_error(mid) is not None
+                        ):
+                            self._failed.add(mid)
+                    if model is None:
+                        fb = self.registry.resolve_warm(name)
+                        if version is None and fb is not None and fb != mid:
+                            model = self.registry.model_if_warm(fb)
+                            if model is not None:
+                                key = fb.key()
+                        if model is None and mid not in self._failed:
+                            if fb is not None and self.registry.is_warming(
+                                mid
+                            ):
+                                pass  # empty lanes this batch, no stall
+                            else:
+                                try:
+                                    model = self.registry.model(mid)
+                                except FlinkJpmmlTpuError:
+                                    # bad path / uncompilable document →
+                                    # lanes go empty, id quarantined, the
+                                    # stream lives
+                                    self._failed.add(mid)
+                                    model = None
+            if model is None:
+                unserved.append(i)
+                continue
+            g = groups.get(key)
+            if g is None:
+                groups[key] = (model, [i], [payload])
+            else:
+                g[1].append(i)
+                g[2].append(payload)
+
+        tickets = []
+        for key, (model, idxs, payloads) in groups.items():
+            first = payloads[0]
+            if isinstance(first, dict):
+                X, M = prepare.from_records(model.field_space, payloads)
+            else:
+                X, M = prepare.from_dense(
+                    model.field_space,
+                    np.asarray(payloads, np.float32),
+                    self._replace_nan,
+                )
+            # rank-wire fast path per served model (qtrees.py; cached on
+            # the CompiledModel, so the probe is free after the first batch)
+            q = model.quantized_scorer()
+            if q is not None:
+                # predict_wire owns batch-size alignment (padding/chunking)
+                Xq = q.wire.encode(X, M)
+                tickets.append((q, idxs, q.predict_wire(Xq)))
+                continue
+            if model.batch_size is not None:
+                X, M, _ = prepare.pad_batch(X, M, model.batch_size)
+            out = model.predict(X, M)  # async dispatch per group
+            tickets.append((model, idxs, out))
+        return (n, records, tickets, unserved)
+
+    def finish(self, ticket) -> List[Any]:
+        n, records, tickets, unserved = ticket
+        preds: List[Optional[Prediction]] = [None] * n
+        for model, idxs, out in tickets:
+            decoded = model.decode(out, len(idxs))
+            for i, p in zip(idxs, decoded):
+                preds[i] = p
+        for i in unserved:
+            preds[i] = Prediction.empty()
+        if self._emit is not None:
+            return self._emit(records, preds)
+        if self._emit_pairs:
+            return [(p, r) for p, r in zip(preds, records)]
+        return list(preds)
+
+    # -- checkpointed operator state (C6/C7) ------------------------------
+
+    def state(self) -> dict:
+        return self.registry.state()
+
+    def restore(self, state: dict) -> None:
+        self.registry.restore(state)

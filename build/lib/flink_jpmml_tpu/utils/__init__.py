@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: exceptions, config, metrics, logging."""

@@ -1,0 +1,97 @@
+"""Metrics registry: counters + latency reservoirs (SURVEY.md §6).
+
+The reference exposed only slf4j logging and Flink's UI metrics; our runtime
+owns its observability: records/sec, batch fill ratio, p50/p99 per-record
+latency — the BASELINE metrics — via a small lock-guarded registry with
+structured snapshots. No external metrics framework.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Counter:
+    value: float = 0.0
+    _lock: threading.Lock = dc_field(default_factory=threading.Lock, repr=False)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def get(self) -> float:
+        with self._lock:
+            return self.value
+
+
+class Reservoir:
+    """Fixed-size sampling reservoir for latency quantiles.
+
+    Keeps the most recent ``capacity`` observations (ring buffer — streaming
+    latencies are non-stationary, recent beats uniform).
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self._buf: List[float] = []
+        self._capacity = capacity
+        self._idx = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            if len(self._buf) < self._capacity:
+                self._buf.append(v)
+            else:
+                self._buf[self._idx] = v
+                self._idx = (self._idx + 1) % self._capacity
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._buf:
+                return None
+            s = sorted(self._buf)
+        pos = min(int(q * len(s)), len(s) - 1)
+        return s[pos]
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+
+class MetricsRegistry:
+    """Named counters and reservoirs with a one-call snapshot."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self._reservoirs: Dict[str, Reservoir] = {}
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def reservoir(self, name: str) -> Reservoir:
+        with self._lock:
+            return self._reservoirs.setdefault(name, Reservoir())
+
+    def snapshot(self) -> Dict[str, float]:
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        out: Dict[str, float] = {"uptime_s": elapsed}
+        with self._lock:
+            counters = dict(self._counters)
+            reservoirs = dict(self._reservoirs)
+        for name, c in counters.items():
+            v = c.get()
+            out[name] = v
+            out[name + "_per_s"] = v / elapsed
+        for name, r in reservoirs.items():
+            for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                v = r.quantile(q)
+                if v is not None:
+                    out[f"{name}_{tag}"] = v
+        return out

@@ -1,0 +1,70 @@
+"""Tracing / profiling hooks (SURVEY.md §6 row "Tracing / profiling").
+
+The reference exposes Flink's web-UI metrics and backpressure monitors; the
+TPU-native equivalents here are:
+
+- :func:`trace` — a context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace directory (XLA op timeline, HBM usage);
+- :class:`StageTimer` — lightweight wall-clock accounting per pipeline
+  stage (featurize / h2d+dispatch / readback / sink), feeding the metrics
+  registry so ``snapshot()`` shows where stream time goes;
+- :func:`annotate` — a ``TraceAnnotation`` wrapper so runtime stages show
+  up as named spans inside the device trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``log_dir`` (TensorBoard format).
+
+    Usage::
+
+        with profiling.trace("/tmp/fjt-trace"):
+            pipeline.run_until_exhausted()
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span inside the device trace (no-op overhead when not tracing)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StageTimer:
+    """Per-stage wall-clock accounting into a :class:`MetricsRegistry`.
+
+    Each ``stage(name)`` context adds its elapsed seconds to the counter
+    ``stage_<name>_s``; the registry snapshot then shows the share of
+    pipeline time per stage.
+    """
+
+    def __init__(self, metrics: Optional[MetricsRegistry] = None):
+        self.metrics = metrics or MetricsRegistry()
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.metrics.counter(f"stage_{name}_s").inc(
+                time.perf_counter() - t0
+            )

@@ -605,10 +605,10 @@ class TestEntityOutputs:
         from flink_jpmml_tpu.pmml.interp import evaluate
 
         xml = MVW_KMEANS.replace(
-            "<MiningSchema>",
+            "</MiningSchema>",
+            "</MiningSchema>"
             '<Output><OutputField name="cluster" feature="entityId"/>'
-            '<OutputField name="dist" feature="affinity"/></Output>'
-            "<MiningSchema>",
+            '<OutputField name="dist" feature="affinity"/></Output>',
         )
         doc = parse_pmml(xml)
         cm = compile_pmml(doc)
@@ -624,9 +624,10 @@ class TestEntityOutputs:
         from flink_jpmml_tpu.pmml.interp import evaluate
 
         xml = MVW_KMEANS.replace(
-            "<MiningSchema>",
+            "</MiningSchema>",
+            "</MiningSchema>"
             '<Output><OutputField name="d2" feature="affinity" value="c2"/>'
-            "</Output><MiningSchema>",
+            "</Output>",
         )
         doc = parse_pmml(xml)
         cm = compile_pmml(doc)

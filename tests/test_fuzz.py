@@ -189,7 +189,7 @@ def _assert_parity(doc, recs, where):
                 # value when rounding kept it in range)
                 assert (
                     np.isinf(p.score.value)
-                    or abs(p.score.value) > 1e38
+                    or abs(p.score.value) > 3.3e38
                 ) and np.sign(p.score.value) == np.sign(o.value), (
                     f"{ctx}: f32-overflow {p.score.value!r} vs {o.value!r}"
                 )

@@ -1,0 +1,47 @@
+"""FJT_XLA_CACHE: opt-in persistent XLA compilation cache — a restarted
+worker warms compiled models from disk instead of recompiling."""
+
+import os
+import subprocess
+import sys
+
+
+def test_cache_populates_and_reloads(tmp_path):
+    cache = str(tmp_path / "xla")
+    prog = """
+import tempfile, time
+import flink_jpmml_tpu
+import jax
+# the production threshold (0.5s) skips trivial compiles; persist
+# everything for this tiny test model
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+from flink_jpmml_tpu.assets_gen import gen_gbm
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+d = tempfile.mkdtemp()
+doc = parse_pmml_file(gen_gbm(d, n_trees=20, depth=4, n_features=6))
+t0 = time.time()
+compile_pmml(doc, batch_size=256).warmup()
+print(f"COMPILE_S={time.time()-t0:.2f}")
+"""
+    env = dict(
+        os.environ,
+        FJT_PLATFORM="cpu",
+        FJT_XLA_CACHE=cache,
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    r1 = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=240,
+    )
+    assert r1.returncode == 0, r1.stderr[-800:]
+    entries = os.listdir(cache)
+    assert entries, "persistent cache stayed empty after a compile"
+    # second process: same model compiles against the populated cache
+    r2 = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=240,
+    )
+    assert r2.returncode == 0, r2.stderr[-800:]
+    assert "COMPILE_S=" in r2.stdout
