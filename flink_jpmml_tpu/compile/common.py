@@ -110,6 +110,10 @@ class LowerCtx:
     field_index: Dict[str, int]
     codecs: Dict[str, Dict[str, float]] = dc_field(default_factory=dict)
     config: CompileConfig = dc_field(default_factory=CompileConfig)
+    # True inside MiningModel segments: entity-surface extras (KNN
+    # neighbor-index columns) stay off so ensemble blends see uniform
+    # probs shapes; entity outputs are top-level-model features
+    nested: bool = False
 
     @property
     def n_fields(self) -> int:

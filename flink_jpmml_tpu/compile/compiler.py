@@ -146,8 +146,14 @@ class CompiledModel:
     # the per-segment outputs mapping
     _segment_ids: Optional[Tuple[str, ...]] = None
     # clustering: its probabilities mapping holds per-entity comparison
-    # scores — the entityId/affinity output features read it
+    # scores — the entityId/affinity output features read it; the order
+    # ("asc" distances / "desc" similarities) ranks entities for rank-k
+    # entityId
     _entity_scores: bool = False
+    _entity_order: Optional[str] = None
+    # KNN instanceIdVariable: (instance ids, k, n_label_columns) — the
+    # last k probs columns are ranked neighbor indices
+    _neighbor_meta: Optional[tuple] = None
 
     @property
     def is_classification(self) -> bool:
@@ -259,7 +265,9 @@ class CompiledModel:
             idx = np.asarray(out.label_idx)[:n]
             labels = [self.labels[i] for i in idx]
             # association: probs is the fired-rule mask, not class
-            # probabilities — consumed below for ruleValue ranking
+            # probabilities — consumed below for ruleValue ranking.
+            # KNN-with-ids: only the first L columns are vote shares
+            # (the rest are ranked neighbor indices)
             if out.probs is not None and self._rule_meta is None:
                 P = np.asarray(out.probs)[:n]
                 probabilities = [
@@ -303,6 +311,7 @@ class CompiledModel:
                     meta.rank(P[i, :C], P[i, C:].astype(np.int32))
                     for i in range(P.shape[0])
                 ]
+            rankings = self._entity_rankings(out, n)
             rank_rows = None
             if self._rule_meta is not None and out.probs is not None and any(
                 of.feature == "ruleValue" for of in self.output_fields
@@ -339,11 +348,37 @@ class CompiledModel:
                             if self._entity_scores and p.target
                             else None
                         ),
+                        entity_ranking=(
+                            rankings[i] if rankings is not None else None
+                        ),
                     ),
                 )
                 for i, p in enumerate(preds)
             ]
         return preds
+
+    def _entity_rankings(self, out, n):
+        """Per-record best-first entity ids for rank-k entityId decode:
+        clustering sorts its score row; KNN-with-ids reads the ranked
+        neighbor-index columns the kernel appended."""
+        if not any(of.feature == "entityId" for of in self.output_fields):
+            return None
+        if self._neighbor_meta is not None and out.probs is not None:
+            ids, k, L = self._neighbor_meta
+            P = np.asarray(out.probs)[:n]
+            idx = P[:, L:].astype(np.int64)  # ranked neighbor indices
+            return [
+                tuple(ids[j] for j in idx[i]) for i in range(idx.shape[0])
+            ]
+        if self._entity_order is not None and out.probs is not None:
+            P = np.asarray(out.probs)[:n]
+            sign = 1.0 if self._entity_order == "asc" else -1.0
+            order = np.argsort(sign * P, axis=1, kind="stable")
+            return [
+                tuple(self.labels[j] for j in order[i])
+                for i in range(order.shape[0])
+            ]
+        return None
 
 
 def compile_pmml(
@@ -510,6 +545,21 @@ def compile_pmml(
         )
     name = getattr(doc.model, "model_name", None)
     entity_scores = isinstance(doc.model, ir.ClusteringModelIR)
+    entity_order = None
+    if entity_scores:
+        entity_order = (
+            "desc" if doc.model.measure.kind == "similarity" else "asc"
+        )
+    neighbor_meta = None
+    if (
+        isinstance(doc.model, ir.NearestNeighborIR)
+        and doc.model.instance_ids
+    ):
+        neighbor_meta = (
+            doc.model.instance_ids,
+            doc.model.n_neighbors,
+            len(lowered.labels),
+        )
     return CompiledModel(
         field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
         labels=lowered.labels,
@@ -527,4 +577,6 @@ def compile_pmml(
         _target_field=doc.target_field,
         _segment_ids=segment_ids,
         _entity_scores=entity_scores,
+        _entity_order=entity_order,
+        _neighbor_meta=neighbor_meta,
     )

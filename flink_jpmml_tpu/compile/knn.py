@@ -85,6 +85,10 @@ def lower_knn(model: ir.NearestNeighborIR, ctx: LowerCtx) -> Lowered:
             ) from None
 
     L = len(labels)
+    # neighbor-index columns only surface for a TOP-LEVEL model:
+    # inside MiningModel segments they would skew ensemble probs shapes,
+    # and entity outputs are top-level-model features anyway
+    surface_ids = bool(model.instance_ids) and not ctx.nested
     params = {"S": S}
     if classification:
         params["lab"] = lab_of.astype(np.float32)
@@ -115,7 +119,15 @@ def lower_knn(model: ir.NearestNeighborIR, ctx: LowerCtx) -> Lowered:
             probs = votes / jnp.maximum(
                 jnp.sum(votes, axis=1, keepdims=True), _EPS
             )
-            value = jnp.take_along_axis(probs, lab[:, None], axis=1)[:, 0]
+            if surface_ids:
+                # append the ranked neighbor indices: decode maps them
+                # through instance_ids for rank-k entityId outputs
+                probs = jnp.concatenate(
+                    [probs, idx.astype(jnp.float32)], axis=1
+                )  # [B, L + k]
+            value = jnp.take_along_axis(
+                probs[:, :L], lab[:, None], axis=1
+            )[:, 0]
             return ModelOutput(
                 value=value.astype(jnp.float32),
                 valid=~missing,
@@ -137,13 +149,14 @@ def lower_knn(model: ir.NearestNeighborIR, ctx: LowerCtx) -> Lowered:
                 return ModelOutput(
                     value=value.astype(jnp.float32),
                     valid=~missing & (tw > 0),
-                    probs=None,
+                    probs=idx.astype(jnp.float32) if surface_ids else None,
                     label_idx=None,
                 )
         return ModelOutput(
             value=value.astype(jnp.float32),
             valid=~missing,
-            probs=None,
+            # ranked neighbor indices for rank-k entityId decode
+            probs=idx.astype(jnp.float32) if surface_ids else None,
             label_idx=None,
         )
 

@@ -94,10 +94,17 @@ def lower_mining(model: ir.MiningModelIR, ctx: LowerCtx) -> Lowered:
 # ---------------------------------------------------------------------------
 
 
+def _nested(ctx):
+    import dataclasses
+
+    return ctx if ctx.nested else dataclasses.replace(ctx, nested=True)
+
+
 def _lower_segments(segments, ctx) -> List[Lowered]:
     from flink_jpmml_tpu.compile.compiler import lower_model  # no cycle at import
 
-    return [lower_model(s.model, ctx) for s in segments]
+    sub = _nested(ctx)
+    return [lower_model(s.model, sub) for s in segments]
 
 
 def _lower_chain(segments: Tuple[ir.Segment, ...], ctx: LowerCtx) -> Lowered:
@@ -118,7 +125,7 @@ def _lower_chain(segments: Tuple[ir.Segment, ...], ctx: LowerCtx) -> Lowered:
             if isinstance(seg.predicate, ir.TruePredicate)
             else lower_predicate(seg.predicate, cur_ctx)
         )
-        low = lower_model(seg.model, cur_ctx)
+        low = lower_model(seg.model, _nested(cur_ctx))
         params[f"s{i}"] = low.params
         outs = []
         new_names: List[str] = []

@@ -51,6 +51,9 @@ class EvalResult:
     reason_codes: Tuple[str, ...] = ()  # scorecard, ranked worst-first
     # association: fired rules' metadata best-first (rank-k ruleValue)
     rule_ranking: Tuple[Dict[str, object], ...] = ()
+    # entity ids best-first (clusters by score; KNN neighbors by
+    # nearness) — rank-k entityId outputs index it
+    entity_ranking: Tuple[str, ...] = ()
 
     @property
     def is_missing(self) -> bool:
@@ -272,6 +275,7 @@ def evaluate(doc: ir.PmmlDocument, record: Record) -> EvalResult:
                 if isinstance(doc.model, ir.ClusteringModelIR)
                 else None
             ),
+            entity_ranking=res.entity_ranking or None,
         )
     return res
 
@@ -998,10 +1002,16 @@ def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
             cl.cluster_id or cl.name or str(i + 1)
             for i, cl in enumerate(model.clusters)
         ]
-        return EvalResult(
+        res = EvalResult(
             value=float(best_idx), label=labels[best_idx],
             probabilities=dict(zip(labels, sims)),
         )
+        res.entity_ranking = tuple(
+            labels[i] for i in sorted(
+                range(len(sims)), key=lambda i: (-sims[i], i)
+            )
+        )
+        return res
     cmp_codes, gauss_s = resolve_compare(model)
     mink_p = float(model.measure.minkowski_p)
     best_idx, best_dist = -1, math.inf
@@ -1057,8 +1067,14 @@ def _eval_clustering(model: ir.ClusteringModelIR, record: Record) -> EvalResult:
     # per-cluster distances keyed by cluster label — the same shape the
     # compiled decode exposes (target.probabilities), so top-level
     # <Output> probability fields agree between the two paths
-    return EvalResult(value=float(best_idx), label=labels[best_idx],
-                      probabilities=dict(zip(labels, dists)))
+    res = EvalResult(value=float(best_idx), label=labels[best_idx],
+                     probabilities=dict(zip(labels, dists)))
+    res.entity_ranking = tuple(
+        labels[i] for i in sorted(
+            range(len(dists)), key=lambda i: (dists[i], i)
+        )
+    )
+    return res
 
 
 # --- GeneralRegressionModel ------------------------------------------------
@@ -1516,6 +1532,12 @@ def _knn_aggregate(
     def nb_weight(i: int) -> float:
         return ds[i] if similarity else 1.0 / (ds[i] + eps)
 
+    ranking = (
+        tuple(model.instance_ids[i] for i in order)
+        if model.instance_ids
+        else ()
+    )
+
     if model.function_name == "classification":
         if model.categorical_scoring not in (
             "majorityVote", "weightedMajorityVote",
@@ -1538,8 +1560,10 @@ def _knn_aggregate(
                 label = c
         total = sum(votes.values())
         probs = {c: votes[c] / max(total, eps) for c in labels}
-        return EvalResult(value=probs[label], label=label,
-                          probabilities=probs)
+        res = EvalResult(value=probs[label], label=label,
+                         probabilities=probs)
+        res.entity_ranking = ranking
+        return res
     m = model.continuous_scoring
     if m not in ("average", "median", "weightedAverage"):
         raise ModelCompilationException(
@@ -1568,7 +1592,9 @@ def _knn_aggregate(
             # neighbor has all-zero weights — undefined average, empty
             return EvalResult()
         value = sum(y * w for y, w in zip(yk, ws)) / tw
-    return EvalResult(value=value)
+    res = EvalResult(value=value)
+    res.entity_ranking = ranking
+    return res
 
 
 # --- AnomalyDetection ------------------------------------------------------
@@ -1897,7 +1923,12 @@ def _eval_mining(model: ir.MiningModelIR, record: Record) -> EvalResult:
     if method == "selectFirst":
         for seg in segments:
             if eval_predicate(seg.predicate, record) is True:
-                return _eval_model(seg.model, record)
+                res = _eval_model(seg.model, record)
+                # entity facets (neighbor ids, cluster rankings) are
+                # top-level-model features: the compiled ensemble path
+                # cannot surface them, so neither does the oracle
+                res.entity_ranking = ()
+                return res
         return EvalResult()
 
     if method == "selectAll":

@@ -606,6 +606,10 @@ class NearestNeighborIR:
     targets: Tuple[str, ...]  # [N] target values (labels or numerics)
     continuous_scoring: str = "average"  # | median | weightedAverage
     categorical_scoring: str = "majorityVote"  # | weightedMajorityVote
+    # instanceIdVariable: neighbor identities; entityId rank-k outputs
+    # surface the kth nearest neighbor's id
+    instance_id_variable: Optional[str] = None
+    instance_ids: Tuple[str, ...] = ()
     model_name: Optional[str] = None
 
 

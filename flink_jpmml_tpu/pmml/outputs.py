@@ -59,10 +59,14 @@ def validate_output_fields(
                 f"unsupported OutputField feature {of.feature!r} "
                 f"(supported: {', '.join(_FEATURES)})"
             )
-        if of.feature in ("entityId", "affinity") and of.rank != 1:
+        if of.feature == "affinity" and of.rank != 1:
             raise ModelCompilationException(
-                f"OutputField {of.name!r}: rank-k {of.feature} is not "
+                f"OutputField {of.name!r}: rank-k affinity is not "
                 "supported (rank must be 1)"
+            )
+        if of.feature == "entityId" and of.rank < 1:
+            raise ModelCompilationException(
+                f"OutputField {of.name!r}: entityId rank must be >= 1"
             )
         if of.feature == "ruleValue" and of.rule_feature not in _RULE_FEATURES:
             raise ModelCompilationException(
@@ -90,6 +94,7 @@ def compute_outputs(
     reason_codes: Optional[Sequence[str]] = None,
     rule_ranking: Optional[Sequence[Mapping[str, object]]] = None,
     entity_scores: Optional[Mapping[str, float]] = None,
+    entity_ranking: Optional[Sequence[str]] = None,
 ) -> Dict[str, object]:
     """One record's model result → its <Output> field values, in
     declaration order (later transformedValues see earlier outputs).
@@ -100,7 +105,10 @@ def compute_outputs(
     per-entity comparison-score mapping for families that surface one
     (clustering distances/similarities); entityId/affinity read it and
     yield None elsewhere — a class-probability map is NOT a comparison
-    score and must not leak through affinity."""
+    score and must not leak through affinity. ``entity_ranking`` is the
+    best-first entity-id list (clusters by score; KNN neighbors by
+    nearness when the document declares instanceIdVariable): an
+    entityId field's ``rank`` indexes it."""
     from flink_jpmml_tpu.pmml.interp import eval_expression
 
     probs = probabilities or {}
@@ -113,9 +121,19 @@ def compute_outputs(
             key = of.target_value if of.target_value is not None else label
             out[of.name] = probs.get(key) if key is not None else None
         elif of.feature == "entityId":
-            # the winning entity's identifier, only where the family
-            # surfaces entities (clustering: the cluster id)
-            out[of.name] = label if entity_scores is not None else None
+            # the rank-kth entity's identifier where the family surfaces
+            # an entity ranking (clusters by score; KNN neighbors by
+            # nearness); rank 1 without a ranking falls back to the
+            # winner where entity scores exist
+            if entity_ranking is not None:
+                er = entity_ranking
+                out[of.name] = (
+                    er[of.rank - 1] if 0 < of.rank <= len(er) else None
+                )
+            elif of.rank == 1 and entity_scores is not None:
+                out[of.name] = label
+            else:
+                out[of.name] = None
         elif of.feature == "affinity":
             # the requested entity's comparison score (the ``value``
             # attribute picks one; absent = the winner's)

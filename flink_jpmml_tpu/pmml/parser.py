@@ -1063,7 +1063,7 @@ def _parse_gaussian_process(elem: ET.Element) -> ir.GaussianProcessIR:
             "GaussianProcessModel needs a target MiningField"
         )
     inputs = schema.active_fields
-    instances, raw_targets = _parse_training_instances(
+    instances, raw_targets, _ = _parse_training_instances(
         _req_child(elem, "TrainingInstances"), inputs, target
     )
     try:
@@ -1336,11 +1336,13 @@ def _parse_training_instances(
     ti: ET.Element,
     feature_fields: Sequence[str],
     target_field: str,
-) -> Tuple[Tuple[Tuple[float, ...], ...], Tuple[str, ...]]:
+    id_field: Optional[str] = None,
+):
     """Shared TrainingInstances/InstanceFields/InlineTable walk (KNN, GP).
 
     → (feature rows as float tuples in ``feature_fields`` order, raw
-    target strings). Every feature field and the target must have an
+    target strings[, raw id strings when ``id_field`` is given]). Every
+    feature field, the target, and the id field must have an
     InstanceField column; only InlineTable bodies are supported."""
     ifields = {
         f.get("field", ""): f.get("column", f.get("field", ""))
@@ -1355,6 +1357,10 @@ def _parse_training_instances(
         raise ModelLoadingException(
             f"target {target_field!r} has no InstanceField column"
         )
+    if id_field is not None and id_field not in ifields:
+        raise ModelLoadingException(
+            f"instanceIdVariable {id_field!r} has no InstanceField column"
+        )
     table = _child(ti, "InlineTable")
     if table is None:
         raise ModelLoadingException(
@@ -1362,6 +1368,7 @@ def _parse_training_instances(
         )
     instances = []
     targets = []
+    ids = []
     for row in _children(table, "row"):
         cells = {_local(c.tag): (c.text or "").strip() for c in row}
         coords = []
@@ -1385,9 +1392,16 @@ def _parse_training_instances(
             )
         instances.append(tuple(coords))
         targets.append(cells[tcol])
+        if id_field is not None:
+            icol = ifields[id_field]
+            if icol not in cells:
+                raise ModelLoadingException(
+                    f"training row missing id column {icol!r}"
+                )
+            ids.append(cells[icol])
     if not instances:
         raise ModelLoadingException("TrainingInstances has no rows")
-    return tuple(instances), tuple(targets)
+    return tuple(instances), tuple(targets), tuple(ids)
 
 
 def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
@@ -1409,10 +1423,12 @@ def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
         raise ModelLoadingException(
             "NearestNeighborModel needs a target MiningField"
         )
-    instances, targets = _parse_training_instances(
+    id_var = elem.get("instanceIdVariable")
+    instances, targets, instance_ids = _parse_training_instances(
         _req_child(elem, "TrainingInstances"),
         [ki.field for ki in inputs],
         target,
+        id_field=id_var,
     )
     k = _int(elem, "numberOfNeighbors", 3)
     if not 1 <= k <= len(instances):
@@ -1433,6 +1449,8 @@ def _parse_nearest_neighbor(elem: ET.Element) -> ir.NearestNeighborIR:
         categorical_scoring=elem.get(
             "categoricalScoringMethod", "majorityVote"
         ),
+        instance_id_variable=id_var,
+        instance_ids=instance_ids,
         model_name=elem.get("modelName"),
     )
 

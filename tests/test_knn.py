@@ -326,3 +326,130 @@ class TestBinarySimilarity:
         rec = {f: 0.0 for f in doc.active_fields}
         assert evaluate(doc, rec).value is None
         assert cm.score_records([rec])[0].is_empty
+
+
+class TestInstanceIds:
+    def _xml_with_ids(self, function="classification", target="cls",
+                      attrs=""):
+        xml = _knn_xml(function=function, target=target, attrs=attrs)
+        # give every training row an id column and declare the variable
+        rows = "".join(
+            f"<row><u>{u}</u><v>{v}</v><cls>{c}</cls><yv>{y}</yv>"
+            f"<rid>row{i}</rid></row>"
+            for i, (u, v, c, y) in enumerate(ROWS)
+        )
+        import re
+
+        xml = re.sub(r"<InlineTable>.*</InlineTable>",
+                     f"<InlineTable>{rows}</InlineTable>", xml, flags=re.S)
+        xml = xml.replace(
+            "<InstanceFields>",
+            '<InstanceFields><InstanceField field="rid" column="rid"/>',
+        ).replace(
+            "<NearestNeighborModel",
+            '<NearestNeighborModel instanceIdVariable="rid"',
+            1,
+        )
+        return xml
+
+    def _with_output(self, xml, n_ranks=3):
+        fields = "".join(
+            f'<OutputField name="nb{r}" feature="entityId" rank="{r}"/>'
+            for r in range(1, n_ranks + 1)
+        )
+        return xml.replace(
+            "</MiningSchema>", f"</MiningSchema><Output>{fields}</Output>"
+        )
+
+    def test_rank_k_neighbor_ids_classification(self):
+        doc = parse_pmml(self._with_output(self._xml_with_ids()))
+        cm = compile_pmml(doc)
+        # query (0.1, 0.1): nearest rows 0, 1, 2 in that order
+        rec = {"u": 0.1, "v": 0.1}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.outputs == {"nb1": "row0", "nb2": "row1", "nb3": "row2"}
+        assert p.outputs == o.outputs
+        # near row 4 (2,2): nb1 = row4
+        rec = {"u": 2.1, "v": 2.0}
+        assert evaluate(doc, rec).outputs["nb1"] == "row4"
+        assert cm.score_records([rec])[0].outputs["nb1"] == "row4"
+
+    def test_rank_k_neighbor_ids_regression(self):
+        doc = parse_pmml(self._with_output(
+            self._xml_with_ids(function="regression", target="yv")
+        ))
+        cm = compile_pmml(doc)
+        rec = {"u": 0.0, "v": 0.0}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.outputs["nb1"] == "row0" == p.outputs["nb1"]
+        assert o.value == pytest.approx(2.0)
+        assert p.score.value == pytest.approx(2.0, rel=1e-6)
+
+    def test_rank_beyond_k_is_none(self):
+        doc = parse_pmml(self._with_output(self._xml_with_ids(), n_ranks=5))
+        cm = compile_pmml(doc)
+        rec = {"u": 0.1, "v": 0.1}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.outputs["nb4"] is None and o.outputs["nb5"] is None
+        assert p.outputs["nb4"] is None and p.outputs["nb5"] is None
+
+    def test_missing_id_column_rejected(self):
+        from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
+
+        xml = _knn_xml().replace(
+            "<NearestNeighborModel",
+            '<NearestNeighborModel instanceIdVariable="rid"',
+            1,
+        )
+        with pytest.raises(ModelLoadingException, match="instanceIdVariable"):
+            parse_pmml(xml)
+
+    def test_clustering_rank_k_entity_ids(self):
+        from tests.test_compile_golden import MVW_KMEANS
+
+        xml = MVW_KMEANS.replace(
+            "</MiningSchema>",
+            "</MiningSchema><Output>"
+            '<OutputField name="c1st" feature="entityId" rank="1"/>'
+            '<OutputField name="c2nd" feature="entityId" rank="2"/>'
+            "</Output>",
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        rec = {"a": 1.0, "b": 0.5, "c": 0.5}  # closer to c1
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert o.outputs == {"c1st": "c1", "c2nd": "c2"} == p.outputs
+
+    def test_nested_knn_with_ids_in_select_first(self):
+        """A KNN segment declaring instanceIdVariable inside a
+        selectFirst ensemble must compile (uniform probs shapes) and
+        agree with the oracle — entity outputs are top-level features,
+        so both paths yield None for entityId here."""
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        inner = self._xml_with_ids()
+        model = inner[
+            inner.index("<NearestNeighborModel"):
+            inner.index("</NearestNeighborModel>")
+            + len("</NearestNeighborModel>")
+        ]
+        xml = inner[: inner.index("<NearestNeighborModel")] + f"""
+          <MiningModel functionName="classification">
+          <MiningSchema><MiningField name="cls" usageType="target"/>
+            <MiningField name="u"/><MiningField name="v"/></MiningSchema>
+          <Output><OutputField name="nb1" feature="entityId" rank="1"/>
+          </Output>
+          <Segmentation multipleModelMethod="selectFirst">
+            <Segment><True/>{model}</Segment>
+          </Segmentation></MiningModel></PMML>"""
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)  # must not raise on probs shapes
+        rec = {"u": 0.1, "v": 0.1}
+        o = evaluate(doc, rec)
+        p = cm.score_records([rec])[0]
+        assert p.target.label == o.label
+        assert o.outputs["nb1"] is None and p.outputs["nb1"] is None
