@@ -59,16 +59,15 @@ def _kernel_matrix_np(
     return kernel.gamma * np.exp(-s)
 
 
-def lower_gp(model: ir.GaussianProcessIR, ctx: LowerCtx) -> Lowered:
-    if model.function_name != "regression":
-        raise ModelCompilationException(
-            "GaussianProcessModel supports functionName=regression only"
-        )
-    cols = np.asarray([ctx.column(f) for f in model.inputs], np.int32)
+def gp_prescale(model: ir.GaussianProcessIR):
+    """Compile-time GP state shared by the single-device lowering and
+    the model-parallel scorer (parallel/sharding.py mp_gp):
+    → (alpha f64[N], lam f32[D], Zs f32[N,D], Zs_sq f32[N], sq_family).
+    The regularized solve runs in float64 with the typed singular-matrix
+    rejection."""
     Xtr = np.asarray(model.instances, np.float64)
     y = np.asarray(model.targets, np.float64)
     N, D = Xtr.shape
-
     K = _kernel_matrix_np(model.kernel, Xtr, Xtr)
     reg = K + model.kernel.noise_variance * np.eye(N)
     try:
@@ -78,12 +77,27 @@ def lower_gp(model: ir.GaussianProcessIR, ctx: LowerCtx) -> Lowered:
             "GP kernel matrix K + noiseVariance*I is singular; increase "
             "noiseVariance or deduplicate training instances"
         ) from None
-
-    kern = model.kernel
-    lam = np.asarray(kern.lambdas, np.float32)
+    lam = np.asarray(model.kernel.lambdas, np.float32)
     if lam.shape[0] == 1:
         lam = np.full((D,), lam[0], np.float32)
-    sq_family = kern.kind in ("radialBasis", "ARDSquaredExponential")
+    sq_family = model.kernel.kind in (
+        "radialBasis", "ARDSquaredExponential"
+    )
+    Zs = Zs_sq = None
+    if sq_family:
+        Zs = (Xtr / lam.astype(np.float64)).astype(np.float32)
+        Zs_sq = (Zs ** 2).sum(-1).astype(np.float32)
+    return alpha, lam, Zs, Zs_sq, sq_family
+
+
+def lower_gp(model: ir.GaussianProcessIR, ctx: LowerCtx) -> Lowered:
+    if model.function_name != "regression":
+        raise ModelCompilationException(
+            "GaussianProcessModel supports functionName=regression only"
+        )
+    cols = np.asarray([ctx.column(f) for f in model.inputs], np.int32)
+    kern = model.kernel
+    alpha, lam, Zs, Zs_sq, sq_family = gp_prescale(model)
 
     params = {
         "alpha": alpha.astype(np.float32),
@@ -92,11 +106,10 @@ def lower_gp(model: ir.GaussianProcessIR, ctx: LowerCtx) -> Lowered:
     if sq_family:
         # pre-scaled training rows: d² = ‖xs‖² + ‖zs‖² − 2·xs·zsᵀ keeps
         # the [B, N] kernel block on the MXU with no [B, N, D] tensor
-        Zs = (Xtr / lam.astype(np.float64)).astype(np.float32)
         params["Zs"] = Zs
-        params["Zs_sq"] = (Zs ** 2).sum(-1).astype(np.float32)
+        params["Zs_sq"] = Zs_sq
     else:
-        params["Ztr"] = Xtr.astype(np.float32)
+        params["Ztr"] = np.asarray(model.instances, np.float32)
 
     gamma = float(kern.gamma)
     degree = float(kern.degree)

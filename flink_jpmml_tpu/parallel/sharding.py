@@ -196,3 +196,85 @@ class TpLinearScorer:
                 f"size {n_data} (pad the micro-batch)"
             )
         return self._jit_fn(self._W, self._b, X)
+
+
+def mp_gp(mesh: Mesh, model) -> "callable":
+    """Model-parallel GP inference: training instances sharded over the
+    ``model`` axis.
+
+    GP scoring is ``μ(x) = k(x, X_train)ᵀ α`` — a [B, N] kernel block
+    against N stored instances. For large training sets N dominates
+    memory and FLOPs, so each device holds an instance shard (its slice
+    of the pre-scaled rows and of α), computes its partial
+    ``k(x, X_shard) @ α_shard``, and a single ``psum`` over the model
+    axis (ICI) combines the partials; the batch stays sharded over the
+    ``data`` axis throughout. Squared-exponential kernels only (their
+    ‖x−z‖² matmul expansion is what shards cleanly); others raise.
+
+    ``model`` is a :class:`~flink_jpmml_tpu.pmml.ir.GaussianProcessIR`.
+    → fn(X f32[B, D]) -> f32[B] with B divisible by the data axis.
+    """
+    from flink_jpmml_tpu.compile.gp import gp_prescale
+    from flink_jpmml_tpu.pmml import ir
+    from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+    if not isinstance(model, ir.GaussianProcessIR):
+        raise ModelCompilationException("mp_gp takes a GaussianProcessIR")
+    if model.kernel.kind not in ("radialBasis", "ARDSquaredExponential"):
+        raise ModelCompilationException(
+            "mp_gp supports the squared-exponential kernels "
+            "(radialBasis, ARDSquaredExponential)"
+        )
+    alpha, lam, Zs, Zs_sq, _ = gp_prescale(model)
+    N, D = Zs.shape
+    inv_lam = (1.0 / lam).astype(np.float32)
+    gamma = float(model.kernel.gamma)
+
+    n_model = mesh.shape[MODEL_AXIS]
+    pad = (-N) % n_model
+    if pad:
+        # zero-α padding rows contribute exactly 0 to the psum
+        Zs = np.concatenate([Zs, np.zeros((pad, D), np.float32)])
+        Zs_sq = np.concatenate([Zs_sq, np.zeros((pad,), np.float32)])
+        alpha = np.concatenate([alpha, np.zeros((pad,))])
+    alpha32 = alpha.astype(np.float32)
+
+    def _partial(alpha_s, Zs_s, Zssq_s, il, X):
+        xs = X * il[None, :]
+        cross = jnp.dot(xs, Zs_s.T, precision=HIGHEST)  # [B, N/m]
+        d2 = jnp.maximum(
+            jnp.sum(xs**2, axis=1, keepdims=True)
+            + Zssq_s[None, :]
+            - 2.0 * cross,
+            0.0,
+        )
+        part = jnp.dot(
+            gamma * jnp.exp(-0.5 * d2), alpha_s, precision=HIGHEST
+        )
+        return jax.lax.psum(part, MODEL_AXIS)
+
+    smapped = jax.shard_map(
+        _partial,
+        mesh=mesh,
+        in_specs=(
+            P(MODEL_AXIS),  # α: instance shards
+            P(MODEL_AXIS, None),  # pre-scaled instances
+            P(MODEL_AXIS),
+            P(),  # inverse length-scales: replicated
+            P(DATA_AXIS, None),  # X: batch sharded
+        ),
+        out_specs=P(DATA_AXIS),
+    )
+    jitted = jax.jit(smapped)
+
+    n_data = mesh.shape[DATA_AXIS]
+
+    def predict(X):
+        if X.shape[0] % n_data != 0:
+            raise InputValidationException(
+                f"batch {X.shape[0]} must divide by data-axis size "
+                f"{n_data} (pad the micro-batch)"
+            )
+        return jitted(alpha32, Zs, Zs_sq, inv_lam, X)
+
+    return predict

@@ -1918,6 +1918,8 @@ def _eval_mining(model: ir.MiningModelIR, record: Record) -> EvalResult:
                     )
             if res.is_missing:
                 return EvalResult()
+        # entity facets are top-level-model features (cf. selectFirst)
+        res.entity_ranking = ()
         return res
 
     if method == "selectFirst":

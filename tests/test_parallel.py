@@ -286,3 +286,60 @@ class TestNewFamiliesSharded:
 
         self._check(_xml("logarithmic", "inverseDocumentFrequency",
                          "cosine", "cosine"), 4)
+
+
+class TestModelParallelGp:
+    def test_instance_sharded_gp_matches_single_device(self):
+        """mp_gp: training instances sharded over the model axis, one
+        psum combines the partial kernel dots — parity vs the
+        single-device compiled GP on an 8-device mesh."""
+        from tests.test_gp_baseline_assoc import GP
+        from flink_jpmml_tpu.parallel.sharding import mp_gp
+        from flink_jpmml_tpu.pmml import parse_pmml
+
+        doc = parse_pmml(GP.format(
+            kernel='<ARDSquaredExponentialKernel gamma="1.4" '
+                   'noiseVariance="0.15"><Lambda>'
+                   '<Array n="2" type="real">0.9 1.7</Array></Lambda>'
+                   "</ARDSquaredExponentialKernel>"
+        ))
+        cm = compile_pmml(doc)
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        fn = mp_gp(mesh, doc.model)
+        rng = np.random.default_rng(7)
+        X = rng.normal(0, 1, size=(32, 2)).astype(np.float32)
+        got = np.asarray(fn(X))
+        ref = cm.predict(X, np.zeros_like(X, bool))
+        np.testing.assert_allclose(
+            got, np.asarray(ref.value), rtol=2e-5, atol=1e-6
+        )
+        # the 4 training rows pad to 2 shards of 2+pad — sharding is real
+        assert mesh.shape["model"] == 2
+
+    def test_non_sq_kernel_rejected(self):
+        from tests.test_gp_baseline_assoc import GP
+        from flink_jpmml_tpu.parallel.sharding import mp_gp
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.utils.exceptions import (
+            ModelCompilationException,
+        )
+
+        doc = parse_pmml(GP.format(
+            kernel='<AbsoluteExponentialKernel gamma="1.0" '
+                   'noiseVariance="0.1"/>'
+        ))
+        with pytest.raises(ModelCompilationException, match="squared"):
+            mp_gp(make_mesh(MeshConfig(data=4, model=2)), doc.model)
+
+    def test_indivisible_batch_rejected(self):
+        from tests.test_gp_baseline_assoc import GP
+        from flink_jpmml_tpu.parallel.sharding import mp_gp
+        from flink_jpmml_tpu.pmml import parse_pmml
+
+        doc = parse_pmml(GP.format(
+            kernel='<RadialBasisKernel gamma="1.0" noiseVariance="0.1" '
+                   'lambda="1.0"/>'
+        ))
+        fn = mp_gp(make_mesh(MeshConfig(data=4, model=2)), doc.model)
+        with pytest.raises(InputValidationException, match="divide"):
+            fn(np.zeros((30, 2), np.float32))
