@@ -5,6 +5,7 @@ from flink_jpmml_tpu.parallel.sharding import (  # noqa: F401
     ShardedModel,
     TpLinearScorer,
     dp_sharded,
+    mp_gp,
     tp_linear,
 )
 from flink_jpmml_tpu.parallel.partitioner import HashPartitioner, stable_hash  # noqa: F401

@@ -220,6 +220,10 @@ def mp_gp(mesh: Mesh, model) -> "callable":
 
     if not isinstance(model, ir.GaussianProcessIR):
         raise ModelCompilationException("mp_gp takes a GaussianProcessIR")
+    if model.function_name != "regression":
+        raise ModelCompilationException(
+            "GaussianProcessModel supports functionName=regression only"
+        )
     if model.kernel.kind not in ("radialBasis", "ARDSquaredExponential"):
         raise ModelCompilationException(
             "mp_gp supports the squared-exponential kernels "
@@ -268,6 +272,15 @@ def mp_gp(mesh: Mesh, model) -> "callable":
     jitted = jax.jit(smapped)
 
     n_data = mesh.shape[DATA_AXIS]
+    # commit the constant params to their device shards ONCE — per-call
+    # numpy args would re-transfer the whole training matrix every batch
+    # (TpLinearScorer.__post_init__ sets the same pattern)
+    alpha_d = jax.device_put(
+        alpha32, NamedSharding(mesh, P(MODEL_AXIS))
+    )
+    Zs_d = jax.device_put(Zs, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    Zssq_d = jax.device_put(Zs_sq, NamedSharding(mesh, P(MODEL_AXIS)))
+    il_d = jax.device_put(inv_lam, NamedSharding(mesh, P()))
 
     def predict(X):
         if X.shape[0] % n_data != 0:
@@ -275,6 +288,10 @@ def mp_gp(mesh: Mesh, model) -> "callable":
                 f"batch {X.shape[0]} must divide by data-axis size "
                 f"{n_data} (pad the micro-batch)"
             )
-        return jitted(alpha32, Zs, Zs_sq, inv_lam, X)
+        if X.shape[1] != D:
+            raise InputValidationException(
+                f"feature dim {X.shape[1]} != model inputs {D}"
+            )
+        return jitted(alpha_d, Zs_d, Zssq_d, il_d, X)
 
     return predict
