@@ -11,7 +11,12 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.compile.common import (
+    HIGHEST,
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+)
 from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
@@ -161,10 +166,12 @@ def make_similarity(measure: ir.ComparisonMeasure, weights: np.ndarray):
         xc = (xs <= 0.5).astype(jnp.float32) * weights[None, :]
         z = (refs > 0.5).astype(jnp.float32)
         zc = (refs <= 0.5).astype(jnp.float32)
-        a = x @ z.T  # both set
-        b = x @ zc.T  # record only
-        c = xc @ z.T  # reference only
-        d = xc @ zc.T  # neither
+        # HIGHEST: TPU's default precision runs f32 matmuls in bf16
+        # passes, which quantizes the contingency counts
+        a = jnp.matmul(x, z.T, precision=HIGHEST)  # both set
+        b = jnp.matmul(x, zc.T, precision=HIGHEST)  # record only
+        c = jnp.matmul(xc, z.T, precision=HIGHEST)  # reference only
+        d = jnp.matmul(xc, zc.T, precision=HIGHEST)  # neither
         numer = num[0] * a + num[1] * b + num[2] * c + num[3] * d
         denom = den[0] * a + den[1] * b + den[2] * c + den[3] * d
         return jnp.where(denom > 0, numer / jnp.maximum(denom, 1e-30), 0.0)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flink_jpmml_tpu.compile.common import (
+    HIGHEST,
     Lowered,
     LowerCtx,
     ModelOutput,
@@ -191,7 +192,7 @@ def lower_weighted_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
         valid = total > 0
         tz = jnp.maximum(total, 1e-30)[:, None]
         if classification:
-            probs = jnp.matmul(W, p["payload"]) / tz  # [B, C]
+            probs = jnp.matmul(W, p["payload"], precision=HIGHEST) / tz  # [B, C]
             lab = jnp.argmax(probs, axis=1).astype(jnp.int32)
             # deterministic path (all weight on one leaf): the leaf's
             # score attribute wins, exactly like the boolean-path
@@ -212,7 +213,9 @@ def lower_weighted_tree(model: ir.TreeModelIR, ctx: LowerCtx) -> Lowered:
                 probs=probs.astype(jnp.float32),
                 label_idx=lab,
             )
-        value = jnp.matmul(W, p["payload"][:, None])[:, 0] / tz[:, 0]
+        value = jnp.matmul(
+            W, p["payload"][:, None], precision=HIGHEST
+        )[:, 0] / tz[:, 0]
         return ModelOutput(
             value=value.astype(jnp.float32), valid=valid
         )
