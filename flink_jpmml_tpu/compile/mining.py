@@ -351,11 +351,6 @@ def _lower_aggregate(
 
         return Lowered(fn=vfn, params=params, labels=tuple(global_labels))
 
-    if method == "median" and not all_true:
-        raise ModelCompilationException(
-            "median over predicate-gated segments is oracle-only"
-        )
-
     def afn(p, X, M):
         B = X.shape[0]
         vals, valids, actives = [], [], []
@@ -387,8 +382,16 @@ def _lower_aggregate(
             all_ok = all_ok & (wsum != 0)
         elif method == "max":
             value = jnp.max(jnp.where(A, V, -jnp.inf), axis=1)
-        else:  # median, all_true guaranteed
-            value = jnp.median(V, axis=1)
+        else:  # median over the ACTIVE subset: sort with +inf pads
+            # for inactive lanes, then index by the active count c —
+            # median = mean of ranks (c−1)//2 and c//2 (equal when odd)
+            Vs = jnp.sort(jnp.where(A, V, jnp.inf), axis=1)
+            c = count.astype(jnp.int32)
+            lo = jnp.maximum((c - 1) // 2, 0)
+            hi = jnp.maximum(c // 2, 0)
+            vlo = jnp.take_along_axis(Vs, lo[:, None], axis=1)[:, 0]
+            vhi = jnp.take_along_axis(Vs, hi[:, None], axis=1)[:, 0]
+            value = 0.5 * (vlo + vhi)
         return ModelOutput(value=value, valid=all_ok)
 
     return Lowered(fn=afn, params=params)

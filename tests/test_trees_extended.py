@@ -413,3 +413,50 @@ class TestSelectAll:
         )
         with pytest.raises(ModelCompilationException, match="regression"):
             compile_pmml(parse_pmml(xml))
+
+
+class TestGatedMedian:
+    def test_median_over_predicated_segments(self):
+        """median with predicate-gated segments: the compiled path sorts
+        the active subset with +inf pads and indexes by the active
+        count — parity with the oracle across subset sizes."""
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.pmml.interp import evaluate
+
+        seg = """<Segment><SimplePredicate field="x" operator="{op}"
+            value="{v}"/>
+          <TreeModel functionName="regression">
+            <MiningSchema><MiningField name="y" usageType="target"/>
+              <MiningField name="x"/></MiningSchema>
+            <Node id="0" score="{s}"><True/></Node></TreeModel></Segment>"""
+        xml = """<PMML version="4.3"><DataDictionary>
+          <DataField name="x" optype="continuous" dataType="double"/>
+          <DataField name="y" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <MiningModel functionName="regression">
+          <MiningSchema><MiningField name="y" usageType="target"/>
+            <MiningField name="x"/></MiningSchema>
+          <Segmentation multipleModelMethod="median">
+        """ + "".join([
+            seg.format(op="greaterThan", v=0, s=1.0),
+            seg.format(op="greaterThan", v=1, s=5.0),
+            seg.format(op="greaterThan", v=2, s=9.0),
+            seg.format(op="greaterThan", v=3, s=20.0),
+        ]) + "</Segmentation></MiningModel></PMML>"
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        cases = {
+            0.5: 1.0,                 # 1 active → itself
+            1.5: 0.5 * (1.0 + 5.0),  # 2 active → mean of both
+            2.5: 5.0,                 # 3 active → middle
+            3.5: 0.5 * (5.0 + 9.0),  # 4 active → mean of middle two
+        }
+        for x, exp in cases.items():
+            assert evaluate(doc, {"x": x}).value == pytest.approx(exp), x
+            assert cm.score_records([{"x": x}])[0].score.value == (
+                pytest.approx(exp, rel=1e-6)
+            ), x
+        # none active → empty on both paths
+        assert evaluate(doc, {"x": -1.0}).value is None
+        assert cm.score_records([{"x": -1.0}])[0].is_empty
