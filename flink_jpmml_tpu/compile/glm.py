@@ -29,7 +29,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.scipy.stats import norm as jnorm
 
-from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.compile.common import (
+    HIGHEST,
+    Lowered,
+    LowerCtx,
+    ModelOutput,
+)
 from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
@@ -236,7 +241,9 @@ def lower_general_regression(
         for pi, col, code in fac_cells:
             ind = (X[:, col] == jnp.float32(code)).astype(jnp.float32)
             x = x.at[:, pi].multiply(ind)
-        eta = jnp.dot(x, p["beta"])  # [B, T or 1]
+        eta = jnp.dot(
+            x, p["beta"], precision=HIGHEST
+        )  # [B, T or 1]
         if ordinal:
             cum = inverse_link(model.cumulative_link, eta)  # [B, J]
             lead = cum[:, :1]

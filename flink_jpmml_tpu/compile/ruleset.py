@@ -25,7 +25,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.compile.common import HIGHEST, Lowered, LowerCtx, ModelOutput
 from flink_jpmml_tpu.compile.gtrees import (
     _combine,
     _flatten_predicate,
@@ -124,7 +124,8 @@ def lower_ruleset(model: ir.RuleSetIR, ctx: LowerCtx) -> Lowered:
             conf = jnp.take(p["conf"], first)
         elif method == "weightedSum":
             totals = jnp.einsum(
-                "br,rl->bl", firedf * p["w"][None, :], p["onehot"]
+                "br,rl->bl", firedf * p["w"][None, :], p["onehot"],
+                precision=HIGHEST,
             )  # [B, L]
             lab = jnp.argmax(totals, axis=-1).astype(jnp.int32)
             n_fired = jnp.sum(firedf, axis=-1)

@@ -31,7 +31,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
+from flink_jpmml_tpu.compile.common import HIGHEST, Lowered, LowerCtx, ModelOutput
 from flink_jpmml_tpu.pmml import ir
 from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
@@ -44,7 +44,8 @@ def kernel_fn(kernel: ir.SvmKernel):
     d = float(kernel.degree)
 
     def lin(X, S):
-        return jnp.dot(X, S.T)
+        # HIGHEST: TPU default precision would run this f32 dot in bf16
+        return jnp.dot(X, S.T, precision=HIGHEST)
 
     if kind == "linear":
         return lin
@@ -136,7 +137,7 @@ def lower_svm(model: ir.SvmModelIR, ctx: LowerCtx) -> Lowered:
         missing = jnp.any(M_ & used[None, :], axis=1)
         x = X[:, cols]  # [B, D]
         K = kfn(x, p["S"])  # [B, N]
-        f = jnp.dot(K, p["A"]) + p["b"][None, :]  # [B, M]
+        f = jnp.dot(K, p["A"], precision=HIGHEST) + p["b"][None, :]  # [B, M]
         if not classification:
             return ModelOutput(
                 value=f[:, 0].astype(jnp.float32),

@@ -17,10 +17,16 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 # The axon TPU plugin ignores the JAX_PLATFORMS env var in this image, so
 # force the CPU backend through the config API as well — otherwise "CPU"
-# tests silently run on the real chip.
+# tests silently run on the real chip. FJT_TEST_PLATFORM overrides (e.g.
+# =tpu to run the golden suites against real TPU numerics — how the
+# round-3 HIGHEST-precision gaps were caught; multi-device tests still
+# need the virtual CPU mesh and should be deselected then).
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+_plat = os.environ.get("FJT_TEST_PLATFORM", "cpu")
+if _plat != "default":  # "default": let jax pick (the tunneled chip
+    # registers under a plugin name, not "tpu", so pinning can't find it)
+    jax.config.update("jax_platforms", _plat)
 
 import pathlib
 import sys

@@ -448,7 +448,12 @@ class TestNeuralActivations:
         for name, fn in spec.items():
             for z in (-3.0, -0.7, 0.0, 0.4, 2.2):
                 exp = fn(z)
-                assert float(C_ACT[name](z)) == pytest.approx(exp, abs=1e-6), name
+                # abs=5e-5: the TPU VPU's transcendental approximations (tanh
+                # at the tails especially) sit a few e-5 off the exact
+                # values; CPU matches to ~1e-7
+                assert float(C_ACT[name](z)) == pytest.approx(
+                    exp, abs=5e-5
+                ), name
                 assert float(O_ACT[name](z)) == pytest.approx(exp, abs=1e-9), name
 
     def test_extended_activations_match_oracle(self):
@@ -496,7 +501,9 @@ class TestNeuralActivations:
             for a in (-1.5, -0.2, 0.4, 1.1):
                 [pred] = cm.score_records([{"a": a}])
                 exp = evaluate(doc, {"a": a})
-                assert abs(pred.score.value - exp.value) < 1e-5, (act, a)
+                # 5e-5: TPU transcendentals (exp/erf chains) carry a couple
+                # extra ulps vs the CPU backend
+                assert abs(pred.score.value - exp.value) < 5e-5, (act, a)
 
 
 MVW_KMEANS = """<PMML version="4.3"><DataDictionary>

@@ -71,9 +71,10 @@ class TestGeneralRegression:
             o = evaluate(doc, rec)
             assert not p.is_empty and o.value is not None
             # f32 device vs f64 oracle: link tails (cloglog/probit near
-            # saturation) cost a few ulps more than the linear case
+            # saturation) cost a few ulps more than the linear case, and
+            # TPU transcendentals (exp/erf) carry ~1-2 extra ulps vs CPU
             assert p.score.value == pytest.approx(
-                o.value, rel=2e-3, abs=1e-6
+                o.value, rel=2e-3, abs=4e-6
             ), rec
             if o.label is not None:
                 assert p.target.label == o.label, rec
