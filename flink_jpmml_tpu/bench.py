@@ -168,7 +168,17 @@ def _orchestrate(args) -> None:
         line["error"] = err or "no TPU backend available; CPU capture"
         print(json.dumps(line), flush=True)
         return
-    tpu_err = err
+    # a wedged tunnel sometimes heals within minutes (observed repeatedly
+    # this round): one more bounded TPU attempt before conceding to the
+    # CPU fallback — worst case adds one tpu_budget of wall-clock
+    line, err_retry = _run_child(args, force_cpu=False, timeout_s=tpu_budget)
+    if line is not None and not str(line.get("backend", "")).startswith(
+        "cpu"
+    ):
+        line["attempts"] = 2
+        print(json.dumps(line), flush=True)
+        return
+    tpu_err = f"{err}; retry: {err_retry or 'cpu backend'}"
     line, err2 = _run_child(
         args, force_cpu=True, timeout_s=180.0 + 4.0 * args.seconds
     )
