@@ -5,7 +5,7 @@ model mid-stream and affected lanes become empty predictions — the stream
 never dies. Mirrors the reference's ``withSupportStream`` dynamic API
 (SURVEY.md §4.3).
 
-Run:  python examples/dynamic_serving.py
+Run:  python examples/dynamic_serving.py [--platform cpu]
 """
 
 import pathlib
@@ -19,6 +19,7 @@ except ImportError:  # source checkout without install: add the repo root
 
 import numpy as np
 
+from flink_jpmml_tpu.utils.demo import demo_backend
 from flink_jpmml_tpu.assets_gen import gen_iris_lr
 from flink_jpmml_tpu.models.control import AddMessage, DelMessage
 from flink_jpmml_tpu.runtime.sources import ControlSource
@@ -26,6 +27,7 @@ from flink_jpmml_tpu.serving import DynamicScorer
 
 
 def main() -> None:
+    print(f"backend: {demo_backend()}")
     workdir = tempfile.mkdtemp(prefix="fjt-dyn-")
     v1 = gen_iris_lr(workdir, seed=7)
     v2_dir = tempfile.mkdtemp(prefix="fjt-dyn2-")

@@ -7,7 +7,7 @@ to a `KafkaBlockSource` driving the production `BlockPipeline`; halfway
 through, the pipeline is stopped and a fresh one resumes from the
 checkpointed Kafka offset — every record scored exactly once.
 
-Run:  FJT_PLATFORM=cpu python examples/kafka_stream.py   (or on the TPU)
+Run:  python examples/kafka_stream.py [--platform cpu]   (or on the TPU)
 """
 
 import pathlib
@@ -22,6 +22,7 @@ except ImportError:  # source checkout without install: add the repo root
 
 import numpy as np
 
+from flink_jpmml_tpu.utils.demo import demo_backend
 from flink_jpmml_tpu.assets_gen import gen_gbm
 from flink_jpmml_tpu.compile import compile_pmml
 from flink_jpmml_tpu.pmml import parse_pmml_file
@@ -32,6 +33,7 @@ from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
 
 
 def main() -> None:
+    print(f"backend: {demo_backend()}")
     workdir = tempfile.mkdtemp(prefix="fjt-kafka-")
     pmml = gen_gbm(workdir, n_trees=50, depth=5, n_features=8)
     cm = compile_pmml(parse_pmml_file(pmml), batch_size=256)

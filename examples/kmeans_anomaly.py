@@ -5,7 +5,7 @@ cdist + argmin (compile/clustering.py). The anomaly signal is the distance
 to the winning centroid — records far from every center are flagged.
 Mirrors the reference's K-Means-over-Iris example job (SURVEY.md §3 D2).
 
-Run:  python examples/kmeans_anomaly.py
+Run:  python examples/kmeans_anomaly.py [--platform cpu]
 """
 
 import pathlib
@@ -19,12 +19,14 @@ except ImportError:  # source checkout without install: add the repo root
 
 import numpy as np
 
+from flink_jpmml_tpu.utils.demo import demo_backend
 from flink_jpmml_tpu.assets_gen import gen_kmeans
 from flink_jpmml_tpu.api import ModelReader, StreamEnvironment
 from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
 
 
 def main() -> None:
+    print(f"backend: {demo_backend()}")
     workdir = tempfile.mkdtemp(prefix="fjt-kmeans-")
     pmml = gen_kmeans(workdir, k=5, n_features=4)
     print(f"model: {pmml}")

@@ -11,7 +11,7 @@ operator DP, SURVEY.md §3 P1). On a CPU host run with
 to get the virtual 8-device mesh; on a TPU slice the same code shards over
 the real chips.
 
-Run:  python examples/stacked_sharded.py [--features 10000]
+Run:  python examples/stacked_sharded.py [--platform cpu] [--features 10000]
 """
 
 import argparse
@@ -35,6 +35,7 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 import numpy as np
 
+from flink_jpmml_tpu.utils.demo import demo_backend
 from flink_jpmml_tpu.assets_gen import gen_stacked
 from flink_jpmml_tpu.compile import compile_pmml
 from flink_jpmml_tpu.parallel.mesh import make_mesh
@@ -43,6 +44,7 @@ from flink_jpmml_tpu.pmml import parse_pmml_file
 
 
 def main() -> None:
+    print(f"backend: {demo_backend()}")
     ap = argparse.ArgumentParser()
     ap.add_argument("--features", type=int, default=10_000)
     ap.add_argument("--trees", type=int, default=50)

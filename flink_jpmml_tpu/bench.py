@@ -23,24 +23,38 @@ also carries:
   "device_value"   — pure device-side scoring rate, batch already resident
   "backend"        — which backend actually ran
   "p50_latency_s" / "p99_latency_s" — per-batch pipeline latency
-    (dispatch → scores materialized on host), the BASELINE tracked metric
+    (dispatch → scores materialized on host) at the THROUGHPUT operating
+    point (262k-record dispatches: these are seconds-scale by design)
+  "latency_mode"   — the LATENCY operating point: the production
+    BlockPipeline at a small batch + ms deadline under paced offered
+    load, reporting record-level {p50_ms, p99_ms, rec_s} (arrival →
+    scores materialized on host). This is the BASELINE tracked metric's
+    honest home; the throughput p50/p99 above is not a latency story.
   "interp_rec_s" / "interp_ratio" — a per-record oracle-interpreter
     (pmml/interp.py) baseline on the same model and host, and the measured
     speedup of the compiled path over it: the backend-independent
-    quantification of "no CPU evaluator in the hot path"
-  "windows"        — both pipelined measurement windows' rates; "value"
-    is the better one (a shared tunnel's throughput wanders run to run,
-    so one window under-samples the steady state)
+    quantification of "no CPU evaluator in the hot path". Pinned: fixed
+    record count, median of 3 repeats, run BEFORE the throughput windows
+    (a teardown-competing tail run wobbled 4x across round-3 captures).
+  "windows"        — all pipelined measurement windows' rates. "value"
+    is the MEDIAN window (the honest typical); "best_window" carries the
+    max separately (a shared tunnel's throughput wanders run to run).
 Process shape: the parent (jax-free) runs the whole measurement in ONE
-bounded child process — device init, compile, measure — with a long
-backend-init budget (300s: a slow tunnel gets its full chance). The chip
-is exclusive-access through a tunnel, so it is opened exactly once per
-attempt; if the child hangs or dies the parent kills it and captures a
-CPU fallback at diagnostic scale, labelled "backend": "cpu-fallback"
-with an "error" field describing the TPU failure (exit 0 — a labelled
-number beats an empty artifact, which is what round 1 recorded). Only
-when even the CPU capture fails does the bench print a zero line and
-exit 1 — the driver always gets exactly one JSON line in bounded time.
+bounded child process — device init, compile, measure. The chip is
+exclusive-access through a tunnel and the tunnel wedges *at init* for
+minutes at a time, then heals (observed across rounds 2-3); so the
+parent watches the child's stderr stage stamps live and applies a SHORT
+init sub-timeout (default 120s: a child that hasn't printed "backend
+resolved" by then is wedged, not slow), then retries up to
+--max-attempts times with sleeps staggered across the heal window.
+FJT_XLA_CACHE is defaulted on for the children so a late healthy
+attempt reuses any compile an earlier attempt persisted. Only after the
+attempt schedule is exhausted does the parent capture a CPU fallback at
+diagnostic scale, labelled "backend": "cpu-fallback" with an "error"
+field describing the TPU failure (exit 0 — a labelled number beats an
+empty artifact). Only when even the CPU capture fails does the bench
+print a zero line and exit 1 — the driver always gets exactly one JSON
+line in bounded time.
 """
 
 import argparse
@@ -75,10 +89,15 @@ def _child_cmd(args, force_cpu: bool) -> list:
         "--features", str(args.features), "--batch", str(args.batch),
         "--chunk", str(args.chunk), "--window", str(args.window),
         "--seconds", str(args.seconds),
+        "--latency-batch", str(args.latency_batch),
+        "--latency-deadline-us", str(args.latency_deadline_us),
+        "--latency-offered", str(args.latency_offered),
     ]
     for flag, on in (
         ("--f32-wire", args.f32_wire),
         ("--skip-interp", args.skip_interp),
+        ("--skip-latency", args.skip_latency),
+        ("--latency", args.latency),
         ("--block-pipeline", args.block_pipeline),
         ("--force-cpu", force_cpu),
     ):
@@ -87,100 +106,239 @@ def _child_cmd(args, force_cpu: bool) -> list:
     return cmd
 
 
-def _run_child(args, force_cpu: bool, timeout_s: float):
-    """→ (parsed_json_line | None, error | None). The whole measurement —
-    backend init included — runs in ONE bounded child process, so the
-    device is opened exactly once per attempt (a probe child + a parent
-    re-init is two openings of an exclusive-access chip, and the second
-    one is what wedged on the tunneled TPU), and a hang anywhere is a
-    kill + fallback for the parent, never a stuck driver."""
+_INIT_STAMP = "backend resolved"
+
+
+def _child_env() -> dict:
     env = dict(os.environ)
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (pkg_root, env.get("PYTHONPATH")) if p
     )
+    # persistent XLA compile cache across attempts: a late healthy
+    # attempt spends its budget measuring, not recompiling what an
+    # earlier (post-init) attempt already compiled
+    env.setdefault(
+        "FJT_XLA_CACHE", os.path.join(tempfile.gettempdir(), "fjt-xla-cache")
+    )
+    return env
+
+
+def _run_child(args, force_cpu: bool, init_timeout_s: float,
+               total_timeout_s: float):
+    """→ (parsed_json_line | None, error | None, init_wedged: bool).
+
+    The whole measurement — backend init included — runs in ONE child
+    process, so the device is opened exactly once per attempt (a probe
+    child + a parent re-init is two openings of an exclusive-access
+    chip, and the second one is what wedged on the tunneled TPU). The
+    parent tails the child's stderr stage stamps live: no
+    "backend resolved" stamp within ``init_timeout_s`` means the tunnel
+    wedged at init (rounds 2-3: the child never got past "importing
+    jax") — kill NOW and let the retry schedule spread attempts over
+    the heal window instead of burning the whole budget on one corpse."""
+    stderr_f = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".bench-err", delete=False
+    )
+    t0 = time.monotonic()
     try:
-        r = subprocess.run(
+        proc = subprocess.Popen(
             _child_cmd(args, force_cpu),
-            capture_output=True, text=True, timeout=timeout_s, env=env,
+            stdout=subprocess.PIPE, stderr=stderr_f,
+            text=True, env=_child_env(),
         )
-    except subprocess.TimeoutExpired as e:
-        # the killed child's stderr tail says WHERE it wedged (stage
-        # stamps + FJT_BENCH_TRACE faulthandler dumps land there)
-        tail = ""
-        if e.stderr:
-            err = e.stderr
-            if isinstance(err, bytes):
-                err = err.decode("utf-8", "replace")
-            tail = ": " + err.strip()[-400:]
-        return None, f"measurement exceeded {timeout_s:.0f}s{tail}"
     except OSError as e:
-        return None, f"child spawn failed: {e}"
-    for ln in reversed((r.stdout or "").strip().splitlines()):
+        stderr_f.close()
+        os.unlink(stderr_f.name)
+        return None, f"child spawn failed: {e}", False
+
+    def _stderr_read() -> str:
         try:
-            parsed = json.loads(ln)
-            if isinstance(parsed, dict) and "metric" in parsed:
-                return parsed, None
-        except json.JSONDecodeError:
-            continue
-    tail = (r.stderr or "no output").strip()[-500:]
-    return None, f"child rc={r.returncode}: {tail}"
+            with open(stderr_f.name) as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def _stderr_tail(limit: int = 400) -> str:
+        return _stderr_read().strip()[-limit:]
+
+    def _kill() -> None:
+        proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+    try:
+        resolved = force_cpu  # cpu children don't open the tunnel
+        while not resolved:
+            if proc.poll() is not None:
+                break  # exited during init: fall through to parse
+            waited = time.monotonic() - t0
+            if waited >= init_timeout_s:
+                _kill()
+                return (
+                    None,
+                    f"backend init exceeded {init_timeout_s:.0f}s "
+                    f"(no '{_INIT_STAMP}' stamp): {_stderr_tail()}",
+                    True,
+                )
+            # search the WHOLE stderr: with FJT_BENCH_TRACE the faulthandler
+            # dumps can push the stamp far past any fixed tail window
+            if _INIT_STAMP in _stderr_read():
+                resolved = True
+                break
+            time.sleep(1.0)
+        remaining = total_timeout_s - (time.monotonic() - t0)
+        try:
+            stdout, _ = proc.communicate(timeout=max(remaining, 5.0))
+        except subprocess.TimeoutExpired:
+            _kill()
+            return (
+                None,
+                f"measurement exceeded {total_timeout_s:.0f}s: "
+                f"{_stderr_tail()}",
+                False,
+            )
+        for ln in reversed((stdout or "").strip().splitlines()):
+            try:
+                parsed = json.loads(ln)
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    return parsed, None, False
+            except json.JSONDecodeError:
+                continue
+        return None, f"child rc={proc.returncode}: {_stderr_tail(500)}", False
+    finally:
+        stderr_f.close()
+        try:
+            os.unlink(stderr_f.name)
+        except OSError:
+            pass
+
+
+def _note(msg: str) -> None:
+    print(f"[bench-parent] {msg}", file=sys.stderr, flush=True)
 
 
 def _orchestrate(args) -> None:
-    """Parent: never imports jax. One long-budget TPU attempt, then a
-    clearly-labelled CPU fallback capture, then (only if even CPU fails)
-    a zero line with rc=1 — the driver always gets exactly one JSON
-    line within a bounded time."""
+    """Parent: never imports jax. Staggered TPU attempts across the
+    tunnel's heal window, then a clearly-labelled CPU fallback capture,
+    then (only if even CPU fails) a zero line with rc=1 — the driver
+    always gets exactly one JSON line within a bounded time."""
     metric = f"gbm{args.trees}_records_per_sec_per_chip"
-    # generous: backend init (a slow tunnel gets its full chance) +
-    # compile + measurement + interpreter baseline
-    tpu_budget = args.probe_timeout + 90.0 + 4.0 * args.seconds + 60.0
-    line, err = _run_child(args, force_cpu=False, timeout_s=tpu_budget)
-    if line is not None:
-        if not str(line.get("backend", "")).startswith("cpu"):
-            # the tunneled link's throughput drifts by hours, not runs
-            # (device_value stays ~constant while e2e has been observed
-            # anywhere in 0.3-1.0x): a clearly-degraded window gets ONE
-            # bounded re-measure and the better line ships, labeled
-            # "degraded" is judged against the chip's own measured
-            # capability, not the absolute target: a non-default config
-            # whose honest rate is low must not re-measure forever
-            dev = float(line.get("device_value") or 0.0)
-            if dev > 0 and float(line.get("value", 0.0)) < 0.25 * dev:
-                line2, _ = _run_child(
-                    args, force_cpu=False, timeout_s=tpu_budget
-                )
-                if (
-                    line2 is not None
-                    and not str(line2.get("backend", "")).startswith("cpu")
-                    and float(line2.get("value", 0.0))
-                    > float(line.get("value", 0.0))
-                ):
-                    line = line2
-                line["attempts"] = 2
-            print(json.dumps(line), flush=True)
-            return
-        # the child initialized, but onto the CPU backend (machine has
-        # no TPU): its measurement is already the CPU capture — relabel
-        # it rather than re-running the identical workload
-        line["backend"] = "cpu-fallback"
-        line["error"] = err or "no TPU backend available; CPU capture"
-        print(json.dumps(line), flush=True)
+    t_start = time.monotonic()
+    # post-init budget: compile (warm via FJT_XLA_CACHE after the first
+    # healthy attempt) + 3 windows + device-resident + latency mode +
+    # pinned interp baseline
+    measure_budget = 150.0 + 5.0 * args.seconds + 120.0
+    cpu_reserve = 180.0 + 4.0 * args.seconds  # always keep room for fallback
+    sleeps = (45.0, 90.0, 120.0, 120.0, 120.0)
+    errors = []
+    healthy = None
+    cpu_line = None  # a completed capture that landed on the CPU backend
+    cpu_resolutions = 0
+
+    def _remaining() -> float:
+        return args.total_budget - (time.monotonic() - t_start) - cpu_reserve
+
+    attempt = 0
+    while attempt < args.max_attempts:
+        attempt += 1
+        budget = min(args.init_timeout + measure_budget, _remaining())
+        if budget < args.init_timeout + 30.0:
+            errors.append("attempt budget exhausted")
+            break
+        _note(
+            f"TPU attempt {attempt}/{args.max_attempts} "
+            f"(init<={args.init_timeout:.0f}s, total<={budget:.0f}s)"
+        )
+        line, err, init_wedged = _run_child(
+            args, force_cpu=False,
+            init_timeout_s=args.init_timeout, total_timeout_s=budget,
+        )
+        if line is not None and not str(
+            line.get("backend", "")
+        ).startswith("cpu"):
+            line["attempts"] = attempt
+            healthy = line
+            break
+        if line is not None:
+            # the child initialized, but onto the CPU backend. Either the
+            # machine simply has no TPU (every retry would land here too)
+            # or a wedge manifested as a plugin init *error* rather than
+            # a hang (jax falls back to CPU) — in which case a staggered
+            # retry may find the healed chip. Keep the capture as the
+            # fallback candidate; concede to it only after a second CPU
+            # resolution (bounds the cost on genuinely TPU-less hosts).
+            cpu_line = line
+            cpu_resolutions += 1
+            errors.append(err or "child resolved to the cpu backend")
+            if cpu_resolutions >= 2:
+                _note("cpu backend twice: concluding no TPU on this host")
+                break
+            _note(f"attempt {attempt} resolved to cpu; retrying for TPU")
+        else:
+            errors.append(err)
+            _note(f"attempt {attempt} failed ({'init-wedge' if init_wedged else 'post-init'}): {(err or '')[:160]}")
+        if attempt < args.max_attempts:
+            # spread the retries across the heal window (wedges observed
+            # to clear within minutes, not seconds)
+            sleep_s = min(
+                sleeps[min(attempt - 1, len(sleeps) - 1)],
+                max(_remaining() - args.init_timeout - 30.0, 0.0),
+            )
+            if sleep_s <= 0:
+                errors.append("retry budget exhausted")
+                break
+            _note(f"sleeping {sleep_s:.0f}s before retry")
+            time.sleep(sleep_s)
+
+    if healthy is not None:
+        # the tunneled link's throughput drifts by hours, not runs
+        # (device_value stays ~constant while e2e has been observed
+        # anywhere in 0.3-1.0x): a clearly-degraded capture gets ONE
+        # bounded re-measure and the better line ships. "Degraded" is
+        # judged against the chip's own measured capability, not the
+        # absolute target: a non-default config whose honest rate is
+        # low must not re-measure forever.
+        dev = float(healthy.get("device_value") or 0.0)
+        budget = min(args.init_timeout + measure_budget, _remaining())
+        if (
+            dev > 0
+            and float(healthy.get("value", 0.0)) < 0.25 * dev
+            and budget >= args.init_timeout + 30.0
+        ):
+            _note("e2e <<25% of device capability: one re-measure")
+            line2, _, _ = _run_child(
+                args, force_cpu=False,
+                init_timeout_s=args.init_timeout, total_timeout_s=budget,
+            )
+            if (
+                line2 is not None
+                and not str(line2.get("backend", "")).startswith("cpu")
+                and float(line2.get("value", 0.0))
+                > float(healthy.get("value", 0.0))
+            ):
+                line2["attempts"] = healthy["attempts"] + 1
+                healthy = line2
+        print(json.dumps(healthy), flush=True)
         return
-    # a wedged tunnel sometimes heals within minutes (observed repeatedly
-    # this round): one more bounded TPU attempt before conceding to the
-    # CPU fallback — worst case adds one tpu_budget of wall-clock
-    line, err_retry = _run_child(args, force_cpu=False, timeout_s=tpu_budget)
-    if line is not None and not str(line.get("backend", "")).startswith(
-        "cpu"
-    ):
-        line["attempts"] = 2
-        print(json.dumps(line), flush=True)
+
+    tpu_err = "; ".join(
+        f"attempt {i + 1}: {e}" for i, e in enumerate(errors) if e
+    )
+    if cpu_line is not None:
+        # an attempt already measured the workload on the CPU backend:
+        # relabel it rather than re-running the identical capture
+        cpu_line["backend"] = "cpu-fallback"
+        cpu_line["error"] = tpu_err
+        print(json.dumps(cpu_line), flush=True)
         return
-    tpu_err = f"{err}; retry: {err_retry or 'cpu backend'}"
-    line, err2 = _run_child(
-        args, force_cpu=True, timeout_s=180.0 + 4.0 * args.seconds
+    _note("all TPU attempts failed; capturing CPU fallback")
+    line, err2, _ = _run_child(
+        args, force_cpu=True,
+        init_timeout_s=120.0,
+        total_timeout_s=150.0 + 4.0 * args.seconds,
     )
     if line is not None:
         line["backend"] = "cpu-fallback"
@@ -189,6 +347,134 @@ def _orchestrate(args) -> None:
         return
     _fail_line(metric, f"tpu: {tpu_err}; cpu: {err2}")
     sys.exit(1)
+
+
+def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
+    """The LATENCY operating point (BASELINE's tracked metric): the
+    production BlockPipeline compiled at a small batch with a
+    millisecond fill-or-deadline, under paced offered load well below
+    capacity. Record-level latency = block arrival (source poll stamp)
+    → that block's scores materialized on the host; blocks are
+    equal-size, so block percentiles == record percentiles.
+
+    Only called from the measurement child (jax already imported)."""
+    import jax
+    import numpy as np
+
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.runtime.block import BlockPipeline, BlockSource
+    from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+    Bl = int(args.latency_batch)
+    block = 256  # granularity of arrival stamps (and of the percentiles)
+    cm = compile_pmml(doc, batch_size=Bl)
+    # arrival stamps in offset order (ingest thread appends, score-loop
+    # sink pops — deque ops are atomic under the GIL). Ordered matching
+    # rather than stride-keyed lookup: the fill-or-deadline drain may
+    # close a batch mid-block, so sink offsets need not stay
+    # block-aligned; a block counts as done when its LAST record has
+    # materialized.
+    arrivals = collections.deque()  # (offset, t_arrival)
+    lats = []
+
+    class _PacedSource(BlockSource):
+        """Cycles the dataset in small blocks at a paced offered rate,
+        stamping each block's arrival time."""
+
+        exhausted = False
+
+        def __init__(self):
+            self._pos = 0
+            self._off = 0
+            self._interval = block / float(args.latency_offered)
+            self._next = None
+
+        def poll(self):
+            now = time.monotonic()
+            if self._next is None:
+                self._next = now
+            if now < self._next:
+                return None  # pipeline ingest re-polls after a short sleep
+            n = data_f32.shape[0]
+            if self._pos + block <= n:
+                blk = data_f32[self._pos : self._pos + block]
+                self._pos += block
+            else:
+                self._pos = block
+                blk = data_f32[:block]
+            off = self._off
+            self._off += block
+            arrivals.append((off, time.monotonic()))
+            # pace against the schedule (no drift), but a stall must not
+            # turn into a catch-up burst that measures queueing, not the
+            # pipeline
+            self._next = max(
+                self._next + self._interval, now - 5 * self._interval
+            )
+            return off, blk
+
+        def seek(self, offset: int) -> None:
+            pass
+
+    def sink(out, n, first_off):
+        # force the D2H round trip: latency counts *materialized* scores
+        np.asarray(
+            out.value if hasattr(out, "value")
+            else out[0] if isinstance(out, tuple) else out
+        )
+        t = time.monotonic()
+        end = first_off + n
+        while arrivals and arrivals[0][0] + block <= end:
+            _, t_arr = arrivals.popleft()
+            lats.append(t - t_arr)
+
+    pipe = BlockPipeline(
+        _PacedSource(), cm, sink,
+        RuntimeConfig(batch=BatchConfig(
+            size=Bl, deadline_us=int(args.latency_deadline_us)
+        )),
+        in_flight=1,  # latency point: no completion window to hide in
+        use_quantized=use_quantized,
+    )
+    # warm the compile + first transfer outside the measured run
+    q = cm.quantized_scorer() if use_quantized else None
+    if q is not None:
+        jax.block_until_ready(q.predict_wire(q.wire.encode(data_f32[:Bl])))
+    else:
+        cm.warmup()
+    seconds = min(4.0, max(2.0, args.seconds))
+    t0 = time.monotonic()
+    pipe.run_for(seconds=seconds)
+    elapsed = time.monotonic() - t0
+    if not lats:
+        return None
+    s = sorted(lats)
+    return {
+        "p50_ms": round(1000 * s[len(s) // 2], 3),
+        "p99_ms": round(1000 * s[min(len(s) - 1, int(0.99 * len(s)))], 3),
+        "rec_s": round(len(lats) * block / elapsed, 1),
+        "offered_rec_s": float(args.latency_offered),
+        "batch": Bl,
+        "deadline_us": int(args.latency_deadline_us),
+        "backend": pipe.backend,
+    }
+
+
+def _latency_headline(line: dict, trees: int, backend: str) -> dict:
+    """--latency: re-headline the artifact on the latency operating
+    point (p50 record latency, ms); the throughput number rides along."""
+    lm = line.get("latency_mode")
+    if not lm:
+        return line  # latency capture unavailable: keep the line honest
+    return {
+        "metric": f"gbm{trees}_record_latency_p50_ms",
+        "value": lm["p50_ms"],
+        "unit": "ms",
+        "vs_baseline": None,  # BASELINE tracks but fixes no number
+        "backend": backend,
+        "latency_mode": lm,
+        "throughput_rec_s": line.get("value"),
+    }
 
 
 def main() -> None:
@@ -204,10 +490,24 @@ def main() -> None:
     ap.add_argument("--seconds", type=float, default=4.0)
     ap.add_argument("--f32-wire", action="store_true",
                     help="ship raw f32 features instead of the rank wire")
-    ap.add_argument("--probe-timeout", type=float, default=300.0,
-                    help="backend-init budget inside the measurement child")
+    ap.add_argument("--init-timeout", type=float, default=120.0,
+                    help="kill a child that hasn't resolved a backend by "
+                         "then (a wedged tunnel, not a slow one)")
+    ap.add_argument("--max-attempts", type=int, default=4,
+                    help="TPU attempts staggered across the heal window")
+    ap.add_argument("--total-budget", type=float, default=1000.0,
+                    help="overall wall-clock budget incl. the CPU fallback")
     ap.add_argument("--skip-interp", action="store_true",
                     help="skip the per-record interpreter baseline")
+    ap.add_argument("--skip-latency", action="store_true",
+                    help="skip the latency-mode operating point")
+    ap.add_argument("--latency", action="store_true",
+                    help="make the latency operating point the headline "
+                         "metric (p50 record latency in ms)")
+    ap.add_argument("--latency-batch", type=int, default=4096)
+    ap.add_argument("--latency-deadline-us", type=int, default=2000)
+    ap.add_argument("--latency-offered", type=float, default=100_000.0,
+                    help="paced offered load (rec/s) for the latency mode")
     ap.add_argument("--in-child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--force-cpu", action="store_true",
@@ -262,23 +562,27 @@ def main() -> None:
             round(s[min(len(s) - 1, int(0.99 * len(s)))], 6),
         )
 
-    def interp_baseline(doc, X, budget_s=2.0, max_n=300):
-        """Per-record oracle-interpreter rate (rec/s) on the same model:
-        what a reference-style CPU evaluator costs, measured not assumed."""
+    def interp_baseline(doc, X, n_records=100, repeats=3):
+        """Pinned per-record oracle-interpreter rate (rec/s) on the same
+        model: what a reference-style CPU evaluator costs, measured not
+        assumed. Fixed record count, MEDIAN of repeats, and the caller
+        runs it BEFORE the throughput windows — the round-3 tail-run
+        version (deadline-bounded, after the windows, competing with
+        encode-pool teardown) wobbled 4x across captures of the same
+        model on the same host."""
         from flink_jpmml_tpu.pmml.interp import evaluate
 
         fields = doc.active_fields
-        recs = [dict(zip(fields, row.tolist())) for row in X[:max_n]]
+        recs = [dict(zip(fields, row.tolist())) for row in X[:n_records]]
         evaluate(doc, recs[0])  # first-call setup out of the timing
-        n = 0
-        t0 = time.perf_counter()
-        deadline = t0 + budget_s
-        for rec in recs:
-            evaluate(doc, rec)
-            n += 1
-            if time.perf_counter() >= deadline:
-                break
-        return n / (time.perf_counter() - t0)
+        rates = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for rec in recs:
+                evaluate(doc, rec)
+            rates.append(len(recs) / (time.perf_counter() - t0))
+        rates.sort()
+        return rates[len(rates) // 2]
 
     if backend.startswith("cpu"):
         # full-size dispatches would allocate GBs of einsum intermediates
@@ -287,6 +591,10 @@ def main() -> None:
         args.chunk = min(args.chunk, 1024)
         args.batch = min(args.batch, 8 * args.chunk)
         args.seconds = min(args.seconds, 3.0)
+        args.latency_batch = min(args.latency_batch, 1024)
+        # diagnostic CPU capacity is ~1-2k rec/s: offered load must sit
+        # well under it or the "latency" captured is queueing delay
+        args.latency_offered = min(args.latency_offered, 500.0)
     # keep the dispatch/chunk contract valid for any flag combination
     args.batch = max(args.chunk, (args.batch // args.chunk) * args.chunk)
 
@@ -317,6 +625,13 @@ def main() -> None:
     pool_f32 = [
         rng.normal(0.0, 1.5, size=(B, F)).astype(np.float32) for _ in range(4)
     ]
+
+    # pinned oracle baseline FIRST: quiet host, nothing competing
+    interp_rate = None
+    if not args.skip_interp:
+        stage("interp baseline (pinned, pre-windows)")
+        interp_rate = interp_baseline(doc, pool_f32[0])
+        stage(f"interp baseline: {interp_rate:,.1f} rec/s")
 
     cm = compile_pmml(doc, batch_size=C)
     stage("lowered (host)")
@@ -369,11 +684,19 @@ def main() -> None:
             "p50_latency_s": round(p50, 6) if p50 is not None else None,
             "p99_latency_s": round(p99, 6) if p99 is not None else None,
             "windows": [round(rate, 1)],  # keys uniform with the hand loop
+            "best_window": round(rate, 1),
         }
-        if not args.skip_interp:
-            interp_rate = interp_baseline(doc, pool_f32[0])
+        if interp_rate is not None:
             line["interp_rec_s"] = round(interp_rate, 1)
             line["interp_ratio"] = round(rate / interp_rate, 1)
+        if not args.skip_latency:
+            stage("latency mode: compile + paced run")
+            line["latency_mode"] = _measure_latency_mode(
+                doc, pool_f32[0], args, use_quantized=not args.f32_wire
+            )
+            stage("latency mode done")
+        if args.latency:
+            line = _latency_headline(line, args.trees, line["backend"])
         print(json.dumps(line))
         return
 
@@ -466,10 +789,14 @@ def main() -> None:
             f.cancel() or f.result()
         return rate_w, lats
 
-    # a shared tunnel's throughput wanders run to run; measure two
-    # windows and report the better steady state (labeled via "windows")
-    windows = [measure_window(args.seconds) for _ in range(2)]
-    rate, lats = max(windows, key=lambda t: t[0])
+    # a shared tunnel's throughput wanders run to run; measure three
+    # windows. "value" is the MEDIAN (the honest typical — round 3's
+    # best-of policy shipped a max the healthy repeats didn't reproduce);
+    # the max rides "best_window", every window rides "windows".
+    windows = [measure_window(args.seconds) for _ in range(3)]
+    by_rate = sorted(windows, key=lambda t: t[0])
+    rate, lats = by_rate[len(by_rate) // 2]
+    best_rate = by_rate[-1][0]
     enc_pool.shutdown(wait=False)
     p50, p99 = quantiles(lats)
     stage(
@@ -512,11 +839,19 @@ def main() -> None:
         "p50_latency_s": p50,
         "p99_latency_s": p99,
         "windows": [round(r, 1) for r, _ in windows],
+        "best_window": round(best_rate, 1),
     }
-    if not args.skip_interp:
-        interp_rate = interp_baseline(doc, pool_f32[0])
+    if interp_rate is not None:
         line["interp_rec_s"] = round(interp_rate, 1)
         line["interp_ratio"] = round(rate / interp_rate, 1)
+    if not args.skip_latency:
+        stage("latency mode: compile + paced run")
+        line["latency_mode"] = _measure_latency_mode(
+            doc, pool_f32[0], args, use_quantized=not args.f32_wire
+        )
+        stage("latency mode done")
+    if args.latency:
+        line = _latency_headline(line, args.trees, backend)
     print(json.dumps(line))
 
 

@@ -560,31 +560,31 @@ def build_quantized_scorer(
         and batch_size is not None
         and (not on_cpu or pallas_interpret)
     )
-    # the CLASSIFICATION kernel stays opt-in (backend="pallas") until
-    # its on-real-TPU parity is green: the round-3 on-device run of
-    # tests/test_qtrees_pallas.py passed every regression case but
-    # failed the classification group-padding/chunking cases before the
-    # chip window degraded mid-diagnosis — the XLA quantized path is
-    # semantically identical and serves vote forests meanwhile
-    # (interpret-mode classification tests still cover the kernel)
-    pallas_cls = (
-        classification
-        and method in ("majorityVote", "weightedMajorityVote")
-        and (backend == "pallas" or pallas_interpret)
+    # round-3 on-device classification parity failure, root-caused: the
+    # kernel contracted a single reconstructed f32 vote table with a
+    # default-precision dot, which the MXU truncates to bf16 — silently
+    # dropping the lo residuals (interpret mode on CPU does exact f32
+    # math, so only hardware disagreed). The kernel now contracts the
+    # SAME bf16 hi/lo split pair as the XLA path (_pair_einsum), so the
+    # vote kernel is back in auto selection.
+    pallas_cls = classification and method in (
+        "majorityVote", "weightedMajorityVote"
     )
     if want_pallas and pallas_env and (
         (not classification and fused_linear) or pallas_cls
     ):
         from flink_jpmml_tpu.compile import qtrees_pallas
 
-        # contract the same bf16 hi+lo reconstructed tables as the XLA
-        # path (phi+plo / vhi+vlo), not the raw f32 ones — otherwise
-        # argmax tie-breaks on near-equal vote shares could differ
-        # between backends for the same model
         if classification:
-            vals_tbl = phi.astype(np.float32) + plo.astype(np.float32)
+            # the bf16 hi/lo split pair — identical operands to the XLA
+            # path, so labels match exactly and shares to f32 rounding
+            vals_tbl, vals_lo = phi, plo
         else:
+            # scalar leaf sums stay a single f32 table: the kernel
+            # combines them with an elementwise VPU multiply (exact in
+            # f32), not an MXU dot
             vals_tbl = vhi.astype(np.float32) + vlo.astype(np.float32)
+            vals_lo = None
         groups = qtrees_pallas.pack_groups(
             feat=params["feat"].astype(np.int64),
             qthr=qthr,
@@ -593,6 +593,7 @@ def build_quantized_scorer(
             count=params["count_i8"],
             vals=vals_tbl,
             n_fields=F,
+            vals_lo=vals_lo,
         )
         raw = qtrees_pallas.build_pallas_fn(
             groups, batch_size, F, sentinel, interpret=pallas_interpret

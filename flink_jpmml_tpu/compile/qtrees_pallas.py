@@ -62,11 +62,21 @@ def pack_groups(
     dleft: np.ndarray,    # bool[T, S]
     P: np.ndarray,        # i8[T, S, L]
     count: np.ndarray,    # i8[T, L]
-    vals: np.ndarray,     # f32[T, L] scalar leaf values, or f32[T, L, C]
-                          # per-leaf class rows (vote weights folded in)
+    vals: np.ndarray,     # f32[T, L] scalar leaf values, or bf16[T, L, C]
+                          # per-leaf class-row HI table (vote weights
+                          # folded in; pass the matching LO residuals via
+                          # ``vals_lo``)
     n_fields: int,
+    vals_lo: Optional[np.ndarray] = None,  # bf16[T, L, C] LO residuals
 ) -> Dict[str, np.ndarray]:
-    """Group-pack the per-tree tensors for the kernel (numpy, host-side)."""
+    """Group-pack the per-tree tensors for the kernel (numpy, host-side).
+
+    Classification tables MUST arrive as the bf16 hi/lo split pair
+    (``vals``=hi, ``vals_lo``=lo) — the same operands the XLA path
+    contracts. A single reconstructed f32 table is NOT equivalent on
+    hardware: a default-precision f32 dot truncates its operands to bf16
+    on the MXU, silently dropping the lo residuals (the round-3
+    on-device classification parity failure)."""
     T, S = feat.shape
     L = P.shape[2]
     G = -(-T // GT)
@@ -81,8 +91,13 @@ def pack_groups(
     dleftp[:T] = dleft.astype(np.float32)
     countp = np.full((Tp, L), -5.0, np.float32)  # padded trees never match
     countp[:T] = count.astype(np.float32)
-    valsp = np.zeros((Tp,) + vals.shape[1:], np.float32)
-    valsp[:T] = vals
+
+    def _pad_collapse(tbl, dtype):
+        padded = np.zeros((Tp,) + tbl.shape[1:], np.float32)
+        padded[:T] = tbl.astype(np.float32)
+        # Tp is G*GT contiguous, so collapsing (G, GT, L, …) → (G, Lg, …)
+        # keeps each group's leaves in block order
+        return padded.reshape((G, Lg) + tbl.shape[2:]).astype(dtype)
 
     # one-hot feature selector [G, F, Sg] (bf16 operand of the select dot)
     fsel = np.zeros((G, n_fields, Sg), np.float32)
@@ -95,16 +110,19 @@ def pack_groups(
         g, o = divmod(t, GT)
         Pg[g, o * S:(o + 1) * S, o * L:(o + 1) * L] = P[t]
 
-    return {
+    groups = {
         "fsel": fsel.astype(jnp.bfloat16),
         "qthr": qthrp.reshape(G, Sg),
         "dleft": dleftp.reshape(G, Sg),
         "Pg": Pg,
         "count": countp.reshape(G, Lg),
-        # Tp is G*GT contiguous, so collapsing (G, GT, L, …) → (G, Lg, …)
-        # keeps each group's leaves in block order
-        "vals": valsp.reshape((G, Lg) + valsp.shape[2:]),
+        "vals": _pad_collapse(
+            vals, jnp.bfloat16 if vals_lo is not None else np.float32
+        ),
     }
+    if vals_lo is not None:
+        groups["vals_lo"] = _pad_collapse(vals_lo, jnp.bfloat16)
+    return groups
 
 
 def param_bytes(groups: Dict[str, np.ndarray]) -> int:
@@ -149,15 +167,25 @@ def _kernel(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
 
 
 def _kernel_cls(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
-                vals_ref, out_ref, *, sentinel: float):
+                vals_ref, vlo_ref, out_ref, *, sentinel: float):
     """Classification votes: per-leaf class rows contract to [Bblk, C]
-    vote-share partials, accumulated over tree groups."""
+    vote-share partials, accumulated over tree groups.
+
+    The class tables are the bf16 hi/lo SPLIT pair, contracted as two
+    bf16 dots with f32 accumulation — the same math as the XLA path's
+    ``_pair_einsum``. (Round-3 on-device failure: a single reconstructed
+    f32 table at default dot precision gets truncated to bf16 by the
+    MXU, losing the lo residuals; interpret mode on CPU did exact f32
+    math, which is why parity only broke on hardware.)"""
     j = pl.program_id(1)
     hit = _leaf_hits(
         xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref, j, sentinel
     )
+    hb = hit.astype(jnp.bfloat16)  # 0/1 one-hot: exact in bf16
     part = jnp.dot(
-        hit, vals_ref[j], preferred_element_type=jnp.float32
+        hb, vals_ref[j], preferred_element_type=jnp.float32
+    ) + jnp.dot(
+        hb, vlo_ref[j], preferred_element_type=jnp.float32
     )                                                  # [Bblk, C]
 
     @pl.when(j == 0)
@@ -197,30 +225,40 @@ def build_pallas_fn(
 
     classification = groups["vals"].ndim == 3
     F = n_fields
+    in_specs = [
+        pl.BlockSpec((block_b, F), lambda i, j: (i, 0)),
+        pl.BlockSpec(groups["fsel"].shape, lambda i, j: (0, 0, 0)),
+        pl.BlockSpec(groups["qthr"].shape, lambda i, j: (0, 0)),
+        pl.BlockSpec(groups["dleft"].shape, lambda i, j: (0, 0)),
+        pl.BlockSpec(groups["Pg"].shape, lambda i, j: (0, 0, 0)),
+        pl.BlockSpec(groups["count"].shape, lambda i, j: (0, 0)),
+    ]
     if classification:
+        assert "vals_lo" in groups, (
+            "classification kernel requires the bf16 hi/lo split tables"
+        )
         C = groups["vals"].shape[2]
         kern = functools.partial(_kernel_cls, sentinel=float(sentinel))
-        vals_spec = pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0, 0))
+        in_specs.append(
+            pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0, 0))
+        )
+        in_specs.append(
+            pl.BlockSpec(groups["vals_lo"].shape, lambda i, j: (0, 0, 0))
+        )
         out_specs = pl.BlockSpec((block_b, C), lambda i, j: (i, 0))
         out_shape = jax.ShapeDtypeStruct((batch_size, C), jnp.float32)
     else:
         kern = functools.partial(_kernel, sentinel=float(sentinel))
-        vals_spec = pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0))
+        in_specs.append(
+            pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0))
+        )
         out_specs = pl.BlockSpec((block_b,), lambda i, j: (i,))
         out_shape = jax.ShapeDtypeStruct((batch_size,), jnp.float32)
 
     call = pl.pallas_call(
         kern,
         grid=(nb, G),
-        in_specs=[
-            pl.BlockSpec((block_b, F), lambda i, j: (i, 0)),
-            pl.BlockSpec(groups["fsel"].shape, lambda i, j: (0, 0, 0)),
-            pl.BlockSpec(groups["qthr"].shape, lambda i, j: (0, 0)),
-            pl.BlockSpec(groups["dleft"].shape, lambda i, j: (0, 0)),
-            pl.BlockSpec(groups["Pg"].shape, lambda i, j: (0, 0, 0)),
-            pl.BlockSpec(groups["count"].shape, lambda i, j: (0, 0)),
-            vals_spec,
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=interpret,
@@ -228,9 +266,12 @@ def build_pallas_fn(
 
     def fn(gp, Xq):
         xb = Xq.astype(jnp.bfloat16)
-        return call(
+        operands = [
             xb, gp["fsel"], gp["qthr"], gp["dleft"], gp["Pg"], gp["count"],
             gp["vals"],
-        )
+        ]
+        if classification:
+            operands.append(gp["vals_lo"])
+        return call(*operands)
 
     return fn

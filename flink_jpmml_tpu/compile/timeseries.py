@@ -1,21 +1,32 @@
-"""TimeSeriesModel (ExponentialSmoothing) → JAX: closed-form forecasts.
+"""TimeSeriesModel (ExponentialSmoothing, ARIMA) → JAX forecasts.
 
 Reference parity: JPMML-Evaluator scores TimeSeriesModel documents'
-exponential-smoothing state (SURVEY.md §1 C1). The temporal state is in
-the document (final level/trend + one period of seasonal factors); each
-record carries the forecast horizon h (first active MiningField, integer
-≥ 1, rounded), so scoring stays a pure batched function:
+exponential-smoothing AND ARIMA state (SURVEY.md §1 C1). The temporal
+state is in the document; each record carries the forecast horizon h
+(first active MiningField, integer ≥ 1, rounded), so scoring stays a
+pure batched function:
 
-    ŷ(h) = level (+ h·trend | + trend·φ(1−φ^h)/(1−φ) for damped_trend)
-                 (+ seasonal[(h−1) mod period]  |  × seasonal[…])
+- ExponentialSmoothing — closed form, branch-free:
 
-A missing horizon scores as an empty lane. φ^h lowers as exp(h·ln φ)
-(φ ∈ (0,1) guaranteed by the parser), keeping the math branch-free.
+      ŷ(h) = level (+ h·trend | + trend·φ(1−φ^h)/(1−φ) for damped_trend)
+                   (+ seasonal[(h−1) mod period]  |  × seasonal[…])
+
+  φ^h lowers as exp(h·ln φ) (φ ∈ (0,1) guaranteed by the parser).
+
+- ARIMA — the conditional-least-squares recursion is inherently
+  sequential, but the document state is FIXED, so the whole forecast
+  path ŷ(1..H_MAX) is precomputed once on the host in float64
+  (:func:`arima_forecast_path`) and the hot path is a single
+  ``jnp.take`` by horizon — no per-record recursion ever reaches the
+  device. Horizons clamp to [1, H_MAX].
+
+A missing horizon scores as an empty lane either way.
 """
 
 from __future__ import annotations
 
 import math
+from typing import Dict, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -23,9 +34,109 @@ import numpy as np
 from flink_jpmml_tpu.compile.common import Lowered, LowerCtx, ModelOutput
 from flink_jpmml_tpu.pmml import ir
 
+# compiled-path forecast table length: horizons beyond clamp to the last
+# entry (documented in docs/pmml_support.md; the oracle clamps the same)
+ARIMA_H_MAX = ir.ARIMA_H_MAX
+
+
+def _combine_poly(
+    coef: Tuple[float, ...], scoef: Tuple[float, ...], s: int
+) -> List[Tuple[int, float]]:
+    """(1 − Σc_i B^i)(1 − ΣC_I B^{sI}) → the lag/coefficient pairs of the
+    combined subtracted polynomial: 1 − Σ out[lag]·B^lag."""
+    out: Dict[int, float] = {}
+    for i, c in enumerate(coef, 1):
+        out[i] = out.get(i, 0.0) + c
+    for bigi, bigc in enumerate(scoef, 1):
+        out[s * bigi] = out.get(s * bigi, 0.0) + bigc
+        for i, c in enumerate(coef, 1):
+            out[i + s * bigi] = out.get(i + s * bigi, 0.0) - c * bigc
+    return sorted(out.items())
+
+
+def arima_forecast_path(a: ir.ArimaIR, h_max: int = ARIMA_H_MAX) -> np.ndarray:
+    """ŷ(1..h_max) under the CLS recursion, float64 on the host.
+
+    Differencing order here: seasonal (1−B^s)^D first, then regular
+    (1−B)^d; inversion mirrors it. (The operators commute — the oracle
+    interpreter deliberately composes them the other way round, so the
+    golden/fuzz parity suites cross-check both orderings.)"""
+    s = a.period
+    z = np.asarray(a.history, np.float64)
+    if a.transformation == "logarithmic":
+        z = np.log(z)
+    elif a.transformation == "squareroot":
+        z = np.sqrt(z)
+
+    # seasonal differencing ladder (z → u), then regular (u → w)
+    slevels = [z]
+    for _ in range(a.sd):
+        slevels.append(slevels[-1][s:] - slevels[-1][:-s])
+    levels = [slevels[-1]]
+    for _ in range(a.d):
+        levels.append(levels[-1][1:] - levels[-1][:-1])
+    w = levels[-1]
+
+    ar_c = _combine_poly(a.ar, a.sar, s)
+    ma_c = _combine_poly(a.ma, a.sma, s)
+    res = np.asarray(a.residuals, np.float64)
+    T = len(w)
+
+    # W_{T+k} = c + Σ ar_c[lag]·W_{T+k−lag} + a_{T+k} − Σ ma_c[lag]·a_{T+k−lag}
+    # with future a ≡ 0 and past a from the document's residuals
+    wext = list(w)
+    for k in range(1, h_max + 1):
+        acc = a.constant
+        for lag, c in ar_c:
+            acc += c * wext[T + k - 1 - lag]
+        for lag, c in ma_c:
+            j = k - lag
+            if j <= 0:  # a_{T+j}: observed residual (res[-1] is a_T)
+                acc -= c * res[len(res) - 1 + j]
+        wext.append(acc)
+    fcur = np.asarray(wext[T:], np.float64)  # ŵ(1..h_max)
+
+    # invert regular differencing (anchor: each ladder level's last value)
+    for i in range(a.d, 0, -1):
+        run = levels[i - 1][-1]
+        out = np.empty_like(fcur)
+        for k in range(fcur.shape[0]):
+            run = run + fcur[k]
+            out[k] = run
+        fcur = out
+    # invert seasonal differencing (anchor: each level's last s·1 values)
+    for i in range(a.sd, 0, -1):
+        ext = list(slevels[i - 1])
+        out = np.empty_like(fcur)
+        for k in range(fcur.shape[0]):
+            v = fcur[k] + ext[len(ext) - s]
+            out[k] = v
+            ext.append(v)
+        fcur = out
+
+    if a.transformation == "logarithmic":
+        fcur = np.exp(fcur)
+    elif a.transformation == "squareroot":
+        fcur = fcur * fcur
+    return fcur.astype(np.float32)
+
 
 def lower_time_series(model: ir.TimeSeriesIR, ctx: LowerCtx) -> Lowered:
     col = ctx.column(model.horizon_field)
+    if model.arima is not None:
+        path = arima_forecast_path(model.arima)
+        params_a = {"path": path}
+
+        def fn_a(p, X, M):
+            h = jnp.clip(
+                jnp.round(X[:, col]), 1.0, float(path.shape[0])
+            ).astype(jnp.int32)
+            y = jnp.take(p["path"], h - 1)
+            return ModelOutput(
+                value=y.astype(jnp.float32), valid=~M[:, col]
+            )
+
+        return Lowered(fn=fn_a, params=params_a)
     s = model.smoothing
     params = {
         "level": np.float32(s.level),

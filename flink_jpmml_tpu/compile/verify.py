@@ -21,6 +21,7 @@ otherwise the relative error must be within ``precision``.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import List, Optional
 
 from flink_jpmml_tpu.pmml import ir
@@ -83,6 +84,29 @@ def run_verification(model, target_field: Optional[str]) -> List[str]:
     problems: List[str] = []
     if not expect_fields:
         return ["ModelVerification declares no expectation columns"]
+
+    # JPMML honors declared tolerances verbatim and refuses to serve on any
+    # mismatch; we clamp tighter-than-f32 requests to the noise floor instead
+    # (policy above). Make that deviation observable: warn once per field
+    # whose declared tolerance was loosened.
+    for f in expect_fields:
+        loosened = []
+        if f.precision is not None and f.precision < _F32_PRECISION_FLOOR:
+            loosened.append(
+                f"precision {f.precision:g} → {_F32_PRECISION_FLOOR:g}"
+            )
+        if f.zero_threshold is not None and f.zero_threshold < _F32_ZERO_FLOOR:
+            loosened.append(
+                f"zeroThreshold {f.zero_threshold:g} → {_F32_ZERO_FLOOR:g}"
+            )
+        if loosened:
+            warnings.warn(
+                "ModelVerification field "
+                f"{f.field!r}: declared tolerance below the float32 noise "
+                f"floor was loosened ({'; '.join(loosened)}); JPMML would "
+                "verify at the declared value",
+                stacklevel=2,
+            )
 
     codecs = model.field_space.codecs
     records = []

@@ -1805,11 +1805,88 @@ def _eval_bayesian_network(
     )
 
 
+def _eval_arima(a: "ir.ArimaIR", h: int) -> float:
+    """CLS forecast at horizon h — an independent per-record recursion.
+
+    Deliberately composes the differencing the other way round from the
+    compiled path's host precompute (regular (1−B)^d first, seasonal
+    (1−B^s)^D second — the operators commute), so golden/fuzz parity
+    between the two implementations checks the algebra, not one shared
+    routine."""
+    s = a.period
+    z = [float(v) for v in a.history]
+    if a.transformation == "logarithmic":
+        z = [math.log(v) for v in z]
+    elif a.transformation == "squareroot":
+        z = [math.sqrt(v) for v in z]
+
+    # regular differencing first, then seasonal
+    rlevels = [z]
+    for _ in range(a.d):
+        prev = rlevels[-1]
+        rlevels.append([prev[i + 1] - prev[i] for i in range(len(prev) - 1)])
+    slevels = [rlevels[-1]]
+    for _ in range(a.sd):
+        prev = slevels[-1]
+        slevels.append([prev[i + s] - prev[i] for i in range(len(prev) - s)])
+    w = list(slevels[-1])
+
+    # combined φ(B)Φ(B^s) / θ(B)Θ(B^s) subtracted-polynomial coefficients
+    def poly(coef, scoef):
+        out = {}
+        for i, c in enumerate(coef, 1):
+            out[i] = out.get(i, 0.0) + c
+        for bigi, bigc in enumerate(scoef, 1):
+            out[s * bigi] = out.get(s * bigi, 0.0) + bigc
+            for i, c in enumerate(coef, 1):
+                out[i + s * bigi] = out.get(i + s * bigi, 0.0) - c * bigc
+        return out
+
+    ar_c = poly(a.ar, a.sar)
+    ma_c = poly(a.ma, a.sma)
+    res = list(a.residuals)  # most recent last: res[-1] = a_T
+    T = len(w)
+    for k in range(1, h + 1):
+        acc = a.constant
+        for lag, c in ar_c.items():
+            acc += c * w[T + k - 1 - lag]
+        for lag, c in ma_c.items():
+            if k - lag <= 0:
+                acc -= c * res[len(res) - 1 + (k - lag)]
+        w.append(acc)
+    fore = w[T:]  # ŵ(1..h)
+
+    # invert seasonal differencing, then regular (reverse of application)
+    for i in range(a.sd, 0, -1):
+        base = list(slevels[i - 1])
+        for k in range(h):
+            base.append(fore[k] + base[len(base) - s])
+        fore = base[len(base) - h:]
+    for i in range(a.d, 0, -1):
+        run = rlevels[i - 1][-1]
+        nxt = []
+        for k in range(h):
+            run = run + fore[k]
+            nxt.append(run)
+        fore = nxt
+
+    y = fore[-1]
+    if a.transformation == "logarithmic":
+        return math.exp(y)
+    if a.transformation == "squareroot":
+        return y * y
+    return y
+
+
 def _eval_time_series(model: ir.TimeSeriesIR, record: Record) -> EvalResult:
     hv = _as_float(record.get(model.horizon_field))
     if hv is None:
         return EvalResult()
     h = max(int(round(hv)), 1)
+    if model.arima is not None:
+        return EvalResult(
+            value=_eval_arima(model.arima, min(h, ir.ARIMA_H_MAX))
+        )
     s = model.smoothing
     y = s.level
     if s.trend_type == "additive":

@@ -811,8 +811,48 @@ class BayesianNetworkIR:
 
 
 # ---------------------------------------------------------------------------
-# TimeSeriesModel (ExponentialSmoothing)
+# TimeSeriesModel (ExponentialSmoothing, ARIMA)
 # ---------------------------------------------------------------------------
+
+
+# both scoring paths clamp forecast horizons to this (the compiled path
+# precomputes ŷ(1..H) as a constant table; the oracle clamps identically
+# so parity is total over horizons)
+ARIMA_H_MAX = 1024
+
+
+@dataclass(frozen=True)
+class ArimaIR:
+    """Fitted (seasonal) ARIMA state, PMML 4.4 ``<ARIMA>``.
+
+    Model (Box–Jenkins sign convention, as the PMML spec writes it):
+
+        φ(B)·Φ(B^s) W_t = c + θ(B)·Θ(B^s) a_t,
+        W_t = (1−B)^d (1−B^s)^D z_t,   z = transform(y)
+
+    with φ(B) = 1 − Σφ_i B^i, θ(B) = 1 − Σθ_j B^j (seasonal Φ/Θ alike:
+    MA terms SUBTRACT). The document carries the fitted coefficients,
+    the most recent residuals a_t (``residuals``, most recent LAST) and
+    the observed series (``history``, via ``<TimeSeries>``); scoring is
+    the conditional-least-squares forecast recursion at the record's
+    horizon h.
+    """
+
+    constant: float
+    transformation: str  # none | logarithmic | squareroot
+    p: int
+    d: int
+    q: int
+    ar: Tuple[float, ...]  # φ_1..φ_p
+    ma: Tuple[float, ...]  # θ_1..θ_q
+    residuals: Tuple[float, ...]  # a_{T-r+1}..a_T (most recent last)
+    sp: int = 0
+    sd: int = 0
+    sq: int = 0
+    period: int = 0
+    sar: Tuple[float, ...] = ()  # Φ_1..Φ_P
+    sma: Tuple[float, ...] = ()  # Θ_1..Θ_Q
+    history: Tuple[float, ...] = ()  # y_1..y_T in time order
 
 
 @dataclass(frozen=True)
@@ -832,18 +872,22 @@ class ExponentialSmoothingIR:
 @dataclass(frozen=True)
 class TimeSeriesIR:
     """Forecast-at-horizon scoring: the record's ``horizon_field`` value
-    h (integer ≥ 1) selects the h-step-ahead forecast
+    h (integer ≥ 1) selects the h-step-ahead forecast. Exactly one of
+    ``smoothing`` (bestFit=ExponentialSmoothing:
 
         ŷ(h) = level (+ h·trend | + trend·φ(1−φ^h)/(1−φ))
                      (± / × seasonal[(h−1) mod period])
 
-    — the per-record framing of the reference's lead-time evaluation
-    (temporal state lives in the document, not the stream)."""
+    ) or ``arima`` (bestFit=ARIMA: the CLS forecast recursion, see
+    :class:`ArimaIR`) is set — the per-record framing of the reference's
+    lead-time evaluation (temporal state lives in the document, not the
+    stream)."""
 
     function_name: str  # timeSeries
     mining_schema: MiningSchema
-    smoothing: ExponentialSmoothingIR
     horizon_field: str
+    smoothing: Optional[ExponentialSmoothingIR] = None
+    arima: Optional[ArimaIR] = None
     model_name: Optional[str] = None
 
 

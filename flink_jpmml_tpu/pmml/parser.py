@@ -927,12 +927,177 @@ def _parse_bayesian_network(elem: ET.Element) -> ir.BayesianNetworkIR:
     )
 
 
+def _parse_arima_poly(comp: ET.Element, tag_n: str, order: int, what: str):
+    """<AR>/<MA> coefficient arrays of a (non)seasonal component →
+    (coeffs tuple, residuals tuple | None)."""
+    coeffs: Tuple[float, ...] = ()
+    residuals = None
+    if tag_n == "AR":
+        node = _child(comp, "AR")
+        if node is not None:
+            arr = _child(node, "Array")
+            if arr is None:
+                raise ModelLoadingException(f"{what} AR needs an Array")
+            coeffs = _parse_real_array(arr)
+    else:
+        node = _child(comp, "MA")
+        if node is not None:
+            mac = _child(node, "MACoefficients")
+            if mac is not None:
+                arr = _child(mac, "Array")
+                if arr is None:
+                    raise ModelLoadingException(
+                        f"{what} MACoefficients needs an Array"
+                    )
+                coeffs = _parse_real_array(arr)
+            res = _child(node, "Residuals")
+            if res is not None:
+                arr = _child(res, "Array")
+                if arr is None:
+                    raise ModelLoadingException(
+                        f"{what} Residuals needs an Array"
+                    )
+                residuals = _parse_real_array(arr)
+    if len(coeffs) != order:
+        raise ModelLoadingException(
+            f"{what} {tag_n} has {len(coeffs)} coefficients, order says "
+            f"{order}"
+        )
+    return coeffs, residuals
+
+
+def _parse_arima(elem: ET.Element, model_elem: ET.Element) -> ir.ArimaIR:
+    """PMML 4.4 <ARIMA>: conditional-least-squares forecast state."""
+    method = elem.get("predictionMethod", "conditionalLeastSquares")
+    if method != "conditionalLeastSquares":
+        raise ModelLoadingException(
+            f"unsupported ARIMA predictionMethod {method!r} "
+            "(supported: conditionalLeastSquares)"
+        )
+    if _child(elem, "DynamicRegressor") is not None:
+        raise ModelLoadingException(
+            "ARIMA DynamicRegressor terms are not supported"
+        )
+    transformation = elem.get("transformation", "none")
+    if transformation not in ("none", "logarithmic", "squareroot"):
+        raise ModelLoadingException(
+            f"unsupported ARIMA transformation {transformation!r}"
+        )
+    constant = _float(elem, "constantTerm", 0.0)
+
+    p = d = q = 0
+    ar: Tuple[float, ...] = ()
+    ma: Tuple[float, ...] = ()
+    residuals: Tuple[float, ...] = ()
+    ns = _child(elem, "NonseasonalComponent")
+    if ns is not None:
+        p, d, q = _int(ns, "p", 0), _int(ns, "d", 0), _int(ns, "q", 0)
+        ar, _ = _parse_arima_poly(ns, "AR", p, "NonseasonalComponent")
+        ma, res = _parse_arima_poly(ns, "MA", q, "NonseasonalComponent")
+        if res is not None:
+            residuals = res
+
+    sp = sd = sq = 0
+    period = 0
+    sar: Tuple[float, ...] = ()
+    sma: Tuple[float, ...] = ()
+    sc = _child(elem, "SeasonalComponent")
+    if sc is not None:
+        sp, sd, sq = _int(sc, "P", 0), _int(sc, "D", 0), _int(sc, "Q", 0)
+        period = _int(sc, "period")
+        if period < 2:
+            raise ModelLoadingException(
+                f"SeasonalComponent period must be >= 2, got {period}"
+            )
+        sar, _ = _parse_arima_poly(sc, "AR", sp, "SeasonalComponent")
+        sma, sres = _parse_arima_poly(sc, "MA", sq, "SeasonalComponent")
+        if sres is not None and len(sres) > len(residuals):
+            residuals = sres
+
+    # the observed series rides the TimeSeriesModel's <TimeSeries>
+    ts = _child(model_elem, "TimeSeries")
+    history: Tuple[float, ...] = ()
+    if ts is not None:
+        vals = []
+        for tv in ts:
+            if _local(tv.tag) == "TimeValue":
+                v = tv.get("value")
+                if v is None:
+                    raise ModelLoadingException("TimeValue needs a value")
+                vals.append(float(v))
+        history = tuple(vals)
+
+    a = ir.ArimaIR(
+        constant=constant,
+        transformation=transformation,
+        p=p, d=d, q=q, ar=ar, ma=ma, residuals=residuals,
+        sp=sp, sd=sd, sq=sq, period=period, sar=sar, sma=sma,
+        history=history,
+    )
+    _validate_arima(a)
+    return a
+
+
+def _validate_arima(a: "ir.ArimaIR") -> None:
+    s = a.period
+    max_ar = (a.p + s * a.sp) if (a.ar or a.sar) else 0
+    max_ma = (a.q + s * a.sq) if (a.ma or a.sma) else 0
+    n_w = len(a.history) - a.d - s * a.sd
+    if max_ar > 0 or a.d > 0 or a.sd > 0:
+        if not a.history:
+            raise ModelLoadingException(
+                "ARIMA with AR or differencing terms needs the observed "
+                "series (<TimeSeries> with TimeValue elements)"
+            )
+        if n_w < max_ar:
+            raise ModelLoadingException(
+                f"ARIMA history too short: {len(a.history)} observations "
+                f"leave {n_w} differenced values, AR terms need {max_ar}"
+            )
+    if max_ma > 0 and len(a.residuals) < max_ma:
+        raise ModelLoadingException(
+            f"ARIMA MA terms reach back {max_ma} steps but only "
+            f"{len(a.residuals)} residuals are present"
+        )
+    if a.transformation == "logarithmic" and any(
+        v <= 0.0 for v in a.history
+    ):
+        raise ModelLoadingException(
+            "logarithmic ARIMA transformation needs a positive series"
+        )
+    if a.transformation == "squareroot" and any(
+        v < 0.0 for v in a.history
+    ):
+        raise ModelLoadingException(
+            "squareroot ARIMA transformation needs a non-negative series"
+        )
+
+
 def _parse_time_series(elem: ET.Element) -> ir.TimeSeriesIR:
     best_fit = elem.get("bestFit", "ExponentialSmoothing")
+    if best_fit == "ARIMA":
+        arima_el = _child(elem, "ARIMA")
+        if arima_el is None:
+            raise ModelLoadingException(
+                "TimeSeriesModel bestFit=ARIMA has no ARIMA element"
+            )
+        schema = _parse_mining_schema(elem)
+        if not schema.active_fields:
+            raise ModelLoadingException(
+                "TimeSeriesModel needs one active MiningField carrying "
+                "the forecast horizon (integer >= 1)"
+            )
+        return ir.TimeSeriesIR(
+            function_name=elem.get("functionName", "timeSeries"),
+            mining_schema=schema,
+            horizon_field=schema.active_fields[0],
+            arima=_parse_arima(arima_el, elem),
+            model_name=elem.get("modelName"),
+        )
     if best_fit != "ExponentialSmoothing":
         raise ModelLoadingException(
             f"unsupported TimeSeriesModel bestFit {best_fit!r} "
-            "(supported: ExponentialSmoothing)"
+            "(supported: ExponentialSmoothing, ARIMA)"
         )
     es = _child(elem, "ExponentialSmoothing")
     if es is None:

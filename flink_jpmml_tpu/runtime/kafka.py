@@ -255,7 +255,10 @@ def decode_record_batches(buf: bytes) -> List[Tuple[int, bytes]]:
         (base_offset,) = _I64.unpack_from(buf, pos)
         (batch_len,) = _I32.unpack_from(buf, pos + 8)
         end = pos + 12 + batch_len
-        if batch_len <= 0 or end > len(buf):
+        # 49 = minimum v2 batch body (partitionLeaderEpoch..records count);
+        # anything shorter cannot hold the magic/CRC we read below, so treat
+        # it as a truncated trailing batch rather than indexing past it.
+        if batch_len < 49 or end > len(buf):
             break  # partial trailing batch
         magic = buf[pos + 16]
         if magic != 2:
@@ -744,10 +747,14 @@ class MiniKafkaBroker:
         if api_key == API_VERSIONS:
             w = _Writer()
             w.i16(0).i32(4)
+            # Advertise exactly the versions _dispatch answers in: the
+            # Fetch/ListOffsets/Metadata responses below are fixed v4/v1/v1
+            # shapes, so offering lower versions would let a client pick one
+            # and mis-parse the reply.
             for k, lo, hi in (
-                (API_FETCH, 0, 4),
-                (API_LIST_OFFSETS, 0, 1),
-                (API_METADATA, 0, 1),
+                (API_FETCH, 4, 4),
+                (API_LIST_OFFSETS, 1, 1),
+                (API_METADATA, 1, 1),
                 (API_VERSIONS, 0, 0),
             ):
                 w.i16(k).i16(lo).i16(hi)

@@ -1,0 +1,71 @@
+"""Demo-safe backend bootstrap shared by the ``examples/`` jobs.
+
+The tunneled TPU backend in this environment wedges *at init* for
+minutes at a time (see flink_jpmml_tpu/bench.py, which solves this for
+the benchmark with a child-process attempt schedule). An example that
+hangs >5 minutes is a broken demo, so every example calls
+:func:`demo_backend` first, which gives it two escape hatches:
+
+- ``--platform cpu`` (or any jax platform name; also the
+  ``FJT_PLATFORM`` env var): force the platform through the config API
+  **before** backend init — the axon TPU plugin ignores the
+  ``JAX_PLATFORMS`` env var in this image, so the flag is the reliable
+  route.
+- otherwise a watchdog thread arms, the default backend is initialized
+  eagerly, and if it hasn't resolved within ``--backend-timeout``
+  seconds (default 60) the process **re-execs itself** with
+  ``--platform cpu`` appended. Re-exec rather than in-process fallback:
+  a wedged init cannot be cancelled from Python, and a fresh process
+  avoids opening the exclusive-access chip twice (the double-open is
+  itself a wedge trigger — bench.py's child-process notes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+
+def demo_backend(timeout_s: float = 60.0) -> str:
+    """Resolve the jax backend for an example job, demo-safely.
+
+    Parses (and strips from ``sys.argv``) the shared ``--platform`` /
+    ``--backend-timeout`` flags, then either forces the requested
+    platform or eagerly initializes the default one under a watchdog.
+    Returns the resolved backend name.
+    """
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--platform", default=os.environ.get("FJT_PLATFORM"))
+    ap.add_argument("--backend-timeout", type=float, default=timeout_s)
+    args, rest = ap.parse_known_args(sys.argv[1:])
+    sys.argv = [sys.argv[0]] + rest
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+        return jax.default_backend()
+
+    done = threading.Event()
+
+    def _watchdog() -> None:
+        if done.wait(args.backend_timeout):
+            return
+        print(
+            f"[fjt-demo] backend init exceeded {args.backend_timeout:.0f}s "
+            "(wedged TPU tunnel?) — restarting this example on CPU",
+            file=sys.stderr,
+            flush=True,
+        )
+        os.execv(
+            sys.executable,
+            [sys.executable, sys.argv[0], *rest, "--platform", "cpu"],
+        )
+
+    t = threading.Thread(target=_watchdog, daemon=True, name="fjt-demo-wd")
+    t.start()
+    backend = jax.default_backend()  # blocks here when the tunnel wedges
+    done.set()
+    return backend

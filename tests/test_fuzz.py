@@ -457,3 +457,68 @@ class TestFuzzGlm:
         doc = _doc(_rand_glm_model(rng))
         recs = _rand_records(rng, 32)
         _assert_parity(doc, recs, f"glm seed={seed}")
+
+
+class TestFuzzArima:
+    """Random SARIMA state through the FULL pipeline (XML → parser →
+    compile vs oracle): the two implementations compose the differencing
+    operators in opposite orders, so agreement here checks the algebra,
+    not a shared routine."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_random_sarima_parity(self, seed):
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from test_timeseries import _arima_xml, _ns, _sc
+
+        rng = np.random.default_rng(9000 + seed)
+        p = int(rng.integers(0, 3))
+        d = int(rng.integers(0, 2))
+        q = int(rng.integers(0, 3))
+        s = int(rng.integers(2, 5)) if rng.random() < 0.6 else 0
+        P = int(rng.integers(0, 2)) if s else 0
+        D = int(rng.integers(0, 2)) if s else 0
+        Q = int(rng.integers(0, 2)) if s else 0
+        if s and not (P or D or Q):
+            D = 1
+
+        def coefs(n):
+            return tuple(round(float(v), 3)
+                         for v in rng.uniform(-0.65, 0.65, size=n))
+
+        n_res = q + s * Q
+        residuals = tuple(
+            round(float(v), 3) for v in rng.normal(0, 0.4, size=n_res)
+        )
+        n_hist = d + s * D + (p + s * P) + int(rng.integers(8, 16))
+        t = np.arange(n_hist)
+        hist = tuple(
+            round(float(v), 3)
+            for v in 40
+            + 0.8 * t
+            + (4 * np.sin(2 * np.pi * t / s) if s else 0)
+            + rng.normal(0, 1.0, size=n_hist)
+        )
+        transformation = str(
+            rng.choice(("none", "none", "logarithmic", "squareroot"))
+        )
+        body = _ns(p, d, q, ar=coefs(p), ma=coefs(q),
+                   residuals=residuals if n_res else ())
+        if s:
+            body += _sc(P, D, Q, s, sar=coefs(P), sma=coefs(Q))
+        doc = parse_pmml(_arima_xml(
+            body, hist,
+            constant=round(float(rng.uniform(-0.5, 0.5)), 3),
+            transformation=transformation,
+        ))
+        recs = []
+        for _ in range(24):
+            roll = rng.random()
+            if roll < 0.1:
+                recs.append({})
+            elif roll < 0.2:
+                recs.append({"h": None})
+            elif roll < 0.3:
+                recs.append({"h": float(rng.uniform(0.6, 20.0))})
+            else:
+                recs.append({"h": int(rng.integers(1, 31))})
+        _assert_parity(doc, recs, f"sarima seed={seed}")

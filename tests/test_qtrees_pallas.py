@@ -124,6 +124,28 @@ class TestPallasClassification:
             np.asarray(got_v), np.asarray(ref_v), rtol=1e-5, atol=1e-6
         )
 
+    def test_vote_tables_are_bf16_split_pair(self):
+        # regression guard for the round-3 on-device failure: the class
+        # tables must reach the kernel as the bf16 hi/lo SPLIT pair (the
+        # XLA path's operands). A single reconstructed f32 table gets
+        # truncated to bf16 by the MXU at default dot precision, which
+        # interpret-mode CPU runs cannot detect.
+        import jax.numpy as jnp
+
+        _, _, qp = self._pair(_forest_xml("majorityVote", n_trees=8), 32)
+        gp = qp.params
+        assert "vals_lo" in gp
+        assert np.asarray(gp["vals"]).dtype == jnp.bfloat16
+        assert np.asarray(gp["vals_lo"]).dtype == jnp.bfloat16
+
+    def test_auto_selects_pallas_for_vote_forests(self):
+        # the root-caused fix reopens auto selection (VERDICT r3 #2)
+        doc = parse_pmml(_forest_xml("majorityVote", n_trees=8))
+        qa = build_quantized_scorer(
+            doc, batch_size=32, backend="auto", pallas_interpret=True
+        )
+        assert qa is not None and qa.backend == "pallas"
+
     def test_majority_vote_matches_xla_and_f32(self):
         B = 64
         doc, qx, qp = self._pair(_forest_xml("majorityVote", n_trees=8), B)

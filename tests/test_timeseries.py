@@ -1,5 +1,5 @@
-"""TimeSeriesModel (ExponentialSmoothing): compiled vs oracle vs
-hand-computed Holt-Winters forecasts."""
+"""TimeSeriesModel (ExponentialSmoothing, ARIMA): compiled vs oracle vs
+hand-computed forecasts."""
 
 import numpy as np
 import pytest
@@ -106,4 +106,245 @@ class TestExponentialSmoothing:
             parse_pmml(TS.format(
                 trend="",
                 seasonal=SEASONAL_ADD.replace('period="4"', 'period="3"'),
+            ))
+
+
+# ---------------------------------------------------------------------------
+# ARIMA (PMML 4.4 <ARIMA>, conditionalLeastSquares)
+# ---------------------------------------------------------------------------
+
+
+def _arima_xml(body, history, constant=0.0, transformation="none",
+               extra_attrs=""):
+    tv = "".join(
+        f'<TimeValue index="{i + 1}" value="{v}"/>'
+        for i, v in enumerate(history)
+    )
+    return f"""<PMML version="4.4"><DataDictionary>
+  <DataField name="h" optype="continuous" dataType="integer"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TimeSeriesModel functionName="timeSeries" bestFit="ARIMA">
+  <MiningSchema><MiningField name="y" usageType="target"/>
+    <MiningField name="h"/></MiningSchema>
+  <TimeSeries usage="original">{tv}</TimeSeries>
+  <ARIMA constantTerm="{constant}" transformation="{transformation}"
+      predictionMethod="conditionalLeastSquares"{extra_attrs}>
+  {body}
+  </ARIMA>
+  </TimeSeriesModel></PMML>"""
+
+
+def _ns(p, d, q, ar=(), ma=(), residuals=()):
+    parts = [f'<NonseasonalComponent p="{p}" d="{d}" q="{q}">']
+    if ar:
+        parts.append(
+            f'<AR><Array type="real" n="{len(ar)}">'
+            + " ".join(map(str, ar)) + "</Array></AR>"
+        )
+    if ma or residuals:
+        parts.append("<MA>")
+        if ma:
+            parts.append(
+                f'<MACoefficients><Array type="real" n="{len(ma)}">'
+                + " ".join(map(str, ma)) + "</Array></MACoefficients>"
+            )
+        if residuals:
+            parts.append(
+                f'<Residuals><Array type="real" n="{len(residuals)}">'
+                + " ".join(map(str, residuals)) + "</Array></Residuals>"
+            )
+        parts.append("</MA>")
+    parts.append("</NonseasonalComponent>")
+    return "".join(parts)
+
+
+def _sc(P, D, Q, period, sar=(), sma=(), residuals=()):
+    parts = [
+        f'<SeasonalComponent P="{P}" D="{D}" Q="{Q}" period="{period}">'
+    ]
+    if sar:
+        parts.append(
+            f'<AR><Array type="real" n="{len(sar)}">'
+            + " ".join(map(str, sar)) + "</Array></AR>"
+        )
+    if sma or residuals:
+        parts.append("<MA>")
+        if sma:
+            parts.append(
+                f'<MACoefficients><Array type="real" n="{len(sma)}">'
+                + " ".join(map(str, sma)) + "</Array></MACoefficients>"
+            )
+        if residuals:
+            parts.append(
+                f'<Residuals><Array type="real" n="{len(residuals)}">'
+                + " ".join(map(str, residuals)) + "</Array></Residuals>"
+            )
+        parts.append("</MA>")
+    parts.append("</SeasonalComponent>")
+    return "".join(parts)
+
+
+HIST8 = (10.0, 11.0, 9.5, 12.0, 11.5, 10.5, 12.5, 13.0)
+
+
+def _both(doc, cm, h):
+    """(oracle value, compiled value) at horizon h."""
+    o = evaluate(doc, {"h": h}).value
+    c = cm.score_records([{"h": h}])[0].score.value
+    return o, c
+
+
+class TestArima:
+    def test_ar1_closed_form(self):
+        phi, c, yT = 0.6, 0.5, HIST8[-1]
+        doc = parse_pmml(_arima_xml(
+            _ns(1, 0, 0, ar=(phi,)), HIST8, constant=c
+        ))
+        cm = compile_pmml(doc)
+        for h in (1, 2, 3, 7):
+            hand = c * sum(phi ** i for i in range(h)) + phi ** h * yT
+            o, g = _both(doc, cm, h)
+            assert o == pytest.approx(hand, rel=1e-12)
+            assert g == pytest.approx(hand, rel=1e-5)
+
+    def test_ma1_closed_form(self):
+        theta, c, aT = 0.4, 2.0, 0.8
+        doc = parse_pmml(_arima_xml(
+            _ns(0, 0, 1, ma=(theta,), residuals=(0.1, aT)), HIST8,
+            constant=c,
+        ))
+        cm = compile_pmml(doc)
+        o, g = _both(doc, cm, 1)
+        # spec sign convention: θ(B) = 1 − θB ⇒ MA terms subtract
+        assert o == pytest.approx(c - theta * aT, rel=1e-12)
+        assert g == pytest.approx(c - theta * aT, rel=1e-5)
+        for h in (2, 3, 9):
+            o, g = _both(doc, cm, h)
+            assert o == pytest.approx(c, rel=1e-12)
+            assert g == pytest.approx(c, rel=1e-5)
+
+    def test_arima_011_drift_closed_form(self):
+        theta, c, aT = 0.3, 0.25, -0.6
+        yT = HIST8[-1]
+        doc = parse_pmml(_arima_xml(
+            _ns(0, 1, 1, ma=(theta,), residuals=(aT,)), HIST8, constant=c
+        ))
+        cm = compile_pmml(doc)
+        for h in (1, 2, 5):
+            hand = yT + (c - theta * aT) + (h - 1) * c
+            o, g = _both(doc, cm, h)
+            assert o == pytest.approx(hand, rel=1e-12)
+            assert g == pytest.approx(hand, rel=1e-4)
+
+    def test_seasonal_ar_closed_form(self):
+        # SARIMA(0,0,0)(1,0,0)_4: ŷ(h) = c + Φ·ỹ(T+h−4)
+        big_phi, c = 0.5, 1.0
+        doc = parse_pmml(_arima_xml(
+            _sc(1, 0, 0, 4, sar=(big_phi,)), HIST8, constant=c
+        ))
+        cm = compile_pmml(doc)
+        expect = list(HIST8)
+        for _ in range(6):
+            expect.append(c + big_phi * expect[-4])
+        for h in (1, 2, 4, 5, 6):
+            hand = expect[len(HIST8) + h - 1]
+            o, g = _both(doc, cm, h)
+            assert o == pytest.approx(hand, rel=1e-12)
+            assert g == pytest.approx(hand, rel=1e-5)
+
+    def test_seasonal_difference_drift(self):
+        # (0,0,0)(0,1,0)_4 with constant: ŷ(h) = ỹ(T+h−4) + c
+        c = 0.75
+        doc = parse_pmml(_arima_xml(
+            _sc(0, 1, 0, 4), HIST8, constant=c
+        ))
+        cm = compile_pmml(doc)
+        expect = list(HIST8)
+        for _ in range(9):
+            expect.append(expect[-4] + c)
+        for h in (1, 3, 4, 5, 8, 9):
+            hand = expect[len(HIST8) + h - 1]
+            o, g = _both(doc, cm, h)
+            assert o == pytest.approx(hand, rel=1e-12)
+            assert g == pytest.approx(hand, rel=1e-4)
+
+    def test_log_transformation(self):
+        import math
+
+        phi = 0.7
+        doc = parse_pmml(_arima_xml(
+            _ns(1, 0, 0, ar=(phi,)), HIST8, transformation="logarithmic"
+        ))
+        cm = compile_pmml(doc)
+        zT = math.log(HIST8[-1])
+        for h in (1, 2, 4):
+            hand = math.exp(phi ** h * zT)
+            o, g = _both(doc, cm, h)
+            assert o == pytest.approx(hand, rel=1e-12)
+            assert g == pytest.approx(hand, rel=1e-4)
+
+    def test_full_sarima_oracle_vs_compiled(self):
+        # SARIMA(2,1,1)(1,1,1)_4 — no closed form; the two independent
+        # implementations (opposite differencing composition orders)
+        # must agree over a horizon sweep
+        rng = np.random.default_rng(7)
+        hist = tuple(
+            round(50 + 2 * t + 5 * np.sin(t * np.pi / 2) + v, 3)
+            for t, v in enumerate(rng.normal(0, 0.5, size=24))
+        )
+        doc = parse_pmml(_arima_xml(
+            _ns(2, 1, 1, ar=(0.45, -0.2), ma=(0.3,), residuals=(0.2, -0.4))
+            + _sc(1, 1, 1, 4, sar=(0.35,), sma=(0.25,),
+                  residuals=(0.1, -0.2, 0.15, 0.05, 0.2, -0.1)),
+            hist, constant=0.1,
+        ))
+        cm = compile_pmml(doc)
+        hs = list(range(1, 41))
+        preds = cm.score_records([{"h": h} for h in hs])
+        for h, p in zip(hs, preds):
+            o = evaluate(doc, {"h": h}).value
+            assert p.score.value == pytest.approx(o, rel=2e-4, abs=1e-3)
+
+    def test_horizon_clamp_and_missing(self):
+        from flink_jpmml_tpu.pmml.ir import ARIMA_H_MAX
+
+        doc = parse_pmml(_arima_xml(_ns(1, 0, 0, ar=(0.9,)), HIST8))
+        cm = compile_pmml(doc)
+        o_big = evaluate(doc, {"h": ARIMA_H_MAX + 50}).value
+        o_max = evaluate(doc, {"h": ARIMA_H_MAX}).value
+        assert o_big == o_max
+        g = cm.score_records([{"h": ARIMA_H_MAX + 50}])[0].score.value
+        assert g == pytest.approx(o_max, abs=1e-6)
+        assert cm.score_records([{"h": None}])[0].is_empty
+        assert evaluate(doc, {"h": None}).value is None
+
+    def test_rejections(self):
+        # exactLeastSquares is out of scope (documented)
+        with pytest.raises(ModelLoadingException, match="predictionMethod"):
+            parse_pmml(_arima_xml(
+                _ns(1, 0, 0, ar=(0.5,)), HIST8
+            ).replace("conditionalLeastSquares", "exactLeastSquares"))
+        # AR terms but no history
+        with pytest.raises(ModelLoadingException, match="observed series"):
+            parse_pmml(_arima_xml(_ns(1, 0, 0, ar=(0.5,)), ()))
+        # MA reach exceeds residuals
+        with pytest.raises(ModelLoadingException, match="residuals"):
+            parse_pmml(_arima_xml(
+                _ns(0, 0, 2, ma=(0.3, 0.2), residuals=(0.5,)), HIST8
+            ))
+        # coefficient count must match declared order
+        with pytest.raises(ModelLoadingException, match="coefficients"):
+            parse_pmml(_arima_xml(_ns(2, 0, 0, ar=(0.5,)), HIST8))
+        # log transform needs a positive series
+        with pytest.raises(ModelLoadingException, match="positive"):
+            parse_pmml(_arima_xml(
+                _ns(1, 0, 0, ar=(0.5,)), (1.0, -2.0, 3.0, 4.0),
+                transformation="logarithmic",
+            ))
+        # DynamicRegressor terms are rejected, not ignored
+        with pytest.raises(ModelLoadingException, match="DynamicRegressor"):
+            parse_pmml(_arima_xml(
+                '<DynamicRegressor field="x"/>' + _ns(1, 0, 0, ar=(0.5,)),
+                HIST8,
             ))

@@ -102,6 +102,26 @@ class TestModelVerification:
         path = _write(tmp_path, REG.format(y1="-3.4999998", y2="-1.25"))
         assert ModelReader(path).load().verify() == []
 
+    def test_below_floor_tolerance_warns_when_loosened(self, tmp_path):
+        clear_model_cache()
+        # precision 1E-8 is below the f32 floor: the clamp (a deliberate
+        # deviation from JPMML, which honors declared tolerances) must be
+        # observable as a warning
+        xml = REG.replace('precision="1E-5"', 'precision="1E-8"')
+        path = _write(tmp_path, xml.format(y1="-3.5", y2="-1.25"))
+        with pytest.warns(UserWarning, match="noise floor"):
+            cm = ModelReader(path).load()
+        assert cm.verify() == []
+
+    def test_at_floor_tolerance_does_not_warn(self, tmp_path):
+        clear_model_cache()
+        path = _write(tmp_path, REG.format(y1="-3.5", y2="-1.25"))
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            assert ModelReader(path).load().verify() == []
+
     def test_classification_label_and_probability(self, tmp_path):
         import math
 
