@@ -14,6 +14,12 @@ the parser can read, caching the bytes on disk and re-validating on each
   optional dependency the scheme fails with a typed, actionable error
   instead of an ImportError mid-stream. Object generation/etag is the
   cache validator.
+- ``alluxio://`` — the Alluxio proxy REST API (v1): ``get-status``
+  supplies the validator (lastModificationTimeMs+length), then
+  ``open-file`` → ``streams/{id}/read`` → ``close`` fetches the bytes.
+  Proxy REST port defaults to 39999; ``FJT_ALLUXIO_PORT`` overrides
+  (URIs copied from client configs usually carry the *master RPC* port
+  19998, which does not speak HTTP).
 - ``file://`` and bare paths — passed through untouched.
 
 The cache key is the URI's SHA-256, under ``$FJT_MODEL_CACHE`` (default
@@ -37,7 +43,7 @@ from typing import Optional, Tuple
 
 from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
 
-_REMOTE_SCHEMES = ("http", "https", "gs", "s3", "hdfs")
+_REMOTE_SCHEMES = ("http", "https", "gs", "s3", "hdfs", "alluxio")
 
 # WebHDFS REST port when the hdfs:// URI carries none (Hadoop 3 NameNode
 # default); override per deployment with FJT_WEBHDFS_PORT. URIs copied
@@ -45,6 +51,10 @@ _REMOTE_SCHEMES = ("http", "https", "gs", "s3", "hdfs")
 # to the REST default rather than speaking HTTP at a protobuf endpoint.
 _WEBHDFS_DEFAULT_PORT = 9870
 _HDFS_RPC_PORTS = (8020, 9000)
+
+# Alluxio proxy REST port (the master RPC port 19998 does not speak HTTP)
+_ALLUXIO_DEFAULT_PORT = 39999
+_ALLUXIO_RPC_PORTS = (19998,)
 
 
 def is_remote(path: str) -> bool:
@@ -131,6 +141,8 @@ def fetch(uri: str, timeout_s: float = 30.0) -> Tuple[str, str]:
         return _fetch_s3(parts)
     if parts.scheme == "hdfs":
         return _fetch_hdfs(parts, timeout_s)
+    if parts.scheme == "alluxio":
+        return _fetch_alluxio(parts, timeout_s)
     if parts.scheme == "file":
         local = urllib.request.url2pathname(parts.path)
         return local, str(_mtime(local))
@@ -182,47 +194,49 @@ def _fetch_http(uri: str, timeout_s: float) -> Tuple[str, str]:
     return local, token
 
 
-def _fetch_hdfs(parts, timeout_s: float) -> Tuple[str, str]:
-    """``hdfs://namenode[:port]/path`` via the WebHDFS REST gateway —
-    no Hadoop client dependency, plain HTTP against the NameNode:
-    GETFILESTATUS supplies the cache validator (modificationTime+length);
-    OPEN streams the bytes (follows the DataNode redirect). The REST port
-    defaults to 9870 (Hadoop 3) and can be overridden with
-    ``FJT_WEBHDFS_PORT`` when the URI gives only the RPC authority."""
+def _fetch_rest_validated(
+    parts,
+    timeout_s: float,
+    *,
+    label: str,
+    env_var: str,
+    rpc_ports: Tuple[int, ...],
+    default_port: int,
+    status_token,
+    read_bytes,
+) -> Tuple[str, str]:
+    """Shared scaffold for the REST-gateway filesystems (WebHDFS,
+    Alluxio proxy): resolve the REST port (env override wins; a known
+    RPC port in the URI remaps to the gateway default), validate the
+    cache with ``status_token(host, port) -> token``, fetch with
+    ``read_bytes(host, port) -> bytes``, and apply the module's shared
+    outage ladder (HTTP error → typed; network error → stale-or-raise).
+    Keeping ONE ladder means a fix to stale-serving or port parsing
+    cannot drift between the two schemes."""
     uri = urllib.parse.urlunsplit(parts)
     local, meta_path = _cache_paths(uri)
     host = parts.hostname or "localhost"
     try:
-        env_port = os.environ.get("FJT_WEBHDFS_PORT")
+        env_port = os.environ.get(env_var)
         if env_port is not None:
             port = int(env_port)  # explicit override always wins
         else:
             port = parts.port  # urlsplit defers validation to here
-            if port is None or port in _HDFS_RPC_PORTS:
-                port = _WEBHDFS_DEFAULT_PORT
+            if port is None or port in rpc_ports:
+                port = default_port
     except ValueError as e:
         raise ModelLoadingException(
-            f"invalid WebHDFS port for {uri!r}: {e}"
+            f"invalid {label} port for {uri!r}: {e}"
         ) from e
-    base = f"http://{host}:{port}/webhdfs/v1{parts.path}"
     try:
-        with urllib.request.urlopen(
-            base + "?op=GETFILESTATUS", timeout=timeout_s
-        ) as resp:
-            status = json.load(resp).get("FileStatus", {})
-        token = (
-            f"{status.get('modificationTime', 0)}-{status.get('length', 0)}"
-        )
+        token = status_token(host, port)
         meta = _read_meta(meta_path)
         if os.path.exists(local) and meta.get("token") == token:
             return local, token
-        with urllib.request.urlopen(
-            base + "?op=OPEN", timeout=timeout_s
-        ) as resp:  # urllib follows the DataNode 307 redirect
-            data = resp.read()
+        data = read_bytes(host, port)
     except urllib.error.HTTPError as e:
         raise ModelLoadingException(
-            f"WebHDFS {e.code} fetching model {uri!r}"
+            f"{label} {e.code} fetching model {uri!r}"
         ) from e
     except (
         urllib.error.URLError, OSError, TimeoutError, json.JSONDecodeError,
@@ -232,6 +246,99 @@ def _fetch_hdfs(parts, timeout_s: float) -> Tuple[str, str]:
             _read_meta(meta_path).get("token") or "stale",
         )
     return _commit_cache(local, meta_path, token, data, uri)
+
+
+def _fetch_hdfs(parts, timeout_s: float) -> Tuple[str, str]:
+    """``hdfs://namenode[:port]/path`` via the WebHDFS REST gateway —
+    no Hadoop client dependency, plain HTTP against the NameNode:
+    GETFILESTATUS supplies the cache validator (modificationTime+length);
+    OPEN streams the bytes (follows the DataNode redirect). The REST port
+    defaults to 9870 (Hadoop 3) and can be overridden with
+    ``FJT_WEBHDFS_PORT`` when the URI gives only the RPC authority."""
+
+    def base(host, port):
+        return f"http://{host}:{port}/webhdfs/v1{parts.path}"
+
+    def status_token(host, port):
+        with urllib.request.urlopen(
+            base(host, port) + "?op=GETFILESTATUS", timeout=timeout_s
+        ) as resp:
+            status = json.load(resp).get("FileStatus", {})
+        return (
+            f"{status.get('modificationTime', 0)}-{status.get('length', 0)}"
+        )
+
+    def read_bytes(host, port):
+        with urllib.request.urlopen(
+            base(host, port) + "?op=OPEN", timeout=timeout_s
+        ) as resp:  # urllib follows the DataNode 307 redirect
+            return resp.read()
+
+    return _fetch_rest_validated(
+        parts, timeout_s,
+        label="WebHDFS",
+        env_var="FJT_WEBHDFS_PORT",
+        rpc_ports=_HDFS_RPC_PORTS,
+        default_port=_WEBHDFS_DEFAULT_PORT,
+        status_token=status_token,
+        read_bytes=read_bytes,
+    )
+
+
+def _post_json(url: str, timeout_s: float):
+    """Alluxio REST calls are POSTs with empty bodies → parsed JSON
+    (or None for an empty 200 body, e.g. stream close)."""
+    req = urllib.request.Request(url, data=b"", method="POST")
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        body = resp.read()
+    return json.loads(body) if body else None
+
+
+def _fetch_alluxio(parts, timeout_s: float) -> Tuple[str, str]:
+    """``alluxio://master[:port]/path`` via the Alluxio proxy REST API
+    (v1) — no Alluxio client dependency: ``paths/{p}/get-status``
+    supplies the cache validator, ``paths/{p}/open-file`` opens a read
+    stream whose id feeds ``streams/{id}/read`` (bytes) and
+    ``streams/{id}/close``. The proxy REST port defaults to 39999 and
+    can be overridden with ``FJT_ALLUXIO_PORT`` when the URI carries the
+    master RPC authority (19998)."""
+    path_enc = urllib.parse.quote(parts.path, safe="/")
+
+    def base(host, port):
+        return f"http://{host}:{port}/api/v1"
+
+    def status_token(host, port):
+        status = _post_json(
+            f"{base(host, port)}/paths/{path_enc}/get-status", timeout_s
+        ) or {}
+        return (
+            f"{status.get('lastModificationTimeMs', 0)}-"
+            f"{status.get('length', 0)}"
+        )
+
+    def read_bytes(host, port):
+        root = base(host, port)
+        sid = _post_json(f"{root}/paths/{path_enc}/open-file", timeout_s)
+        req = urllib.request.Request(
+            f"{root}/streams/{sid}/read", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            data = resp.read()
+        try:
+            _post_json(f"{root}/streams/{sid}/close", timeout_s)
+        except (urllib.error.URLError, OSError, TimeoutError):
+            pass  # bytes are already in hand; a leaked stream id times out
+        return data
+
+    return _fetch_rest_validated(
+        parts, timeout_s,
+        label="Alluxio REST",
+        env_var="FJT_ALLUXIO_PORT",
+        rpc_ports=_ALLUXIO_RPC_PORTS,
+        default_port=_ALLUXIO_DEFAULT_PORT,
+        status_token=status_token,
+        read_bytes=read_bytes,
+    )
 
 
 def _fetch_gs(parts) -> Tuple[str, str]:

@@ -366,7 +366,10 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
     from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
 
     Bl = int(args.latency_batch)
-    block = 256  # granularity of arrival stamps (and of the percentiles)
+    # granularity of arrival stamps (and of the percentiles); must not
+    # exceed the data pool or the offset domain (steps of `block`) would
+    # diverge from the record-count domain the sink matches against
+    block = min(256, int(data_f32.shape[0]))
     cm = compile_pmml(doc, batch_size=Bl)
     # arrival stamps in offset order (ingest thread appends, score-loop
     # sink pops — deque ops are atomic under the GIL). Ordered matching

@@ -17,11 +17,15 @@ convention (offset k = "k records consumed" = next record index) maps
 arithmetic, and the offset checkpointed after scoring record ``i`` is
 ``i + 1`` (see runtime/net.py's domain note; both sources share it).
 
-Scope: single-partition consumption without consumer groups — the
-framework's keyed partitioner (parallel/partitioner.py) routes records to
-workers, so group coordination (JoinGroup/SyncGroup/OffsetCommit) is not
-needed; checkpoints own the offsets (capability C7), which is also the
-exactly-once-correct place for them.
+Scope: consumption without consumer groups — the framework's keyed
+partitioner (parallel/partitioner.py) routes records to workers, so
+group coordination (JoinGroup/SyncGroup/OffsetCommit) is not needed;
+checkpoints own the offsets (capability C7), which is also the
+exactly-once-correct place for them. Multi-partition topics are
+consumed via ``partitions=[...]`` as a strict round-robin interleave
+whose single global offset deterministically encodes every partition
+cursor (see ``_KafkaSourceBase``), so the same scalar checkpoint
+resumes N partitions exactly.
 
 All integers big-endian per the Kafka protocol; record-batch varints are
 protobuf zigzag.
@@ -29,6 +33,7 @@ protobuf zigzag.
 
 from __future__ import annotations
 
+import collections
 import socket
 import struct
 import threading
@@ -492,7 +497,21 @@ class KafkaClient:
 
 
 class _KafkaSourceBase:
-    """Shared fetch/reconnect/seek plumbing for both source shapes."""
+    """Shared fetch/reconnect/seek plumbing for both source shapes.
+
+    Single-partition (default): engine offsets ARE Kafka offsets (the
+    1:1 domain of the module header).
+
+    Multi-partition (``partitions=[...]``): records are consumed in a
+    STRICT round-robin interleave — global record index g maps to
+    partition ``partitions[g % P]`` at partition offset ``g // P``.
+    Because the map is a bijection, the engine's single checkpointed
+    offset determinstically encodes every per-partition cursor, so
+    ``seek(k)`` resumes all partitions exactly (capability C7) without
+    any extra state. The contract this buys requires a round-robin
+    producer and gapless partitions (no compaction) — the tabular-stream
+    layout; a partition-offset gap raises ``KafkaProtocolError`` rather
+    than silently mis-aligning lanes."""
 
     def __init__(
         self,
@@ -500,30 +519,37 @@ class _KafkaSourceBase:
         port: int,
         topic: str,
         partition: int = 0,
+        partitions: Optional[Sequence[int]] = None,
         start_offset: int = 0,
         max_wait_ms: int = 50,
         reconnect_backoff_s: float = 0.05,
     ):
         self._client = KafkaClient(host, port)
         self._topic = topic
-        self._partition = partition
-        self._next = start_offset  # next Kafka offset to fetch
+        self._parts = (
+            tuple(partitions) if partitions is not None else (partition,)
+        )
+        if len(set(self._parts)) != len(self._parts) or not self._parts:
+            raise ValueError(f"bad partition set {self._parts!r}")
+        self._partition = self._parts[0]
+        self._next = start_offset  # next Kafka offset (single-partition)
+        self._g = start_offset  # next global record index (multi)
+        self._bufs: Dict[int, "collections.deque"] = {
+            p: collections.deque() for p in self._parts
+        }
         self._max_wait_ms = max_wait_ms
         self._backoff = reconnect_backoff_s
         self._eos = False
 
-    def _fetch(self) -> List[Tuple[int, bytes]]:
+    def _fetch_part(self, part: int, offset: int) -> List[Tuple[int, bytes]]:
         try:
             _, recs = self._client.fetch(
-                self._topic,
-                self._partition,
-                self._next,
-                max_wait_ms=self._max_wait_ms,
+                self._topic, part, offset, max_wait_ms=self._max_wait_ms
             )
         except (OSError, ConnectionError, KafkaProtocolError):
             # reconnect-at-offset: exactly the consumer resume model —
-            # nothing is lost or duplicated because _next only advances
-            # on successfully decoded records
+            # nothing is lost or duplicated because the cursors only
+            # advance on successfully decoded records
             self._client.close()
             time.sleep(self._backoff)
             try:
@@ -531,14 +557,56 @@ class _KafkaSourceBase:
             except OSError:
                 return []
             return []
+        return recs
+
+    def _fetch(self) -> List[Tuple[int, bytes]]:
+        """Single-partition fetch from the legacy Kafka-offset cursor."""
+        recs = self._fetch_part(self._partition, self._next)
         if recs:
             self._next = recs[-1][0] + 1
         return recs
 
+    def _pump(self, want: int) -> List[Tuple[int, bytes]]:
+        """→ up to ``want`` (global_index, value) pairs in strict
+        round-robin order across the configured partitions. Stops early
+        when the next-in-turn partition has nothing fetchable yet (the
+        interleave never skips ahead — that would break the bijection)."""
+        P = len(self._parts)
+        out: List[Tuple[int, bytes]] = []
+        while len(out) < want:
+            part = self._parts[self._g % P]
+            po = self._g // P
+            buf = self._bufs[part]
+            while buf and buf[0][0] < po:
+                buf.popleft()
+            if not buf:
+                recs = self._fetch_part(part, po)
+                if not recs:
+                    break
+                buf.extend(recs)
+                continue
+            off, value = buf.popleft()
+            if off != po:
+                raise KafkaProtocolError(
+                    f"partition {part} offset gap ({po} -> {off}) breaks "
+                    "the round-robin interleave contract"
+                )
+            out.append((self._g, value))
+            self._g += 1
+        return out
+
+    @property
+    def _multi(self) -> bool:
+        return len(self._parts) > 1
+
     def seek(self, offset: int) -> None:
-        # engine offset k ("k records consumed") == next Kafka offset: the
-        # two domains coincide, no +1 bridging anywhere (cf. net.py header)
+        # engine offset k ("k records consumed") == next Kafka offset
+        # (single-partition) / next global index (multi): no +1 bridging
+        # anywhere (cf. net.py header)
         self._next = offset
+        self._g = offset
+        for buf in self._bufs.values():
+            buf.clear()
 
     def close(self) -> None:
         self._client.close()
@@ -560,6 +628,11 @@ class KafkaRecordSource(_KafkaSourceBase, Source):
         self._pending: List[Tuple[int, bytes]] = []
 
     def poll(self, max_n: int) -> Polled:
+        if self._multi:
+            return [
+                (g + 1, self._decode(value))
+                for g, value in self._pump(max_n)
+            ]
         # a fetch may return more than max_n records; the surplus stays
         # buffered so nothing fetched is ever dropped (the fetch cursor
         # has already moved past it)
@@ -585,6 +658,16 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         self._cols = n_cols
 
     def poll(self) -> Optional[Tuple[int, np.ndarray]]:
+        if self._multi:
+            # the interleave yields consecutive global indices by
+            # construction, so a pump's worth IS one contiguous block
+            recs = self._pump(1024)
+            if not recs:
+                return None
+            rows = np.empty((len(recs), self._cols), np.float32)
+            for i, (_, value) in enumerate(recs):
+                rows[i] = np.frombuffer(value, np.float32, count=self._cols)
+            return recs[0][0], rows
         recs = self._fetch()
         if not recs:
             return None
@@ -616,9 +699,11 @@ class MiniKafkaBroker:
     kill/resume drills run against real protocol bytes."""
 
     def __init__(self, topic: str = "records", host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, n_partitions: int = 1):
         self.topic = topic
-        self._log: List[bytes] = []  # value bytes; index == offset
+        self.n_partitions = n_partitions
+        # per-partition value bytes; index within a log == partition offset
+        self._logs: List[List[bytes]] = [[] for _ in range(n_partitions)]
         self._mu = threading.Condition()
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()[:2]
@@ -632,22 +717,38 @@ class MiniKafkaBroker:
 
     # -- producer side (in-process) --------------------------------------
 
-    def append(self, *values: bytes) -> int:
-        """→ offset of the first appended value."""
+    def append(self, *values: bytes, partition: int = 0) -> int:
+        """→ offset of the first appended value (in ``partition``)."""
         with self._mu:
-            first = len(self._log)
-            self._log.extend(values)
+            log = self._logs[partition]
+            first = len(log)
+            log.extend(values)
             self._mu.notify_all()
             return first
 
-    def append_rows(self, rows: np.ndarray) -> int:
+    def append_rows(self, rows: np.ndarray, partition: int = 0) -> int:
         rows = np.ascontiguousarray(rows, np.float32)
-        return self.append(*(rows[i].tobytes() for i in range(rows.shape[0])))
+        return self.append(
+            *(rows[i].tobytes() for i in range(rows.shape[0])),
+            partition=partition,
+        )
+
+    def append_rows_round_robin(self, rows: np.ndarray) -> None:
+        """Row i → partition i % n_partitions (the producer layout the
+        multi-partition sources' strict interleave consumes). Chunked
+        producers must pass chunks whose length divides by n_partitions,
+        or the round-robin phase restarts mid-stream."""
+        rows = np.ascontiguousarray(rows, np.float32)
+        for p in range(self.n_partitions):
+            self.append_rows(rows[p :: self.n_partitions], partition=p)
 
     @property
     def high_watermark(self) -> int:
+        """Total records across ALL partitions — so produced-vs-consumed
+        waits stay correct on a multi-partition broker (per-partition
+        watermarks ride the Fetch/ListOffsets responses)."""
         with self._mu:
-            return len(self._log)
+            return sum(len(log) for log in self._logs)
 
     def close(self) -> None:
         self._closing = True
@@ -768,23 +869,25 @@ class MiniKafkaBroker:
             w.i32(0)  # controller id
             w.i32(1)  # topics
             w.i16(0).string(self.topic).i8(0)
-            w.i32(1)  # partitions
-            w.i16(0).i32(0).i32(0)  # err, index, leader
-            w.i32(1).i32(0)  # replicas
-            w.i32(1).i32(0)  # isr
+            w.i32(self.n_partitions)
+            for idx in range(self.n_partitions):
+                w.i16(0).i32(idx).i32(0)  # err, index, leader
+                w.i32(1).i32(0)  # replicas
+                w.i32(1).i32(0)  # isr
             return bytes(w.b)
         if api_key == API_LIST_OFFSETS:
             r.i32()  # replica id
             r.i32()  # topic count (1)
             r.string()
             r.i32()  # partition count (1)
-            r.i32()  # partition
+            part = r.i32()
             ts = r.i64()
             with self._mu:
-                off = 0 if ts == -2 else len(self._log)
+                log = self._logs[part] if 0 <= part < len(self._logs) else []
+                off = 0 if ts == -2 else len(log)
             w = _Writer()
             w.i32(1).string(self.topic)
-            w.i32(1).i32(0).i16(0).i64(-1).i64(off)
+            w.i32(1).i32(part).i16(0).i64(-1).i64(off)
             return bytes(w.b)
         if api_key == API_FETCH:
             r.i32()  # replica id
@@ -797,25 +900,26 @@ class MiniKafkaBroker:
             r.i32()  # topic count
             r.string()
             r.i32()  # partition count
-            r.i32()  # partition
+            part = r.i32()
             fetch_offset = r.i64()
             part_max_bytes = r.i32()
             deadline = time.monotonic() + max_wait_ms / 1000.0
             with self._mu:
+                log = self._logs[part] if 0 <= part < len(self._logs) else []
                 while (
-                    len(self._log) <= fetch_offset
+                    len(log) <= fetch_offset
                     and not self._closing
                     and time.monotonic() < deadline
                 ):
                     self._mu.wait(
                         max(deadline - time.monotonic(), 0.001)
                     )
-                hw = len(self._log)
+                hw = len(log)
                 values = []
                 size = 0
                 o = fetch_offset
                 while o < hw:
-                    val = self._log[o]
+                    val = log[o]
                     size += len(val) + 32
                     if values and size > part_max_bytes:
                         break
@@ -828,7 +932,7 @@ class MiniKafkaBroker:
             w.i32(0)  # throttle
             w.i32(1).string(self.topic)
             w.i32(1)
-            w.i32(0).i16(0).i64(hw)  # partition, err, high watermark
+            w.i32(part).i16(0).i64(hw)  # partition, err, high watermark
             w.i64(hw)  # last stable offset
             w.i32(0)  # aborted txns
             w.bytes_(record_set)

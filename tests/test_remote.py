@@ -290,3 +290,112 @@ class TestHdfsPortResolution:
         monkeypatch.setenv("FJT_WEBHDFS_PORT", "default")
         with pytest.raises(ModelLoadingException, match="port"):
             remote.fetch("hdfs://nn/m.pmml", timeout_s=0.3)
+
+
+class _AlluxioHandler(http.server.BaseHTTPRequestHandler):
+    """Minimal Alluxio proxy REST stub (v1): get-status / open-file /
+    streams read+close over one in-memory file, counting operations."""
+
+    content = b""
+    mtime_ms = 1000
+    stats = {"status": 0, "open": 0, "read": 0, "close": 0}
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        cls = type(self)
+
+        def reply(body: bytes, ctype="application/json"):
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        if self.path.endswith("/get-status"):
+            cls.stats["status"] += 1
+            reply(
+                b'{"lastModificationTimeMs": %d, "length": %d, '
+                b'"folder": false}' % (cls.mtime_ms, len(cls.content))
+            )
+        elif self.path.endswith("/open-file"):
+            cls.stats["open"] += 1
+            reply(b"7")  # stream id
+        elif self.path.endswith("/streams/7/read"):
+            cls.stats["read"] += 1
+            reply(cls.content, ctype="application/octet-stream")
+        elif self.path.endswith("/streams/7/close"):
+            cls.stats["close"] += 1
+            reply(b"")
+        else:
+            self.send_response(400)
+            self.end_headers()
+
+
+@pytest.fixture()
+def alluxio(tmp_path, monkeypatch):
+    monkeypatch.setenv("FJT_MODEL_CACHE", str(tmp_path / "cache"))
+    _AlluxioHandler.content = _CONST_XML.format(c=4.0).encode()
+    _AlluxioHandler.mtime_ms = 1000
+    _AlluxioHandler.stats = {"status": 0, "open": 0, "read": 0, "close": 0}
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _AlluxioHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+class TestAlluxioFetch:
+    def test_fetch_and_score(self, alluxio):
+        clear_model_cache()
+        uri = f"alluxio://127.0.0.1:{alluxio}/models/const.pmml"
+        m = ModelReader(uri).load()
+        p = m.score_records([{"a": 2.0}])[0]
+        assert p.score.value == pytest.approx(5.0)
+        assert _AlluxioHandler.stats == {
+            "status": 1, "open": 1, "read": 1, "close": 1,
+        }
+
+    def test_unchanged_file_revalidates_without_download(self, alluxio):
+        clear_model_cache()
+        uri = f"alluxio://127.0.0.1:{alluxio}/models/const.pmml"
+        remote.fetch(uri)
+        remote.fetch(uri)
+        assert _AlluxioHandler.stats["status"] == 2
+        assert _AlluxioHandler.stats["read"] == 1  # cache hit, no re-read
+
+    def test_changed_mtime_redownloads(self, alluxio):
+        clear_model_cache()
+        uri = f"alluxio://127.0.0.1:{alluxio}/models/const.pmml"
+        _, tok1 = remote.fetch(uri)
+        _AlluxioHandler.content = _CONST_XML.format(c=9.0).encode()
+        _AlluxioHandler.mtime_ms = 2000
+        local, tok2 = remote.fetch(uri)
+        assert tok1 != tok2
+        assert b"9.0" in pathlib.Path(local).read_bytes()
+
+    def test_outage_serves_stale_with_warning(self, alluxio):
+        clear_model_cache()
+        uri = f"alluxio://127.0.0.1:{alluxio}/models/const.pmml"
+        local, _ = remote.fetch(uri)
+        dead = "alluxio://127.0.0.1:1/models/const.pmml"
+        with pytest.warns(RuntimeWarning, match="stale"):
+            lp, _ = remote._cache_paths(dead)
+            pathlib.Path(lp).write_bytes(pathlib.Path(local).read_bytes())
+            got, tok = remote.fetch(dead, timeout_s=0.5)
+        assert got == lp and tok == "stale"
+
+    def test_rpc_port_maps_to_proxy_default(self, monkeypatch, tmp_path):
+        # alluxio://master:19998/... must NOT speak HTTP at the RPC port
+        monkeypatch.setenv("FJT_MODEL_CACHE", str(tmp_path / "c3"))
+        with pytest.raises(ModelLoadingException, match="cannot fetch"):
+            remote.fetch("alluxio://127.0.0.1:19998/m.pmml", timeout_s=0.3)
+
+    def test_env_override_always_wins(self, alluxio, monkeypatch):
+        clear_model_cache()
+        monkeypatch.setenv("FJT_ALLUXIO_PORT", str(alluxio))
+        local, tok = remote.fetch(
+            "alluxio://127.0.0.1:19998/models/const.pmml"
+        )
+        assert pathlib.Path(local).exists() and tok
