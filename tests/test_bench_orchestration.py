@@ -1,0 +1,132 @@
+"""bench.py parent orchestration: the live stderr watch, the init
+sub-timeout kill, and the headline policies — tested against FAKE
+children (shell scripts standing in for the measurement child), so the
+attempt schedule's behavior is pinned without touching jax or a device."""
+
+import json
+import sys
+import textwrap
+
+import pytest
+
+from flink_jpmml_tpu import bench
+
+
+def _args(**over):
+    import argparse
+
+    ns = argparse.Namespace(
+        trees=500, depth=6, features=32, batch=262144, chunk=16384,
+        window=2, seconds=4.0, f32_wire=False, init_timeout=2.0,
+        max_attempts=4, total_budget=60.0, skip_interp=False,
+        skip_latency=False, latency=False, latency_batch=4096,
+        latency_deadline_us=2000, latency_offered=100000.0,
+        in_child=False, force_cpu=False, block_pipeline=False,
+    )
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def _fake_child(tmp_path, monkeypatch, body):
+    """Route _child_cmd at a scripted stand-in for the measurement
+    child."""
+    script = tmp_path / "fake_child.py"
+    script.write_text(textwrap.dedent(body))
+    monkeypatch.setattr(
+        bench, "_child_cmd",
+        lambda args, force_cpu: [sys.executable, str(script)],
+    )
+
+
+class TestRunChild:
+    def test_healthy_child_line_parsed(self, tmp_path, monkeypatch):
+        _fake_child(tmp_path, monkeypatch, """
+            import json, sys
+            print("[bench +0.1s] backend resolved: tpu", file=sys.stderr)
+            print(json.dumps({"metric": "m", "value": 1.0,
+                              "backend": "tpu"}))
+        """)
+        line, err, wedged = bench._run_child(
+            _args(), force_cpu=False, init_timeout_s=30.0,
+            total_timeout_s=30.0,
+        )
+        assert err is None and not wedged
+        assert line["backend"] == "tpu"
+
+    def test_init_wedge_killed_at_sub_timeout(self, tmp_path, monkeypatch):
+        _fake_child(tmp_path, monkeypatch, """
+            import sys, time
+            print("[bench +0.0s] importing jax", file=sys.stderr, flush=True)
+            time.sleep(600)  # wedged: never prints the resolved stamp
+        """)
+        import time
+
+        t0 = time.monotonic()
+        line, err, wedged = bench._run_child(
+            _args(), force_cpu=False, init_timeout_s=2.0,
+            total_timeout_s=60.0,
+        )
+        elapsed = time.monotonic() - t0
+        assert line is None and wedged
+        assert "backend init exceeded" in err
+        assert elapsed < 30.0  # killed at the sub-timeout, not the budget
+
+    def test_stamp_found_beyond_tail_window(self, tmp_path, monkeypatch):
+        # regression: the stamp must be found even when later stderr
+        # (e.g. FJT_BENCH_TRACE faulthandler dumps) pushes it far back
+        _fake_child(tmp_path, monkeypatch, """
+            import json, sys
+            print("[bench +0.1s] backend resolved: tpu", file=sys.stderr,
+                  flush=True)
+            print("x" * 100000, file=sys.stderr, flush=True)
+            print(json.dumps({"metric": "m", "value": 2.0,
+                              "backend": "tpu"}))
+        """)
+        line, err, wedged = bench._run_child(
+            _args(), force_cpu=False, init_timeout_s=30.0,
+            total_timeout_s=30.0,
+        )
+        assert err is None and line["value"] == 2.0
+
+    def test_post_init_overrun_killed_at_budget(self, tmp_path, monkeypatch):
+        _fake_child(tmp_path, monkeypatch, """
+            import sys, time
+            print("backend resolved: tpu", file=sys.stderr, flush=True)
+            time.sleep(600)  # hangs mid-measurement
+        """)
+        line, err, wedged = bench._run_child(
+            _args(), force_cpu=False, init_timeout_s=30.0,
+            total_timeout_s=4.0,
+        )
+        assert line is None and not wedged
+        assert "measurement exceeded" in err
+
+    def test_force_cpu_child_skips_stamp_wait(self, tmp_path, monkeypatch):
+        _fake_child(tmp_path, monkeypatch, """
+            import json
+            print(json.dumps({"metric": "m", "value": 3.0,
+                              "backend": "cpu"}))
+        """)
+        line, err, _ = bench._run_child(
+            _args(), force_cpu=True, init_timeout_s=2.0,
+            total_timeout_s=30.0,
+        )
+        assert err is None and line["backend"] == "cpu"
+
+
+class TestLatencyHeadline:
+    def test_swaps_to_latency_metric(self):
+        line = {
+            "metric": "gbm500_records_per_sec_per_chip",
+            "value": 900000.0,
+            "latency_mode": {"p50_ms": 4.2, "p99_ms": 9.1},
+        }
+        out = bench._latency_headline(line, 500, "tpu")
+        assert out["metric"] == "gbm500_record_latency_p50_ms"
+        assert out["value"] == 4.2
+        assert out["throughput_rec_s"] == 900000.0
+
+    def test_missing_latency_mode_keeps_line(self):
+        line = {"metric": "m", "value": 1.0, "latency_mode": None}
+        assert bench._latency_headline(line, 500, "tpu") is line

@@ -1,0 +1,35 @@
+"""utils/demo.py: the examples' demo-safe backend bootstrap."""
+
+import sys
+
+from flink_jpmml_tpu.utils.demo import demo_backend
+
+
+class TestDemoBackend:
+    def test_platform_flag_parsed_and_stripped(self, monkeypatch):
+        monkeypatch.setattr(
+            sys, "argv", ["ex.py", "--platform", "cpu", "--trees", "7"]
+        )
+        # conftest already pins the cpu backend; the flag path must
+        # force the same and strip its own args, leaving the example's
+        assert demo_backend() == "cpu"
+        assert sys.argv == ["ex.py", "--trees", "7"]
+
+    def test_env_var_default(self, monkeypatch):
+        monkeypatch.setattr(sys, "argv", ["ex.py"])
+        monkeypatch.setenv("FJT_PLATFORM", "cpu")
+        assert demo_backend() == "cpu"
+
+    def test_resolved_backend_returned_without_flag(self, monkeypatch):
+        # no flag, no env: the watchdog path resolves the default
+        # backend (cpu under the test conftest) and disarms. Stub execv
+        # so a pathologically slow init can't replace the pytest
+        # process wholesale — firing the stub is itself a failure.
+        import os
+
+        fired = []
+        monkeypatch.setattr(os, "execv", lambda *a: fired.append(a))
+        monkeypatch.setattr(sys, "argv", ["ex.py"])
+        monkeypatch.delenv("FJT_PLATFORM", raising=False)
+        assert demo_backend(timeout_s=30.0) == "cpu"
+        assert not fired, "watchdog fired during a healthy resolve"
