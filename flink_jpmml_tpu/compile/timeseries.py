@@ -114,11 +114,15 @@ def arima_forecast_path(a: ir.ArimaIR, h_max: int = ARIMA_H_MAX) -> np.ndarray:
             ext.append(v)
         fcur = out
 
-    if a.transformation == "logarithmic":
-        fcur = np.exp(fcur)
-    elif a.transformation == "squareroot":
-        fcur = fcur * fcur
-    return fcur.astype(np.float32)
+    # exploding forecasts (an AR polynomial outside the unit circle at
+    # deep horizons) overflow to inf rather than warn: the table must be
+    # total — the oracle returns inf for the same lanes
+    with np.errstate(over="ignore"):
+        if a.transformation == "logarithmic":
+            fcur = np.exp(fcur)
+        elif a.transformation == "squareroot":
+            fcur = fcur * fcur
+        return fcur.astype(np.float32)
 
 
 def lower_time_series(model: ir.TimeSeriesIR, ctx: LowerCtx) -> Lowered:

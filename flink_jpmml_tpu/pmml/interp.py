@@ -1872,9 +1872,15 @@ def _eval_arima(a: "ir.ArimaIR", h: int) -> float:
 
     y = fore[-1]
     if a.transformation == "logarithmic":
-        return math.exp(y)
+        # an exploding AR on the log scale must stay total: the compiled
+        # path's table holds f32 inf there, so the oracle says inf too
+        # rather than raising out of the hot path (C5)
+        try:
+            return math.exp(y)
+        except OverflowError:
+            return math.inf
     if a.transformation == "squareroot":
-        return y * y
+        return y * y  # float multiply overflows to inf, matching f32
     return y
 
 

@@ -319,6 +319,18 @@ class TestArima:
         assert cm.score_records([{"h": None}])[0].is_empty
         assert evaluate(doc, {"h": None}).value is None
 
+    def test_explosive_log_forecast_is_total(self):
+        # an AR polynomial outside the unit circle on the log scale
+        # overflows exp at deep horizons: both paths must stay total and
+        # agree on +inf — the hot path never raises (C5)
+        doc = parse_pmml(_arima_xml(
+            _ns(1, 0, 0, ar=(1.5,)), HIST8, transformation="logarithmic"
+        ))
+        cm = compile_pmml(doc)
+        o = evaluate(doc, {"h": 60}).value
+        g = cm.score_records([{"h": 60}])[0].score.value
+        assert o == float("inf") and np.isinf(g) and g > 0
+
     def test_rejections(self):
         # exactLeastSquares is out of scope (documented)
         with pytest.raises(ModelLoadingException, match="predictionMethod"):
