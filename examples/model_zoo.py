@@ -281,12 +281,40 @@ TIMESERIES = """<PMML version="4.3"><DataDictionary>
     <MiningField name="h"/></MiningSchema>
   <ExponentialSmoothing>
     <Level alpha="0.3" smoothedValue="120.5"/>
-    <Trend_ExpoSmooth trend="damped_trend" gamma="0.1" smoothedValue="2.5"
+    <Trend_ExpoSmooth trend="damped_additive" gamma="0.1" smoothedValue="2.5"
         phi="0.85"/>
     <Seasonality_ExpoSmooth type="multiplicative" period="4" gamma="0.2">
       <Array n="4" type="real">1.1 0.9 1.05 0.95</Array>
     </Seasonality_ExpoSmooth>
   </ExponentialSmoothing></TimeSeriesModel></PMML>"""
+
+# seasonal ARIMA(1,1,1)(0,1,0)_4 with drift over a short quarterly series
+ARIMA = """<PMML version="4.4"><DataDictionary>
+  <DataField name="h" optype="continuous" dataType="integer"/>
+  <DataField name="demand" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TimeSeriesModel functionName="timeSeries" bestFit="ARIMA">
+  <MiningSchema><MiningField name="demand" usageType="target"/>
+    <MiningField name="h"/></MiningSchema>
+  <TimeSeries usage="original">
+    <TimeValue index="1" value="52.1"/><TimeValue index="2" value="47.3"/>
+    <TimeValue index="3" value="55.8"/><TimeValue index="4" value="60.2"/>
+    <TimeValue index="5" value="54.6"/><TimeValue index="6" value="49.9"/>
+    <TimeValue index="7" value="58.4"/><TimeValue index="8" value="63.0"/>
+    <TimeValue index="9" value="57.2"/><TimeValue index="10" value="52.4"/>
+    <TimeValue index="11" value="61.1"/><TimeValue index="12" value="65.7"/>
+  </TimeSeries>
+  <ARIMA constantTerm="0.1" predictionMethod="conditionalLeastSquares">
+    <NonseasonalComponent p="1" d="1" q="1">
+      <AR><Array type="real" n="1">0.4</Array></AR>
+      <MA>
+        <MACoefficients><Array type="real" n="1">0.3</Array>
+        </MACoefficients>
+        <Residuals><Array type="real" n="1">0.25</Array></Residuals>
+      </MA>
+    </NonseasonalComponent>
+    <SeasonalComponent P="0" D="1" Q="0" period="4"/>
+  </ARIMA></TimeSeriesModel></PMML>"""
 
 BAYESNET = """<PMML version="4.3"><DataDictionary>
   <DataField name="rain" optype="categorical" dataType="string">
@@ -398,6 +426,7 @@ def main() -> None:
         ("BaselineModel (zValue)", BASELINE_Z, 1),
         ("AssociationModel (baskets)", ASSOC, 4),
         ("TimeSeriesModel (Holt-Winters)", TIMESERIES, 1),
+        ("TimeSeriesModel (seasonal ARIMA)", ARIMA, 1),
         ("BayesianNetworkModel (sprinkler)", BAYESNET, 2),
         ("TextModel (tf-idf cosine)", TEXTMODEL, 4),
     ]

@@ -8,10 +8,12 @@ pure batched function:
 
 - ExponentialSmoothing — closed form, branch-free:
 
-      ŷ(h) = level (+ h·trend | + trend·φ(1−φ^h)/(1−φ) for damped_trend)
+      ŷ(h) = level (+ h·trend | + trend·φ(1−φ^h)/(1−φ)   additive forms)
+             (· trend^h | · trend^(φ(1−φ^h)/(1−φ))  multiplicative forms)
                    (+ seasonal[(h−1) mod period]  |  × seasonal[…])
 
-  φ^h lowers as exp(h·ln φ) (φ ∈ (0,1) guaranteed by the parser).
+  φ^h and trend^x lower as exp(x·ln b) (φ ∈ (0,1), multiplicative
+  trend > 0, both guaranteed by the parser).
 
 - ARIMA — the conditional-least-squares recursion is inherently
   sequential, but the document state is FIXED, so the whole forecast
@@ -151,9 +153,13 @@ def lower_time_series(model: ir.TimeSeriesIR, ctx: LowerCtx) -> Lowered:
     trend_type = s.trend_type
     seasonal_type = s.seasonal_type
     period = s.period
-    log_phi = math.log(s.phi) if trend_type == "damped_trend" else 0.0
-    phi_scale = (
-        s.phi / (1.0 - s.phi) if trend_type == "damped_trend" else 0.0
+    damped = trend_type.startswith("damped")
+    log_phi = math.log(s.phi) if damped else 0.0
+    phi_scale = s.phi / (1.0 - s.phi) if damped else 0.0
+    # multiplicative trends lower as exp(x·ln b) (b > 0 guaranteed by
+    # the parser), keeping the math branch-free like the damped sum
+    log_trend = (
+        math.log(s.trend) if trend_type.endswith("multiplicative") else 0.0
     )
 
     def fn(p, X, M):
@@ -161,9 +167,14 @@ def lower_time_series(model: ir.TimeSeriesIR, ctx: LowerCtx) -> Lowered:
         y = jnp.broadcast_to(p["level"], h.shape)
         if trend_type == "additive":
             y = y + h * p["trend"]
-        elif trend_type == "damped_trend":
+        elif trend_type == "damped_additive":
             phi_h = jnp.exp(h * log_phi)
             y = y + p["trend"] * phi_scale * (1.0 - phi_h)
+        elif trend_type == "multiplicative":
+            y = y * jnp.exp(h * log_trend)
+        elif trend_type == "damped_multiplicative":
+            phi_h = jnp.exp(h * log_phi)
+            y = y * jnp.exp(phi_scale * (1.0 - phi_h) * log_trend)
         if seasonal_type != "none":
             idx = jnp.mod(h.astype(jnp.int32) - 1, period)
             factor = jnp.take(p["seasonal"], idx)

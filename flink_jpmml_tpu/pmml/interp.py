@@ -1897,9 +1897,18 @@ def _eval_time_series(model: ir.TimeSeriesIR, record: Record) -> EvalResult:
     y = s.level
     if s.trend_type == "additive":
         y += h * s.trend
-    elif s.trend_type == "damped_trend":
+    elif s.trend_type == "damped_additive":
         # Σ_{i=1..h} φ^i = φ(1−φ^h)/(1−φ)
         y += s.trend * s.phi * (1.0 - s.phi ** h) / (1.0 - s.phi)
+    elif s.trend_type == "multiplicative":
+        # ** raises OverflowError where the compiled f32 path holds inf;
+        # the hot path stays total either way (C5, cf. _eval_arima)
+        try:
+            y *= s.trend ** h
+        except OverflowError:
+            y = math.copysign(math.inf, y) if y else y
+    elif s.trend_type == "damped_multiplicative":
+        y *= s.trend ** (s.phi * (1.0 - s.phi ** h) / (1.0 - s.phi))
     if s.seasonal_type != "none":
         factor = s.seasonal[(h - 1) % s.period]
         y = y + factor if s.seasonal_type == "additive" else y * factor

@@ -862,7 +862,9 @@ class ExponentialSmoothingIR:
 
     level: float
     trend: float = 0.0
-    trend_type: str = "none"  # none | additive | damped_trend
+    # none | additive | damped_additive | multiplicative |
+    # damped_multiplicative ("damped_trend" parses as damped_additive)
+    trend_type: str = "none"
     phi: float = 1.0  # damped_trend decay
     seasonal_type: str = "none"  # none | additive | multiplicative
     period: int = 0

@@ -1114,16 +1114,25 @@ def _parse_time_series(elem: ET.Element) -> ir.TimeSeriesIR:
     tr = _child(es, "Trend_ExpoSmooth")
     if tr is not None:
         trend_type = tr.get("trend", "additive")
-        if trend_type not in ("additive", "damped_trend"):
+        if trend_type == "damped_trend":  # pre-round-4 alias of the
+            trend_type = "damped_additive"  # spec's enumeration value
+        if trend_type not in (
+            "additive", "damped_additive",
+            "multiplicative", "damped_multiplicative",
+        ):
             raise ModelLoadingException(
                 f"unsupported trend {trend_type!r} (supported: additive, "
-                "damped_trend)"
+                "damped_additive, multiplicative, damped_multiplicative)"
             )
         trend = _float(tr, "smoothedValue", 0.0)
         phi = _float(tr, "phi", 1.0)
-        if trend_type == "damped_trend" and not 0.0 < phi < 1.0:
+        if trend_type.startswith("damped") and not 0.0 < phi < 1.0:
             raise ModelLoadingException(
-                f"damped_trend needs 0 < phi < 1, got {phi}"
+                f"{trend_type} needs 0 < phi < 1, got {phi}"
+            )
+        if trend_type.endswith("multiplicative") and trend <= 0.0:
+            raise ModelLoadingException(
+                f"multiplicative trend needs smoothedValue > 0, got {trend}"
             )
     seasonal_type = "none"
     period = 0

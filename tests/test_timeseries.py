@@ -25,8 +25,16 @@ TS = """<PMML version="4.3"><DataDictionary>
 
 TREND_ADD = '<Trend_ExpoSmooth trend="additive" gamma="0.1" smoothedValue="2.5"/>'
 TREND_DAMPED = (
-    '<Trend_ExpoSmooth trend="damped_trend" gamma="0.1" smoothedValue="2.5" '
-    'phi="0.8"/>'
+    '<Trend_ExpoSmooth trend="damped_additive" gamma="0.1" '
+    'smoothedValue="2.5" phi="0.8"/>'
+)
+TREND_MUL = (
+    '<Trend_ExpoSmooth trend="multiplicative" gamma="0.1" '
+    'smoothedValue="1.03"/>'
+)
+TREND_DAMPED_MUL = (
+    '<Trend_ExpoSmooth trend="damped_multiplicative" gamma="0.1" '
+    'smoothedValue="1.03" phi="0.8"/>'
 )
 SEASONAL_ADD = (
     '<Seasonality_ExpoSmooth type="additive" period="4" gamma="0.2">'
@@ -46,6 +54,10 @@ def _hand(h, trend="none", seasonal="none"):
         y += h * 2.5
     elif trend == "damped":
         y += 2.5 * sum(0.8 ** i for i in range(1, h + 1))
+    elif trend == "mul":
+        y *= 1.03 ** h
+    elif trend == "damped_mul":
+        y *= 1.03 ** sum(0.8 ** i for i in range(1, h + 1))
     if seasonal == "add":
         y += [5.0, -3.0, 1.5, -3.5][(h - 1) % 4]
     elif seasonal == "mul":
@@ -62,6 +74,9 @@ class TestExponentialSmoothing:
             (TREND_DAMPED, "", "damped", "none"),
             (TREND_ADD, SEASONAL_ADD, "additive", "add"),
             (TREND_DAMPED, SEASONAL_MUL, "damped", "mul"),
+            (TREND_MUL, "", "mul", "none"),
+            (TREND_MUL, SEASONAL_ADD, "mul", "add"),
+            (TREND_DAMPED_MUL, SEASONAL_MUL, "damped_mul", "mul"),
         ],
     )
     def test_forecast_parity(self, trend_xml, seasonal_xml, trend, seasonal):
@@ -92,10 +107,41 @@ class TestExponentialSmoothing:
         assert cm.score_records([{"h": None}])[0].is_empty
         assert evaluate(doc, {"h": None}).value is None
 
+    def test_multiplicative_trend_huge_horizon_total(self):
+        # 1.03^30000 overflows float: the oracle must agree with the
+        # compiled f32 inf instead of raising out of the hot path
+        doc = parse_pmml(TS.format(trend=TREND_MUL, seasonal=""))
+        cm = compile_pmml(doc)
+        o = evaluate(doc, {"h": 30000}).value
+        g = cm.score_records([{"h": 30000}])[0].score.value
+        assert o == float("inf") and np.isinf(g) and g > 0
+
+    def test_legacy_damped_trend_alias(self):
+        # pre-spec spelling accepted and normalized to damped_additive
+        legacy = TREND_DAMPED.replace("damped_additive", "damped_trend")
+        doc = parse_pmml(TS.format(trend=legacy, seasonal=""))
+        assert doc.model.smoothing.trend_type == "damped_additive"
+        assert evaluate(doc, {"h": 3}).value == pytest.approx(
+            _hand(3, "damped")
+        )
+
     def test_rejections(self):
         with pytest.raises(ModelLoadingException):
             parse_pmml(TS.format(trend="", seasonal="").replace(
                 'bestFit="ExponentialSmoothing"', 'bestFit="ARIMA"'
+            ))
+        # polynomial_exponential is not supported
+        with pytest.raises(ModelLoadingException, match="trend"):
+            parse_pmml(TS.format(
+                trend=TREND_ADD.replace("additive", "polynomial_exponential"),
+                seasonal="",
+            ))
+        # multiplicative trends need a positive base
+        with pytest.raises(ModelLoadingException, match="smoothedValue > 0"):
+            parse_pmml(TS.format(
+                trend=TREND_MUL.replace('smoothedValue="1.03"',
+                                        'smoothedValue="-1.0"'),
+                seasonal="",
             ))
         with pytest.raises(ModelLoadingException):
             parse_pmml(TS.format(
