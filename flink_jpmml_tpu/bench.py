@@ -30,6 +30,10 @@ also carries:
     load, reporting record-level {p50_ms, p99_ms, rec_s} (arrival →
     scores materialized on host). This is the BASELINE tracked metric's
     honest home; the throughput p50/p99 above is not a latency story.
+  "kafka_mode"     — BASELINE config 2 literally: the GBM scored over a
+    REAL Kafka wire-protocol stream (in-process broker serving magic-v2
+    batches on loopback, C++ record-batch decoder on the consume side,
+    production BlockPipeline scoring), reporting {rec_s, log_records}.
   "interp_rec_s" / "interp_ratio" — a per-record oracle-interpreter
     (pmml/interp.py) baseline on the same model and host, and the measured
     speedup of the compiled path over it: the backend-independent
@@ -97,6 +101,7 @@ def _child_cmd(args, force_cpu: bool) -> list:
         ("--f32-wire", args.f32_wire),
         ("--skip-interp", args.skip_interp),
         ("--skip-latency", args.skip_latency),
+        ("--skip-kafka", args.skip_kafka),
         ("--latency", args.latency),
         ("--block-pipeline", args.block_pipeline),
         ("--force-cpu", force_cpu),
@@ -229,8 +234,8 @@ def _orchestrate(args) -> None:
     t_start = time.monotonic()
     # post-init budget: compile (warm via FJT_XLA_CACHE after the first
     # healthy attempt) + 3 windows + device-resident + latency mode +
-    # pinned interp baseline
-    measure_budget = 150.0 + 5.0 * args.seconds + 120.0
+    # kafka mode (one-time producer encode dominates) + pinned interp
+    measure_budget = 150.0 + 5.0 * args.seconds + 210.0
     cpu_reserve = 180.0 + 4.0 * args.seconds  # always keep room for fallback
     sleeps = (45.0, 90.0, 120.0, 120.0, 120.0)
     errors = []
@@ -463,6 +468,79 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
     }
 
 
+def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
+    """BASELINE config 2, literally: the GBM scored over a REAL Kafka
+    wire-protocol stream — an in-process broker serving magic-v2 record
+    batches on loopback, the C++ record-batch decoder
+    (fjt_kafka_decode_fixed) on the consume side, the production
+    BlockPipeline scoring. The log cycles (seek-on-wrap) so the steady
+    state outlasts the appended records. ``cm`` is the already-compiled
+    chunk-batch model (no second compile on the device budget).
+
+    Only called from the measurement child (jax already imported)."""
+    import jax
+    import numpy as np
+
+    from flink_jpmml_tpu.runtime.block import BlockPipeline
+    from flink_jpmml_tpu.runtime.kafka import (
+        KafkaBlockSource, MiniKafkaBroker,
+    )
+    from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+    C = int(cm.batch_size)
+    broker = MiniKafkaBroker(topic="bench")
+    try:
+        broker.append_rows(data_f32)  # one-time encode, like a producer
+        hw = broker.high_watermark
+
+        class _CyclingKafka(KafkaBlockSource):
+            """Wraps the cursor back to 0 at the high watermark so a
+            finite log sustains a steady-state measurement."""
+
+            def poll(self):
+                if self._next >= hw:
+                    self.seek(0)
+                return super().poll()
+
+        src = _CyclingKafka(
+            broker.host, broker.port, "bench",
+            n_cols=data_f32.shape[1], max_wait_ms=20,
+        )
+        count = [0]
+
+        def sink(out, n, first_off):
+            np.asarray(
+                out.value if hasattr(out, "value")
+                else out[0] if isinstance(out, tuple) else out
+            )
+            count[0] += n
+
+        pipe = BlockPipeline(
+            src, cm, sink,
+            RuntimeConfig(batch=BatchConfig(size=C, deadline_us=5000)),
+            use_quantized=use_quantized,
+        )
+        q = cm.quantized_scorer() if use_quantized else None
+        if q is not None:
+            jax.block_until_ready(
+                q.predict_wire(q.wire.encode(data_f32[:C]))
+            )
+        else:
+            cm.warmup()
+        t0 = time.perf_counter()
+        pipe.run_for(seconds=min(5.0, max(2.0, args.seconds)))
+        dt = time.perf_counter() - t0
+        src.close()
+        return {
+            "rec_s": round(count[0] / dt, 1),
+            "source": "kafka-wire",
+            "log_records": hw,
+            "backend": pipe.backend,
+        }
+    finally:
+        broker.close()
+
+
 def _latency_headline(line: dict, trees: int, backend: str) -> dict:
     """--latency: re-headline the artifact on the latency operating
     point (p50 record latency, ms); the throughput number rides along."""
@@ -504,6 +582,8 @@ def main() -> None:
                     help="skip the per-record interpreter baseline")
     ap.add_argument("--skip-latency", action="store_true",
                     help="skip the latency-mode operating point")
+    ap.add_argument("--skip-kafka", action="store_true",
+                    help="skip the Kafka wire-protocol operating point")
     ap.add_argument("--latency", action="store_true",
                     help="make the latency operating point the headline "
                          "metric (p50 record latency in ms)")
@@ -698,6 +778,12 @@ def main() -> None:
                 doc, pool_f32[0], args, use_quantized=not args.f32_wire
             )
             stage("latency mode done")
+        if not args.skip_kafka:
+            stage("kafka mode: broker + wire consume + score")
+            line["kafka_mode"] = _measure_kafka_mode(
+                cm, pool_f32[0], args, use_quantized=not args.f32_wire
+            )
+            stage("kafka mode done")
         if args.latency:
             line = _latency_headline(line, args.trees, line["backend"])
         print(json.dumps(line))
@@ -853,6 +939,12 @@ def main() -> None:
             doc, pool_f32[0], args, use_quantized=not args.f32_wire
         )
         stage("latency mode done")
+    if not args.skip_kafka:
+        stage("kafka mode: broker + wire consume + score")
+        line["kafka_mode"] = _measure_kafka_mode(
+            cm, pool_f32[0], args, use_quantized=not args.f32_wire
+        )
+        stage("kafka mode done")
     if args.latency:
         line = _latency_headline(line, args.trees, backend)
     print(json.dumps(line))

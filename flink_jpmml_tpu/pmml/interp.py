@@ -1908,7 +1908,10 @@ def _eval_time_series(model: ir.TimeSeriesIR, record: Record) -> EvalResult:
         except OverflowError:
             y = math.copysign(math.inf, y) if y else y
     elif s.trend_type == "damped_multiplicative":
-        y *= s.trend ** (s.phi * (1.0 - s.phi ** h) / (1.0 - s.phi))
+        try:
+            y *= s.trend ** (s.phi * (1.0 - s.phi ** h) / (1.0 - s.phi))
+        except OverflowError:
+            y = math.copysign(math.inf, y) if y else y
     if s.seasonal_type != "none":
         factor = s.seasonal[(h - 1) % s.period]
         y = y + factor if s.seasonal_type == "additive" else y * factor

@@ -158,6 +158,15 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(code_t),           # out
                 ctypes.c_uint32,                  # n_threads
             ]
+        lib.fjt_kafka_decode_fixed.restype = ctypes.c_int64
+        lib.fjt_kafka_decode_fixed.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),   # record-set bytes
+            ctypes.c_int64,                   # len
+            ctypes.c_int64,                   # value_len
+            ctypes.POINTER(ctypes.c_uint8),   # out values [cap, value_len]
+            ctypes.c_int64,                   # out capacity (records)
+            ctypes.POINTER(ctypes.c_int64),   # out offsets [cap]
+        ]
         _lib = lib
         return _lib
 
@@ -240,6 +249,47 @@ class NativeRing:
         if handle:
             self._lib.fjt_ring_destroy(handle)
             self._handle = None
+
+
+def kafka_decode_fixed(
+    buf: bytes, value_len: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Decode magic-v2 record batches whose values are all ``value_len``
+    bytes (the tabular-stream contract) at C speed.
+
+    → ``(offsets int64 [n], values uint8 [n, value_len])``, or ``None``
+    when the native library is unavailable OR the record set is not
+    fixed-length (caller falls back to the Python decoder). Raises
+    ``ValueError`` on CRC mismatch / bad magic / malformed framing with
+    the same messages as ``decode_record_batches``.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    # a record costs at least 6 framing bytes + the value, so this bounds
+    # the record count from the buffer size alone
+    cap = len(buf) // (value_len + 6) + 1
+    out = np.empty((cap, value_len), np.uint8)
+    offs = np.empty((cap,), np.int64)
+    src = np.frombuffer(buf, np.uint8)  # zero-copy, read-only view
+    rc = lib.fjt_kafka_decode_fixed(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(buf),
+        value_len,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc == -3:
+        return None  # not fixed-length: the general Python path decides
+    if rc == -1:
+        raise ValueError("record batch CRC32C mismatch")
+    if rc == -2:
+        raise ValueError("unsupported record-batch magic")
+    if rc < 0:
+        raise ValueError(f"malformed record batch (native rc={rc})")
+    n = int(rc)
+    return offs[:n].copy(), out[:n].copy()
 
 
 def bucketize(

@@ -22,6 +22,7 @@ from flink_jpmml_tpu.runtime.kafka import (
     MiniKafkaBroker,
     crc32c,
     decode_record_batches,
+    decode_record_batches_rows,
     encode_record_batch,
 )
 from flink_jpmml_tpu.runtime.sinks import CollectSink
@@ -67,6 +68,47 @@ class TestProtocolBytes:
         raw[-1] ^= 0xFF
         with pytest.raises(ValueError, match="CRC32C"):
             decode_record_batches(bytes(raw))
+
+
+class TestNativeRowDecode:
+    """decode_record_batches_rows: the C++ fixed-length fast path must be
+    byte-identical with the Python walk (and fall back when the tabular
+    contract doesn't hold)."""
+
+    def test_rows_match_python_decode(self):
+        rng = np.random.default_rng(21)
+        rows = rng.normal(size=(700, 6)).astype(np.float32)
+        raw = encode_record_batch(40, [rows[i].tobytes() for i in range(700)])
+        raw += encode_record_batch(
+            740, [rows[i].tobytes() for i in range(100)]
+        )
+        offs, got = decode_record_batches_rows(raw, 6)
+        ref = decode_record_batches(raw)
+        assert offs.tolist() == [o for o, _ in ref]
+        np.testing.assert_array_equal(got[:700], rows)
+        np.testing.assert_array_equal(got[700:], rows[:100])
+
+    def test_native_falls_back_on_variable_lengths(self):
+        from flink_jpmml_tpu.runtime import native
+
+        raw = encode_record_batch(0, [b"12345678", b"1234"])
+        if native.available():
+            assert native.kafka_decode_fixed(raw, 8) is None
+        # the general path still serves them (here as a length error at
+        # row construction, same as the pre-native behavior)
+        with pytest.raises(ValueError):
+            decode_record_batches_rows(raw, 2)
+
+    def test_partial_tail_and_crc_parity(self):
+        rows = np.arange(24, dtype=np.float32).reshape(6, 4)
+        b1 = encode_record_batch(0, [rows[i].tobytes() for i in range(6)])
+        offs, got = decode_record_batches_rows(b1 + b1[: len(b1) // 2], 4)
+        assert offs.tolist() == list(range(6))
+        np.testing.assert_array_equal(got, rows)
+        bad = bytearray(b1)
+        bad[-1] ^= 0xFF
+        with pytest.raises(ValueError, match="CRC32C"):
+            decode_record_batches_rows(bytes(bad), 4)
 
 
 class TestClientBroker:
