@@ -703,23 +703,67 @@ class KafkaRecordSource(_KafkaSourceBase, Source):
 
 class KafkaBlockSource(_KafkaSourceBase, BlockSource):
     """Block source: each Kafka message value is one packed f32-LE feature
-    row; a fetch's worth of consecutive rows forms one [n, F] block."""
+    row; a fetch's worth of consecutive rows forms one [n, F] block.
+    Single- and multi-partition polls both ride the C++ record-batch
+    decoder; the multi-partition interleave is array-strided, not
+    per-record."""
 
     def __init__(self, *args, n_cols: int, **kw):
         super().__init__(*args, **kw)
         self._cols = n_cols
+        # per-slot decoded row buffers: slot → [rows...] contiguous from
+        # that slot's next needed partition offset (multi-partition only)
+        self._rbufs: Dict[int, np.ndarray] = {}
+
+    def _poll_multi(self) -> Optional[Tuple[int, np.ndarray]]:
+        """Strict round-robin interleave, vectorized: global index
+        g ↦ (slot g % P, partition offset g // P). Each slot keeps a
+        contiguous decoded-row buffer; emission takes min-available full
+        strides and interleaves with P slice-assigns."""
+        P = len(self._parts)
+        g0 = self._g
+        limits = []
+        for s, part in enumerate(self._parts):
+            off_s = (s - g0) % P  # first emission index landing on slot s
+            po0 = (g0 + off_s) // P  # that record's partition offset
+            buf = self._rbufs.get(s)
+            if buf is None or buf.shape[0] == 0:
+                raw = self._fetch_raw_part(part, po0)
+                if raw:
+                    offs, rows = decode_record_batches_rows(raw, self._cols)
+                    k = int(np.searchsorted(offs, po0))
+                    offs, rows = offs[k:], rows[k:]
+                    if offs.shape[0]:
+                        if offs[0] != po0 or (np.diff(offs) != 1).any():
+                            raise KafkaProtocolError(
+                                f"partition {part} offset gap at {po0} "
+                                "breaks the round-robin interleave contract"
+                            )
+                        buf = rows
+                        self._rbufs[s] = buf
+            avail = 0 if buf is None else buf.shape[0]
+            limits.append(off_s + avail * P)
+        m = min(limits)
+        if m <= 0:
+            return None
+        out = np.empty((m, self._cols), np.float32)
+        for s in range(P):
+            off_s = (s - g0) % P
+            c = len(range(off_s, m, P))
+            if c:
+                buf = self._rbufs[s]
+                out[off_s:m:P] = buf[:c]
+                self._rbufs[s] = buf[c:]
+        self._g = g0 + m
+        return g0, out
+
+    def seek(self, offset: int) -> None:
+        self._rbufs.clear()
+        super().seek(offset)
 
     def poll(self) -> Optional[Tuple[int, np.ndarray]]:
         if self._multi:
-            # the interleave yields consecutive global indices by
-            # construction, so a pump's worth IS one contiguous block
-            recs = self._pump(1024)
-            if not recs:
-                return None
-            rows = np.empty((len(recs), self._cols), np.float32)
-            for i, (_, value) in enumerate(recs):
-                rows[i] = np.frombuffer(value, np.float32, count=self._cols)
-            return recs[0][0], rows
+            return self._poll_multi()
         raw = self._fetch_raw_part(self._partition, self._next)
         if not raw:
             return None
