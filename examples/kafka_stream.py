@@ -10,6 +10,7 @@ checkpointed Kafka offset — every record scored exactly once.
 Run:  python examples/kafka_stream.py [--platform cpu]   (or on the TPU)
 """
 
+import argparse
 import pathlib
 import sys
 import tempfile
@@ -34,6 +35,12 @@ from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
 
 def main() -> None:
     print(f"backend: {demo_backend()}")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--partitions", type=int, default=1,
+                    help="topic partitions (round-robin interleaved "
+                         "consumption; one checkpointed offset resumes "
+                         "every partition cursor)")
+    args = ap.parse_args()
     workdir = tempfile.mkdtemp(prefix="fjt-kafka-")
     pmml = gen_gbm(workdir, n_trees=50, depth=5, n_features=8)
     cm = compile_pmml(parse_pmml_file(pmml), batch_size=256)
@@ -42,10 +49,15 @@ def main() -> None:
     N = 20_000
     data = rng.normal(0.0, 1.5, size=(N, 8)).astype(np.float32)
 
-    broker = MiniKafkaBroker(topic="features")
-    broker.append_rows(data)
+    broker = MiniKafkaBroker(topic="features",
+                             n_partitions=args.partitions)
+    if args.partitions > 1:
+        broker.append_rows_round_robin(data)
+    else:
+        broker.append_rows(data)
     print(f"broker on {broker.host}:{broker.port}, "
-          f"{broker.high_watermark} records in topic 'features'")
+          f"{broker.high_watermark} records in topic 'features' "
+          f"({args.partitions} partition(s))")
 
     cfg = RuntimeConfig(
         batch=BatchConfig(size=256, deadline_us=2000),
@@ -59,7 +71,8 @@ def main() -> None:
 
     def make_pipe():
         src = KafkaBlockSource(
-            broker.host, broker.port, "features", n_cols=8, max_wait_ms=20
+            broker.host, broker.port, "features", n_cols=8, max_wait_ms=20,
+            partitions=list(range(args.partitions)),
         )
         return src, BlockPipeline(
             src, cm, sink, cfg, checkpoint=CheckpointManager(ckdir)

@@ -445,6 +445,80 @@ inline bool read_zigzag(const uint8_t* b, int64_t len, int64_t& p,
 
 extern "C" {
 
+// Inverse of the decoder for the producer side: encode n fixed-length
+// values as ONE magic-v2 batch (null keys, no headers, timestamp 0) —
+// byte-identical to runtime/kafka.py's encode_record_batch. → bytes
+// written, or -1 when out_cap is too small.
+int64_t fjt_kafka_encode_fixed(const uint8_t* values, int64_t n,
+                               int64_t value_len, int64_t base_offset,
+                               uint8_t* out, int64_t out_cap) {
+    if (n <= 0 || value_len < 0) return -1;
+    auto zig = [](int64_t x) -> uint64_t {
+        return (uint64_t(x) << 1) ^ uint64_t(x >> 63);
+    };
+    auto vsize = [](uint64_t u) -> int64_t {
+        int64_t s = 1;
+        while (u >= 0x80) {
+            u >>= 7;
+            ++s;
+        }
+        return s;
+    };
+    int64_t p = 61;  // batch header (21) + post header (40)
+    auto put_varint = [&](uint64_t u) {
+        while (u >= 0x80) {
+            out[p++] = uint8_t(u) | 0x80;
+            u >>= 7;
+        }
+        out[p++] = uint8_t(u);
+    };
+    // bound: per record <= rec_len varint(<=10) + body; check coarsely
+    for (int64_t i = 0; i < n; ++i) {
+        // body: attr(1) vz(0)(1) vz(i) vz(-1)(1) vz(len) value vz(0)(1)
+        const int64_t body_len =
+            4 + vsize(zig(i)) + vsize(zig(value_len)) + value_len;
+        if (p + vsize(zig(body_len)) + body_len > out_cap) return -1;
+        put_varint(zig(body_len));
+        out[p++] = 0;  // record attributes
+        put_varint(0);  // timestamp delta
+        put_varint(zig(i));  // offset delta
+        put_varint(zig(-1));  // null key
+        put_varint(zig(value_len));
+        std::memcpy(out + p, values + i * value_len, value_len);
+        p += value_len;
+        put_varint(0);  // headers count
+    }
+    const int64_t end = p;
+    auto be32w = [&](int64_t at, uint32_t v) {
+        out[at] = uint8_t(v >> 24);
+        out[at + 1] = uint8_t(v >> 16);
+        out[at + 2] = uint8_t(v >> 8);
+        out[at + 3] = uint8_t(v);
+    };
+    auto be64w = [&](int64_t at, uint64_t v) {
+        for (int i = 0; i < 8; ++i)
+            out[at + i] = uint8_t(v >> (8 * (7 - i)));
+    };
+    // post header (CRC-covered region starts at 21)
+    out[21] = 0;
+    out[22] = 0;  // attributes
+    be32w(23, uint32_t(n - 1));  // last offset delta
+    be64w(27, 0);  // first timestamp
+    be64w(35, 0);  // max timestamp
+    be64w(43, ~uint64_t(0));  // producer id -1
+    out[51] = 0xFF;
+    out[52] = 0xFF;  // producer epoch -1
+    be32w(53, ~uint32_t(0));  // base sequence -1
+    be32w(57, uint32_t(n));
+    // batch header
+    be64w(0, uint64_t(base_offset));
+    be32w(8, uint32_t(end - 12));  // batch length (after this field)
+    be32w(12, ~uint32_t(0));  // partition leader epoch -1
+    out[16] = 2;  // magic
+    be32w(17, crc32c_buf(out + 21, end - 21));
+    return end;
+}
+
 // → records decoded (>= 0), or: -1 CRC mismatch, -2 unsupported magic,
 // -3 a value's length != value_len (caller falls back to the general
 // Python decoder), -4 malformed framing, -5 out capacity exhausted.

@@ -845,11 +845,35 @@ class MiniKafkaBroker:
             return first
 
     def append_rows(self, rows: np.ndarray, partition: int = 0) -> int:
+        """Fixed-width producer fast path: segments encode through the
+        C++ batch encoder when available (byte-identical output), so a
+        million-row log appends in tenths of a second instead of tens."""
+        from flink_jpmml_tpu.runtime import native
+
         rows = np.ascontiguousarray(rows, np.float32)
-        return self.append(
-            *(rows[i].tobytes() for i in range(rows.shape[0])),
-            partition=partition,
-        )
+        if rows.shape[0] == 0:  # round-robin slices can be empty
+            with self._mu:
+                return len(self._logs[partition])
+        raw = rows.view(np.uint8).reshape(rows.shape[0], -1)
+        with self._mu:
+            log = self._logs[partition]
+            first = len(log)
+            segs = self._segs[partition]
+            for i in range(0, rows.shape[0], self._SEG_RECORDS):
+                chunk = raw[i : i + self._SEG_RECORDS]
+                base = first + i
+                blob = native.kafka_encode_fixed(chunk, base)
+                if blob is None:
+                    blob = encode_record_batch(
+                        base,
+                        [chunk[j].tobytes() for j in range(chunk.shape[0])],
+                    )
+                segs.append((base, chunk.shape[0], blob))
+            log.extend(
+                raw[i].tobytes() for i in range(raw.shape[0])
+            )
+            self._mu.notify_all()
+            return first
 
     def append_rows_round_robin(self, rows: np.ndarray) -> None:
         """Row i → partition i % n_partitions (the producer layout the

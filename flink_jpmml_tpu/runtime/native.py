@@ -158,6 +158,15 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(code_t),           # out
                 ctypes.c_uint32,                  # n_threads
             ]
+        lib.fjt_kafka_encode_fixed.restype = ctypes.c_int64
+        lib.fjt_kafka_encode_fixed.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),   # values [n, value_len]
+            ctypes.c_int64,                   # n
+            ctypes.c_int64,                   # value_len
+            ctypes.c_int64,                   # base_offset
+            ctypes.POINTER(ctypes.c_uint8),   # out buffer
+            ctypes.c_int64,                   # out capacity (bytes)
+        ]
         lib.fjt_kafka_decode_fixed.restype = ctypes.c_int64
         lib.fjt_kafka_decode_fixed.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),   # record-set bytes
@@ -249,6 +258,34 @@ class NativeRing:
         if handle:
             self._lib.fjt_ring_destroy(handle)
             self._handle = None
+
+
+def kafka_encode_fixed(
+    values: np.ndarray, base_offset: int
+) -> Optional[bytes]:
+    """Encode a contiguous ``[n, value_len]`` uint8 array as one
+    magic-v2 record batch — byte-identical to the Python
+    ``encode_record_batch`` (null keys, no headers, timestamp 0).
+    → batch bytes, or ``None`` when the native library is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, np.uint8)
+    n, value_len = values.shape
+    cap = 61 + n * (value_len + 26)  # generous per-record framing bound
+    out = np.empty((cap,), np.uint8)
+    rc = lib.fjt_kafka_encode_fixed(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n,
+        value_len,
+        base_offset,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+    )
+    if rc < 0:
+        return None
+    return out[: int(rc)].tobytes()
 
 
 def kafka_decode_fixed(

@@ -99,6 +99,21 @@ class TestNativeRowDecode:
         with pytest.raises(ValueError):
             decode_record_batches_rows(raw, 2)
 
+    def test_native_encode_byte_exact(self):
+        from flink_jpmml_tpu.runtime import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        rows = np.random.default_rng(31).normal(size=(300, 5)).astype(
+            np.float32
+        )
+        raw8 = rows.view(np.uint8).reshape(300, -1)
+        got = native.kafka_encode_fixed(raw8, 777)
+        ref = encode_record_batch(
+            777, [rows[i].tobytes() for i in range(300)]
+        )
+        assert got == ref  # the C++ producer path IS the wire format
+
     def test_partial_tail_and_crc_parity(self):
         rows = np.arange(24, dtype=np.float32).reshape(6, 4)
         b1 = encode_record_batch(0, [rows[i].tobytes() for i in range(6)])
