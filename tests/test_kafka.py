@@ -366,6 +366,44 @@ class TestKillResume:
         finally:
             broker.close()
 
+    def test_concurrent_produce_consume_ordered(self):
+        # live producer racing the consumer: the segment cache grows
+        # under the lock while fetches serve from it — order and
+        # completeness must hold (the round-4 broker stores encoded
+        # segments, so this is the write/read race that rework created)
+        import threading
+
+        rows = np.arange(2000 * 3, dtype=np.float32).reshape(2000, 3)
+        broker = MiniKafkaBroker(topic="live")
+        try:
+            def produce():
+                for i in range(0, 2000, 100):
+                    broker.append_rows(rows[i : i + 100])
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=produce)
+            t.start()
+            src = KafkaBlockSource(
+                broker.host, broker.port, "live", n_cols=3, max_wait_ms=20
+            )
+            got = []
+            pos = 0
+            deadline = time.monotonic() + 30.0
+            while pos < 2000 and time.monotonic() < deadline:
+                polled = src.poll()
+                if polled is None:
+                    continue
+                off, blk = polled
+                assert off == pos
+                got.append(blk)
+                pos += blk.shape[0]
+            t.join()
+            assert pos == 2000
+            np.testing.assert_array_equal(np.concatenate(got), rows)
+            src.close()
+        finally:
+            broker.close()
+
     def test_source_survives_broker_restart(self):
         data = np.arange(400 * 3, dtype=np.float32).reshape(400, 3)
         broker = MiniKafkaBroker(topic="r")
