@@ -9,7 +9,7 @@ are encoded to uint8 threshold ranks by the multithreaded bucketizer, and
 the whole micro-batch is scored by the Pallas VMEM-resident kernel (TPU)
 or the int8 einsum path. No Python object per record exists anywhere.
 
-Run:  python examples/gbm_throughput.py [--platform cpu]  [--trees 500 --seconds 3]
+Run:  python examples/gbm_throughput.py [--platform cpu] [--kafka]  [--trees 500 --seconds 3]
 bench.py is the driver-measured version of this same pipeline shape.
 """
 
@@ -38,6 +38,10 @@ def main() -> None:
     ap.add_argument("--features", type=int, default=32)
     ap.add_argument("--batch", type=int, default=16384)
     ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--kafka", action="store_true",
+                    help="stream through the real Kafka wire protocol "
+                         "(in-process broker + C++ record-batch decode) "
+                         "instead of the in-memory source")
     args = ap.parse_args()
 
     workdir = tempfile.mkdtemp(prefix="fjt-gbm-")
@@ -64,8 +68,32 @@ def main() -> None:
                    out[0] if isinstance(out, tuple) else out)
         count[0] += n
 
+    broker = None
+    if args.kafka:
+        from flink_jpmml_tpu.runtime.kafka import (
+            KafkaBlockSource, MiniKafkaBroker,
+        )
+
+        broker = MiniKafkaBroker(topic="gbm")
+        broker.append_rows(data)
+        hw = broker.high_watermark
+
+        class _Cycling(KafkaBlockSource):
+            def poll(self):
+                if self._next >= hw:
+                    self.seek(0)
+                return super().poll()
+
+        source = _Cycling(
+            broker.host, broker.port, "gbm",
+            n_cols=args.features, max_wait_ms=20,
+        )
+        print(f"kafka broker on {broker.host}:{broker.port}, "
+              f"{hw} records cycling")
+    else:
+        source = CyclingBlockSource(data, block_size=args.batch)
     pipe = BlockPipeline(
-        CyclingBlockSource(data, block_size=args.batch),
+        source,
         cm,
         sink,
         RuntimeConfig(batch=BatchConfig(size=args.batch, deadline_us=5000)),
@@ -78,13 +106,18 @@ def main() -> None:
         jax.block_until_ready(q.predict_wire(q.wire.encode(data[: args.batch])))
     else:
         cm.warmup()
-    t0 = time.perf_counter()
-    pipe.run_for(seconds=args.seconds)
-    dt = time.perf_counter() - t0
-    snap = pipe.metrics.snapshot()
-    print(f"scored {count[0]:,} records in {dt:.2f}s "
-          f"({count[0] / dt:,.0f} rec/s through the full block pipeline)")
-    print(f"metrics: {snap}")
+    try:
+        t0 = time.perf_counter()
+        pipe.run_for(seconds=args.seconds)
+        dt = time.perf_counter() - t0
+        snap = pipe.metrics.snapshot()
+        print(f"scored {count[0]:,} records in {dt:.2f}s "
+              f"({count[0] / dt:,.0f} rec/s through the full block pipeline)")
+        print(f"metrics: {snap}")
+    finally:
+        if broker is not None:
+            source.close()
+            broker.close()
 
 
 if __name__ == "__main__":

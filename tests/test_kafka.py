@@ -344,6 +344,47 @@ class TestKillResume:
         finally:
             broker.close()
 
+    def test_multi_partition_staggered_producers(self):
+        # partitions fill at different rates: the strict interleave must
+        # stall at the slowest partition's cursor (never reorder or skip)
+        # and drain the backlog once it catches up
+        rows = np.arange(400 * 2, dtype=np.float32).reshape(400, 2)
+        broker = MiniKafkaBroker(topic="st", n_partitions=2)
+        try:
+            # partition 0 far ahead of partition 1
+            broker.append_rows(rows[0::2], partition=0)
+            broker.append_rows(rows[1::2][:3], partition=1)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "st", partitions=[0, 1],
+                n_cols=2, max_wait_ms=20,
+            )
+            got = []
+            pos = 0
+            deadline = time.monotonic() + 10.0
+            while pos < 7 and time.monotonic() < deadline:
+                p = src.poll()
+                if p:
+                    got.append(p[1])
+                    pos += p[1].shape[0]
+            # 3 full strides + the head record of the incomplete one
+            # (global 6 lands on partition 0); global 7 needs partition
+            # 1's 4th record, which doesn't exist yet
+            assert pos == 7
+            assert src.poll() is None  # stalled, not reordered
+            # catch-up: the rest of partition 1 arrives
+            broker.append_rows(rows[1::2][3:], partition=1)
+            deadline = time.monotonic() + 15.0
+            while pos < 400 and time.monotonic() < deadline:
+                p = src.poll()
+                if p:
+                    got.append(p[1])
+                    pos += p[1].shape[0]
+            assert pos == 400
+            np.testing.assert_array_equal(np.concatenate(got), rows)
+            src.close()
+        finally:
+            broker.close()
+
     def test_multi_partition_record_source(self):
         broker = MiniKafkaBroker(topic="mpr", n_partitions=3)
         try:
