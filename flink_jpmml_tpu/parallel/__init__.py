@@ -10,3 +10,7 @@ from flink_jpmml_tpu.parallel.sharding import (  # noqa: F401
 )
 from flink_jpmml_tpu.parallel.partitioner import HashPartitioner, stable_hash  # noqa: F401
 from flink_jpmml_tpu.parallel.distributed import global_batch, init_distributed  # noqa: F401
+from flink_jpmml_tpu.parallel.health import (  # noqa: F401
+    HealthCoordinator,
+    HealthReporter,
+)

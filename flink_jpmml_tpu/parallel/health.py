@@ -1,0 +1,274 @@
+"""Worker health monitoring: heartbeats + failure detection (SURVEY.md
+§6 row "Failure detection / elastic recovery").
+
+The reference inherits Flink's runtime heartbeats and restart
+strategies; the library's own contribution was idempotent model reload
+plus checkpointed state, so recovery = restart from checkpoint. The
+equivalent here:
+
+- :class:`HealthCoordinator` — a tiny framed-TCP listener (one thread +
+  one thread per connection) tracking each worker's last heartbeat.
+  A worker with no beat within ``timeout_s`` is declared DEAD and the
+  ``on_dead`` callback fires; a worker that resumes beating is declared
+  recovered via ``on_recover`` — the elastic re-join path. ALL state
+  transitions (and both callbacks) happen on the single monitor thread,
+  in order, so callbacks never race each other and a crash-prone
+  callback cannot take the monitor down (exceptions are swallowed).
+- :class:`HealthReporter` — the worker side: beats every
+  ``interval_s`` over a persistent connection, reconnecting with
+  backoff through coordinator restarts.
+
+Recovery itself stays the C7 model: the operator (or a supervisor
+script) restarts the dead worker, which resumes from the checkpointed
+source offsets and serving registry — nothing here tries to migrate
+state over the wire, matching the reference's restart-from-checkpoint
+semantics rather than inventing new ones.
+
+Frame format: u32 big-endian length + UTF-8 JSON ``{"id": worker_id,
+"seq": n}`` — same framing discipline as runtime/net.py, small enough
+to need none of its machinery.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from flink_jpmml_tpu.utils.netio import recv_exact
+
+_U32 = struct.Struct(">I")
+_MAX_FRAME = 4096  # heartbeats are tiny; anything bigger is garbage
+
+
+class HealthCoordinator:
+    """Heartbeat listener + liveness registry.
+
+    ``on_dead(worker_id)`` / ``on_recover(worker_id)`` both fire on the
+    monitor thread, once per state transition, in transition order;
+    exceptions they raise are swallowed (a broken supervisor hook must
+    not disable failure detection). ``alive()`` / ``dead()`` snapshot
+    the current view. ``remove(worker_id)`` deregisters a
+    decommissioned worker; ``expire_after_s`` (optional) auto-drops
+    workers that have been dead that long, so elastic fleets with
+    unstable ids don't grow the registry without bound.
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        timeout_s: float = 2.0,
+        on_dead: Optional[Callable[[str], None]] = None,
+        on_recover: Optional[Callable[[str], None]] = None,
+        expire_after_s: Optional[float] = None,
+    ):
+        self._timeout = timeout_s
+        self._expire = expire_after_s
+        self._on_dead = on_dead
+        self._on_recover = on_recover
+        self._mu = threading.Lock()
+        self._last_seen: Dict[str, float] = {}
+        # known workers → declared dead? (transitions only on the
+        # monitor thread; _beat just stamps _last_seen)
+        self._declared_dead: Dict[str, bool] = {}
+        self._closing = False
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._conns: List[socket.socket] = []
+        self._threads = [
+            threading.Thread(target=self._accept_loop, daemon=True),
+            threading.Thread(target=self._monitor_loop, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- views / admin -----------------------------------------------------
+
+    def alive(self) -> List[str]:
+        with self._mu:
+            return sorted(
+                w for w, d in self._declared_dead.items() if not d
+            )
+
+    def dead(self) -> List[str]:
+        with self._mu:
+            return sorted(w for w, d in self._declared_dead.items() if d)
+
+    def last_seen(self, worker_id: str) -> Optional[float]:
+        with self._mu:
+            return self._last_seen.get(worker_id)
+
+    def remove(self, worker_id: str) -> None:
+        """Deregister a decommissioned worker (no callback)."""
+        with self._mu:
+            self._last_seen.pop(worker_id, None)
+            self._declared_dead.pop(worker_id, None)
+
+    # -- internals ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            with self._mu:
+                self._conns.append(conn)
+            if self._closing:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            t = threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._closing:
+                hdr = recv_exact(conn, 4)
+                if hdr is None:
+                    return
+                (n,) = _U32.unpack(hdr)
+                if n > _MAX_FRAME:
+                    return
+                payload = recv_exact(conn, n)
+                if payload is None:
+                    return
+                try:
+                    beat = json.loads(payload)
+                    wid = str(beat["id"])
+                except (ValueError, KeyError, TypeError):
+                    continue  # one garbage frame must not kill the feed
+                with self._mu:
+                    self._last_seen[wid] = time.monotonic()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._mu:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+
+    def _fire(self, cb: Optional[Callable[[str], None]], wid: str) -> None:
+        if cb is None:
+            return
+        try:
+            cb(wid)
+        except Exception:
+            pass  # a broken hook must not kill the monitor thread
+
+    def _monitor_loop(self) -> None:
+        while not self._closing:
+            time.sleep(min(self._timeout / 4.0, 0.25))
+            now = time.monotonic()
+            newly_dead: List[str] = []
+            recovered: List[str] = []
+            with self._mu:
+                for wid, t in list(self._last_seen.items()):
+                    stale = now - t > self._timeout
+                    was_dead = self._declared_dead.get(wid)
+                    if was_dead is None:  # first sighting: register
+                        self._declared_dead[wid] = stale
+                        if stale:
+                            newly_dead.append(wid)
+                    elif stale and not was_dead:
+                        self._declared_dead[wid] = True
+                        newly_dead.append(wid)
+                    elif not stale and was_dead:
+                        self._declared_dead[wid] = False
+                        recovered.append(wid)
+                    if (
+                        self._expire is not None
+                        and now - t > self._timeout + self._expire
+                    ):
+                        self._last_seen.pop(wid, None)
+                        self._declared_dead.pop(wid, None)
+            # single thread, strict order: a recovery observed in the
+            # same sweep as a death cannot be delivered out of order
+            for wid in newly_dead:
+                self._fire(self._on_dead, wid)
+            for wid in recovered:
+                self._fire(self._on_recover, wid)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._mu:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class HealthReporter:
+    """Worker-side heartbeat: beats every ``interval_s``, reconnecting
+    with backoff through coordinator outages/restarts."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        worker_id: str,
+        interval_s: float = 0.5,
+        reconnect_backoff_s: float = 0.2,
+    ):
+        self._addr = (host, port)
+        self._id = worker_id
+        self._interval = interval_s
+        self._backoff = reconnect_backoff_s
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        conn: Optional[socket.socket] = None
+        while not self._stop.is_set():
+            if conn is None:
+                try:
+                    conn = socket.create_connection(self._addr, timeout=1.0)
+                    conn.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                except OSError:
+                    conn = None
+                    self._stop.wait(self._backoff)
+                    continue
+            payload = json.dumps(
+                {"id": self._id, "seq": self._seq}
+            ).encode()
+            self._seq += 1
+            try:
+                conn.sendall(_U32.pack(len(payload)) + payload)
+            except OSError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = None
+                continue
+            self._stop.wait(self._interval)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

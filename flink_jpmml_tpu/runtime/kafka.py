@@ -975,16 +975,9 @@ class MiniKafkaBroker:
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
-        chunks = bytearray()
-        while len(chunks) < n:
-            try:
-                chunk = conn.recv(n - len(chunks))
-            except OSError:
-                return None
-            if not chunk:
-                return None
-            chunks += chunk
-        return bytes(chunks)
+        from flink_jpmml_tpu.utils.netio import recv_exact
+
+        return recv_exact(conn, n)
 
     def _dispatch(self, api_key: int, v: int, r: _Reader) -> Optional[bytes]:
         if api_key == API_VERSIONS:

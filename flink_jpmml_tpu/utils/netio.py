@@ -1,0 +1,27 @@
+"""Shared socket helpers for the framed-TCP servers/monitors.
+
+The canonical EOF/error-tolerant exact read: returns ``None`` on a
+closed peer OR a socket error, so accept-side loops treat both as "this
+connection is done" without a try/except at every call site. (The
+*client*-side readers in runtime/net.py and runtime/kafka.py keep their
+raising variants on purpose — their reconnect logic is driven by the
+exception path.)
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+
+def recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = conn.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)

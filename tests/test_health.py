@@ -1,0 +1,131 @@
+"""Failure detection (parallel/health.py): heartbeat liveness, death
+declaration, elastic recovery, coordinator-restart resilience."""
+
+import time
+
+from flink_jpmml_tpu.parallel.health import HealthCoordinator, HealthReporter
+
+
+def _wait(cond, timeout=10.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(msg)
+
+
+class TestHealth:
+    def test_alive_dead_recover_cycle(self):
+        deaths, recoveries = [], []
+        coord = HealthCoordinator(
+            timeout_s=0.6,
+            on_dead=deaths.append,
+            on_recover=recoveries.append,
+        )
+        try:
+            r1 = HealthReporter(coord.host, coord.port, "w1",
+                                interval_s=0.1)
+            r2 = HealthReporter(coord.host, coord.port, "w2",
+                                interval_s=0.1)
+            _wait(lambda: set(coord.alive()) == {"w1", "w2"},
+                  msg="workers never registered")
+            # kill w2's heartbeats → declared dead within the timeout
+            r2.stop()
+            _wait(lambda: coord.dead() == ["w2"],
+                  msg="w2 never declared dead")
+            assert deaths == ["w2"]
+            assert coord.alive() == ["w1"]
+            # the worker restarts (new reporter, same id): elastic rejoin
+            r2b = HealthReporter(coord.host, coord.port, "w2",
+                                 interval_s=0.1)
+            _wait(lambda: set(coord.alive()) == {"w1", "w2"},
+                  msg="w2 never recovered")
+            assert recoveries == ["w2"]
+            assert coord.dead() == []
+            r1.stop()
+            r2b.stop()
+        finally:
+            coord.close()
+
+    def test_reporter_survives_coordinator_restart(self):
+        coord = HealthCoordinator(timeout_s=0.6)
+        port = coord.port
+        rep = HealthReporter(coord.host, port, "w", interval_s=0.05)
+        try:
+            _wait(lambda: coord.alive() == ["w"])
+            coord.close()  # outage: the reporter reconnects with backoff
+            time.sleep(0.3)
+            coord2 = HealthCoordinator(port=port, timeout_s=0.6)
+            try:
+                _wait(lambda: coord2.alive() == ["w"],
+                      msg="reporter never re-registered after restart")
+            finally:
+                coord2.close()
+        finally:
+            rep.stop()
+            coord.close()
+
+    def test_crashing_callback_does_not_disable_detection(self):
+        deaths = []
+
+        def bad_hook(wid):
+            deaths.append(wid)
+            raise RuntimeError("supervisor hook broke")
+
+        coord = HealthCoordinator(timeout_s=0.5, on_dead=bad_hook)
+        try:
+            r1 = HealthReporter(coord.host, coord.port, "a",
+                                interval_s=0.1)
+            r2 = HealthReporter(coord.host, coord.port, "b",
+                                interval_s=0.1)
+            _wait(lambda: set(coord.alive()) == {"a", "b"})
+            r1.stop()
+            _wait(lambda: "a" in coord.dead(), msg="a never declared")
+            # the hook raised — detection must still work for b
+            r2.stop()
+            _wait(lambda: set(coord.dead()) == {"a", "b"},
+                  msg="detection disabled after callback crash")
+            assert set(deaths) == {"a", "b"}
+        finally:
+            coord.close()
+
+    def test_remove_and_expiry(self):
+        coord = HealthCoordinator(timeout_s=0.3, expire_after_s=0.5)
+        try:
+            rep = HealthReporter(coord.host, coord.port, "tmp",
+                                 interval_s=0.05)
+            _wait(lambda: coord.alive() == ["tmp"])
+            rep.stop()
+            _wait(lambda: coord.dead() == ["tmp"])
+            # expiry drops the long-dead worker from the registry
+            _wait(lambda: coord.dead() == [] and coord.alive() == [],
+                  msg="dead worker never expired")
+            # remove() deregisters immediately
+            rep2 = HealthReporter(coord.host, coord.port, "tmp2",
+                                  interval_s=0.05)
+            _wait(lambda: coord.alive() == ["tmp2"])
+            rep2.stop()
+            time.sleep(0.15)  # drain any frame already in the socket buffer
+            coord.remove("tmp2")
+            assert coord.alive() == [] and coord.dead() == []
+        finally:
+            coord.close()
+
+    def test_garbage_frame_ignored(self):
+        import socket
+        import struct
+
+        coord = HealthCoordinator(timeout_s=1.0)
+        try:
+            rep = HealthReporter(coord.host, coord.port, "ok",
+                                 interval_s=0.05)
+            with socket.create_connection(
+                (coord.host, coord.port)
+            ) as s:
+                s.sendall(struct.pack(">I", 7) + b"not-json")
+            _wait(lambda: coord.alive() == ["ok"])
+            assert coord.dead() == []
+            rep.stop()
+        finally:
+            coord.close()
