@@ -19,6 +19,11 @@ Each host ingests and hash-partitions its own records
 (:mod:`flink_jpmml_tpu.parallel.partitioner`), builds the process-local
 slice of the global micro-batch, and `jax.make_array_from_process_local_data`
 stitches them into one global array without any host gathering the world.
+
+Liveness: pair the group with :mod:`flink_jpmml_tpu.parallel.health` —
+workers run a ``HealthReporter`` against the job's ``HealthCoordinator``
+so a hung or killed host is declared dead within its timeout and the
+supervisor restarts it from checkpoints (C7).
 """
 
 from __future__ import annotations
