@@ -72,3 +72,51 @@ class TestControlMessages:
         add = AddMessage("m", 1, "/tmp/m.pmml", 10.0)
         rm = DelMessage("m", 1, 11.0)
         assert add.model_id == rm.model_id == ModelId("m", 1)
+
+
+class TestDonateBatches:
+    def test_donate_flag_never_breaks_scoring(self, tmp_path):
+        """CompileConfig.donate_batches passes donate_argnums through to
+        jax.jit. For scoring workloads the outputs are almost always
+        smaller than the batch inputs, so XLA usually deems the donated
+        buffers unusable and warns — exactly why the flag defaults off
+        (utils/config.py). This is the regression guard for the flag
+        itself: a donation-enabled compile must still score identically
+        (warning contained, not leaked into the suite)."""
+        import warnings
+
+        import numpy as np
+
+        from assets.generate import gen_gbm
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml_file
+        from flink_jpmml_tpu.utils.config import CompileConfig
+
+        doc = parse_pmml_file(
+            gen_gbm(str(tmp_path), n_trees=12, depth=3, n_features=5)
+        )
+        cm = compile_pmml(doc, batch_size=32)
+        cm_d = compile_pmml(
+            doc, batch_size=32,
+            config=CompileConfig(donate_batches=True),
+        )
+        rng = np.random.default_rng(17)
+        base = rng.normal(0, 1.5, size=(32, 5)).astype(np.float32)
+        ref = np.asarray(cm.predict(base.copy(), np.isnan(base)).value)
+        with warnings.catch_warnings():
+            # "donated buffers were not usable" is the expected outcome
+            # on these shapes, not suite noise
+            warnings.simplefilter("ignore", UserWarning)
+            # fresh buffers per donated call (donation invalidates them)
+            got = np.asarray(
+                cm_d.predict(base.copy(), np.isnan(base)).value
+            )
+            q = cm_d.quantized_scorer()
+            got_q = (
+                np.asarray(q.predict_wire(q.wire.encode(base.copy())))
+                if q is not None
+                else None
+            )
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+        if got_q is not None:
+            np.testing.assert_allclose(got_q, ref, rtol=1e-4, atol=1e-5)
