@@ -1,0 +1,187 @@
+"""``fjt-score``: score a PMML document over a CSV/JSONL file from the
+shell — the quickest "switching user" path from a model file to
+predictions, no code required.
+
+    fjt-score model.pmml records.csv            # CSV with a header row
+    fjt-score model.pmml records.jsonl -o out.jsonl
+    cat records.jsonl | fjt-score model.pmml - --format jsonl
+
+Input: CSV (header row names the fields; empty cells = missing) or
+JSONL (one record object per line); ``-`` reads stdin. Output: one JSON
+object per input record —
+
+    {"value": 1.25, "label": "versicolor", "probs": {...}}
+    {"empty": true}                                 # invalid lane (C5)
+
+The hot path is the same compiled scorer the streaming runtime uses
+(`ModelReader.load()` → ``score_records`` in batches); this is a
+convenience frontend, not a second engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+from typing import Any, Dict, Iterator, List, Optional, TextIO
+
+
+def _records_csv(f: TextIO, codec_fields) -> Iterator[Dict[str, Any]]:
+    reader = csv.DictReader(f)
+    for row in reader:
+        rec: Dict[str, Any] = {}
+        for k, v in row.items():
+            if k is None or v is None or v == "":
+                continue  # absent cell = missing value
+            if k in codec_fields:
+                # categorical: the raw string must ride the codec — a
+                # numeric-looking category ("2") float-parsed here would
+                # bypass it and alias onto a wrong category code
+                rec[k] = v
+                continue
+            try:
+                rec[k] = float(v)
+            except ValueError:
+                rec[k] = v
+        yield rec
+
+
+def _records_jsonl(f: TextIO) -> Iterator[Dict[str, Any]]:
+    for i, line in enumerate(f, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"input line {i}: invalid JSON ({e})")
+        if not isinstance(rec, dict):
+            raise SystemExit(f"input line {i}: expected an object")
+        yield rec
+
+
+def _pred_json(pred) -> Dict[str, Any]:
+    if pred.is_empty:
+        return {"empty": True}
+    out: Dict[str, Any] = {"value": pred.score.value}
+    if pred.target is not None:
+        if pred.target.label is not None:
+            out["label"] = pred.target.label
+        if pred.target.probabilities:
+            out["probs"] = {
+                k: round(float(v), 6)
+                for k, v in pred.target.probabilities.items()
+            }
+    if pred.outputs:
+        out["outputs"] = {k: v for k, v in pred.outputs.items()}
+    return out
+
+
+def score_main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fjt-score",
+        description="Score a PMML document over CSV/JSONL records.",
+    )
+    ap.add_argument("model", help="PMML path or URI (any ModelReader scheme)")
+    ap.add_argument("input", help="records file (.csv / .jsonl) or - for stdin")
+    ap.add_argument("-o", "--output", default="-",
+                    help="output JSONL path (default stdout)")
+    ap.add_argument("--format", choices=("auto", "csv", "jsonl"),
+                    default="auto")
+    ap.add_argument("--batch", type=int, default=4096,
+                    help="records per scoring dispatch")
+    ap.add_argument("--replace-nan", type=float, default=None,
+                    help="replace missing/NaN inputs with this value")
+    ap.add_argument("--platform", default=None,
+                    help="force the jax platform (e.g. cpu) before init; "
+                         "without it the default backend initializes "
+                         "under a 60s wedge watchdog (FJT_PLATFORM "
+                         "honored)")
+    args = ap.parse_args(argv)
+
+    from flink_jpmml_tpu.utils.demo import resolve_backend
+
+    # same demo-safe bootstrap as the examples: a wedged TPU tunnel
+    # re-execs this process onto CPU instead of hanging a no-code user
+    resolve_backend(args.platform, argv_rest=argv)
+
+    from flink_jpmml_tpu.api import ModelReader
+
+    fmt = args.format
+    if fmt == "auto":
+        if args.input == "-":
+            fmt = "jsonl"
+        elif args.input.lower().endswith(".csv"):
+            fmt = "csv"
+        else:
+            fmt = "jsonl"
+
+    cm = ModelReader(args.model).load(batch_size=args.batch)
+
+    try:
+        fin = sys.stdin if args.input == "-" else open(
+            args.input, "r", encoding="utf-8"
+        )
+    except OSError as e:
+        raise SystemExit(f"cannot read {args.input!r}: {e}")
+    try:
+        fout = sys.stdout if args.output == "-" else open(
+            args.output, "w", encoding="utf-8"
+        )
+    except OSError as e:
+        if fin is not sys.stdin:
+            fin.close()
+        raise SystemExit(f"cannot write {args.output!r}: {e}")
+    n = 0
+    try:
+        records = (
+            _records_csv(fin, set(cm.field_space.codecs))
+            if fmt == "csv"
+            else _records_jsonl(fin)
+        )
+        # --replace-nan fills missing/NaN NUMERIC active fields (the
+        # reference's replaceNan option); categorical fields keep the
+        # missing-value semantics their codecs define
+        numeric_fields = [
+            f for f in cm.field_space.fields
+            if f not in cm.field_space.codecs
+        ]
+
+        def fill(rec: Dict[str, Any]) -> Dict[str, Any]:
+            if args.replace_nan is None:
+                return rec
+            for f in numeric_fields:
+                v = rec.get(f)
+                if v is None or (isinstance(v, float) and v != v):
+                    rec[f] = args.replace_nan
+            return rec
+
+        batch: List[Dict[str, Any]] = []
+
+        def flush() -> None:
+            nonlocal n
+            if not batch:
+                return
+            preds = cm.score_records(batch)
+            for p in preds:
+                fout.write(json.dumps(_pred_json(p)) + "\n")
+            n += len(batch)
+            batch.clear()
+
+        for rec in records:
+            batch.append(fill(rec))
+            if len(batch) >= args.batch:
+                flush()
+        flush()
+    finally:
+        if fin is not sys.stdin:
+            fin.close()
+        if fout is not sys.stdout:
+            fout.close()
+    print(f"scored {n} records", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(score_main())

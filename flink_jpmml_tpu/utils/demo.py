@@ -26,36 +26,36 @@ import argparse
 import os
 import sys
 import threading
+from typing import Optional
 
 
-def demo_backend(timeout_s: float = 60.0) -> str:
-    """Resolve the jax backend for an example job, demo-safely.
-
-    Parses (and strips from ``sys.argv``) the shared ``--platform`` /
-    ``--backend-timeout`` flags, then either forces the requested
-    platform or eagerly initializes the default one under a watchdog.
-    Returns the resolved backend name.
-    """
-    ap = argparse.ArgumentParser(add_help=False)
-    ap.add_argument("--platform", default=os.environ.get("FJT_PLATFORM"))
-    ap.add_argument("--backend-timeout", type=float, default=timeout_s)
-    args, rest = ap.parse_known_args(sys.argv[1:])
-    sys.argv = [sys.argv[0]] + rest
-
+def resolve_backend(
+    platform: Optional[str],
+    timeout_s: float = 60.0,
+    argv_rest: Optional[list] = None,
+) -> str:
+    """The core demo-safe resolve, shared by the examples and the
+    ``fjt-score`` CLI: force ``platform`` when given (falling back to
+    ``FJT_PLATFORM``), otherwise eagerly initialize the default backend
+    under a watchdog that re-execs the process with ``--platform cpu``
+    appended if init wedges past ``timeout_s``. ``argv_rest`` is the
+    argv tail to re-exec with (default: current ``sys.argv[1:]``)."""
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
+    platform = platform or os.environ.get("FJT_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
         return jax.default_backend()
 
+    rest = sys.argv[1:] if argv_rest is None else list(argv_rest)
     done = threading.Event()
 
     def _watchdog() -> None:
-        if done.wait(args.backend_timeout):
+        if done.wait(timeout_s):
             return
         print(
-            f"[fjt-demo] backend init exceeded {args.backend_timeout:.0f}s "
-            "(wedged TPU tunnel?) — restarting this example on CPU",
+            f"[fjt-demo] backend init exceeded {timeout_s:.0f}s "
+            "(wedged TPU tunnel?) — restarting on CPU",
             file=sys.stderr,
             flush=True,
         )
@@ -69,3 +69,20 @@ def demo_backend(timeout_s: float = 60.0) -> str:
     backend = jax.default_backend()  # blocks here when the tunnel wedges
     done.set()
     return backend
+
+
+def demo_backend(timeout_s: float = 60.0) -> str:
+    """Resolve the jax backend for an example job, demo-safely.
+
+    Parses (and strips from ``sys.argv``) the shared ``--platform`` /
+    ``--backend-timeout`` flags, then defers to :func:`resolve_backend`.
+    Returns the resolved backend name.
+    """
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--backend-timeout", type=float, default=timeout_s)
+    args, rest = ap.parse_known_args(sys.argv[1:])
+    sys.argv = [sys.argv[0]] + rest
+    return resolve_backend(
+        args.platform, args.backend_timeout, argv_rest=rest
+    )
