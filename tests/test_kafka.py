@@ -126,6 +126,71 @@ class TestNativeRowDecode:
             decode_record_batches_rows(bytes(bad), 4)
 
 
+class TestProtocolFuzz:
+    """Random batches, truncations, and corruptions through both
+    decoders: every outcome must be a correct prefix or a typed
+    ValueError — never an IndexError/struct.error escape."""
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_roundtrip_truncate_corrupt(self, seed):
+        rng = np.random.default_rng(7000 + seed)
+        n = int(rng.integers(1, 120))
+        fixed = bool(rng.integers(0, 2))
+        vlen = int(rng.integers(0, 64))
+        values = [
+            rng.bytes(vlen if fixed else int(rng.integers(0, 64)))
+            for _ in range(n)
+        ]
+        base = int(rng.integers(0, 10_000))
+        raw = encode_record_batch(base, values)
+
+        ref = decode_record_batches(raw)
+        assert ref == [(base + i, v) for i, v in enumerate(values)]
+        if fixed and vlen > 0:
+            from flink_jpmml_tpu.runtime import native
+
+            if native.available():
+                # a fixed-length batch MUST take the native fast path —
+                # a spurious None here would be a silent fallback bug
+                dec = native.kafka_decode_fixed(raw, vlen)
+                assert dec is not None
+                offs, vals = dec
+                assert offs.tolist() == [o for o, _ in ref]
+                assert [vals[i].tobytes() for i in range(len(ref))] == values
+
+        # truncations: every strict prefix of the single batch decodes
+        # to [] — the decoder must never fabricate records
+        for _ in range(6):
+            k = int(rng.integers(0, len(raw)))
+            assert decode_record_batches(raw[:k]) == []
+
+        # corruptions: a flipped byte is caught typed (CRC/magic/framing)
+        # or yields a clean prefix. The v2 CRC deliberately does NOT
+        # cover the first 21 header bytes (base_offset/batch_len/epoch),
+        # so flips there can decode successfully with shifted offsets —
+        # the VALUES must still be intact (they are CRC-covered).
+        for _ in range(5):
+            j = int(rng.integers(0, len(raw)))
+            bad = bytearray(raw)
+            bad[j] ^= 0xFF
+            try:
+                got = decode_record_batches(bytes(bad))
+                assert got == [] or [v for _, v in got] == [
+                    v for _, v in ref
+                ]
+            except ValueError:
+                pass  # typed rejection is the expected outcome
+            if fixed and vlen > 0:
+                from flink_jpmml_tpu.runtime import native
+
+                if native.available():
+                    try:
+                        dec = native.kafka_decode_fixed(bytes(bad), vlen)
+                        assert dec is None or len(dec[0]) in (0, n)
+                    except ValueError:
+                        pass
+
+
 class TestClientBroker:
     def test_api_versions_metadata_offsets(self):
         broker = MiniKafkaBroker(topic="t")
