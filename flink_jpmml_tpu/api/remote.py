@@ -39,7 +39,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import warnings
-from typing import Optional, Tuple
+from typing import Tuple
 
 from flink_jpmml_tpu.utils.exceptions import ModelLoadingException
 

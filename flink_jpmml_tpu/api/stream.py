@@ -19,8 +19,8 @@ Dynamic serving (C6): ``stream.with_control_stream(ctrl).evaluate()`` — see
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dc_field
-from typing import Any, Callable, List, Optional, Sequence
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence
 
 from flink_jpmml_tpu.api.reader import ModelReader
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager

@@ -65,11 +65,9 @@ import argparse
 import collections
 import json
 import os
-import pathlib
 import subprocess
 import sys
 import tempfile
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 

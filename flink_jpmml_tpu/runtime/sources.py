@@ -15,7 +15,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Sequence, Tuple
 
 Record = Any
 # poll() → list of (offset, record); offset is the position *after* the record
