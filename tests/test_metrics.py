@@ -1,0 +1,84 @@
+"""utils/metrics.py: counters, latency reservoirs, snapshots — the
+observability layer every pipeline reports through (SURVEY.md §6)."""
+
+import threading
+
+from flink_jpmml_tpu.utils.metrics import Counter, MetricsRegistry, Reservoir
+
+
+class TestCounter:
+    def test_inc_and_get(self):
+        c = Counter()
+        c.inc()
+        c.inc(2.5)
+        assert c.get() == 3.5
+
+    def test_thread_safety(self):
+        c = Counter()
+
+        def bump():
+            for _ in range(10_000):
+                c.inc()
+
+        ts = [threading.Thread(target=bump) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.get() == 40_000  # no lost increments
+
+
+class TestReservoir:
+    def test_empty_quantile_is_none(self):
+        r = Reservoir()
+        assert r.quantile(0.5) is None
+        assert r.count() == 0
+
+    def test_quantiles_exact_small(self):
+        r = Reservoir()
+        for v in (5.0, 1.0, 3.0, 2.0, 4.0):
+            r.observe(v)
+        assert r.quantile(0.0) == 1.0
+        assert r.quantile(0.5) == 3.0
+        assert r.quantile(0.99) == 5.0  # clamped to the max sample
+        assert r.count() == 5
+
+    def test_ring_keeps_most_recent(self):
+        r = Reservoir(capacity=4)
+        for v in (100.0, 100.0, 100.0, 100.0):
+            r.observe(v)
+        # four newer observations fully displace the old regime
+        for v in (1.0, 2.0, 3.0, 4.0):
+            r.observe(v)
+        assert r.count() == 4
+        assert r.quantile(0.99) == 4.0  # no 100.0 survivor
+
+    def test_single_observation(self):
+        r = Reservoir()
+        r.observe(7.5)
+        assert r.quantile(0.5) == 7.5
+        assert r.quantile(0.99) == 7.5
+
+
+class TestRegistry:
+    def test_names_are_stable_handles(self):
+        m = MetricsRegistry()
+        assert m.counter("x") is m.counter("x")
+        assert m.reservoir("lat") is m.reservoir("lat")
+
+    def test_snapshot_shape(self):
+        m = MetricsRegistry()
+        m.counter("records_out").inc(100)
+        m.reservoir("lat_s").observe(0.25)
+        m.reservoir("lat_s").observe(0.75)
+        m.reservoir("empty")  # registered but never observed
+        snap = m.snapshot()
+        assert snap["records_out"] == 100
+        assert snap["records_out_per_s"] > 0
+        assert snap["uptime_s"] > 0
+        # index convention: pos = int(q*n) clamped — the p50 of two
+        # samples is the upper one
+        assert snap["lat_s_p50"] == 0.75
+        assert snap["lat_s_p99"] == 0.75
+        # unobserved reservoirs contribute no NaN/None keys
+        assert not any(k.startswith("empty") for k in snap)
