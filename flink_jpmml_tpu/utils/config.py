@@ -68,6 +68,17 @@ class CompileConfig:
     # score outputs rarely alias input shapes (XLA would warn and ignore it)
     donate_batches: bool = False
 
+    def __post_init__(self) -> None:
+        if self.matmul_dtype not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"matmul_dtype must be bfloat16 or float32: "
+                f"{self.matmul_dtype!r}"
+            )
+        if self.max_dense_depth <= 0:
+            raise ValueError(
+                f"max_dense_depth must be > 0: {self.max_dense_depth}"
+            )
+
 
 @dataclass(frozen=True)
 class RuntimeConfig:
@@ -97,6 +108,12 @@ def from_env(base: Optional[RuntimeConfig] = None) -> RuntimeConfig:
         raw = os.environ.get(_ENV_PREFIX + name)
         return int(raw) if raw else cur
 
+    def _str(name: str, cur):
+        # set-but-empty (common in CI/k8s templating) keeps the default,
+        # same as the int vars
+        raw = os.environ.get(_ENV_PREFIX + name)
+        return raw if raw else cur
+
     batch = dataclasses.replace(
         batch,
         size=_int("BATCH_SIZE", batch.size),
@@ -109,12 +126,12 @@ def from_env(base: Optional[RuntimeConfig] = None) -> RuntimeConfig:
     )
     comp = dataclasses.replace(
         comp,
-        matmul_dtype=os.environ.get(_ENV_PREFIX + "MATMUL_DTYPE", comp.matmul_dtype),
+        matmul_dtype=_str("MATMUL_DTYPE", comp.matmul_dtype),
     )
     return dataclasses.replace(
         cfg,
         batch=batch,
         mesh=mesh,
         compile=comp,
-        checkpoint_dir=os.environ.get(_ENV_PREFIX + "CHECKPOINT_DIR", cfg.checkpoint_dir),
+        checkpoint_dir=_str("CHECKPOINT_DIR", cfg.checkpoint_dir),
     )

@@ -1,0 +1,78 @@
+"""utils/config.py: dataclass validation + FJT_* env overrides."""
+
+import pytest
+
+from flink_jpmml_tpu.utils.config import (
+    BatchConfig,
+    MeshConfig,
+    RuntimeConfig,
+    from_env,
+)
+
+
+class TestValidation:
+    def test_batch_rejections(self):
+        with pytest.raises(ValueError, match="batch size"):
+            BatchConfig(size=0)
+        with pytest.raises(ValueError, match="deadline"):
+            BatchConfig(deadline_us=0)
+
+    def test_mesh_rejections(self):
+        with pytest.raises(ValueError, match="mesh axes"):
+            MeshConfig(data=0)
+        with pytest.raises(ValueError, match="mesh axes"):
+            MeshConfig(model=-1)
+
+    def test_compile_rejections(self):
+        from flink_jpmml_tpu.utils.config import CompileConfig
+
+        with pytest.raises(ValueError, match="matmul_dtype"):
+            CompileConfig(matmul_dtype="float64typo")
+        with pytest.raises(ValueError, match="max_dense_depth"):
+            CompileConfig(max_dense_depth=0)
+
+
+class TestFromEnv:
+    def test_no_env_is_identity(self, monkeypatch):
+        for v in ("FJT_BATCH_SIZE", "FJT_BATCH_DEADLINE_US",
+                  "FJT_MESH_DATA", "FJT_MESH_MODEL",
+                  "FJT_MATMUL_DTYPE", "FJT_CHECKPOINT_DIR"):
+            monkeypatch.delenv(v, raising=False)
+        base = RuntimeConfig()
+        assert from_env(base) == base
+
+    def test_overrides_apply(self, monkeypatch):
+        monkeypatch.setenv("FJT_BATCH_SIZE", "512")
+        monkeypatch.setenv("FJT_BATCH_DEADLINE_US", "1500")
+        monkeypatch.setenv("FJT_MESH_DATA", "4")
+        monkeypatch.setenv("FJT_MESH_MODEL", "2")
+        monkeypatch.setenv("FJT_MATMUL_DTYPE", "float32")
+        monkeypatch.setenv("FJT_CHECKPOINT_DIR", "/ck")
+        cfg = from_env()
+        assert cfg.batch.size == 512
+        assert cfg.batch.deadline_us == 1500
+        assert cfg.mesh.data == 4 and cfg.mesh.model == 2
+        assert cfg.compile.matmul_dtype == "float32"
+        assert cfg.checkpoint_dir == "/ck"
+
+    def test_invalid_override_is_typed(self, monkeypatch):
+        # a bad value must surface as the dataclass's own validation,
+        # not silently produce a broken config
+        monkeypatch.setenv("FJT_BATCH_SIZE", "0")
+        with pytest.raises(ValueError, match="batch size"):
+            from_env()
+        monkeypatch.delenv("FJT_BATCH_SIZE")
+        monkeypatch.setenv("FJT_MATMUL_DTYPE", "float64typo")
+        with pytest.raises(ValueError, match="matmul_dtype"):
+            from_env()
+
+    def test_set_but_empty_keeps_defaults(self, monkeypatch):
+        # common CI/k8s templating artifact: VAR= (empty) means unset
+        monkeypatch.setenv("FJT_MATMUL_DTYPE", "")
+        monkeypatch.setenv("FJT_CHECKPOINT_DIR", "")
+        monkeypatch.setenv("FJT_BATCH_SIZE", "")
+        base = RuntimeConfig(checkpoint_dir="/keep")
+        cfg = from_env(base)
+        assert cfg.compile.matmul_dtype == "bfloat16"
+        assert cfg.checkpoint_dir == "/keep"
+        assert cfg.batch.size == base.batch.size
