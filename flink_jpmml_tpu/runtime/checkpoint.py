@@ -17,6 +17,7 @@ import json
 import os
 import tempfile
 import time
+import warnings
 from typing import Any, Dict, Optional
 
 from flink_jpmml_tpu.utils.exceptions import CheckpointException
@@ -79,16 +80,38 @@ class CheckpointManager:
         return path
 
     def load_latest(self) -> Optional[Dict[str, Any]]:
+        """Newest readable checkpoint's state (None when none exist).
+
+        A corrupt newest file (disk damage — the atomic rename rules out
+        torn writes) falls back to the next retained snapshot with a
+        loud warning: that is what retention is FOR, and resuming from
+        an older offset just replays more records (the at-least-once
+        contract). Only when every retained checkpoint is unreadable
+        does restore fail."""
         ckpts = self._list()
         if not ckpts:
             return None
-        try:
-            with open(ckpts[-1], "r", encoding="utf-8") as f:
-                return json.load(f)["state"]
-        except (OSError, json.JSONDecodeError, KeyError) as e:
-            raise CheckpointException(
-                f"corrupt checkpoint {ckpts[-1]!r}: {e}"
-            ) from e
+        errors = []
+        for path in reversed(ckpts):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    state = json.load(f)["state"]
+            except (
+                OSError, json.JSONDecodeError, KeyError, TypeError,
+            ) as e:  # TypeError: valid JSON that isn't a dict payload
+                errors.append(f"{path!r}: {e}")
+                continue
+            if errors:
+                warnings.warn(
+                    "corrupt checkpoint(s) skipped during restore "
+                    f"({'; '.join(errors)}); resuming from {path!r}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            return state
+        raise CheckpointException(
+            f"no readable checkpoint: {'; '.join(errors)}"
+        )
 
     def _list(self):
         try:

@@ -1,0 +1,77 @@
+"""runtime/checkpoint.py direct unit coverage: atomic save, retention,
+corrupt-latest fallback (what retention exists for), total corruption."""
+
+import json
+import pathlib
+import time
+
+import pytest
+
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.utils.exceptions import CheckpointException
+
+
+class TestCheckpointManager:
+    def test_save_load_roundtrip(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"source_offset": 42, "served": {"m_1": "/p"}})
+        assert mgr.load_latest() == {
+            "source_offset": 42, "served": {"m_1": "/p"},
+        }
+
+    def test_empty_dir_is_none(self, tmp_path):
+        assert CheckpointManager(str(tmp_path)).load_latest() is None
+
+    def test_retention_keeps_last_k(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        for i in range(6):
+            mgr.save({"source_offset": i})
+            time.sleep(0.002)  # distinct microsecond stamps
+        files = sorted(tmp_path.glob("ckpt-*.json"))
+        assert len(files) == 3
+        assert mgr.load_latest() == {"source_offset": 5}
+
+    def test_corrupt_latest_falls_back_with_warning(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        mgr.save({"source_offset": 1})
+        time.sleep(0.002)
+        mgr.save({"source_offset": 2})
+        time.sleep(0.002)
+        mgr.save({"source_offset": 3})
+        newest = sorted(tmp_path.glob("ckpt-*.json"))[-1]
+        newest.write_text("{truncated")
+        with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+            state = mgr.load_latest()
+        assert state == {"source_offset": 2}  # older offset: replay, not loss
+
+    def test_all_corrupt_is_typed_error(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        mgr.save({"source_offset": 1})
+        time.sleep(0.002)
+        mgr.save({"source_offset": 2})
+        for p in tmp_path.glob("ckpt-*.json"):
+            p.write_text("not json at all")
+        with pytest.raises(CheckpointException, match="no readable"):
+            mgr.load_latest()
+
+    def test_non_dict_json_is_corrupt(self, tmp_path):
+        # valid JSON that isn't the payload shape (e.g. null) must take
+        # the fallback path, not crash with TypeError
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"source_offset": 5})
+        time.sleep(0.002)
+        bad = pathlib.Path(mgr.save({"source_offset": 6}))
+        bad.write_text("null")
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            assert mgr.load_latest() == {"source_offset": 5}
+
+    def test_missing_state_key_is_corrupt(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"source_offset": 7})
+        time.sleep(0.002)
+        bad = pathlib.Path(
+            mgr.save({"source_offset": 8})
+        )
+        bad.write_text(json.dumps({"timestamp": 0}))  # no "state"
+        with pytest.warns(RuntimeWarning, match="corrupt"):
+            assert mgr.load_latest() == {"source_offset": 7}
