@@ -171,10 +171,17 @@ def lower_time_series(model: ir.TimeSeriesIR, ctx: LowerCtx) -> Lowered:
             phi_h = jnp.exp(h * log_phi)
             y = y + p["trend"] * phi_scale * (1.0 - phi_h)
         elif trend_type == "multiplicative":
-            y = y * jnp.exp(h * log_trend)
+            # level == 0 must stay 0 even when exp overflows to inf
+            # (0·inf = NaN in IEEE; the oracle keeps y = 0 — interp.py
+            # _eval_time_series multiplicative overflow guard)
+            y = jnp.where(y == 0.0, y, y * jnp.exp(h * log_trend))
         elif trend_type == "damped_multiplicative":
             phi_h = jnp.exp(h * log_phi)
-            y = y * jnp.exp(phi_scale * (1.0 - phi_h) * log_trend)
+            y = jnp.where(
+                y == 0.0,
+                y,
+                y * jnp.exp(phi_scale * (1.0 - phi_h) * log_trend),
+            )
         if seasonal_type != "none":
             idx = jnp.mod(h.astype(jnp.int32) - 1, period)
             factor = jnp.take(p["seasonal"], idx)

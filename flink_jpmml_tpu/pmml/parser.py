@@ -1011,8 +1011,24 @@ def _parse_arima(elem: ET.Element, model_elem: ET.Element) -> ir.ArimaIR:
             )
         sar, _ = _parse_arima_poly(sc, "AR", sp, "SeasonalComponent")
         sma, sres = _parse_arima_poly(sc, "MA", sq, "SeasonalComponent")
-        if sres is not None and len(sres) > len(residuals):
-            residuals = sres
+        if sres is not None:
+            # there is ONE residual history; each component may carry a
+            # trailing window of it sized to its own MA reach. Consistent
+            # = the shorter array is a suffix of the longer; anything
+            # else means the two windows disagree on shared positions,
+            # and silently picking one would forecast from an arbitrary
+            # history — fail loudly instead.
+            short, long_ = sorted(
+                (tuple(residuals), tuple(sres)), key=len
+            )
+            if residuals and short != long_[len(long_) - len(short):]:
+                raise ModelLoadingException(
+                    "NonseasonalComponent.MA and SeasonalComponent.MA "
+                    "both carry <Residuals> that disagree on their "
+                    f"overlap ({residuals!r} vs {sres!r}); the residual "
+                    "history is ambiguous"
+                )
+            residuals = long_
 
     # the observed series rides the TimeSeriesModel's <TimeSeries>
     ts = _child(model_elem, "TimeSeries")

@@ -87,20 +87,35 @@ class CheckpointManager:
         loud warning: that is what retention is FOR, and resuming from
         an older offset just replays more records (the at-least-once
         contract). Only when every retained checkpoint is unreadable
-        does restore fail."""
+        does restore fail.
+
+        Transient I/O failures (EMFILE, EACCES, an NFS hiccup) are NOT
+        corruption: falling back past an intact newest snapshot would
+        silently replay up to a full retention window. Such reads get
+        one retry; a second failure raises so the operator sees it."""
         ckpts = self._list()
         if not ckpts:
             return None
         errors = []
         for path in reversed(ckpts):
             try:
-                with open(path, "r", encoding="utf-8") as f:
-                    state = json.load(f)["state"]
+                state = self._read_state(path)
             except (
-                OSError, json.JSONDecodeError, KeyError, TypeError,
+                json.JSONDecodeError, KeyError, TypeError,
             ) as e:  # TypeError: valid JSON that isn't a dict payload
                 errors.append(f"{path!r}: {e}")
                 continue
+            except FileNotFoundError as e:
+                # a concurrent _gc may legitimately remove older files;
+                # a vanished file is not an intact snapshot being skipped
+                errors.append(f"{path!r}: {e}")
+                continue
+            except OSError as e:
+                raise CheckpointException(
+                    f"transient I/O failure reading {path!r} (retried "
+                    f"once): {e} — not falling back past a possibly "
+                    "intact snapshot"
+                ) from e
             if errors:
                 warnings.warn(
                     "corrupt checkpoint(s) skipped during restore "
@@ -112,6 +127,20 @@ class CheckpointManager:
         raise CheckpointException(
             f"no readable checkpoint: {'; '.join(errors)}"
         )
+
+    @staticmethod
+    def _read_state(path: str):
+        """Read + decode one snapshot, retrying a transient OSError
+        once (decode errors are deterministic — no point retrying)."""
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return json.load(f)["state"]
+        except FileNotFoundError:
+            raise
+        except OSError:
+            time.sleep(0.05)
+            with open(path, "r", encoding="utf-8") as f:
+                return json.load(f)["state"]
 
     def _list(self):
         try:

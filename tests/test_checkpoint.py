@@ -75,3 +75,61 @@ class TestCheckpointManager:
         bad.write_text(json.dumps({"timestamp": 0}))  # no "state"
         with pytest.warns(RuntimeWarning, match="corrupt"):
             assert mgr.load_latest() == {"source_offset": 7}
+
+    def test_transient_oserror_retries_once(self, tmp_path, monkeypatch):
+        # an EMFILE-style hiccup on the newest snapshot must not roll
+        # the job back a retention window: one retry, then success
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"source_offset": 1})
+        time.sleep(0.002)
+        mgr.save({"source_offset": 2})
+        real_open = open
+        fails = {"n": 1}
+
+        def flaky_open(path, *a, **kw):
+            if "ckpt-" in str(path) and fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError(24, "Too many open files")
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr("builtins.open", flaky_open)
+        assert mgr.load_latest() == {"source_offset": 2}
+
+    def test_persistent_oserror_raises_not_falls_back(
+        self, tmp_path, monkeypatch
+    ):
+        # a persistent I/O failure on an intact newest snapshot raises
+        # (operator-visible) instead of silently resuming from an older
+        # offset — corruption falls back, transport failure does not
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"source_offset": 1})
+        time.sleep(0.002)
+        newest = mgr.save({"source_offset": 2})
+        real_open = open
+
+        def broken_open(path, *a, **kw):
+            if str(path) == newest:
+                raise OSError(13, "Permission denied")
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr("builtins.open", broken_open)
+        with pytest.raises(CheckpointException, match="transient I/O"):
+            mgr.load_latest()
+
+    def test_vanished_file_falls_back(self, tmp_path, monkeypatch):
+        # FileNotFoundError = a concurrent GC removed it between listing
+        # and opening: fall back past it (no intact snapshot is skipped)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"source_offset": 1})
+        time.sleep(0.002)
+        newest = mgr.save({"source_offset": 2})
+        real_open = open
+
+        def racing_open(path, *a, **kw):
+            if str(path) == newest:
+                raise FileNotFoundError(2, "No such file", str(path))
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr("builtins.open", racing_open)
+        with pytest.warns(RuntimeWarning):
+            assert mgr.load_latest() == {"source_offset": 1}

@@ -116,6 +116,32 @@ class TestExponentialSmoothing:
         g = cm.score_records([{"h": 30000}])[0].score.value
         assert o == float("inf") and np.isinf(g) and g > 0
 
+    def test_multiplicative_trend_zero_level_total(self):
+        # level == 0: the forecast is 0 for every horizon, including
+        # horizons where trend^h overflows — the compiled path must not
+        # produce 0 · inf = NaN where the oracle keeps 0 (backend
+        # parity in the exact corner the overflow handling covers)
+        xml = TS.format(trend=TREND_MUL, seasonal="").replace(
+            'smoothedValue="120.5"', 'smoothedValue="0.0"'
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        for h in (3, 30000):
+            o = evaluate(doc, {"h": h}).value
+            g = cm.score_records([{"h": h}])[0].score.value
+            assert o == 0.0
+            assert g == 0.0
+
+    def test_damped_multiplicative_zero_level_total(self):
+        xml = TS.format(trend=TREND_DAMPED_MUL, seasonal="").replace(
+            'smoothedValue="120.5"', 'smoothedValue="0.0"'
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        o = evaluate(doc, {"h": 5}).value
+        g = cm.score_records([{"h": 5}])[0].score.value
+        assert o == 0.0 and g == 0.0
+
     def test_legacy_damped_trend_alias(self):
         # pre-spec spelling accepted and normalized to damped_additive
         legacy = TREND_DAMPED.replace("damped_additive", "damped_trend")
@@ -340,7 +366,7 @@ class TestArima:
             for t, v in enumerate(rng.normal(0, 0.5, size=24))
         )
         doc = parse_pmml(_arima_xml(
-            _ns(2, 1, 1, ar=(0.45, -0.2), ma=(0.3,), residuals=(0.2, -0.4))
+            _ns(2, 1, 1, ar=(0.45, -0.2), ma=(0.3,), residuals=(0.2, -0.1))
             + _sc(1, 1, 1, 4, sar=(0.35,), sma=(0.25,),
                   residuals=(0.1, -0.2, 0.15, 0.05, 0.2, -0.1)),
             hist, constant=0.1,
@@ -406,3 +432,29 @@ class TestArima:
                 '<DynamicRegressor field="x"/>' + _ns(1, 0, 0, ar=(0.5,)),
                 HIST8,
             ))
+
+    def test_conflicting_residuals_rejected(self):
+        # NonseasonalComponent.MA and SeasonalComponent.MA both carrying
+        # <Residuals> that disagree on their overlap is ambiguous:
+        # forecasting from an arbitrarily-chosen history would be
+        # silent corruption (the shorter must be a trailing window —
+        # a suffix — of the longer)
+        with pytest.raises(ModelLoadingException, match="ambiguous"):
+            parse_pmml(_arima_xml(
+                _ns(0, 0, 1, ma=(0.3,), residuals=(0.5, 0.6))
+                + _sc(0, 0, 1, 4, sma=(0.2,),
+                      residuals=(0.1, 0.2, 0.3, 0.4, 0.5)),
+                HIST8,
+            ))
+
+    def test_suffix_residuals_accepted(self):
+        # each component carrying a trailing window of the ONE residual
+        # history (sized to its own MA reach) is consistent: the longer
+        # window wins, the shorter must be its suffix
+        long_res = (0.1, 0.2, 0.3, 0.4, 0.5)
+        doc = parse_pmml(_arima_xml(
+            _ns(0, 0, 1, ma=(0.3,), residuals=long_res[-2:])
+            + _sc(0, 0, 1, 4, sma=(0.2,), residuals=long_res),
+            HIST8,
+        ))
+        assert tuple(doc.model.arima.residuals) == long_res
