@@ -1,0 +1,374 @@
+"""Worker supervision: automatic restart-from-checkpoint (SURVEY.md §6
+row "Failure detection / elastic recovery", recovery half).
+
+The reference's user gets automatic job restart from the Flink runtime:
+heartbeats detect a dead TaskManager, the restart strategy (fixed-delay
+or failure-rate, both bounded) relaunches the job, and execution resumes
+from the last completed checkpoint. ``parallel/health.py`` provides the
+detection half; this module owns the recovery half — so detection →
+restart is in-tree and automatic, not "the operator runs a script"
+(docs/operations.md pre-round-5).
+
+:class:`Supervisor` owns a set of worker *processes*:
+
+- spawn: each :class:`WorkerSpec` is an argv the supervisor launches
+  with ``FJT_SUPERVISOR_ADDR`` / ``FJT_WORKER_ID`` in the environment;
+  the worker is expected to (a) beat via :func:`reporter_from_env` and
+  (b) resume from its own checkpoint on startup — restart-from-
+  checkpoint stays the worker's C7 contract (idempotent load, seek to
+  committed offset); the supervisor never migrates state.
+- detect: two independent signals, either sufficient —
+  * **process exit** (a watcher thread polls ``Popen``), the fast
+    path for crashes/kill -9;
+  * **heartbeat silence** (``HealthCoordinator.on_dead``), the only
+    path for a *wedged* worker whose process is still alive — that
+    worker is killed first, then restarted.
+- restart: per-worker bounded retries with exponential backoff
+  (:class:`RestartPolicy` — Flink's fixed-delay strategy; a
+  ``window_s`` turns it into the failure-rate strategy: only failures
+  inside the trailing window count against ``max_restarts``).
+- give up: a worker exceeding the policy stays down and
+  ``on_give_up(worker_id)`` fires exactly once — the operator
+  escalation point, matching Flink's job-failure terminal state.
+
+A worker that exits rc=0 is *finished*, not failed: it is
+deregistered and never restarted (streaming jobs normally never exit;
+batch drains do).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from flink_jpmml_tpu.parallel.health import HealthCoordinator, HealthReporter
+
+_ADDR_ENV = "FJT_SUPERVISOR_ADDR"
+_ID_ENV = "FJT_WORKER_ID"
+
+
+def reporter_from_env(interval_s: float = 0.25) -> Optional[HealthReporter]:
+    """Worker side: start beating to the supervising coordinator named
+    by the environment (no-op → None when not under supervision)."""
+    addr = os.environ.get(_ADDR_ENV)
+    wid = os.environ.get(_ID_ENV)
+    if not addr or not wid:
+        return None
+    host, port = addr.rsplit(":", 1)
+    return HealthReporter(host, int(port), wid, interval_s=interval_s)
+
+
+@dataclass(frozen=True)
+class RestartPolicy:
+    """Flink restart-strategy analogue. ``window_s=None`` = fixed-delay
+    (lifetime budget of ``max_restarts``); a window makes it
+    failure-rate (``max_restarts`` per trailing ``window_s``)."""
+
+    max_restarts: int = 3
+    backoff_s: float = 0.2
+    backoff_multiplier: float = 2.0
+    max_backoff_s: float = 10.0
+    window_s: Optional[float] = None
+    # a restarted worker that stays up this long is healthy again: the
+    # exponential backoff resets (failures months apart must not pay
+    # the max backoff forever)
+    reset_after_s: float = 10.0
+
+    def backoff(self, consecutive_failures: int) -> float:
+        b = self.backoff_s * (
+            self.backoff_multiplier ** max(consecutive_failures - 1, 0)
+        )
+        return min(b, self.max_backoff_s)
+
+
+@dataclass(frozen=True)
+class WorkerSpec:
+    worker_id: str
+    argv: Sequence[str]
+    env: Optional[Dict[str, str]] = None
+    cwd: Optional[str] = None
+
+
+@dataclass
+class _WorkerState:
+    spec: WorkerSpec
+    proc: Optional[subprocess.Popen] = None
+    spawned_at: float = 0.0
+    failure_times: List[float] = field(default_factory=list)
+    consecutive_failures: int = 0
+    restart_at: Optional[float] = None  # backoff deadline, monotonic
+    finished: bool = False  # exited rc=0: do not restart
+    gave_up: bool = False
+    gave_up_notified: bool = False  # on_give_up fired exactly once
+    restarts: int = 0  # successful respawns (observability)
+
+
+class Supervisor:
+    """Spawn, watch, and automatically restart worker processes.
+
+    ``heartbeat_timeout_s=None`` disables the coordinator (process-exit
+    detection only — enough when workers can only die, not wedge)."""
+
+    def __init__(
+        self,
+        specs: Sequence[WorkerSpec],
+        policy: RestartPolicy = RestartPolicy(),
+        heartbeat_timeout_s: Optional[float] = 2.0,
+        first_beat_timeout_s: float = 30.0,
+        on_give_up: Optional[Callable[[str], None]] = None,
+        on_restart: Optional[Callable[[str, int], None]] = None,
+        poll_interval_s: float = 0.05,
+    ):
+        ids = [s.worker_id for s in specs]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate worker ids: {ids}")
+        self._policy = policy
+        self._first_beat_timeout = first_beat_timeout_s
+        self._on_give_up = on_give_up
+        self._on_restart = on_restart
+        self._poll_interval = poll_interval_s
+        self._mu = threading.Lock()
+        self._workers: Dict[str, _WorkerState] = {
+            s.worker_id: _WorkerState(spec=s) for s in specs
+        }
+        self._closing = False
+        self._coord: Optional[HealthCoordinator] = None
+        if heartbeat_timeout_s is not None:
+            self._coord = HealthCoordinator(
+                timeout_s=heartbeat_timeout_s,
+                on_dead=self._on_heartbeat_dead,
+                # a successfully restarted worker resumes beating under
+                # the same id; recovery needs no action here
+            )
+        self._watcher = threading.Thread(target=self._watch, daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self._mu:
+            for st in self._workers.values():
+                self._spawn_locked(st)
+        self._watcher.start()
+
+    def stop(self, grace_s: float = 5.0) -> None:
+        """Terminate all workers (SIGTERM, then SIGKILL after grace)."""
+        with self._mu:
+            self._closing = True
+            procs = [
+                st.proc for st in self._workers.values()
+                if st.proc is not None and st.proc.poll() is None
+            ]
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + grace_s
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                    p.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        if self._watcher.is_alive():
+            self._watcher.join(timeout=5.0)
+        if self._coord is not None:
+            self._coord.close()
+
+    # -- views -------------------------------------------------------------
+
+    def status(self) -> Dict[str, dict]:
+        with self._mu:
+            return {
+                wid: {
+                    "alive": st.proc is not None
+                    and st.proc.poll() is None,
+                    "pid": st.proc.pid if st.proc is not None else None,
+                    "restarts": st.restarts,
+                    "finished": st.finished,
+                    "gave_up": st.gave_up,
+                }
+                for wid, st in self._workers.items()
+            }
+
+    @property
+    def coordinator_address(self) -> Optional[str]:
+        if self._coord is None:
+            return None
+        return f"{self._coord.host}:{self._coord.port}"
+
+    # -- internals ---------------------------------------------------------
+
+    def _spawn_locked(self, st: _WorkerState) -> bool:
+        """Spawn (or respawn) one worker. A Popen failure (fork EAGAIN
+        under memory pressure, ENOENT after a deploy replaced the
+        binary) counts as an immediate worker failure against the
+        restart policy — it must NEVER propagate: an exception here
+        would kill the watcher thread and silently disable ALL
+        supervision."""
+        env = dict(os.environ)
+        if st.spec.env:
+            env.update(st.spec.env)
+        env[_ID_ENV] = st.spec.worker_id
+        if self._coord is not None:
+            env[_ADDR_ENV] = f"{self._coord.host}:{self._coord.port}"
+        try:
+            st.proc = subprocess.Popen(
+                list(st.spec.argv), env=env, cwd=st.spec.cwd
+            )
+        except OSError:
+            st.proc = None
+            now = time.monotonic()
+            st.failure_times.append(now)
+            st.consecutive_failures += 1
+            if self._policy.window_s is not None:
+                st.failure_times = [
+                    t for t in st.failure_times
+                    if now - t <= self._policy.window_s
+                ]
+            if len(st.failure_times) > self._policy.max_restarts:
+                st.gave_up = True
+                st.restart_at = None
+            else:
+                st.restart_at = now + self._policy.backoff(
+                    st.consecutive_failures
+                )
+            return False
+        st.spawned_at = time.monotonic()
+        st.restart_at = None
+        return True
+
+    def _on_heartbeat_dead(self, worker_id: str) -> None:
+        """A worker stopped beating. If its process is still alive it is
+        wedged (hung device call, deadlock): kill it — the watcher then
+        sees the exit and takes the normal restart path. A process
+        already dead is the watcher's job; nothing to do here."""
+        with self._mu:
+            st = self._workers.get(worker_id)
+            if st is None or self._closing or st.finished or st.gave_up:
+                return
+            proc = st.proc
+            spawned_at = st.spawned_at
+        last = (
+            self._coord.last_seen(worker_id)
+            if self._coord is not None
+            else None
+        )
+        if last is not None and last < spawned_at:
+            # the silence belongs to a PREVIOUS incarnation (e.g. it was
+            # just killed and respawned): the current one hasn't had a
+            # chance to beat yet — the watcher's first-beat deadline
+            # covers it, and killing it here would cycle restarts forever
+            return
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+
+    def _watch(self) -> None:
+        while True:
+            give_up: List[str] = []
+            restarted: List[str] = []
+            with self._mu:
+                if self._closing:
+                    return
+                now = time.monotonic()
+                for wid, st in self._workers.items():
+                    if st.gave_up:
+                        if not st.gave_up_notified:
+                            st.gave_up_notified = True
+                            if self._coord is not None:
+                                self._coord.remove(wid)
+                            give_up.append(wid)
+                        continue
+                    if st.finished:
+                        continue
+                    if st.restart_at is not None:
+                        if now >= st.restart_at:
+                            if self._spawn_locked(st):
+                                st.restarts += 1
+                                restarted.append(wid)
+                            # a failed respawn re-arms restart_at (or
+                            # gives up) inside _spawn_locked
+                        continue
+                    proc = st.proc
+                    if proc is None or proc.poll() is None:
+                        if (
+                            proc is not None
+                            and st.consecutive_failures > 0
+                            and now - st.spawned_at
+                            > self._policy.reset_after_s
+                        ):
+                            st.consecutive_failures = 0
+                        last = (
+                            self._coord.last_seen(wid)
+                            if self._coord is not None
+                            else None
+                        )
+                        if (
+                            proc is not None
+                            and self._coord is not None
+                            and (last is None or last < st.spawned_at)
+                            and now - st.spawned_at
+                            > self._first_beat_timeout
+                        ):
+                            # spawned, alive, and THIS incarnation has
+                            # never beaten (a beat predating spawned_at
+                            # belongs to a previous one): wedged before
+                            # its first heartbeat — the on_dead path only
+                            # covers live beats. Kill it; the exit takes
+                            # the normal restart path next sweep.
+                            try:
+                                proc.send_signal(signal.SIGKILL)
+                            except OSError:
+                                pass
+                        continue
+                    rc = proc.returncode
+                    if rc == 0:
+                        st.finished = True
+                        if self._coord is not None:
+                            self._coord.remove(wid)
+                        continue
+                    # failed: count against the policy window
+                    st.failure_times.append(now)
+                    st.consecutive_failures += 1
+                    if self._policy.window_s is not None:
+                        st.failure_times = [
+                            t for t in st.failure_times
+                            if now - t <= self._policy.window_s
+                        ]
+                    if len(st.failure_times) > self._policy.max_restarts:
+                        st.gave_up = True
+                        st.gave_up_notified = True
+                        if self._coord is not None:
+                            self._coord.remove(wid)
+                        give_up.append(wid)
+                        continue
+                    st.restart_at = now + self._policy.backoff(
+                        st.consecutive_failures
+                    )
+            # callbacks outside the lock: they may inspect status()
+            for wid in restarted:
+                if self._on_restart is not None:
+                    try:
+                        self._on_restart(
+                            wid, self._workers[wid].restarts
+                        )
+                    except Exception:
+                        pass
+            for wid in give_up:
+                if self._on_give_up is not None:
+                    try:
+                        self._on_give_up(wid)
+                    except Exception:
+                        pass
+            time.sleep(self._poll_interval)

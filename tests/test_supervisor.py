@@ -1,0 +1,319 @@
+"""runtime/supervisor.py: automatic restart-from-checkpoint (SURVEY.md
+§6 "Failure detection / elastic recovery", recovery half).
+
+The flagship drill is the last test: a worker process scoring a GBM
+over a real Kafka wire stream is SIGKILLed mid-stream; the supervisor
+detects the death and respawns it with NO operator action; the worker
+restores the committed offset from its checkpoint and drains the rest;
+the merged emission log proves exactly-once per committed offset
+(records below the restore point appear exactly once; duplicates exist
+only in the uncommitted replay window — the at-least-once tail).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # process-spawning drills (-m 'not slow' = fast inner loop)
+
+from flink_jpmml_tpu.runtime.supervisor import (
+    RestartPolicy, Supervisor, WorkerSpec,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _py(body: str) -> list:
+    return [sys.executable, "-c", textwrap.dedent(body)]
+
+
+def _wait(pred, timeout_s: float, interval_s: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+class TestRestartPolicy:
+    def test_backoff_grows_and_caps(self):
+        p = RestartPolicy(
+            backoff_s=0.5, backoff_multiplier=2.0, max_backoff_s=3.0
+        )
+        assert p.backoff(1) == 0.5
+        assert p.backoff(2) == 1.0
+        assert p.backoff(3) == 2.0
+        assert p.backoff(4) == 3.0  # capped
+        assert p.backoff(10) == 3.0
+
+
+class TestSupervisorUnit:
+    def test_clean_exit_is_finished_not_restarted(self):
+        sup = Supervisor(
+            [WorkerSpec("w0", _py("pass"))],
+            policy=RestartPolicy(backoff_s=0.01),
+            heartbeat_timeout_s=None,
+        )
+        sup.start()
+        try:
+            assert _wait(lambda: sup.status()["w0"]["finished"], 10.0)
+            time.sleep(0.2)
+            st = sup.status()["w0"]
+            assert st["restarts"] == 0 and not st["gave_up"]
+        finally:
+            sup.stop()
+
+    def test_crash_restarts_then_gives_up(self):
+        gave_up = []
+        sup = Supervisor(
+            [WorkerSpec("w0", _py("import sys; sys.exit(3)"))],
+            policy=RestartPolicy(max_restarts=2, backoff_s=0.01),
+            heartbeat_timeout_s=None,
+            on_give_up=gave_up.append,
+        )
+        sup.start()
+        try:
+            assert _wait(lambda: sup.status()["w0"]["gave_up"], 15.0)
+            st = sup.status()["w0"]
+            # max_restarts=2: initial + 2 respawns all failed, then stop
+            assert st["restarts"] == 2
+            assert gave_up == ["w0"]
+        finally:
+            sup.stop()
+
+    def test_failure_rate_window_forgives_old_failures(self, tmp_path):
+        # worker crashes once, then (second incarnation) runs forever:
+        # inside a window policy the early failure ages out of the
+        # budget instead of counting against it for the process lifetime
+        flag = tmp_path / "crashed-once"
+        body = f"""
+        import os, time, sys
+        flag = {str(flag)!r}
+        if not os.path.exists(flag):
+            open(flag, "w").close()
+            sys.exit(1)
+        time.sleep(60)
+        """
+        sup = Supervisor(
+            [WorkerSpec("w0", _py(body))],
+            policy=RestartPolicy(
+                max_restarts=1, backoff_s=0.01, window_s=5.0
+            ),
+            heartbeat_timeout_s=None,
+        )
+        sup.start()
+        try:
+            assert _wait(lambda: sup.status()["w0"]["restarts"] == 1, 10.0)
+            time.sleep(0.3)
+            st = sup.status()["w0"]
+            assert st["alive"] and not st["gave_up"]
+        finally:
+            sup.stop()
+
+    def test_two_workers_independent(self):
+        sup = Supervisor(
+            [
+                WorkerSpec("crasher", _py("import sys; sys.exit(2)")),
+                WorkerSpec("steady", _py("import time; time.sleep(60)")),
+            ],
+            policy=RestartPolicy(max_restarts=1, backoff_s=0.01),
+            heartbeat_timeout_s=None,
+        )
+        sup.start()
+        try:
+            assert _wait(
+                lambda: sup.status()["crasher"]["gave_up"], 15.0
+            )
+            st = sup.status()
+            assert st["steady"]["alive"] and not st["steady"]["gave_up"]
+        finally:
+            sup.stop()
+
+
+class TestHeartbeatKill:
+    def test_wedged_worker_is_killed_and_restarted(self, tmp_path):
+        # incarnation 1 never beats (a wedged device call: alive but
+        # silent) -> heartbeat death -> supervisor SIGKILLs it -> the
+        # respawned incarnation beats and stays up
+        flag = tmp_path / "wedged-once"
+        body = f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        from flink_jpmml_tpu.runtime.supervisor import reporter_from_env
+        flag = {str(flag)!r}
+        if not os.path.exists(flag):
+            open(flag, "w").close()
+            time.sleep(120)  # wedged: no heartbeat, no exit
+        rep = reporter_from_env()
+        assert rep is not None
+        time.sleep(120)  # healthy half: beats in the background
+        """
+        sup = Supervisor(
+            [WorkerSpec("w0", _py(body))],
+            policy=RestartPolicy(max_restarts=5, backoff_s=0.01),
+            heartbeat_timeout_s=1.0,
+            # must exceed worker STARTUP (package import) time — a
+            # too-tight first-beat deadline kills workers mid-import
+            first_beat_timeout_s=6.0,
+        )
+        sup.start()
+        try:
+            assert _wait(
+                lambda: sup.status()["w0"]["restarts"] >= 1, 30.0
+            ), sup.status()
+
+            def alive_and_beating():
+                st = sup.status()["w0"]
+                return st["alive"] and not st["gave_up"]
+
+            assert _wait(alive_and_beating, 15.0), sup.status()
+            # the healthy incarnation beats: it must NOT be killed again
+            settled = sup.status()["w0"]["restarts"]
+            time.sleep(2.5)
+            st = sup.status()["w0"]
+            assert st["alive"] and st["restarts"] == settled
+        finally:
+            sup.stop()
+
+
+_DRILL_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.runtime.block import BlockPipeline
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.runtime.kafka import KafkaBlockSource
+from flink_jpmml_tpu.runtime.supervisor import reporter_from_env
+from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+host, port, topic, pmml, ckdir, outfile, total = (
+    sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], sys.argv[6], int(sys.argv[7]),
+)
+rep = reporter_from_env()
+doc = parse_pmml_file(pmml)
+cm = compile_pmml(doc, batch_size=64)
+out = open(outfile, "a", buffering=1)
+
+def sink(o, n, first_off):
+    out.write(f"E {{first_off}} {{n}}\\n")
+
+src = KafkaBlockSource(host, port, topic, n_cols=5, max_wait_ms=20)
+pipe = BlockPipeline(
+    src, cm, sink,
+    RuntimeConfig(
+        batch=BatchConfig(size=64, deadline_us=2000),
+        checkpoint_interval_s=0.05,
+    ),
+    checkpoint=CheckpointManager(ckdir),
+)
+restored = pipe.restore()
+out.write(f"R {{pipe.committed_offset if restored else 0}}\\n")
+pipe.start()
+while pipe.committed_offset < total:
+    time.sleep(0.01)
+pipe.stop(); pipe.join(timeout=30.0)
+src.close()
+out.close()
+"""
+
+
+class TestKillResumeDrill:
+    def test_kill9_auto_restart_resumes_exactly(self, tmp_path):
+        from assets.generate import gen_gbm
+        from flink_jpmml_tpu.runtime.kafka import MiniKafkaBroker
+
+        pmml = gen_gbm(str(tmp_path), n_trees=10, depth=3, n_features=5)
+        rng = np.random.default_rng(5)
+        N = 4000
+        data = rng.normal(0, 1.5, size=(N, 5)).astype(np.float32)
+        outfile = tmp_path / "emissions.log"
+        outfile.touch()
+        ckdir = tmp_path / "ck"
+
+        broker = MiniKafkaBroker(topic="drill")
+        sup = None
+        try:
+            broker.append_rows(data)
+            spec = WorkerSpec(
+                "scorer",
+                [
+                    sys.executable, "-c",
+                    _DRILL_WORKER.format(repo=REPO),
+                    broker.host, str(broker.port), "drill", pmml,
+                    str(ckdir), str(outfile), str(N),
+                ],
+            )
+            sup = Supervisor(
+                [spec],
+                policy=RestartPolicy(max_restarts=3, backoff_s=0.05),
+                heartbeat_timeout_s=2.0,
+            )
+            sup.start()
+
+            def committed():
+                try:
+                    from flink_jpmml_tpu.runtime.checkpoint import (
+                        CheckpointManager,
+                    )
+                    st = CheckpointManager(str(ckdir)).load_latest()
+                    return st["source_offset"] if st else 0
+                except Exception:
+                    return 0
+
+            # let it commit real progress, then kill -9 mid-stream
+            assert _wait(lambda: 0 < committed() < N, 60.0, 0.05), (
+                "worker never committed progress"
+            )
+            pid = sup.status()["scorer"]["pid"]
+            os.kill(pid, signal.SIGKILL)
+
+            # NO operator action from here on: the supervisor restarts
+            # the worker, which resumes from its checkpoint and drains
+            assert _wait(
+                lambda: sup.status()["scorer"]["finished"], 120.0, 0.1
+            ), f"drill did not finish: {sup.status()}"
+            assert sup.status()["scorer"]["restarts"] >= 1
+        finally:
+            if sup is not None:
+                sup.stop()
+            broker.close()
+
+        # ---- exactly-once per committed offset ----
+        emitted = []   # (first_off, n) per sink call, in order
+        restores = []  # committed offset each incarnation started from
+        for ln in outfile.read_text().splitlines():
+            kind, *rest = ln.split()
+            if kind == "E":
+                emitted.append((int(rest[0]), int(rest[1])))
+            elif kind == "R":
+                restores.append(int(rest[0]))
+        assert restores[0] == 0 and len(restores) >= 2
+        c = restores[-1]  # the post-kill incarnation's restore point
+        assert 0 < c < N
+        covered = np.zeros(N, np.int64)
+        for off, n in emitted:
+            covered[off : off + n] += 1
+        # no gaps anywhere; below the restore point exactly once;
+        # duplicates confined to the uncommitted replay window
+        assert (covered >= 1).all(), (
+            f"gaps at {np.flatnonzero(covered == 0)[:5]}"
+        )
+        assert (covered[:c] == 1).all(), (
+            f"dups below restore point at "
+            f"{np.flatnonzero(covered[:c] > 1)[:5]}"
+        )
+        assert (covered <= 2).all()
