@@ -53,15 +53,26 @@ def main() -> None:
 
     workdir = tempfile.mkdtemp(prefix="fjt-stacked-")
     pmml = gen_stacked(
-        workdir, n_trees=args.trees, depth=4, n_features=args.features
+        workdir, n_trees=args.trees, depth=4, n_features=args.features,
+        wide_lr=True,  # the full config-5 shape: GBM + wide LR + calibration
     )
     doc = parse_pmml_file(pmml)
-    cm = compile_pmml(doc)
 
     import jax
 
-    mesh = make_mesh()
-    print(f"mesh: {mesh.shape} over {len(jax.devices())} devices")
+    from flink_jpmml_tpu.utils.config import MeshConfig
+
+    n = len(jax.devices())
+    # data x model mesh: the wide LR stage feature-shards over `model`
+    n_model = 2 if n % 2 == 0 and n >= 2 else 1
+    mesh = make_mesh(MeshConfig(data=n // n_model, model=n_model))
+    print(f"mesh: {mesh.shape} over {n} devices")
+
+    # mesh-aware compile: the wide stage's [F] coefficient tensors are
+    # feature-sharded INSIDE the compiled scorer (GSPMD inserts the
+    # tp_linear-style partial-matmul + psum); narrow params replicate
+    sharded = compile_pmml(doc, mesh=mesh)
+    print(f"TP-sharded param leaves: {list(sharded.tp_sharded_leaves) or '(pure-DP mesh)'}")
 
     rng = np.random.default_rng(0)
     # sparse-ish stream: most features zero, a few hot
@@ -72,12 +83,19 @@ def main() -> None:
     )
     M = np.zeros_like(X, bool)
 
-    sharded = dp_sharded(cm, mesh)
     out = sharded.predict(X, M)
     values = np.asarray(out.value)
     print(f"scored {args.batch} x {args.features}-dim records "
-          f"(batch axis sharded {mesh.shape}); "
+          f"(batch sharded over data, wide-LR features over model, "
+          f"{mesh.shape}); "
           f"calibrated score range [{values.min():.4f}, {values.max():.4f}]")
+
+    # plain DP on the same document stays available (params replicated)
+    dp = dp_sharded(compile_pmml(doc), mesh)
+    np.testing.assert_allclose(
+        np.asarray(dp.predict(X, M).value), values, rtol=2e-5, atol=1e-6
+    )
+    print("DP-replicated predict agrees with the TP-sharded compile")
 
 
 if __name__ == "__main__":

@@ -43,13 +43,20 @@ class ModelReader:
         config: Optional[CompileConfig] = None,
         warmup: bool = False,
         verify: bool = True,
+        mesh=None,
     ) -> CompiledModel:
         """``verify=True`` (default) replays any embedded
         <ModelVerification> vectors through the compiled model and
         raises :class:`ModelVerificationException` on mismatch — a model
         whose own test vectors fail must not serve (JPMML's
         ``Evaluator.verify()`` contract). Documents without embedded
-        vectors load unconditionally."""
+        vectors load unconditionally.
+
+        ``mesh`` (a ``jax.sharding.Mesh``) loads the model mesh-aware —
+        a :class:`~flink_jpmml_tpu.parallel.sharding.ShardedModel` with
+        the batch sharded over ``data`` and wide params over ``model``
+        (the slice serving path); cached per mesh like any other compile
+        axis."""
         local_path, token = remote.fetch(self.path)
         key = (
             self.path if remote.is_remote(self.path)
@@ -57,6 +64,7 @@ class ModelReader:
             token,
             batch_size,
             config,
+            mesh,  # jax.sharding.Mesh is hashable; None = single-device
         )
         with _cache_lock:
             cached = _cache.get(key)
@@ -71,7 +79,9 @@ class ModelReader:
                     _verified.add(key)
             return cached
         doc = parse_pmml_file(local_path)
-        model = compile_pmml(doc, batch_size=batch_size, config=config)
+        model = compile_pmml(
+            doc, batch_size=batch_size, config=config, mesh=mesh
+        )
         if verify and model.has_verification:
             self._verify(model)
         if warmup:

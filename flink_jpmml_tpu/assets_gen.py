@@ -338,7 +338,14 @@ def gen_stacked(
     n_features: int = 64,
     seed: int = 23,
     name: str = "stacked.pmml",
+    wide_lr: bool = False,
 ) -> str:
+    """Config 5's stacked modelChain. ``wide_lr=True`` is the full
+    BASELINE shape — "GBM + LR calibration, 10k-dim sparse features,
+    sharded": an extra chain stage scores a linear model over ALL raw
+    features (one [F]-wide coefficient vector — the tensor
+    ``mesh_sharded`` feature-shards over the ``model`` axis), and the
+    final calibration combines gbm_score + lr_score."""
     rng = np.random.default_rng(seed)
     fields = tuple(f"f{i}" for i in range(n_features))
     root = _pmml_root()
@@ -382,7 +389,35 @@ def gen_stacked(
         ET.SubElement(root_node, "True")
         _gen_tree_nodes(root_node, rng, n_features, depth, _counter(), 0.2)
 
-    # Segment 2: logistic calibration over gbm_score
+    if wide_lr:
+        # Segment 2: the wide linear stage — every raw feature carries a
+        # small coefficient (the 10k-dim sparse LR of config 5)
+        sw = ET.SubElement(seg, "Segment", {"id": "wide-lr"})
+        ET.SubElement(sw, "True")
+        wlr = ET.SubElement(
+            sw,
+            "RegressionModel",
+            {"functionName": "regression", "modelName": "wide-lr"},
+        )
+        outw = ET.SubElement(wlr, "Output")
+        ET.SubElement(
+            outw,
+            "OutputField",
+            {"name": "lr_score", "feature": "predictedValue"},
+        )
+        _mining_schema(wlr, fields)
+        wtable = ET.SubElement(
+            wlr, "RegressionTable", {"intercept": _fmt(0.05)}
+        )
+        coefs = rng.normal(0.0, 0.02, size=n_features)
+        for f, c in zip(fields, coefs):
+            ET.SubElement(
+                wtable,
+                "NumericPredictor",
+                {"name": f, "coefficient": _fmt(c)},
+            )
+
+    # Final segment: logistic calibration over the chained scores
     s2 = ET.SubElement(seg, "Segment", {"id": "calibrate"})
     ET.SubElement(s2, "True")
     lr = ET.SubElement(
@@ -402,6 +437,13 @@ def gen_stacked(
         "NumericPredictor",
         {"name": "gbm_score", "coefficient": _fmt(1.7)},
     )
+    if wide_lr:
+        ET.SubElement(ms, "MiningField", {"name": "lr_score", "usageType": "active"})
+        ET.SubElement(
+            table,
+            "NumericPredictor",
+            {"name": "lr_score", "coefficient": _fmt(0.9)},
+        )
     return _write(root, os.path.join(out_dir, name))
 
 

@@ -386,12 +386,20 @@ def compile_pmml(
     batch_size: Optional[int] = None,
     config: Optional[CompileConfig] = None,
     donate: Optional[bool] = None,
-) -> CompiledModel:
+    mesh=None,
+):
     """Parse-tree → jitted scorer (capability C1 + the north-star hot path).
 
     ``batch_size`` fixes the traced batch shape (None = shape-polymorphic:
     jit re-traces per distinct batch size — fine for tests, wrong for the
     streaming runtime, which always pads to a fixed size).
+
+    ``mesh`` (a ``jax.sharding.Mesh``, BASELINE config 5): returns a
+    :class:`~flink_jpmml_tpu.parallel.sharding.ShardedModel` instead —
+    batch sharded over ``data``, any param tensor at least
+    ``config.tp_wide_threshold`` wide feature-sharded over ``model``
+    (the stacked model's 10k-dim linear stage compiles to a local
+    partial matmul + one psum over ICI; see ``mesh_sharded``).
     """
     config = config or CompileConfig()
     fields = doc.active_fields
@@ -560,7 +568,7 @@ def compile_pmml(
             doc.model.n_neighbors,
             len(lowered.labels),
         )
-    return CompiledModel(
+    compiled = CompiledModel(
         field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
         labels=lowered.labels,
         params=jax.device_put(lowered.params),
@@ -580,3 +588,10 @@ def compile_pmml(
         _entity_order=entity_order,
         _neighbor_meta=neighbor_meta,
     )
+    if mesh is not None:
+        from flink_jpmml_tpu.parallel.sharding import mesh_sharded
+
+        return mesh_sharded(
+            compiled, mesh, wide_threshold=config.tp_wide_threshold
+        )
+    return compiled

@@ -35,12 +35,16 @@ from flink_jpmml_tpu.utils.exceptions import InputValidationException
 @dataclass
 class ShardedModel:
     """A CompiledModel re-jitted for a mesh: same predict contract, batch
-    sharded over ``data``, params replicated."""
+    sharded over ``data``; params replicated (:func:`dp_sharded`) or
+    feature-sharded over ``model`` where wide (:func:`mesh_sharded`)."""
 
     base: CompiledModel
     mesh: Mesh
     _jit_fn: object
     _params_sharded: object
+    # names of param leaves sharded over the model axis ("" = none):
+    # observability for tests/dryruns asserting the TP path is real
+    tp_sharded_leaves: tuple = ()
 
     @property
     def batch_divisor(self) -> int:
@@ -57,6 +61,42 @@ class ShardedModel:
     def decode(self, out: ModelOutput, n: Optional[int] = None):
         return self.base.decode(out, n)
 
+    def warmup(self) -> "ShardedModel":
+        b = self.base.batch_size or self.batch_divisor
+        b += (-b) % self.batch_divisor
+        X = np.zeros((b, self.field_space.arity), np.float32)
+        M = np.zeros((b, self.field_space.arity), bool)
+        jax.block_until_ready(self.predict(X, M))
+        return self
+
+    # -- convenience wrappers (CompiledModel parity for serving/tests) ----
+
+    def score_records(self, records):
+        from flink_jpmml_tpu.compile import prepare
+
+        X, M = prepare.from_records(self.field_space, records)
+        return self._score(X, M, n=X.shape[0])
+
+    def score_dense(self, vectors, replace_nan: Optional[float] = None):
+        from flink_jpmml_tpu.compile import prepare
+
+        X, M = prepare.from_dense(self.field_space, vectors, replace_nan)
+        return self._score(X, M, n=X.shape[0])
+
+    def _score(self, X, M, n: int):
+        from flink_jpmml_tpu.compile import prepare
+
+        target = self.base.batch_size or X.shape[0]
+        target += (-target) % self.batch_divisor  # mesh-divisible pad
+        X, M, _ = prepare.pad_batch(X, M, target)
+        return self.decode(self.predict(X, M), n)
+
+    def quantized_scorer(self):
+        """The rank-wire fast path is single-device only for now: a
+        sharded serving plane scores on the f32 path (None here keeps
+        the BlockPipeline fallback contract)."""
+        return None
+
     @property
     def field_space(self):
         return self.base.field_space
@@ -72,6 +112,41 @@ class ShardedModel:
     @property
     def is_classification(self):
         return self.base.is_classification
+
+    @property
+    def model_name(self):
+        return self.base.model_name
+
+    @property
+    def output_fields(self):
+        return self.base.output_fields
+
+    @property
+    def active_fields(self):
+        return self.base.active_fields
+
+    @property
+    def _verification(self):
+        return self.base._verification
+
+    @property
+    def _target_field(self):
+        return self.base._target_field
+
+    @property
+    def has_verification(self) -> bool:
+        return self.base.has_verification
+
+    def verify(self):
+        """Replay embedded <ModelVerification> vectors through the
+        SHARDED jit — the computation that will actually serve. The
+        GSPMD re-jit (in/out shardings, TP partitioning of wide leaves)
+        is precisely the kind of transformation the vectors exist to
+        validate; delegating to the unsharded base would check a code
+        path the sharded model never uses."""
+        from flink_jpmml_tpu.compile.verify import run_verification
+
+        return run_verification(self, self.base._target_field)
 
 
 def dp_sharded(model: CompiledModel, mesh: Mesh) -> ShardedModel:
@@ -106,6 +181,87 @@ def dp_sharded(model: CompiledModel, mesh: Mesh) -> ShardedModel:
     )
     return ShardedModel(
         base=model, mesh=mesh, _jit_fn=jit_fn, _params_sharded=params_sharded
+    )
+
+
+def mesh_sharded(
+    model: CompiledModel,
+    mesh: Mesh,
+    wide_threshold: Optional[int] = None,
+) -> ShardedModel:
+    """DP over the batch axis + 1-D feature TP over wide param tensors
+    (BASELINE config 5: the stacked model's 10k-dim linear stage).
+
+    The compiled graph is re-jitted with *sharding constraints*, the
+    GSPMD recipe (scaling-book): the batch rides ``P(data)``; any param
+    leaf whose leading dimension is ≥ ``wide_threshold`` (and divisible
+    by the model-axis size) gets ``P(model, …)`` on that dimension —
+    a wide RegressionTable's ``num_coefs``/``cat_codes``/``cat_coefs``
+    vectors, a wide first-layer NN weight. XLA then partitions the
+    contracting dot exactly like the hand-written :func:`tp_linear`
+    (local partial matmul + one psum over the ``model`` axis on ICI) —
+    same collectives, derived by the partitioner instead of spelled out
+    per model family, so EVERY lowering that consumes the wide leaf
+    (chain stages included) shards without bespoke code.
+
+    Narrow params replicate; a pure-DP mesh (model axis 1) degrades to
+    exactly :func:`dp_sharded`.
+    """
+    if wide_threshold is None:
+        from flink_jpmml_tpu.utils.config import CompileConfig
+
+        wide_threshold = CompileConfig().tp_wide_threshold
+    n_model = mesh.shape[MODEL_AXIS]
+    batch_spec = NamedSharding(mesh, P(DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(model.params)
+    specs = {}
+    tp_leaves = []
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        wide = (
+            n_model > 1
+            and arr.ndim >= 1
+            and arr.shape[0] >= wide_threshold
+            and arr.shape[0] % n_model == 0
+        )
+        if wide:
+            specs[path] = NamedSharding(
+                mesh, P(MODEL_AXIS, *([None] * (arr.ndim - 1)))
+            )
+            tp_leaves.append(jax.tree_util.keystr(path))
+        else:
+            specs[path] = repl
+
+    def _place(path, x):
+        arr = np.asarray(x)
+        s = specs[path]
+        # make_array_from_callback serves local index slices even when
+        # the mesh spans processes (cf. dp_sharded._replicate)
+        return jax.make_array_from_callback(
+            arr.shape, s, lambda idx: arr[idx]
+        )
+
+    params_sharded = jax.tree_util.tree_unflatten(
+        treedef, [_place(p, leaf) for p, leaf in flat]
+    )
+    in_params_spec = jax.tree_util.tree_unflatten(
+        treedef, [specs[p] for p, _ in flat]
+    )
+    inner = model._jit_fn
+    fn = getattr(inner, "__wrapped__", inner)
+    jit_fn = jax.jit(
+        fn,
+        in_shardings=(in_params_spec, batch_spec, batch_spec),
+        out_shardings=batch_spec,
+    )
+    return ShardedModel(
+        base=model,
+        mesh=mesh,
+        _jit_fn=jit_fn,
+        _params_sharded=params_sharded,
+        tp_sharded_leaves=tuple(tp_leaves),
     )
 
 

@@ -92,11 +92,20 @@ class DynamicBlockPipeline(BlockPipelineBase):
         checkpoint=None,
         hold_poll_s: float = 0.005,
         drain_hold_timeout_s: float = 5.0,
+        mesh=None,
     ):
         if batch_size <= 0:
             raise InputValidationException(
                 f"batch_size must be positive: {batch_size}"
             )
+        if mesh is not None:
+            n_data = mesh.shape.get("data", 1)
+            if batch_size % max(n_data, 1) != 0:
+                raise InputValidationException(
+                    f"batch_size {batch_size} must divide by the mesh "
+                    f"data-axis size {n_data} (sharded dispatch pads to "
+                    "the batch, which must split evenly across devices)"
+                )
         super().__init__(
             source=source,
             sink=sink,
@@ -114,7 +123,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
         self._hold_poll_s = hold_poll_s
         self._drain_hold_timeout_s = drain_hold_timeout_s
         self.registry = ModelRegistry(
-            batch_size=batch_size, compile_config=compile_config
+            batch_size=batch_size, compile_config=compile_config, mesh=mesh
         )
         self._current: Optional[BoundScorer] = None
         self._rejected: set = set()  # arity-mismatched served ids

@@ -64,7 +64,13 @@ class ModelRegistry:
         async_warmup: bool = True,
         warm_workers: int = 3,
         warm_join_timeout_s: float = 300.0,
+        mesh=None,
     ):
+        """``mesh`` (a ``jax.sharding.Mesh``) makes every load/warm
+        produce a mesh-aware ``ShardedModel`` (same predict/decode
+        surface as ``CompiledModel``): dynamic swaps on a slice re-jit
+        the incoming version for the mesh during the background warm, so
+        the swap itself stays compile-free (C6 on a mesh)."""
         self._meta: managers.Metadata = {}
         self._compiled: Dict[ModelId, CompiledModel] = {}
         self._warming: Dict[ModelId, _WarmTask] = {}
@@ -72,6 +78,7 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._batch_size = batch_size
         self._compile_config = compile_config
+        self._mesh = mesh
         self._async = async_warmup
         # warms run on a small bounded pool, not a thread per model: a
         # restore() of a registry serving many models must not trigger a
@@ -192,7 +199,9 @@ class ModelRegistry:
 
     def _load(self, info: ModelInfo) -> CompiledModel:
         return ModelReader(info.path).load(
-            batch_size=self._batch_size, config=self._compile_config
+            batch_size=self._batch_size,
+            config=self._compile_config,
+            mesh=self._mesh,
         )
 
     def _prewarm(self, compiled: CompiledModel) -> None:

@@ -58,21 +58,26 @@ class DynamicScorer(Scorer):
         emit_pairs: bool = True,
         emit: Optional[Callable[[Sequence[Any], List[Prediction]], List[Any]]] = None,
         async_warmup: bool = True,
+        mesh=None,
     ):
         """``async_warmup=False`` disables background warming: a newly
         Added model compiles synchronously inside ``submit`` on its first
         matching event (the reference's operator-blocking lazy load) —
         kept for comparison/tests; the default never stalls the batch
-        loop on a compile."""
+        loop on a compile. ``mesh`` serves every model (default
+        included) mesh-aware — see :class:`ModelRegistry`."""
         self.registry = ModelRegistry(
             batch_size=batch_size,
             compile_config=compile_config,
             async_warmup=async_warmup,
+            mesh=mesh,
         )
         self._control = control
         self._route = route or default_route
         self._default_model = (
-            default_reader.load(batch_size=batch_size, config=compile_config)
+            default_reader.load(
+                batch_size=batch_size, config=compile_config, mesh=mesh
+            )
             if default_reader is not None
             else None
         )

