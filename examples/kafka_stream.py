@@ -73,6 +73,7 @@ def main() -> None:
         src = KafkaBlockSource(
             broker.host, broker.port, "features", n_cols=8, max_wait_ms=20,
             partitions=list(range(args.partitions)),
+            interleave="strict",  # round-robin producer below: the exact-seek fast path
         )
         return src, BlockPipeline(
             src, cm, sink, cfg, checkpoint=CheckpointManager(ckdir)

@@ -301,16 +301,33 @@ class BlockPipelineBase:
         return not isinstance(self._ring, _PyRing)
 
     def _ckpt_state(self) -> dict:
-        return {"source_offset": self.committed_offset}
+        state = {"source_offset": self.committed_offset}
+        # sources whose resume needs more than the scalar offset (e.g.
+        # multi-partition Kafka's per-partition cursor vector) embed it
+        # via the checkpoint_state/restore_state hooks
+        snap = getattr(self._source, "checkpoint_state", None)
+        if snap is not None:
+            extra = snap(self.committed_offset)
+            if extra is not None:
+                state["source_state"] = extra
+        return state
 
     def restore(self) -> bool:
         """Resume from the latest checkpoint: seek the source to the last
-        committed record offset (commit happens after sink, C7)."""
+        committed record offset (commit happens after sink, C7). A
+        source-state payload (per-partition offset vector) takes
+        precedence — its effective resume offset may sit one emission
+        boundary below the scalar commit (at-least-once replay)."""
         state = self._ckpt.restore_latest()
         if state is None:
             return False
         off = int(state.get("source_offset", 0))
-        self._source.seek(off)
+        sstate = state.get("source_state")
+        rst = getattr(self._source, "restore_state", None)
+        if sstate is not None and rst is not None:
+            off = int(rst(sstate))
+        else:
+            self._source.seek(off)
         self.committed_offset = off
         self._restore_extra(state)
         return True

@@ -179,10 +179,18 @@ class Pipeline:
         self._error: Optional[BaseException] = None
 
     def _ckpt_state(self) -> dict:
-        return {
+        state = {
             "source_offset": self._committed_offset,
             "scorer": self._scorer.state(),
         }
+        # cf. BlockPipelineBase._ckpt_state: vector-resume sources embed
+        # their per-partition cursor snapshot alongside the scalar
+        snap = getattr(self._source, "checkpoint_state", None)
+        if snap is not None:
+            extra = snap(self._committed_offset)
+            if extra is not None:
+                state["source_state"] = extra
+        return state
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -191,8 +199,14 @@ class Pipeline:
         state = self._ckpt.restore_latest()
         if state is None:
             return False
-        self._source.seek(state.get("source_offset", 0))
-        self._committed_offset = state.get("source_offset", 0)
+        off = int(state.get("source_offset", 0))
+        sstate = state.get("source_state")
+        rst = getattr(self._source, "restore_state", None)
+        if sstate is not None and rst is not None:
+            off = int(rst(sstate))
+        else:
+            self._source.seek(off)
+        self._committed_offset = off
         self._scorer.restore(state.get("scorer", {}))
         return True
 

@@ -22,10 +22,14 @@ partitioner (parallel/partitioner.py) routes records to workers, so
 group coordination (JoinGroup/SyncGroup/OffsetCommit) is not needed;
 checkpoints own the offsets (capability C7), which is also the
 exactly-once-correct place for them. Multi-partition topics are
-consumed via ``partitions=[...]`` as a strict round-robin interleave
-whose single global offset deterministically encodes every partition
-cursor (see ``_KafkaSourceBase``), so the same scalar checkpoint
-resumes N partitions exactly.
+consumed via ``partitions=[...]`` in one of two interleave modes (see
+``_KafkaSourceBase``): the default ``"auto"`` tolerates what real
+brokers serve — keyed producers, uneven partition fill, compaction
+gaps — and checkpoints a per-partition OFFSET VECTOR through the
+engine's ``checkpoint_state``/``restore_state`` hooks; ``"strict"`` is
+the round-robin-bijection fast path whose single scalar offset encodes
+every cursor and reconstructs the producer's global order (requires a
+round-robin producer and gapless partitions).
 
 All integers big-endian per the Kafka protocol; record-batch varints are
 protobuf zigzag.
@@ -335,6 +339,13 @@ class KafkaProtocolError(RuntimeError):
     pass
 
 
+class KafkaPartitionError(KafkaProtocolError):
+    """UNKNOWN_TOPIC_OR_PARTITION (err 3): a misconfiguration, not a
+    transient wire failure — sources re-raise it instead of entering
+    the reconnect-and-retry loop (fail fast, don't poll a phantom
+    partition forever)."""
+
+
 class KafkaClient:
     """Minimal single-connection Kafka client (consumer side).
 
@@ -465,6 +476,11 @@ class KafkaClient:
             for _ in range(r.i32()):
                 r.i32()  # partition
                 err = r.i16()
+                if err == 3:
+                    raise KafkaPartitionError(
+                        f"ListOffsets error 3 (unknown partition "
+                        f"{partition} of topic {topic!r})"
+                    )
                 if err:
                     raise KafkaProtocolError(f"ListOffsets error {err}")
                 r.i64()  # timestamp
@@ -506,6 +522,11 @@ class KafkaClient:
                     r.i64()
                     r.i64()
                 record_set += r.bytes_() or b""
+                if err == 3:
+                    raise KafkaPartitionError(
+                        f"Fetch error 3 (unknown partition {partition} "
+                        f"of topic {topic!r})"
+                    )
                 if err:
                     raise KafkaProtocolError(f"Fetch error {err}")
         return high_watermark, record_set
@@ -541,16 +562,30 @@ class _KafkaSourceBase:
     Single-partition (default): engine offsets ARE Kafka offsets (the
     1:1 domain of the module header).
 
-    Multi-partition (``partitions=[...]``): records are consumed in a
-    STRICT round-robin interleave — global record index g maps to
-    partition ``partitions[g % P]`` at partition offset ``g // P``.
-    Because the map is a bijection, the engine's single checkpointed
-    offset determinstically encodes every per-partition cursor, so
-    ``seek(k)`` resumes all partitions exactly (capability C7) without
-    any extra state. The contract this buys requires a round-robin
-    producer and gapless partitions (no compaction) — the tabular-stream
-    layout; a partition-offset gap raises ``KafkaProtocolError`` rather
-    than silently mis-aligning lanes."""
+    Multi-partition (``partitions=[...]``), two interleave modes:
+
+    - ``interleave="auto"`` (default): records are consumed from
+      whichever partition has data, in round-robin *preference* but
+      never stalling on an empty partition; per-partition cursors
+      advance to the offsets actually observed, so keyed producers
+      (uneven fill) and compacted logs (offset gaps) — what real
+      brokers serve — both work. Resume state is a checkpointed
+      per-partition OFFSET VECTOR: the source snapshots its cursor
+      vector at every emission boundary, the engine checkpoint embeds
+      the newest snapshot ≤ the committed offset
+      (``checkpoint_state``), and ``restore_state`` resumes every
+      partition from it exactly. A commit landing mid-emission resumes
+      from the preceding boundary — strictly less replay than one
+      batch, within the C7 at-least-once contract.
+    - ``interleave="strict"``: the round-robin bijection fast path —
+      global record index g maps to partition ``partitions[g % P]`` at
+      partition offset ``g // P``, so the engine's single scalar offset
+      encodes every cursor and ``seek(k)`` is exact at ANY k. Requires
+      a round-robin producer and gapless partitions (the tabular-stream
+      layout); a partition-offset gap raises ``KafkaProtocolError``
+      rather than silently mis-aligning lanes. Also reconstructs the
+      producer's global record order, which auto mode (arrival order)
+      cannot."""
 
     def __init__(
         self,
@@ -562,6 +597,7 @@ class _KafkaSourceBase:
         start_offset: int = 0,
         max_wait_ms: int = 50,
         reconnect_backoff_s: float = 0.05,
+        interleave: str = "auto",
     ):
         self._client = KafkaClient(host, port)
         self._topic = topic
@@ -570,12 +606,39 @@ class _KafkaSourceBase:
         )
         if len(set(self._parts)) != len(self._parts) or not self._parts:
             raise ValueError(f"bad partition set {self._parts!r}")
+        if interleave not in ("auto", "strict"):
+            raise ValueError(f"bad interleave mode {interleave!r}")
         self._partition = self._parts[0]
+        self._strict = interleave == "strict"
+        if (
+            len(self._parts) > 1
+            and not self._strict
+            and start_offset != 0
+        ):
+            # a scalar start offset has no meaning without the strict
+            # bijection: silently accepting it would relabel records
+            # (global indices shifted by start_offset) without skipping
+            # anything
+            raise ValueError(
+                "start_offset requires interleave='strict' on a "
+                "multi-partition source; auto mode resumes through "
+                "restore_state (per-partition offset vector)"
+            )
         self._next = start_offset  # next Kafka offset (single-partition)
         self._g = start_offset  # next global record index (multi)
         self._bufs: Dict[int, "collections.deque"] = {
             p: collections.deque() for p in self._parts
         }
+        # vector mode: per-partition next-offset cursors + emission-
+        # boundary snapshots (global_end, cursor vector) for checkpoint.
+        # _snap_mu guards snaps/floor: the ingest thread appends while
+        # the score thread's checkpoint_state prunes (block.py runs
+        # poll and _ckpt_state on different threads)
+        self._cursors: Dict[int, int] = {p: 0 for p in self._parts}
+        self._rr = 0  # round-robin preference pointer (auto mode)
+        self._snap_mu = threading.Lock()
+        self._snaps: "collections.deque" = collections.deque()
+        self._snap_floor = (start_offset, dict(self._cursors))
         self._max_wait_ms = max_wait_ms
         self._backoff = reconnect_backoff_s
         self._eos = False
@@ -591,21 +654,35 @@ class _KafkaSourceBase:
         except OSError:
             pass
 
-    def _fetch_part(self, part: int, offset: int) -> List[Tuple[int, bytes]]:
+    def _fetch_part(
+        self, part: int, offset: int, max_wait_ms: Optional[int] = None
+    ) -> List[Tuple[int, bytes]]:
         try:
             _, recs = self._client.fetch(
-                self._topic, part, offset, max_wait_ms=self._max_wait_ms
+                self._topic, part, offset,
+                max_wait_ms=(
+                    self._max_wait_ms if max_wait_ms is None else max_wait_ms
+                ),
             )
+        except KafkaPartitionError:
+            raise  # misconfiguration: fail fast, don't reconnect-loop
         except (OSError, ConnectionError, KafkaProtocolError):
             self._reconnect()
             return []
         return recs
 
-    def _fetch_raw_part(self, part: int, offset: int) -> bytes:
+    def _fetch_raw_part(
+        self, part: int, offset: int, max_wait_ms: Optional[int] = None
+    ) -> bytes:
         try:
             _, raw = self._client.fetch_raw(
-                self._topic, part, offset, max_wait_ms=self._max_wait_ms
+                self._topic, part, offset,
+                max_wait_ms=(
+                    self._max_wait_ms if max_wait_ms is None else max_wait_ms
+                ),
             )
+        except KafkaPartitionError:
+            raise  # misconfiguration: fail fast, don't reconnect-loop
         except (OSError, ConnectionError, KafkaProtocolError):
             self._reconnect()
             return b""
@@ -651,14 +728,94 @@ class _KafkaSourceBase:
     def _multi(self) -> bool:
         return len(self._parts) > 1
 
-    def seek(self, offset: int) -> None:
-        # engine offset k ("k records consumed") == next Kafka offset
-        # (single-partition) / next global index (multi): no +1 bridging
-        # anywhere (cf. net.py header)
-        self._next = offset
-        self._g = offset
+    @property
+    def _vector_mode(self) -> bool:
+        return self._multi and not self._strict
+
+    def _snap(self) -> None:
+        """Record an emission-boundary cursor snapshot (vector mode)."""
+        with self._snap_mu:
+            self._snaps.append((self._g, dict(self._cursors)))
+            # bound memory when nothing ever checkpoints by THINNING —
+            # dropping intermediate boundaries only coarsens resume
+            # granularity (more replay). The floor must NEVER advance
+            # here: every retained-or-dropped entry has g > any
+            # committed offset the score thread could have pruned to,
+            # and a floor past committed would SKIP records on restore.
+            if len(self._snaps) > 65536:
+                self._snaps = collections.deque(
+                    v for i, v in enumerate(self._snaps)
+                    if i % 2 == 1
+                )
+
+    def checkpoint_state(self, committed: int) -> Optional[dict]:
+        """Engine hook: JSON state for an exact multi-partition resume —
+        the newest cursor-vector snapshot at or before ``committed``
+        (None = the scalar offset fully encodes resume: single-partition
+        or strict mode)."""
+        if not self._vector_mode:
+            return None
+        with self._snap_mu:
+            while self._snaps and self._snaps[0][0] <= committed:
+                self._snap_floor = self._snaps.popleft()
+            g, cursors = self._snap_floor
+        return {
+            "offset": g,
+            "cursors": {str(p): off for p, off in cursors.items()},
+        }
+
+    def restore_state(self, state: dict) -> int:
+        """Engine hook: resume from a checkpointed cursor vector →
+        the effective committed offset (≤ what was requested when the
+        commit landed mid-emission)."""
+        if not self._vector_mode:
+            # an auto-era checkpoint restored into a strict source:
+            # the bijection would silently misread the arrival-order
+            # global offset — refuse rather than mis-align lanes
+            raise KafkaProtocolError(
+                "checkpoint carries a per-partition cursor vector "
+                "(written by interleave='auto') but this source is "
+                "strict/single-partition; construct it with "
+                "interleave='auto' to resume"
+            )
+        cursors = {
+            int(p): int(off) for p, off in state["cursors"].items()
+        }
+        if set(cursors) != set(self._parts):
+            raise KafkaProtocolError(
+                f"checkpoint cursors {sorted(cursors)} do not match the "
+                f"configured partitions {sorted(self._parts)}"
+            )
+        g = int(state["offset"])
+        with self._snap_mu:
+            self._cursors = cursors
+            self._g = g
+            self._snaps.clear()
+            self._snap_floor = (g, dict(cursors))
+        self._clear_buffers()
+        return g
+
+    def _clear_buffers(self) -> None:
         for buf in self._bufs.values():
             buf.clear()
+
+    def seek(self, offset: int) -> None:
+        # engine offset k ("k records consumed") == next Kafka offset
+        # (single-partition) / next global index (multi-strict): no +1
+        # bridging anywhere (cf. net.py header)
+        if self._vector_mode and offset != self._snap_floor[0]:
+            raise KafkaProtocolError(
+                f"vector-mode seek({offset}) without cursor state: "
+                "multi-partition auto interleave resumes through "
+                "restore_state (checkpointed per-partition offsets); "
+                "arbitrary scalar seeks only exist in strict mode. "
+                "Restoring a legacy scalar-only checkpoint (written by "
+                "the pre-vector strict bijection)? Construct the "
+                "source with interleave='strict'."
+            )
+        self._next = offset
+        self._g = offset
+        self._clear_buffers()
 
     def close(self) -> None:
         self._client.close()
@@ -678,8 +835,61 @@ class KafkaRecordSource(_KafkaSourceBase, Source):
 
         self._decode = decoder or (lambda v: json.loads(v))
         self._pending: List[Tuple[int, bytes]] = []
+        # vector mode: globally-indexed records buffered between polls
+        self._pending_global: "collections.deque" = collections.deque()
+
+    def _pump_auto(self, want: int) -> List[Tuple[int, bytes]]:
+        """Vector-mode pump: runs from whichever partition has data
+        (round-robin preference); cursors track observed offsets, gaps
+        included; one snapshot per fetched run. Dry partitions are
+        probed with ``max_wait_ms=0``; one long-poll only when the
+        whole sweep is dry (cf. ``_poll_multi_auto``)."""
+        out: List[Tuple[int, bytes]] = []
+        P = len(self._parts)
+        while len(out) < want:
+            if self._pending_global:
+                out.append(self._pending_global.popleft())
+                continue
+            fetched = False
+            for attempt in (0, 1):
+                for i in range(P):
+                    idx = (self._rr + i) % P
+                    part = self._parts[idx]
+                    cur = self._cursors[part]
+                    recs = [
+                        (o, v)
+                        for o, v in self._fetch_part(
+                            part, cur,
+                            max_wait_ms=0 if attempt == 0 else None,
+                        )
+                        if o >= cur
+                    ]
+                    if not recs:
+                        if attempt:
+                            break  # one long-poll per dry sweep
+                        continue
+                    g0 = self._g
+                    self._pending_global.extend(
+                        (g0 + j, v) for j, (_, v) in enumerate(recs)
+                    )
+                    self._g = g0 + len(recs)
+                    self._cursors[part] = recs[-1][0] + 1
+                    self._rr = (idx + 1) % P
+                    self._snap()
+                    fetched = True
+                    break
+                if fetched:
+                    break
+            if not fetched:
+                break
+        return out
 
     def poll(self, max_n: int) -> Polled:
+        if self._vector_mode:
+            return [
+                (g + 1, self._decode(value))
+                for g, value in self._pump_auto(max_n)
+            ]
         if self._multi:
             return [
                 (g + 1, self._decode(value))
@@ -695,6 +905,11 @@ class KafkaRecordSource(_KafkaSourceBase, Source):
             self._pending[max_n:],
         )
         return [(off + 1, self._decode(value)) for off, value in take]
+
+    def _clear_buffers(self) -> None:
+        self._pending.clear()
+        self._pending_global.clear()
+        super()._clear_buffers()
 
     def seek(self, offset: int) -> None:
         self._pending.clear()
@@ -757,11 +972,59 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         self._g = g0 + m
         return g0, out
 
+    def _poll_multi_auto(self) -> Optional[Tuple[int, np.ndarray]]:
+        """Vector-mode poll: take the next available run from whichever
+        partition has data (round-robin preference, never stalling on an
+        empty one). Cursors advance to the offsets actually observed —
+        offset gaps (compaction) are data, not errors — and every
+        emission appends a cursor-vector snapshot for checkpointing.
+
+        Empty partitions are probed with ``max_wait_ms=0`` — a serial
+        sweep must not pay the broker's long-poll per dry partition
+        (with one hot partition of P, that would cap the poll rate at
+        ~1/((P-1)·max_wait) regardless of throughput); only when the
+        WHOLE sweep is dry does one bounded long-poll keep the idle-
+        stream blocking semantics."""
+        P = len(self._parts)
+        for attempt in (0, 1):
+            for i in range(P):
+                idx = (self._rr + i) % P
+                part = self._parts[idx]
+                raw = self._fetch_raw_part(
+                    part,
+                    self._cursors[part],
+                    max_wait_ms=0 if attempt == 0 else None,
+                )
+                if not raw:
+                    if attempt:
+                        break  # one long-poll per dry sweep, not P
+                    continue
+                offs, rows = decode_record_batches_rows(raw, self._cols)
+                k = int(np.searchsorted(offs, self._cursors[part]))
+                offs, rows = offs[k:], rows[k:]
+                if offs.shape[0] == 0:
+                    if attempt:
+                        break
+                    continue
+                g0 = self._g
+                self._g = g0 + rows.shape[0]
+                self._cursors[part] = int(offs[-1]) + 1
+                self._rr = (idx + 1) % P
+                self._snap()
+                return g0, rows
+        return None
+
+    def _clear_buffers(self) -> None:
+        self._rbufs.clear()
+        super()._clear_buffers()
+
     def seek(self, offset: int) -> None:
         self._rbufs.clear()
         super().seek(offset)
 
     def poll(self) -> Optional[Tuple[int, np.ndarray]]:
+        if self._vector_mode:
+            return self._poll_multi_auto()
         if self._multi:
             return self._poll_multi()
         raw = self._fetch_raw_part(self._partition, self._next)
@@ -802,13 +1065,18 @@ class MiniKafkaBroker:
                  port: int = 0, n_partitions: int = 1):
         self.topic = topic
         self.n_partitions = n_partitions
-        # per-partition value bytes; index within a log == partition offset
-        self._logs: List[List[bytes]] = [[] for _ in range(n_partitions)]
-        # per-partition encoded segments (base_offset, count, batch bytes):
-        # like a real broker's log, the wire format is the storage format —
-        # appends encode once, fetches serve cached bytes (the round-4
-        # rework; re-encoding per fetch made the test broker the loopback
-        # bottleneck at ~45k rec/s while the consumer decodes at 2.3M)
+        # per-partition parallel (offsets, values) lists — offsets are
+        # explicit (not list indices) so a compacted log can hold real
+        # gaps, like a real broker's; _next[p] = next offset to assign
+        self._offs: List[List[int]] = [[] for _ in range(n_partitions)]
+        self._vals: List[List[bytes]] = [[] for _ in range(n_partitions)]
+        self._next: List[int] = [0] * n_partitions
+        # per-partition encoded segments (base_offset, end_offset, batch
+        # bytes): like a real broker's log, the wire format is the
+        # storage format — appends encode once, fetches serve cached
+        # bytes (the round-4 rework; re-encoding per fetch made the test
+        # broker the loopback bottleneck at ~45k rec/s while the
+        # consumer decodes at 2.3M)
         self._segs: List[List[Tuple[int, int, bytes]]] = [
             [] for _ in range(n_partitions)
         ]
@@ -830,15 +1098,18 @@ class MiniKafkaBroker:
     def append(self, *values: bytes, partition: int = 0) -> int:
         """→ offset of the first appended value (in ``partition``)."""
         with self._mu:
-            log = self._logs[partition]
-            first = len(log)
-            log.extend(values)
+            first = self._next[partition]
+            self._offs[partition].extend(
+                range(first, first + len(values))
+            )
+            self._vals[partition].extend(values)
+            self._next[partition] = first + len(values)
             segs = self._segs[partition]
             for i in range(0, len(values), self._SEG_RECORDS):
                 chunk = values[i : i + self._SEG_RECORDS]
                 segs.append((
                     first + i,
-                    len(chunk),
+                    first + i + len(chunk),
                     encode_record_batch(first + i, list(chunk)),
                 ))
             self._mu.notify_all()
@@ -853,11 +1124,10 @@ class MiniKafkaBroker:
         rows = np.ascontiguousarray(rows, np.float32)
         if rows.shape[0] == 0:  # round-robin slices can be empty
             with self._mu:
-                return len(self._logs[partition])
+                return self._next[partition]
         raw = rows.view(np.uint8).reshape(rows.shape[0], -1)
         with self._mu:
-            log = self._logs[partition]
-            first = len(log)
+            first = self._next[partition]
             segs = self._segs[partition]
             for i in range(0, rows.shape[0], self._SEG_RECORDS):
                 chunk = raw[i : i + self._SEG_RECORDS]
@@ -868,10 +1138,14 @@ class MiniKafkaBroker:
                         base,
                         [chunk[j].tobytes() for j in range(chunk.shape[0])],
                     )
-                segs.append((base, chunk.shape[0], blob))
-            log.extend(
+                segs.append((base, base + chunk.shape[0], blob))
+            self._offs[partition].extend(
+                range(first, first + rows.shape[0])
+            )
+            self._vals[partition].extend(
                 raw[i].tobytes() for i in range(raw.shape[0])
             )
+            self._next[partition] = first + rows.shape[0]
             self._mu.notify_all()
             return first
 
@@ -884,13 +1158,70 @@ class MiniKafkaBroker:
         for p in range(self.n_partitions):
             self.append_rows(rows[p :: self.n_partitions], partition=p)
 
+    def append_rows_keyed(self, rows: np.ndarray, keys) -> None:
+        """Keyed producer: row i → partition ``hash(keys[i]) %
+        n_partitions`` — the layout real keyed producers create, where
+        partitions fill unevenly and NO round-robin bijection exists.
+        The vector-offset consumer mode exists for exactly this."""
+        import zlib
+
+        rows = np.ascontiguousarray(rows, np.float32)
+        if len(keys) != rows.shape[0]:
+            raise ValueError(
+                f"{len(keys)} keys for {rows.shape[0]} rows"
+            )
+        parts = np.asarray([
+            zlib.crc32(str(k).encode()) % self.n_partitions for k in keys
+        ])
+        for p in range(self.n_partitions):
+            self.append_rows(rows[parts == p], partition=p)
+
+    def compact(self, partition: int, remove_offsets) -> None:
+        """Log compaction: drop the given offsets from the partition,
+        leaving REAL gaps (surviving records keep their original
+        offsets, exactly like Kafka compaction). Segments are rebuilt
+        as contiguous surviving runs — a drill operation; efficiency is
+        irrelevant next to correctness here."""
+        remove = set(int(o) for o in remove_offsets)
+        with self._mu:
+            offs = self._offs[partition]
+            vals = self._vals[partition]
+            keep = [
+                (o, v) for o, v in zip(offs, vals) if o not in remove
+            ]
+            self._offs[partition] = [o for o, _ in keep]
+            self._vals[partition] = [v for _, v in keep]
+            segs: List[Tuple[int, int, bytes]] = []
+            run: List[Tuple[int, bytes]] = []
+            for o, v in keep:
+                if run and o != run[-1][0] + 1:
+                    segs.append(self._encode_run(run))
+                    run = []
+                run.append((o, v))
+                if len(run) >= self._SEG_RECORDS:
+                    segs.append(self._encode_run(run))
+                    run = []
+            if run:
+                segs.append(self._encode_run(run))
+            self._segs[partition] = segs
+            self._mu.notify_all()
+
+    @staticmethod
+    def _encode_run(run) -> Tuple[int, int, bytes]:
+        base = run[0][0]
+        return (
+            base,
+            run[-1][0] + 1,
+            encode_record_batch(base, [v for _, v in run]),
+        )
+
     @property
     def high_watermark(self) -> int:
         """Total records across ALL partitions — so produced-vs-consumed
         waits stay correct on a multi-partition broker (per-partition
         watermarks ride the Fetch/ListOffsets responses)."""
         with self._mu:
-            return sum(len(log) for log in self._logs)
+            return sum(len(v) for v in self._vals)
 
     def close(self) -> None:
         self._closing = True
@@ -1018,11 +1349,19 @@ class MiniKafkaBroker:
             part = r.i32()
             ts = r.i64()
             with self._mu:
-                log = self._logs[part] if 0 <= part < len(self._logs) else []
-                off = 0 if ts == -2 else len(log)
+                ok_part = 0 <= part < len(self._offs)
+                if ts == -2:  # earliest surviving offset
+                    offs = self._offs[part] if ok_part else []
+                    off = offs[0] if offs else (
+                        self._next[part] if ok_part else 0
+                    )
+                else:  # latest = next offset to be assigned
+                    off = self._next[part] if ok_part else 0
             w = _Writer()
             w.i32(1).string(self.topic)
-            w.i32(1).i32(part).i16(0).i64(-1).i64(off)
+            # err 3 = UNKNOWN_TOPIC_OR_PARTITION: a misconfigured
+            # consumer must fail fast, not poll an empty phantom log
+            w.i32(1).i32(part).i16(0 if ok_part else 3).i64(-1).i64(off)
             return bytes(w.b)
         if api_key == API_FETCH:
             r.i32()  # replica id
@@ -1040,18 +1379,18 @@ class MiniKafkaBroker:
             part_max_bytes = r.i32()
             deadline = time.monotonic() + max_wait_ms / 1000.0
             with self._mu:
-                ok_part = 0 <= part < len(self._logs)
-                log = self._logs[part] if ok_part else []
+                ok_part = 0 <= part < len(self._offs)
                 segs = self._segs[part] if ok_part else []
                 while (
-                    len(log) <= fetch_offset
+                    ok_part
+                    and self._next[part] <= fetch_offset
                     and not self._closing
                     and time.monotonic() < deadline
                 ):
                     self._mu.wait(
                         max(deadline - time.monotonic(), 0.001)
                     )
-                hw = len(log)
+                hw = self._next[part] if ok_part else 0
                 parts: List[bytes] = []
                 if fetch_offset < hw:
                     # serve the cached encoded segments (a real broker's
@@ -1068,8 +1407,7 @@ class MiniKafkaBroker:
                     if j < 0:
                         j = 0
                     while (
-                        j < len(segs)
-                        and segs[j][0] + segs[j][1] <= fetch_offset
+                        j < len(segs) and segs[j][1] <= fetch_offset
                     ):
                         j += 1
                     size = 0
@@ -1078,19 +1416,25 @@ class MiniKafkaBroker:
                         if parts and size + len(blob) > part_max_bytes:
                             break
                         if not parts and len(blob) > part_max_bytes:
+                            offs_l = self._offs[part]
+                            k = bisect.bisect_left(offs_l, fetch_offset)
                             values = []
                             size2 = 0
-                            o = fetch_offset
-                            while o < hw:
-                                val = log[o]
+                            base = None
+                            while k < len(offs_l):
+                                o, val = offs_l[k], self._vals[part][k]
+                                if base is None:
+                                    base = o
+                                elif o != base + len(values):
+                                    break  # re-encode one contiguous run
                                 size2 += len(val) + 32
                                 if values and size2 > part_max_bytes:
                                     break
                                 values.append(val)
-                                o += 1
+                                k += 1
                             parts = [
-                                encode_record_batch(fetch_offset, values)
-                            ]
+                                encode_record_batch(base, values)
+                            ] if values else []
                             break
                         parts.append(blob)
                         size += len(blob)
@@ -1100,7 +1444,10 @@ class MiniKafkaBroker:
             w.i32(0)  # throttle
             w.i32(1).string(self.topic)
             w.i32(1)
-            w.i32(part).i16(0).i64(hw)  # partition, err, high watermark
+            # err 3 = UNKNOWN_TOPIC_OR_PARTITION for an out-of-range
+            # partition index (a real broker fails the fetch; an empty
+            # err-0 log would mask the misconfiguration forever)
+            w.i32(part).i16(0 if ok_part else 3).i64(hw)
             w.i64(hw)  # last stable offset
             w.i32(0)  # aborted txns
             w.bytes_(record_set)
