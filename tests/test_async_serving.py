@@ -14,6 +14,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # serving swap/SLO drills (-m 'not slow' = fast inner loop)
+
 from flink_jpmml_tpu.models.control import AddMessage
 from flink_jpmml_tpu.models.core import ModelId
 from flink_jpmml_tpu.runtime.sources import ControlSource

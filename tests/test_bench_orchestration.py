@@ -9,6 +9,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # process-spawning drill (-m 'not slow' = fast inner loop)
+
 from flink_jpmml_tpu import bench
 
 
@@ -18,7 +20,8 @@ def _args(**over):
     ns = argparse.Namespace(
         trees=500, depth=6, features=32, batch=262144, chunk=16384,
         window=2, seconds=4.0, f32_wire=False, init_timeout=2.0,
-        max_attempts=4, total_budget=60.0, skip_interp=False,
+        probe_interval=0.2, probe_timeout=2.0, total_budget=60.0,
+        skip_interp=False,
         skip_latency=False, latency=False, latency_batch=4096,
         latency_deadline_us=2000, latency_offered=100000.0,
         in_child=False, force_cpu=False, block_pipeline=False,
@@ -113,6 +116,86 @@ class TestRunChild:
             total_timeout_s=30.0,
         )
         assert err is None and line["backend"] == "cpu"
+
+
+class TestProbePoll:
+    """_orchestrate probe-poll (r4 VERDICT #1): cheap probes across the
+    whole budget; the measurement child launches only on a healthy
+    probe; budget expiry → labelled CPU fallback."""
+
+    def _capture_line(self, capsys):
+        out = capsys.readouterr().out.strip().splitlines()
+        return json.loads(out[-1])
+
+    def test_measures_on_first_healthy_probe(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        seq = [(None, "probe wedged"), (None, "probe wedged"),
+               ("tpu", None)]
+        probed = []
+
+        def fake_probe(t):
+            probed.append(1)
+            # repeat the last value rather than StopIteration if the
+            # loop probes more than scripted (a failure should assert,
+            # not crash)
+            return seq[min(len(probed), len(seq)) - 1]
+
+        monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+        _fake_child(tmp_path, monkeypatch, """
+            import json, sys
+            print("backend resolved: tpu", file=sys.stderr, flush=True)
+            print(json.dumps({"metric": "m", "value": 5.0,
+                              "backend": "tpu"}))
+        """)
+        # budget must clear the CPU fallback reserve or the poll loop
+        # never starts (the reserve is ~180s + 4x --seconds); generous
+        # init_timeout — a loaded host can take seconds just to start
+        # the fake child's interpreter
+        bench._orchestrate(_args(total_budget=400.0, init_timeout=30.0))
+        line = self._capture_line(capsys)
+        assert line["backend"] == "tpu" and line["value"] == 5.0
+        assert line["probes"] == 3 and line["attempts"] == 1
+        assert len(probed) == 3  # two wedged probes did NOT spawn children
+
+    def test_budget_expiry_falls_back_to_labelled_cpu(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        monkeypatch.setattr(
+            bench, "_probe_backend", lambda t: (None, "probe wedged")
+        )
+        _fake_child(tmp_path, monkeypatch, """
+            import json
+            print(json.dumps({"metric": "m", "value": 7.0,
+                              "backend": "cpu"}))
+        """)
+        # budget only big enough for a few probes + the cpu reserve
+        bench._orchestrate(_args(total_budget=190.0, seconds=0.5))
+        line = self._capture_line(capsys)
+        assert line["backend"] == "cpu-fallback"
+        assert "probe wedged" in line["error"]
+
+    def test_cpu_resolution_twice_concedes_early(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        calls = []
+        monkeypatch.setattr(
+            bench, "_probe_backend",
+            lambda t: calls.append(1) or ("cpu", None),
+        )
+        _fake_child(tmp_path, monkeypatch, """
+            import json
+            print(json.dumps({"metric": "m", "value": 9.0,
+                              "backend": "cpu"}))
+        """)
+        import time
+
+        t0 = time.monotonic()
+        bench._orchestrate(_args(total_budget=600.0, seconds=0.5))
+        assert time.monotonic() - t0 < 30.0  # did not poll out 600s
+        line = self._capture_line(capsys)
+        assert line["backend"] == "cpu-fallback"
+        assert len(calls) == 2
 
 
 class TestLatencyHeadline:

@@ -10,6 +10,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # serving swap/SLO drills (-m 'not slow' = fast inner loop)
+
 from assets.generate import gen_gbm
 from flink_jpmml_tpu.models.control import AddMessage, DelMessage
 from flink_jpmml_tpu.runtime.block import CyclingBlockSource, FiniteBlockSource
@@ -444,6 +446,7 @@ class TestIdleStreamControl:
             pipe.join(timeout=30.0)
 
 
+@pytest.mark.slow
 class TestKafkaDynamicServing:
     def test_add_swap_over_kafka_wire(self, tmp_path):
         """The marquee combination end to end: dynamic serving at block

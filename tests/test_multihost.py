@@ -18,6 +18,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow  # process-spawning drill (-m 'not slow' = fast inner loop)
+
 _WORKER = textwrap.dedent(
     """
     import os, sys

@@ -107,6 +107,7 @@ class TestEngineIntegration:
             srv.stop()
 
 
+@pytest.mark.slow
 class TestKillResume:
     def test_block_pipeline_resumes_exactly(self, tmp_path):
         # VERDICT r1 #3 'Done': BlockPipeline scores a socket-fed GBM

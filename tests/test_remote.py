@@ -129,6 +129,7 @@ class TestHttpFetch:
 
 
 class TestGatedSchemes:
+    @pytest.mark.slow
     def test_gs_unusable_is_typed_error(self, monkeypatch, tmp_path):
         # google-cloud-storage may or may not be installed; either a
         # missing dep or missing credentials must surface as the typed

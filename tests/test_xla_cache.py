@@ -5,6 +5,10 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess compile drill
+
 
 def test_cache_populates_and_reloads(tmp_path):
     cache = str(tmp_path / "xla")
