@@ -446,7 +446,6 @@ class TestIdleStreamControl:
             pipe.join(timeout=30.0)
 
 
-@pytest.mark.slow
 class TestKafkaDynamicServing:
     def test_add_swap_over_kafka_wire(self, tmp_path):
         """The marquee combination end to end: dynamic serving at block
