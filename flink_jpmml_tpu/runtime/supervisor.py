@@ -123,7 +123,17 @@ class Supervisor:
         on_give_up: Optional[Callable[[str], None]] = None,
         on_restart: Optional[Callable[[str, int], None]] = None,
         poll_interval_s: float = 0.05,
+        restart_group: bool = False,
     ):
+        """``restart_group=True`` is Flink's full-job restart strategy:
+        ANY worker failure tears down every live worker and respawns
+        the whole set after one shared backoff, with ONE shared policy
+        budget. This is the right mode for a ``jax.distributed``
+        process group — a dead rank breaks the group's collectives, so
+        the surviving ranks cannot continue and must restart together
+        from the shared checkpoint. The default (False) restarts
+        workers independently — right for shared-nothing scoring
+        workers that each own a partition."""
         ids = [s.worker_id for s in specs]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate worker ids: {ids}")
@@ -137,6 +147,12 @@ class Supervisor:
             s.worker_id: _WorkerState(spec=s) for s in specs
         }
         self._closing = False
+        self._group = restart_group
+        # group mode: ONE shared failure budget + backoff clock
+        self._group_failures: List[float] = []
+        self._group_consecutive = 0
+        self._group_restart_at: Optional[float] = None
+        self._group_gave_up = False
         self._coord: Optional[HealthCoordinator] = None
         if heartbeat_timeout_s is not None:
             self._coord = HealthCoordinator(
@@ -150,9 +166,29 @@ class Supervisor:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        give_up: List[str] = []
         with self._mu:
-            for st in self._workers.values():
-                self._spawn_locked(st)
+            if self._group:
+                ok = all(
+                    self._spawn_locked_raw(st)
+                    for st in list(self._workers.values())
+                )
+                if not ok:  # a partial group cannot run collectives
+                    self._kill_live_locked()
+                    self._count_group_failure_locked(
+                        time.monotonic(), give_up
+                    )
+            else:
+                for st in self._workers.values():
+                    self._spawn_locked(st)
+        # a spawn failure that immediately exhausts the budget must
+        # still reach the operator (callbacks outside the lock)
+        for wid in give_up:
+            if self._on_give_up is not None:
+                try:
+                    self._on_give_up(wid)
+                except Exception:
+                    pass
         self._watcher.start()
 
     def stop(self, grace_s: float = 5.0) -> None:
@@ -207,13 +243,12 @@ class Supervisor:
 
     # -- internals ---------------------------------------------------------
 
-    def _spawn_locked(self, st: _WorkerState) -> bool:
-        """Spawn (or respawn) one worker. A Popen failure (fork EAGAIN
-        under memory pressure, ENOENT after a deploy replaced the
-        binary) counts as an immediate worker failure against the
-        restart policy — it must NEVER propagate: an exception here
-        would kill the watcher thread and silently disable ALL
-        supervision."""
+    def _spawn_locked_raw(self, st: _WorkerState) -> bool:
+        """Popen one worker; False on OSError (fork EAGAIN under memory
+        pressure, ENOENT after a deploy replaced the binary) with NO
+        policy accounting — group mode owns its own shared budget. Must
+        NEVER raise: an exception here would kill the watcher thread
+        and silently disable ALL supervision."""
         env = dict(os.environ)
         if st.spec.env:
             env.update(st.spec.env)
@@ -226,25 +261,25 @@ class Supervisor:
             )
         except OSError:
             st.proc = None
-            now = time.monotonic()
-            st.failure_times.append(now)
-            st.consecutive_failures += 1
-            if self._policy.window_s is not None:
-                st.failure_times = [
-                    t for t in st.failure_times
-                    if now - t <= self._policy.window_s
-                ]
-            if len(st.failure_times) > self._policy.max_restarts:
-                st.gave_up = True
-                st.restart_at = None
-            else:
-                st.restart_at = now + self._policy.backoff(
-                    st.consecutive_failures
-                )
             return False
         st.spawned_at = time.monotonic()
         st.restart_at = None
         return True
+
+    def _spawn_locked(self, st: _WorkerState) -> bool:
+        """Per-worker spawn: a Popen failure counts as an immediate
+        worker failure against that worker's restart policy."""
+        if self._spawn_locked_raw(st):
+            return True
+        (
+            st.failure_times,
+            st.consecutive_failures,
+            st.gave_up,
+            st.restart_at,
+        ) = self._strike(
+            st.failure_times, st.consecutive_failures, time.monotonic()
+        )
+        return False
 
     def _on_heartbeat_dead(self, worker_id: str) -> None:
         """A worker stopped beating. If its process is still alive it is
@@ -274,6 +309,127 @@ class Supervisor:
             except OSError:
                 pass
 
+    def _kill_live_locked(self) -> None:
+        for st in self._workers.values():
+            if st.proc is not None and st.proc.poll() is None:
+                try:
+                    st.proc.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+
+    def _strike(self, times: List[float], consecutive: int, now: float):
+        """Register one failure against a policy budget → (pruned
+        failure times, consecutive+1, gave_up, restart_at). The ONE
+        implementation of the window/backoff/give-up arithmetic, shared
+        by per-worker spawns, per-worker sweeps, and the group budget."""
+        times = times + [now]
+        consecutive += 1
+        if self._policy.window_s is not None:
+            times = [t for t in times if now - t <= self._policy.window_s]
+        gave_up = len(times) > self._policy.max_restarts
+        restart_at = (
+            None if gave_up else now + self._policy.backoff(consecutive)
+        )
+        return times, consecutive, gave_up, restart_at
+
+    def _first_beat_kill_locked(self, wid, st, now) -> None:
+        """SIGKILL a live worker whose CURRENT incarnation has never
+        beaten past the first-beat deadline (shared by both sweep
+        modes; the kill surfaces as an exit next sweep)."""
+        if self._coord is None:
+            return
+        last = self._coord.last_seen(wid)
+        if (
+            (last is None or last < st.spawned_at)
+            and now - st.spawned_at > self._first_beat_timeout
+        ):
+            try:
+                st.proc.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+
+    def _watch_group_locked(self, now, give_up, restarted) -> None:
+        """One sweep of full-job restart semantics (Flink's default):
+        any failure → tear down all → one shared backoff → respawn
+        all. Appends to the callback lists; caller holds the lock."""
+        if self._group_gave_up:
+            for wid, st in self._workers.items():
+                if not (st.gave_up_notified or st.finished):
+                    st.gave_up = True
+                    st.gave_up_notified = True
+                    give_up.append(wid)
+            return
+        live = [
+            st for st in self._workers.values()
+            if st.proc is not None and st.proc.poll() is None
+        ]
+        if self._group_restart_at is not None:
+            if live or now < self._group_restart_at:
+                return  # still tearing down / backing off
+            pending = [
+                (wid, st) for wid, st in self._workers.items()
+                if not st.finished
+            ]
+            if all(self._spawn_locked_raw(st) for _, st in pending):
+                # commit the restart only once the WHOLE group is up —
+                # a partial group is as dead as a failed one
+                for wid, st in pending:
+                    st.restarts += 1
+                    restarted.append(wid)
+                self._group_restart_at = None
+            else:
+                self._kill_live_locked()
+                self._count_group_failure_locked(now, give_up)
+            return
+        # healthy-uptime reset for the shared backoff
+        if (
+            self._group_consecutive > 0
+            and live
+            and all(
+                now - st.spawned_at > self._policy.reset_after_s
+                for st in live
+            )
+        ):
+            self._group_consecutive = 0
+        failed = False
+        for wid, st in self._workers.items():
+            proc = st.proc
+            if proc is None or st.finished:
+                continue
+            if proc.poll() is None:
+                self._first_beat_kill_locked(wid, st, now)
+                continue
+            if proc.returncode == 0:
+                st.finished = True
+                if self._coord is not None:
+                    self._coord.remove(wid)
+            else:
+                failed = True
+        if failed:
+            self._kill_live_locked()
+            self._count_group_failure_locked(now, give_up)
+
+    def _count_group_failure_locked(self, now, give_up) -> None:
+        (
+            self._group_failures,
+            self._group_consecutive,
+            gave_up,
+            self._group_restart_at,
+        ) = self._strike(
+            self._group_failures, self._group_consecutive, now
+        )
+        if gave_up:
+            self._group_gave_up = True
+            self._kill_live_locked()  # idempotent: nothing survives
+            for wid, st in self._workers.items():
+                if st.finished:
+                    continue  # rc=0 means finished, never failed
+                st.gave_up = True
+                st.gave_up_notified = True
+                if self._coord is not None:
+                    self._coord.remove(wid)
+                give_up.append(wid)
+
     def _watch(self) -> None:
         while True:
             give_up: List[str] = []
@@ -282,7 +438,11 @@ class Supervisor:
                 if self._closing:
                     return
                 now = time.monotonic()
-                for wid, st in self._workers.items():
+                if self._group:
+                    self._watch_group_locked(now, give_up, restarted)
+                for wid, st in (
+                    {} if self._group else self._workers
+                ).items():
                     if st.gave_up:
                         if not st.gave_up_notified:
                             st.gave_up_notified = True
@@ -302,60 +462,39 @@ class Supervisor:
                         continue
                     proc = st.proc
                     if proc is None or proc.poll() is None:
-                        if (
-                            proc is not None
-                            and st.consecutive_failures > 0
-                            and now - st.spawned_at
-                            > self._policy.reset_after_s
-                        ):
-                            st.consecutive_failures = 0
-                        last = (
-                            self._coord.last_seen(wid)
-                            if self._coord is not None
-                            else None
-                        )
-                        if (
-                            proc is not None
-                            and self._coord is not None
-                            and (last is None or last < st.spawned_at)
-                            and now - st.spawned_at
-                            > self._first_beat_timeout
-                        ):
-                            # spawned, alive, and THIS incarnation has
-                            # never beaten (a beat predating spawned_at
-                            # belongs to a previous one): wedged before
-                            # its first heartbeat — the on_dead path only
-                            # covers live beats. Kill it; the exit takes
-                            # the normal restart path next sweep.
-                            try:
-                                proc.send_signal(signal.SIGKILL)
-                            except OSError:
-                                pass
+                        if proc is not None:
+                            if (
+                                st.consecutive_failures > 0
+                                and now - st.spawned_at
+                                > self._policy.reset_after_s
+                            ):
+                                st.consecutive_failures = 0
+                            # a worker wedged before its FIRST heartbeat
+                            # is invisible to the on_dead path (it only
+                            # covers live beats): kill it, the exit
+                            # takes the normal restart path next sweep
+                            self._first_beat_kill_locked(wid, st, now)
                         continue
-                    rc = proc.returncode
-                    if rc == 0:
+                    if proc.returncode == 0:
                         st.finished = True
                         if self._coord is not None:
                             self._coord.remove(wid)
                         continue
                     # failed: count against the policy window
-                    st.failure_times.append(now)
-                    st.consecutive_failures += 1
-                    if self._policy.window_s is not None:
-                        st.failure_times = [
-                            t for t in st.failure_times
-                            if now - t <= self._policy.window_s
-                        ]
-                    if len(st.failure_times) > self._policy.max_restarts:
+                    (
+                        st.failure_times,
+                        st.consecutive_failures,
+                        gave_up_now,
+                        st.restart_at,
+                    ) = self._strike(
+                        st.failure_times, st.consecutive_failures, now
+                    )
+                    if gave_up_now:
                         st.gave_up = True
                         st.gave_up_notified = True
                         if self._coord is not None:
                             self._coord.remove(wid)
                         give_up.append(wid)
-                        continue
-                    st.restart_at = now + self._policy.backoff(
-                        st.consecutive_failures
-                    )
             # callbacks outside the lock: they may inspect status()
             for wid in restarted:
                 if self._on_restart is not None:

@@ -138,6 +138,76 @@ class TestSupervisorUnit:
             sup.stop()
 
 
+class TestGroupRestart:
+    """restart_group=True — Flink's full-job restart: any failure tears
+    down every worker and the whole set respawns after one shared
+    backoff (the right semantics for a jax.distributed process group,
+    whose collectives cannot survive a dead rank)."""
+
+    def test_one_death_restarts_all(self, tmp_path):
+        sup = Supervisor(
+            [
+                WorkerSpec("r0", _py("import time; time.sleep(120)")),
+                WorkerSpec("r1", _py("import time; time.sleep(120)")),
+                WorkerSpec("r2", _py("import time; time.sleep(120)")),
+            ],
+            policy=RestartPolicy(max_restarts=3, backoff_s=0.05),
+            heartbeat_timeout_s=None,
+            restart_group=True,
+        )
+        sup.start()
+        try:
+            assert _wait(
+                lambda: all(
+                    s["alive"] for s in sup.status().values()
+                ), 15.0,
+            ), sup.status()
+            pids = {w: s["pid"] for w, s in sup.status().items()}
+            os.kill(pids["r1"], signal.SIGKILL)
+            # ALL three must come back as new incarnations
+            assert _wait(
+                lambda: all(
+                    s["alive"] and s["restarts"] == 1
+                    for s in sup.status().values()
+                ), 20.0,
+            ), sup.status()
+            new_pids = {w: s["pid"] for w, s in sup.status().items()}
+            assert all(new_pids[w] != pids[w] for w in pids)
+        finally:
+            sup.stop()
+
+    def test_group_budget_is_shared(self):
+        # one chronically-crashing rank exhausts the ONE group budget;
+        # every worker ends gave_up and on_give_up fires per worker
+        gave_up = []
+        sup = Supervisor(
+            [
+                WorkerSpec("r0", _py("import sys; sys.exit(9)")),
+                WorkerSpec("r1", _py("import time; time.sleep(120)")),
+            ],
+            policy=RestartPolicy(max_restarts=2, backoff_s=0.02),
+            heartbeat_timeout_s=None,
+            restart_group=True,
+            on_give_up=gave_up.append,
+        )
+        sup.start()
+        try:
+            assert _wait(
+                lambda: all(
+                    s["gave_up"] for s in sup.status().values()
+                ), 20.0,
+            ), sup.status()
+            assert sorted(gave_up) == ["r0", "r1"]
+            # the healthy rank was torn down with the group, not left
+            # half-running against dead collectives (SIGKILL delivery
+            # is async: wait, don't sample)
+            assert _wait(
+                lambda: not sup.status()["r1"]["alive"], 10.0
+            ), sup.status()
+        finally:
+            sup.stop()
+
+
 class TestHeartbeatKill:
     def test_wedged_worker_is_killed_and_restarted(self, tmp_path):
         # incarnation 1 never beats (a wedged device call: alive but
@@ -238,7 +308,11 @@ class TestKillResumeDrill:
 
         pmml = gen_gbm(str(tmp_path), n_trees=10, depth=3, n_features=5)
         rng = np.random.default_rng(5)
-        N = 4000
+        # large enough that the stream takes whole seconds: the parent
+        # polls committed() every 50 ms and must observe a MID-stream
+        # commit window — at 4k records the worker could race 0 → N
+        # between two polls and the drill would never see "in progress"
+        N = 60_000
         data = rng.normal(0, 1.5, size=(N, 5)).astype(np.float32)
         outfile = tmp_path / "emissions.log"
         outfile.touch()
