@@ -1,0 +1,149 @@
+"""Extended fuzz soak: arbitrary seed ranges over the test_fuzz
+generators, on any backend — the on-device evidence tool behind the
+"N seeds on-device clean" claims in docs/parity.md.
+
+The pytest suite pins fixed seed ranges so CI stays deterministic and
+fast; this driver reuses the exact same generators and the exact same
+lane-by-lane compiled-vs-oracle assertion, but sweeps as many seeds as
+a soak budget allows, on whichever backend the session resolves
+(run plainly for the real chip; FJT_TEST_PLATFORM-style CPU pinning is
+the test suite's business, not this tool's).
+
+Usage:
+  python tools/fuzz_soak.py [--families trees,mining,regression,...]
+                            [--seeds 100] [--start 10000]
+Prints one summary line per family and exits nonzero on any parity
+failure (the failing seed is in the assertion message — replay it by
+passing --start <seed> --seeds 1).
+"""
+
+import argparse
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from tests import test_fuzz as tf
+
+
+def _soak_trees(seed):
+    rng = np.random.default_rng(seed)
+    doc, recs = None, None
+    doc = tf._doc(tf._rand_tree_model(rng))
+    recs = tf._rand_records(rng, 64)
+    tf._assert_parity(doc, recs, f"tree seed={seed}")
+
+
+def _soak_mining(seed):
+    # mirrors TestFuzzMining.test_random_regression_ensemble_parity
+    # (the generator is inline there, not a module helper)
+    from flink_jpmml_tpu.pmml import ir
+
+    rng = np.random.default_rng(seed)
+    n_seg = int(rng.integers(2, 5))
+    segments = tuple(
+        ir.Segment(
+            predicate=(
+                ir.TruePredicate()
+                if rng.random() < 0.5
+                else tf._rand_predicate(rng, 1)
+            ),
+            model=ir.TreeModelIR(
+                function_name="regression",
+                mining_schema=tf._schema(),
+                root=tf._rand_tree(rng, False, max_depth=2),
+                missing_value_strategy=str(rng.choice(
+                    ["none", "defaultChild", "nullPrediction"]
+                )),
+                split_characteristic="multiSplit",
+            ),
+            segment_id=f"s{i}",
+            weight=float(np.round(rng.uniform(0.5, 2.0), 2)),
+        )
+        for i in range(n_seg)
+    )
+    method = str(rng.choice(
+        ["sum", "average", "weightedAverage", "max", "median",
+         "selectFirst"]
+    ))
+    model = ir.MiningModelIR(
+        function_name="regression",
+        mining_schema=tf._schema(),
+        segmentation=ir.Segmentation(
+            multiple_model_method=method, segments=segments
+        ),
+    )
+    doc = tf._doc(model)
+    recs = tf._rand_records(rng, 32)
+    tf._assert_parity(doc, recs, f"mining {method} seed={seed}")
+
+
+def _soak_regression(seed):
+    rng = np.random.default_rng(seed)
+    doc = tf._doc(tf._rand_regression_model(rng))
+    recs = tf._rand_records(rng, 64)
+    tf._assert_parity(doc, recs, f"regression seed={seed}")
+
+
+def _soak_neural(seed):
+    rng = np.random.default_rng(seed)
+    doc = tf._doc(tf._rand_nn_model(rng))
+    recs = tf._rand_records(rng, 64)
+    tf._assert_parity(doc, recs, f"neural seed={seed}")
+
+
+def _soak_glm(seed):
+    rng = np.random.default_rng(seed)
+    doc = tf._doc(tf._rand_glm_model(rng))
+    recs = tf._rand_records(rng, 64)
+    tf._assert_parity(doc, recs, f"glm seed={seed}")
+
+
+FAMILIES = {
+    "trees": _soak_trees,
+    "mining": _soak_mining,
+    "regression": _soak_regression,
+    "neural": _soak_neural,
+    "glm": _soak_glm,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--families", default=",".join(FAMILIES))
+    ap.add_argument("--seeds", type=int, default=50)
+    ap.add_argument("--start", type=int, default=100_000)
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"backend: {jax.default_backend()}", flush=True)
+    failures = 0
+    for fam in args.families.split(","):
+        fam = fam.strip()
+        if fam not in FAMILIES:
+            print(f"unknown family {fam!r}; have {sorted(FAMILIES)}")
+            return 2
+        fn = FAMILIES[fam]
+        t0 = time.perf_counter()
+        ok = 0
+        for s in range(args.start, args.start + args.seeds):
+            try:
+                fn(s)
+                ok += 1
+            except AssertionError as e:
+                failures += 1
+                print(f"FAIL {fam} seed={s}: {e}", flush=True)
+        dt = time.perf_counter() - t0
+        print(
+            f"{fam}: {ok}/{args.seeds} seeds clean in {dt:.1f}s",
+            flush=True,
+        )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
