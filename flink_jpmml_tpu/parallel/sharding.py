@@ -18,7 +18,6 @@ model copy; here one jitted computation spans the mesh —
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Optional
 
 import jax
