@@ -663,7 +663,12 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
 
         pipe = BlockPipeline(
             src, cm, sink,
-            RuntimeConfig(batch=BatchConfig(size=C, deadline_us=5000)),
+            RuntimeConfig(batch=BatchConfig(
+                size=C, deadline_us=5000,
+                # the ring must hold several batches or the drain
+                # serializes on the ingest thread at large chunks
+                queue_capacity=max(65536, 4 * C),
+            )),
             use_quantized=use_quantized,
         )
         q = cm.quantized_scorer() if use_quantized else None
@@ -898,7 +903,12 @@ def main() -> None:
             CyclingBlockSource(np.concatenate(pool_f32), block_size=C),
             cm,
             bsink,
-            RuntimeConfig(batch=BatchConfig(size=C, deadline_us=5000)),
+            RuntimeConfig(batch=BatchConfig(
+                size=C, deadline_us=5000,
+                # the ring must hold several batches or the drain
+                # serializes on the ingest thread at large chunks
+                queue_capacity=max(65536, 4 * C),
+            )),
             use_quantized=not args.f32_wire,
         )
         q = None if args.f32_wire else cm.quantized_scorer()
