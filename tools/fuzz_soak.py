@@ -102,12 +102,107 @@ def _soak_glm(seed):
     tf._assert_parity(doc, recs, f"glm seed={seed}")
 
 
+def _soak_scorecard(seed):
+    # mirrors TestFuzzScorecard.test_random_scorecard_parity
+    from flink_jpmml_tpu.pmml import ir
+
+    rng = np.random.default_rng(seed)
+    chars = []
+    for ci in range(int(rng.integers(1, 4))):
+        attrs = [
+            ir.ScorecardAttribute(
+                predicate=tf._rand_predicate(rng, 1),
+                partial_score=float(np.round(rng.normal(0, 20), 1)),
+            )
+            for _ in range(int(rng.integers(1, 4)))
+        ]
+        if rng.random() < 0.8:
+            attrs.append(ir.ScorecardAttribute(
+                predicate=ir.TruePredicate(),
+                partial_score=float(np.round(rng.normal(0, 5), 1)),
+            ))
+        chars.append(ir.Characteristic(
+            name=f"ch{ci}", attributes=tuple(attrs)
+        ))
+    model = ir.ScorecardIR(
+        function_name="regression",
+        mining_schema=tf._schema(),
+        characteristics=tuple(chars),
+        initial_score=float(np.round(rng.normal(100, 20), 1)),
+        use_reason_codes=False,
+    )
+    doc = tf._doc(model)
+    recs = tf._rand_records(rng, 40)
+    tf._assert_parity(doc, recs, f"scorecard seed={seed}")
+
+
+def _soak_sarima(seed):
+    # mirrors TestFuzzArima.test_random_sarima_parity
+    from flink_jpmml_tpu.pmml import parse_pmml
+    from tests.test_timeseries import _arima_xml, _ns, _sc
+
+    rng = np.random.default_rng(seed)
+    p = int(rng.integers(0, 3))
+    d = int(rng.integers(0, 2))
+    q = int(rng.integers(0, 3))
+    s = int(rng.integers(2, 5)) if rng.random() < 0.6 else 0
+    P = int(rng.integers(0, 2)) if s else 0
+    D = int(rng.integers(0, 2)) if s else 0
+    Q = int(rng.integers(0, 2)) if s else 0
+    if s and not (P or D or Q):
+        D = 1
+
+    def coefs(n):
+        return tuple(round(float(v), 3)
+                     for v in rng.uniform(-0.65, 0.65, size=n))
+
+    n_res = q + s * Q
+    residuals = tuple(
+        round(float(v), 3) for v in rng.normal(0, 0.4, size=n_res)
+    )
+    n_hist = d + s * D + (p + s * P) + int(rng.integers(8, 16))
+    t = np.arange(n_hist)
+    hist = tuple(
+        round(float(v), 3)
+        for v in 40
+        + 0.8 * t
+        + (4 * np.sin(2 * np.pi * t / s) if s else 0)
+        + rng.normal(0, 1.0, size=n_hist)
+    )
+    transformation = str(
+        rng.choice(("none", "none", "logarithmic", "squareroot"))
+    )
+    body = _ns(p, d, q, ar=coefs(p), ma=coefs(q),
+               residuals=residuals if n_res else ())
+    if s:
+        body += _sc(P, D, Q, s, sar=coefs(P), sma=coefs(Q))
+    doc = parse_pmml(_arima_xml(
+        body, hist,
+        constant=round(float(rng.uniform(-0.5, 0.5)), 3),
+        transformation=transformation,
+    ))
+    recs = []
+    for _ in range(24):
+        roll = rng.random()
+        if roll < 0.1:
+            recs.append({})
+        elif roll < 0.2:
+            recs.append({"h": None})
+        elif roll < 0.3:
+            recs.append({"h": float(rng.uniform(0.6, 20.0))})
+        else:
+            recs.append({"h": int(rng.integers(1, 31))})
+    tf._assert_parity(doc, recs, f"sarima seed={seed}")
+
+
 FAMILIES = {
     "trees": _soak_trees,
     "mining": _soak_mining,
     "regression": _soak_regression,
     "neural": _soak_neural,
     "glm": _soak_glm,
+    "scorecard": _soak_scorecard,
+    "sarima": _soak_sarima,
 }
 
 
