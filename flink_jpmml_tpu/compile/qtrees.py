@@ -34,7 +34,7 @@ an all-numeric-comparison tree ensemble simply reports "not eligible".
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -199,6 +199,9 @@ class QuantizedScorer:
     _jit_fn: object
     backend: str = "xla"  # "xla" | "pallas"
     labels: Tuple[str, ...] = ()  # classification class list; () = regression
+    # scan-wrapped multi-chunk dispatchers, keyed by K = n // batch_size
+    # (built lazily; one trace per distinct K — callers bound the K set)
+    _multi_fns: dict = field(default_factory=dict)
 
     @property
     def is_classification(self) -> bool:
@@ -222,17 +225,42 @@ class QuantizedScorer:
                     [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)], axis=0
                 )
             if self.backend == "pallas":
-                outs = [
-                    self._jit_fn(self.params, Xq[i : i + bs])
-                    for i in range(0, Xq.shape[0], bs)
-                ]
-                if isinstance(outs[0], tuple):  # classification triple
-                    return tuple(
-                        jnp.concatenate([o[k] for o in outs], axis=0)
-                        for k in range(len(outs[0]))
-                    )
-                return jnp.concatenate(outs, axis=0)
+                # one scan-wrapped dispatch for all K chunks: a python
+                # loop of per-chunk calls pays the device-RPC round
+                # trip K times — on a tunneled chip (~25 ms/RPC) that
+                # serialized the whole pipeline (the block pipeline's
+                # multi-chunk dispatches exist precisely to amortize it)
+                return self._multi_fn(Xq.shape[0] // bs)(self.params, Xq)
         return self._jit_fn(self.params, Xq)
+
+    def _multi_fn(self, K: int):
+        """Jitted scan over K fixed-size chunks (Pallas backend: the
+        kernel bakes its batch grid, so bigger batches iterate). Built
+        once per distinct K; callers bound the K set (the block
+        pipeline aggregates to powers of two)."""
+        if K == 1:
+            return self._jit_fn  # already compiled; no scan wrapper
+        fn = self._multi_fns.get(K)
+        if fn is None:
+            bs = self.batch_size
+            inner = getattr(self._jit_fn, "__wrapped__", self._jit_fn)
+
+            @jax.jit
+            def fn(p, Xq):
+                def body(c, xq):
+                    return c, inner(p, xq)
+
+                _, outs = jax.lax.scan(
+                    body, 0, Xq.reshape(K, bs, Xq.shape[1])
+                )
+                if isinstance(outs, tuple):  # classification triple
+                    return tuple(
+                        o.reshape((K * bs,) + o.shape[2:]) for o in outs
+                    )
+                return outs.reshape(-1)
+
+            self._multi_fns[K] = fn
+        return fn
 
     def score(self, X, M=None) -> List[Prediction]:
         n = np.asarray(X).shape[0]

@@ -286,11 +286,15 @@ class BlockPipelineBase:
         use_native: bool,
         in_flight: int,
         checkpoint,
+        max_dispatch_chunks: int = 8,
     ):
         self._source = source
         self._sink = sink
         self._arity = arity
         self._batch_size = batch_size
+        # >1 enables opportunistic multi-chunk dispatch on a backed-up
+        # ring (see _aggregate_full_batches); 1 = one batch per dispatch
+        self._max_dispatch_chunks = max(1, max_dispatch_chunks)
         self._config = config or RuntimeConfig()
         self.metrics = metrics or MetricsRegistry()
         self._ring = make_ring(
@@ -300,6 +304,10 @@ class BlockPipelineBase:
             native=use_native,
         )
         self._in_flight_max = max(1, in_flight)
+        # one drained-but-undispatched batch carried across loop
+        # iterations (aggregation stops at an offset discontinuity —
+        # a cycling source's wrap — and the chunk cannot be re-queued)
+        self._carry_drain: Optional[Tuple[np.ndarray, np.ndarray]] = None
         # see engine.Pipeline: True only for run_until_exhausted's full
         # drain; plain stop() discards the uncommitted ring backlog so it
         # returns promptly under a flooding source
@@ -414,6 +422,62 @@ class BlockPipelineBase:
     def _on_idle(self) -> None:
         pass
 
+    def _aggregate_full_batches(self, X, offsets, bs: int):
+        """Opportunistic multi-chunk dispatch: when the ring is backed
+        up (the first drain came back FULL), immediately drain further
+        already-full batches and ship them as ONE dispatch. Each device
+        dispatch pays an RPC round trip (~25 ms on the tunneled chip),
+        so K chunks per dispatch amortize it K-fold exactly like the
+        scan in the hand-written bench loop; a lightly-loaded stream
+        never aggregates (the ring holds at most one full batch), so
+        the latency operating point is untouched.
+
+        K is rounded DOWN to a power of two ≤ ``max_dispatch_chunks``:
+        the Pallas scorer compiles one scan program per distinct K, and
+        a drifting backlog yielding K=3,5,6,7… would pay a mid-stream
+        compile for each — power-of-two K bounds that to log2(max)
+        programs. Only provably-FULL extra batches are drained (a
+        partial cannot be pushed back and would force a padded
+        dispatch — measured 418k → 74k rec/s on the Kafka stream when
+        partials rode along). Drained views alias the ring's reuse
+        buffer, hence the copies."""
+        avail = 1 + len(self._ring) // bs  # full batches on hand NOW
+        k_target = 1
+        while (
+            k_target * 2 <= avail
+            and k_target * 2 <= self._max_dispatch_chunks
+        ):
+            k_target *= 2
+        if k_target == 1:
+            return X, offsets, bs
+        parts = [np.array(X, copy=True)]
+        first_off = int(offsets[0])
+        total = bs
+        while total < bs * k_target and len(self._ring) >= bs:
+            X2, off2 = self._ring.drain(0, 0)
+            n2 = X2.shape[0]
+            if n2 == 0:
+                break
+            if n2 < bs or int(off2[0]) != first_off + total:
+                # offset discontinuity: cycling sources legitimately
+                # wrap back to 0 (steady-state benches), and fabricating
+                # contiguous offsets across a gap would corrupt commit
+                # accounting — carry the drained chunk to the NEXT loop
+                # iteration as its own dispatch instead
+                self._carry_drain = (
+                    np.array(X2, copy=True), np.array(off2, copy=True)
+                )
+                break
+            parts.append(np.array(X2, copy=True))
+            total += n2
+        if len(parts) == 1:
+            return X, offsets, bs
+        X = np.concatenate(parts, axis=0)
+        offsets = np.arange(
+            first_off, first_off + total, dtype=np.uint64
+        )
+        return X, offsets, total
+
     def _dispatch_bound(self, bound: "BoundScorer", X, n):
         """Shared async dispatch through a :class:`BoundScorer` — the
         rank wire when eligible (the bucketizer folds NaN→missing during
@@ -495,10 +559,21 @@ class BlockPipelineBase:
                     if in_flight and self._IDLE_WAIT_US < 0
                     else self._IDLE_WAIT_US
                 )
-                X, offsets = self._ring.drain(
-                    batch_cfg.deadline_us, idle_us
-                )
+                if self._carry_drain is not None:
+                    X, offsets = self._carry_drain
+                    self._carry_drain = None
+                else:
+                    X, offsets = self._ring.drain(
+                        batch_cfg.deadline_us, idle_us
+                    )
                 n = X.shape[0]
+                if (
+                    n == self._batch_size  # drain limit = model batch
+                    and self._max_dispatch_chunks > 1
+                ):
+                    X, offsets, n = self._aggregate_full_batches(
+                        X, offsets, self._batch_size
+                    )
                 if n == 0:
                     if self._ring.closed:
                         break
@@ -557,6 +632,7 @@ class BlockPipeline(BlockPipelineBase):
         in_flight: int = 2,
         use_quantized: bool = True,
         checkpoint=None,
+        max_dispatch_chunks: int = 8,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -573,6 +649,7 @@ class BlockPipeline(BlockPipelineBase):
             use_native=use_native,
             in_flight=in_flight,
             checkpoint=checkpoint,
+            max_dispatch_chunks=max_dispatch_chunks,
         )
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend

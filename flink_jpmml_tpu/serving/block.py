@@ -93,6 +93,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
         hold_poll_s: float = 0.005,
         drain_hold_timeout_s: float = 5.0,
         mesh=None,
+        max_dispatch_chunks: int = 8,
     ):
         if batch_size <= 0:
             raise InputValidationException(
@@ -116,6 +117,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
             use_native=use_native,
             in_flight=in_flight,
             checkpoint=checkpoint,
+            max_dispatch_chunks=max_dispatch_chunks,
         )
         self._control = control
         self._name = name
