@@ -73,6 +73,19 @@ def lower_expression(expr: ir.Expression, ctx: LowerCtx) -> ExprFn:
         fn_name = expr.function
         mm = expr.map_missing_to
 
+        if fn_name in ("isMissing", "isNotMissing"):
+            # consumes missing-ness itself: the any-arg-missing
+            # propagation below must not fire (oracle parity)
+            probe = arg_fns[0]
+            want_missing = fn_name == "isMissing"
+
+            def pfn(X, M):
+                _, m = probe(X, M)
+                y = (m if want_missing else ~m).astype(jnp.float32)
+                return y, jnp.zeros_like(m)
+
+            return pfn
+
         def afn(X, M):
             vals, misses = zip(*(f(X, M) for f in arg_fns))
             miss = jnp.zeros_like(misses[0]) if not misses else misses[0]
@@ -150,4 +163,97 @@ def _apply(fn: str, vals):
         if len(vals) > 2:
             return jnp.where(cond, vals[1], vals[2]), zero_false
         return jnp.where(cond, vals[1], 0.0), ~cond
+    # comparisons / booleans: results are PMML booleans as 1.0/0.0
+    if fn == "equal":
+        return (vals[0] == vals[1]).astype(jnp.float32), zero_false
+    if fn == "notEqual":
+        return (vals[0] != vals[1]).astype(jnp.float32), zero_false
+    if fn == "lessThan":
+        return (vals[0] < vals[1]).astype(jnp.float32), zero_false
+    if fn == "lessOrEqual":
+        return (vals[0] <= vals[1]).astype(jnp.float32), zero_false
+    if fn == "greaterThan":
+        return (vals[0] > vals[1]).astype(jnp.float32), zero_false
+    if fn == "greaterOrEqual":
+        return (vals[0] >= vals[1]).astype(jnp.float32), zero_false
+    if fn == "and":
+        acc = vals[0] != 0.0
+        for v in vals[1:]:
+            acc = acc & (v != 0.0)
+        return acc.astype(jnp.float32), zero_false
+    if fn == "or":
+        acc = vals[0] != 0.0
+        for v in vals[1:]:
+            acc = acc | (v != 0.0)
+        return acc.astype(jnp.float32), zero_false
+    if fn == "not":
+        return (vals[0] == 0.0).astype(jnp.float32), zero_false
+    # rounding / residues
+    if fn == "round":  # PMML: 0.5 rounds UP (floor(x + 0.5))
+        return jnp.floor(vals[0] + 0.5), zero_false
+    if fn == "rint":  # IEEE half-to-even
+        return jnp.round(vals[0]), zero_false
+    if fn == "modulo":  # jnp.mod = sign of the divisor (python %)
+        bad = vals[1] == 0
+        return jnp.where(
+            bad, 0.0, jnp.mod(vals[0], jnp.where(bad, 1.0, vals[1]))
+        ), bad
+    # logs
+    if fn == "log10":
+        # sanitize only the BAD lanes (a clamp would distort valid
+        # inputs near the domain edge at f32 resolution)
+        bad = vals[0] <= 0
+        return jnp.where(
+            bad, 0.0, jnp.log10(jnp.where(bad, 1.0, vals[0]))
+        ), bad
+    if fn == "ln1p":
+        bad = vals[0] <= -1
+        return jnp.where(
+            bad, 0.0, jnp.log1p(jnp.where(bad, 0.0, vals[0]))
+        ), bad
+    if fn == "expm1":
+        return jnp.expm1(vals[0]), zero_false
+    # trigonometry
+    if fn == "sin":
+        return jnp.sin(vals[0]), zero_false
+    if fn == "cos":
+        return jnp.cos(vals[0]), zero_false
+    if fn == "tan":
+        return jnp.tan(vals[0]), zero_false
+    if fn == "asin":
+        bad = jnp.abs(vals[0]) > 1
+        return jnp.arcsin(jnp.clip(vals[0], -1.0, 1.0)), bad
+    if fn == "acos":
+        bad = jnp.abs(vals[0]) > 1
+        return jnp.arccos(jnp.clip(vals[0], -1.0, 1.0)), bad
+    if fn == "atan":
+        return jnp.arctan(vals[0]), zero_false
+    if fn == "atan2":
+        return jnp.arctan2(vals[0], vals[1]), zero_false
+    if fn == "sinh":
+        return jnp.sinh(vals[0]), zero_false
+    if fn == "cosh":
+        return jnp.cosh(vals[0]), zero_false
+    if fn == "tanh":
+        return jnp.tanh(vals[0]), zero_false
+    if fn == "hypot":
+        return jnp.hypot(vals[0], vals[1]), zero_false
+    # standard-normal family (PMML 4.4)
+    if fn == "stdNormalCDF":
+        from jax.scipy.special import erf
+
+        return 0.5 * (1.0 + erf(vals[0] / np.sqrt(2.0))), zero_false
+    if fn == "stdNormalPDF":
+        return jnp.exp(-0.5 * vals[0] * vals[0]) / np.sqrt(
+            2.0 * np.pi
+        ), zero_false
+    if fn == "stdNormalIDF":
+        from jax.scipy.special import ndtri
+
+        bad = (vals[0] <= 0) | (vals[0] >= 1)
+        # sanitize only the bad lanes: clipping valid extreme
+        # probabilities (e.g. 1e-9) would silently shift the quantile
+        return jnp.where(
+            bad, 0.0, ndtri(jnp.where(bad, 0.5, vals[0]))
+        ), bad
     raise ModelCompilationException(f"unsupported Apply function {fn!r}")

@@ -176,6 +176,19 @@ def eval_expression(expr: ir.Expression, record: Record) -> Optional[float]:
             return expr.map_missing_to
         return 1.0 if _values_equal(v, expr.value) else 0.0
     if isinstance(expr, ir.Apply):
+        if expr.function in ("isMissing", "isNotMissing"):
+            # the ONE function pair that consumes missing-ness itself:
+            # the any-arg-missing shortcut below must not fire for it.
+            # A bare FieldRef asks about record PRESENCE — a present
+            # categorical string is NOT missing even though it does not
+            # coerce to float (the compiled lane sees its codec code)
+            arg = expr.args[0]
+            if isinstance(arg, ir.FieldRef):
+                missing = _is_missing(record.get(arg.field))
+            else:
+                missing = eval_expression(arg, record) is None
+            want = expr.function == "isMissing"
+            return 1.0 if missing == want else 0.0
         args = [eval_expression(a, record) for a in expr.args]
         if any(a is None for a in args):
             return expr.map_missing_to
@@ -232,6 +245,86 @@ def _apply_function(fn: str, args: List[float]) -> Optional[float]:
             return 1.0 if args[0] > args[1] else 0.0
         if fn == "if":
             return args[1] if args[0] != 0.0 else (args[2] if len(args) > 2 else None)
+        # comparisons / booleans: results are PMML booleans as 1.0/0.0
+        if fn == "equal":
+            return 1.0 if args[0] == args[1] else 0.0
+        if fn == "notEqual":
+            return 1.0 if args[0] != args[1] else 0.0
+        if fn == "lessThan":
+            return 1.0 if args[0] < args[1] else 0.0
+        if fn == "lessOrEqual":
+            return 1.0 if args[0] <= args[1] else 0.0
+        if fn == "greaterThan":
+            return 1.0 if args[0] > args[1] else 0.0
+        if fn == "greaterOrEqual":
+            return 1.0 if args[0] >= args[1] else 0.0
+        if fn == "and":
+            return 1.0 if all(a != 0.0 for a in args) else 0.0
+        if fn == "or":
+            return 1.0 if any(a != 0.0 for a in args) else 0.0
+        if fn == "not":
+            return 1.0 if args[0] == 0.0 else 0.0
+        # rounding / residues
+        if fn == "round":  # PMML: half away from floor — 0.5 rounds UP
+            return math.floor(args[0] + 0.5)
+        if fn == "rint":  # IEEE half-to-even (python round() matches)
+            return float(round(args[0]))
+        if fn == "modulo":  # sign of the divisor (python % semantics)
+            return args[0] % args[1] if args[1] != 0 else None
+        # logs
+        if fn == "log10":
+            return math.log10(args[0]) if args[0] > 0 else None
+        if fn == "ln1p":
+            return math.log1p(args[0]) if args[0] > -1 else None
+        if fn == "expm1":
+            # overflow → inf, matching the compiled f32 path's totality
+            # (the repo convention for monotone overflow; cf. ARIMA)
+            try:
+                return math.expm1(args[0])
+            except OverflowError:
+                return math.inf
+        # trigonometry
+        if fn == "sin":
+            return math.sin(args[0])
+        if fn == "cos":
+            return math.cos(args[0])
+        if fn == "tan":
+            return math.tan(args[0])
+        if fn == "asin":
+            return math.asin(args[0]) if -1 <= args[0] <= 1 else None
+        if fn == "acos":
+            return math.acos(args[0]) if -1 <= args[0] <= 1 else None
+        if fn == "atan":
+            return math.atan(args[0])
+        if fn == "atan2":
+            return math.atan2(args[0], args[1])
+        if fn == "sinh":
+            try:
+                return math.sinh(args[0])
+            except OverflowError:
+                return math.copysign(math.inf, args[0])
+        if fn == "cosh":
+            try:
+                return math.cosh(args[0])
+            except OverflowError:
+                return math.inf
+        if fn == "tanh":
+            return math.tanh(args[0])
+        if fn == "hypot":
+            return math.hypot(args[0], args[1])
+        # standard-normal family (PMML 4.4)
+        if fn == "stdNormalCDF":
+            return 0.5 * (1.0 + math.erf(args[0] / math.sqrt(2.0)))
+        if fn == "stdNormalPDF":
+            return math.exp(-0.5 * args[0] * args[0]) / math.sqrt(
+                2.0 * math.pi
+            )
+        if fn == "stdNormalIDF":
+            if not 0.0 < args[0] < 1.0:
+                return None
+            import statistics
+
+            return statistics.NormalDist().inv_cdf(args[0])
     except (ValueError, ZeroDivisionError, OverflowError):
         return None
     raise ModelCompilationException(f"unsupported Apply function {fn!r}")

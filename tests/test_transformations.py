@@ -242,3 +242,164 @@ class TestLocalTransformations:
           </Segmentation></MiningModel></PMML>"""
         with pytest.raises(ModelLoadingException, match="LocalTransformations"):
             parse_pmml(xml)
+
+
+class TestBuiltinFunctionLibrary:
+    """The PMML 4.4 numeric built-in library (round 5 widening):
+    comparisons, booleans, isMissing/isNotMissing, rounding, residues,
+    logs, trigonometry, and the standard-normal family — compiled vs
+    oracle, including the domain-error → missing contract."""
+
+    FN_XML = """<PMML xmlns="http://www.dmg.org/PMML-4_4" version="4.4">
+      <Header/>
+      <DataDictionary numberOfFields="3">
+        <DataField name="a" optype="continuous" dataType="double"/>
+        <DataField name="b" optype="continuous" dataType="double"/>
+        <DataField name="y" optype="continuous" dataType="double"/>
+      </DataDictionary>
+      <TransformationDictionary>
+        <DerivedField name="d" optype="continuous" dataType="double">
+          <Apply function="{fn}">{args}</Apply>
+        </DerivedField>
+      </TransformationDictionary>
+      <RegressionModel functionName="regression">
+        <MiningSchema>
+          <MiningField name="y" usageType="target"/>
+          <MiningField name="a"/>
+          <MiningField name="b"/>
+        </MiningSchema>
+        <RegressionTable intercept="0.0">
+          <NumericPredictor name="d" coefficient="1.0"/>
+        </RegressionTable>
+      </RegressionModel>
+    </PMML>"""
+
+    A = '<FieldRef field="a"/>'
+    AB = '<FieldRef field="a"/><FieldRef field="b"/>'
+
+    def _diff(self, fn, args, records, rel=2e-5, abs_tol=2e-6):
+        doc = parse_pmml(self.FN_XML.format(fn=fn, args=args))
+        cm = compile_pmml(doc)
+        got = cm.score_records(records)
+        want = _oracle_values(doc, records)
+        for i, (g, w) in enumerate(zip(got, want)):
+            if np.isnan(w):
+                assert g.is_empty, (fn, records[i], g)
+            else:
+                assert not g.is_empty, (fn, records[i], "compiled empty")
+                assert abs(g.score.value - w) <= abs_tol + rel * abs(w), (
+                    fn, records[i], g.score.value, w,
+                )
+
+    def test_unary_numeric_functions(self):
+        recs = [{"a": v, "b": 0.0} for v in
+                (-2.5, -1.5, -1.0, -0.5, 0.0, 0.3, 0.5, 1.0, 1.5, 2.5)]
+        for fn in ("round", "rint", "expm1", "sin", "cos", "tan",
+                   "atan", "sinh", "cosh", "tanh", "stdNormalCDF",
+                   "stdNormalPDF", "not"):
+            self._diff(fn, self.A, recs)
+
+    def test_domain_errors_empty_the_lane(self):
+        # out-of-domain inputs must MISS (both paths), not produce junk
+        recs = [{"a": v, "b": 0.0} for v in (-2.0, -1.0, 0.0, 0.5, 2.0)]
+        for fn in ("asin", "acos", "log10", "ln1p", "stdNormalIDF"):
+            self._diff(fn, self.A, recs)
+
+    def test_binary_functions(self):
+        recs = [{"a": a, "b": b} for a in (-2.0, -0.5, 0.0, 1.0, 3.0)
+                for b in (-1.5, 0.0, 0.5, 2.0)]
+        for fn in ("equal", "notEqual", "lessThan", "lessOrEqual",
+                   "greaterThan", "greaterOrEqual", "and", "or",
+                   "modulo", "atan2", "hypot"):
+            self._diff(fn, self.AB, recs)
+
+    def test_round_is_half_up_and_rint_half_even(self):
+        doc = parse_pmml(self.FN_XML.format(fn="round", args=self.A))
+        cm = compile_pmml(doc)
+        vals = [p.score.value for p in cm.score_records(
+            [{"a": 0.5, "b": 0}, {"a": 1.5, "b": 0}, {"a": -0.5, "b": 0}]
+        )]
+        assert vals == [1.0, 2.0, 0.0]  # PMML round: 0.5 rounds UP
+        doc = parse_pmml(self.FN_XML.format(fn="rint", args=self.A))
+        cm = compile_pmml(doc)
+        vals = [p.score.value for p in cm.score_records(
+            [{"a": 0.5, "b": 0}, {"a": 1.5, "b": 0}, {"a": 2.5, "b": 0}]
+        )]
+        assert vals == [0.0, 2.0, 2.0]  # half-to-even
+
+    def test_is_missing_consumes_missingness(self):
+        # the any-arg-missing shortcut must not fire for isMissing
+        for fn, on_missing, on_present in (
+            ("isMissing", 1.0, 0.0), ("isNotMissing", 0.0, 1.0),
+        ):
+            doc = parse_pmml(self.FN_XML.format(fn=fn, args=self.A))
+            cm = compile_pmml(doc)
+            got = cm.score_records([{"a": None, "b": 0}, {"a": 3.0, "b": 0}])
+            assert got[0].score.value == on_missing
+            assert got[1].score.value == on_present
+            assert evaluate(doc, {"a": None}).value == on_missing
+            assert evaluate(doc, {"a": 3.0}).value == on_present
+
+    def test_modulo_sign_follows_divisor(self):
+        doc = parse_pmml(self.FN_XML.format(fn="modulo", args=self.AB))
+        cm = compile_pmml(doc)
+        recs = [{"a": 7.0, "b": 3.0}, {"a": -7.0, "b": 3.0},
+                {"a": 7.0, "b": -3.0}, {"a": -7.0, "b": -3.0}]
+        vals = [p.score.value for p in cm.score_records(recs)]
+        assert vals == [1.0, 2.0, -2.0, -1.0]
+        for r, v in zip(recs, vals):
+            assert evaluate(doc, r).value == v
+        # modulo by zero: missing, not a crash
+        assert cm.score_records([{"a": 1.0, "b": 0.0}])[0].is_empty
+        assert evaluate(doc, {"a": 1.0, "b": 0.0}).value is None
+
+    def test_is_missing_on_present_categorical_string(self):
+        # a present categorical value is NOT missing even though it
+        # does not coerce to float (the compiled lane holds its codec
+        # code) — both paths must agree on 0.0
+        xml = """<PMML xmlns="http://www.dmg.org/PMML-4_4" version="4.4">
+          <Header/>
+          <DataDictionary numberOfFields="2">
+            <DataField name="color" optype="categorical" dataType="string">
+              <Value value="red"/><Value value="green"/>
+            </DataField>
+            <DataField name="y" optype="continuous" dataType="double"/>
+          </DataDictionary>
+          <TransformationDictionary>
+            <DerivedField name="d" optype="continuous" dataType="double">
+              <Apply function="isMissing"><FieldRef field="color"/></Apply>
+            </DerivedField>
+          </TransformationDictionary>
+          <RegressionModel functionName="regression">
+            <MiningSchema>
+              <MiningField name="y" usageType="target"/>
+              <MiningField name="color"/>
+            </MiningSchema>
+            <RegressionTable intercept="0.0">
+              <NumericPredictor name="d" coefficient="1.0"/>
+            </RegressionTable>
+          </RegressionModel>
+        </PMML>"""
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        got = cm.score_records([{"color": "red"}, {"color": None}])
+        assert got[0].score.value == 0.0
+        assert got[1].score.value == 1.0
+        assert evaluate(doc, {"color": "red"}).value == 0.0
+        assert evaluate(doc, {"color": None}).value == 1.0
+
+    def test_extreme_but_valid_idf_is_not_clipped(self):
+        doc = parse_pmml(self.FN_XML.format(fn="stdNormalIDF", args=self.A))
+        cm = compile_pmml(doc)
+        got = cm.score_records([{"a": 1e-6, "b": 0}])[0].score.value
+        want = _oracle_values(doc, [{"a": 1e-6}])[0]
+        assert abs(got - want) < 1e-3, (got, want)  # ~-4.75, not -5.2-clip
+
+    def test_hyperbolic_overflow_is_inf_on_both_paths(self):
+        doc = parse_pmml(self.FN_XML.format(fn="sinh", args=self.A))
+        cm = compile_pmml(doc)
+        g = cm.score_records([{"a": 1000.0, "b": 0}, {"a": -1000.0, "b": 0}])
+        assert np.isinf(g[0].score.value) and g[0].score.value > 0
+        assert np.isinf(g[1].score.value) and g[1].score.value < 0
+        assert evaluate(doc, {"a": 1000.0}).value == float("inf")
+        assert evaluate(doc, {"a": -1000.0}).value == float("-inf")
