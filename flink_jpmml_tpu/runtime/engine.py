@@ -118,16 +118,20 @@ class StaticScorer(Scorer):
         )
 
     def submit(self, records: Sequence[Any]):
+        from flink_jpmml_tpu.runtime.block import _prefetch_host
+
         X, M = self._extract(records)
         n = X.shape[0]
         if self._q is not None:
             Xq = self._q.wire.encode(X, M)
             # predict_wire owns batch-size alignment (padding / chunking)
             out = self._q.predict_wire(Xq)  # async dispatch
+            _prefetch_host(out)  # D2H queued now; finish() finds it local
             return ("q", out, records, n)
         if self._model.batch_size is not None:
             X, M, _ = prepare.pad_batch(X, M, self._model.batch_size)
         out = self._model.predict(X, M)  # async dispatch
+        _prefetch_host(out)
         return ("f", out, records, n)
 
     def finish(self, ticket) -> List[Any]:
