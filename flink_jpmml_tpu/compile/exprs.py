@@ -86,6 +86,30 @@ def lower_expression(expr: ir.Expression, ctx: LowerCtx) -> ExprFn:
 
             return pfn
 
+        if fn_name in ("and", "or"):
+            # Kleene three-valued logic (JPMML BinaryBooleanFunction):
+            # a definite dominator decides the lane even when another
+            # argument is missing — and(false, missing) = false,
+            # or(true, missing) = true; only an undecided lane with a
+            # missing argument stays missing (then mapMissingTo applies)
+            is_and = fn_name == "and"
+
+            def kfn(X, M):
+                vals, misses = zip(*(f(X, M) for f in arg_fns))
+                dom = None  # lanes decided by a known dominator
+                any_miss = None
+                for v, m in zip(vals, misses):
+                    known = ~m & ((v == 0.0) if is_and else (v != 0.0))
+                    dom = known if dom is None else (dom | known)
+                    any_miss = m if any_miss is None else (any_miss | m)
+                if is_and:
+                    y = (~dom).astype(jnp.float32)  # false iff any known false
+                else:
+                    y = dom.astype(jnp.float32)  # true iff any known true
+                return _with_map_missing(y, any_miss & ~dom, mm)
+
+            return kfn
+
         def afn(X, M):
             vals, misses = zip(*(f(X, M) for f in arg_fns))
             miss = jnp.zeros_like(misses[0]) if not misses else misses[0]

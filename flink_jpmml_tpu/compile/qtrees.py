@@ -199,54 +199,98 @@ class QuantizedScorer:
     _jit_fn: object
     backend: str = "xla"  # "xla" | "pallas"
     labels: Tuple[str, ...] = ()  # classification class list; () = regression
-    # scan-wrapped multi-chunk dispatchers, keyed by K = n // batch_size
-    # (built lazily; one trace per distinct K — callers bound the K set)
+    # scan-wrapped multi-chunk dispatchers, keyed by (K, donate) with
+    # K = n // batch_size (built lazily; one trace per distinct key —
+    # callers bound the K set)
     _multi_fns: dict = field(default_factory=dict)
+    # donate_argnums twin of _jit_fn (built lazily on first donated call)
+    _donate_fn: object = None
 
     @property
     def is_classification(self) -> bool:
         return bool(self.labels)
 
-    def predict_wire(self, Xq):
-        """→ f32 values [B] (regression) or (values, probs, label_idx).
+    def pad_wire(self, Xq):
+        """Host-side batch alignment → ``(Xq_padded, K)``.
 
         The ONE place batch-size alignment happens: any batch whose length
         differs from the compile ``batch_size`` is zero-padded up to a
-        multiple of it — one padded call on the XLA path (bounded retrace
-        per distinct multiple), fixed-grid batch-size chunks on Pallas
-        (whose kernel bakes ``out_shape=(batch_size,)``). Callers pass the
-        encoded batch as-is and trim via ``decode(out, n)``."""
+        multiple of it — one padded call on the XLA path (``K == 1``,
+        bounded retrace per distinct multiple), fixed-grid batch-size
+        chunks on Pallas (``K > 1`` — the kernel bakes
+        ``out_shape=(batch_size,)``). Callers pass the encoded batch
+        as-is and trim via ``decode(out, n)``.  Split out of
+        :meth:`predict_wire` so the overlapped pipeline can stage the
+        aligned batch onto the device (``jax.device_put``) *before*
+        dispatch — see :meth:`predict_padded`."""
         n = Xq.shape[0]
         bs = self.batch_size
-        if bs is not None and n != bs:
-            pad = (-n) % bs
-            if pad:
-                Xq = np.concatenate(
-                    [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)], axis=0
-                )
-            if self.backend == "pallas":
-                # one scan-wrapped dispatch for all K chunks: a python
-                # loop of per-chunk calls pays the device-RPC round
-                # trip K times — on a tunneled chip (~25 ms/RPC) that
-                # serialized the whole pipeline (the block pipeline's
-                # multi-chunk dispatches exist precisely to amortize it)
-                return self._multi_fn(Xq.shape[0] // bs)(self.params, Xq)
-        return self._jit_fn(self.params, Xq)
+        if bs is None or n == bs:
+            return Xq, 1
+        pad = (-n) % bs
+        if pad:
+            Xq = np.concatenate(
+                [Xq, np.zeros((pad, Xq.shape[1]), Xq.dtype)], axis=0
+            )
+        if self.backend == "pallas":
+            # one scan-wrapped dispatch for all K chunks: a python
+            # loop of per-chunk calls pays the device-RPC round
+            # trip K times — on a tunneled chip (~25 ms/RPC) that
+            # serialized the whole pipeline (the block pipeline's
+            # multi-chunk dispatches exist precisely to amortize it)
+            return Xq, Xq.shape[0] // bs
+        return Xq, 1
 
-    def _multi_fn(self, K: int):
+    def predict_padded(self, Xq, K: int, donate: bool = False):
+        """Async-dispatch an already-aligned (and possibly already
+        device-resident) batch from :meth:`pad_wire`.
+
+        ``donate=True`` routes through a ``donate_argnums=(1,)`` twin of
+        the jitted entry point: a device-staged input buffer is consumed
+        by the call — released to the device allocator at dispatch
+        rather than pinned until fetch, so the overlapped pipeline's
+        steady-state input allocations stay bounded at its window depth
+        (the uint8 wire cannot output-alias the f32 scores; donation
+        frees, it does not alias).  Callers that donate must not reuse
+        ``Xq`` afterwards."""
+        return self._entry(K, donate)(self.params, Xq)
+
+    def predict_wire(self, Xq, donate: bool = False):
+        """→ f32 values [B] (regression) or (values, probs, label_idx).
+
+        Convenience compose of :meth:`pad_wire` + :meth:`predict_padded`
+        (alignment + async dispatch in one call)."""
+        Xq, K = self.pad_wire(Xq)
+        return self.predict_padded(Xq, K, donate=donate)
+
+    def _entry(self, K: int, donate: bool):
+        """The jitted entry point for K chunks, optionally donating its
+        batch argument.  Donating twins are separate compiles of the
+        same program (built lazily — callers that never donate never
+        pay them)."""
+        if K == 1:
+            if not donate:
+                return self._jit_fn
+            if self._donate_fn is None:
+                inner = getattr(self._jit_fn, "__wrapped__", self._jit_fn)
+                self._donate_fn = jax.jit(inner, donate_argnums=(1,))
+            return self._donate_fn
+        return self._multi_fn(K, donate)
+
+    def _multi_fn(self, K: int, donate: bool = False):
         """Jitted scan over K fixed-size chunks (Pallas backend: the
         kernel bakes its batch grid, so bigger batches iterate). Built
-        once per distinct K; callers bound the K set (the block
-        pipeline aggregates to powers of two)."""
+        once per distinct (K, donate); callers bound the K set (the
+        block pipeline aggregates to powers of two)."""
         if K == 1:
-            return self._jit_fn  # already compiled; no scan wrapper
-        fn = self._multi_fns.get(K)
+            return self._entry(1, donate)  # already compiled; no wrapper
+        key = (K, donate)
+        fn = self._multi_fns.get(key)
         if fn is None:
             bs = self.batch_size
             inner = getattr(self._jit_fn, "__wrapped__", self._jit_fn)
 
-            @jax.jit
-            def fn(p, Xq):
+            def scan_fn(p, Xq):
                 def body(c, xq):
                     return c, inner(p, xq)
 
@@ -259,7 +303,10 @@ class QuantizedScorer:
                     )
                 return outs.reshape(-1)
 
-            self._multi_fns[K] = fn
+            fn = jax.jit(
+                scan_fn, donate_argnums=(1,) if donate else ()
+            )
+            self._multi_fns[key] = fn
         return fn
 
     def score(self, X, M=None) -> List[Prediction]:

@@ -190,6 +190,19 @@ def eval_expression(expr: ir.Expression, record: Record) -> Optional[float]:
             want = expr.function == "isMissing"
             return 1.0 if missing == want else 0.0
         args = [eval_expression(a, record) for a in expr.args]
+        if expr.function in ("and", "or"):
+            # Kleene three-valued logic (JPMML BinaryBooleanFunction):
+            # a definite dominator wins over a missing argument —
+            # and(false, missing) = false, or(true, missing) = true;
+            # undecided-with-missing stays missing (→ mapMissingTo)
+            is_and = expr.function == "and"
+            if is_and and any(a is not None and a == 0.0 for a in args):
+                return 0.0
+            if not is_and and any(a is not None and a != 0.0 for a in args):
+                return 1.0
+            if any(a is None for a in args):
+                return expr.map_missing_to
+            return 1.0 if is_and else 0.0
         if any(a is None for a in args):
             return expr.map_missing_to
         return _apply_function(expr.function, args)

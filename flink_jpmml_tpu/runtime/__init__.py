@@ -1,6 +1,7 @@
 """Streaming micro-batch engine (SURVEY.md section 8 step 3)."""
 
 from flink_jpmml_tpu.runtime.engine import Pipeline, Scorer, StaticScorer  # noqa: F401
+from flink_jpmml_tpu.runtime.pipeline import OverlappedDispatcher  # noqa: F401
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager  # noqa: F401
 from flink_jpmml_tpu.runtime.queues import BoundedQueue, Closed  # noqa: F401
 from flink_jpmml_tpu.runtime.net import (  # noqa: F401

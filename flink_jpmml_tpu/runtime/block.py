@@ -28,6 +28,10 @@ import numpy as np
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
+from flink_jpmml_tpu.runtime.pipeline import (
+    OverlappedDispatcher,
+    _prefetch_host,  # noqa: F401  (re-export: engine.py imports it here)
+)
 from flink_jpmml_tpu.utils.config import RuntimeConfig
 from flink_jpmml_tpu.utils.exceptions import InputValidationException
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
@@ -214,20 +218,9 @@ def make_ring(capacity: int, arity: int, batch_size: int, native: bool = True):
     return _PyRing(capacity, arity, batch_size)
 
 
-def _prefetch_host(out) -> None:
-    """Queue the D2H copies for a dispatched batch NOW, so the sink's
-    later ``np.asarray`` finds the data already on the host. Without
-    this the copy is first issued inside the sink's blocking fetch, and
-    on a high-RTT link (the tunneled chip: ~66 ms round trip) every
-    batch pays the full round trip serially — measured 243k rec/s
-    through this loop vs ~1M with the prefetch (the hand-loop bench
-    always did this; the production pipeline must match it)."""
-    import jax
-
-    for leaf in jax.tree_util.tree_leaves(out):
-        fn = getattr(leaf, "copy_to_host_async", None)
-        if fn is not None:  # numpy fallback leaves are host-resident
-            fn()
+# one-shot guard for the donated-dispatch warning filter (see
+# BlockPipelineBase._resolve_donate)
+_DONATE_WARN_FILTERED = False
 
 
 class BoundScorer:
@@ -287,6 +280,7 @@ class BlockPipelineBase:
         in_flight: int,
         checkpoint,
         max_dispatch_chunks: int = 8,
+        donate: Optional[bool] = None,
     ):
         self._source = source
         self._sink = sink
@@ -304,6 +298,11 @@ class BlockPipelineBase:
             native=use_native,
         )
         self._in_flight_max = max(1, in_flight)
+        # buffer donation on the rank-wire dispatch: None = auto (on
+        # when the backend isn't CPU — XLA:CPU ignores donation with a
+        # warning per compile, so tests stay quiet by default)
+        self._donate = donate
+        self._donation_hits = self.metrics.counter("donation_hits")
         # one drained-but-undispatched batch carried across loop
         # iterations (aggregation stops at an offset discontinuity —
         # a cycling source's wrap — and the chunk cannot be re-queued)
@@ -451,41 +450,102 @@ class BlockPipelineBase:
         if k_target == 1:
             return X, offsets, bs
         parts = [np.array(X, copy=True)]
-        first_off = int(offsets[0])
+        # carry the REAL drained offset arrays, never a fabricated
+        # np.arange: a cycling source's wrap-to-0 can land INSIDE the
+        # first drained batch (the ring stitches chunks from both sides
+        # of the wrap), and synthesized-contiguous offsets would mislabel
+        # every record after the wrap
+        off_parts = [np.array(offsets, copy=True)]
         total = bs
         while total < bs * k_target and len(self._ring) >= bs:
             X2, off2 = self._ring.drain(0, 0)
             n2 = X2.shape[0]
             if n2 == 0:
                 break
-            if n2 < bs or int(off2[0]) != first_off + total:
+            if n2 < bs or int(off2[0]) != int(off_parts[-1][-1]) + 1:
                 # offset discontinuity: cycling sources legitimately
-                # wrap back to 0 (steady-state benches), and fabricating
-                # contiguous offsets across a gap would corrupt commit
-                # accounting — carry the drained chunk to the NEXT loop
-                # iteration as its own dispatch instead
+                # wrap back to 0 (steady-state benches), and aggregating
+                # across the gap would break the one-dispatch ==
+                # contiguous-commit-range invariant — carry the drained
+                # chunk to the NEXT loop iteration as its own dispatch
                 self._carry_drain = (
                     np.array(X2, copy=True), np.array(off2, copy=True)
                 )
                 break
             parts.append(np.array(X2, copy=True))
+            off_parts.append(np.array(off2, copy=True))
             total += n2
         if len(parts) == 1:
-            return X, offsets, bs
+            # MUST return the copies, not the drained views: X/offsets
+            # alias the ring's reuse buffer, and a discontinuous extra
+            # drain above just overwrote it in place — returning the
+            # aliased views would ship the carried chunk's data twice
+            # and lose this batch entirely
+            return parts[0], off_parts[0], bs
         X = np.concatenate(parts, axis=0)
-        offsets = np.arange(
-            first_off, first_off + total, dtype=np.uint64
-        )
+        offsets = np.concatenate(off_parts)
         return X, offsets, total
+
+    def _resolve_donate(self) -> bool:
+        """Donation default: on unless the backend is CPU. Resolved
+        once, lazily — backend identity needs jax initialized.
+
+        The wire batch (uint8/uint16 [B, F]) can never output-alias the
+        f32 score outputs, so XLA flags every donated compile with a
+        "donated buffers were not usable" warning; the donation still
+        releases the staging buffer to the device allocator at dispatch
+        (bounding steady-state input allocations to the window depth)
+        rather than holding it to fetch time, so it is kept — and the
+        known-inert warning is silenced once, only when a pipeline
+        actually donates, and only for the rank-wire uint dtypes: an
+        application's own f32 donation warnings (where failed aliasing
+        IS actionable) stay visible."""
+        if self._donate is None:
+            from flink_jpmml_tpu.compile import common
+
+            self._donate = not common.backend_is_cpu()
+        global _DONATE_WARN_FILTERED
+        if self._donate and not _DONATE_WARN_FILTERED:
+            import warnings
+
+            warnings.filterwarnings(
+                "ignore",
+                message=(
+                    r"Some donated buffers were not usable: "
+                    r"ShapedArray\(uint(8|16)\["
+                ),
+            )
+            _DONATE_WARN_FILTERED = True
+        return self._donate
 
     def _dispatch_bound(self, bound: "BoundScorer", X, n):
         """Shared async dispatch through a :class:`BoundScorer` — the
         rank wire when eligible (the bucketizer folds NaN→missing during
         encoding: no separate host-side NaN pass, no f32 mask plane),
-        the f32 path otherwise."""
+        the f32 path otherwise.
+
+        Rank-wire dispatches stage the encoded batch onto the device
+        explicitly (``jax.device_put``, async) and donate the staging
+        buffer to the jitted call: the buffer is released to the device
+        allocator at dispatch instead of being pinned until fetch, so
+        with the depth-2 in-flight window steady-state input allocations
+        stay bounded at two staging buffers. ``donation_hits`` counts
+        dispatches whose staging buffer was actually consumed
+        (invalidated) by the call — 0 on backends that ignore
+        donation."""
         if bound.q is not None:
-            Xq = bound.q.wire.encode(X)
-            return bound.q.predict_wire(Xq)  # async dispatch
+            q = bound.q
+            Xq, K = q.pad_wire(q.wire.encode(X))
+            if self._resolve_donate():
+                import jax
+
+                staged = jax.device_put(Xq)  # async H2D staging copy
+                out = q.predict_padded(staged, K, donate=True)
+                deleted = getattr(staged, "is_deleted", None)
+                if deleted is not None and deleted():
+                    self._donation_hits.inc()
+                return out
+            return q.predict_padded(Xq, K)  # async dispatch
         return self._score_f32(bound.model, X, n)
 
     def _score_f32(self, model, X, n):
@@ -531,20 +591,29 @@ class BlockPipelineBase:
         batches = self.metrics.counter("batches")
         fill = self.metrics.counter("batch_fill_records")
         lat = self.metrics.reservoir("batch_latency_s")
-        in_flight: List[Tuple] = []
 
-        def _finish_one():
-            out, n, first_off, t_start, decode = in_flight.pop(0)
+        def _complete(pair, meta):
+            """FIFO completion off the dispatcher: sink, then commit —
+            offsets only advance past records that reached the sink."""
+            out, decode = pair
+            n, first_off, t_start = meta
             self._emit(out, n, first_off, decode)
             lat.observe(time.monotonic() - t_start)
             records_out.inc(n)
             self.committed_offset = first_off + n
             self._ckpt.maybe_save(self._ckpt_state)
 
-        def _drain_inflight_one():
-            """Safe for hooks: finish the oldest in-flight batch if any."""
-            if in_flight:
-                _finish_one()
+        # the overlapped in-flight window: batch N executes on device
+        # while batch N+1 is drained, encoded, and staged here — the
+        # window only ever blocks on its own oldest dispatch, so the
+        # ring's fill-or-deadline semantics are untouched. in_flight=1
+        # keeps its historical meaning (finish every batch before the
+        # next drain — the latency operating point) via depth 0.
+        disp = OverlappedDispatcher(
+            depth=self._in_flight_max if self._in_flight_max > 1 else 0,
+            metrics=self.metrics,
+            complete=_complete,
+        )
 
         try:
             while True:
@@ -556,7 +625,7 @@ class BlockPipelineBase:
                 # their offsets unsaved) until new data arrives
                 idle_us = (
                     min(batch_cfg.deadline_us, 20_000)
-                    if in_flight and self._IDLE_WAIT_US < 0
+                    if len(disp) and self._IDLE_WAIT_US < 0
                     else self._IDLE_WAIT_US
                 )
                 if self._carry_drain is not None:
@@ -581,26 +650,23 @@ class BlockPipelineBase:
                     # hold completed batches uncommitted until NEW data
                     # arrives — unbounded tail latency (and a stuck
                     # committed_offset) on a paused feed. Flush it.
-                    while in_flight:
-                        _finish_one()
+                    disp.flush()
                     self._on_idle()
                     continue
-                handle = self._acquire(_drain_inflight_one)
+                handle = self._acquire(disp.finish_oldest)
                 if handle is None:
-                    return  # abandoned (records replay from the
-                    # committed offset on restore)
+                    # abandoned (dynamic give-up): drop un-fetched work;
+                    # records replay from the committed offset on restore
+                    disp.abandon()
+                    return
                 t_start = time.monotonic()
-                out, decode = self._dispatch(handle, X, n)
-                _prefetch_host(out)
-                in_flight.append(
-                    (out, n, int(offsets[0]) if n else 0, t_start, decode)
+                disp.launch(
+                    lambda h=handle, X=X, n=n: self._dispatch(h, X, n),
+                    meta=(n, int(offsets[0]) if n else 0, t_start),
                 )
                 batches.inc()
                 fill.inc(n)
-                if len(in_flight) >= self._in_flight_max:
-                    _finish_one()
-            while in_flight:
-                _finish_one()
+            disp.close()  # drain the window: every dispatched batch sinks
             self._ckpt.save_now(self._ckpt_state)  # clean drain → exact resume
         except BaseException as e:
             self._error = e
@@ -633,6 +699,7 @@ class BlockPipeline(BlockPipelineBase):
         use_quantized: bool = True,
         checkpoint=None,
         max_dispatch_chunks: int = 8,
+        donate: Optional[bool] = None,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -650,6 +717,7 @@ class BlockPipeline(BlockPipelineBase):
             in_flight=in_flight,
             checkpoint=checkpoint,
             max_dispatch_chunks=max_dispatch_chunks,
+            donate=donate,
         )
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend

@@ -101,8 +101,13 @@ class CheckpointManager:
             try:
                 state = self._read_state(path)
             except (
-                json.JSONDecodeError, KeyError, TypeError,
-            ) as e:  # TypeError: valid JSON that isn't a dict payload
+                ValueError, KeyError, TypeError,
+            ) as e:
+                # ValueError covers json.JSONDecodeError (malformed
+                # JSON) and UnicodeDecodeError (bit-rot turned the
+                # newest snapshot into invalid UTF-8 — deterministic
+                # corruption, not a transient read failure); TypeError:
+                # valid JSON that isn't a dict payload
                 errors.append(f"{path!r}: {e}")
                 continue
             except FileNotFoundError as e:

@@ -776,7 +776,9 @@ class _KafkaSourceBase:
                 "checkpoint carries a per-partition cursor vector "
                 "(written by interleave='auto') but this source is "
                 "strict/single-partition; construct it with "
-                "interleave='auto' to resume"
+                "interleave='auto' to resume (migration notes: "
+                "docs/migration.md, 'Kafka multi-partition interleave "
+                "and checkpoint migration')"
             )
         cursors = {
             int(p): int(off) for p, off in state["cursors"].items()
@@ -811,7 +813,9 @@ class _KafkaSourceBase:
                 "arbitrary scalar seeks only exist in strict mode. "
                 "Restoring a legacy scalar-only checkpoint (written by "
                 "the pre-vector strict bijection)? Construct the "
-                "source with interleave='strict'."
+                "source with interleave='strict' (migration notes: "
+                "docs/migration.md, 'Kafka multi-partition interleave "
+                "and checkpoint migration')."
             )
         self._next = offset
         self._g = offset

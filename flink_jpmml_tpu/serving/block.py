@@ -94,6 +94,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
         drain_hold_timeout_s: float = 5.0,
         mesh=None,
         max_dispatch_chunks: int = 8,
+        donate: Optional[bool] = None,
     ):
         if batch_size <= 0:
             raise InputValidationException(
@@ -118,6 +119,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
             in_flight=in_flight,
             checkpoint=checkpoint,
             max_dispatch_chunks=max_dispatch_chunks,
+            donate=donate,
         )
         self._control = control
         self._name = name

@@ -28,10 +28,12 @@ from flink_jpmml_tpu.api.reader import ModelReader
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.models.prediction import Prediction
 from flink_jpmml_tpu.runtime.engine import Scorer
+from flink_jpmml_tpu.runtime.pipeline import OverlappedDispatcher
 from flink_jpmml_tpu.runtime.sources import ControlSource
 from flink_jpmml_tpu.serving.registry import ModelRegistry
 from flink_jpmml_tpu.utils.config import CompileConfig
 from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 
 # route(event) -> (name, version|None, record)
 RouteFn = Callable[[Any], Tuple[Optional[str], Optional[int], Any]]
@@ -59,13 +61,25 @@ class DynamicScorer(Scorer):
         emit: Optional[Callable[[Sequence[Any], List[Prediction]], List[Any]]] = None,
         async_warmup: bool = True,
         mesh=None,
+        metrics: Optional[MetricsRegistry] = None,
+        in_flight: Optional[int] = None,
     ):
         """``async_warmup=False`` disables background warming: a newly
         Added model compiles synchronously inside ``submit`` on its first
         matching event (the reference's operator-blocking lazy load) —
         kept for comparison/tests; the default never stalls the batch
         loop on a compile. ``mesh`` serves every model (default
-        included) mesh-aware — see :class:`ModelRegistry`."""
+        included) mesh-aware — see :class:`ModelRegistry`.
+
+        Per-group device dispatches run through a shared
+        :class:`OverlappedDispatcher` (D2H prefetch at dispatch, FIFO
+        fetch with stall accounting in ``finish``). ``in_flight``
+        optionally bounds pending group dispatches across tickets; the
+        default None is UNBOUNDED because the :class:`Scorer` contract
+        requires ``submit`` to dispatch without blocking on device work
+        — the engine's own submit/finish window is the backpressure.
+        ``metrics`` shares a registry so stall time and in-flight depth
+        land next to the caller's counters."""
         self.registry = ModelRegistry(
             batch_size=batch_size,
             compile_config=compile_config,
@@ -84,6 +98,10 @@ class DynamicScorer(Scorer):
         self._replace_nan = replace_nan
         self._emit_pairs = emit_pairs
         self._emit = emit
+        self.metrics = metrics or MetricsRegistry()
+        self._dispatcher = OverlappedDispatcher(
+            depth=in_flight, metrics=self.metrics
+        )
         # models whose load/compile failed: don't re-attempt every batch;
         # cleared when the registry changes (a fixed version can be re-Added)
         self._failed: set = set()
@@ -179,23 +197,33 @@ class DynamicScorer(Scorer):
                     self._replace_nan,
                 )
             # rank-wire fast path per served model (qtrees.py; cached on
-            # the CompiledModel, so the probe is free after the first batch)
+            # the CompiledModel, so the probe is free after the first
+            # batch). Each group's device call launches through the
+            # shared overlapped window: dispatch stays async, D2H copies
+            # are prefetched, and the window depth bounds how far device
+            # work can run ahead of the finish() fetches.
             q = model.quantized_scorer()
             if q is not None:
                 # predict_wire owns batch-size alignment (padding/chunking)
                 Xq = q.wire.encode(X, M)
-                tickets.append((q, idxs, q.predict_wire(Xq)))
+                handle = self._dispatcher.launch(
+                    lambda q=q, Xq=Xq: q.predict_wire(Xq)
+                )
+                tickets.append((q, idxs, handle))
                 continue
             if model.batch_size is not None:
                 X, M, _ = prepare.pad_batch(X, M, model.batch_size)
-            out = model.predict(X, M)  # async dispatch per group
-            tickets.append((model, idxs, out))
+            handle = self._dispatcher.launch(
+                lambda m=model, X=X, M=M: m.predict(X, M)
+            )
+            tickets.append((model, idxs, handle))
         return (n, records, tickets, unserved)
 
     def finish(self, ticket) -> List[Any]:
         n, records, tickets, unserved = ticket
         preds: List[Optional[Prediction]] = [None] * n
-        for model, idxs, out in tickets:
+        for model, idxs, handle in tickets:
+            out = self._dispatcher.wait(handle)
             decoded = model.decode(out, len(idxs))
             for i, p in zip(idxs, decoded):
                 preds[i] = p

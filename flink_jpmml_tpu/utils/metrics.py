@@ -28,6 +28,25 @@ class Counter:
             return self.value
 
 
+@dataclass
+class Gauge:
+    """Last-set value + high-water mark (e.g. in-flight dispatch depth)."""
+
+    value: float = 0.0
+    max: float = 0.0
+    _lock: threading.Lock = dc_field(default_factory=threading.Lock, repr=False)
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+            if v > self.max:
+                self.max = v
+
+    def get(self) -> float:
+        with self._lock:
+            return self.value
+
+
 class Reservoir:
     """Fixed-size sampling reservoir for latency quantiles.
 
@@ -68,6 +87,7 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._counters: Dict[str, Counter] = {}
         self._reservoirs: Dict[str, Reservoir] = {}
+        self._gauges: Dict[str, Gauge] = {}
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
 
@@ -79,16 +99,24 @@ class MetricsRegistry:
         with self._lock:
             return self._reservoirs.setdefault(name, Reservoir())
 
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
     def snapshot(self) -> Dict[str, float]:
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         out: Dict[str, float] = {"uptime_s": elapsed}
         with self._lock:
             counters = dict(self._counters)
             reservoirs = dict(self._reservoirs)
+            gauges = dict(self._gauges)
         for name, c in counters.items():
             v = c.get()
             out[name] = v
             out[name + "_per_s"] = v / elapsed
+        for name, g in gauges.items():
+            out[name] = g.get()
+            out[name + "_max"] = g.max
         for name, r in reservoirs.items():
             for q, tag in ((0.5, "p50"), (0.99, "p99")):
                 v = r.quantile(q)

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Iterator, Optional
+from typing import Dict, Iterator, Optional
 
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 
@@ -46,6 +46,30 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def overlap_stats(
+    metrics: MetricsRegistry, elapsed_s: float
+) -> Dict[str, float]:
+    """Overlap accounting for a run through the
+    :class:`~flink_jpmml_tpu.runtime.pipeline.OverlappedDispatcher`.
+
+    ``h2d_stall_ms`` is the total host time spent blocked on device
+    completion (the dispatcher's ``h2d_stall_s`` counter);
+    ``overlap_efficiency`` is the fraction of the run's wall clock the
+    host was NOT so blocked — 1.0 means host staging fully hid behind
+    device execution.  The bench emits both per operating mode.
+    """
+    stall = metrics.counter("h2d_stall_s").get()
+    eff = 1.0
+    if elapsed_s > 0:
+        eff = max(0.0, min(1.0, 1.0 - stall / elapsed_s))
+    return {
+        "overlap_efficiency": round(eff, 4),
+        "h2d_stall_ms": round(1000.0 * stall, 3),
+        "inflight_depth_max": metrics.gauge("inflight_depth").max,
+        "donation_hits": metrics.counter("donation_hits").get(),
+    }
 
 
 class StageTimer:

@@ -44,6 +44,32 @@ class TestCheckpointManager:
             state = mgr.load_latest()
         assert state == {"source_offset": 2}  # older offset: replay, not loss
 
+    def test_invalid_utf8_latest_falls_back_with_warning(self, tmp_path):
+        # bit-rot can turn the newest snapshot into NON-UTF-8 bytes: the
+        # decode error is deterministic corruption (UnicodeDecodeError,
+        # a ValueError), so restore must fall back to an older retained
+        # snapshot exactly like malformed JSON — not crash the resume
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        mgr.save({"source_offset": 7})
+        time.sleep(0.002)
+        mgr.save({"source_offset": 8})
+        newest = sorted(tmp_path.glob("ckpt-*.json"))[-1]
+        newest.write_bytes(b'{"state": \xff\xfe\x80 torn}')
+        with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+            state = mgr.load_latest()
+        assert state == {"source_offset": 7}
+
+    def test_truncated_latest_falls_back_with_warning(self, tmp_path):
+        # a truncated-to-empty newest file is the classic torn-disk shape
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        mgr.save({"source_offset": 4})
+        time.sleep(0.002)
+        mgr.save({"source_offset": 5})
+        newest = sorted(tmp_path.glob("ckpt-*.json"))[-1]
+        newest.write_bytes(b"")
+        with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+            assert mgr.load_latest() == {"source_offset": 4}
+
     def test_all_corrupt_is_typed_error(self, tmp_path):
         mgr = CheckpointManager(str(tmp_path), keep=2)
         mgr.save({"source_offset": 1})

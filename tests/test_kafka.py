@@ -652,6 +652,64 @@ class TestMultiPartitionResume:
         )
 
 
+class TestInterleaveMigration:
+    """The strict-resume migration path (docs/migration.md, 'Kafka
+    multi-partition interleave and checkpoint migration'): a legacy
+    scalar-only checkpoint written by the pre-vector strict bijection
+    (a) is REFUSED by a default-constructed (auto) source with a pointer
+    to the migration notes, and (b) resumes exactly when the source is
+    constructed with interleave='strict' as the notes prescribe.
+    Fast-loop on purpose: tier-1 guards the migration contract."""
+
+    def test_legacy_scalar_checkpoint_migration_path(self):
+        rng = np.random.default_rng(9)
+        data = rng.normal(size=(40, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="vec", n_partitions=2)
+        try:
+            # round-robin producer: global index g lives at partition
+            # g % 2, offset g // 2 — the strict bijection's layout
+            broker.append_rows(data[0::2], partition=0)
+            broker.append_rows(data[1::2], partition=1)
+
+            # (a) the post-default-change constructor (auto) cannot
+            # expand a scalar offset; the error routes to the docs
+            src_auto = KafkaBlockSource(
+                broker.host, broker.port, "vec",
+                partitions=[0, 1], n_cols=4, max_wait_ms=20,
+            )
+            with pytest.raises(
+                KafkaProtocolError, match="docs/migration.md"
+            ):
+                src_auto.seek(10)  # the legacy checkpoint's scalar
+            src_auto.close()
+
+            # (b) the documented migration: interleave='strict' resumes
+            # the same scalar exactly, records in producer order
+            src = KafkaBlockSource(
+                broker.host, broker.port, "vec",
+                partitions=[0, 1], n_cols=4, max_wait_ms=20,
+                interleave="strict",
+            )
+            src.seek(10)
+            got, pos = [], 10
+            deadline = time.monotonic() + 10.0
+            while len(got) < 30 and time.monotonic() < deadline:
+                polled = src.poll()
+                if polled is None:
+                    time.sleep(0.01)
+                    continue
+                off, blk = polled
+                assert off == pos
+                pos += blk.shape[0]
+                got.extend(np.asarray(blk))
+            src.close()
+            np.testing.assert_array_equal(
+                np.asarray(got), data[10:40]
+            )
+        finally:
+            broker.close()
+
+
 @pytest.mark.slow
 class TestVectorOffsets:
     """Multi-partition ``interleave="auto"`` (the default): keyed

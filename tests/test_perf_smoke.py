@@ -1,0 +1,30 @@
+"""Tier-1 guard for the overlapped-dispatch concurrency code: run the
+tools/perf_smoke.py check in a subprocess (its watchdog converts a
+shutdown hang into a non-zero exit instead of a wedged test session).
+Deliberately NOT marked slow — this is the fast-loop tripwire for
+ordering and shutdown regressions in runtime/pipeline.py."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+_SMOKE = (
+    pathlib.Path(__file__).resolve().parent.parent / "tools" / "perf_smoke.py"
+)
+
+
+def test_perf_smoke_passes():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FJT_SMOKE_WATCHDOG_S"] = "120"
+    proc = subprocess.run(
+        [sys.executable, str(_SMOKE)],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"perf smoke rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
+    )
+    assert "dispatcher ordering OK" in proc.stdout
+    assert "block pipeline drain/ordering OK" in proc.stdout

@@ -1,0 +1,307 @@
+"""OverlappedDispatcher unit tests + sync-vs-overlapped parity.
+
+The depth-K in-flight window (runtime/pipeline.py) is the concurrency
+core every scoring path now runs through; these tests pin its contract:
+FIFO completion, depth bounds, exception propagation from an in-flight
+batch, drain-on-close — and that the overlapped block pipeline produces
+byte-identical scores to the synchronous one on CPU.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+from flink_jpmml_tpu.runtime.pipeline import (
+    DispatcherClosed,
+    OverlappedDispatcher,
+)
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+from flink_jpmml_tpu.utils.profiling import overlap_stats
+
+
+class _Leaf:
+    """Test double for an async device result: readiness is observable
+    and can be delayed or poisoned."""
+
+    def __init__(self, tag, delay_s=0.0, fail=None):
+        self.tag = tag
+        self.delay_s = delay_s
+        self.fail = fail
+        self.fetched = False
+        self.prefetched = False
+
+    def copy_to_host_async(self):
+        self.prefetched = True
+
+    def block_until_ready(self):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail is not None:
+            raise self.fail
+        self.fetched = True
+
+
+class TestDispatcherUnit:
+    def test_fifo_ordering_under_reversed_readiness(self):
+        # later launches become ready FIRST; completion must still be
+        # launch order (the commit protocol rides on it)
+        seen = []
+        disp = OverlappedDispatcher(
+            depth=2, complete=lambda out, meta: seen.append(meta)
+        )
+        for i in range(10):
+            disp.launch(
+                lambda i=i: _Leaf(i, delay_s=max(0.0, (5 - i) * 0.001)),
+                meta=i,
+            )
+        disp.flush()
+        assert seen == list(range(10))
+
+    def test_depth_bound_and_prefetch(self):
+        disp = OverlappedDispatcher(depth=3)
+        leaves = []
+        for i in range(10):
+            leaf = _Leaf(i)
+            leaves.append(leaf)
+            disp.launch(lambda leaf=leaf: leaf)
+            assert len(disp) <= 3  # never exceeds the window after launch
+            assert leaf.prefetched  # D2H queued at launch, not at fetch
+        # the first 7 were forced out by the window; the last 3 pend
+        assert [lf.fetched for lf in leaves] == [True] * 7 + [False] * 3
+        disp.flush()
+        assert all(lf.fetched for lf in leaves)
+
+    def test_depth_zero_is_synchronous(self):
+        # the latency operating point: every launch finishes its own batch
+        disp = OverlappedDispatcher(depth=0)
+        leaf = _Leaf(0)
+        disp.launch(lambda: leaf)
+        assert leaf.fetched and len(disp) == 0
+
+    def test_unbounded_depth_never_blocks_in_launch(self):
+        # depth=None: the Scorer contract's shape — submit-side launches
+        # must not block on device completion; the caller bounds the
+        # window itself via wait/finish
+        disp = OverlappedDispatcher(depth=None)
+        leaves = [_Leaf(i) for i in range(20)]
+        for leaf in leaves:
+            disp.launch(lambda leaf=leaf: leaf)
+        assert len(disp) == 20  # nothing was force-finished
+        assert not any(lf.fetched for lf in leaves)
+        disp.flush()
+        assert all(lf.fetched for lf in leaves)
+
+    def test_wait_on_failed_handle_reraises_every_time(self):
+        # a fetch failure must not be handed back as a completed result
+        disp = OverlappedDispatcher(depth=None)
+        bad = disp.launch(
+            lambda: _Leaf("bad", fail=RuntimeError("device died"))
+        )
+        with pytest.raises(RuntimeError, match="device died"):
+            disp.wait(bad)
+        # the poisoned entry left the window, but a retry must re-raise
+        # the original error, never return the unsynchronized output
+        with pytest.raises(RuntimeError, match="device died"):
+            disp.wait(bad)
+
+    def test_wait_on_abandoned_handle_still_synchronizes(self):
+        # wait() must never hand back an unsynchronized result — even
+        # for a handle the window dropped via abandon()
+        disp = OverlappedDispatcher(depth=None)
+        ok = disp.launch(lambda: _Leaf("ok"))
+        bad = disp.launch(
+            lambda: _Leaf("bad", fail=RuntimeError("late device error"))
+        )
+        disp.abandon()
+        out = disp.wait(ok)  # fetched directly, not returned raw
+        assert out.fetched
+        with pytest.raises(RuntimeError, match="late device error"):
+            disp.wait(bad)
+
+    def test_inflight_error_propagates_and_window_survives(self):
+        seen = []
+        disp = OverlappedDispatcher(
+            depth=8, complete=lambda out, meta: seen.append(meta)
+        )
+        disp.launch(lambda: _Leaf("a"), meta="a")
+        disp.launch(lambda: _Leaf("bad", fail=RuntimeError("device died")),
+                    meta="bad")
+        disp.launch(lambda: _Leaf("b"), meta="b")
+        with pytest.raises(RuntimeError, match="device died"):
+            disp.flush()
+        # the poisoned entry left the window (no wedged flushes) and the
+        # batches behind it remain drainable
+        assert seen == ["a"]
+        disp.flush()
+        assert seen == ["a", "b"]
+
+    def test_launch_error_propagates(self):
+        disp = OverlappedDispatcher(depth=2)
+        with pytest.raises(ValueError, match="encode exploded"):
+            disp.launch(lambda: (_ for _ in ()).throw(
+                ValueError("encode exploded")
+            ))
+        assert len(disp) == 0
+
+    def test_wait_finishes_fifo_up_to_handle(self):
+        seen = []
+        disp = OverlappedDispatcher(
+            depth=8, complete=lambda out, meta: seen.append(meta)
+        )
+        h1 = disp.launch(lambda: _Leaf(1), meta=1)
+        h2 = disp.launch(lambda: _Leaf(2), meta=2)
+        h3 = disp.launch(lambda: _Leaf(3), meta=3)
+        out = disp.wait(h2)
+        assert out.tag == 2 and seen == [1, 2] and len(disp) == 1
+        disp.wait(h1)  # already finished: no-op
+        assert seen == [1, 2]
+        disp.wait(h3)
+        assert seen == [1, 2, 3]
+
+    def test_close_drains_and_refuses_further_launches(self):
+        seen = []
+        disp = OverlappedDispatcher(
+            depth=4, complete=lambda out, meta: seen.append(meta)
+        )
+        for i in range(3):
+            disp.launch(lambda i=i: _Leaf(i), meta=i)
+        disp.close()
+        assert seen == [0, 1, 2] and len(disp) == 0
+        with pytest.raises(DispatcherClosed):
+            disp.launch(lambda: _Leaf(9))
+
+    def test_abandon_drops_without_fetching(self):
+        disp = OverlappedDispatcher(depth=4)
+        leaves = [_Leaf(i) for i in range(3)]
+        for leaf in leaves:
+            disp.launch(lambda leaf=leaf: leaf)
+        assert disp.abandon() == 3
+        assert len(disp) == 0
+        assert not any(lf.fetched for lf in leaves)
+
+    def test_stall_and_depth_metrics(self):
+        m = MetricsRegistry()
+        disp = OverlappedDispatcher(depth=2, metrics=m)
+        t0 = time.monotonic()
+        for i in range(4):
+            disp.launch(lambda: _Leaf(0, delay_s=0.02))
+        disp.flush()
+        elapsed = time.monotonic() - t0
+        snap = m.snapshot()
+        assert snap["dispatches"] == 4
+        assert 0 < snap["h2d_stall_s"] <= elapsed + 0.1
+        assert snap["inflight_depth_max"] == 2
+        stats = overlap_stats(m, elapsed)
+        assert 0.0 <= stats["overlap_efficiency"] <= 1.0
+        assert stats["h2d_stall_ms"] == pytest.approx(
+            1000 * snap["h2d_stall_s"], abs=0.002  # field rounds to µs
+        )
+
+
+class TestSyncOverlapParity:
+    @pytest.fixture(scope="class")
+    def gbm(self, tmp_path_factory):
+        from assets.generate import gen_gbm
+
+        tmp = tmp_path_factory.mktemp("disp_gbm")
+        doc = parse_pmml_file(
+            gen_gbm(str(tmp), n_trees=20, depth=4, n_features=6)
+        )
+        return compile_pmml(doc, batch_size=128)
+
+    def _scores(self, cm, data, **kw):
+        got = np.full((data.shape[0],), np.nan, np.float32)
+
+        def sink(out, n, first_off):
+            vals = np.asarray(
+                out.value if hasattr(out, "value") else out, np.float32
+            )[:n]
+            got[first_off : first_off + n] = vals
+
+        pipe = BlockPipeline(
+            FiniteBlockSource(data, block_size=100),
+            cm, sink, use_native=False, **kw,
+        )
+        pipe.run_until_exhausted(timeout=60.0)
+        assert not np.isnan(got).any()
+        return got, pipe
+
+    def test_overlapped_matches_synchronous_byte_exact(self, gbm):
+        rng = np.random.default_rng(11)
+        data = rng.normal(0.0, 1.5, size=(1000, 6)).astype(np.float32)
+        data[rng.random(size=data.shape) < 0.05] = np.nan
+
+        sync, _ = self._scores(gbm, data, in_flight=1)
+        over, pipe = self._scores(
+            gbm, data, in_flight=3, max_dispatch_chunks=4
+        )
+        # byte-identical, not allclose: the overlapped window reorders
+        # nothing and computes the same program on the same batches
+        np.testing.assert_array_equal(sync, over)
+        assert pipe.metrics.snapshot()["dispatches"] >= 1
+
+    def test_donation_path_scores_identically(self, gbm):
+        # donate=True on CPU: XLA ignores the donation (0 hits) but the
+        # staged-dispatch path must still produce identical scores
+        rng = np.random.default_rng(12)
+        data = rng.normal(0.0, 1.5, size=(600, 6)).astype(np.float32)
+        plain, _ = self._scores(gbm, data, in_flight=2, donate=False)
+        donated, pipe = self._scores(gbm, data, in_flight=2, donate=True)
+        np.testing.assert_array_equal(plain, donated)
+        assert pipe.metrics.snapshot()["donation_hits"] >= 0
+
+
+class TestAggregationOffsets:
+    def test_wrap_inside_first_batch_keeps_real_offsets(self, monkeypatch):
+        """A cycling source's wrap-to-0 landing INSIDE the first drained
+        batch must surface the REAL per-record offsets (concatenated
+        from the ring's chunks), never a fabricated contiguous range."""
+        from flink_jpmml_tpu.runtime.block import BlockPipelineBase
+
+        pipe = BlockPipelineBase(
+            source=None, sink=lambda *a: None, arity=2, batch_size=4,
+            config=None, metrics=None, use_native=False, in_flight=1,
+            checkpoint=None, max_dispatch_chunks=4,
+        )
+        ring = pipe._ring
+        # chunk A: offsets 6..7 (tail of the log), chunk B: wrap to 0..5
+        ring.push_block(np.full((2, 2), 1.0, np.float32), 6)
+        ring.push_block(np.full((6, 2), 2.0, np.float32), 0)
+        X, offs = ring.drain(1000, 0)
+        assert X.shape[0] == 4  # first batch spans the wrap
+        assert offs.tolist() == [6, 7, 0, 1]
+        X2, offs2, n = pipe._aggregate_full_batches(X, offs, 4)
+        # the second FULL batch (offsets 2..5) is NOT contiguous with the
+        # first batch's real tail (offset 1 → 2 IS contiguous here), so
+        # aggregation may take it; what matters is offsets stay REAL
+        assert n == offs2.shape[0] == X2.shape[0]
+        assert offs2[:4].tolist() == [6, 7, 0, 1]
+        if n == 8:
+            assert offs2.tolist() == [6, 7, 0, 1, 2, 3, 4, 5]
+
+    def test_discontinuous_extra_batch_is_carried(self):
+        from flink_jpmml_tpu.runtime.block import BlockPipelineBase
+
+        pipe = BlockPipelineBase(
+            source=None, sink=lambda *a: None, arity=1, batch_size=4,
+            config=None, metrics=None, use_native=False, in_flight=1,
+            checkpoint=None, max_dispatch_chunks=4,
+        )
+        ring = pipe._ring
+        ring.push_block(np.ones((4, 1), np.float32), 100)
+        ring.push_block(np.ones((4, 1), np.float32), 0)  # wrap at a batch edge
+        X, offs = ring.drain(1000, 0)
+        assert offs.tolist() == [100, 101, 102, 103]
+        X2, offs2, n = pipe._aggregate_full_batches(X, offs, 4)
+        # the wrapped batch must NOT be aggregated across the gap...
+        assert n == 4
+        assert offs2.tolist() == [100, 101, 102, 103]
+        # ...and must be carried (not lost) for the next loop iteration
+        assert pipe._carry_drain is not None
+        carry_X, carry_offs = pipe._carry_drain
+        assert carry_offs.tolist() == [0, 1, 2, 3]
+        assert carry_X.shape[0] == 4

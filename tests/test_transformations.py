@@ -392,6 +392,86 @@ class TestBuiltinFunctionLibrary:
         assert evaluate(doc, {"color": "red"}).value == 0.0
         assert evaluate(doc, {"color": None}).value == 1.0
 
+    def test_kleene_and_or_dominators_beat_missing(self):
+        # JPMML BinaryBooleanFunction three-valued (Kleene) logic:
+        # and(false, missing) = false and or(true, missing) = true — a
+        # definite dominator decides the lane; only an undecided lane
+        # with a missing argument stays missing. Both lanes must agree.
+        for fn, dom, other in (("and", 0.0, 1.0), ("or", 1.0, 0.0)):
+            doc = parse_pmml(self.FN_XML.format(fn=fn, args=self.AB))
+            cm = compile_pmml(doc)
+            recs = [
+                {"a": dom, "b": None},    # dominator + missing → decided
+                {"a": None, "b": dom},    # (either side)
+                {"a": other, "b": None},  # undecided + missing → missing
+                {"a": None, "b": None},
+                {"a": other, "b": other},  # no missing: plain logic
+                {"a": dom, "b": other},
+            ]
+            expected = [dom, dom, None, None, other, dom]
+            got = cm.score_records(recs)
+            for r, g, w in zip(recs, got, expected):
+                o = evaluate(doc, r).value
+                assert o == w, (fn, r, o, w)
+                if w is None:
+                    assert g.is_empty, (fn, r, g)
+                else:
+                    assert not g.is_empty and g.score.value == w, (fn, r, g)
+
+    def test_kleene_boolean_apply_chain_golden(self):
+        # nested missing-value boolean chain, compiled vs oracle over
+        # the full {0, 1, missing}^2 grid:
+        #   or(and(greaterThan(a, 0), lessThan(b, 1)), isMissing(a))
+        xml = self.FN_XML.format(
+            fn="or",
+            args=(
+                '<Apply function="and">'
+                '<Apply function="greaterThan">'
+                '<FieldRef field="a"/><Constant>0</Constant></Apply>'
+                '<Apply function="lessThan">'
+                '<FieldRef field="b"/><Constant>1</Constant></Apply>'
+                "</Apply>"
+                '<Apply function="isMissing"><FieldRef field="a"/></Apply>'
+            ),
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        vals = (None, -1.0, 0.5, 2.0)
+        recs = [{"a": a, "b": b} for a in vals for b in vals]
+        got = cm.score_records(recs)
+        for r, g in zip(recs, got):
+            w = evaluate(doc, r).value
+            if w is None:
+                assert g.is_empty, (r, g)
+            else:
+                assert not g.is_empty and g.score.value == w, (r, g, w)
+        # spot-check the Kleene-specific lanes: a missing with b known
+        # decides via isMissing(a)=true through the or; a present but
+        # chain-missing (b missing, a>0 undecided-and) stays missing
+        by_rec = {(r["a"], r["b"]): g for r, g in zip(recs, got)}
+        assert by_rec[(None, -1.0)].score.value == 1.0
+        assert by_rec[(0.5, None)].is_empty
+        assert by_rec[(-1.0, None)].score.value == 0.0  # and-dominated false
+
+    def test_kleene_map_missing_to_applies_after_domination(self):
+        # mapMissingTo fills only the lanes Kleene logic left missing —
+        # dominated lanes keep their decided value
+        xml = self.FN_XML.format(fn="or", args=self.AB).replace(
+            '<Apply function="or">',
+            '<Apply function="or" mapMissingTo="5">',
+        )
+        doc = parse_pmml(xml)
+        cm = compile_pmml(doc)
+        recs = [
+            {"a": 1.0, "b": None},  # or-dominated true: stays 1.0
+            {"a": 0.0, "b": None},  # undecided-missing: mapped to 5
+        ]
+        got = cm.score_records(recs)
+        assert got[0].score.value == 1.0
+        assert got[1].score.value == 5.0
+        assert evaluate(doc, recs[0]).value == 1.0
+        assert evaluate(doc, recs[1]).value == 5.0
+
     def test_extreme_but_valid_idf_is_not_clipped(self):
         doc = parse_pmml(self.FN_XML.format(fn="stdNormalIDF", args=self.A))
         cm = compile_pmml(doc)

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Perf-smoke check for the overlapped dispatch pipeline (CI tier-1).
+
+Runs a tiny GBM stream through the production BlockPipeline — and a raw
+:class:`OverlappedDispatcher` window — under ``JAX_PLATFORMS=cpu``, and
+fails loudly on exactly the regressions new concurrency code breeds:
+
+- **ordering**: sink deliveries must arrive in contiguous offset order
+  (the dispatcher's FIFO contract feeding the commit protocol);
+- **loss/duplication**: every source record reaches the sink once;
+- **shutdown hangs**: the whole check runs under a hard watchdog that
+  dumps all thread stacks and force-exits non-zero if the pipeline
+  wedges instead of draining.
+
+Seconds-cheap by design (tier-1 guards it — tests/test_perf_smoke.py);
+exit 0 = healthy, 1 = assertion failure, 2 = watchdog fired.
+"""
+
+import faulthandler
+import os
+import pathlib
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable from anywhere: the repo root (one level up) on the path
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+WATCHDOG_S = float(os.environ.get("FJT_SMOKE_WATCHDOG_S", 120.0))
+
+
+def _watchdog():
+    """Force-exit with stacks when the pipeline wedges: a hang is the
+    failure mode this smoke exists to catch, so it must terminate."""
+    faulthandler.dump_traceback(file=sys.stderr)
+    print(
+        f"perf-smoke: WATCHDOG after {WATCHDOG_S:.0f}s — "
+        "pipeline shutdown hang",
+        file=sys.stderr,
+        flush=True,
+    )
+    os._exit(2)
+
+
+def check_dispatcher_ordering() -> None:
+    """Raw window FIFO under adversarial completion timing: leaves that
+    become ready out of order must still complete in launch order."""
+    import time
+
+    from flink_jpmml_tpu.runtime.pipeline import OverlappedDispatcher
+
+    class _Leaf:
+        def __init__(self, i):
+            self.i = i
+            # later launches get SHORTER waits: readiness order is the
+            # reverse of launch order, the worst case for FIFO delivery
+            self.delay = max(0.0, (8 - i) * 0.002)
+
+        def block_until_ready(self):
+            time.sleep(self.delay)
+
+    seen = []
+    disp = OverlappedDispatcher(
+        depth=3, complete=lambda out, meta: seen.append(meta)
+    )
+    for i in range(32):
+        disp.launch(lambda i=i: _Leaf(i), meta=i)
+    disp.close()
+    assert seen == list(range(32)), f"dispatcher order broke: {seen[:10]}..."
+    assert len(disp) == 0, "close() left work in flight"
+
+
+def check_block_pipeline() -> None:
+    """Tiny GBM through the production overlapped block pipeline:
+    exhaustive drain, in-order contiguous sink offsets, no loss."""
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+
+    with tempfile.TemporaryDirectory() as tmp:
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=10, depth=3, n_features=4)
+        )
+    cm = compile_pmml(doc, batch_size=64)
+    rng = np.random.default_rng(0)
+    data = rng.normal(0.0, 1.0, size=(1000, 4)).astype(np.float32)
+
+    deliveries = []
+
+    def sink(out, n, first_off):
+        np.asarray(out if not hasattr(out, "value") else out.value)
+        deliveries.append((first_off, n))
+
+    pipe = BlockPipeline(
+        FiniteBlockSource(data, block_size=100),
+        cm,
+        sink,
+        in_flight=3,
+        use_native=False,
+    )
+    pipe.run_until_exhausted(timeout=60.0)
+
+    total = sum(n for _, n in deliveries)
+    assert total == 1000, f"lost/duplicated records: {total} != 1000"
+    cursor = 0
+    for first_off, n in deliveries:
+        assert first_off == cursor, (
+            f"out-of-order sink delivery at offset {first_off}, "
+            f"expected {cursor}"
+        )
+        cursor += n
+    assert pipe.committed_offset == 1000, pipe.committed_offset
+    snap = pipe.metrics.snapshot()
+    assert snap["records_out"] == 1000, snap["records_out"]
+    assert snap["dispatches"] >= 1
+
+
+def main() -> int:
+    timer = threading.Timer(WATCHDOG_S, _watchdog)
+    timer.daemon = True
+    timer.start()
+    check_dispatcher_ordering()
+    print("perf-smoke: dispatcher ordering OK", flush=True)
+    check_block_pipeline()
+    print("perf-smoke: block pipeline drain/ordering OK", flush=True)
+    timer.cancel()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
