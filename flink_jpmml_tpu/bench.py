@@ -83,7 +83,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 # jax-free (lazy jax inside): safe for the probe-polling parent
-from flink_jpmml_tpu.utils.profiling import overlap_stats
+from flink_jpmml_tpu.utils.profiling import overlap_stats, wire_stats
 
 NORTH_STAR_REC_S = 1_000_000.0
 
@@ -154,6 +154,7 @@ def _child_cmd(args, force_cpu: bool) -> list:
         ("--skip-interp", args.skip_interp),
         ("--skip-latency", args.skip_latency),
         ("--skip-kafka", args.skip_kafka),
+        ("--no-autotune", args.no_autotune),
         ("--latency", args.latency),
         ("--block-pipeline", args.block_pipeline),
         ("--force-cpu", force_cpu),
@@ -177,6 +178,12 @@ def _child_env() -> dict:
     # earlier (post-init) attempt already compiled
     env.setdefault(
         "FJT_XLA_CACHE", os.path.join(tempfile.gettempdir(), "fjt-xla-cache")
+    )
+    # same for the kernel/encode autotune cache: a later attempt reuses
+    # the sweep an earlier one measured (one file, corrupt-tolerant)
+    env.setdefault(
+        "FJT_AUTOTUNE_CACHE",
+        os.path.join(tempfile.gettempdir(), "fjt-autotune.json"),
     )
     return env
 
@@ -585,7 +592,10 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
         elapsed = time.monotonic() - t0
         return (
             len(lats) * block / elapsed, sorted(lats), pipe.backend,
-            overlap_stats(pipe.metrics, elapsed),
+            {
+                **overlap_stats(pipe.metrics, elapsed),
+                **wire_stats(pipe.metrics, len(lats) * block),
+            },
         )
 
     # warm the compile + first transfer outside the measured runs
@@ -625,11 +635,15 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
         "offered_rec_s": round(offered, 1),
         "capacity_rec_s": round(capacity, 1),
         "achieved_frac": round(achieved_frac, 3),
+        # the --latency-batch knob, echoed so a sweep's artifacts are
+        # self-describing
         "batch": Bl,
         "deadline_us": int(args.latency_deadline_us),
         "backend": backend,
         "overlap_efficiency": ostats["overlap_efficiency"],
         "h2d_stall_ms": ostats["h2d_stall_ms"],
+        "encode_ms": ostats.get("encode_ms"),
+        "h2d_bytes_per_record": ostats.get("h2d_bytes_per_record"),
     }
 
 
@@ -651,6 +665,7 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
         KafkaBlockSource, MiniKafkaBroker,
     )
     from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 
     C = int(cm.batch_size)
     broker = MiniKafkaBroker(topic="bench")
@@ -667,9 +682,13 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
                     self.seek(0)
                 return super().poll()
 
+        # one registry shared by the source (wire-decode accounting) and
+        # the pipeline (encode/h2d + overlap accounting): the kafka_mode
+        # line then says where both host threads' time goes
+        km = MetricsRegistry()
         src = _CyclingKafka(
             broker.host, broker.port, "bench",
-            n_cols=data_f32.shape[1], max_wait_ms=20,
+            n_cols=data_f32.shape[1], max_wait_ms=20, metrics=km,
         )
         count = [0]
 
@@ -688,6 +707,7 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
                 # serializes on the ingest thread at large chunks
                 queue_capacity=max(65536, 4 * C),
             )),
+            metrics=km,
             use_quantized=use_quantized,
         )
         q = cm.quantized_scorer() if use_quantized else None
@@ -702,7 +722,7 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
         dt = time.perf_counter() - t0
         src.close()
         ostats = overlap_stats(pipe.metrics, dt)
-        return {
+        line = {
             "rec_s": round(count[0] / dt, 1),
             "source": "kafka-wire",
             "log_records": hw,
@@ -710,6 +730,10 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
             "overlap_efficiency": ostats["overlap_efficiency"],
             "h2d_stall_ms": ostats["h2d_stall_ms"],
         }
+        # encode placement + consumer decode accounting (encode_ms ≈ 0
+        # when the autotuner fused the bucketize onto the device)
+        line.update(wire_stats(pipe.metrics, count[0]))
+        return line
     finally:
         broker.close()
 
@@ -768,6 +792,9 @@ def main() -> None:
                     help="skip the latency-mode operating point")
     ap.add_argument("--skip-kafka", action="store_true",
                     help="skip the Kafka wire-protocol operating point")
+    ap.add_argument("--no-autotune", action="store_true",
+                    help="skip the warmup autotune sweep (ablation: the "
+                         "hand-picked defaults + host encode)")
     ap.add_argument("--latency", action="store_true",
                     help="make the latency operating point the headline "
                          "metric (p50 record latency in ms)")
@@ -813,6 +840,10 @@ def main() -> None:
         # env-var routing is ignored by the axon plugin in this image;
         # the config API works (tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
+    if args.no_autotune:
+        # a true ablation: the compile-time cache consult must not apply
+        # a config an earlier run swept (autotune.lookup honours this)
+        os.environ["FJT_AUTOTUNE_DISABLE"] = "1"
 
     import jax.numpy as jnp
     import numpy as np
@@ -903,6 +934,30 @@ def main() -> None:
     cm = compile_pmml(doc, batch_size=C)
     stage("lowered (host)")
 
+    # bench-warmup autotune (ISSUE 2): sweep fused-vs-host encode (and
+    # the Pallas tile shapes) on THIS backend, or apply the cached
+    # winner from an earlier attempt (FJT_AUTOTUNE_CACHE is defaulted
+    # by the parent). Runs before any measured window — it is warmup.
+    q_tuned = None if args.f32_wire else cm.quantized_scorer()
+    tuned = None
+    if q_tuned is not None and not args.no_autotune:
+        from flink_jpmml_tpu.compile import autotune
+
+        stage("autotune: cache consult / warmup sweep")
+        tuned = autotune.ensure_tuned(q_tuned, pool_f32[0][:C], repeats=2)
+        stage(
+            f"autotune: encode={tuned.encode} block_b={tuned.block_b} "
+            f"gt={tuned.gt} source={tuned.source}"
+        )
+
+    def autotune_fields(line: dict) -> dict:
+        line["autotune"] = tuned.as_dict() if tuned is not None else None
+        line["encode_mode"] = (
+            "f32" if args.f32_wire
+            else (q_tuned.encode_mode if q_tuned is not None else None)
+        )
+        return line
+
     if args.block_pipeline:
         # the production path: f32 blocks → C++ ring → bucketizer →
         # quantized scoring → sink. Same model, same chunk size; reported
@@ -964,6 +1019,8 @@ def main() -> None:
             "inflight_depth_max": ostats["inflight_depth_max"],
             "donation_hits": ostats["donation_hits"],
         }
+        line.update(wire_stats(pipe.metrics, count[0]))
+        autotune_fields(line)
         if interp_rate is not None:
             line["interp_rec_s"] = round(interp_rate, 1)
             line["interp_ratio"] = round(rate / interp_rate, 1)
@@ -984,6 +1041,21 @@ def main() -> None:
         print(json.dumps(line))
         return
 
+    from flink_jpmml_tpu.utils.metrics import Counter
+
+    # host featurize seconds, accumulated from the 2-worker encode pool
+    # (the same lock-protected Counter dispatch_quantized feeds for the
+    # other modes; windows account deltas against it)
+    enc_counter = Counter()
+
+    def _timed_encode(encode_impl):
+        def encode(X):
+            t0 = time.perf_counter()
+            out = encode_impl(X)
+            enc_counter.inc(time.perf_counter() - t0)
+            return out
+        return encode
+
     if args.f32_wire:
         inner = getattr(cm._jit_fn, "__wrapped__", cm._jit_fn)
         params = cm.params
@@ -996,13 +1068,20 @@ def main() -> None:
             _, vals = jax.lax.scan(body, 0, X.reshape(K, C, F))
             return vals.reshape(-1)
 
-        def encode(X):
-            return X
+        encode = _timed_encode(lambda X: X)
     else:
         q = cm.quantized_scorer()
         assert q is not None, "bench GBM must be rank-wire eligible"
-        qfn = getattr(q._jit_fn, "__wrapped__", q._jit_fn)
         params = q.params
+        fused = q.encode_mode == "fused" and q.supports_fused
+        # fused: raw f32 ships and the threshold-rank bucketize is
+        # traced INTO the scan program (one dispatch covers
+        # encode+pad+score); host: the C++ bucketizer runs in the
+        # encode pool and uint8 codes ship
+        qfn = (
+            q._fused_inner if fused
+            else getattr(q._jit_fn, "__wrapped__", q._jit_fn)
+        )
 
         @jax.jit
         def run(p, Xq):
@@ -1011,8 +1090,7 @@ def main() -> None:
             _, vals = jax.lax.scan(body, 0, Xq.reshape(K, C, F))
             return vals.reshape(-1)
 
-        def encode(X):
-            return q.wire.encode(X)
+        encode = _timed_encode((lambda X: X) if fused else q.wire.encode)
 
     # ---- pipeline: featurize (threads) → h2d → score → d2h readback ----
     # the window runs through the SAME OverlappedDispatcher as the
@@ -1028,7 +1106,9 @@ def main() -> None:
 
     # warm: compile + first transfers (excluded from the measurement)
     stage("warmup: first compile + transfers")
-    warm = np.asarray(run(params, jax.device_put(encode(pool_f32[0]))))
+    payload0 = encode(pool_f32[0])
+    h2d_per_rec = payload0.nbytes / B  # what one record costs on the wire
+    warm = np.asarray(run(params, jax.device_put(payload0)))
     stage("warm done; measuring")
     assert warm.shape == (B,) and np.isfinite(
         warm.astype(np.float32)
@@ -1044,6 +1124,7 @@ def main() -> None:
         )
         done_records = [0]
         lats = []
+        enc0 = enc_counter.get()  # per-window host-encode accounting
         # dispatch-issued stamps in FIFO order: latency = dispatch
         # complete → scores materialized, same quantity as every prior
         # round's artifact (NOT including the host-side staging call)
@@ -1084,7 +1165,11 @@ def main() -> None:
         # depress the next window's start (and linger past shutdown)
         for f in encoded:
             f.cancel() or f.result()
-        return rate_w, lats, overlap_stats(wm, elapsed)
+        ostats_w = overlap_stats(wm, elapsed)
+        ostats_w["encode_ms"] = round(
+            1000.0 * (enc_counter.get() - enc0), 3
+        )
+        return rate_w, lats, ostats_w
 
     # a shared tunnel's throughput wanders run to run; measure three
     # windows. "value" is the MEDIAN (the honest typical — round 3's
@@ -1127,7 +1212,10 @@ def main() -> None:
     stage(f"device-resident measurement done: {dev_rate:,.0f} rec/s")
 
     mfu, membw_util, flops_rec = _device_utilization(
-        dev_rate, args.trees, args.depth, args.features, args.f32_wire
+        dev_rate, args.trees, args.depth, args.features,
+        # the fused path also streams raw f32 to the device
+        args.f32_wire
+        or (q_tuned is not None and q_tuned.encode_mode == "fused"),
     )
     line = {
         "metric": metric,
@@ -1146,6 +1234,11 @@ def main() -> None:
         "overlap_efficiency": ostats["overlap_efficiency"],
         "h2d_stall_ms": ostats["h2d_stall_ms"],
         "inflight_depth_max": ostats["inflight_depth_max"],
+        # encode placement accounting for the MEDIAN window: host
+        # featurize time (≈0 when the autotuner fused the encode onto
+        # the device) and staged bytes per record on the wire
+        "encode_ms": ostats.get("encode_ms"),
+        "h2d_bytes_per_record": round(h2d_per_rec, 2),
         # honest roofline: achieved device FLOP/s and HBM bytes/s vs the
         # chip's peaks (null off-TPU / unknown chip); low MFU is the
         # DESIGN for this gather-shaped workload — the rank wire trades
@@ -1154,6 +1247,7 @@ def main() -> None:
         "device_membw_util": membw_util,
         "flops_per_record": flops_rec,
     }
+    autotune_fields(line)
     if interp_rate is not None:
         line["interp_rec_s"] = round(interp_rate, 1)
         line["interp_ratio"] = round(rate / interp_rate, 1)

@@ -26,6 +26,15 @@ This module builds that wire format:
   (exact to ~2^-17 relative) so the MXU stays in fast dtypes without
   giving up float32-level accuracy.
 
+- **Fused featurization (round 6).** The same bucketize also exists as
+  an on-device XLA pre-stage (``_make_encode_stage``: vmapped
+  ``searchsorted`` over +inf-padded cut tables, replacement/sentinel
+  folding included) traced INTO the scoring jit, so a raw f32 batch can
+  ship as-is and one dispatch covers encode+pad+score
+  (``QuantizedScorer.predict_fused``). Host vs fused is decided per
+  (model, backend) by the measured autotuner (compile/autotune.py);
+  the host path stays the default and the byte-parity oracle.
+
 Reference parity: this accelerates the same evaluation the reference runs
 per record on the CPU via JPMML-Evaluator (SURVEY.md §4.1 hot loop); the
 general f32 path remains the semantic baseline and every model that is not
@@ -34,6 +43,7 @@ an all-numeric-comparison tree ensemble simply reports "not eligible".
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -61,6 +71,11 @@ from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
 
 # opcodes from trees.py: 0 '<', 1 '<=', 2 '>', 3 '>='
 _SUPPORTED_OPS = frozenset((0, 1, 2, 3))
+# fused-encode cut-table budget: the on-device featurizer carries a
+# [F, L] +inf-padded table; a pathological uint16 wire (tens of
+# thousands of cuts across many features) would pin tens of MB of HBM
+# per served model for a stage the host bucketizer handles fine
+_DEVICE_TABLE_BUDGET = 16 * 1024 * 1024
 _REGRESSION_METHODS = frozenset(
     ("single", "sum", "average", "weightedAverage", "max", "median")
 )
@@ -181,6 +196,66 @@ class QuantizedWire:
         X, M = prepare.from_records(space, records)
         return self.encode(X, M)
 
+    def device_tables(self) -> Optional[Dict[str, np.ndarray]]:
+        """Operands of the fused on-device encode stage, or None when the
+        padded table blows the budget (such models stay host-encoded).
+
+        ``enc_cuts`` is the [F, L] +inf-padded cut table (L the next
+        power of two ≥ the longest per-feature table). Unlike
+        :meth:`_pow2_tables` there is no skew heuristic: the device
+        searchsorted is lockstep by construction and +inf pads never
+        change a rank (a pad is never < any finite x), so padding is
+        free of rank error regardless of skew."""
+        cached = getattr(self, "_dev_cache", None)
+        if cached is None:
+            m = max((len(c) for c in self.cuts), default=0)
+            L = 1
+            while L < max(m, 1):
+                L <<= 1
+            F = max(len(self.cuts), 1)
+            if F * L * 4 > _DEVICE_TABLE_BUDGET:
+                cached = (None,)
+            else:
+                padded = np.full((F, L), np.inf, np.float32)
+                for j, c in enumerate(self.cuts):
+                    padded[j, : len(c)] = c
+                cached = ({
+                    "enc_cuts": np.ascontiguousarray(padded),
+                    "enc_repl": self.repl.astype(np.float32),
+                    "enc_has_repl": self.has_repl.astype(bool),
+                },)
+            object.__setattr__(self, "_dev_cache", cached)
+        return cached[0]
+
+
+def _make_encode_stage(sentinel: int, out_dtype, any_repl: bool):
+    """Build the on-device featurize stage: f32[B, F] → rank codes
+    [B, F] in the wire dtype, byte-identical to
+    :meth:`QuantizedWire.encode` (tested in tests/test_fused_encode.py).
+
+    NaN cells take the mining-schema replacement where one is declared,
+    else the missing sentinel; ``rank = #{cut < x}`` comes from a
+    vmapped ``searchsorted`` over the +inf-padded per-feature tables —
+    bit-exact with the host bucketizer's ragged/lockstep searches. The
+    stage is meant to be traced INTO the scoring jit (one dispatch for
+    encode+pad+score: the fused path of ISSUE 2)."""
+
+    def encode_stage(pp, X):
+        X = X.astype(jnp.float32)
+        miss = jnp.isnan(X)
+        if any_repl:
+            use = miss & pp["enc_has_repl"][None, :]
+            X = jnp.where(use, pp["enc_repl"][None, :], X)
+            miss = miss & ~pp["enc_has_repl"][None, :]
+        ranks = jax.vmap(
+            lambda c, x: jnp.searchsorted(c, x, side="left"),
+            in_axes=(0, 1),
+            out_axes=1,
+        )(pp["enc_cuts"], X)
+        return jnp.where(miss, sentinel, ranks).astype(out_dtype)
+
+    return encode_stage
+
 
 @dataclass
 class QuantizedScorer:
@@ -201,14 +276,41 @@ class QuantizedScorer:
     labels: Tuple[str, ...] = ()  # classification class list; () = regression
     # scan-wrapped multi-chunk dispatchers, keyed by (K, donate) with
     # K = n // batch_size (built lazily; one trace per distinct key —
-    # callers bound the K set)
+    # callers bound the K set; fused twins share the dict under
+    # ("fused", K, donate) keys)
     _multi_fns: dict = field(default_factory=dict)
     # donate_argnums twin of _jit_fn (built lazily on first donated call)
     _donate_fn: object = None
+    # fused featurize+score path: which encode the runtime dispatch
+    # helpers take — "host" (wire.encode + uint codes on the wire) or
+    # "fused" (raw f32 to the device, encode traced into the scoring
+    # jit). Decided per (model, backend) by compile/autotune.py; "host"
+    # is the default and the byte-parity oracle.
+    encode_mode: str = "host"
+    # stable identity for the on-disk autotune cache (wire tables +
+    # packed shapes; see build_quantized_scorer)
+    model_hash: str = ""
+    tuned: object = None  # applied TunedConfig (autotune provenance)
+    # un-jitted fused program (encode stage + kernel in one trace) and
+    # the bare encode stage (the parity-test surface); None when the
+    # model's cut tables blow the device-table budget
+    _fused_inner: object = None
+    _encode_stage: object = None
+    # autotune hook: rebuild the pallas backend at (block_b, gt) →
+    # (device params, jit entry, fused inner) or None when those tile
+    # shapes are ineligible; None on the XLA backend. Released by
+    # compile/autotune.py once a config is applied — the closure pins
+    # the host-side packing tables, which a long-lived served model
+    # must not carry next to its device-resident copies.
+    _pallas_rebuild: object = None
 
     @property
     def is_classification(self) -> bool:
         return bool(self.labels)
+
+    @property
+    def supports_fused(self) -> bool:
+        return self._fused_inner is not None
 
     def pad_wire(self, Xq):
         """Host-side batch alignment → ``(Xq_padded, K)``.
@@ -277,37 +379,121 @@ class QuantizedScorer:
             return self._donate_fn
         return self._multi_fn(K, donate)
 
+    def _scan_over(self, inner, K: int):
+        """Scan ``inner`` over K fixed-size chunks of the leading axis
+        (Pallas bakes its batch grid, so bigger batches iterate) —
+        shared by the host-encoded and fused dispatch entries."""
+        bs = self.batch_size
+
+        def scan_fn(p, Xq):
+            def body(c, xq):
+                return c, inner(p, xq)
+
+            _, outs = jax.lax.scan(
+                body, 0, Xq.reshape(K, bs, Xq.shape[1])
+            )
+            if isinstance(outs, tuple):  # classification triple
+                return tuple(
+                    o.reshape((K * bs,) + o.shape[2:]) for o in outs
+                )
+            return outs.reshape(-1)
+
+        return scan_fn
+
     def _multi_fn(self, K: int, donate: bool = False):
-        """Jitted scan over K fixed-size chunks (Pallas backend: the
-        kernel bakes its batch grid, so bigger batches iterate). Built
-        once per distinct (K, donate); callers bound the K set (the
-        block pipeline aggregates to powers of two)."""
+        """Jitted scan over K fixed-size chunks. Built once per distinct
+        (K, donate); callers bound the K set (the block pipeline
+        aggregates to powers of two)."""
         if K == 1:
             return self._entry(1, donate)  # already compiled; no wrapper
         key = (K, donate)
         fn = self._multi_fns.get(key)
         if fn is None:
-            bs = self.batch_size
             inner = getattr(self._jit_fn, "__wrapped__", self._jit_fn)
-
-            def scan_fn(p, Xq):
-                def body(c, xq):
-                    return c, inner(p, xq)
-
-                _, outs = jax.lax.scan(
-                    body, 0, Xq.reshape(K, bs, Xq.shape[1])
-                )
-                if isinstance(outs, tuple):  # classification triple
-                    return tuple(
-                        o.reshape((K * bs,) + o.shape[2:]) for o in outs
-                    )
-                return outs.reshape(-1)
-
             fn = jax.jit(
-                scan_fn, donate_argnums=(1,) if donate else ()
+                self._scan_over(inner, K),
+                donate_argnums=(1,) if donate else (),
             )
             self._multi_fns[key] = fn
         return fn
+
+    # -- fused featurize+score entries ------------------------------------
+
+    def pad_f32(self, X):
+        """:meth:`pad_wire`'s f32 twin for the fused path: zero-row pad
+        up to a multiple of the compile batch (trimmed by
+        ``decode(out, n)``), chunk count for the Pallas fixed grid."""
+        X = np.ascontiguousarray(X, np.float32)
+        n = X.shape[0]
+        bs = self.batch_size
+        if bs is None or n == bs:
+            return X, 1
+        pad = (-n) % bs
+        if pad:
+            X = np.concatenate(
+                [X, np.zeros((pad, X.shape[1]), np.float32)], axis=0
+            )
+        if self.backend == "pallas":
+            return X, X.shape[0] // bs
+        return X, 1
+
+    def _fused_entry(self, K: int, donate: bool):
+        if self._fused_inner is None:
+            raise ModelCompilationException(
+                "fused encode unavailable for this model (device cut "
+                "tables over budget); use the host-encode path"
+            )
+        key = ("fused", K, donate)
+        fn = self._multi_fns.get(key)
+        if fn is None:
+            inner = (
+                self._fused_inner
+                if K == 1
+                else self._scan_over(self._fused_inner, K)
+            )
+            fn = jax.jit(inner, donate_argnums=(1,) if donate else ())
+            self._multi_fns[key] = fn
+        return fn
+
+    def predict_fused_padded(self, X, K: int, donate: bool = False):
+        """Fused twin of :meth:`predict_padded`: ``X`` is an aligned
+        (possibly device-staged) RAW f32 batch; one dispatch covers
+        encode+score. Donation semantics match predict_padded (the f32
+        batch cannot output-alias the scores either; donating frees the
+        staging buffer at dispatch)."""
+        return self._fused_entry(K, donate)(self.params, X)
+
+    def predict_fused(self, X, donate: bool = False):
+        """Fused convenience entry: align (:meth:`pad_f32`) + dispatch.
+        NaN cells are the missing convention on this path — callers
+        with an explicit mask fold it in as NaN first."""
+        X, K = self.pad_f32(X)
+        return self.predict_fused_padded(X, K, donate=donate)
+
+    def encode_device(self, X):
+        """Run ONLY the on-device encode stage (jitted) → rank codes.
+        The byte-parity oracle surface: tests assert this equals
+        ``wire.encode`` exactly, code for code."""
+        if self._encode_stage is None:
+            raise ModelCompilationException(
+                "fused encode unavailable for this model"
+            )
+        key = ("enc",)
+        fn = self._multi_fns.get(key)
+        if fn is None:
+            fn = jax.jit(self._encode_stage)
+            self._multi_fns[key] = fn
+        return fn(self.params, jnp.asarray(X, jnp.float32))
+
+    def adopt_backend(self, params, jit_fn, fused_inner) -> None:
+        """Autotune apply hook: swap in a re-packed kernel (new Pallas
+        tile shapes). Clears every lazily-built compile cache keyed off
+        the old program."""
+        self.params = params
+        self._jit_fn = jit_fn
+        self._fused_inner = fused_inner
+        self._multi_fns.clear()
+        self._donate_fn = None
 
     def score(self, X, M=None) -> List[Prediction]:
         n = np.asarray(X).shape[0]
@@ -557,6 +743,33 @@ def build_quantized_scorer(
         params["plo"] = plo
         params["lab"] = lab_f
 
+    # stable identity for the on-disk autotune cache: the wire tables +
+    # packed shapes pin the compiled program (weights don't change tile
+    # choice, but folding the threshold tables in makes the key
+    # collision-proof across same-shape models)
+    hasher = hashlib.sha256()
+    hasher.update(
+        f"{T}:{S}:{L}:{F}:{batch_size}:{np.dtype(dtype).name}:"
+        f"{int(classification)}:{method}".encode()
+    )
+    for c in cuts:
+        hasher.update(c.tobytes())
+    hasher.update(qthr.tobytes())
+    hasher.update(np.asarray(dleft, np.uint8).tobytes())
+    model_hash = hasher.hexdigest()[:16]
+
+    # fused featurize+score pre-stage (tentpole of ISSUE 2): the same
+    # threshold-rank bucketize as wire.encode, but as XLA ops traced
+    # into the scoring jit — raw f32 batches go straight to the device
+    # and one dispatch covers encode+pad+score. The host path stays the
+    # default and the byte-parity oracle.
+    enc_tables = wire.device_tables()
+    encode_stage = (
+        _make_encode_stage(sentinel, dtype, bool(has_repl.any()))
+        if enc_tables is not None
+        else None
+    )
+
     on_cpu = common.backend_is_cpu()
     sent = dtype(sentinel)
 
@@ -660,20 +873,34 @@ def build_quantized_scorer(
             # f32), not an MXU dot
             vals_tbl = vhi.astype(np.float32) + vlo.astype(np.float32)
             vals_lo = None
-        groups = qtrees_pallas.pack_groups(
-            feat=params["feat"].astype(np.int64),
-            qthr=qthr,
-            dleft=np.asarray(dleft),
-            P=params["P_i8"],
-            count=params["count_i8"],
-            vals=vals_tbl,
-            n_fields=F,
-            vals_lo=vals_lo,
-        )
-        raw = qtrees_pallas.build_pallas_fn(
-            groups, batch_size, F, sentinel, interpret=pallas_interpret
-        )
-        if raw is not None:
+
+        def _build_pallas(
+            block_b: Optional[int] = None, gt: Optional[int] = None
+        ):
+            """Pack + build the kernel at the given tile shapes →
+            (device params, jit entry, fused inner) or None when
+            build_pallas_fn rejects them. The default shapes build the
+            scorer; the autotuner re-invokes this to sweep candidates
+            and adopts the winner (:meth:`QuantizedScorer
+            .adopt_backend`)."""
+            groups = qtrees_pallas.pack_groups(
+                feat=params["feat"].astype(np.int64),
+                qthr=qthr,
+                dleft=np.asarray(dleft),
+                P=params["P_i8"],
+                count=params["count_i8"],
+                vals=vals_tbl,
+                n_fields=F,
+                vals_lo=vals_lo,
+                gt=gt or qtrees_pallas.GT,
+            )
+            raw = qtrees_pallas.build_pallas_fn(
+                groups, batch_size, F, sentinel,
+                block_b=block_b or qtrees_pallas.DEFAULT_BLOCK_B,
+                interpret=pallas_interpret,
+            )
+            if raw is None:
+                return None
             if classification:
                 def pqfn(gp, Xq):
                     probs = raw(gp, Xq)  # [B, C] vote shares
@@ -693,26 +920,55 @@ def build_quantized_scorer(
                         jnp.float32
                     )
 
-            return QuantizedScorer(
+            fused_inner = None
+            if encode_stage is not None:
+                # the enc tables ride in the same params dict (added
+                # AFTER build_pallas_fn's VMEM budget check: they are
+                # XLA-stage operands, not kernel residents)
+                groups.update(enc_tables)
+
+                def fused_inner(gp, X):
+                    return pqfn(gp, encode_stage(gp, X))
+
+            jit_fn = jax.jit(
+                pqfn,
+                donate_argnums=(1,) if config.donate_batches else (),
+            )
+            return jax.device_put(groups), jit_fn, fused_inner
+
+        built = _build_pallas()
+        if built is not None:
+            gp, jit_fn, fused_inner = built
+            scorer = QuantizedScorer(
                 wire=wire,
-                params=jax.device_put(groups),
+                params=gp,
                 field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
                 batch_size=batch_size,
                 n_trees=T,
-                _jit_fn=jax.jit(
-                    pqfn,
-                    donate_argnums=(1,) if config.donate_batches else (),
-                ),
+                _jit_fn=jit_fn,
                 backend="pallas",
                 labels=packed.labels if classification else (),
+                model_hash=model_hash,
+                _fused_inner=fused_inner,
+                _encode_stage=encode_stage,
+                _pallas_rebuild=_build_pallas,
             )
+            _consult_autotune(scorer)
+            return scorer
     if backend == "pallas":
         return None  # forced pallas but not eligible
 
     jit_fn = jax.jit(qfn, donate_argnums=(1,) if config.donate_batches else ())
     codecs = ctx.codecs
 
-    return QuantizedScorer(
+    fused_inner = None
+    if encode_stage is not None:
+        params.update(enc_tables)
+
+        def fused_inner(pp, X):
+            return qfn(pp, encode_stage(pp, X))
+
+    scorer = QuantizedScorer(
         wire=wire,
         params=jax.device_put(params),
         field_space=prepare.FieldSpace(fields=fields, codecs=codecs),
@@ -721,4 +977,26 @@ def build_quantized_scorer(
         _jit_fn=jit_fn,
         backend="xla",
         labels=packed.labels if classification else (),
+        model_hash=model_hash,
+        _fused_inner=fused_inner,
+        _encode_stage=encode_stage,
     )
+    _consult_autotune(scorer)
+    return scorer
+
+
+def _consult_autotune(scorer: QuantizedScorer) -> None:
+    """Apply a previously-measured config from the on-disk autotune
+    cache (compile/autotune.py) to a freshly-built scorer.
+
+    Never raises: a cache problem (corrupt file, unreadable dir, a
+    stale config the current build can't honour) must not break model
+    compilation — the default host-encode path always works."""
+    try:
+        from flink_jpmml_tpu.compile import autotune
+
+        cfg = autotune.lookup(scorer.model_hash, autotune.backend_key(scorer))
+        if cfg is not None:
+            autotune.apply(scorer, cfg)
+    except Exception:
+        pass

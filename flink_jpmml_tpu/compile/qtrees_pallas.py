@@ -49,7 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-GT = 4  # trees per block-diagonal group
+GT = 4  # trees per block-diagonal group (default; autotune may sweep it)
+DEFAULT_BLOCK_B = 1024  # batch rows per grid block (autotune may sweep it)
 # VMEM is ~16MB/core; params for the 500-tree GBM take ~11MB, temps at
 # Bblk=512 another ~2.5MB, so the resident-params layout fits with room
 # for the input/output pipeline. Guard eligibility on this budget.
@@ -68,8 +69,14 @@ def pack_groups(
                           # ``vals_lo``)
     n_fields: int,
     vals_lo: Optional[np.ndarray] = None,  # bf16[T, L, C] LO residuals
+    gt: int = GT,
 ) -> Dict[str, np.ndarray]:
     """Group-pack the per-tree tensors for the kernel (numpy, host-side).
+
+    ``gt`` is the trees-per-group tile knob (block-diagonal operand is
+    ``[gt*S, gt*L]``): the default 4 makes two full 128x128 MXU tiles
+    per axis for depth-6 trees; the bench-warmup autotuner
+    (compile/autotune.py) may sweep it per model/backend.
 
     Classification tables MUST arrive as the bf16 hi/lo split pair
     (``vals``=hi, ``vals_lo``=lo) — the same operands the XLA path
@@ -77,11 +84,13 @@ def pack_groups(
     hardware: a default-precision f32 dot truncates its operands to bf16
     on the MXU, silently dropping the lo residuals (the round-3
     on-device classification parity failure)."""
+    if gt <= 0:
+        raise ValueError(f"gt must be > 0: {gt}")
     T, S = feat.shape
     L = P.shape[2]
-    G = -(-T // GT)
-    Tp = G * GT
-    Sg, Lg = GT * S, GT * L
+    G = -(-T // gt)
+    Tp = G * gt
+    Sg, Lg = gt * S, gt * L
 
     featp = np.zeros((Tp, S), np.int64)
     featp[:T] = feat
@@ -95,19 +104,19 @@ def pack_groups(
     def _pad_collapse(tbl, dtype):
         padded = np.zeros((Tp,) + tbl.shape[1:], np.float32)
         padded[:T] = tbl.astype(np.float32)
-        # Tp is G*GT contiguous, so collapsing (G, GT, L, …) → (G, Lg, …)
+        # Tp is G*gt contiguous, so collapsing (G, gt, L, …) → (G, Lg, …)
         # keeps each group's leaves in block order
         return padded.reshape((G, Lg) + tbl.shape[2:]).astype(dtype)
 
     # one-hot feature selector [G, F, Sg] (bf16 operand of the select dot)
     fsel = np.zeros((G, n_fields, Sg), np.float32)
     for t in range(Tp):
-        g, o = divmod(t, GT)
+        g, o = divmod(t, gt)
         fsel[g, featp[t], o * S + np.arange(S)] = 1.0
 
     Pg = np.zeros((G, Sg, Lg), np.int8)
     for t in range(T):
-        g, o = divmod(t, GT)
+        g, o = divmod(t, gt)
         Pg[g, o * S:(o + 1) * S, o * L:(o + 1) * L] = P[t]
 
     groups = {
@@ -202,7 +211,7 @@ def build_pallas_fn(
     batch_size: int,
     n_fields: int,
     sentinel: int,
-    block_b: int = 1024,
+    block_b: int = DEFAULT_BLOCK_B,
     interpret: bool = False,
 ):
     """→ fn(group_params, Xq u8[B, F]) -> f32[B] ensemble sums (scalar

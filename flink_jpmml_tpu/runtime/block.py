@@ -31,6 +31,8 @@ from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.runtime.pipeline import (
     OverlappedDispatcher,
     _prefetch_host,  # noqa: F401  (re-export: engine.py imports it here)
+    dispatch_quantized,
+    filter_donate_warning,
 )
 from flink_jpmml_tpu.utils.config import RuntimeConfig
 from flink_jpmml_tpu.utils.exceptions import InputValidationException
@@ -216,11 +218,6 @@ def make_ring(capacity: int, arity: int, batch_size: int, native: bool = True):
         if native_mod.available():
             return native_mod.NativeRing(capacity, arity, batch_size)
     return _PyRing(capacity, arity, batch_size)
-
-
-# one-shot guard for the donated-dispatch warning filter (see
-# BlockPipelineBase._resolve_donate)
-_DONATE_WARN_FILTERED = False
 
 
 class BoundScorer:
@@ -497,55 +494,43 @@ class BlockPipelineBase:
         (bounding steady-state input allocations to the window depth)
         rather than holding it to fetch time, so it is kept — and the
         known-inert warning is silenced once, only when a pipeline
-        actually donates, and only for the rank-wire uint dtypes: an
-        application's own f32 donation warnings (where failed aliasing
-        IS actionable) stay visible."""
+        actually donates, and only for the rank-wire uint dtypes
+        (pipeline.filter_donate_warning — the fused f32 shape gets the
+        same treatment there): an application's own f32 donation
+        warnings (where failed aliasing IS actionable) stay visible."""
         if self._donate is None:
             from flink_jpmml_tpu.compile import common
 
             self._donate = not common.backend_is_cpu()
-        global _DONATE_WARN_FILTERED
-        if self._donate and not _DONATE_WARN_FILTERED:
-            import warnings
-
-            warnings.filterwarnings(
-                "ignore",
-                message=(
-                    r"Some donated buffers were not usable: "
-                    r"ShapedArray\(uint(8|16)\["
-                ),
-            )
-            _DONATE_WARN_FILTERED = True
+        if self._donate:
+            filter_donate_warning(r"uint(8|16)\[")
         return self._donate
 
     def _dispatch_bound(self, bound: "BoundScorer", X, n):
         """Shared async dispatch through a :class:`BoundScorer` — the
-        rank wire when eligible (the bucketizer folds NaN→missing during
-        encoding: no separate host-side NaN pass, no f32 mask plane),
-        the f32 path otherwise.
+        rank wire when eligible, the f32 path otherwise. The rank-wire
+        hop runs through :func:`runtime.pipeline.dispatch_quantized`:
+        host encode (the bucketizer folds NaN→missing — no separate
+        host-side NaN pass, no f32 mask plane) or the fused on-device
+        encode stage, per the scorer's autotuned ``encode_mode``.
 
-        Rank-wire dispatches stage the encoded batch onto the device
-        explicitly (``jax.device_put``, async) and donate the staging
-        buffer to the jitted call: the buffer is released to the device
-        allocator at dispatch instead of being pinned until fetch, so
-        with the depth-2 in-flight window steady-state input allocations
-        stay bounded at two staging buffers. ``donation_hits`` counts
+        Rank-wire dispatches stage the batch onto the device explicitly
+        (``jax.device_put``, async) and donate the staging buffer to
+        the jitted call: the buffer is released to the device allocator
+        at dispatch instead of being pinned until fetch, so with the
+        depth-2 in-flight window steady-state input allocations stay
+        bounded at two staging buffers. ``donation_hits`` counts
         dispatches whose staging buffer was actually consumed
         (invalidated) by the call — 0 on backends that ignore
-        donation."""
+        donation. ``encode_s``/``h2d_bytes`` accounting lands in this
+        pipeline's metrics registry."""
         if bound.q is not None:
-            q = bound.q
-            Xq, K = q.pad_wire(q.wire.encode(X))
-            if self._resolve_donate():
-                import jax
-
-                staged = jax.device_put(Xq)  # async H2D staging copy
-                out = q.predict_padded(staged, K, donate=True)
-                deleted = getattr(staged, "is_deleted", None)
-                if deleted is not None and deleted():
-                    self._donation_hits.inc()
-                return out
-            return q.predict_padded(Xq, K)  # async dispatch
+            return dispatch_quantized(
+                bound.q, X,
+                donate=self._resolve_donate(),
+                metrics=self.metrics,
+                donation_hits=self._donation_hits,
+            )
         return self._score_f32(bound.model, X, n)
 
     def _score_f32(self, model, X, n):
@@ -560,6 +545,13 @@ class BlockPipelineBase:
             Xb, Mb = X, _ZEROS_M.get(n, self._arity)
         if n < B:
             Xb, Mb, _ = prepare.pad_batch(Xb, Mb, B)
+        if Xb is X:
+            # a full, NaN-free batch reaches here still aliasing the
+            # ring's reuse buffer; jax's CPU backend can zero-copy that
+            # numpy array into the async dispatch, so the next drain
+            # would overwrite an in-flight batch — ship a private copy
+            # (cf. pipeline.dispatch_quantized's fused branch)
+            Xb = np.array(Xb, copy=True)
         return model.predict(Xb, Mb)  # async dispatch
 
     # -- internals ---------------------------------------------------------

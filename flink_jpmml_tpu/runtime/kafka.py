@@ -925,14 +925,32 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
     row; a fetch's worth of consecutive rows forms one [n, F] block.
     Single- and multi-partition polls both ride the C++ record-batch
     decoder; the multi-partition interleave is array-strided, not
-    per-record."""
+    per-record.
 
-    def __init__(self, *args, n_cols: int, **kw):
+    ``metrics`` (optional, a ``MetricsRegistry``) accounts wire-decode
+    time into a ``kafka_decode_s`` counter — the consumer-thread half
+    of the stream's host budget, reported next to the score loop's
+    ``encode_s`` so the bench's ``kafka_mode`` can say where consumer
+    CPU goes (``decode_ms``)."""
+
+    def __init__(self, *args, n_cols: int, metrics=None, **kw):
         super().__init__(*args, **kw)
         self._cols = n_cols
+        self._decode_s = (
+            metrics.counter("kafka_decode_s") if metrics is not None else None
+        )
         # per-slot decoded row buffers: slot → [rows...] contiguous from
         # that slot's next needed partition offset (multi-partition only)
         self._rbufs: Dict[int, np.ndarray] = {}
+
+    def _decode_rows(self, raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
+        if self._decode_s is None:
+            return decode_record_batches_rows(raw, self._cols)
+        t0 = time.monotonic()
+        try:
+            return decode_record_batches_rows(raw, self._cols)
+        finally:
+            self._decode_s.inc(time.monotonic() - t0)
 
     def _poll_multi(self) -> Optional[Tuple[int, np.ndarray]]:
         """Strict round-robin interleave, vectorized: global index
@@ -949,7 +967,7 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
             if buf is None or buf.shape[0] == 0:
                 raw = self._fetch_raw_part(part, po0)
                 if raw:
-                    offs, rows = decode_record_batches_rows(raw, self._cols)
+                    offs, rows = self._decode_rows(raw)
                     k = int(np.searchsorted(offs, po0))
                     offs, rows = offs[k:], rows[k:]
                     if offs.shape[0]:
@@ -1003,7 +1021,7 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
                     if attempt:
                         break  # one long-poll per dry sweep, not P
                     continue
-                offs, rows = decode_record_batches_rows(raw, self._cols)
+                offs, rows = self._decode_rows(raw)
                 k = int(np.searchsorted(offs, self._cursors[part]))
                 offs, rows = offs[k:], rows[k:]
                 if offs.shape[0] == 0:
@@ -1034,7 +1052,7 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         raw = self._fetch_raw_part(self._partition, self._next)
         if not raw:
             return None
-        offs, rows = decode_record_batches_rows(raw, self._cols)
+        offs, rows = self._decode_rows(raw)
         # a fetch returns whole batches: drop records below the cursor
         k = int(np.searchsorted(offs, self._next))
         offs, rows = offs[k:], rows[k:]

@@ -56,8 +56,11 @@ Metrics (into the shared :class:`MetricsRegistry`):
 from __future__ import annotations
 
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, Optional
+
+import numpy as np
 
 from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
@@ -99,6 +102,128 @@ def _block_ready(out) -> None:
 
 class DispatcherClosed(FlinkJpmmlTpuError):
     """launch() after close(): the window is shut down."""
+
+
+# shape regexes whose inert donation warning is already silenced (see
+# filter_donate_warning)
+_DONATE_WARN_FILTERED: set = set()
+
+
+def filter_donate_warning(shape_re: str) -> None:
+    """One-shot, NARROW silencing of XLA's "donated buffers were not
+    usable" warning for a wire batch shape that can never output-alias
+    its scores (the uint8/uint16 rank wire, or the fused path's raw
+    f32 [B, F] batch): the donation still frees the staging buffer to
+    the device allocator at dispatch, so the warning is inert — but
+    only for these shapes; an application's own actionable donation
+    warnings stay visible. Shared by the block pipelines' uint-wire
+    filter and the fused dispatch path (one mechanism, one message
+    shape to keep in sync with XLA)."""
+    if shape_re in _DONATE_WARN_FILTERED:
+        return
+    import warnings
+
+    warnings.filterwarnings(
+        "ignore",
+        message=(
+            r"Some donated buffers were not usable: ShapedArray\("
+            + shape_re
+        ),
+    )
+    _DONATE_WARN_FILTERED.add(shape_re)
+
+
+# per-registry (encode_s, h2d_bytes) counter pairs: resolving through
+# the registry lock on every per-batch dispatch is avoidable hot-path
+# work; weak keys let ephemeral bench registries die normally
+_WIRE_COUNTERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _wire_counters(metrics: MetricsRegistry):
+    pair = _WIRE_COUNTERS.get(metrics)
+    if pair is None:
+        pair = (metrics.counter("encode_s"), metrics.counter("h2d_bytes"))
+        _WIRE_COUNTERS[metrics] = pair
+    return pair
+
+
+def dispatch_quantized(
+    q,
+    X,
+    M=None,
+    *,
+    donate: bool = False,
+    metrics: Optional[MetricsRegistry] = None,
+    donation_hits=None,
+):
+    """Featurize + stage + async-dispatch one raw f32 batch through a
+    :class:`~flink_jpmml_tpu.compile.qtrees.QuantizedScorer` — the ONE
+    place the autotuned encode-placement decision (``q.encode_mode``)
+    is enacted, shared by the block pipelines, the dynamic scorer, and
+    every bench mode:
+
+    - ``"host"`` (default, the byte-parity oracle): the C++ bucketizer
+      rank-encodes on the host and the uint8/uint16 codes ship;
+    - ``"fused"``: the raw f32 batch ships and the threshold-rank
+      bucketize runs on-device as an XLA pre-stage traced into the
+      scoring jit — one dispatch covers encode+pad+score.
+
+    ``M`` is an optional explicit missing mask (the dynamic scorer's
+    record path); the fused stage understands only the NaN convention,
+    so the mask folds in as NaN before staging.
+
+    Two counters land in ``metrics`` (→ the bench's ``encode_ms`` /
+    ``h2d_bytes_per_record`` fields via ``profiling.wire_stats``):
+    ``encode_s`` — host featurize+align time on the dispatch path (≈0
+    when fused); ``h2d_bytes`` — bytes staged per dispatch (F uint
+    codes per record on the host path, 4·F f32 on the fused path).
+
+    ``donate=True`` stages via an explicit ``jax.device_put`` and
+    donates the staging buffer to the jitted call (released to the
+    device allocator at dispatch, not pinned until fetch);
+    ``donation_hits`` counts dispatches whose buffer was actually
+    consumed."""
+    enc, h2d = (
+        _wire_counters(metrics) if metrics is not None else (None, None)
+    )
+    t0 = time.monotonic()
+    fused = getattr(q, "encode_mode", "host") == "fused" and q.supports_fused
+    if fused:
+        owned = False  # does X already sit in a buffer only we hold?
+        if M is not None and np.asarray(M).any():
+            X = np.where(M, np.nan, np.asarray(X, np.float32))
+            owned = True
+        payload, K = q.pad_f32(X)
+        if payload is X and not owned:
+            # an unpadded f32-contiguous batch passes through pad_f32
+            # unchanged, and the caller's array may alias a REUSED ring
+            # drain buffer — which jax's CPU backend can zero-copy
+            # alias straight into the async dispatch, letting the next
+            # drain overwrite an in-flight batch. The host path never
+            # hits this (wire.encode always allocates); the fused path
+            # must ship a private copy. (One memcpy per batch — the
+            # same cost the ring drain itself pays.)
+            payload = np.array(payload, copy=True)
+        predict = q.predict_fused_padded
+    else:
+        payload, K = q.pad_wire(q.wire.encode(X, M))
+        predict = q.predict_padded
+    if enc is not None:
+        enc.inc(time.monotonic() - t0)
+    if h2d is not None:
+        h2d.inc(payload.nbytes)
+    if not donate:
+        return predict(payload, K)  # async dispatch
+    import jax
+
+    if fused:
+        filter_donate_warning(rf"float32\[\d+,{payload.shape[1]}\]")
+    staged = jax.device_put(payload)  # async H2D staging copy
+    out = predict(staged, K, donate=True)
+    deleted = getattr(staged, "is_deleted", None)
+    if deleted is not None and deleted() and donation_hits is not None:
+        donation_hits.inc()
+    return out
 
 
 class _InFlight:

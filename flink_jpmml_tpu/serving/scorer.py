@@ -28,7 +28,10 @@ from flink_jpmml_tpu.api.reader import ModelReader
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.models.prediction import Prediction
 from flink_jpmml_tpu.runtime.engine import Scorer
-from flink_jpmml_tpu.runtime.pipeline import OverlappedDispatcher
+from flink_jpmml_tpu.runtime.pipeline import (
+    OverlappedDispatcher,
+    dispatch_quantized,
+)
 from flink_jpmml_tpu.runtime.sources import ControlSource
 from flink_jpmml_tpu.serving.registry import ModelRegistry
 from flink_jpmml_tpu.utils.config import CompileConfig
@@ -201,13 +204,18 @@ class DynamicScorer(Scorer):
             # batch). Each group's device call launches through the
             # shared overlapped window: dispatch stays async, D2H copies
             # are prefetched, and the window depth bounds how far device
-            # work can run ahead of the finish() fetches.
+            # work can run ahead of the finish() fetches. The featurize
+            # itself goes through the SAME staged path as the block
+            # pipelines (dispatch_quantized: host bucketize or the fused
+            # on-device encode per the scorer's autotuned encode_mode),
+            # with encode_s/h2d_bytes accounted into this scorer's
+            # metrics registry.
             q = model.quantized_scorer()
             if q is not None:
-                # predict_wire owns batch-size alignment (padding/chunking)
-                Xq = q.wire.encode(X, M)
                 handle = self._dispatcher.launch(
-                    lambda q=q, Xq=Xq: q.predict_wire(Xq)
+                    lambda q=q, X=X, M=M: dispatch_quantized(
+                        q, X, M, metrics=self.metrics
+                    )
                 )
                 tickets.append((q, idxs, handle))
                 continue

@@ -72,6 +72,31 @@ def overlap_stats(
     }
 
 
+def wire_stats(metrics: MetricsRegistry, records: float) -> Dict[str, object]:
+    """Encode-placement accounting for a run through
+    :func:`~flink_jpmml_tpu.runtime.pipeline.dispatch_quantized`.
+
+    ``encode_ms`` is the total host featurize+align time spent on the
+    dispatch path (≈0 when the autotuner picked the fused on-device
+    encode); ``h2d_bytes_per_record`` is staged host→device bytes per
+    record (F on the uint8 rank wire, 4·F on the fused f32 wire);
+    ``decode_ms`` rides along when a Kafka source accounted its wire
+    decode (``kafka_decode_s``). The bench emits these per operating
+    mode next to the overlap stats."""
+    enc = metrics.counter("encode_s").get()
+    dec = metrics.counter("kafka_decode_s").get()
+    h2d = metrics.counter("h2d_bytes").get()
+    out: Dict[str, object] = {
+        "encode_ms": round(1000.0 * enc, 3),
+        "h2d_bytes_per_record": (
+            round(h2d / records, 2) if records else None
+        ),
+    }
+    if dec:
+        out["decode_ms"] = round(1000.0 * dec, 3)
+    return out
+
+
 class StageTimer:
     """Per-stage wall-clock accounting into a :class:`MetricsRegistry`.
 

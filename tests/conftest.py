@@ -30,11 +30,30 @@ if _plat != "default":  # "default": let jax pick (the tunneled chip
 
 import pathlib
 import sys
+import tempfile
 
 # Make the repo root importable regardless of how pytest is invoked.
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+# Hermetic rank-wire autotune cache (compile/autotune.py):
+# build_quantized_scorer consults it on EVERY compile, including ones
+# inside class/session-scoped fixtures that run before any
+# function-scoped monkeypatch — so the redirect must happen at conftest
+# import, unconditionally (a developer's real ~/.cache entry would
+# otherwise silently switch golden models to tuned configs per machine).
+os.environ["FJT_AUTOTUNE_CACHE"] = os.path.join(
+    tempfile.mkdtemp(prefix="fjt-test-autotune-"), "autotune.json"
+)
+
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_autotune_cache(tmp_path, monkeypatch):
+    """Per-test cache file on top of the import-time session redirect
+    above: one test's sweep must not leak tuned configs into another's
+    compiles (higher-scoped fixtures still use the session file)."""
+    monkeypatch.setenv("FJT_AUTOTUNE_CACHE", str(tmp_path / "autotune.json"))
 
 
 @pytest.fixture(scope="session")

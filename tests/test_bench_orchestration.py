@@ -21,9 +21,10 @@ def _args(**over):
         trees=500, depth=6, features=32, batch=262144, chunk=16384,
         window=2, seconds=4.0, f32_wire=False, init_timeout=2.0,
         probe_interval=0.2, probe_timeout=2.0, total_budget=60.0,
-        skip_interp=False,
+        skip_interp=False, skip_kafka=False,
         skip_latency=False, latency=False, latency_batch=4096,
         latency_deadline_us=2000, latency_offered=100000.0,
+        no_autotune=False,
         in_child=False, force_cpu=False, block_pipeline=False,
     )
     for k, v in over.items():
@@ -40,6 +41,23 @@ def _fake_child(tmp_path, monkeypatch, body):
         bench, "_child_cmd",
         lambda args, force_cpu: [sys.executable, str(script)],
     )
+
+
+class TestChildCmd:
+    """The parent→child flag plumbing: knobs must actually reach the
+    measurement child (the --latency-batch knob is reported back in the
+    latency_mode JSON as "batch")."""
+
+    def test_latency_batch_knob_flows_to_child(self):
+        cmd = bench._child_cmd(_args(latency_batch=512), force_cpu=False)
+        i = cmd.index("--latency-batch")
+        assert cmd[i + 1] == "512"
+
+    def test_no_autotune_flag_passthrough(self):
+        assert "--no-autotune" not in bench._child_cmd(_args(), False)
+        assert "--no-autotune" in bench._child_cmd(
+            _args(no_autotune=True), False
+        )
 
 
 class TestRunChild:

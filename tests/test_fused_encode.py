@@ -1,0 +1,279 @@
+"""Fused on-device featurization (ISSUE 2 tentpole) parity suite.
+
+The fused path ships raw f32 batches and runs the threshold-rank
+bucketize as an XLA pre-stage traced into the scoring jit; the host
+bucketizer (``QuantizedWire.encode``) stays the byte-parity oracle.
+These tests pin, on the CPU test backend (interpret mode for Pallas):
+
+- BYTE parity of ``QuantizedScorer.encode_device`` against
+  ``wire.encode`` — code for code, dtype for dtype — across golden
+  models, NaN patterns, mining-schema ``missingValueReplacement``,
+  explicit missing masks, ±inf cells, and the uint16 wire;
+- end-to-end fused scoring parity (``predict_fused`` vs host-encoded
+  ``predict_wire``) including pad-lane trimming on odd batch sizes and
+  classification triples;
+- the shared runtime dispatch helper
+  (``runtime.pipeline.dispatch_quantized``) taking the fused path and
+  accounting ``encode_s``/``h2d_bytes``.
+"""
+
+import numpy as np
+import pytest
+
+from assets.generate import gen_gbm
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
+from flink_jpmml_tpu.pmml import parse_pmml, parse_pmml_file
+from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+from test_qtrees import _forest_xml
+
+
+def _doc(tmp_path, **kw):
+    return parse_pmml_file(gen_gbm(str(tmp_path), **kw))
+
+
+def _rand_X(rng, n, f, missing_rate=0.0):
+    X = rng.normal(0.0, 1.5, size=(n, f)).astype(np.float32)
+    if missing_rate:
+        X[rng.random(size=X.shape) < missing_rate] = np.nan
+    return X
+
+
+_REPL_XML = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <DataDictionary numberOfFields="3">
+    <DataField name="a" optype="continuous" dataType="double"/>
+    <DataField name="b" optype="continuous" dataType="double"/>
+    <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TreeModel functionName="regression" missingValueStrategy="defaultChild"
+             splitCharacteristic="binarySplit">
+    <MiningSchema>
+      <MiningField name="y" usageType="target"/>
+      <MiningField name="a" missingValueReplacement="0.25"/>
+      <MiningField name="b"/>
+    </MiningSchema>
+    <Node id="0" defaultChild="1"><True/>
+      <Node id="1" defaultChild="3">
+        <SimplePredicate field="a" operator="lessThan" value="0.1"/>
+        <Node id="3" score="1.5">
+          <SimplePredicate field="b" operator="lessOrEqual" value="-0.2"/>
+        </Node>
+        <Node id="4" score="-2.0">
+          <SimplePredicate field="b" operator="greaterThan" value="-0.2"/>
+        </Node>
+      </Node>
+      <Node id="2" score="3.0">
+        <SimplePredicate field="a" operator="greaterOrEqual" value="0.1"/>
+      </Node>
+    </Node>
+  </TreeModel></PMML>"""
+
+
+class TestEncodeByteParity:
+    def _assert_codes_equal(self, q, X, M=None):
+        host = q.wire.encode(X, M)
+        Xd = X if M is None else np.where(M, np.nan, X)
+        dev = np.asarray(q.encode_device(Xd))
+        assert dev.dtype == host.dtype
+        np.testing.assert_array_equal(dev, host)
+
+    def test_uint8_wire_with_nans(self, tmp_path):
+        doc = _doc(tmp_path, n_trees=15, depth=4, n_features=8)
+        q = build_quantized_scorer(doc, batch_size=64)
+        assert q.supports_fused and q.wire.dtype is np.uint8
+        rng = np.random.default_rng(0)
+        self._assert_codes_equal(q, _rand_X(rng, 64, 8, missing_rate=0.3))
+
+    def test_uint16_wire(self, tmp_path):
+        # >254 cuts/feature → uint16 sentinel 65535; ranks must still be
+        # exact through the on-device searchsorted (int32 → uint16 cast)
+        doc = _doc(
+            tmp_path, n_trees=300, depth=5, n_features=2, hist_bins=None
+        )
+        q = build_quantized_scorer(doc, batch_size=32)
+        assert q.wire.dtype is np.uint16
+        if not q.supports_fused:
+            pytest.skip("device tables over budget for this model")
+        rng = np.random.default_rng(1)
+        self._assert_codes_equal(q, _rand_X(rng, 32, 2, missing_rate=0.2))
+
+    def test_missing_value_replacement_folds_in(self):
+        # NaN in a replaced column must take the mining-schema value
+        # (NOT the sentinel); NaN in an unreplaced column stays missing
+        doc = parse_pmml(_REPL_XML)
+        q = build_quantized_scorer(doc, batch_size=8)
+        assert q is not None and q.supports_fused
+        X = np.array(
+            [[np.nan, -0.5], [np.nan, 0.5], [0.0, np.nan], [2.0, -1.0]],
+            np.float32,
+        )
+        host = q.wire.encode(X)
+        dev = np.asarray(q.encode_device(X))
+        np.testing.assert_array_equal(dev, host)
+        # column a (replacement declared): no sentinel even for NaN
+        assert (dev[:2, 0] != q.wire.sentinel).all()
+        # column b (no replacement): NaN becomes the sentinel
+        assert dev[2, 1] == q.wire.sentinel
+
+    def test_explicit_mask_folds_as_nan(self, tmp_path):
+        # the dynamic scorer's record path carries (X, M) with zeros at
+        # masked cells; fused folds M in as NaN — codes must match the
+        # host encoder given the same mask
+        doc = _doc(tmp_path, n_trees=10, depth=3, n_features=4)
+        q = build_quantized_scorer(doc, batch_size=16)
+        rng = np.random.default_rng(2)
+        X = _rand_X(rng, 16, 4)
+        M = rng.random(size=X.shape) < 0.25
+        Xz = np.where(M, 0.0, X).astype(np.float32)
+        self._assert_codes_equal(q, Xz, M)
+
+    def test_infinite_cells(self, tmp_path):
+        # +inf ranks past every finite cut (== len(cuts), never the
+        # sentinel and never perturbed by the +inf table pads); -inf
+        # ranks 0 — bit-exact with numpy searchsorted either way
+        doc = _doc(tmp_path, n_trees=10, depth=3, n_features=4)
+        q = build_quantized_scorer(doc, batch_size=8)
+        rng = np.random.default_rng(3)
+        X = _rand_X(rng, 8, 4)
+        X[0, 0] = np.inf
+        X[1, 1] = -np.inf
+        X[2, 2] = np.nan
+        self._assert_codes_equal(q, X)
+
+    def test_exact_cut_values_rank_left(self, tmp_path):
+        # x exactly equal to a cut must rank strictly-less (#{c < x})
+        # on both sides — the bit-exactness contract of the rank wire
+        doc = _doc(tmp_path, n_trees=12, depth=4, n_features=4)
+        q = build_quantized_scorer(doc, batch_size=None)
+        cuts = q.wire.cuts
+        rows = []
+        for j, c in enumerate(cuts):
+            if len(c):
+                row = np.zeros((len(cuts),), np.float32)
+                row[j] = c[len(c) // 2]
+                rows.append(row)
+        X = np.asarray(rows, np.float32)
+        self._assert_codes_equal(q, X)
+
+
+class TestFusedScoringParity:
+    def test_xla_regression_all_lanes(self, tmp_path):
+        doc = _doc(tmp_path, n_trees=21, depth=4, n_features=8)
+        B = 64
+        q = build_quantized_scorer(doc, batch_size=B, backend="xla")
+        rng = np.random.default_rng(4)
+        for n in (B, B - 9, 2 * B, 2 * B + 7):
+            X = _rand_X(rng, n, 8, missing_rate=0.2)
+            host = q.decode(q.predict_wire(q.wire.encode(X)), n)
+            fused = q.decode(q.predict_fused(X), n)
+            np.testing.assert_allclose(
+                [p.score.value for p in fused],
+                [p.score.value for p in host],
+                rtol=0, atol=0,
+            )
+
+    def test_pallas_interpret_fused(self, tmp_path):
+        doc = _doc(tmp_path, n_trees=13, depth=3, n_features=4)
+        B = 32
+        qp = build_quantized_scorer(
+            doc, batch_size=B, backend="pallas", pallas_interpret=True
+        )
+        assert qp is not None and qp.backend == "pallas"
+        assert qp.supports_fused
+        rng = np.random.default_rng(5)
+        for n in (B, 2 * B):  # exercises the fused scan (K > 1) too
+            X = _rand_X(rng, n, 4, missing_rate=0.15)
+            host = np.asarray(
+                qp.predict_wire(qp.wire.encode(X)), np.float32
+            )[:n]
+            fused = np.asarray(qp.predict_fused(X), np.float32)[:n]
+            np.testing.assert_allclose(fused, host, rtol=0, atol=0)
+
+    def test_classification_triple_fused(self):
+        doc = parse_pmml(_forest_xml("majorityVote", n_trees=8))
+        B = 32
+        q = build_quantized_scorer(doc, batch_size=B, backend="xla")
+        assert q.is_classification and q.supports_fused
+        rng = np.random.default_rng(6)
+        X = _rand_X(rng, B, 4, missing_rate=0.2)
+        hv, hp, hl = q.predict_wire(q.wire.encode(X))
+        fv, fp, fl = q.predict_fused(X)
+        np.testing.assert_array_equal(np.asarray(fl), np.asarray(hl))
+        np.testing.assert_allclose(
+            np.asarray(fp), np.asarray(hp), rtol=0, atol=0
+        )
+        np.testing.assert_allclose(
+            np.asarray(fv), np.asarray(hv), rtol=0, atol=0
+        )
+
+    def test_f32_reference_agreement(self, tmp_path):
+        doc = _doc(tmp_path, n_trees=15, depth=4, n_features=6)
+        B = 64
+        cm = compile_pmml(doc, batch_size=B)
+        q = build_quantized_scorer(doc, batch_size=B)
+        rng = np.random.default_rng(7)
+        X = _rand_X(rng, B, 6, missing_rate=0.25)
+        M = np.isnan(X)
+        ref = np.asarray(
+            cm.predict(np.nan_to_num(X, nan=0.0), M).value, np.float32
+        )
+        fused = np.asarray(q.predict_fused(X), np.float32)
+        np.testing.assert_allclose(fused, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestDispatchHelper:
+    def _scorer(self, tmp_path):
+        doc = _doc(tmp_path, n_trees=10, depth=3, n_features=4)
+        return build_quantized_scorer(doc, batch_size=32)
+
+    def test_fused_vs_host_identical_scores(self, tmp_path):
+        q = self._scorer(tmp_path)
+        rng = np.random.default_rng(8)
+        X = _rand_X(rng, 32, 4, missing_rate=0.2)
+        q.encode_mode = "host"
+        host = np.asarray(dispatch_quantized(q, X), np.float32)
+        q.encode_mode = "fused"
+        fused = np.asarray(dispatch_quantized(q, X), np.float32)
+        np.testing.assert_allclose(fused, host, rtol=0, atol=0)
+
+    def test_metrics_accounting(self, tmp_path):
+        q = self._scorer(tmp_path)
+        rng = np.random.default_rng(9)
+        X = _rand_X(rng, 32, 4)
+        m_host = MetricsRegistry()
+        q.encode_mode = "host"
+        dispatch_quantized(q, X, metrics=m_host)
+        assert m_host.counter("encode_s").get() > 0
+        # uint8 wire: one byte per feature per record
+        assert m_host.counter("h2d_bytes").get() == 32 * 4
+        m_fused = MetricsRegistry()
+        q.encode_mode = "fused"
+        dispatch_quantized(q, X, metrics=m_fused)
+        # fused ships raw f32: 4 bytes per feature per record
+        assert m_fused.counter("h2d_bytes").get() == 32 * 4 * 4
+
+    def test_mask_path_through_helper(self, tmp_path):
+        q = self._scorer(tmp_path)
+        rng = np.random.default_rng(10)
+        X = _rand_X(rng, 32, 4)
+        M = rng.random(size=X.shape) < 0.3
+        Xz = np.where(M, 0.0, X).astype(np.float32)
+        q.encode_mode = "host"
+        host = np.asarray(dispatch_quantized(q, Xz, M), np.float32)
+        q.encode_mode = "fused"
+        fused = np.asarray(dispatch_quantized(q, Xz, M), np.float32)
+        np.testing.assert_allclose(fused, host, rtol=0, atol=0)
+
+    def test_fused_falls_back_when_unsupported(self, tmp_path):
+        # a stale "fused" mode on a scorer without device tables must
+        # quietly take the host path, not raise
+        q = self._scorer(tmp_path)
+        q._fused_inner = None
+        q.encode_mode = "fused"
+        rng = np.random.default_rng(11)
+        X = _rand_X(rng, 32, 4)
+        out = np.asarray(dispatch_quantized(q, X), np.float32)
+        assert out.shape == (32,)

@@ -28,3 +28,5 @@ def test_perf_smoke_passes():
     )
     assert "dispatcher ordering OK" in proc.stdout
     assert "block pipeline drain/ordering OK" in proc.stdout
+    assert "fused encode parity OK" in proc.stdout
+    assert "autotune cache roundtrip OK" in proc.stdout

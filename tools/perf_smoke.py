@@ -10,7 +10,12 @@ fails loudly on exactly the regressions new concurrency code breeds:
 - **loss/duplication**: every source record reaches the sink once;
 - **shutdown hangs**: the whole check runs under a hard watchdog that
   dumps all thread stacks and force-exits non-zero if the pipeline
-  wedges instead of draining.
+  wedges instead of draining;
+- **fused-encode divergence**: the on-device featurize stage
+  (compile/qtrees.py fused path) must stay byte-identical to the host
+  bucketizer, through the production pipeline too;
+- **autotune-cache fragility**: a corrupt on-disk autotune cache must
+  read as empty (silent re-tune) — never crash a compile or a sweep.
 
 Seconds-cheap by design (tier-1 guards it — tests/test_perf_smoke.py);
 exit 0 = healthy, 1 = assertion failure, 2 = watchdog fired.
@@ -28,6 +33,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 WATCHDOG_S = float(os.environ.get("FJT_SMOKE_WATCHDOG_S", 120.0))
+
+# hermetic autotune cache: the smoke must neither inherit a developer's
+# real ~/.cache entries (a cached "fused" config would change which
+# path check_block_pipeline exercises) nor pollute them
+os.environ["FJT_AUTOTUNE_CACHE"] = os.path.join(
+    tempfile.mkdtemp(prefix="fjt-smoke-at-"), "autotune.json"
+)
 
 
 def _watchdog():
@@ -119,6 +131,122 @@ def check_block_pipeline() -> None:
     assert snap["dispatches"] >= 1
 
 
+def check_fused_pipeline_parity() -> None:
+    """Fused on-device encode through the production BlockPipeline:
+    byte-identical codes vs the host bucketizer, and identical decoded
+    scores for the whole stream (no loss, no divergence)."""
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+
+    with tempfile.TemporaryDirectory() as tmp:
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=10, depth=3, n_features=4)
+        )
+    cm = compile_pmml(doc, batch_size=64)
+    q = cm.quantized_scorer()
+    assert q is not None and q.supports_fused, "fused path unavailable"
+    rng = np.random.default_rng(1)
+    data = rng.normal(0.0, 1.5, size=(1000, 4)).astype(np.float32)
+    data[rng.random(size=data.shape) < 0.2] = np.nan
+
+    # 1) encode-stage byte parity
+    host_codes = q.wire.encode(data)
+    dev_codes = np.asarray(q.encode_device(data))
+    assert dev_codes.dtype == host_codes.dtype
+    assert np.array_equal(dev_codes, host_codes), "fused encode diverged"
+
+    # 2) whole-stream parity through the production pipeline: host-mode
+    # run vs fused-mode run over the same stream — identical dispatch
+    # shapes, so byte-identical codes must mean BIT-identical scores
+    def run_pipeline(mode):
+        q.encode_mode = mode
+        got = np.full((1000,), np.nan, np.float32)
+
+        def sink(out, n, first_off):
+            vals = np.asarray(
+                out if not hasattr(out, "value") else out.value, np.float32
+            )[:n]
+            got[first_off : first_off + n] = vals
+
+        pipe = BlockPipeline(
+            FiniteBlockSource(data, block_size=100),
+            cm,
+            sink,
+            in_flight=2,
+            use_native=False,
+        )
+        pipe.run_until_exhausted(timeout=60.0)
+        assert np.isfinite(got).all(), f"{mode} pipeline lost records"
+        return got, pipe.metrics.snapshot()
+
+    ref, snap_host = run_pipeline("host")
+    got, snap_fused = run_pipeline("fused")
+    # the two runs may pick different drain/aggregation boundaries (the
+    # fill-or-deadline ring is timing-dependent), so scores compare at
+    # f32 noise tolerance; the CODES above are the bit-exactness check
+    assert np.allclose(got, ref, rtol=1e-5, atol=1e-6), (
+        "fused pipeline scores diverged from the host-encode oracle"
+    )
+    # fused ships raw f32 (4 bytes/feature) vs the uint8 wire (1): the
+    # staged-bytes accounting must reflect it (ratio has slack because
+    # per-run padding differs with drain boundaries)
+    ratio = snap_fused["h2d_bytes"] / max(snap_host["h2d_bytes"], 1)
+    assert 3.5 < ratio < 4.6, (
+        f"fused h2d accounting wrong (bytes ratio {ratio:.2f}, expected ~4)"
+    )
+
+
+def check_autotune_cache_roundtrip() -> None:
+    """Sweep → persist → cache-consult round trip, plus the corrupt-file
+    contract: garbage on disk means silent re-tune, not a crash."""
+    import json
+
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import autotune
+    from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+
+    with tempfile.TemporaryDirectory() as tmp:
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=10, depth=3, n_features=4)
+        )
+    rng = np.random.default_rng(2)
+    X = rng.normal(0.0, 1.5, size=(64, 4)).astype(np.float32)
+    prev_cache = os.environ.get("FJT_AUTOTUNE_CACHE")
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ["FJT_AUTOTUNE_CACHE"] = os.path.join(tmp, "at.json")
+        try:
+            q = build_quantized_scorer(doc, batch_size=64)
+            cfg = autotune.ensure_tuned(q, X, repeats=1)
+            assert cfg.source == "sweep"
+            with open(autotune.cache_path()) as f:
+                assert json.load(f)["entries"], "sweep did not persist"
+            q2 = build_quantized_scorer(doc, batch_size=64)
+            assert q2.tuned is not None and q2.tuned.source == "cache", (
+                "fresh compile did not consult the cache"
+            )
+            # corrupt the file: everything must keep working silently
+            with open(autotune.cache_path(), "w") as f:
+                f.write("\x00garbage{{{")
+            q3 = build_quantized_scorer(doc, batch_size=64)  # no crash
+            assert q3.tuned is None
+            cfg3 = autotune.ensure_tuned(q3, X, repeats=1)
+            assert cfg3.source == "sweep", "corrupt cache did not re-tune"
+            with open(autotune.cache_path()) as f:
+                assert json.load(f)["entries"], "re-tune did not rewrite"
+        finally:
+            if prev_cache is None:
+                os.environ.pop("FJT_AUTOTUNE_CACHE", None)
+            else:
+                os.environ["FJT_AUTOTUNE_CACHE"] = prev_cache
+
+
 def main() -> int:
     timer = threading.Timer(WATCHDOG_S, _watchdog)
     timer.daemon = True
@@ -127,6 +255,10 @@ def main() -> int:
     print("perf-smoke: dispatcher ordering OK", flush=True)
     check_block_pipeline()
     print("perf-smoke: block pipeline drain/ordering OK", flush=True)
+    check_fused_pipeline_parity()
+    print("perf-smoke: fused encode parity OK", flush=True)
+    check_autotune_cache_roundtrip()
+    print("perf-smoke: autotune cache roundtrip OK", flush=True)
     timer.cancel()
     return 0
 
