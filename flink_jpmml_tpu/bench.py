@@ -76,6 +76,7 @@ import argparse
 import collections
 import json
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -83,6 +84,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 # jax-free (lazy jax inside): safe for the probe-polling parent
+from flink_jpmml_tpu.utils.metrics import _nearest_rank
 from flink_jpmml_tpu.utils.profiling import overlap_stats, wire_stats
 
 NORTH_STAR_REC_S = 1_000_000.0
@@ -595,6 +597,8 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
             {
                 **overlap_stats(pipe.metrics, elapsed),
                 **wire_stats(pipe.metrics, len(lats) * block),
+                # the mode's exposition snapshot (scrape-format struct)
+                "varz": pipe.metrics.struct_snapshot(),
             },
         )
 
@@ -631,6 +635,11 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
     return {
         "p50_ms": round(1000 * s[len(s) // 2], 3),
         "p99_ms": round(1000 * s[min(len(s) - 1, int(0.99 * len(s)))], 3),
+        # nearest-rank (ceil(q·n)-1, utils.metrics): int(q·n) over-
+        # indexes — at exactly 1000 samples it returns the MAX. p50/p99
+        # keep their historical convention (comparable across rounds);
+        # p999 is new this round and starts unbiased
+        "p999_ms": round(1000 * s[_nearest_rank(0.999, len(s))], 3),
         "rec_s": round(rate, 1),
         "offered_rec_s": round(offered, 1),
         "capacity_rec_s": round(capacity, 1),
@@ -644,6 +653,7 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
         "h2d_stall_ms": ostats["h2d_stall_ms"],
         "encode_ms": ostats.get("encode_ms"),
         "h2d_bytes_per_record": ostats.get("h2d_bytes_per_record"),
+        "varz": ostats.get("varz"),
     }
 
 
@@ -733,6 +743,20 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
         # encode placement + consumer decode accounting (encode_ms ≈ 0
         # when the autotuner fused the bucketize onto the device)
         line.update(wire_stats(pipe.metrics, count[0]))
+        varz = km.struct_snapshot()
+        # per-partition consumer lag (kafka_lag{partition="p"} gauges,
+        # runtime/kafka.py): hw minus the cursor at the LAST fetch —
+        # the cycling consumer seeks back to 0 at the high watermark,
+        # so this oscillates over [0, log_records) rather than sitting
+        # at 0; the field pins the gauge's plumbing end to end
+        lag = {}
+        for name, g in varz.get("gauges", {}).items():
+            m = re.match(r'^kafka_lag\{partition="(\d+)"\}$', name)
+            if m:
+                lag[m.group(1)] = g["value"]
+        if lag:
+            line["kafka_lag"] = lag
+        line["varz"] = varz
         return line
     finally:
         broker.close()
@@ -853,11 +877,14 @@ def main() -> None:
 
     def quantiles(lats):
         if not lats:
-            return None, None
+            return None, None, None
         s = sorted(lats)
+        # p50/p99 keep the historical convention (comparable across
+        # BENCH rounds); the new p999 uses unbiased nearest-rank
         return (
             round(s[len(s) // 2], 6),
             round(s[min(len(s) - 1, int(0.99 * len(s)))], 6),
+            round(s[_nearest_rank(0.999, len(s))], 6),
         )
 
     def interp_baseline(doc, X, n_records=100, repeats=3):
@@ -999,8 +1026,14 @@ def main() -> None:
         pipe.run_for(seconds=args.seconds)
         dt = time.perf_counter() - t0
         rate = count[0] / dt
-        blat = pipe.metrics.reservoir("batch_latency_s")
-        p50, p99 = blat.quantile(0.5), blat.quantile(0.99)
+        # histogram-backed quantiles (runtime/block.py records batch
+        # latency into the mergeable fixed-bucket histogram now): the
+        # same sketch a fleet scrape merges, so the bench's p999 and a
+        # production /metrics p999 are the same estimator
+        blat = pipe.metrics.histogram("batch_latency_s")
+        p50, p99, p999 = (
+            blat.quantile(0.5), blat.quantile(0.99), blat.quantile(0.999)
+        )
 
         ostats = overlap_stats(pipe.metrics, dt)
         line = {
@@ -1012,6 +1045,7 @@ def main() -> None:
             "backend": f"{backend}/{pipe.backend}",
             "p50_latency_s": round(p50, 6) if p50 is not None else None,
             "p99_latency_s": round(p99, 6) if p99 is not None else None,
+            "p999_latency_s": round(p999, 6) if p999 is not None else None,
             "windows": [round(rate, 1)],  # keys uniform with the hand loop
             "best_window": round(rate, 1),
             "overlap_efficiency": ostats["overlap_efficiency"],
@@ -1020,6 +1054,10 @@ def main() -> None:
             "donation_hits": ostats["donation_hits"],
         }
         line.update(wire_stats(pipe.metrics, count[0]))
+        # the scrape format's first consumer: the same typed struct the
+        # /metrics endpoint renders, embedded per operating mode so a
+        # BENCH_*.json diff and a Prometheus scrape tell one story
+        line["varz"] = pipe.metrics.struct_snapshot()
         autotune_fields(line)
         if interp_rate is not None:
             line["interp_rec_s"] = round(interp_rate, 1)
@@ -1180,7 +1218,7 @@ def main() -> None:
     rate, lats, ostats = by_rate[len(by_rate) // 2]
     best_rate = by_rate[-1][0]
     enc_pool.shutdown(wait=False)
-    p50, p99 = quantiles(lats)
+    p50, p99, p999 = quantiles(lats)
     stage(
         "pipelined windows: "
         + ", ".join(f"{r:,.0f}" for r, _, _ in windows)
@@ -1226,6 +1264,7 @@ def main() -> None:
         "backend": backend,
         "p50_latency_s": p50,
         "p99_latency_s": p99,
+        "p999_latency_s": p999,
         "windows": [round(r, 1) for r, _, _ in windows],
         "best_window": round(best_rate, 1),
         # overlap accounting for the MEDIAN window (the headline rate):

@@ -373,12 +373,24 @@ def ensure_tuned(
 ) -> TunedConfig:
     """The warmup entry point: cache hit → apply it; miss → sweep and
     persist the winner. Always returns the config now in force."""
+    from flink_jpmml_tpu.obs import recorder as flight
+
     key = backend_key(scorer)
     if use_cache:
         cfg = lookup(scorer.model_hash, key)
         if cfg is not None:
             apply(scorer, cfg)
+            flight.record(
+                "autotune_decision", source="cache", backend=key,
+                model_hash=scorer.model_hash, encode=cfg.encode,
+                block_b=cfg.block_b, gt=cfg.gt,
+            )
             return cfg
     cfg = sweep(scorer, X_sample, repeats=repeats, budget_s=budget_s)
     store(scorer.model_hash, key, cfg)
+    flight.record(
+        "autotune_decision", source="sweep", backend=key,
+        model_hash=scorer.model_hash, encode=cfg.encode,
+        block_b=cfg.block_b, gt=cfg.gt, rec_s=cfg.rec_s,
+    )
     return cfg

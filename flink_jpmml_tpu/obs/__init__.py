@@ -1,0 +1,23 @@
+"""Observability plane: flight recorder, span export, /metrics endpoint.
+
+The reference leaned on Flink's web UI, slf4j logging, and backpressure
+monitors (SURVEY.md §6); the TPU-native runtime replaced those with an
+in-process :class:`~flink_jpmml_tpu.utils.metrics.MetricsRegistry` that
+only the bench read. This package makes a served fleet observable from
+the outside:
+
+- :mod:`flink_jpmml_tpu.obs.recorder` — a bounded ring of structured
+  runtime events (reconnects, checkpoint saves, worker deaths, autotune
+  decisions) dumped to JSONL on failure, so postmortems get the last N
+  events instead of nothing;
+- :mod:`flink_jpmml_tpu.obs.spans` — env-gated chrome://tracing
+  (Perfetto-loadable) span export for the pipeline stages and the
+  in-flight dispatch window (``FJT_TRACE_DIR``);
+- :mod:`flink_jpmml_tpu.obs.server` — stdlib-HTTP exposition:
+  ``/metrics`` (Prometheus text), ``/healthz``, ``/varz`` (JSON), fed by
+  one registry or by a whole supervised fleet's merged heartbeat
+  snapshots (``runtime/supervisor.py``).
+"""
+
+from flink_jpmml_tpu.obs.recorder import FlightRecorder, record  # noqa: F401
+from flink_jpmml_tpu.obs.server import ObsServer, prometheus_text  # noqa: F401

@@ -1,0 +1,130 @@
+"""Chrome-tracing span export (Perfetto-loadable), env-gated.
+
+``FJT_TRACE_DIR=/tmp/fjt-trace`` makes the runtime's host-side stages
+(featurize / h2d+dispatch / readback / sink via ``profiling.StageTimer``
+and ``annotate``) and the :class:`OverlappedDispatcher` in-flight window
+emit complete-events (``"ph": "X"``) into
+``$FJT_TRACE_DIR/spans-<pid>.trace.json`` — load the file in
+https://ui.perfetto.dev or chrome://tracing to see where stream time
+goes, per thread, alongside any ``jax.profiler`` device trace.
+
+Unset (the default) every emit is a dict lookup + None check — cheap
+enough to leave the call sites unconditional. The file is size-bounded
+(``FJT_TRACE_MAX_MB``, default 64): when the budget is hit one
+truncation marker is written and the writer goes quiet, so a long-lived
+worker cannot fill the disk. The format is the JSON Array Format with
+one event per line and no closing bracket — both loaders accept the
+truncated array, which is exactly what an abruptly-killed worker leaves
+behind.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+_DIR_ENV = "FJT_TRACE_DIR"
+_MAX_ENV = "FJT_TRACE_MAX_MB"
+
+
+class SpanWriter:
+    def __init__(self, path: str, max_bytes: int = 64 << 20):
+        self._path = path
+        self._max = max_bytes
+        self._bytes = 0
+        self._truncated = False
+        self._lock = threading.Lock()
+        self._f = open(path, "w", encoding="utf-8")
+        self._f.write("[\n")
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def emit(
+        self, name: str, t0_s: float, dur_s: float, **args
+    ) -> None:
+        """One complete-event: ``t0_s`` on the ``time.monotonic`` clock
+        (every emitter uses it, so spans align across threads)."""
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": round(t0_s * 1e6, 1),
+            "dur": round(dur_s * 1e6, 1),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "cat": "fjt",
+        }
+        if args:
+            ev["args"] = args
+        line = json.dumps(ev) + ",\n"
+        with self._lock:
+            if self._truncated:
+                return
+            if self._bytes + len(line) > self._max:
+                self._truncated = True
+                line = json.dumps({
+                    "name": "TRACE TRUNCATED (FJT_TRACE_MAX_MB)",
+                    "ph": "i", "ts": ev["ts"], "pid": ev["pid"],
+                    "tid": ev["tid"], "s": "g",
+                }) + ",\n"
+            try:
+                self._f.write(line)
+                self._f.flush()  # a killed worker keeps what it wrote
+                self._bytes += len(line)
+            except (OSError, ValueError):
+                self._truncated = True  # fd gone: go quiet, stay alive
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+_writer: Optional[SpanWriter] = None
+_writer_dir: Optional[str] = None
+_writer_lock = threading.Lock()
+
+
+def writer() -> Optional[SpanWriter]:
+    """The process's lazy singleton writer; None when tracing is off.
+    Re-checks the env var so tests (and long-lived REPLs) can gate it
+    on/off without re-importing."""
+    global _writer, _writer_dir
+    d = os.environ.get(_DIR_ENV)
+    if not d:
+        return None
+    if _writer is None or _writer_dir != d:
+        with _writer_lock:
+            if _writer is None or _writer_dir != d:
+                try:
+                    os.makedirs(d, exist_ok=True)
+                    max_mb = float(os.environ.get(_MAX_ENV) or 64)
+                    _writer = SpanWriter(
+                        os.path.join(d, f"spans-{os.getpid()}.trace.json"),
+                        max_bytes=int(max_mb * (1 << 20)),
+                    )
+                    _writer_dir = d
+                except (OSError, ValueError):
+                    return None
+    return _writer
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(_DIR_ENV))
+
+
+def emit(name: str, t0_s: float, dur_s: float, **args) -> None:
+    w = writer()
+    if w is not None:
+        w.emit(name, t0_s, dur_s, **args)
+
+
+def span_clock() -> float:
+    """The clock spans are stamped on (`time.monotonic`)."""
+    return time.monotonic()

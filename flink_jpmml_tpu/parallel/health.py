@@ -16,7 +16,12 @@ equivalent here:
   callback cannot take the monitor down (exceptions are swallowed).
 - :class:`HealthReporter` — the worker side: beats every
   ``interval_s`` over a persistent connection, reconnecting with
-  backoff through coordinator restarts.
+  backoff through coordinator restarts. With ``snapshot_fn`` set (a
+  ``MetricsRegistry.struct_snapshot`` bound method is the intended
+  value) every beat piggybacks a compact metrics snapshot, so the
+  coordinator holds each worker's latest counters/gauges/histograms and
+  the supervisor's ``/metrics`` endpoint (obs/server.py) can expose the
+  merged fleet view without a second wire protocol.
 
 Recovery itself stays the C7 model: the operator (or a supervisor
 script) restarts the dead worker, which resumes from the checkpointed
@@ -38,10 +43,13 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.utils.netio import recv_exact
 
 _U32 = struct.Struct(">I")
-_MAX_FRAME = 4096  # heartbeats are tiny; anything bigger is garbage
+# beats may piggyback a metrics struct_snapshot (sparse histograms for
+# a busy worker run tens of KB); anything bigger than this is garbage
+_MAX_FRAME = 1 << 20
 
 
 class HealthCoordinator:
@@ -72,6 +80,11 @@ class HealthCoordinator:
         self._on_recover = on_recover
         self._mu = threading.Lock()
         self._last_seen: Dict[str, float] = {}
+        # latest piggybacked metrics struct per worker (see
+        # HealthReporter.snapshot_fn); deliberately kept after death —
+        # a dead worker's last snapshot is exactly what a postmortem
+        # scrape wants — dropped only by remove()/expiry
+        self._snapshots: Dict[str, dict] = {}
         # known workers → declared dead? (transitions only on the
         # monitor thread; _beat just stamps _last_seen)
         self._declared_dead: Dict[str, bool] = {}
@@ -102,11 +115,19 @@ class HealthCoordinator:
         with self._mu:
             return self._last_seen.get(worker_id)
 
+    def metrics_snapshots(self) -> Dict[str, dict]:
+        """Latest piggybacked metrics struct per worker (copies the
+        mapping, not the structs: a worker's snapshot is replaced whole
+        on each beat, never mutated in place)."""
+        with self._mu:
+            return dict(self._snapshots)
+
     def remove(self, worker_id: str) -> None:
         """Deregister a decommissioned worker (no callback)."""
         with self._mu:
             self._last_seen.pop(worker_id, None)
             self._declared_dead.pop(worker_id, None)
+            self._snapshots.pop(worker_id, None)
 
     # -- internals ---------------------------------------------------------
 
@@ -146,8 +167,11 @@ class HealthCoordinator:
                     wid = str(beat["id"])
                 except (ValueError, KeyError, TypeError):
                     continue  # one garbage frame must not kill the feed
+                snap = beat.get("metrics")
                 with self._mu:
                     self._last_seen[wid] = time.monotonic()
+                    if isinstance(snap, dict):
+                        self._snapshots[wid] = snap
         finally:
             try:
                 conn.close()
@@ -193,11 +217,14 @@ class HealthCoordinator:
                     ):
                         self._last_seen.pop(wid, None)
                         self._declared_dead.pop(wid, None)
+                        self._snapshots.pop(wid, None)
             # single thread, strict order: a recovery observed in the
             # same sweep as a death cannot be delivered out of order
             for wid in newly_dead:
+                flight.record("heartbeat_dead", worker=wid)
                 self._fire(self._on_dead, wid)
             for wid in recovered:
+                flight.record("heartbeat_recover", worker=wid)
                 self._fire(self._on_recover, wid)
 
     def close(self) -> None:
@@ -226,11 +253,17 @@ class HealthReporter:
         worker_id: str,
         interval_s: float = 0.5,
         reconnect_backoff_s: float = 0.2,
+        snapshot_fn: Optional[Callable[[], dict]] = None,
     ):
+        """``snapshot_fn`` (optional) is called once per beat and its
+        dict rides along as the beat's ``"metrics"`` field — pass a
+        registry's ``struct_snapshot`` so the coordinator/supervisor
+        can serve this worker's metrics without a second protocol."""
         self._addr = (host, port)
         self._id = worker_id
         self._interval = interval_s
         self._backoff = reconnect_backoff_s
+        self._snapshot_fn = snapshot_fn
         self._stop = threading.Event()
         self._seq = 0
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -249,9 +282,15 @@ class HealthReporter:
                     conn = None
                     self._stop.wait(self._backoff)
                     continue
-            payload = json.dumps(
-                {"id": self._id, "seq": self._seq}
-            ).encode()
+            beat = {"id": self._id, "seq": self._seq}
+            if self._snapshot_fn is not None:
+                try:
+                    beat["metrics"] = self._snapshot_fn()
+                except Exception:
+                    # a broken snapshot hook must not stop the
+                    # heartbeat — liveness outranks metrics
+                    pass
+            payload = json.dumps(beat, default=repr).encode()
             self._seq += 1
             try:
                 conn.sendall(_U32.pack(len(payload)) + payload)

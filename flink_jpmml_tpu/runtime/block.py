@@ -27,6 +27,7 @@ import numpy as np
 
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.runtime.pipeline import (
     OverlappedDispatcher,
@@ -582,14 +583,19 @@ class BlockPipelineBase:
         records_out = self.metrics.counter("records_out")
         batches = self.metrics.counter("batches")
         fill = self.metrics.counter("batch_fill_records")
-        lat = self.metrics.reservoir("batch_latency_s")
+        # fixed-bucket histogram, not a reservoir: N workers' bucket
+        # counts ADD, so the supervisor's fleet /metrics view can merge
+        # per-worker latency distributions exactly (utils/metrics.py)
+        lat = self.metrics.histogram("batch_latency_s")
 
         def _complete(pair, meta):
             """FIFO completion off the dispatcher: sink, then commit —
             offsets only advance past records that reached the sink."""
             out, decode = pair
             n, first_off, t_start = meta
+            t_sink = time.monotonic()
             self._emit(out, n, first_off, decode)
+            spans.emit("sink", t_sink, time.monotonic() - t_sink, n=n)
             lat.observe(time.monotonic() - t_start)
             records_out.inc(n)
             self.committed_offset = first_off + n

@@ -20,6 +20,7 @@ import time
 import warnings
 from typing import Any, Dict, Optional
 
+from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.utils.exceptions import CheckpointException
 
 _PREFIX = "ckpt-"
@@ -75,7 +76,12 @@ class CheckpointManager:
             path = os.path.join(self._dir, f"{_PREFIX}{int(time.time() * 1e6)}.json")
             os.rename(tmp, path)
         except OSError as e:
+            flight.record("checkpoint_save_failed", error=str(e))
             raise CheckpointException(f"cannot write checkpoint: {e}") from e
+        flight.record(
+            "checkpoint_save", path=path,
+            source_offset=state.get("source_offset"),
+        )
         self._gc()
         return path
 
@@ -128,6 +134,13 @@ class CheckpointManager:
                     RuntimeWarning,
                     stacklevel=2,
                 )
+            flight.record(
+                "checkpoint_load", path=path, skipped_corrupt=len(errors),
+                source_offset=(
+                    state.get("source_offset")
+                    if isinstance(state, dict) else None
+                ),
+            )
             return state
         raise CheckpointException(
             f"no readable checkpoint: {'; '.join(errors)}"

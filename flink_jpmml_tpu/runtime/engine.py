@@ -295,7 +295,9 @@ class Pipeline:
         records_out = self.metrics.counter("records_out")
         batches = self.metrics.counter("batches")
         fill = self.metrics.counter("batch_fill_records")
-        lat = self.metrics.reservoir("record_latency_s")
+        # mergeable histogram (not a reservoir): fleet aggregation adds
+        # bucket counts, so multi-worker p50/p99/p999 stay correct
+        lat = self.metrics.histogram("record_latency_s")
         in_flight: List[Tuple[Any, List[_Stamped]]] = []
 
         stages = StageTimer(self.metrics)

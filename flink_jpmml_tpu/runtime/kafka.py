@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.runtime.block import BlockSource
 from flink_jpmml_tpu.runtime.sources import Polled, Record, Source
 
@@ -598,8 +599,20 @@ class _KafkaSourceBase:
         max_wait_ms: int = 50,
         reconnect_backoff_s: float = 0.05,
         interleave: str = "auto",
+        metrics=None,
     ):
         self._client = KafkaClient(host, port)
+        # observability (optional MetricsRegistry): fetch-RPC latency as
+        # a mergeable histogram, and per-partition consumer lag gauges —
+        # kafka_lag{partition="p"} = broker high-water mark minus this
+        # consumer's fetch cursor at fetch time, the classic "how far
+        # behind is this worker" signal the fleet /metrics view scrapes
+        self._metrics = metrics
+        self._fetch_hist = (
+            metrics.histogram("kafka_fetch_s") if metrics is not None
+            else None
+        )
+        self._lag_gauges: Dict[int, object] = {}
         self._topic = topic
         self._parts = (
             tuple(partitions) if partitions is not None else (partition,)
@@ -647,6 +660,10 @@ class _KafkaSourceBase:
         # reconnect-at-offset: exactly the consumer resume model —
         # nothing is lost or duplicated because the cursors only
         # advance on successfully decoded records
+        flight.record(
+            "kafka_reconnect", topic=self._topic,
+            partitions=list(self._parts),
+        )
         self._client.close()
         time.sleep(self._backoff)
         try:
@@ -654,11 +671,23 @@ class _KafkaSourceBase:
         except OSError:
             pass
 
+    def _observe_fetch(self, part: int, offset: int, hw: int,
+                       t0: float) -> None:
+        if self._metrics is None:
+            return
+        self._fetch_hist.observe(time.monotonic() - t0)
+        g = self._lag_gauges.get(part)
+        if g is None:
+            g = self._metrics.gauge(f'kafka_lag{{partition="{part}"}}')
+            self._lag_gauges[part] = g
+        g.set(max(hw - offset, 0))
+
     def _fetch_part(
         self, part: int, offset: int, max_wait_ms: Optional[int] = None
     ) -> List[Tuple[int, bytes]]:
+        t0 = time.monotonic()
         try:
-            _, recs = self._client.fetch(
+            hw, recs = self._client.fetch(
                 self._topic, part, offset,
                 max_wait_ms=(
                     self._max_wait_ms if max_wait_ms is None else max_wait_ms
@@ -669,13 +698,15 @@ class _KafkaSourceBase:
         except (OSError, ConnectionError, KafkaProtocolError):
             self._reconnect()
             return []
+        self._observe_fetch(part, offset, hw, t0)
         return recs
 
     def _fetch_raw_part(
         self, part: int, offset: int, max_wait_ms: Optional[int] = None
     ) -> bytes:
+        t0 = time.monotonic()
         try:
-            _, raw = self._client.fetch_raw(
+            hw, raw = self._client.fetch_raw(
                 self._topic, part, offset,
                 max_wait_ms=(
                     self._max_wait_ms if max_wait_ms is None else max_wait_ms
@@ -686,6 +717,7 @@ class _KafkaSourceBase:
         except (OSError, ConnectionError, KafkaProtocolError):
             self._reconnect()
             return b""
+        self._observe_fetch(part, offset, hw, t0)
         return raw
 
     def _fetch(self) -> List[Tuple[int, bytes]]:
@@ -931,10 +963,11 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
     time into a ``kafka_decode_s`` counter — the consumer-thread half
     of the stream's host budget, reported next to the score loop's
     ``encode_s`` so the bench's ``kafka_mode`` can say where consumer
-    CPU goes (``decode_ms``)."""
+    CPU goes (``decode_ms``) — plus the base class's fetch-latency
+    histogram and per-partition ``kafka_lag`` gauges."""
 
     def __init__(self, *args, n_cols: int, metrics=None, **kw):
-        super().__init__(*args, **kw)
+        super().__init__(*args, metrics=metrics, **kw)
         self._cols = n_cols
         self._decode_s = (
             metrics.counter("kafka_decode_s") if metrics is not None else None

@@ -47,6 +47,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.runtime.block import BlockSource
 from flink_jpmml_tpu.runtime.sources import Polled, Record, Source
 
@@ -282,7 +283,14 @@ class _FrameClient:
                         return body
                 chunk = self._sock.recv(1 << 20)
                 if not chunk:
-                    self._disconnect()  # server went away mid-stream
+                    # server went away mid-stream: one event per lost
+                    # connection (reconnect ATTEMPTS are throttled spin
+                    # and would flood the ring)
+                    flight.record(
+                        "net_disconnect", peer=f"{self._addr[0]}:"
+                        f"{self._addr[1]}", next_offset=self.next_offset,
+                    )
+                    self._disconnect()
                     return None
                 self._buf.extend(chunk)
         except socket.timeout:
@@ -295,6 +303,11 @@ class _FrameClient:
                 pass
             return None
         except OSError:
+            flight.record(
+                "net_disconnect",
+                peer=f"{self._addr[0]}:{self._addr[1]}",
+                next_offset=self.next_offset,
+            )
             self._disconnect()
             return None
 

@@ -62,6 +62,8 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 
@@ -131,6 +133,9 @@ def filter_donate_warning(shape_re: str) -> None:
         ),
     )
     _DONATE_WARN_FILTERED.add(shape_re)
+    # once per shape, so a postmortem can see which donation warnings
+    # this process decided were inert (and when)
+    flight.record("donation_warning_filtered", shape_re=shape_re)
 
 
 # per-registry (encode_s, h2d_bytes) counter pairs: resolving through
@@ -208,18 +213,28 @@ def dispatch_quantized(
     else:
         payload, K = q.pad_wire(q.wire.encode(X, M))
         predict = q.predict_padded
+    t1 = time.monotonic()
+    spans.emit("featurize", t0, t1 - t0, fused=fused)
     if enc is not None:
-        enc.inc(time.monotonic() - t0)
+        enc.inc(t1 - t0)
     if h2d is not None:
         h2d.inc(payload.nbytes)
     if not donate:
-        return predict(payload, K)  # async dispatch
+        out = predict(payload, K)  # async dispatch
+        spans.emit(
+            "h2d_dispatch", t1, time.monotonic() - t1,
+            bytes=payload.nbytes,
+        )
+        return out
     import jax
 
     if fused:
         filter_donate_warning(rf"float32\[\d+,{payload.shape[1]}\]")
     staged = jax.device_put(payload)  # async H2D staging copy
     out = predict(staged, K, donate=True)
+    spans.emit(
+        "h2d_dispatch", t1, time.monotonic() - t1, bytes=payload.nbytes
+    )
     deleted = getattr(staged, "is_deleted", None)
     if deleted is not None and deleted() and donation_hits is not None:
         donation_hits.inc()
@@ -324,6 +339,7 @@ class OverlappedDispatcher:
         if not self._window:
             return None
         handle = self._window[0]
+        depth = len(self._window)
         t0 = time.monotonic()
         try:
             _block_ready(handle.out)
@@ -335,6 +351,11 @@ class OverlappedDispatcher:
             # stall time counts even when the wait raised: the host WAS
             # gated on the device for that long either way
             self._stall.inc(time.monotonic() - t0)
+            # the in-flight window on the trace: how long the host sat
+            # on the oldest dispatch, and how deep the window was
+            spans.emit(
+                "readback", t0, time.monotonic() - t0, inflight=depth
+            )
             # the entry leaves the window regardless — a poisoned batch
             # must not wedge every later flush
             self._window.popleft()
@@ -380,6 +401,8 @@ class OverlappedDispatcher:
         n = len(self._window)
         self._window.clear()
         self._gauge.set(0)
+        if n:  # a give-up is exactly what a postmortem wants to see
+            flight.record("dispatch_abandon", dropped=n)
         return n
 
     def close(self, drain: bool = True) -> None:

@@ -46,21 +46,35 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.obs.server import ObsServer
 from flink_jpmml_tpu.parallel.health import HealthCoordinator, HealthReporter
+from flink_jpmml_tpu.utils.metrics import merge_structs
 
 _ADDR_ENV = "FJT_SUPERVISOR_ADDR"
 _ID_ENV = "FJT_WORKER_ID"
 
 
-def reporter_from_env(interval_s: float = 0.25) -> Optional[HealthReporter]:
+def reporter_from_env(
+    interval_s: float = 0.25, metrics=None
+) -> Optional[HealthReporter]:
     """Worker side: start beating to the supervising coordinator named
-    by the environment (no-op → None when not under supervision)."""
+    by the environment (no-op → None when not under supervision).
+    ``metrics`` (a ``MetricsRegistry``) makes every beat piggyback its
+    ``struct_snapshot`` so the supervisor's ``/metrics`` endpoint can
+    serve this worker's counters/histograms — the one-line opt-in to
+    fleet observability."""
     addr = os.environ.get(_ADDR_ENV)
     wid = os.environ.get(_ID_ENV)
     if not addr or not wid:
         return None
     host, port = addr.rsplit(":", 1)
-    return HealthReporter(host, int(port), wid, interval_s=interval_s)
+    return HealthReporter(
+        host, int(port), wid, interval_s=interval_s,
+        snapshot_fn=(
+            metrics.struct_snapshot if metrics is not None else None
+        ),
+    )
 
 
 @dataclass(frozen=True)
@@ -147,6 +161,7 @@ class Supervisor:
             s.worker_id: _WorkerState(spec=s) for s in specs
         }
         self._closing = False
+        self._obs: Optional[ObsServer] = None
         self._group = restart_group
         # group mode: ONE shared failure budget + backoff clock
         self._group_failures: List[float] = []
@@ -184,6 +199,8 @@ class Supervisor:
         # a spawn failure that immediately exhausts the budget must
         # still reach the operator (callbacks outside the lock)
         for wid in give_up:
+            flight.record("worker_give_up", worker=wid)
+            flight.dump(reason=f"worker_give_up:{wid}")
             if self._on_give_up is not None:
                 try:
                     self._on_give_up(wid)
@@ -218,10 +235,17 @@ class Supervisor:
             self._watcher.join(timeout=5.0)
         if self._coord is not None:
             self._coord.close()
+        if self._obs is not None:
+            self._obs.close()
+            self._obs = None
 
     # -- views -------------------------------------------------------------
 
     def status(self) -> Dict[str, dict]:
+        """Per-worker liveness + the worker's latest heartbeat-
+        piggybacked metrics struct (None until its first metric-bearing
+        beat, or without a coordinator) — the fleet view in one call."""
+        snaps = self.metrics_snapshots()
         with self._mu:
             return {
                 wid: {
@@ -231,9 +255,61 @@ class Supervisor:
                     "restarts": st.restarts,
                     "finished": st.finished,
                     "gave_up": st.gave_up,
+                    "metrics": snaps.get(wid),
                 }
                 for wid, st in self._workers.items()
             }
+
+    def metrics_snapshots(self) -> Dict[str, dict]:
+        """Latest piggybacked metrics struct per worker id."""
+        if self._coord is None:
+            return {}
+        return self._coord.metrics_snapshots()
+
+    def fleet_metrics(self) -> dict:
+        """The merged fleet view: counters/gauges add, histogram
+        buckets add — quantiles over the merge are exact
+        (utils/metrics.merge_structs)."""
+        return merge_structs(self.metrics_snapshots().values())
+
+    def start_obs_server(
+        self, host: str = "127.0.0.1", port: int = 0
+    ) -> ObsServer:
+        """Expose the fleet on HTTP: ``/metrics`` (Prometheus text —
+        the aggregate unlabeled, per-worker series labeled
+        ``worker="..."``), ``/healthz`` (503 once nothing is alive and
+        not everything finished cleanly), ``/varz`` (raw structs).
+        Closed by :meth:`stop`; calling again first closes the previous
+        server (releasing its port) — a rebind, not a leak."""
+        if self._obs is not None:
+            self._obs.close()
+            self._obs = None
+
+        def collect():
+            snaps = self.metrics_snapshots()
+            sources: Dict[Optional[str], dict] = {
+                None: merge_structs(snaps.values())
+            }
+            sources.update(snaps)
+            return sources
+
+        def health():
+            st = self.status()
+            ok = any(s["alive"] for s in st.values()) or (
+                bool(st) and all(s["finished"] for s in st.values())
+            )
+            return {
+                "ok": ok,
+                "workers": {
+                    w: {k: v for k, v in s.items() if k != "metrics"}
+                    for w, s in st.items()
+                },
+            }
+
+        self._obs = ObsServer(
+            collect, host=host, port=port, health_fn=health
+        )
+        return self._obs
 
     @property
     def coordinator_address(self) -> Optional[str]:
@@ -259,11 +335,18 @@ class Supervisor:
             st.proc = subprocess.Popen(
                 list(st.spec.argv), env=env, cwd=st.spec.cwd
             )
-        except OSError:
+        except OSError as e:
             st.proc = None
+            flight.record(
+                "worker_spawn_failed", worker=st.spec.worker_id,
+                error=str(e),
+            )
             return False
         st.spawned_at = time.monotonic()
         st.restart_at = None
+        flight.record(
+            "worker_spawn", worker=st.spec.worker_id, pid=st.proc.pid
+        )
         return True
 
     def _spawn_locked(self, st: _WorkerState) -> bool:
@@ -304,6 +387,9 @@ class Supervisor:
             # covers it, and killing it here would cycle restarts forever
             return
         if proc is not None and proc.poll() is None:
+            flight.record(
+                "worker_wedged_kill", worker=worker_id, pid=proc.pid
+            )
             try:
                 proc.send_signal(signal.SIGKILL)
             except OSError:
@@ -348,7 +434,7 @@ class Supervisor:
             except OSError:
                 pass
 
-    def _watch_group_locked(self, now, give_up, restarted) -> None:
+    def _watch_group_locked(self, now, give_up, restarted, deaths) -> None:
         """One sweep of full-job restart semantics (Flink's default):
         any failure → tear down all → one shared backoff → respawn
         all. Appends to the callback lists; caller holds the lock."""
@@ -404,6 +490,10 @@ class Supervisor:
                 if self._coord is not None:
                     self._coord.remove(wid)
             else:
+                deaths.append(
+                    {"worker": wid, "returncode": proc.returncode,
+                     "pid": proc.pid}
+                )
                 failed = True
         if failed:
             self._kill_live_locked()
@@ -434,12 +524,15 @@ class Supervisor:
         while True:
             give_up: List[str] = []
             restarted: List[str] = []
+            deaths: List[dict] = []
             with self._mu:
                 if self._closing:
                     return
                 now = time.monotonic()
                 if self._group:
-                    self._watch_group_locked(now, give_up, restarted)
+                    self._watch_group_locked(
+                        now, give_up, restarted, deaths
+                    )
                 for wid, st in (
                     {} if self._group else self._workers
                 ).items():
@@ -480,6 +573,10 @@ class Supervisor:
                         if self._coord is not None:
                             self._coord.remove(wid)
                         continue
+                    deaths.append(
+                        {"worker": wid, "returncode": proc.returncode,
+                         "pid": proc.pid}
+                    )
                     # failed: count against the policy window
                     (
                         st.failure_times,
@@ -495,8 +592,23 @@ class Supervisor:
                         if self._coord is not None:
                             self._coord.remove(wid)
                         give_up.append(wid)
-            # callbacks outside the lock: they may inspect status()
+            # flight recording + callbacks outside the lock (dump does
+            # file I/O; callbacks may inspect status())
+            for d in deaths:
+                flight.record("worker_death", **d)
+            if deaths:
+                # the postmortem artifact the acceptance drill reads:
+                # last-N events as JSONL, written at the moment the
+                # supervisor observed the death(s)
+                flight.dump(
+                    reason="worker_death:"
+                    + ",".join(d["worker"] for d in deaths)
+                )
             for wid in restarted:
+                flight.record(
+                    "worker_restart", worker=wid,
+                    restarts=self._workers[wid].restarts,
+                )
                 if self._on_restart is not None:
                     try:
                         self._on_restart(
@@ -505,6 +617,8 @@ class Supervisor:
                     except Exception:
                         pass
             for wid in give_up:
+                flight.record("worker_give_up", worker=wid)
+                flight.dump(reason=f"worker_give_up:{wid}")
                 if self._on_give_up is not None:
                     try:
                         self._on_give_up(wid)

@@ -20,6 +20,7 @@ to models.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -105,6 +106,9 @@ class DynamicScorer(Scorer):
         self._dispatcher = OverlappedDispatcher(
             depth=in_flight, metrics=self.metrics
         )
+        # submit→finish latency per micro-batch as a MERGEABLE histogram
+        # (the fleet /metrics view adds bucket counts across workers)
+        self._lat = self.metrics.histogram("score_latency_s")
         # models whose load/compile failed: don't re-attempt every batch;
         # cleared when the registry changes (a fixed version can be re-Added)
         self._failed: set = set()
@@ -225,10 +229,10 @@ class DynamicScorer(Scorer):
                 lambda m=model, X=X, M=M: m.predict(X, M)
             )
             tickets.append((model, idxs, handle))
-        return (n, records, tickets, unserved)
+        return (n, records, tickets, unserved, time.monotonic())
 
     def finish(self, ticket) -> List[Any]:
-        n, records, tickets, unserved = ticket
+        n, records, tickets, unserved, t_submit = ticket
         preds: List[Optional[Prediction]] = [None] * n
         for model, idxs, handle in tickets:
             out = self._dispatcher.wait(handle)
@@ -237,6 +241,8 @@ class DynamicScorer(Scorer):
                 preds[i] = p
         for i in unserved:
             preds[i] = Prediction.empty()
+        if tickets:  # an all-unserved batch scored nothing: no sample
+            self._lat.observe(time.monotonic() - t_submit)
         if self._emit is not None:
             return self._emit(records, preds)
         if self._emit_pairs:

@@ -1,17 +1,32 @@
-"""Metrics registry: counters + latency reservoirs (SURVEY.md §6).
+"""Metrics registry: counters, latency histograms, reservoirs (SURVEY.md §6).
 
 The reference exposed only slf4j logging and Flink's UI metrics; our runtime
-owns its observability: records/sec, batch fill ratio, p50/p99 per-record
-latency — the BASELINE metrics — via a small lock-guarded registry with
-structured snapshots. No external metrics framework.
+owns its observability: records/sec, batch fill ratio, p50/p99/p999
+per-record latency — the BASELINE metrics — via a small lock-guarded
+registry with structured snapshots. No external metrics framework.
+
+Two quantile sketches coexist on purpose:
+
+- :class:`Histogram` — fixed log-spaced buckets. The fleet primitive:
+  bucket counts from N workers ADD, so multi-worker quantiles aggregate
+  exactly (``merge``); this is what heartbeats piggyback and what the
+  ``/metrics`` exposition (obs/server.py) renders as Prometheus
+  histogram series. Quantiles are bucket-upper-bound nearest-rank —
+  bounded relative error set by the bucket ratio, never mergeable-wrong.
+- :class:`Reservoir` — recent-sample ring. Exact order statistics for a
+  SINGLE process, but reservoirs cannot be merged (two samples of 8k
+  from unequal populations have no correct union), so nothing that
+  feeds the fleet view uses one.
 """
 
 from __future__ import annotations
 
+import bisect
+import math
 import threading
 import time
 from dataclasses import dataclass, field as dc_field
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 
 @dataclass
@@ -47,6 +62,153 @@ class Gauge:
             return self.value
 
 
+def _nearest_rank(q: float, n: int) -> int:
+    """0-based nearest-rank index: the smallest k with (k+1)/n >= q.
+
+    ``int(q*n)`` over-indexes small samples (the p50 of 2 observations
+    is their MAX under it); ceil(q·n)-1 is the standard nearest-rank."""
+    return min(max(math.ceil(q * n) - 1, 0), n - 1)
+
+
+# shared edge tables per layout — every histogram of one layout must use
+# the IDENTICAL edges or merges would be silently wrong
+_EDGE_CACHE: Dict[Tuple[float, float, int], List[float]] = {}
+
+
+def _edges(lo: float, hi: float, buckets_per_decade: int) -> List[float]:
+    key = (lo, hi, buckets_per_decade)
+    edges = _EDGE_CACHE.get(key)
+    if edges is None:
+        n = int(math.ceil(
+            round(math.log10(hi / lo) * buckets_per_decade, 9)
+        ))
+        edges = [lo * 10.0 ** (i / buckets_per_decade) for i in range(n + 1)]
+        _EDGE_CACHE[key] = edges
+    return edges
+
+
+class Histogram:
+    """Mergeable fixed-bucket histogram over log-spaced edges.
+
+    Bucket i counts observations v <= edges[i] (bucket 0 also absorbs
+    anything below ``lo``); one extra overflow bucket holds v > ``hi``.
+    ``quantile`` returns the nearest-rank bucket's upper edge clamped to
+    the true observed max — an upper bound with relative error set by
+    the bucket ratio (default 4 buckets/decade ⇒ ≤ 78%... in the worst
+    case within a bucket, typically far less), and — the property the
+    fleet view needs — ``merge(a, b).quantile(q)`` is exactly the
+    quantile of the combined observation stream's bucketing, which no
+    sampling reservoir can promise.
+    """
+
+    DEFAULT_LO = 1e-6  # 1 µs
+    DEFAULT_HI = 1e3  # ~17 min; slower than that is an outage, not a tail
+    DEFAULT_BPD = 4
+
+    def __init__(
+        self,
+        lo: float = DEFAULT_LO,
+        hi: float = DEFAULT_HI,
+        buckets_per_decade: int = DEFAULT_BPD,
+    ):
+        if not (0 < lo < hi) or buckets_per_decade < 1:
+            raise ValueError(
+                f"bad histogram layout lo={lo} hi={hi} "
+                f"buckets_per_decade={buckets_per_decade}"
+            )
+        self._layout = (float(lo), float(hi), int(buckets_per_decade))
+        self._edges = _edges(*self._layout)
+        self._counts = [0] * (len(self._edges) + 1)  # +1 = overflow
+        self._sum = 0.0
+        self._n = 0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def edges(self) -> List[float]:
+        return list(self._edges)
+
+    @property
+    def layout(self) -> Tuple[float, float, int]:
+        return self._layout
+
+    def observe(self, v: float) -> None:
+        idx = bisect.bisect_left(self._edges, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._n += 1
+            if v > self._max:
+                self._max = v
+
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if self._n == 0:
+                return None
+            rank = _nearest_rank(q, self._n)
+            acc = 0
+            for i, c in enumerate(self._counts):
+                acc += c
+                if acc > rank:
+                    edge = (
+                        self._edges[i] if i < len(self._edges) else self._max
+                    )
+                    return min(edge, self._max)
+            return self._max  # unreachable: counts sum to _n
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Add ``other``'s buckets into self (in place; → self)."""
+        if other._layout != self._layout:
+            raise ValueError(
+                f"histogram layouts differ: {self._layout} vs {other._layout}"
+            )
+        with other._lock:
+            counts = list(other._counts)
+            s, n, mx = other._sum, other._n, other._max
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += s
+            self._n += n
+            if mx > self._max:
+                self._max = mx
+        return self
+
+    # -- wire format (heartbeat piggyback / BENCH varz / fleet merge) ------
+
+    def state(self) -> dict:
+        """Compact JSON-shaped state: sparse non-zero buckets only."""
+        with self._lock:
+            return {
+                "layout": list(self._layout),
+                "counts": {
+                    str(i): c for i, c in enumerate(self._counts) if c
+                },
+                "sum": self._sum,
+                "n": self._n,
+                "max": self._max,
+            }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "Histogram":
+        lo, hi, bpd = state["layout"]
+        h = cls(float(lo), float(hi), int(bpd))
+        for i, c in state.get("counts", {}).items():
+            h._counts[int(i)] += int(c)
+        h._sum = float(state.get("sum", 0.0))
+        h._n = int(state.get("n", 0))
+        h._max = float(state.get("max", 0.0))
+        return h
+
+
 class Reservoir:
     """Fixed-size sampling reservoir for latency quantiles.
 
@@ -73,8 +235,7 @@ class Reservoir:
             if not self._buf:
                 return None
             s = sorted(self._buf)
-        pos = min(int(q * len(s)), len(s) - 1)
-        return s[pos]
+        return s[_nearest_rank(q, len(s))]
 
     def count(self) -> int:
         with self._lock:
@@ -82,12 +243,16 @@ class Reservoir:
 
 
 class MetricsRegistry:
-    """Named counters and reservoirs with a one-call snapshot."""
+    """Named counters, gauges, histograms, reservoirs with one-call
+    snapshots — flat (``snapshot``) for humans/bench lines, structured
+    (``struct_snapshot``) for the fleet wire (heartbeat piggyback →
+    :func:`merge_structs` → the supervisor's aggregated ``/metrics``)."""
 
     def __init__(self) -> None:
         self._counters: Dict[str, Counter] = {}
         self._reservoirs: Dict[str, Reservoir] = {}
         self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
 
@@ -103,13 +268,26 @@ class MetricsRegistry:
         with self._lock:
             return self._gauges.setdefault(name, Gauge())
 
+    def histogram(self, name: str, **layout) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(**layout)
+            return h
+
+    def _views(self):
+        with self._lock:
+            return (
+                dict(self._counters),
+                dict(self._gauges),
+                dict(self._histograms),
+                dict(self._reservoirs),
+            )
+
     def snapshot(self) -> Dict[str, float]:
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         out: Dict[str, float] = {"uptime_s": elapsed}
-        with self._lock:
-            counters = dict(self._counters)
-            reservoirs = dict(self._reservoirs)
-            gauges = dict(self._gauges)
+        counters, gauges, histograms, reservoirs = self._views()
         for name, c in counters.items():
             v = c.get()
             out[name] = v
@@ -117,9 +295,86 @@ class MetricsRegistry:
         for name, g in gauges.items():
             out[name] = g.get()
             out[name + "_max"] = g.max
-        for name, r in reservoirs.items():
-            for q, tag in ((0.5, "p50"), (0.99, "p99")):
-                v = r.quantile(q)
+        for name, sketch in list(reservoirs.items()) + list(
+            histograms.items()
+        ):
+            qs = (
+                ((0.5, "p50"), (0.99, "p99"))
+                if isinstance(sketch, Reservoir)
+                else ((0.5, "p50"), (0.99, "p99"), (0.999, "p999"))
+            )
+            for q, tag in qs:
+                v = sketch.quantile(q)
                 if v is not None:
                     out[f"{name}_{tag}"] = v
         return out
+
+    def struct_snapshot(self) -> dict:
+        """Typed, mergeable, JSON-shaped snapshot — the fleet wire format
+        (reservoirs are deliberately absent: they cannot merge)."""
+        counters, gauges, histograms, _ = self._views()
+        return {
+            "uptime_s": max(time.monotonic() - self._t0, 1e-9),
+            "counters": {n: c.get() for n, c in counters.items()},
+            "gauges": {
+                n: {"value": g.get(), "max": g.max}
+                for n, g in gauges.items()
+            },
+            "histograms": {n: h.state() for n, h in histograms.items()},
+        }
+
+
+def merge_structs(structs: Iterable[dict]) -> dict:
+    """Merge :meth:`MetricsRegistry.struct_snapshot` dicts into one fleet
+    view: counters add, gauge values add (fleet totals: in-flight depth
+    across workers is a sum) with the max-of-maxes high-water, histogram
+    buckets add — the merge whose quantiles are exact.
+
+    Entries that don't merge are SKIPPED, never raised: the inputs are
+    heartbeat-piggybacked snapshots from remote workers (the coordinator
+    accepts any dict — garbage frames must not kill the feed, and by the
+    same logic one worker with version skew — a changed histogram layout,
+    a custom ``snapshot_fn`` shape — must not turn every supervisor
+    ``/metrics`` scrape into an HTTP 500)."""
+    out: dict = {
+        "uptime_s": 0.0, "counters": {}, "gauges": {}, "histograms": {}
+    }
+    hists: Dict[str, Histogram] = {}
+    for s in structs:
+        if not isinstance(s, dict):
+            continue
+        try:
+            out["uptime_s"] = max(
+                out["uptime_s"], float(s.get("uptime_s", 0.0))
+            )
+        except (TypeError, ValueError):
+            pass
+        for n, v in _items(s.get("counters")):
+            try:
+                out["counters"][n] = out["counters"].get(n, 0.0) + float(v)
+            except (TypeError, ValueError):
+                pass
+        for n, g in _items(s.get("gauges")):
+            try:
+                value = float(g.get("value", 0.0))
+                mx = float(g.get("max", 0.0))
+            except (AttributeError, TypeError, ValueError):
+                continue
+            agg = out["gauges"].setdefault(n, {"value": 0.0, "max": 0.0})
+            agg["value"] += value
+            agg["max"] = max(agg["max"], mx)
+        for n, hstate in _items(s.get("histograms")):
+            try:
+                h = Histogram.from_state(hstate)
+                if n in hists:
+                    hists[n].merge(h)  # ValueError on layout skew
+                else:
+                    hists[n] = h
+            except (KeyError, IndexError, TypeError, ValueError):
+                continue
+    out["histograms"] = {n: h.state() for n, h in hists.items()}
+    return out
+
+
+def _items(d):
+    return d.items() if isinstance(d, dict) else ()

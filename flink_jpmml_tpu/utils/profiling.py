@@ -10,6 +10,11 @@ TPU-native equivalents here are:
   registry so ``snapshot()`` shows where stream time goes;
 - :func:`annotate` — a ``TraceAnnotation`` wrapper so runtime stages show
   up as named spans inside the device trace.
+
+With ``FJT_TRACE_DIR`` set, :class:`StageTimer` and :func:`annotate`
+additionally emit host-side chrome://tracing spans (obs/spans.py) —
+Perfetto-loadable without TensorBoard, bounded file size, survives a
+killed worker.
 """
 
 from __future__ import annotations
@@ -18,6 +23,7 @@ import contextlib
 import time
 from typing import Dict, Iterator, Optional
 
+from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 
 
@@ -41,11 +47,17 @@ def trace(log_dir: str) -> Iterator[None]:
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named span inside the device trace (no-op overhead when not tracing)."""
+    """Named span inside the device trace (no-op overhead when not
+    tracing); also a host-side chrome://tracing span when
+    ``FJT_TRACE_DIR`` is set."""
     import jax
 
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    t0 = time.monotonic()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        spans.emit(name, t0, time.monotonic() - t0)
 
 
 def overlap_stats(
@@ -111,9 +123,10 @@ class StageTimer:
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
+        t0_span = time.monotonic()  # span clock: shared across emitters
         try:
             yield
         finally:
-            self.metrics.counter(f"stage_{name}_s").inc(
-                time.perf_counter() - t0
-            )
+            dt = time.perf_counter() - t0
+            self.metrics.counter(f"stage_{name}_s").inc(dt)
+            spans.emit(name, t0_span, dt)

@@ -59,6 +59,19 @@ class TestReservoir:
         assert r.quantile(0.5) == 7.5
         assert r.quantile(0.99) == 7.5
 
+    def test_nearest_rank_small_samples(self):
+        """ceil(q·n)-1 nearest-rank: the old int(q·n) over-indexed small
+        samples (the p50 of 2 observations returned their MAX)."""
+        r = Reservoir()
+        r.observe(1.0)
+        r.observe(2.0)
+        assert r.quantile(0.5) == 1.0  # median of 2 = the lower one
+        assert r.quantile(0.99) == 2.0
+        r.observe(3.0)
+        assert r.quantile(0.5) == 2.0  # odd n: the true middle
+        assert r.quantile(1.0) == 3.0
+        assert r.quantile(0.0) == 1.0
+
 
 class TestRegistry:
     def test_names_are_stable_handles(self):
@@ -76,9 +89,9 @@ class TestRegistry:
         assert snap["records_out"] == 100
         assert snap["records_out_per_s"] > 0
         assert snap["uptime_s"] > 0
-        # index convention: pos = int(q*n) clamped — the p50 of two
-        # samples is the upper one
-        assert snap["lat_s_p50"] == 0.75
+        # nearest-rank convention: ceil(q*n)-1 — the p50 of two samples
+        # is the LOWER one (int(q*n) over-indexed small samples)
+        assert snap["lat_s_p50"] == 0.25
         assert snap["lat_s_p99"] == 0.75
         # unobserved reservoirs contribute no NaN/None keys
         assert not any(k.startswith("empty") for k in snap)

@@ -1,0 +1,24 @@
+"""Tier-1 guard: metric names emitted in code and the operator
+catalogue (docs/operations.md) cannot silently drift — dashboards and
+alert rules key on these names (tools/metrics_lint.py)."""
+
+import pathlib
+import subprocess
+import sys
+
+_LINT = (
+    pathlib.Path(__file__).resolve().parent.parent
+    / "tools" / "metrics_lint.py"
+)
+
+
+def test_metric_names_match_catalogue():
+    proc = subprocess.run(
+        [sys.executable, str(_LINT)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, (
+        f"metrics lint rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "in sync" in proc.stdout

@@ -1,0 +1,623 @@
+"""Observability plane (ISSUE 3): mergeable histograms, the flight
+recorder, span export, the /metrics exposition endpoint, and the
+heartbeat-piggybacked fleet view.
+
+The acceptance drill (slow-marked, like every process-spawning test):
+a supervised two-worker run exposes an aggregated Prometheus /metrics
+endpoint whose histogram quantiles equal the merge of the individual
+worker registries, and SIGKILLing a worker produces a flight-recorder
+JSONL dump containing the death event.
+"""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+from flink_jpmml_tpu.obs import recorder, spans
+from flink_jpmml_tpu.obs.server import ObsServer, prometheus_text
+from flink_jpmml_tpu.utils.metrics import (
+    Histogram,
+    MetricsRegistry,
+    merge_structs,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait(pred, timeout_s: float, interval_s: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Histogram
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_empty_quantile_is_none(self):
+        h = Histogram()
+        assert h.quantile(0.5) is None
+        assert h.count() == 0
+
+    def test_quantile_bounds(self):
+        """quantile(q) is an upper bound on the true nearest-rank
+        quantile, within one bucket ratio (10^(1/4) at the default
+        4 buckets/decade)."""
+        import random
+
+        rng = random.Random(7)
+        vals = [rng.uniform(1e-5, 10.0) for _ in range(500)]
+        h = Histogram()
+        for v in vals:
+            h.observe(v)
+        s = sorted(vals)
+        ratio = 10.0 ** (1.0 / 4.0)
+        for q in (0.5, 0.9, 0.99, 0.999):
+            true = s[min(len(s) - 1, max(math.ceil(q * len(s)) - 1, 0))]
+            got = h.quantile(q)
+            assert true <= got <= true * ratio * (1 + 1e-9), (q, true, got)
+
+    def test_max_clamp_and_overflow(self):
+        h = Histogram()
+        h.observe(5e3)  # above hi: overflow bucket
+        assert h.quantile(0.5) == 5e3  # clamped to the observed max
+        h2 = Histogram()
+        h2.observe(1e-9)  # below lo: absorbed by bucket 0
+        assert h2.quantile(0.5) == 1e-9
+
+    def test_merge_associativity(self):
+        """(a ⊕ b) ⊕ c == a ⊕ (b ⊕ c) == bucketing of the combined
+        stream — the property reservoirs cannot offer."""
+        import random
+
+        rng = random.Random(11)
+        streams = [
+            [rng.uniform(1e-6, 100.0) for _ in range(200)]
+            for _ in range(3)
+        ]
+
+        def hist(vals):
+            h = Histogram()
+            for v in vals:
+                h.observe(v)
+            return h
+
+        c = hist(streams[2])
+        left = hist(streams[0]).merge(hist(streams[1])).merge(c)
+        bc = hist(streams[1]).merge(hist(streams[2]))
+        right = hist(streams[0]).merge(bc)
+        combined = hist(streams[0] + streams[1] + streams[2])
+
+        def buckets(h):
+            s = h.state()
+            return (s["counts"], s["n"], s["max"], s["layout"])
+
+        # bucket counts (what quantiles read) merge EXACTLY in any
+        # association; the float `sum` is add-order-sensitive in its
+        # last ulp, so it gets an approx check
+        assert buckets(left) == buckets(right) == buckets(combined)
+        assert left.sum() == pytest.approx(combined.sum())
+        for q in (0.5, 0.99, 0.999):
+            assert left.quantile(q) == combined.quantile(q)
+
+    def test_merge_layout_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            Histogram().merge(Histogram(lo=1e-3))
+
+    def test_state_roundtrip(self):
+        h = Histogram()
+        for v in (0.001, 0.02, 3.0, 5e4):
+            h.observe(v)
+        h2 = Histogram.from_state(
+            json.loads(json.dumps(h.state()))  # through the JSON wire
+        )
+        assert h2.state() == h.state()
+        assert h2.quantile(0.5) == h.quantile(0.5)
+
+    def test_registry_snapshot_has_p999(self):
+        m = MetricsRegistry()
+        for _ in range(10):
+            m.histogram("lat_s").observe(0.01)
+        snap = m.snapshot()
+        assert "lat_s_p50" in snap
+        assert "lat_s_p99" in snap
+        assert "lat_s_p999" in snap
+
+
+class TestMergeStructs:
+    def test_counters_gauges_histograms(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("records_out").inc(10)
+        b.counter("records_out").inc(5)
+        b.counter("only_b").inc(1)
+        a.gauge("inflight_depth").set(2)
+        b.gauge("inflight_depth").set(3)
+        a.gauge("inflight_depth").set(1)  # a's max stays 2
+        a.histogram("lat_s").observe(0.001)
+        b.histogram("lat_s").observe(1.0)
+        merged = merge_structs(
+            [a.struct_snapshot(), b.struct_snapshot()]
+        )
+        assert merged["counters"]["records_out"] == 15
+        assert merged["counters"]["only_b"] == 1
+        # gauge values ADD (fleet total in-flight), maxes take the max
+        assert merged["gauges"]["inflight_depth"]["value"] == 4
+        assert merged["gauges"]["inflight_depth"]["max"] == 3
+        h = Histogram.from_state(merged["histograms"]["lat_s"])
+        assert h.count() == 2
+        assert h.sum() == pytest.approx(1.001)
+
+    def test_empty_and_none_sources_skipped(self):
+        m = MetricsRegistry()
+        m.counter("x").inc()
+        merged = merge_structs([None, {}, m.struct_snapshot()])
+        assert merged["counters"]["x"] == 1
+
+    def test_garbage_snapshots_never_raise(self):
+        """One worker with version skew (changed layout, custom
+        snapshot_fn shape, plain garbage) must not turn every fleet
+        merge — and hence every supervisor /metrics scrape — into an
+        exception; bad entries are skipped, good ones survive."""
+        good = MetricsRegistry()
+        good.counter("records_out").inc(7)
+        good.histogram("lat_s").observe(0.01)
+        skewed = Histogram(lo=1e-3)  # different layout, same name
+        skewed.observe(0.5)
+        garbage = [
+            "not a dict",
+            {"counters": "nope", "gauges": 3, "histograms": ["x"]},
+            {"counters": {"records_out": "NaN-ish", "ok": 1},
+             "gauges": {"g": {"value": "x"}, "g2": 5},
+             "histograms": {"lat_s": {"layout": [1e-3, 1e3, 4]},
+                            "broken": {"no": "layout"},
+                            "lat2_s": None},
+             "uptime_s": "soon"},
+            {"histograms": {"lat_s": skewed.state()}},
+        ]
+        merged = merge_structs(garbage + [good.struct_snapshot()])
+        assert merged["counters"]["records_out"] == 7
+        assert merged["counters"]["ok"] == 1
+        h = Histogram.from_state(merged["histograms"]["lat_s"])
+        assert h.count() >= 1  # the unmergeable layout was skipped
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheusText:
+    def test_golden_render(self):
+        """Pinned text-format output: counter, gauge (+_max twin),
+        histogram (cumulative buckets + +Inf + sum/count), uptime —
+        over a tiny 1-bucket-per-decade layout so the golden is
+        readable."""
+        m = MetricsRegistry()
+        m.counter("records_out").inc(3)
+        m.gauge("inflight_depth").set(2)
+        h = m.histogram("lat_s", lo=0.01, hi=1.0, buckets_per_decade=1)
+        h.observe(0.005)  # bucket 0 (below lo)
+        h.observe(0.05)  # bucket 1
+        h.observe(7.0)  # overflow
+        s = m.struct_snapshot()
+        s["uptime_s"] = 12.5  # pin the one nondeterministic field
+        got = prometheus_text({None: s})
+        expected = (
+            "# TYPE fjt_inflight_depth gauge\n"
+            "fjt_inflight_depth 2\n"
+            "# TYPE fjt_inflight_depth_max gauge\n"
+            "fjt_inflight_depth_max 2\n"
+            "# TYPE fjt_lat_s histogram\n"
+            'fjt_lat_s_bucket{le="0.01"} 1\n'
+            'fjt_lat_s_bucket{le="0.1"} 2\n'
+            'fjt_lat_s_bucket{le="1"} 2\n'
+            'fjt_lat_s_bucket{le="+Inf"} 3\n'
+            "fjt_lat_s_sum 7.055\n"
+            "fjt_lat_s_count 3\n"
+            "# TYPE fjt_records_out counter\n"
+            "fjt_records_out 3\n"
+            "# TYPE fjt_uptime_s gauge\n"
+            "fjt_uptime_s 12.5\n"
+        )
+        assert got == expected
+
+    def test_worker_labels_and_unlabeled_aggregate(self):
+        agg, w0 = MetricsRegistry(), MetricsRegistry()
+        agg.counter("records_out").inc(15)
+        w0.counter("records_out").inc(15)
+        text = prometheus_text({None: agg, "w0": w0})
+        assert "fjt_records_out 15\n" in text
+        assert 'fjt_records_out{worker="w0"} 15\n' in text
+        # one TYPE line per metric name across all sources
+        assert text.count("# TYPE fjt_records_out counter") == 1
+
+    def test_labelled_registry_name_passthrough(self):
+        m = MetricsRegistry()
+        m.gauge('kafka_lag{partition="3"}').set(42)
+        text = prometheus_text({None: m})
+        assert 'fjt_kafka_lag{partition="3"} 42\n' in text
+        text2 = prometheus_text({"w1": m})
+        assert 'fjt_kafka_lag{partition="3",worker="w1"} 42\n' in text2
+
+
+class TestObsServer:
+    def test_endpoints(self):
+        m = MetricsRegistry()
+        m.counter("records_out").inc(9)
+        m.histogram("lat_s").observe(0.01)
+        health = {"ok": True}
+        srv = ObsServer.for_registry(m, health_fn=lambda: dict(health))
+        try:
+            status, text = _get(srv.url + "/metrics")
+            assert status == 200
+            assert "fjt_records_out 9\n" in text
+            assert 'fjt_lat_s_bucket{le="+Inf"} 1\n' in text
+
+            status, body = _get(srv.url + "/varz")
+            assert status == 200
+            varz = json.loads(body)
+            assert varz[""]["counters"]["records_out"] == 9
+
+            status, body = _get(srv.url + "/healthz")
+            assert status == 200 and json.loads(body)["ok"] is True
+
+            health["ok"] = False
+            try:
+                _get(srv.url + "/healthz")
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+
+            try:
+                _get(srv.url + "/nope")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_order(self):
+        r = recorder.FlightRecorder(capacity=4)
+        for i in range(10):
+            r.record("tick", i=i)
+        evs = r.events()
+        assert [e["i"] for e in evs] == [6, 7, 8, 9]
+        assert [e["seq"] for e in evs] == [7, 8, 9, 10]
+
+    def test_dump_jsonl_with_reason(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FJT_FLIGHT_DIR", str(tmp_path))
+        r = recorder.FlightRecorder()
+        r.record("kafka_reconnect", topic="t")
+        r.record("worker_death", worker="w0", returncode=-9)
+        path = r.dump(reason="test")
+        assert path is not None and os.path.dirname(path) == str(tmp_path)
+        lines = [
+            json.loads(ln)
+            for ln in open(path, encoding="utf-8")
+            if ln.strip()
+        ]
+        assert lines[0] == {
+            "t": lines[0]["t"], "kind": "dump", "reason": "test"
+        }
+        kinds = [ln["kind"] for ln in lines[1:]]
+        assert kinds == ["kafka_reconnect", "worker_death"]
+        assert lines[2]["worker"] == "w0"
+
+    def test_dump_prunes_old_files(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FJT_FLIGHT_DIR", str(tmp_path))
+        r = recorder.FlightRecorder()
+        r.record("e")
+        for _ in range(20):
+            assert r.dump(reason="spam") is not None
+        files = [n for n in os.listdir(tmp_path) if n.startswith("flight-")]
+        assert len(files) <= 16
+
+    def test_prune_keeps_newest_by_timestamp_not_filename(
+        self, tmp_path, monkeypatch
+    ):
+        """Lexicographic filename order interleaves pids (999 sorts
+        after 1000): across worker restarts that deleted the FRESH
+        dumps and kept a stale one forever. The prune key is the
+        embedded µs timestamp."""
+        monkeypatch.setenv("FJT_FLIGHT_DIR", str(tmp_path))
+        stale = tmp_path / "flight-999-1000000.jsonl"  # old, high pid
+        stale.write_text("{}\n")
+        for i in range(recorder._KEEP_DUMPS + 3):
+            (tmp_path / f"flight-1000-{2000000 + i}.jsonl").write_text(
+                "{}\n"
+            )
+        r = recorder.FlightRecorder()
+        r.record("e")
+        path = r.dump(reason="now")  # timestamped time.time()*1e6: newest
+        assert path is not None
+        kept = sorted(
+            n for n in os.listdir(tmp_path) if n.startswith("flight-")
+        )
+        assert len(kept) <= recorder._KEEP_DUMPS
+        assert "flight-999-1000000.jsonl" not in kept  # stale pruned
+        assert os.path.basename(path) in kept  # the new dump survives
+
+    def test_unjsonable_fields_fall_back_to_repr(self, tmp_path):
+        r = recorder.FlightRecorder()
+        r.record("odd", obj=object())
+        path = r.dump(path=str(tmp_path / "d.jsonl"))
+        assert path is not None
+        assert "odd" in open(path, encoding="utf-8").read()
+
+
+# ---------------------------------------------------------------------------
+# Span export
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_disabled_without_env(self, monkeypatch):
+        monkeypatch.delenv("FJT_TRACE_DIR", raising=False)
+        assert spans.writer() is None
+        spans.emit("noop", 0.0, 1.0)  # must be a silent no-op
+
+    def test_emit_writes_perfetto_events(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FJT_TRACE_DIR", str(tmp_path))
+        spans.emit("featurize", 1.0, 0.5, n=64)
+        w = spans.writer()
+        assert w is not None and os.path.dirname(w.path) == str(tmp_path)
+        raw = open(w.path, encoding="utf-8").read()
+        # JSON Array Format, truncated-array tolerant: strip the
+        # trailing comma and close it ourselves, like the loaders do
+        events = json.loads(raw.rstrip().rstrip(",") + "]")
+        ev = events[-1]
+        assert ev["name"] == "featurize" and ev["ph"] == "X"
+        assert ev["ts"] == 1e6 and ev["dur"] == 5e5
+        assert ev["args"] == {"n": 64}
+
+    def test_size_bound_truncates_once(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FJT_TRACE_DIR", str(tmp_path))
+        w = spans.SpanWriter(str(tmp_path / "t.trace.json"), max_bytes=400)
+        for i in range(100):
+            w.emit("s", float(i), 0.001)
+        w.close()
+        raw = open(w.path, encoding="utf-8").read()
+        assert len(raw) < 700  # bounded, not 100 events
+        assert raw.count("TRACE TRUNCATED") == 1
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat piggyback (in-process: reporter → coordinator)
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeatPiggyback:
+    def test_snapshots_reach_coordinator(self):
+        from flink_jpmml_tpu.parallel.health import (
+            HealthCoordinator, HealthReporter,
+        )
+
+        reg = MetricsRegistry()
+        reg.counter("records_out").inc(123)
+        reg.histogram("batch_latency_s").observe(0.02)
+        coord = HealthCoordinator(timeout_s=5.0)
+        rep = HealthReporter(
+            coord.host, coord.port, "w0", interval_s=0.05,
+            snapshot_fn=reg.struct_snapshot,
+        )
+        try:
+            assert _wait(
+                lambda: "w0" in coord.metrics_snapshots(), 10.0
+            ), coord.metrics_snapshots()
+            snap = coord.metrics_snapshots()["w0"]
+            assert snap["counters"]["records_out"] == 123
+            h = Histogram.from_state(snap["histograms"]["batch_latency_s"])
+            assert h.count() == 1
+            # remove() drops the snapshot with the registration
+            coord.remove("w0")
+            assert "w0" not in coord.metrics_snapshots()
+        finally:
+            rep.stop()
+            coord.close()
+
+    def test_broken_snapshot_fn_does_not_stop_beats(self):
+        from flink_jpmml_tpu.parallel.health import (
+            HealthCoordinator, HealthReporter,
+        )
+
+        coord = HealthCoordinator(timeout_s=5.0)
+        rep = HealthReporter(
+            coord.host, coord.port, "w0", interval_s=0.05,
+            snapshot_fn=lambda: 1 / 0,
+        )
+        try:
+            assert _wait(lambda: coord.last_seen("w0") is not None, 10.0)
+            assert coord.metrics_snapshots() == {}
+        finally:
+            rep.stop()
+            coord.close()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: death dump (fast) + the two-worker acceptance drill (slow)
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisorDeathDump:
+    def test_worker_death_dumps_flight_jsonl(self, tmp_path, monkeypatch):
+        """A supervised worker crash writes a postmortem JSONL dump
+        whose events include the death (trivial worker: no package
+        import, so this stays in the fast tier)."""
+        from flink_jpmml_tpu.runtime.supervisor import (
+            RestartPolicy, Supervisor, WorkerSpec,
+        )
+
+        monkeypatch.setenv("FJT_FLIGHT_DIR", str(tmp_path))
+        sup = Supervisor(
+            [WorkerSpec("w0", [sys.executable, "-c", "import sys; sys.exit(3)"])],
+            policy=RestartPolicy(max_restarts=0),
+            heartbeat_timeout_s=None,
+        )
+        sup.start()
+        try:
+            assert _wait(
+                lambda: any(
+                    n.startswith("flight-") for n in os.listdir(tmp_path)
+                ),
+                15.0,
+            ), os.listdir(tmp_path)
+            events = []
+            for n in sorted(os.listdir(tmp_path)):
+                if n.startswith("flight-"):
+                    with open(tmp_path / n, encoding="utf-8") as f:
+                        events += [json.loads(ln) for ln in f if ln.strip()]
+            deaths = [e for e in events if e.get("kind") == "worker_death"]
+            assert deaths and deaths[0]["worker"] == "w0"
+            assert deaths[0]["returncode"] == 3
+            spawns = [e for e in events if e.get("kind") == "worker_spawn"]
+            assert spawns and spawns[0]["worker"] == "w0"
+        finally:
+            sup.stop()
+
+
+_OBS_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from flink_jpmml_tpu.runtime.supervisor import reporter_from_env
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+wid = os.environ["FJT_WORKER_ID"]
+reg = MetricsRegistry()
+reg.counter("records_out").inc(100 if wid == "w0" else 50)
+h = reg.histogram("batch_latency_s")
+# deliberately disjoint per-worker distributions so the merged
+# quantiles differ from either worker's own
+vals = (0.0012, 0.012) if wid == "w0" else (0.12, 0.9)
+for v in vals:
+    for _ in range(50):
+        h.observe(v)
+rep = reporter_from_env(interval_s=0.05, metrics=reg)
+assert rep is not None
+time.sleep(300)
+"""
+
+
+@pytest.mark.slow
+class TestFleetMetricsDrill:
+    def test_two_worker_aggregate_and_death_dump(
+        self, tmp_path, monkeypatch
+    ):
+        """The acceptance drill: aggregated /metrics quantiles == the
+        merge of the individual worker registries, and a SIGKILLed
+        worker leaves a flight dump containing the death event."""
+        from flink_jpmml_tpu.runtime.supervisor import (
+            RestartPolicy, Supervisor, WorkerSpec,
+        )
+
+        flight_dir = tmp_path / "flight"
+        monkeypatch.setenv("FJT_FLIGHT_DIR", str(flight_dir))
+        body = textwrap.dedent(_OBS_WORKER.format(repo=REPO))
+        sup = Supervisor(
+            [
+                WorkerSpec("w0", [sys.executable, "-c", body]),
+                WorkerSpec("w1", [sys.executable, "-c", body]),
+            ],
+            policy=RestartPolicy(max_restarts=3, backoff_s=0.05),
+            heartbeat_timeout_s=2.0,
+            first_beat_timeout_s=60.0,  # worker startup imports jax
+        )
+        sup.start()
+        srv = sup.start_obs_server()
+        try:
+            assert _wait(
+                lambda: set(sup.metrics_snapshots()) == {"w0", "w1"},
+                60.0,
+            ), sup.metrics_snapshots().keys()
+
+            # heartbeat-piggybacked snapshots reach Supervisor.status()
+            st = sup.status()
+            assert st["w0"]["metrics"]["counters"]["records_out"] == 100
+            assert st["w1"]["metrics"]["counters"]["records_out"] == 50
+
+            # one scrape serves aggregate + per-worker consistently
+            status, body_ = _get(srv.url + "/varz")
+            assert status == 200
+            varz = json.loads(body_)
+            assert set(varz) == {"", "w0", "w1"}
+            merged_local = merge_structs([varz["w0"], varz["w1"]])
+            assert varz[""] == merged_local
+
+            # the aggregated histogram's quantiles equal the merge of
+            # the individual worker registries' histograms — exactly
+            h_agg = Histogram.from_state(
+                varz[""]["histograms"]["batch_latency_s"]
+            )
+            h_merge = Histogram.from_state(
+                varz["w0"]["histograms"]["batch_latency_s"]
+            ).merge(Histogram.from_state(
+                varz["w1"]["histograms"]["batch_latency_s"]
+            ))
+            for q in (0.5, 0.99, 0.999):
+                assert h_agg.quantile(q) == h_merge.quantile(q)
+            # and the known combined stream pins the estimator: 200
+            # obs, p50 = rank-100 value (0.012) ≤ edge < 0.012·10^¼
+            assert 0.012 <= h_agg.quantile(0.5) <= 0.012 * 1.7783
+            assert 0.9 <= h_agg.quantile(0.999) <= 0.9 * 1.7783
+
+            status, text = _get(srv.url + "/metrics")
+            assert status == 200
+            assert "fjt_records_out 150\n" in text
+            assert 'fjt_records_out{worker="w0"} 100\n' in text
+            assert 'fjt_records_out{worker="w1"} 50\n' in text
+            assert 'fjt_batch_latency_s_count 200\n' in text
+
+            status, body_ = _get(srv.url + "/healthz")
+            assert status == 200 and json.loads(body_)["ok"] is True
+
+            # kill w0: the supervisor's watcher dumps the ring
+            pid = sup.status()["w0"]["pid"]
+            os.kill(pid, signal.SIGKILL)
+            assert _wait(
+                lambda: flight_dir.is_dir() and any(
+                    n.startswith("flight-")
+                    for n in os.listdir(flight_dir)
+                ),
+                30.0,
+            )
+            events = []
+            for n in sorted(os.listdir(flight_dir)):
+                if n.startswith("flight-"):
+                    with open(flight_dir / n, encoding="utf-8") as f:
+                        events += [
+                            json.loads(ln) for ln in f if ln.strip()
+                        ]
+            deaths = [
+                e for e in events
+                if e.get("kind") == "worker_death"
+                and e.get("worker") == "w0"
+            ]
+            assert deaths, [e.get("kind") for e in events]
+            # the dead worker's LAST snapshot still serves (postmortem)
+            assert "w0" in sup.metrics_snapshots()
+        finally:
+            sup.stop()

@@ -30,3 +30,4 @@ def test_perf_smoke_passes():
     assert "block pipeline drain/ordering OK" in proc.stdout
     assert "fused encode parity OK" in proc.stdout
     assert "autotune cache roundtrip OK" in proc.stdout
+    assert "obs /metrics scrape OK" in proc.stdout

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Metric-name drift lint (CI tier-1 via tests/test_metrics_lint.py).
+
+Every metric name the runtime registers must appear in the operator
+catalogue (docs/operations.md, "Metric name catalogue" table) and vice
+versa — a renamed counter that silently vanishes from dashboards, or a
+documented metric nothing emits, both fail this check.
+
+Static, regex-level, zero imports of the package (runs in milliseconds
+and cannot be skewed by which code paths a test run happened to
+execute): every ``.counter("...")`` / ``.gauge(...)`` /
+``.histogram(...)`` / ``.reservoir(...)`` call with a literal (or
+f-string-literal) first argument is an emission site. F-string
+placeholders normalize to ``*`` — the same wildcard the catalogue uses
+for dynamic segments (``stage_*_s``, ``scorer_backend_*``,
+``kafka_lag{partition="*"}``).
+
+Exit 0 = in sync; 1 = drift (each direction listed); 2 = the catalogue
+table could not be found (the docs structure changed under the lint —
+fix the parser, don't delete the contract).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+from typing import Set, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SRC = REPO / "flink_jpmml_tpu"
+DOCS = REPO / "docs" / "operations.md"
+
+# .counter("name") / .gauge(f"...") — single or double quoted literal
+_CALL = re.compile(
+    r"\.(counter|gauge|histogram|reservoir)\(\s*(f?)(\"([^\"]+)\"|'([^']+)')"
+)
+_CATALOGUE_HEAD = "### Metric name catalogue"
+_ROW_NAME = re.compile(r"^\|\s*`([^`]+)`")
+
+
+def _normalize_fstring(s: str) -> str:
+    """f-string literal → catalogue wildcard form: ``{{``/``}}`` are
+    literal braces, any ``{expr}`` placeholder becomes ``*``."""
+    s = s.replace("{{", "\x00").replace("}}", "\x01")
+    s = re.sub(r"\{[^{}]*\}", "*", s)
+    return s.replace("\x00", "{").replace("\x01", "}")
+
+
+def code_names() -> Set[Tuple[str, str]]:
+    """→ {(name, 'file:line')} for every literal registration site."""
+    out: Set[Tuple[str, str]] = set()
+    for path in sorted(SRC.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _CALL.finditer(text):
+            is_f = bool(m.group(2))
+            raw = m.group(4) if m.group(4) is not None else m.group(5)
+            name = _normalize_fstring(raw) if is_f else raw
+            line = text.count("\n", 0, m.start()) + 1
+            out.add((name, f"{path.relative_to(REPO)}:{line}"))
+    return out
+
+
+def doc_names() -> Set[str]:
+    text = DOCS.read_text(encoding="utf-8")
+    try:
+        section = text.split(_CATALOGUE_HEAD, 1)[1]
+    except IndexError:
+        print(
+            f"metrics-lint: {_CATALOGUE_HEAD!r} section not found in "
+            f"{DOCS}", file=sys.stderr,
+        )
+        sys.exit(2)
+    names: Set[str] = set()
+    in_table = False
+    for line in section.splitlines():
+        if line.startswith("|"):
+            in_table = True
+            m = _ROW_NAME.match(line)
+            if m and m.group(1) not in ("Name",):
+                names.add(m.group(1))
+        elif in_table:
+            break  # one table; the first non-| line after it ends it
+    if not names:
+        print(
+            f"metrics-lint: catalogue table empty/unparseable in {DOCS}",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    return names
+
+
+def main() -> int:
+    emitted = code_names()
+    documented = doc_names()
+    emitted_names = {n for n, _ in emitted}
+    rc = 0
+    undocumented = sorted(emitted_names - documented)
+    if undocumented:
+        rc = 1
+        for n in undocumented:
+            sites = sorted(s for name, s in emitted if name == n)
+            print(
+                f"metrics-lint: `{n}` is emitted ({', '.join(sites)}) "
+                "but missing from the docs/operations.md catalogue"
+            )
+    unemitted = sorted(documented - emitted_names)
+    if unemitted:
+        rc = 1
+        for n in unemitted:
+            print(
+                f"metrics-lint: `{n}` is in the docs/operations.md "
+                "catalogue but nothing in flink_jpmml_tpu/ registers it"
+            )
+    if rc == 0:
+        print(
+            f"metrics-lint: {len(emitted_names)} metric names in sync "
+            "with the catalogue"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,11 @@ fails loudly on exactly the regressions new concurrency code breeds:
   (compile/qtrees.py fused path) must stay byte-identical to the host
   bucketizer, through the production pipeline too;
 - **autotune-cache fragility**: a corrupt on-disk autotune cache must
-  read as empty (silent re-tune) — never crash a compile or a sweep.
+  read as empty (silent re-tune) — never crash a compile or a sweep;
+- **scrape-surface rot**: a live pipeline's ``/metrics`` endpoint
+  (obs/server.py) must serve parseable Prometheus text whose
+  ``fjt_records_out`` is non-zero and whose histogram ``_count``
+  matches its ``+Inf`` bucket — the fleet dashboard's ground truth.
 
 Seconds-cheap by design (tier-1 guards it — tests/test_perf_smoke.py);
 exit 0 = healthy, 1 = assertion failure, 2 = watchdog fired.
@@ -247,6 +251,66 @@ def check_autotune_cache_roundtrip() -> None:
                 os.environ["FJT_AUTOTUNE_CACHE"] = prev_cache
 
 
+def check_obs_scrape() -> None:
+    """Live-pipeline /metrics tripwire: run a small stream with an
+    ObsServer attached to its registry, scrape over real HTTP, and
+    assert the scrape is a truthful Prometheus rendering — non-zero
+    ``fjt_records_out``, histogram ``_count`` == ``+Inf`` bucket."""
+    import urllib.request
+
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.obs.server import ObsServer
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+
+    with tempfile.TemporaryDirectory() as tmp:
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=10, depth=3, n_features=4)
+        )
+    cm = compile_pmml(doc, batch_size=64)
+    rng = np.random.default_rng(3)
+    data = rng.normal(0.0, 1.0, size=(1000, 4)).astype(np.float32)
+
+    def sink(out, n, first_off):
+        np.asarray(out if not hasattr(out, "value") else out.value)
+
+    pipe = BlockPipeline(
+        FiniteBlockSource(data, block_size=100), cm, sink,
+        in_flight=2, use_native=False,
+    )
+    srv = ObsServer.for_registry(pipe.metrics)
+    try:
+        pipe.run_until_exhausted(timeout=60.0)
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            text = r.read().decode()
+        metrics = {}
+        for line in text.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            name, value = line.rsplit(" ", 1)
+            metrics[name] = float(value)
+        assert metrics.get("fjt_records_out") == 1000, (
+            f"scraped fjt_records_out={metrics.get('fjt_records_out')}"
+            " != 1000"
+        )
+        assert metrics.get("fjt_dispatches", 0) >= 1
+        inf_bucket = metrics.get('fjt_batch_latency_s_bucket{le="+Inf"}')
+        assert inf_bucket is not None and inf_bucket >= 1, (
+            "batch latency histogram missing from the scrape"
+        )
+        assert metrics.get("fjt_batch_latency_s_count") == inf_bucket, (
+            "histogram _count != +Inf bucket — non-cumulative render"
+        )
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        srv.close()
+
+
 def main() -> int:
     timer = threading.Timer(WATCHDOG_S, _watchdog)
     timer.daemon = True
@@ -259,6 +323,8 @@ def main() -> int:
     print("perf-smoke: fused encode parity OK", flush=True)
     check_autotune_cache_roundtrip()
     print("perf-smoke: autotune cache roundtrip OK", flush=True)
+    check_obs_scrape()
+    print("perf-smoke: obs /metrics scrape OK", flush=True)
     timer.cancel()
     return 0
 
