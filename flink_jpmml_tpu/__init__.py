@@ -60,6 +60,8 @@ from flink_jpmml_tpu.models.prediction import (  # noqa: F401
 from flink_jpmml_tpu.models.control import (  # noqa: F401
     AddMessage,
     DelMessage,
+    RolloutMessage,
     ServingMessage,
 )
+from flink_jpmml_tpu.rollout import GuardrailSpec  # noqa: F401
 from flink_jpmml_tpu.models.core import ModelId, ModelInfo  # noqa: F401

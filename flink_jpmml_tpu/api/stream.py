@@ -111,6 +111,8 @@ class Stream:
         emit: Optional[EmitFn] = None,
         replace_nan: Optional[float] = None,
         batch_size: Optional[int] = None,
+        guardrails=None,
+        key_fn=None,
     ) -> "EvaluatedStream":
         """Score this stream through a PMML model (reference:
         ``stream.evaluate(modelReader) { (event, model) => … }``).
@@ -118,6 +120,13 @@ class Stream:
         ``extract`` maps a record batch → feature matrix (default: dict
         records / dense vectors against the model's active fields);
         ``emit`` shapes sink items from (records, predictions).
+
+        With a control stream attached, ``guardrails`` (a
+        :class:`~flink_jpmml_tpu.rollout.GuardrailSpec`) sets the
+        default health spec for staged rollouts pushed on it, and
+        ``key_fn`` derives the canary-split routing key per event
+        payload — see :mod:`flink_jpmml_tpu.rollout` and
+        docs/operations.md §Rollouts.
         """
         if self._control is not None:
             from flink_jpmml_tpu.serving.scorer import DynamicScorer
@@ -135,6 +144,9 @@ class Stream:
                 default_reader=reader,
                 replace_nan=replace_nan,
                 emit=emit,
+                metrics=self.env.metrics,
+                guardrails=guardrails,
+                key_fn=key_fn,
             )
         else:
             model = reader.load(

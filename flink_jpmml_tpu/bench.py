@@ -762,6 +762,150 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
         broker.close()
 
 
+def run_rollout_drill(
+    records: int = 20_000,
+    fraction: float = 0.2,
+    batch: int = 256,
+    trees: int = 10,
+    depth: int = 3,
+    features: int = 4,
+) -> dict:
+    """``--rollout-drill``: correctness drill for the rollout control
+    plane (rollout/), through the REAL DynamicScorer hot path on a real
+    (small) GBM — also the perf-smoke tripwire's engine.
+
+    Asserts the two properties a canary design most easily loses:
+
+    - **split ratio** — the deterministic per-key hash split hands the
+      candidate ``fraction`` of unpinned traffic within ±1% (absolute),
+      measured from the ``rollout_candidate_records`` counter against
+      the emitted predictions (which must also prove the candidate
+      actually served: its outputs are bit-identical here, so the
+      counter is the arbiter);
+    - **zero shadow leakage** — a shadow-stage candidate scores mirrored
+      traffic (``rollout_shadow_compared`` > 0, candidate latency
+      observed) yet the emitted stream stays exactly one prediction per
+      record and the candidate-records counter stays flat.
+
+    Raises ``AssertionError`` on violation; → the drill's JSON line."""
+    import numpy as np
+
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.models.control import AddMessage, RolloutMessage
+    from flink_jpmml_tpu.models.core import ModelId
+    from flink_jpmml_tpu.runtime.sources import ControlSource
+    from flink_jpmml_tpu.serving.scorer import DynamicScorer
+
+    t0 = time.monotonic()
+    tmp = tempfile.mkdtemp(prefix="fjt-rollout-drill-")
+    pmml_v1 = gen_gbm(tmp, n_trees=trees, depth=depth, n_features=features)
+    # the candidate is a byte-identical COPY: a healthy rollout (zero
+    # disagreement), so any split-ratio error is pure routing
+    pmml_v2 = os.path.join(tmp, "gbm_v2.pmml")
+    pmml_v3 = os.path.join(tmp, "gbm_v3.pmml")
+    with open(pmml_v1, "rb") as f:
+        doc_bytes = f.read()
+    for p in (pmml_v2, pmml_v3):
+        with open(p, "wb") as f:
+            f.write(doc_bytes)
+
+    ctrl = ControlSource()
+    sc = DynamicScorer(control=ctrl, batch_size=batch, auto_rollout=False)
+    ctrl.push(AddMessage("drill", 1, pmml_v1, timestamp=time.time()))
+    sc._drain_control()
+
+    rng = np.random.default_rng(7)
+    fields = [f"f{j}" for j in range(features)]
+    data = rng.normal(0.0, 1.5, size=(records, features)).astype(np.float32)
+
+    def event(i):
+        rec = dict(zip(fields, data[i].tolist()))
+        rec["_key"] = f"k{i}"
+        return ("drill", rec)
+
+    def run_phase():
+        emitted = 0
+        for off in range(0, records, batch):
+            out = sc.finish(
+                sc.submit([event(i) for i in range(off, off + batch)
+                           if i < records])
+            )
+            emitted += len(out)
+            assert all(not p.is_empty for p, _ in out), (
+                "drill produced empty lanes"
+            )
+        return emitted
+
+    def wait_warm(mid, timeout_s=120.0):
+        deadline = time.monotonic() + timeout_s
+        while sc.registry.model_if_warm(mid) is None:
+            err = sc.registry.warm_error(mid)
+            assert err is None, f"candidate warm failed: {err!r}"
+            assert time.monotonic() < deadline, f"{mid} never warmed"
+            time.sleep(0.02)
+
+    wait_warm(ModelId("drill", 1))
+
+    def counter(name_suffix):
+        # read-side: snapshot lookup, not .counter() — the drill must
+        # not register rollout series the scorer didn't emit
+        return sc.metrics.struct_snapshot()["counters"].get(
+            f'rollout_{name_suffix}{{model="drill"}}', 0.0
+        )
+
+    # -- canary phase ------------------------------------------------------
+    ctrl.push(RolloutMessage(
+        "drill", 2, "canary", time.time(), path=pmml_v2, fraction=fraction,
+    ))
+    sc._drain_control()
+    wait_warm(ModelId("drill", 2))
+    emitted = run_phase()
+    assert emitted == records, (
+        f"canary phase leaked/lost: emitted {emitted} != {records}"
+    )
+    cand = counter("candidate_records")
+    share = cand / records
+    assert abs(share - fraction) <= 0.01, (
+        f"canary split {share:.4f} off target {fraction} by > 1% abs"
+    )
+    ctrl.push(RolloutMessage("drill", 2, "full", time.time()))
+
+    # -- shadow phase ------------------------------------------------------
+    ctrl.push(RolloutMessage(
+        "drill", 3, "shadow", time.time(), path=pmml_v3,
+    ))
+    sc._drain_control()
+    wait_warm(ModelId("drill", 3))
+    cand_before = counter("candidate_records")
+    compared_before = counter("shadow_compared")
+    emitted = run_phase()
+    assert emitted == records, (
+        f"shadow phase leaked/lost: emitted {emitted} != {records}"
+    )
+    assert counter("candidate_records") == cand_before, (
+        "shadow-stage candidate took live traffic"
+    )
+    shadow_compared = counter("shadow_compared") - compared_before
+    assert shadow_compared > 0, "shadow stage mirrored nothing"
+    assert counter("shadow_disagree") == 0, (
+        "byte-identical candidate disagreed with the incumbent"
+    )
+    ctrl.push(RolloutMessage("drill", 3, "rollback", time.time()))
+    sc._drain_control()
+
+    return {
+        "metric": "rollout_drill",
+        "ok": True,
+        "records_per_phase": records,
+        "canary_fraction": fraction,
+        "canary_share": round(share, 5),
+        "shadow_compared": int(shadow_compared),
+        "shadow_disagree": 0,
+        "sink_leakage": 0,
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+
 def _latency_headline(line: dict, trees: int, backend: str) -> dict:
     """--latency: re-headline the artifact on the latency operating
     point (p50 record latency, ms); the throughput number rides along."""
@@ -834,7 +978,35 @@ def main() -> None:
                     help="measure through the production BlockPipeline "
                          "(ring + rank wire) instead of the hand loop — "
                          "the engine-vs-bench parity check")
+    ap.add_argument("--rollout-drill", action="store_true",
+                    help="run the rollout control-plane correctness "
+                         "drill (canary split ratio ±1%%, zero shadow "
+                         "sink leakage) instead of the perf capture")
+    ap.add_argument("--rollout-records", type=int, default=20_000,
+                    help="records per rollout-drill phase")
+    ap.add_argument("--rollout-fraction", type=float, default=0.2,
+                    help="canary traffic share the drill asserts")
     args = ap.parse_args()
+
+    if args.rollout_drill:
+        # correctness drill, not a perf capture: runs in-process (no
+        # probe/orchestration dance — a tiny GBM compiles anywhere)
+        if args.force_cpu:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            line = run_rollout_drill(
+                records=args.rollout_records,
+                fraction=args.rollout_fraction,
+            )
+        except AssertionError as e:
+            print(json.dumps({
+                "metric": "rollout_drill", "ok": False, "error": str(e),
+            }))
+            sys.exit(1)
+        print(json.dumps(line))
+        return
 
     if not args.in_child:
         _orchestrate(args)

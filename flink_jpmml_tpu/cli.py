@@ -16,6 +16,18 @@ object per input record —
 The hot path is the same compiled scorer the streaming runtime uses
 (`ModelReader.load()` → ``score_records`` in batches); this is a
 convenience frontend, not a second engine.
+
+``fjt-rollout``: drive staged rollouts from the shell by appending
+control frames (models/control.py wire form) to a JSONL control file a
+pipeline tails as its control stream (``JsonlFileSource(path,
+follow=True)`` → ``with_control_stream``; the dynamic scorer decodes
+wire dicts natively). The manual promote/rollback recipe — see
+docs/operations.md §Rollouts:
+
+    fjt-rollout ctrl.jsonl shadow   --name m --version 2 --path v2.pmml
+    fjt-rollout ctrl.jsonl canary   --name m --version 2 --fraction 0.1
+    fjt-rollout ctrl.jsonl full     --name m --version 2   # promote
+    fjt-rollout ctrl.jsonl rollback --name m --version 2   # abort
 """
 
 from __future__ import annotations
@@ -180,6 +192,82 @@ def score_main(argv: Optional[List[str]] = None) -> int:
         if fout is not sys.stdout:
             fout.close()
     print(f"scored {n} records", file=sys.stderr)
+    return 0
+
+
+def rollout_main(argv: Optional[List[str]] = None) -> int:
+    """``fjt-rollout``: append one staged-rollout control frame to a
+    JSONL control file (no jax import — safe on any host)."""
+    ap = argparse.ArgumentParser(
+        prog="fjt-rollout",
+        description="Stage, promote, or roll back a served-model rollout "
+                    "by appending a control frame to a JSONL control file.",
+    )
+    ap.add_argument("control_file",
+                    help="JSONL control file the pipeline tails "
+                         "(JsonlFileSource(follow=True) as its control "
+                         "stream)")
+    ap.add_argument("stage",
+                    choices=("shadow", "canary", "full", "rollback"),
+                    help="target stage: shadow/canary start or advance a "
+                         "rollout; full promotes; rollback aborts")
+    ap.add_argument("--name", required=True, help="served model name")
+    ap.add_argument("--version", type=int, required=True,
+                    help="candidate version")
+    ap.add_argument("--path", default=None,
+                    help="candidate PMML path/URI (registers it in the "
+                         "same message; required unless already served)")
+    ap.add_argument("--fraction", type=float, default=None,
+                    help="canary traffic share (default: the guardrail "
+                         "spec's canary_fraction)")
+    g = ap.add_argument_group("guardrails (any flag builds a spec; "
+                              "unset fields keep the defaults)")
+    g.add_argument("--max-disagree-rate", type=float, default=None)
+    g.add_argument("--max-latency-ratio", type=float, default=None)
+    g.add_argument("--max-error-rate", type=float, default=None)
+    g.add_argument("--min-samples", type=int, default=None)
+    g.add_argument("--promote-after-s", type=float, default=None)
+    g.add_argument("--window-s", type=float, default=None)
+    g.add_argument("--shadow-sample", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    import time
+
+    from flink_jpmml_tpu.models.control import RolloutMessage, to_wire
+    from flink_jpmml_tpu.rollout.state import GuardrailSpec
+
+    guard_kw = {
+        k: v for k, v in (
+            ("max_disagree_rate", args.max_disagree_rate),
+            ("max_latency_ratio", args.max_latency_ratio),
+            ("max_error_rate", args.max_error_rate),
+            ("min_samples", args.min_samples),
+            ("promote_after_s", args.promote_after_s),
+            ("window_s", args.window_s),
+            ("shadow_sample", args.shadow_sample),
+        ) if v is not None
+    }
+    try:
+        msg = RolloutMessage(
+            name=args.name, version=args.version, stage=args.stage,
+            timestamp=time.time(), path=args.path,
+            fraction=args.fraction,
+            guardrails=(
+                GuardrailSpec.from_dict(guard_kw) if guard_kw else None
+            ),
+        )
+    except ValueError as e:
+        raise SystemExit(f"invalid rollout message: {e}")
+    try:
+        with open(args.control_file, "a", encoding="utf-8") as f:
+            f.write(json.dumps(to_wire(msg)) + "\n")
+    except OSError as e:
+        raise SystemExit(f"cannot append to {args.control_file!r}: {e}")
+    print(
+        f"queued {args.stage} for {args.name}_{args.version} on "
+        f"{args.control_file}",
+        file=sys.stderr,
+    )
     return 0
 
 

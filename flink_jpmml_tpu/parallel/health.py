@@ -23,6 +23,19 @@ equivalent here:
   the supervisor's ``/metrics`` endpoint (obs/server.py) can expose the
   merged fleet view without a second wire protocol.
 
+The heartbeat link also carries the **control channel** (the rollout
+plane's fleet-convergence path, rollout/): the coordinator holds one
+current control document (:meth:`HealthCoordinator.set_control`, a
+monotonically sequenced dict), and a reporter constructed with
+``on_control`` advertises the sequence it has applied in every beat
+(``"ctl"``); the coordinator replies on the same socket with the
+document whenever the reporter is behind. Propagation latency is one
+beat interval; a worker that reconnects or restarts converges on its
+first beat. Backward compatible in both directions: a reporter without
+``on_control`` sends no ``"ctl"`` and gets no reply; a reporter talking
+to a pre-control coordinator times out once waiting for the first ack
+and stops expecting replies.
+
 Recovery itself stays the C7 model: the operator (or a supervisor
 script) restarts the dead worker, which resumes from the checkpointed
 source offsets and serving registry — nothing here tries to migrate
@@ -88,6 +101,13 @@ class HealthCoordinator:
         # known workers → declared dead? (transitions only on the
         # monitor thread; _beat just stamps _last_seen)
         self._declared_dead: Dict[str, bool] = {}
+        # current control documents by key: key -> (seq, dict). Keyed,
+        # not single-slot: concurrent rollouts of different model names
+        # are independent state machines — a worker that was down for
+        # "rollback A" then "promote B" must receive BOTH on its next
+        # beat, not just the newest (see set_control)
+        self._controls: Dict[str, tuple] = {}
+        self._control_seq = 0
         self._closing = False
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()[:2]
@@ -128,6 +148,21 @@ class HealthCoordinator:
             self._last_seen.pop(worker_id, None)
             self._declared_dead.pop(worker_id, None)
             self._snapshots.pop(worker_id, None)
+
+    def set_control(self, doc: dict, key: str = "") -> int:
+        """Publish ``doc`` as the current control document for ``key``;
+        → its seq.
+
+        Replaces the previous document OF THE SAME KEY only: within one
+        key the channel carries "the newest decision", not a log, but
+        different keys (e.g. per-model-name rollout decisions) are
+        independent — a reconnecting worker receives every key's current
+        document it hasn't applied yet, piggybacked on the reply to its
+        next beat. Retention is bounded by the number of live keys."""
+        with self._mu:
+            self._control_seq += 1
+            self._controls[key] = (self._control_seq, dict(doc))
+            return self._control_seq
 
     # -- internals ---------------------------------------------------------
 
@@ -172,6 +207,29 @@ class HealthCoordinator:
                     self._last_seen[wid] = time.monotonic()
                     if isinstance(snap, dict):
                         self._snapshots[wid] = snap
+                    ctls = list(self._controls.values())
+                if "ctl" in beat:
+                    # control-aware reporter: always ack (it blocks on
+                    # the reply), shipping every key's current document
+                    # the worker hasn't applied yet (seq-ordered, so a
+                    # worker down across several decisions converges on
+                    # all of them in one beat)
+                    try:
+                        have = int(beat["ctl"])
+                    except (TypeError, ValueError):
+                        have = 0
+                    top = max([s for s, _ in ctls], default=0)
+                    pending = sorted(
+                        (s, d) for s, d in ctls if s > have
+                    )
+                    reply = {"ctl_seq": top}
+                    if pending:
+                        reply["controls"] = [d for _, d in pending]
+                    payload = json.dumps(reply, default=repr).encode()
+                    try:
+                        conn.sendall(_U32.pack(len(payload)) + payload)
+                    except OSError:
+                        return
         finally:
             try:
                 conn.close()
@@ -254,20 +312,76 @@ class HealthReporter:
         interval_s: float = 0.5,
         reconnect_backoff_s: float = 0.2,
         snapshot_fn: Optional[Callable[[], dict]] = None,
+        on_control: Optional[Callable[[dict], None]] = None,
     ):
         """``snapshot_fn`` (optional) is called once per beat and its
         dict rides along as the beat's ``"metrics"`` field — pass a
         registry's ``struct_snapshot`` so the coordinator/supervisor
-        can serve this worker's metrics without a second protocol."""
+        can serve this worker's metrics without a second protocol.
+        ``on_control`` (optional) opts in to the control channel: each
+        beat advertises the last applied control seq and the hook
+        receives every newer control document the coordinator holds
+        (the rollout broadcast path). Exceptions it raises are
+        swallowed — liveness outranks control application."""
         self._addr = (host, port)
         self._id = worker_id
         self._interval = interval_s
         self._backoff = reconnect_backoff_s
         self._snapshot_fn = snapshot_fn
+        self._on_control = on_control
+        # False once a reply timed out: a pre-control coordinator never
+        # acks, and blocking a heartbeat on it every beat would turn the
+        # control channel into a liveness hazard
+        self._expect_replies = on_control is not None
+        self._ctl_seq = 0
         self._stop = threading.Event()
         self._seq = 0
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    @staticmethod
+    def _recv_raising(conn: socket.socket, n: int) -> bytes:
+        """Exact read that RAISES (timeout/OSError/closed peer): the
+        reporter needs to tell 'no reply coming' (socket.timeout) apart
+        from 'connection died' (everything else) — recv_exact folds
+        both into None."""
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return bytes(buf)
+
+    def _read_control_reply(self, conn: socket.socket) -> bool:
+        """Consume the coordinator's per-beat control ack; → False when
+        the connection must be torn down (reconnect path)."""
+        try:
+            (m,) = _U32.unpack(self._recv_raising(conn, 4))
+            if m > _MAX_FRAME:
+                raise ConnectionError(f"oversized control reply: {m}")
+            reply = json.loads(self._recv_raising(conn, m))
+        except socket.timeout:
+            # no ack within the socket timeout: a pre-control
+            # coordinator — stop expecting replies, keep beating
+            self._expect_replies = False
+            return True
+        except (OSError, ValueError):
+            return False
+        if isinstance(reply, dict) and self._on_control is not None:
+            docs = reply.get("controls")
+            if not isinstance(docs, list):  # older coordinator wire form
+                docs = [reply.get("control")]
+            for doc in docs:
+                if isinstance(doc, dict):
+                    try:
+                        self._on_control(doc)
+                    except Exception:
+                        pass  # a broken hook must not stop the heartbeat
+        seq = reply.get("ctl_seq") if isinstance(reply, dict) else None
+        if isinstance(seq, (int, float)):
+            self._ctl_seq = max(self._ctl_seq, int(seq))
+        return True
 
     def _run(self) -> None:
         conn: Optional[socket.socket] = None
@@ -290,11 +404,20 @@ class HealthReporter:
                     # a broken snapshot hook must not stop the
                     # heartbeat — liveness outranks metrics
                     pass
+            if self._expect_replies:
+                beat["ctl"] = self._ctl_seq
             payload = json.dumps(beat, default=repr).encode()
             self._seq += 1
             try:
                 conn.sendall(_U32.pack(len(payload)) + payload)
             except OSError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = None
+                continue
+            if self._expect_replies and not self._read_control_reply(conn):
                 try:
                     conn.close()
                 except OSError:

@@ -1,0 +1,63 @@
+"""Rollout control plane: staged deployment for dynamically served models.
+
+The reference system flips 100% of a name's traffic to a new version
+the moment an ``AddMessage``'s document warms. This package interposes
+a guarded lifecycle — shadow scoring, deterministic canary splits, and
+guardrail-driven auto-promotion/rollback — on top of the existing
+registry/control-stream machinery:
+
+- :mod:`~flink_jpmml_tpu.rollout.state` — stages, guardrail specs, and
+  the pure transition function shared by the registry and the fleet
+  book (checkpoint-shaped: a restore mid-canary resumes the stage).
+- :mod:`~flink_jpmml_tpu.rollout.split` — replay-stable per-key hash
+  assignment (canary side + shadow sampling).
+- :mod:`~flink_jpmml_tpu.rollout.controller` — the sliding-window
+  guardrail loop that turns the PR 3 obs structs into promote/rollback
+  decisions, locally or fleet-wide via the supervisor's heartbeat
+  control channel.
+
+Entry points: push a :class:`~flink_jpmml_tpu.models.control
+.RolloutMessage` on the control stream (the ``fjt-rollout`` CLI writes
+the wire form), and the :class:`~flink_jpmml_tpu.serving.scorer
+.DynamicScorer` does the rest. See docs/operations.md §Rollouts.
+"""
+
+from flink_jpmml_tpu.rollout.controller import (
+    RolloutBook,
+    RolloutController,
+    labelled,
+)
+from flink_jpmml_tpu.rollout.split import (
+    assign_candidate,
+    record_key,
+    sample_shadow,
+)
+from flink_jpmml_tpu.rollout.state import (
+    ACTIVE_STAGES,
+    STAGE_CANARY,
+    STAGE_FULL,
+    STAGE_ROLLBACK,
+    STAGE_SHADOW,
+    STAGES,
+    GuardrailSpec,
+    RolloutState,
+    apply_rollout,
+)
+
+__all__ = [
+    "ACTIVE_STAGES",
+    "GuardrailSpec",
+    "RolloutBook",
+    "RolloutController",
+    "RolloutState",
+    "STAGES",
+    "STAGE_CANARY",
+    "STAGE_FULL",
+    "STAGE_ROLLBACK",
+    "STAGE_SHADOW",
+    "apply_rollout",
+    "assign_candidate",
+    "labelled",
+    "record_key",
+    "sample_shadow",
+]

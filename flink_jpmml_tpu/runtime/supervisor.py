@@ -49,31 +49,63 @@ from typing import Callable, Dict, List, Optional, Sequence
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs.server import ObsServer
 from flink_jpmml_tpu.parallel.health import HealthCoordinator, HealthReporter
-from flink_jpmml_tpu.utils.metrics import merge_structs
+from flink_jpmml_tpu.rollout.controller import RolloutBook, RolloutController
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry, merge_structs
 
 _ADDR_ENV = "FJT_SUPERVISOR_ADDR"
 _ID_ENV = "FJT_WORKER_ID"
 
 
+def rollout_control_hook(registry) -> Callable[[dict], None]:
+    """→ an ``on_control`` hook applying broadcast rollout decisions to
+    ``registry`` (a ``ModelRegistry``; pass ``scorer.registry``). The
+    worker half of fleet-wide rollback convergence: the supervisor's
+    guardrail controller broadcasts one decision, every beating worker
+    applies it within a heartbeat interval."""
+    from flink_jpmml_tpu.models.control import from_wire
+    from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
+
+    def hook(doc: dict) -> None:
+        frame = doc.get("rollout")
+        if not isinstance(frame, dict):
+            return
+        try:
+            registry.apply(from_wire(frame))
+        except (ValueError, FlinkJpmmlTpuError) as e:
+            # a malformed/unapplicable broadcast must not take the
+            # heartbeat down; the flight ring says what was refused
+            flight.record("rollout_control_rejected", error=str(e))
+
+    return hook
+
+
 def reporter_from_env(
-    interval_s: float = 0.25, metrics=None
+    interval_s: float = 0.25, metrics=None, rollout_registry=None,
+    on_control=None,
 ) -> Optional[HealthReporter]:
     """Worker side: start beating to the supervising coordinator named
     by the environment (no-op → None when not under supervision).
     ``metrics`` (a ``MetricsRegistry``) makes every beat piggyback its
     ``struct_snapshot`` so the supervisor's ``/metrics`` endpoint can
     serve this worker's counters/histograms — the one-line opt-in to
-    fleet observability."""
+    fleet observability. ``rollout_registry`` (a ``ModelRegistry``,
+    e.g. ``scorer.registry``) additionally subscribes this worker to
+    the supervisor's rollout control broadcasts (fleet-wide
+    promote/rollback convergence); ``on_control`` is the raw-hook
+    override for custom control documents."""
     addr = os.environ.get(_ADDR_ENV)
     wid = os.environ.get(_ID_ENV)
     if not addr or not wid:
         return None
     host, port = addr.rsplit(":", 1)
+    if on_control is None and rollout_registry is not None:
+        on_control = rollout_control_hook(rollout_registry)
     return HealthReporter(
         host, int(port), wid, interval_s=interval_s,
         snapshot_fn=(
             metrics.struct_snapshot if metrics is not None else None
         ),
+        on_control=on_control,
     )
 
 
@@ -156,6 +188,10 @@ class Supervisor:
         self._on_give_up = on_give_up
         self._on_restart = on_restart
         self._poll_interval = poll_interval_s
+        # supervisor-local metrics (fleet rollout-controller decisions
+        # land here); merged into the unlabeled aggregate on /metrics
+        self.metrics = MetricsRegistry()
+        self._rollout_ctl: Optional[RolloutController] = None
         self._mu = threading.Lock()
         self._workers: Dict[str, _WorkerState] = {
             s.worker_id: _WorkerState(spec=s) for s in specs
@@ -233,6 +269,9 @@ class Supervisor:
                     pass
         if self._watcher.is_alive():
             self._watcher.join(timeout=5.0)
+        if self._rollout_ctl is not None:
+            self._rollout_ctl.close()
+            self._rollout_ctl = None
         if self._coord is not None:
             self._coord.close()
         if self._obs is not None:
@@ -269,8 +308,70 @@ class Supervisor:
     def fleet_metrics(self) -> dict:
         """The merged fleet view: counters/gauges add, histogram
         buckets add — quantiles over the merge are exact
-        (utils/metrics.merge_structs)."""
-        return merge_structs(self.metrics_snapshots().values())
+        (utils/metrics.merge_structs). Includes the supervisor's own
+        registry (fleet rollout decisions)."""
+        return merge_structs(
+            list(self.metrics_snapshots().values())
+            + [self.metrics.struct_snapshot()]
+        )
+
+    # -- fleet rollout control plane ---------------------------------------
+
+    def broadcast_control(self, doc: dict, key: str = "") -> int:
+        """Publish a control document to every beating worker over the
+        heartbeat reply channel (workers opt in via
+        ``reporter_from_env(..., rollout_registry=...)`` /
+        ``on_control=``); → the document's sequence number. Documents
+        replace per ``key`` only — independent decisions (different
+        rollout names) all reach a reconnecting worker."""
+        if self._coord is None:
+            raise RuntimeError(
+                "broadcast_control needs the heartbeat coordinator "
+                "(heartbeat_timeout_s must not be None)"
+            )
+        return self._coord.set_control(doc, key=key)
+
+    def broadcast_rollout(self, msg) -> int:
+        """Broadcast one rollout decision fleet-wide. Workers apply it
+        to their local registries on their next beat, so a guardrail
+        rollback converges across the fleet within a heartbeat
+        interval; a worker that restarts meanwhile converges on its
+        first beat (the coordinator re-serves each name's current
+        document — keyed per name, so concurrent rollouts' decisions
+        never shadow each other)."""
+        from flink_jpmml_tpu.models.control import to_wire
+
+        seq = self.broadcast_control(
+            {"rollout": to_wire(msg)}, key=f"rollout:{msg.name}"
+        )
+        flight.record(
+            "rollout_broadcast", seq=seq,
+            model=f"{msg.name}_{msg.version}", stage=msg.stage,
+        )
+        return seq
+
+    def rollout_controller(
+        self, interval_s: float = 0.5, start: bool = True
+    ) -> RolloutController:
+        """The fleet guardrail controller: evaluates the MERGED fleet
+        metrics (exact histogram merges — the DrJAX-style reduce over
+        per-worker measurements) and actuates via
+        :meth:`broadcast_rollout`, so one verdict moves every worker.
+        Feed it rollouts with ``controller._book.apply(msg)`` (or
+        :meth:`broadcast_rollout` plus a book apply) when initiating
+        from the supervisor side. Closed by :meth:`stop`."""
+        if self._rollout_ctl is not None:
+            return self._rollout_ctl
+        book = RolloutBook(self.broadcast_rollout)
+        self._rollout_ctl = RolloutController(
+            book=book,
+            struct_fn=self.fleet_metrics,
+            metrics=self.metrics,
+            interval_s=interval_s,
+        )
+        if start:
+            self._rollout_ctl.start()
+        return self._rollout_ctl
 
     def start_obs_server(
         self, host: str = "127.0.0.1", port: int = 0
@@ -288,7 +389,10 @@ class Supervisor:
         def collect():
             snaps = self.metrics_snapshots()
             sources: Dict[Optional[str], dict] = {
-                None: merge_structs(snaps.values())
+                None: merge_structs(
+                    list(snaps.values())
+                    + [self.metrics.struct_snapshot()]
+                )
             }
             sources.update(snaps)
             return sources

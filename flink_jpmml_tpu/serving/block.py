@@ -184,7 +184,14 @@ class DynamicBlockPipeline(BlockPipelineBase):
         # the newest warm-and-compiled served version of our name wins;
         # warmness is judged per *compiled instance*, so a re-Add with a
         # different document (new instance after its background warm) is
-        # adopted even though the (name, version) key looks unchanged
+        # adopted even though the (name, version) key looks unchanged.
+        # An active rollout's candidate is NOT adoptable: the block path
+        # serves whole dense batches to one model, so the candidate
+        # becomes visible here only at promotion to full (shadow/canary
+        # splitting is the record-path DynamicScorer's job) — a
+        # guardrail rollback therefore never had block traffic to undo.
+        ro = self.registry.rollout(self._name)
+        cand_version = ro.candidate_version if ro is not None else None
         best_mid = None
         best_model = None
         for mid in sorted(
@@ -192,7 +199,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
             key=lambda m: m.version,
             reverse=True,
         ):
-            if mid in self._rejected:
+            if mid in self._rejected or mid.version == cand_version:
                 continue
             model = self.registry.model_if_warm(mid)  # kicks warm if cold
             if model is None:

@@ -16,6 +16,21 @@ Event routing: by default an event is a ``(model_name, record)`` pair or a
 dict with a ``"_model"`` key (optionally ``"_version"``); pass ``route`` to
 override. This replaces the reference's keyed-stream association of events
 to models.
+
+Staged rollouts (:mod:`flink_jpmml_tpu.rollout`): while a name has an
+active rollout, unpinned events split deterministically per record key —
+the incumbent serves everything at the shadow stage and ``1 − p`` at the
+canary stage; the candidate serves its hash slice only once warm (a cold
+candidate's slice stays on the incumbent rather than stalling or going
+empty). Incumbent-served events are additionally *mirrored* (sampled,
+per the rollout's guardrail spec) to the candidate through the same
+overlapped dispatch window; mirrored results are diffed against the
+incumbent's (``rollout_shadow_*`` metrics) and NEVER emitted. A
+candidate dispatch/decode failure empties its lanes and counts
+``rollout_candidate_errors`` instead of killing the stream (C5 totality
+extends to candidates). The attached guardrail controller ticks from
+this batch loop, so promote/rollback actuation happens between
+micro-batches on the serving thread.
 """
 
 from __future__ import annotations
@@ -27,7 +42,17 @@ import numpy as np
 
 from flink_jpmml_tpu.api.reader import ModelReader
 from flink_jpmml_tpu.compile import prepare
+from flink_jpmml_tpu.models.control import RolloutMessage
+from flink_jpmml_tpu.models.core import ModelId
 from flink_jpmml_tpu.models.prediction import Prediction
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.rollout import split as rsplit
+from flink_jpmml_tpu.rollout.controller import RolloutController
+from flink_jpmml_tpu.rollout.state import (
+    ACTIVE_STAGES,
+    STAGE_CANARY,
+    GuardrailSpec,
+)
 from flink_jpmml_tpu.runtime.engine import Scorer
 from flink_jpmml_tpu.runtime.pipeline import (
     OverlappedDispatcher,
@@ -67,6 +92,10 @@ class DynamicScorer(Scorer):
         mesh=None,
         metrics: Optional[MetricsRegistry] = None,
         in_flight: Optional[int] = None,
+        key_fn: Optional[Callable[[Any], Any]] = None,
+        guardrails: Optional[GuardrailSpec] = None,
+        auto_rollout: bool = True,
+        rollout_interval_s: float = 0.5,
     ):
         """``async_warmup=False`` disables background warming: a newly
         Added model compiles synchronously inside ``submit`` on its first
@@ -83,7 +112,15 @@ class DynamicScorer(Scorer):
         requires ``submit`` to dispatch without blocking on device work
         — the engine's own submit/finish window is the backpressure.
         ``metrics`` shares a registry so stall time and in-flight depth
-        land next to the caller's counters."""
+        land next to the caller's counters.
+
+        Rollout knobs: ``key_fn`` derives the canary-split routing key
+        from an event payload (default: ``"_key"`` on dict records, else
+        content addressing — :func:`flink_jpmml_tpu.rollout.split
+        .record_key`); ``guardrails`` is the default spec stamped onto
+        ``RolloutMessage``s that carry none; ``auto_rollout=False``
+        disables the attached controller's batch-loop ticks (manual
+        promote/rollback via ``scorer.rollout_controller`` only)."""
         self.registry = ModelRegistry(
             batch_size=batch_size,
             compile_config=compile_config,
@@ -112,6 +149,18 @@ class DynamicScorer(Scorer):
         # models whose load/compile failed: don't re-attempt every batch;
         # cleared when the registry changes (a fixed version can be re-Added)
         self._failed: set = set()
+        self._key_fn = key_fn or rsplit.record_key
+        self._default_guardrails = guardrails
+        self._auto_rollout = auto_rollout
+        # the guardrail loop, ticked from the batch loop (between
+        # micro-batches, on the serving thread): promote/rollback
+        # decisions actuate on this registry with no extra thread
+        self.rollout_controller = RolloutController(
+            book=self.registry,
+            struct_fn=self.metrics.struct_snapshot,
+            metrics=self.metrics,
+            interval_s=rollout_interval_s,
+        )
 
     def _drain_control(self) -> None:
         while True:
@@ -119,20 +168,86 @@ class DynamicScorer(Scorer):
             if not msgs:
                 break
             for _, msg in msgs:
+                if isinstance(msg, dict):
+                    # JSONL control feeds (the fjt-rollout CLI, the
+                    # heartbeat broadcast) deliver wire dicts; a bad
+                    # frame is skipped loudly, never poisons the stream
+                    from flink_jpmml_tpu.models.control import from_wire
+
+                    try:
+                        msg = from_wire(msg)
+                    except ValueError as e:
+                        flight.record(
+                            "control_frame_rejected", error=str(e)
+                        )
+                        continue
+                if (
+                    isinstance(msg, RolloutMessage)
+                    and msg.guardrails is None
+                    and self._default_guardrails is not None
+                ):
+                    import dataclasses
+
+                    msg = dataclasses.replace(
+                        msg, guardrails=self._default_guardrails
+                    )
                 if self.registry.apply(msg):
                     self._failed.clear()
 
     def submit(self, records: Sequence[Any]):
         self._drain_control()
+        if self._auto_rollout:
+            self.rollout_controller.maybe_tick()
+        active = self.registry.rollouts()  # name -> RolloutState
         n = len(records)
-        groups: dict = {}  # model-key -> (CompiledModel, [indices], [payloads])
+        # model-key -> [scoring model, [indices], [payloads], rollinfo]
+        # where rollinfo is (rollout name, "candidate"|"incumbent") for
+        # groups of a name with an active rollout, else None
+        groups: dict = {}
+        # rollout name -> [candidate model, [indices], [payloads]]:
+        # mirrored copies of incumbent-served events for shadow diffing
+        mirrors: dict = {}
+        # per-batch candidate-model cache: model_if_warm takes the
+        # registry lock, and the answer cannot change within one batch
+        cand_models: dict = {}
         unserved: List[int] = []
         for i, event in enumerate(records):
             name, version, payload = self._route(event)
             model = None
+            ro = active.get(name) if name is not None else None
+            cand_model = None
+            rkey = None
+            if ro is not None:
+                # the candidate participates only once warm: its canary
+                # slice keeps scoring on the incumbent (and mirroring
+                # skips) until the background warm lands — never a stall,
+                # never an empty lane, exactly the double-buffer rule
+                if name in cand_models:
+                    cand_model = cand_models[name]
+                else:
+                    cand_model = cand_models[name] = (
+                        self.registry.model_if_warm(
+                            ModelId(name, ro.candidate_version)
+                        )
+                    )
+                # one canonicalization per event, shared by the canary
+                # assignment and the shadow sampling below
+                rkey = self._key_fn(payload)
             if name is None:
                 model = self._default_model
                 key = "__default__"
+            elif (
+                ro is not None
+                and cand_model is not None
+                and version is None
+                and ro.stage == STAGE_CANARY
+                and rsplit.assign_candidate(
+                    name, ro.candidate_version, ro.fraction, rkey,
+                )
+            ):
+                # deterministic per-key canary slice → the candidate
+                model = cand_model
+                key = ModelId(name, ro.candidate_version).key()
             else:
                 mid = self.registry.resolve(name, version)
                 key = mid.key() if mid else None
@@ -185,60 +300,119 @@ class DynamicScorer(Scorer):
             if model is None:
                 unserved.append(i)
                 continue
+            rollinfo = None
+            if ro is not None:
+                role = "candidate" if model is cand_model else "incumbent"
+                rollinfo = (name, role)
+                if (
+                    role == "incumbent"
+                    and cand_model is not None
+                    and ro.stage in ACTIVE_STAGES
+                    and rsplit.sample_shadow(
+                        name, ro.candidate_version,
+                        ro.spec.shadow_sample, rkey,
+                    )
+                ):
+                    # mirror a copy to the candidate, off the emitting
+                    # path: its output is diffed in finish(), never sunk
+                    m = mirrors.get(name)
+                    if m is None:
+                        mirrors[name] = [cand_model, [i], [payload]]
+                    else:
+                        m[1].append(i)
+                        m[2].append(payload)
             g = groups.get(key)
             if g is None:
-                groups[key] = (model, [i], [payload])
+                groups[key] = [model, [i], [payload], rollinfo]
             else:
                 g[1].append(i)
                 g[2].append(payload)
 
         tickets = []
-        for key, (model, idxs, payloads) in groups.items():
-            first = payloads[0]
-            if isinstance(first, dict):
-                X, M = prepare.from_records(model.field_space, payloads)
-            else:
-                X, M = prepare.from_dense(
-                    model.field_space,
-                    np.asarray(payloads, np.float32),
-                    self._replace_nan,
-                )
-            # rank-wire fast path per served model (qtrees.py; cached on
-            # the CompiledModel, so the probe is free after the first
-            # batch). Each group's device call launches through the
-            # shared overlapped window: dispatch stays async, D2H copies
-            # are prefetched, and the window depth bounds how far device
-            # work can run ahead of the finish() fetches. The featurize
-            # itself goes through the SAME staged path as the block
-            # pipelines (dispatch_quantized: host bucketize or the fused
-            # on-device encode per the scorer's autotuned encode_mode),
-            # with encode_s/h2d_bytes accounted into this scorer's
-            # metrics registry.
-            q = model.quantized_scorer()
-            if q is not None:
-                handle = self._dispatcher.launch(
-                    lambda q=q, X=X, M=M: dispatch_quantized(
-                        q, X, M, metrics=self.metrics
-                    )
-                )
-                tickets.append((q, idxs, handle))
-                continue
-            if model.batch_size is not None:
-                X, M, _ = prepare.pad_batch(X, M, model.batch_size)
-            handle = self._dispatcher.launch(
-                lambda m=model, X=X, M=M: m.predict(X, M)
+        for key, (model, idxs, payloads, rollinfo) in groups.items():
+            handle, scorer = self._launch_group(model, payloads)
+            tickets.append((scorer, idxs, handle, rollinfo))
+        shadows = []
+        for name, (model, idxs, payloads) in mirrors.items():
+            handle, scorer = self._launch_group(model, payloads)
+            shadows.append((scorer, idxs, handle, name))
+        return (n, records, tickets, shadows, unserved, time.monotonic())
+
+    def _launch_group(self, model, payloads):
+        """Featurize + async-dispatch one per-model group through the
+        shared overlapped window → (in-flight handle, the object whose
+        ``decode`` matches the dispatch)."""
+        first = payloads[0]
+        if isinstance(first, dict):
+            X, M = prepare.from_records(model.field_space, payloads)
+        else:
+            X, M = prepare.from_dense(
+                model.field_space,
+                np.asarray(payloads, np.float32),
+                self._replace_nan,
             )
-            tickets.append((model, idxs, handle))
-        return (n, records, tickets, unserved, time.monotonic())
+        # rank-wire fast path per served model (qtrees.py; cached on
+        # the CompiledModel, so the probe is free after the first
+        # batch). Each group's device call launches through the
+        # shared overlapped window: dispatch stays async, D2H copies
+        # are prefetched, and the window depth bounds how far device
+        # work can run ahead of the finish() fetches. The featurize
+        # itself goes through the SAME staged path as the block
+        # pipelines (dispatch_quantized: host bucketize or the fused
+        # on-device encode per the scorer's autotuned encode_mode),
+        # with encode_s/h2d_bytes accounted into this scorer's
+        # metrics registry.
+        q = model.quantized_scorer()
+        if q is not None:
+            handle = self._dispatcher.launch(
+                lambda q=q, X=X, M=M: dispatch_quantized(
+                    q, X, M, metrics=self.metrics
+                )
+            )
+            return handle, q
+        if model.batch_size is not None:
+            X, M, _ = prepare.pad_batch(X, M, model.batch_size)
+        handle = self._dispatcher.launch(
+            lambda m=model, X=X, M=M: m.predict(X, M)
+        )
+        return handle, model
 
     def finish(self, ticket) -> List[Any]:
-        n, records, tickets, unserved, t_submit = ticket
+        n, records, tickets, shadows, unserved, t_submit = ticket
         preds: List[Optional[Prediction]] = [None] * n
-        for model, idxs, handle in tickets:
-            out = self._dispatcher.wait(handle)
-            decoded = model.decode(out, len(idxs))
+        for model, idxs, handle, rollinfo in tickets:
+            role = rollinfo[1] if rollinfo is not None else None
+            failed = False
+            try:
+                out = self._dispatcher.wait(handle)
+                decoded = model.decode(out, len(idxs))
+            except Exception as e:
+                if role != "candidate":
+                    raise
+                # a poisoned candidate must not kill the stream: its
+                # lanes go empty (C5) and the failure lands where the
+                # guardrail controller reads it — the rollback signal
+                failed = True
+                name = rollinfo[0]
+                self.metrics.counter(
+                    f'rollout_candidate_errors{{model="{name}"}}'
+                ).inc(len(idxs))
+                flight.record(
+                    "rollout_candidate_error", model=name, error=repr(e)
+                )
+                decoded = [Prediction.empty()] * len(idxs)
+            if rollinfo is not None and not failed:
+                # failed groups count ONLY as errors: adding them to the
+                # served-records counter would halve the controller's
+                # error rate (errors/(records+errors) double-counts the
+                # failures), and their fail-fast timings would skew the
+                # latency histogram
+                self._observe_rollout_group(
+                    rollinfo[0], role, len(idxs), handle
+                )
             for i, p in zip(idxs, decoded):
                 preds[i] = p
+        self._diff_shadows(shadows, preds)
         for i in unserved:
             preds[i] = Prediction.empty()
         if tickets:  # an all-unserved batch scored nothing: no sample
@@ -248,6 +422,98 @@ class DynamicScorer(Scorer):
         if self._emit_pairs:
             return [(p, r) for p, r in zip(preds, records)]
         return list(preds)
+
+    # -- rollout accounting / shadow diffing -------------------------------
+
+    def _observe_rollout_group(
+        self, name: str, role: str, n_records: int, handle
+    ) -> None:
+        """Per-role traffic + latency accounting for a rolled-out name:
+        the signals the guardrail controller windows over. Latency is
+        launch→fetch-complete through the shared FIFO window — both
+        roles ride the same window in the same batches, so the
+        comparison is like-for-like even though neither is a pure
+        device time."""
+        lat = time.monotonic() - handle.t_launch
+        if role == "candidate":
+            self.metrics.counter(
+                f'rollout_candidate_records{{model="{name}"}}'
+            ).inc(n_records)
+            self.metrics.histogram(
+                f'rollout_candidate_latency_s{{model="{name}"}}'
+            ).observe(lat)
+        else:
+            self.metrics.counter(
+                f'rollout_incumbent_records{{model="{name}"}}'
+            ).inc(n_records)
+            self.metrics.histogram(
+                f'rollout_incumbent_latency_s{{model="{name}"}}'
+            ).observe(lat)
+
+    def _diff_shadows(self, shadows, preds) -> None:
+        """Fetch + decode the mirrored candidate dispatches and diff
+        them against the incumbent's emitted predictions: disagreement
+        rate and numeric drift are the shadow stage's health signals.
+        Shadow outputs never reach ``preds`` — zero sink leakage."""
+        for model, idxs, handle, name in shadows:
+            try:
+                out = self._dispatcher.wait(handle)
+                decoded = model.decode(out, len(idxs))
+            except Exception as e:
+                self.metrics.counter(
+                    f'rollout_candidate_errors{{model="{name}"}}'
+                ).inc(len(idxs))
+                flight.record(
+                    "rollout_candidate_error", model=name, error=repr(e),
+                    shadow=True,
+                )
+                continue
+            # mirrored dispatches are real candidate work: they feed the
+            # candidate latency histogram (the shadow stage's only
+            # latency signal) exactly like canary-served groups
+            self.metrics.histogram(
+                f'rollout_candidate_latency_s{{model="{name}"}}'
+            ).observe(time.monotonic() - handle.t_launch)
+            disagreements = 0
+            drift = self.metrics.histogram(
+                f'rollout_shadow_drift{{model="{name}"}}'
+            )
+            for i, cp in zip(idxs, decoded):
+                ip = preds[i]
+                if ip is None:
+                    continue
+                if self._disagrees(ip, cp, drift):
+                    disagreements += 1
+            self.metrics.counter(
+                f'rollout_shadow_compared{{model="{name}"}}'
+            ).inc(len(idxs))
+            if disagreements:
+                self.metrics.counter(
+                    f'rollout_shadow_disagree{{model="{name}"}}'
+                ).inc(disagreements)
+
+    @staticmethod
+    def _disagrees(ip: Prediction, cp: Prediction, drift) -> bool:
+        """One mirrored pair's verdict: emptiness or label mismatch is a
+        disagreement outright; numeric values disagree past f32 noise.
+        Every numeric diff (target value + shared numeric output fields)
+        lands in the drift histogram either way — drift below the
+        disagreement threshold is still the early-warning signal."""
+        if ip.is_empty or cp.is_empty:
+            return ip.is_empty != cp.is_empty
+        il = ip.target.label if ip.target is not None else None
+        cl = cp.target.label if cp.target is not None else None
+        iv, cv = ip.score.value, cp.score.value
+        d = abs(cv - iv)
+        drift.observe(d)
+        if ip.outputs and cp.outputs:
+            for k, v in ip.outputs.items():
+                w = cp.outputs.get(k)
+                if isinstance(v, (int, float)) and isinstance(w, (int, float)):
+                    drift.observe(abs(float(w) - float(v)))
+        if il != cl:
+            return True
+        return d > 1e-6 * max(1.0, abs(iv))
 
     # -- checkpointed operator state (C6/C7) ------------------------------
 

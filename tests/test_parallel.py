@@ -48,7 +48,10 @@ class TestDpSharded:
         ref = np.asarray(cm.predict(X, M).value)
         out = sm.predict(X, M)
         got = np.asarray(out.value)
-        np.testing.assert_allclose(got, ref, rtol=1e-6)
+        # GSPMD partitioning may re-associate the tree-sum reduction, so
+        # parity holds at f32 noise tolerance (same bound the rest of the
+        # sharded suite uses), not bit-exactly
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
         # output really is sharded over the data axis
         assert len(out.value.sharding.device_set) == 8
 

@@ -31,3 +31,4 @@ def test_perf_smoke_passes():
     assert "fused encode parity OK" in proc.stdout
     assert "autotune cache roundtrip OK" in proc.stdout
     assert "obs /metrics scrape OK" in proc.stdout
+    assert "rollout drill OK" in proc.stdout

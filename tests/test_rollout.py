@@ -1,0 +1,637 @@
+"""Rollout control plane (flink_jpmml_tpu/rollout/): staged traffic
+splits, shadow scoring, guardrail-driven auto-promotion/rollback, and
+checkpoint durability.
+
+The pinned end-to-end drills:
+- a candidate with injected +latency (and, separately, disagreement) is
+  auto-rolled-back under canary — the incumbent keeps serving and the
+  flight recorder holds the decision event;
+- a healthy candidate auto-promotes shadow → canary → full with a
+  per-key-stable split at each stage;
+- a checkpoint restore mid-canary resumes the same stage and the
+  identical split;
+- a registry restore while a background warm is mid-compile neither
+  double-compiles nor serves a cold model.
+"""
+
+import pathlib
+import time
+
+import pytest
+
+from flink_jpmml_tpu.models.control import (
+    AddMessage,
+    RolloutMessage,
+    from_wire,
+    to_wire,
+)
+from flink_jpmml_tpu.models.core import ModelId
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.rollout import split as rsplit
+from flink_jpmml_tpu.rollout.controller import _hist_window
+from flink_jpmml_tpu.rollout.state import (
+    GuardrailSpec,
+    RolloutState,
+    apply_rollout,
+)
+from flink_jpmml_tpu.runtime.sources import ControlSource
+from flink_jpmml_tpu.serving.registry import ModelRegistry
+from flink_jpmml_tpu.serving.scorer import DynamicScorer
+from flink_jpmml_tpu.utils.metrics import Histogram
+
+_CONST_XML = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <DataDictionary numberOfFields="2">
+    <DataField name="a" optype="continuous" dataType="double"/>
+    <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <RegressionModel functionName="regression">
+    <MiningSchema>
+      <MiningField name="y" usageType="target"/>
+      <MiningField name="a"/>
+    </MiningSchema>
+    <RegressionTable intercept="{c}"/>
+  </RegressionModel></PMML>"""
+
+
+def _write_const(tmp_path, name, c):
+    p = pathlib.Path(tmp_path, name)
+    p.write_text(_CONST_XML.format(c=c))
+    return str(p)
+
+
+def _wait_warm(reg, mid, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if reg.model_if_warm(mid) is not None:
+            return
+        err = reg.warm_error(mid)
+        assert err is None, f"warm of {mid} failed: {err!r}"
+        time.sleep(0.01)
+    raise AssertionError(f"{mid} never warmed")
+
+
+def _values(results):
+    return [p.score.value if not p.is_empty else None for p, _ in results]
+
+
+def _events(name, n, start=0):
+    return [
+        (name, {"a": 0.0, "_key": f"k{start + i}"}) for i in range(n)
+    ]
+
+
+def _run(sc, events, batch=64):
+    out = []
+    for off in range(0, len(events), batch):
+        out += sc.finish(sc.submit(events[off : off + batch]))
+    return out
+
+
+class _SlowOut:
+    """A dispatch result whose readiness wait carries injected latency
+    (the dispatcher blocks on leaves' ``block_until_ready``, so the
+    delay lands exactly where a slow device would put it)."""
+
+    def __init__(self, out, delay):
+        self.out = out
+        self._delay = delay
+
+    def block_until_ready(self):
+        time.sleep(self._delay)
+
+
+class _SlowModel:
+    """CompiledModel wrapper adding +delay to every dispatch — the
+    "miscompiled, slow" candidate of the rollback drill."""
+
+    def __init__(self, inner, delay):
+        self._inner = inner
+        self._delay = delay
+
+    def quantized_scorer(self):
+        return None
+
+    @property
+    def field_space(self):
+        return self._inner.field_space
+
+    @property
+    def batch_size(self):
+        return self._inner.batch_size
+
+    def warmup(self):
+        return self._inner.warmup()
+
+    def predict(self, X, M):
+        return _SlowOut(self._inner.predict(X, M), self._delay)
+
+    def decode(self, out, n):
+        return self._inner.decode(out.out, n)
+
+
+def _inject_slow(reg, substr, delay_s):
+    """Models whose path contains ``substr`` gain +delay per dispatch."""
+    orig = reg._load
+
+    def load(info):
+        cm = orig(info)
+        if substr in info.path:
+            return _SlowModel(cm, delay_s)
+        return cm
+
+    reg._load = load
+
+
+class TestSplit:
+    def test_per_key_stable_and_monotone(self):
+        keys = [f"u{i}" for i in range(4000)]
+        a10 = [rsplit.assign_candidate("m", 2, 0.1, k) for k in keys]
+        assert a10 == [rsplit.assign_candidate("m", 2, 0.1, k) for k in keys]
+        share = sum(a10) / len(a10)
+        assert abs(share - 0.1) < 0.02
+        # growing the canary never reassigns a candidate key back
+        a30 = [rsplit.assign_candidate("m", 2, 0.3, k) for k in keys]
+        assert all(b or not a for a, b in zip(a10, a30))
+        # a new candidate version canaries a different key population
+        b10 = [rsplit.assign_candidate("m", 3, 0.1, k) for k in keys]
+        assert a10 != b10
+
+    def test_content_addressed_keys(self):
+        rec = {"a": 1.5, "b": "x"}
+        assert rsplit.record_key(dict(rec)) == rsplit.record_key(
+            {"b": "x", "a": 1.5}
+        )
+        assert rsplit.record_key({"_key": "s1", "a": 1.0}) == "s1"
+
+
+class TestTransitions:
+    def test_stage_change_resets_dwell_knob_turn_keeps_it(self):
+        m1 = RolloutMessage("m", 2, "shadow", 10.0)
+        states, ch = apply_rollout({}, m1)
+        assert ch and states["m"].stage_since == 10.0
+        # knob turn: same stage, new fraction — dwell preserved
+        m2 = RolloutMessage("m", 2, "shadow", 50.0, fraction=0.5)
+        states, ch = apply_rollout(states, m2)
+        assert ch and states["m"].stage_since == 10.0
+        # stage change: dwell resets
+        m3 = RolloutMessage("m", 2, "canary", 99.0)
+        states, ch = apply_rollout(states, m3)
+        assert ch and states["m"].stage_since == 99.0
+
+    def test_stale_terminal_is_noop(self):
+        states, _ = apply_rollout({}, RolloutMessage("m", 3, "canary", 1.0))
+        # a replayed decision about version 2 must not cancel v3's rollout
+        states2, ch = apply_rollout(
+            states, RolloutMessage("m", 2, "rollback", 2.0)
+        )
+        assert not ch and states2 == states
+
+    def test_wire_roundtrip(self):
+        msg = RolloutMessage(
+            "m", 2, "canary", 1.5, path="/p.pmml", fraction=0.25,
+            guardrails=GuardrailSpec(max_disagree_rate=0.1),
+        )
+        back = from_wire(to_wire(msg))
+        assert back == msg
+        with pytest.raises(ValueError):
+            from_wire({"kind": "nope"})
+
+    def test_bad_stage_and_fraction_rejected(self):
+        with pytest.raises(ValueError):
+            RolloutMessage("m", 2, "yolo", 1.0)
+        with pytest.raises(ValueError):
+            RolloutMessage("m", 2, "canary", 1.0, fraction=1.5)
+        with pytest.raises(ValueError):
+            RolloutState("m", 2, "full", 1.0)  # terminal is not storable
+
+
+class TestHistWindow:
+    def test_delta_and_reset_fallback(self):
+        h = Histogram()
+        for v in (0.001,) * 50:
+            h.observe(v)
+        old = h.state()
+        for v in (0.1,) * 50:
+            h.observe(v)
+        win = _hist_window({"histograms": {"x": h.state()}},
+                           {"histograms": {"x": old}}, "x")
+        assert win.count() == 50
+        assert win.quantile(0.5) >= 0.05  # only the new observations
+        # a counter going backwards (worker restart) falls back whole
+        win2 = _hist_window({"histograms": {"x": old}},
+                            {"histograms": {"x": h.state()}}, "x")
+        assert win2.count() == 50  # cumulative fallback, not negative
+
+
+class TestCanaryServing:
+    def test_split_serves_fraction_and_replays_identically(self, tmp_path):
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        v2 = _write_const(tmp_path, "v2.pmml", 2.0)
+        ctrl = ControlSource()
+        sc = DynamicScorer(control=ctrl, batch_size=64, auto_rollout=False)
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        _run(sc, _events("m", 1))
+        ctrl.push(RolloutMessage(
+            "m", 2, "canary", time.time(), path=v2, fraction=0.25,
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 2))
+
+        events = _events("m", 1024)
+        vals = _values(_run(sc, events))
+        share = sum(1 for v in vals if v == 2.0) / len(vals)
+        assert abs(share - 0.25) < 0.05
+        # per-key-stable: the replay routes every record identically
+        assert _values(_run(sc, events)) == vals
+        # assignment matches the pure split function exactly
+        for (name, rec), v in zip(events, vals):
+            expected = 2.0 if rsplit.assign_candidate(
+                "m", 2, 0.25, rec["_key"]
+            ) else 1.0
+            assert v == expected
+
+    def test_shadow_stage_serves_incumbent_only_no_sink_leakage(
+        self, tmp_path
+    ):
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        v2 = _write_const(tmp_path, "v2.pmml", 2.0)
+        ctrl = ControlSource()
+        sc = DynamicScorer(control=ctrl, batch_size=64, auto_rollout=False)
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        _run(sc, _events("m", 1))
+        ctrl.push(RolloutMessage("m", 2, "shadow", time.time(), path=v2))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 2))
+
+        events = _events("m", 512)
+        out = _run(sc, events)
+        assert len(out) == len(events)  # exactly one emission per record
+        assert set(_values(out)) == {1.0}  # incumbent serves everything
+        snap = sc.metrics.struct_snapshot()["counters"]
+        assert snap.get('rollout_candidate_records{model="m"}', 0) == 0
+        assert snap['rollout_shadow_compared{model="m"}'] == 512
+        assert snap['rollout_shadow_disagree{model="m"}'] == 512
+
+    def test_cold_candidate_slice_stays_on_incumbent(self, tmp_path):
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        v2 = _write_const(tmp_path, "v2.pmml", 2.0)
+        ctrl = ControlSource()
+        sc = DynamicScorer(control=ctrl, batch_size=64, auto_rollout=False)
+        orig_load = sc.registry._load
+
+        def stall_v2(info):
+            if "v2" in info.path:
+                time.sleep(1.5)
+            return orig_load(info)
+
+        sc.registry._load = stall_v2
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        _run(sc, _events("m", 1))
+        ctrl.push(RolloutMessage(
+            "m", 2, "canary", time.time(), path=v2, fraction=0.5,
+        ))
+        sc._drain_control()
+        t0 = time.monotonic()
+        out = _run(sc, _events("m", 128))
+        dt = time.monotonic() - t0
+        # candidate still compiling: its slice scores on the incumbent,
+        # nothing stalls, nothing goes empty
+        assert set(_values(out)) == {1.0}
+        assert dt < 1.0, f"canary batch stalled {dt:.2f}s on a cold candidate"
+
+
+class TestGuardrails:
+    def _scorer_with_rollout(self, tmp_path, spec, slow_candidate=False,
+                             candidate_const=2.0):
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        v2 = _write_const(tmp_path, "v2slow.pmml" if slow_candidate
+                          else "v2.pmml", candidate_const)
+        ctrl = ControlSource()
+        sc = DynamicScorer(control=ctrl, batch_size=64, auto_rollout=False)
+        if slow_candidate:
+            _inject_slow(sc.registry, "v2slow", 0.05)
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        _run(sc, _events("m", 1))
+        return sc, ctrl, v2
+
+    def test_disagreeing_candidate_rolled_back_under_canary(self, tmp_path):
+        spec = GuardrailSpec(
+            min_samples=50, window_s=30.0, promote_after_s=3600.0,
+            max_disagree_rate=0.02,
+        )
+        sc, ctrl, v2 = self._scorer_with_rollout(tmp_path, spec)
+        ctrl.push(RolloutMessage(
+            "m", 2, "canary", time.time(), path=v2, fraction=0.25,
+            guardrails=spec,
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 2))
+        _run(sc, _events("m", 512))
+        decisions = sc.rollout_controller.tick()
+        assert len(decisions) == 1 and decisions[0]["action"] == "rollback"
+        assert "disagreement" in decisions[0]["reason"]
+        # incumbent keeps serving; the candidate is gone from the registry
+        assert sc.registry.rollout("m") is None
+        assert sc.registry.resolve("m") == ModelId("m", 1)
+        assert sc.registry.resolve("m", 2) is None
+        out = _run(sc, _events("m", 64))
+        assert set(_values(out)) == {1.0}
+        # the flight recorder holds the decision event with its reason
+        evs = [e for e in flight.events() if e["kind"] == "rollout_rollback"
+               and e.get("name") == "m"]
+        assert evs and "disagreement" in evs[-1]["reason"]
+        snap = sc.rollout_controller.metrics.struct_snapshot()["counters"]
+        assert snap['rollout_rollbacks{model="m"}'] == 1
+
+    def test_slow_candidate_rolled_back_on_latency(self, tmp_path):
+        # byte-identical semantics (no disagreement), +50ms per dispatch:
+        # only the latency guardrail can catch it
+        spec = GuardrailSpec(
+            min_samples=8, window_s=60.0, promote_after_s=3600.0,
+            max_latency_ratio=2.0, max_disagree_rate=1.0,
+        )
+        sc, ctrl, v2 = self._scorer_with_rollout(
+            tmp_path, spec, slow_candidate=True, candidate_const=1.0,
+        )
+        ctrl.push(RolloutMessage(
+            "m", 2, "canary", time.time(), path=v2, fraction=0.25,
+            guardrails=spec,
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 2))
+        # put an incumbent-assigned key FIRST in every batch so the
+        # incumbent group launches (and FIFO-completes) ahead of the
+        # slow candidate: its latency baseline stays unpolluted by the
+        # candidate's injected sleep
+        events = _events("m", 10 * 64)
+        for off in range(0, len(events), 64):
+            chunk = events[off : off + 64]
+            for j, (_nm, rec) in enumerate(chunk):
+                if not rsplit.assign_candidate("m", 2, 0.25, rec["_key"]):
+                    chunk[0], chunk[j] = chunk[j], chunk[0]
+                    break
+            events[off : off + 64] = chunk
+        _run(sc, events)
+        decisions = sc.rollout_controller.tick()
+        assert len(decisions) == 1 and decisions[0]["action"] == "rollback"
+        assert "p99" in decisions[0]["reason"]
+        assert sc.registry.rollout("m") is None
+        assert sc.registry.resolve("m") == ModelId("m", 1)
+
+    def test_healthy_candidate_promotes_shadow_to_canary_to_full(
+        self, tmp_path
+    ):
+        spec = GuardrailSpec(
+            min_samples=50, window_s=60.0, promote_after_s=0.0,
+            canary_fraction=0.25,
+            # identical-speed twins on a noisy CPU host: the latency
+            # guardrail is not under test here, keep it out of the way
+            max_latency_ratio=1000.0,
+        )
+        # candidate scores identically: zero disagreement, same speed
+        sc, ctrl, v2 = self._scorer_with_rollout(
+            tmp_path, spec, candidate_const=1.0,
+        )
+        ctrl.push(RolloutMessage(
+            "m", 2, "shadow", time.time(), path=v2, guardrails=spec,
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 2))
+
+        events = _events("m", 512)
+        _run(sc, events)
+        d1 = sc.rollout_controller.tick()
+        assert [d["stage"] for d in d1] == ["canary"]
+        st = sc.registry.rollout("m")
+        assert st.stage == "canary" and st.fraction == 0.25
+
+        # canary stage: the split is live and per-key stable
+        vals = _values(_run(sc, events))
+        assert _values(_run(sc, events)) == vals
+        snap = sc.metrics.struct_snapshot()["counters"]
+        assert snap['rollout_candidate_records{model="m"}'] > 0
+        d2 = sc.rollout_controller.tick()
+        assert [d["stage"] for d in d2] == ["full"]
+        assert sc.registry.rollout("m") is None
+        # promoted: latest-wins routing now serves the candidate
+        assert sc.registry.resolve("m") == ModelId("m", 2)
+        snap = sc.rollout_controller.metrics.struct_snapshot()["counters"]
+        assert snap['rollout_promotions{model="m"}'] == 2
+
+
+class TestReviewRegressions:
+    def test_superseding_rollout_drops_the_abandoned_candidate(self):
+        """Starting a rollout of v3 while v2 is mid-canary must not hand
+        the never-promoted v2 latest-wins traffic: it is dropped like a
+        rollback, not left as the newest served version."""
+        reg = ModelRegistry(async_warmup=False)
+        reg.apply(AddMessage("m", 1, "/tmp/v1.pmml", 1.0))
+        reg.apply(RolloutMessage(
+            "m", 2, "canary", 2.0, path="/tmp/v2.pmml", fraction=0.2,
+        ))
+        reg.apply(RolloutMessage("m", 3, "shadow", 3.0, path="/tmp/v3.pmml"))
+        st = reg.rollout("m")
+        assert st is not None and st.candidate_version == 3
+        assert reg.resolve("m", 2) is None, "abandoned candidate still served"
+        assert reg.resolve("m") == ModelId("m", 1)
+        # a late rollback frame for the superseded v2 is a harmless no-op
+        assert not reg.apply(RolloutMessage("m", 2, "rollback", 4.0))
+        assert reg.rollout("m").candidate_version == 3
+
+    def test_failed_candidate_counts_errors_not_records(self, tmp_path):
+        """A failing candidate group must land ONLY in the error counter:
+        counting its lanes as served records would halve the controller's
+        error rate and pollute the latency baseline."""
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        v2 = _write_const(tmp_path, "v2.pmml", 2.0)
+        ctrl = ControlSource()
+        sc = DynamicScorer(control=ctrl, batch_size=64, auto_rollout=False)
+
+        class _Poisoned(_SlowModel):
+            def predict(self, X, M):
+                out = self._inner.predict(X, M)
+
+                class _Boom:
+                    def block_until_ready(self):
+                        raise RuntimeError("injected candidate poison")
+
+                return _Boom()
+
+        orig = sc.registry._load
+        sc.registry._load = lambda info: (
+            _Poisoned(orig(info), 0.0) if "v2" in info.path else orig(info)
+        )
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        _run(sc, _events("m", 1))
+        ctrl.push(RolloutMessage(
+            "m", 2, "canary", time.time(), path=v2, fraction=0.5,
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 2))
+        out = _run(sc, _events("m", 128))
+        # stream lives; candidate lanes are empty, incumbent lanes score
+        vals = _values(out)
+        assert None in vals and 1.0 in vals and 2.0 not in vals
+        snap = sc.metrics.struct_snapshot()
+        counters = snap["counters"]
+        assert counters['rollout_candidate_errors{model="m"}'] > 0
+        assert counters.get('rollout_candidate_records{model="m"}', 0) == 0
+        hists = snap["histograms"]
+        assert 'rollout_candidate_latency_s{model="m"}' not in hists or (
+            hists['rollout_candidate_latency_s{model="m"}']["n"] == 0
+        )
+
+    def test_keyed_control_delivers_every_names_decision(self):
+        """Two concurrent rollouts: a worker that missed BOTH decisions
+        must receive both on one beat — a single-slot control document
+        would silently drop the earlier rollback."""
+        from flink_jpmml_tpu.parallel.health import (
+            HealthCoordinator, HealthReporter,
+        )
+
+        applied = []
+        coord = HealthCoordinator(timeout_s=5.0)
+        # both decisions published BEFORE the worker first connects
+        coord.set_control({"rollout": to_wire(
+            RolloutMessage("a", 2, "rollback", 1.0)
+        )}, key="rollout:a")
+        coord.set_control({"rollout": to_wire(
+            RolloutMessage("b", 5, "full", 2.0)
+        )}, key="rollout:b")
+        rep = HealthReporter(
+            coord.host, coord.port, "w0", interval_s=0.05,
+            on_control=applied.append,
+        )
+        try:
+            deadline = time.monotonic() + 10.0
+            while len(applied) < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            names = sorted(d["rollout"]["name"] for d in applied)
+            assert names == ["a", "b"], applied
+        finally:
+            rep.stop()
+            coord.close()
+
+
+class TestCheckpointDurability:
+    def test_restore_mid_canary_resumes_stage_and_split(self, tmp_path):
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        v2 = _write_const(tmp_path, "v2.pmml", 2.0)
+        ctrl = ControlSource()
+        sc = DynamicScorer(control=ctrl, batch_size=64, auto_rollout=False)
+        ctrl.push(AddMessage("m", 1, v1, timestamp=1.0))
+        _run(sc, _events("m", 1))
+        spec = GuardrailSpec(promote_after_s=123.0)
+        ctrl.push(RolloutMessage(
+            "m", 2, "canary", 777.0, path=v2, fraction=0.25,
+            guardrails=spec,
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 2))
+        events = _events("m", 512)
+        vals = _values(_run(sc, events))
+
+        state = sc.state()  # what the pipeline checkpoints
+
+        sc2 = DynamicScorer(
+            control=ControlSource(), batch_size=64, auto_rollout=False
+        )
+        sc2.restore(state)
+        st = sc2.registry.rollout("m")
+        # same stage, fraction, spec, and dwell clock — NOT a re-flip
+        assert st is not None and st.stage == "canary"
+        assert st.fraction == 0.25
+        assert st.stage_since == 777.0
+        assert st.spec.promote_after_s == 123.0
+        _wait_warm(sc2.registry, ModelId("m", 1))
+        _wait_warm(sc2.registry, ModelId("m", 2))
+        # the identical split: every key routes as it did pre-restore
+        assert _values(_run(sc2, events)) == vals
+
+    def test_restore_while_warm_in_flight_no_double_compile(self, tmp_path):
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        reg = ModelRegistry(batch_size=8)
+        loads = {}
+        orig = reg._load
+
+        def slow_load(info):
+            loads[info.path] = loads.get(info.path, 0) + 1
+            time.sleep(0.5)
+            return orig(info)
+
+        reg._load = slow_load
+        mid = ModelId("m", 1)
+        reg.apply(AddMessage("m", 1, v1, timestamp=1.0))
+        assert reg.is_warming(mid)
+        state = reg.state()
+        reg.restore(state)  # warm still mid-compile
+        # the in-flight warm is re-attributed, not duplicated
+        model = reg.model(mid)  # joins the warm — never serves cold
+        assert model is not None
+        assert loads[v1] == 1, f"double compile: {loads}"
+        assert reg.warm_error(mid) is None
+        # and the result is attributed: no further compile on re-ask
+        assert reg.model_if_warm(mid) is model
+
+    def test_restore_with_changed_path_rewarns(self, tmp_path):
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        v1b = _write_const(tmp_path, "v1b.pmml", 3.0)
+        reg = ModelRegistry(batch_size=8, async_warmup=False)
+        reg.apply(AddMessage("m", 1, v1, timestamp=1.0))
+        assert reg.model(ModelId("m", 1)) is not None
+        reg.restore({"served": {"m_1": v1b}})
+        # different document: the old compile must not be served
+        m = reg.model(ModelId("m", 1))
+        out = m.score_records([{"a": 0.0}])
+        assert out[0].score.value == 3.0
+
+
+class TestFleetConvergence:
+    def test_broadcast_rollback_converges_a_beating_worker(self, tmp_path):
+        from flink_jpmml_tpu.parallel.health import (
+            HealthCoordinator, HealthReporter,
+        )
+        from flink_jpmml_tpu.runtime.supervisor import rollout_control_hook
+
+        v1 = _write_const(tmp_path, "v1.pmml", 1.0)
+        v2 = _write_const(tmp_path, "v2.pmml", 2.0)
+        reg = ModelRegistry(batch_size=8, async_warmup=False)
+        reg.apply(AddMessage("m", 1, v1, timestamp=1.0))
+        reg.apply(RolloutMessage(
+            "m", 2, "canary", 2.0, path=v2, fraction=0.2,
+        ))
+        assert reg.rollout("m") is not None
+
+        coord = HealthCoordinator(timeout_s=5.0)
+        rep = HealthReporter(
+            coord.host, coord.port, "w0", interval_s=0.05,
+            on_control=rollout_control_hook(reg),
+        )
+        try:
+            # the supervisor-side decision, broadcast over the beat reply
+            coord.set_control({
+                "rollout": to_wire(RolloutMessage("m", 2, "rollback", 3.0))
+            })
+            deadline = time.monotonic() + 10.0
+            while reg.rollout("m") is not None:
+                assert time.monotonic() < deadline, "never converged"
+                time.sleep(0.02)
+            assert reg.resolve("m", 2) is None  # candidate dropped
+            assert reg.resolve("m") == ModelId("m", 1)
+        finally:
+            rep.stop()
+            coord.close()
+
+    def test_rollout_book_forwards_and_tracks(self):
+        from flink_jpmml_tpu.rollout.controller import RolloutBook
+
+        sent = []
+        book = RolloutBook(sent.append)
+        msg = RolloutMessage("m", 2, "canary", 1.0)
+        assert book.apply(msg)
+        assert book.rollouts()["m"].stage == "canary"
+        assert sent == [msg]
+        assert book.apply(RolloutMessage("m", 2, "rollback", 2.0))
+        assert book.rollouts() == {}
+        assert len(sent) == 2  # terminal frames forward too

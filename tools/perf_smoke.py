@@ -19,7 +19,10 @@ fails loudly on exactly the regressions new concurrency code breeds:
 - **scrape-surface rot**: a live pipeline's ``/metrics`` endpoint
   (obs/server.py) must serve parseable Prometheus text whose
   ``fjt_records_out`` is non-zero and whose histogram ``_count``
-  matches its ``+Inf`` bucket — the fleet dashboard's ground truth.
+  matches its ``+Inf`` bucket — the fleet dashboard's ground truth;
+- **rollout-plane drift**: the canary hash split must hand the
+  candidate its configured fraction ±1% with zero shadow-traffic sink
+  leakage (the ``bench.py --rollout-drill`` engine at smoke scale).
 
 Seconds-cheap by design (tier-1 guards it — tests/test_perf_smoke.py);
 exit 0 = healthy, 1 = assertion failure, 2 = watchdog fired.
@@ -311,6 +314,19 @@ def check_obs_scrape() -> None:
         srv.close()
 
 
+def check_rollout_drill() -> None:
+    """Rollout control-plane tripwire: the bench drill's engine at smoke
+    scale — canary split ratio ±1% absolute, zero shadow sink leakage,
+    zero disagreement on a byte-identical candidate. (The end-to-end
+    guardrail promote/rollback drills live in tests/test_rollout.py;
+    this guards the routing arithmetic every one of them rests on.)"""
+    from flink_jpmml_tpu.bench import run_rollout_drill
+
+    line = run_rollout_drill(records=4096, fraction=0.2, batch=256)
+    assert line["ok"], line
+    assert line["shadow_compared"] > 0, line
+
+
 def main() -> int:
     timer = threading.Timer(WATCHDOG_S, _watchdog)
     timer.daemon = True
@@ -325,6 +341,8 @@ def main() -> int:
     print("perf-smoke: autotune cache roundtrip OK", flush=True)
     check_obs_scrape()
     print("perf-smoke: obs /metrics scrape OK", flush=True)
+    check_rollout_drill()
+    print("perf-smoke: rollout drill OK", flush=True)
     timer.cancel()
     return 0
 
