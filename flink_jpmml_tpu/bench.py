@@ -84,19 +84,12 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 # jax-free (lazy jax inside): safe for the probe-polling parent
+from flink_jpmml_tpu.obs import attr as attr_mod
+from flink_jpmml_tpu.obs import profiler as prof_mod
 from flink_jpmml_tpu.utils.metrics import _nearest_rank
 from flink_jpmml_tpu.utils.profiling import overlap_stats, wire_stats
 
 NORTH_STAR_REC_S = 1_000_000.0
-
-# chip peaks for the honest-utilization fields (device_kind substring →
-# (bf16 peak FLOP/s, HBM bytes/s)); unknown chips report null fields
-_CHIP_PEAKS = (
-    ("v5 lite", (197e12, 819e9)),   # v5e
-    ("v5e", (197e12, 819e9)),
-    ("v4", (275e12, 1228e9)),
-    ("v5p", (459e12, 2765e9)),
-)
 
 
 def _device_utilization(dev_rate: float, trees: int, depth: int,
@@ -111,22 +104,23 @@ def _device_utilization(dev_rate: float, trees: int, depth: int,
     amortize over the chunk). A gather-shaped workload that
     deliberately trades FLOPs toward bandwidth will sit in single-digit
     MFU — the point of the field is that the artifact says so itself.
+    Chip peaks and the roofline arithmetic are shared with the LIVE
+    gauges (obs/profiler.py); the bench keeps the strict null-on-
+    unknown-chip convention.
     """
     import jax
 
     kind = getattr(jax.devices()[0], "device_kind", "") or ""
-    peaks = next(
-        (p for sub, p in _CHIP_PEAKS if sub in kind.lower()), None
-    )
+    peaks = prof_mod.chip_peaks(kind, strict=True)
     splits = (1 << depth) - 1
     leaves = 1 << depth
     flops_per_record = 2.0 * trees * splits * leaves + 2.0 * trees * leaves
     if peaks is None or dev_rate <= 0:
         return None, None, flops_per_record
-    flop_peak, membw_peak = peaks
     bytes_per_record = (4.0 * features if f32_wire else features) + 2.0
-    mfu = dev_rate * flops_per_record / flop_peak
-    membw = dev_rate * bytes_per_record / membw_peak
+    mfu, membw = prof_mod.roofline(
+        dev_rate, flops_per_record, bytes_per_record, peaks
+    )
     return round(mfu, 4), round(membw, 4), flops_per_record
 
 
@@ -597,6 +591,9 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
             {
                 **overlap_stats(pipe.metrics, elapsed),
                 **wire_stats(pipe.metrics, len(lats) * block),
+                # per-stage latency attribution (obs/attr.py): where
+                # this operating point's wall time went
+                "attribution": attr_mod.summary(pipe.metrics),
                 # the mode's exposition snapshot (scrape-format struct)
                 "varz": pipe.metrics.struct_snapshot(),
             },
@@ -653,6 +650,7 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
         "h2d_stall_ms": ostats["h2d_stall_ms"],
         "encode_ms": ostats.get("encode_ms"),
         "h2d_bytes_per_record": ostats.get("h2d_bytes_per_record"),
+        "attribution": ostats.get("attribution"),
         "varz": ostats.get("varz"),
     }
 
@@ -756,6 +754,11 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
                 lag[m.group(1)] = g["value"]
         if lag:
             line["kafka_lag"] = lag
+        # the production-shaped path's stage decomposition: the ranked
+        # answer to "where does the 545k-vs-1.09M kafka gap live" —
+        # fetch/decode (consumer thread) next to encode/h2d/queue_wait/
+        # readback/sink (score thread), one shared registry
+        line["attribution"] = attr_mod.summary(km)
         line["varz"] = varz
         return line
     finally:
@@ -1226,6 +1229,7 @@ def main() -> None:
             "donation_hits": ostats["donation_hits"],
         }
         line.update(wire_stats(pipe.metrics, count[0]))
+        line["attribution"] = attr_mod.summary(pipe.metrics)
         # the scrape format's first consumer: the same typed struct the
         # /metrics endpoint renders, embedded per operating mode so a
         # BENCH_*.json diff and a Prometheus scrape tell one story
@@ -1379,6 +1383,11 @@ def main() -> None:
         ostats_w["encode_ms"] = round(
             1000.0 * (enc_counter.get() - enc0), 3
         )
+        # per-stage attribution + the window's scrape struct: the hand
+        # loop's queue_wait/readback columns come from the shared
+        # dispatcher; encode/h2d ride the artifact's existing fields
+        ostats_w["attribution"] = attr_mod.summary(wm)
+        ostats_w["varz"] = wm.struct_snapshot()
         return rate_w, lats, ostats_w
 
     # a shared tunnel's throughput wanders run to run; measure three
@@ -1421,12 +1430,34 @@ def main() -> None:
     dev_rate = reps * B / (time.perf_counter() - t1)
     stage(f"device-resident measurement done: {dev_rate:,.0f} rec/s")
 
-    mfu, membw_util, flops_rec = _device_utilization(
-        dev_rate, args.trees, args.depth, args.features,
-        # the fused path also streams raw f32 to the device
-        args.f32_wire
-        or (q_tuned is not None and q_tuned.encode_mode == "fused"),
+    # the fused path also streams raw f32 to the device; one predicate
+    # feeds both the artifact roofline and the kernel cost ledger so
+    # their bytes_per_record can never diverge
+    f32ish = args.f32_wire or (
+        q_tuned is not None and q_tuned.encode_mode == "fused"
     )
+    mfu, membw_util, flops_rec = _device_utilization(
+        dev_rate, args.trees, args.depth, args.features, f32ish,
+    )
+    # feed the bench's high-quality device measurement into the kernel
+    # cost ledger (obs/profiler.py, persisted next to the autotune
+    # cache): the predict-then-verify cost model's best training rows
+    # come from here, where the measurement is device-resident and
+    # multi-second, not a single sampled bracket
+    if dev_rate > 0:
+        prof_mod.KernelCostLedger(flush_interval_s=0.0).update(
+            model=(
+                q_tuned.model_hash if q_tuned is not None
+                else f"gbm{args.trees}x{args.depth}x{args.features}"
+            ),
+            backend=f"bench:{backend}",
+            device_s=reps * B / dev_rate,
+            records=reps * B,
+            flops_per_record=flops_rec,
+            bytes_per_record=(
+                4.0 * args.features if f32ish else float(args.features)
+            ) + 2.0,
+        )
     line = {
         "metric": metric,
         "value": round(rate, 1),
@@ -1457,6 +1488,10 @@ def main() -> None:
         "device_mfu": mfu,
         "device_membw_util": membw_util,
         "flops_per_record": flops_rec,
+        # stage attribution + scrape struct of the MEDIAN window: the
+        # same stage_seconds family a production /metrics scrape serves
+        "attribution": ostats.get("attribution"),
+        "varz": ostats.get("varz"),
     }
     autotune_fields(line)
     if interp_rate is not None:

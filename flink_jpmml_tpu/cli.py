@@ -28,6 +28,17 @@ docs/operations.md §Rollouts:
     fjt-rollout ctrl.jsonl canary   --name m --version 2 --fraction 0.1
     fjt-rollout ctrl.jsonl full     --name m --version 2   # promote
     fjt-rollout ctrl.jsonl rollback --name m --version 2   # abort
+
+``fjt-top``: render the latency-attribution plane (obs/attr.py) as a
+ranked table — per-stage p50/p99/total share, live device occupancy,
+top exemplars — from a running pipeline's ``/varz`` endpoint or a
+struct dump (a ``/varz`` JSON file or a ``BENCH_*.json`` artifact).
+Turns "the chip is 94% idle" into the ordered list of which stage to
+attack next. No jax import — safe on any host:
+
+    fjt-top http://127.0.0.1:9100          # live /varz scrape
+    fjt-top BENCH_r06.json                 # bench artifact's varz
+    fjt-top /tmp/varz-dump.json
 """
 
 from __future__ import annotations
@@ -268,6 +279,157 @@ def rollout_main(argv: Optional[List[str]] = None) -> int:
         f"{args.control_file}",
         file=sys.stderr,
     )
+    return 0
+
+
+def _top_load(source: str) -> Dict[str, dict]:
+    """→ {label: metrics struct} from a /varz URL, a /varz JSON dump,
+    or a BENCH artifact (its embedded ``varz`` structs, per mode)."""
+    if source.startswith(("http://", "https://")):
+        import urllib.error
+        import urllib.request
+
+        url = source.rstrip("/")
+        if not url.endswith("/varz"):
+            url += "/varz"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                payload = json.loads(r.read().decode())
+        except (urllib.error.URLError, OSError,
+                json.JSONDecodeError) as e:
+            raise SystemExit(f"cannot read {url!r}: {e}")
+    else:
+        try:
+            with open(source, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise SystemExit(f"cannot read {source!r}: {e}")
+    if not isinstance(payload, dict):
+        raise SystemExit(f"{source!r} is not a JSON object")
+    if isinstance(payload.get("parsed"), dict):
+        payload = payload["parsed"]  # the bench driver's artifact wrap
+    if "histograms" in payload or "counters" in payload:
+        return {"": payload}  # a bare struct dump
+    out: Dict[str, dict] = {}
+    if isinstance(payload.get("varz"), dict):
+        out[""] = payload["varz"]  # a bench artifact's top-level mode
+    for k, v in payload.items():
+        if k == "varz" and "" in out:
+            continue  # the headline struct, already the aggregate
+        if isinstance(v, dict):
+            if "histograms" in v or "counters" in v:
+                out[str(k)] = v  # a /varz {label: struct} mapping
+            elif isinstance(v.get("varz"), dict):
+                out[str(k)] = v["varz"]  # bench sub-modes (latency/kafka)
+    if not out:
+        raise SystemExit(f"no metrics structs found in {source!r}")
+    return out
+
+
+def _top_render(label: str, struct: dict, out) -> None:
+    from flink_jpmml_tpu.obs import attr
+
+    title = label or "aggregate"
+    print(f"== {title} ==", file=out)
+    gauges = struct.get("gauges") or {}
+
+    def g(name):
+        v = gauges.get(name)
+        return v.get("value") if isinstance(v, dict) else None
+
+    mfu, membw = g("device_mfu"), g("device_membw_util")
+    nsrec, flops = g("device_ns_per_record"), g("flops_per_record")
+    if any(x is not None for x in (mfu, membw, nsrec)):
+        parts = []
+        if mfu is not None:
+            parts.append(f"mfu {100.0 * mfu:6.2f}%")
+        if membw is not None:
+            parts.append(f"membw {100.0 * membw:6.2f}%")
+        if nsrec is not None:
+            parts.append(f"{nsrec:,.0f} ns/rec (device, sampled)")
+        if flops is not None:
+            parts.append(f"{flops:,.0f} flops/rec")
+        print("device   " + "   ".join(parts), file=out)
+    slo_ok = g("slo_ok")
+    if slo_ok is not None:
+        burns = ", ".join(
+            f"{k.split('=', 1)[1].strip(chr(34) + '}')}s: "
+            f"{v['value']:.2f}x"
+            for k, v in sorted(gauges.items())
+            if k.startswith("slo_burn_rate{") and isinstance(v, dict)
+        )
+        state = "OK" if slo_ok else "BREACHED"
+        print(f"slo      {state}" + (f"   burn [{burns}]" if burns else ""),
+              file=out)
+    summary = attr.summary(struct)
+    if summary is None:
+        print("(no stage attribution recorded)", file=out)
+        return
+    print(
+        f"{'stage':<12}{'batches':>9}{'p50 ms':>10}{'p99 ms':>10}"
+        f"{'total ms':>12}{'share':>8}",
+        file=out,
+    )
+    ranked = sorted(
+        summary.items(), key=lambda kv: kv[1]["total_ms"], reverse=True
+    )
+    for stage, row in ranked:
+        print(
+            f"{stage:<12}{row['n']:>9}{row['p50_ms']:>10.3f}"
+            f"{row['p99_ms']:>10.3f}{row['total_ms']:>12.3f}"
+            f"{100.0 * row['share']:>7.1f}%",
+            file=out,
+        )
+    # top exemplars: the tail batches a p99 scrape would link to
+    exemplars = []
+    for name, hstate in (struct.get("histograms") or {}).items():
+        for ex in (hstate.get("exemplars") or {}).values():
+            try:
+                exemplars.append((float(ex[1]), str(ex[0]), name))
+            except (IndexError, TypeError, ValueError):
+                continue
+    if exemplars:
+        exemplars.sort(reverse=True)
+        print("exemplars (worst observed per bucket):", file=out)
+        for v, tid, name in exemplars[:5]:
+            print(
+                f"  {1000.0 * v:10.3f} ms  trace_id={tid}  {name}",
+                file=out,
+            )
+
+
+def top_main(argv: Optional[List[str]] = None) -> int:
+    """``fjt-top``: the fleet attribution table (see module docstring).
+    Renders every labelled source (the supervisor's /varz serves the
+    aggregate under ``""`` plus one struct per worker); ``--worker``
+    narrows to one label."""
+    ap = argparse.ArgumentParser(
+        prog="fjt-top",
+        description="Render per-stage latency attribution, live device "
+                    "occupancy, and top exemplars from /varz or a "
+                    "struct dump.",
+    )
+    ap.add_argument("source",
+                    help="obs-server base URL (or /varz URL), a /varz "
+                         "JSON dump, or a BENCH_*.json artifact")
+    ap.add_argument("--worker", default=None,
+                    help="render only this source label "
+                         "(default: all, aggregate first)")
+    args = ap.parse_args(argv)
+    sources = _top_load(args.source)
+    if args.worker is not None:
+        if args.worker not in sources:
+            raise SystemExit(
+                f"no source {args.worker!r}; have "
+                f"{sorted(sources)}"
+            )
+        sources = {args.worker: sources[args.worker]}
+    first = True
+    for label in sorted(sources, key=lambda k: (k != "", k)):
+        if not first:
+            print(file=sys.stdout)
+        _top_render(label, sources[label], sys.stdout)
+        first = False
     return 0
 
 

@@ -16,7 +16,16 @@ the outside:
 - :mod:`flink_jpmml_tpu.obs.server` — stdlib-HTTP exposition:
   ``/metrics`` (Prometheus text), ``/healthz``, ``/varz`` (JSON), fed by
   one registry or by a whole supervised fleet's merged heartbeat
-  snapshots (``runtime/supervisor.py``).
+  snapshots (``runtime/supervisor.py``);
+- :mod:`flink_jpmml_tpu.obs.attr` — the per-batch stage ledger:
+  end-to-end wall time decomposed into ``stage_seconds{stage=...}``
+  histograms with exemplar capture (a scraped tail bucket links to its
+  flight-recorder event);
+- :mod:`flink_jpmml_tpu.obs.profiler` — sampled device timing → live
+  ``device_mfu``/``device_membw_util`` gauges and the persisted kernel
+  cost ledger;
+- :mod:`flink_jpmml_tpu.obs.slo` — multi-window burn-rate SLO tracking
+  over any latency histogram (``FJT_SLO_*``).
 """
 
 from flink_jpmml_tpu.obs.recorder import FlightRecorder, record  # noqa: F401

@@ -1,0 +1,406 @@
+"""Continuous device profiling: sampled kernel timing → live roofline.
+
+``device_mfu`` and ``device_membw_util`` existed only as one-shot bench
+artifact fields — a production pipeline could not say whether the chip
+was busy. This module makes them **live**: a rate-limited sampler
+measures true device execution time with a block-until-ready delta
+pair around a dispatch (drain the in-flight window, stamp, dispatch,
+block, stamp), and from the sample stream derives per-registry gauges
+
+- ``device_mfu``          — achieved FLOP/s over the chip's bf16 peak,
+- ``device_membw_util``   — achieved HBM stream bytes/s over peak,
+- ``flops_per_record``    — the analytic cost model's FLOPs/record,
+- ``device_ns_per_record``— smoothed measured device time per record,
+
+plus a ``stage_seconds{stage="device"}`` histogram entry per sample
+(the attribution plane's sampled device column). Sampling serializes
+the window for the sampled batch, so it is **rate-limited twice**: at
+most once per ``FJT_PROF_SAMPLE`` seconds (default 1.0; ``0``/``off``
+disables), and never past an accumulated-overhead budget of 1% of wall
+clock — the perf-smoke tripwire pins total attribution overhead <2%.
+
+Each sample also lands in the **kernel cost ledger**: per
+``(model, backend)`` the observed device-seconds/record next to the
+analytic FLOP/byte model — persisted as JSON beside the autotune cache
+(``kernel_costs.json``), the training data ROADMAP item 2's
+predict-then-verify cost model needs.
+
+Chip peaks are known for the TPU generations the bench knows; unknown
+device kinds (CPU test runs, new chips) fall back to a nominal
+1 TFLOP/s / 100 GB/s peak (override: ``FJT_PROF_PEAKS=flops,bytes``) so
+the gauges stay live as *trends* — the bench artifact keeps its strict
+null-on-unknown semantics via ``chip_peaks(strict=True)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Callable, Dict, Optional, Tuple
+
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+_SAMPLE_ENV = "FJT_PROF_SAMPLE"
+_PEAKS_ENV = "FJT_PROF_PEAKS"
+_DEFAULT_INTERVAL_S = 1.0
+_OVERHEAD_BUDGET = 0.01  # ≤1% of wall clock spent inside samples
+_EWMA_ALPHA = 0.3  # smoothing for the per-record device time
+
+# chip peaks (device_kind substring → (bf16 peak FLOP/s, HBM bytes/s));
+# shared with bench.py's roofline fields
+CHIP_PEAKS = (
+    ("v5 lite", (197e12, 819e9)),  # v5e
+    ("v5e", (197e12, 819e9)),
+    ("v4", (275e12, 1228e9)),
+    ("v5p", (459e12, 2765e9)),
+)
+_NOMINAL_PEAKS = (1e12, 100e9)
+
+
+def chip_peaks(
+    device_kind: str, strict: bool = False
+) -> Optional[Tuple[float, float]]:
+    """(bf16 peak FLOP/s, HBM bytes/s) for a device kind. Unknown kinds
+    return None under ``strict`` (the bench's honest-null convention) or
+    the nominal/env-overridden fallback otherwise (live trend gauges)."""
+    kind = (device_kind or "").lower()
+    for sub, peaks in CHIP_PEAKS:
+        if sub in kind:
+            return peaks
+    if strict:
+        return None
+    raw = os.environ.get(_PEAKS_ENV)
+    if raw:
+        try:
+            f, b = (float(x) for x in raw.split(","))
+            if f > 0 and b > 0:
+                return (f, b)
+        except ValueError:
+            pass
+    return _NOMINAL_PEAKS
+
+
+def roofline(
+    dev_rate: float,
+    flops_per_record: Optional[float],
+    bytes_per_record: Optional[float],
+    peaks: Optional[Tuple[float, float]],
+) -> Tuple[Optional[float], Optional[float]]:
+    """→ (mfu, membw_util) for a measured device record rate against a
+    chip's peaks; None fields where the cost model or peaks are
+    unknown."""
+    if peaks is None or dev_rate <= 0:
+        return None, None
+    flop_peak, membw_peak = peaks
+    mfu = (
+        dev_rate * flops_per_record / flop_peak
+        if flops_per_record else None
+    )
+    membw = (
+        dev_rate * bytes_per_record / membw_peak
+        if bytes_per_record else None
+    )
+    return mfu, membw
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return getattr(jax.devices()[0], "device_kind", "") or ""
+    except Exception:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# Kernel cost ledger (persisted next to the autotune cache)
+# ---------------------------------------------------------------------------
+
+
+def cost_ledger_path() -> str:
+    """``kernel_costs.json`` in the autotune cache's directory — the
+    measured-cost training data lives next to the measured-config
+    cache it will eventually replace."""
+    from flink_jpmml_tpu.compile import autotune
+
+    p = autotune.cache_path()
+    return str(p.parent / "kernel_costs.json")
+
+
+class KernelCostLedger:
+    """Observed device cost per (model, backend) vs the analytic model.
+
+    Every profiler sample updates one entry (EWMA of device
+    seconds/record, sample count, last batch shape, the analytic
+    flops/bytes per record); entries persist through the same
+    corrupt-tolerant atomic-replace JSON discipline as the autotune
+    cache, rate-limited to one write per ``flush_interval_s``."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        flush_interval_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._path = path
+        self._flush_interval = flush_interval_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._entries: Dict[str, dict] = {}
+        self._dirty = False
+        self._last_flush = 0.0
+
+    def _resolve_path(self) -> Optional[str]:
+        if self._path is None:
+            try:
+                self._path = cost_ledger_path()
+            except Exception:
+                return None
+        return self._path
+
+    def update(
+        self,
+        model: Optional[str],
+        backend: Optional[str],
+        device_s: float,
+        records: int,
+        flops_per_record: Optional[float],
+        bytes_per_record: Optional[float],
+    ) -> None:
+        if not records or device_s <= 0:
+            return
+        key = f"{model or 'unknown'}|{backend or 'unknown'}"
+        per_rec = device_s / records
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entries[key] = {
+                    "model": model, "backend": backend,
+                    "device_s_per_record": per_rec, "samples": 0,
+                }
+            else:
+                e["device_s_per_record"] = (
+                    (1.0 - _EWMA_ALPHA) * e["device_s_per_record"]
+                    + _EWMA_ALPHA * per_rec
+                )
+            e["samples"] += 1
+            e["last_batch"] = int(records)
+            e["last_device_s"] = round(device_s, 9)
+            e["flops_per_record"] = flops_per_record
+            e["bytes_per_record"] = bytes_per_record
+            e["rec_s"] = round(records / device_s, 1)
+            e["ts"] = time.time()
+            self._dirty = True
+            now = self._clock()
+            due = now - self._last_flush >= self._flush_interval
+            if due:
+                self._last_flush = now
+        if due:
+            self.flush()
+
+    def entries(self) -> Dict[str, dict]:
+        with self._mu:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def flush(self) -> None:
+        """Merge-write this process's entries into the on-disk ledger
+        (atomic replace; any I/O or parse failure is silent — a
+        read-only cache dir must not break serving)."""
+        path = self._resolve_path()
+        if path is None:
+            return
+        with self._mu:
+            if not self._dirty:
+                return
+            mine = {k: dict(v) for k, v in self._entries.items()}
+            self._dirty = False
+        disk: Dict[str, dict] = {}
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if isinstance(data.get("entries"), dict):
+                disk = data["entries"]
+        except (OSError, ValueError, AttributeError):
+            disk = {}
+        disk.update(mine)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump({"version": 1, "entries": disk}, f)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# The sampler
+# ---------------------------------------------------------------------------
+
+
+class DeviceProfiler:
+    """Rate-limited device-time sampler feeding live roofline gauges.
+
+    The :class:`~flink_jpmml_tpu.runtime.pipeline.OverlappedDispatcher`
+    consults :meth:`should_sample` per launch; on a sample it drains
+    its window, brackets the dispatch with ``block_until_ready``, and
+    hands the delta to :meth:`record_sample` together with the launch
+    site's :func:`~flink_jpmml_tpu.obs.attr.dispatch_profile`."""
+
+    def __init__(
+        self,
+        metrics: MetricsRegistry,
+        interval_s: Optional[float] = None,
+        overhead_budget: float = _OVERHEAD_BUDGET,
+        clock: Callable[[], float] = time.monotonic,
+        cost_ledger: Optional[KernelCostLedger] = None,
+    ):
+        # weak for the same reason as attr.StageLedger: the _PROFILERS
+        # cache keys weakly on the registry, so a strong back-ref here
+        # would pin every registry for process lifetime
+        self._metrics_ref = weakref.ref(metrics)
+        if interval_s is None:
+            raw = (os.environ.get(_SAMPLE_ENV) or "").strip().lower()
+            if raw in ("0", "off", "false", "no"):
+                interval_s = 0.0
+            else:
+                try:
+                    interval_s = float(raw) if raw else _DEFAULT_INTERVAL_S
+                except ValueError:
+                    interval_s = _DEFAULT_INTERVAL_S
+        self._interval = max(0.0, float(interval_s))
+        self._budget = overhead_budget
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._t0 = clock()
+        self._last_sample = 0.0
+        self._overhead_s = 0.0
+        # EWMA of ns/record keyed per (model, backend): multi-model
+        # serving (incumbent + rollout candidate through one
+        # dispatcher) must not blend one model's rate with another's
+        # flop/byte model — the roofline would report a cross-term
+        # true of neither
+        self._ns_per_record: Dict[str, float] = {}
+        self._peaks = None
+        self._peaks_resolved = False
+        self.cost_ledger = cost_ledger or KernelCostLedger()
+        self._samples = metrics.counter("device_samples")
+        self._g_mfu = metrics.gauge("device_mfu")
+        self._g_membw = metrics.gauge("device_membw_util")
+        self._g_flops = metrics.gauge("flops_per_record")
+        self._g_nsrec = metrics.gauge("device_ns_per_record")
+
+    @property
+    def enabled(self) -> bool:
+        return self._interval > 0.0
+
+    def should_sample(self) -> bool:
+        """One atomic check-and-claim per launch: True at most once per
+        interval AND only while accumulated sampling overhead stays
+        under the budget share of wall clock. The claim is optimistic —
+        a claimed slot that doesn't call :meth:`record_sample` simply
+        wastes one interval, never double-samples."""
+        if self._interval <= 0.0:
+            return False
+        now = self._clock()
+        with self._mu:
+            if now - self._last_sample < self._interval:
+                return False
+            elapsed = max(now - self._t0, 1e-9)
+            if (
+                self._overhead_s > 0.0
+                and self._overhead_s / elapsed > self._budget
+            ):
+                return False
+            self._last_sample = now
+            return True
+
+    def record_sample(
+        self,
+        device_s: float,
+        profile: Optional[dict],
+        overhead_s: Optional[float] = None,
+    ) -> None:
+        """Fold one measured (device seconds, dispatch profile) pair
+        into the gauges, the sampled device-stage histogram, and the
+        kernel cost ledger. ``overhead_s`` is the sample's full
+        serialization cost (drain + bracket), charged against the
+        rate limiter's budget."""
+        profile = profile or {}
+        records = int(profile.get("records") or 0)
+        with self._mu:
+            self._overhead_s += (
+                overhead_s if overhead_s is not None else device_s
+            )
+        self._samples.inc()
+        if device_s <= 0 or records <= 0:
+            return
+        per_rec = device_s / records
+        key = f"{profile.get('model')}|{profile.get('backend')}"
+        with self._mu:
+            prev = self._ns_per_record.get(key)
+            if prev is None:
+                self._ns_per_record[key] = per_rec * 1e9
+            else:
+                self._ns_per_record[key] = (
+                    (1.0 - _EWMA_ALPHA) * prev
+                    + _EWMA_ALPHA * per_rec * 1e9
+                )
+            ns_rec = self._ns_per_record[key]
+            if not self._peaks_resolved:
+                self._peaks = chip_peaks(_device_kind())
+                self._peaks_resolved = True
+            peaks = self._peaks
+        self._g_nsrec.set(ns_rec)
+        # smoothed records/s of pure device time — THIS model's EWMA
+        # against THIS model's cost profile, so the roofline is
+        # internally consistent even when models alternate samples
+        dev_rate = 1e9 / ns_rec
+        flops = profile.get("flops_per_record")
+        bpr = profile.get("bytes_per_record")
+        mfu, membw = roofline(dev_rate, flops, bpr, peaks)
+        if flops is not None:
+            self._g_flops.set(float(flops))
+        if mfu is not None:
+            self._g_mfu.set(round(mfu, 6))
+        if membw is not None:
+            self._g_membw.set(round(membw, 6))
+        # the sampled device column of the attribution plane
+        from flink_jpmml_tpu.obs import attr
+
+        led = attr.ledger_for(self._metrics_ref())
+        if led is not None:
+            led.observe("device", device_s)
+        self.cost_ledger.update(
+            profile.get("model"), profile.get("backend"),
+            device_s, records, flops, bpr,
+        )
+
+
+# one profiler per registry (cf. attr.ledger_for); a shared process-wide
+# cost ledger so every pipeline's samples land in one file
+_COST_LEDGER = KernelCostLedger()
+_PROFILERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_PROFILERS_MU = threading.Lock()
+
+
+def profiler_for(
+    metrics: Optional[MetricsRegistry],
+) -> Optional[DeviceProfiler]:
+    if metrics is None:
+        return None
+    prof = _PROFILERS.get(metrics)
+    if prof is None:
+        with _PROFILERS_MU:
+            prof = _PROFILERS.get(metrics)
+            if prof is None:
+                prof = _PROFILERS[metrics] = DeviceProfiler(
+                    metrics, cost_ledger=_COST_LEDGER
+                )
+    return prof

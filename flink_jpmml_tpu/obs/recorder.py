@@ -66,6 +66,16 @@ class FlightRecorder:
     ) -> Optional[str]:
         """Write the ring as JSONL → the file path (None on I/O failure:
         a postmortem helper must never become the second failure)."""
+        try:
+            # the span file must contain everything up to the moment of
+            # the dump: a buffered span writer (obs/spans.py) would
+            # otherwise hold the last ~flush-interval of the story a
+            # postmortem exists to tell
+            from flink_jpmml_tpu.obs import spans
+
+            spans.flush()
+        except Exception:
+            pass
         events = self.events()
         try:
             if path is None:

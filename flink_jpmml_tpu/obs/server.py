@@ -4,8 +4,11 @@ The scrape surface for a running pipeline or a whole supervised fleet,
 on stdlib ``http.server`` only (no external metrics framework — the
 same discipline as utils/netio.py's hand-rolled framing):
 
-- ``/metrics`` — Prometheus text format 0.0.4. Counters and gauges map
-  1:1; :class:`~flink_jpmml_tpu.utils.metrics.Histogram` maps to the
+- ``/metrics`` — Prometheus text format 0.0.4, or OpenMetrics when the
+  scraper's Accept header negotiates it (exemplar suffixes on histogram
+  buckets + ``# EOF`` ride only the OpenMetrics form — the classic
+  format doesn't admit them). Counters and gauges map 1:1;
+  :class:`~flink_jpmml_tpu.utils.metrics.Histogram` maps to the
   native Prometheus histogram series (cumulative ``_bucket{le=...}`` +
   ``_sum`` + ``_count``), so PromQL's ``histogram_quantile`` over a
   fleet computes the SAME answer as the in-process bucket merge.
@@ -60,13 +63,25 @@ def _series_name(raw: str, extra: Dict[str, str]):
 def prometheus_text(
     sources: Mapping[Optional[str], Union[MetricsRegistry, dict]],
     label: str = "worker",
+    openmetrics: bool = False,
 ) -> str:
-    """Render registries/structs as Prometheus text exposition 0.0.4.
+    """Render registries/structs as Prometheus text exposition.
 
     ``sources`` keys become ``label`` values; the ``None`` (or ``""``)
     key renders unlabeled — the aggregate series a fleet scrape reads.
     ``# TYPE`` lines are emitted once per metric name across all
-    sources, as the format requires."""
+    sources, as the format requires.
+
+    Default is the classic text format 0.0.4 — which does NOT admit
+    exemplars, so none are rendered (a stock scraper would reject the
+    whole page). ``openmetrics=True`` (the server sets it when the
+    scraper's Accept header negotiates ``application/openmetrics-text``
+    — modern Prometheus does by default) emits OpenMetrics instead:
+    exemplar suffixes on histogram ``_bucket`` lines and a terminating
+    ``# EOF``. Counters are declared ``unknown`` there — OpenMetrics
+    requires a ``_total`` sample-name suffix on counter families, and
+    keeping the SAME series names across both formats matters more to
+    dashboards than the type annotation (PromQL doesn't consult it)."""
     typed: Dict[str, str] = {}  # prom name -> type line emitted
     blocks: Dict[str, list] = {}  # prom name -> series lines
 
@@ -76,12 +91,13 @@ def prometheus_text(
             blocks[name] = []
         blocks[name].extend(lines)
 
+    counter_type = "unknown" if openmetrics else "counter"
     for key in sorted(sources, key=lambda k: (k is not None, k or "")):
         extra = {} if key in (None, "") else {label: str(key)}
         s = _struct(sources[key])
         for raw, v in sorted(s.get("counters", {}).items()):
             name, lab = _series_name(raw, extra)
-            _add(name, "counter", [f"{name}{lab} {_fmt(v)}\n"])
+            _add(name, counter_type, [f"{name}{lab} {_fmt(v)}\n"])
         for raw, g in sorted(s.get("gauges", {}).items()):
             name, lab = _series_name(raw, extra)
             _add(name, "gauge", [f"{name}{lab} {_fmt(g['value'])}\n"])
@@ -96,13 +112,28 @@ def prometheus_text(
             lines = []
             acc = 0
             counts = h._counts  # snapshot-local object: no racing writers
+            exemplars = h.exemplars()
+
+            def _bucket_line(le: str, acc: int, idx: int) -> str:
+                line = f"{name}_bucket{{{le}}} {acc}"
+                ex = exemplars.get(idx) if openmetrics else None
+                if ex is not None:
+                    # OpenMetrics exemplar syntax: the trace id links a
+                    # scraped tail bucket straight to its
+                    # flight-recorder `latency_exemplar` event
+                    line += (
+                        f' # {{trace_id="{ex[0]}"}} '
+                        f"{_fmt(ex[1])} {_fmt(ex[2])}"
+                    )
+                return line + "\n"
+
             for i, edge in enumerate(h.edges):
                 acc += counts[i]
                 le = ",".join(x for x in (inner, f'le="{_fmt(edge)}"') if x)
-                lines.append(f"{name}_bucket{{{le}}} {acc}\n")
+                lines.append(_bucket_line(le, acc, i))
             acc += counts[-1]
             le = ",".join(x for x in (inner, 'le="+Inf"') if x)
-            lines.append(f"{name}_bucket{{{le}}} {acc}\n")
+            lines.append(_bucket_line(le, acc, len(h.edges)))
             lines.append(f"{name}_sum{lab} {_fmt(h.sum())}\n")
             lines.append(f"{name}_count{lab} {acc}\n")
             _add(name, "histogram", lines)
@@ -115,6 +146,8 @@ def prometheus_text(
     for name in sorted(typed):
         out.append(typed[name])
         out.extend(blocks[name])
+    if openmetrics:
+        out.append("# EOF\n")
     return "".join(out)
 
 
@@ -158,9 +191,17 @@ class ObsServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/metrics":
+                        om = "application/openmetrics-text" in (
+                            self.headers.get("Accept") or ""
+                        )
                         self._reply(
                             200,
-                            prometheus_text(obs._collect()),
+                            prometheus_text(
+                                obs._collect(), openmetrics=om
+                            ),
+                            "application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8"
+                            if om else
                             "text/plain; version=0.0.4; charset=utf-8",
                         )
                     elif path == "/healthz":

@@ -16,33 +16,72 @@ worker cannot fill the disk. The format is the JSON Array Format with
 one event per line and no closing bracket — both loaders accept the
 truncated array, which is exactly what an abruptly-killed worker leaves
 behind.
+
+Writes are **buffered**: the original writer flushed the OS file per
+event, which put a syscall pair on every hot-path span (measured as the
+dominant cost of tracing a ≥1M rec/s stream). Events now accumulate in
+a bounded in-memory buffer written out when it reaches
+``BUFFER_EVENTS`` (128) events or ``FLUSH_INTERVAL_S`` (0.5 s) has
+passed since the last write — and on :func:`flush` (called by the
+flight recorder's postmortem dump), on ``close``, and at interpreter
+exit. Crash-loss is therefore bounded at ``BUFFER_EVENTS`` events /
+one flush interval, a contract pinned by
+``tests/test_attr.py::TestSpanBuffering``.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 _DIR_ENV = "FJT_TRACE_DIR"
 _MAX_ENV = "FJT_TRACE_MAX_MB"
 
+BUFFER_EVENTS = 128  # max events lost on an abrupt kill
+FLUSH_INTERVAL_S = 0.5
+
 
 class SpanWriter:
-    def __init__(self, path: str, max_bytes: int = 64 << 20):
+    def __init__(
+        self,
+        path: str,
+        max_bytes: int = 64 << 20,
+        buffer_events: int = BUFFER_EVENTS,
+        flush_interval_s: float = FLUSH_INTERVAL_S,
+    ):
         self._path = path
         self._max = max_bytes
         self._bytes = 0
         self._truncated = False
+        self._buf: List[str] = []
+        self._buf_max = max(1, int(buffer_events))
+        self._flush_interval = flush_interval_s
+        self._last_flush = time.monotonic()
         self._lock = threading.Lock()
         self._f = open(path, "w", encoding="utf-8")
         self._f.write("[\n")
+        self._f.flush()  # a kill before the first flush leaves a
+        # loadable (empty) truncated array, not a zero-byte file
 
     @property
     def path(self) -> str:
         return self._path
+
+    def _flush_locked(self) -> None:
+        if not self._buf:
+            return
+        chunk = "".join(self._buf)
+        self._buf.clear()
+        self._last_flush = time.monotonic()
+        try:
+            self._f.write(chunk)
+            self._f.flush()
+        except (OSError, ValueError):
+            self._truncated = True  # fd gone: go quiet, stay alive
 
     def emit(
         self, name: str, t0_s: float, dur_s: float, **args
@@ -71,15 +110,27 @@ class SpanWriter:
                     "ph": "i", "ts": ev["ts"], "pid": ev["pid"],
                     "tid": ev["tid"], "s": "g",
                 }) + ",\n"
-            try:
-                self._f.write(line)
-                self._f.flush()  # a killed worker keeps what it wrote
+                self._buf.append(line)
                 self._bytes += len(line)
-            except (OSError, ValueError):
-                self._truncated = True  # fd gone: go quiet, stay alive
+                self._flush_locked()  # the marker must reach disk
+                return
+            self._buf.append(line)
+            self._bytes += len(line)
+            if (
+                len(self._buf) >= self._buf_max
+                or time.monotonic() - self._last_flush
+                >= self._flush_interval
+            ):
+                self._flush_locked()
+
+    def flush(self) -> None:
+        """Write any buffered events out now (postmortem/exit path)."""
+        with self._lock:
+            self._flush_locked()
 
     def close(self) -> None:
         with self._lock:
+            self._flush_locked()
             try:
                 self._f.close()
             except OSError:
@@ -102,6 +153,13 @@ def writer() -> Optional[SpanWriter]:
     if _writer is None or _writer_dir != d:
         with _writer_lock:
             if _writer is None or _writer_dir != d:
+                if _writer is not None:
+                    # retargeting: the old writer's buffered tail must
+                    # reach ITS file (close flushes), and the fd must
+                    # not leak — GC of the file object would write
+                    # nothing from the Python-level buffer
+                    _writer.close()
+                    _writer = None  # a failed reopen must not resurrect it
                 try:
                     os.makedirs(d, exist_ok=True)
                     max_mb = float(os.environ.get(_MAX_ENV) or 64)
@@ -123,6 +181,19 @@ def emit(name: str, t0_s: float, dur_s: float, **args) -> None:
     w = writer()
     if w is not None:
         w.emit(name, t0_s, dur_s, **args)
+
+
+def flush() -> None:
+    """Flush the singleton writer's buffer (no-op when tracing is off).
+    Called by the flight recorder before a postmortem dump and at
+    interpreter exit, so the span file and the flight JSONL tell the
+    same final story."""
+    w = _writer  # don't CREATE a writer just to flush nothing
+    if w is not None:
+        w.flush()
+
+
+atexit.register(flush)
 
 
 def span_clock() -> float:

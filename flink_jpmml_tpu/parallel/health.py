@@ -21,7 +21,14 @@ equivalent here:
   value) every beat piggybacks a compact metrics snapshot, so the
   coordinator holds each worker's latest counters/gauges/histograms and
   the supervisor's ``/metrics`` endpoint (obs/server.py) can expose the
-  merged fleet view without a second wire protocol.
+  merged fleet view without a second wire protocol. The attribution
+  plane rides the same channel untouched: per-stage
+  ``stage_seconds{stage=...}`` histograms (with their exemplar trace
+  ids), the live ``device_mfu``/``device_membw_util`` gauges, and the
+  ``slo_burn_*`` family are ordinary registry entries, so a worker's
+  latency attribution reaches the fleet scrape — exemplars included —
+  through the existing struct merge (``utils.metrics.merge_structs``
+  keeps, per bucket, the worst exemplar it sees).
 
 The heartbeat link also carries the **control channel** (the rollout
 plane's fleet-convergence path, rollout/): the coordinator holds one

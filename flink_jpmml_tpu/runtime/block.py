@@ -27,6 +27,7 @@ import numpy as np
 
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.obs import attr as attr_mod
 from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.runtime.pipeline import (
@@ -279,9 +280,15 @@ class BlockPipelineBase:
         checkpoint,
         max_dispatch_chunks: int = 8,
         donate: Optional[bool] = None,
+        slo=None,
     ):
         self._source = source
         self._sink = sink
+        # optional obs/slo.SLOTracker: ticked from the completion path
+        # (between batches, on the score thread — the RolloutController
+        # piggyback pattern), so burn-rate state stays live without a
+        # thread of its own
+        self._slo = slo
         self._arity = arity
         self._batch_size = batch_size
         # >1 enables opportunistic multi-chunk dispatch on a backed-up
@@ -588,6 +595,8 @@ class BlockPipelineBase:
         # per-worker latency distributions exactly (utils/metrics.py)
         lat = self.metrics.histogram("batch_latency_s")
 
+        ledger = attr_mod.ledger_for(self.metrics)
+
         def _complete(pair, meta):
             """FIFO completion off the dispatcher: sink, then commit —
             offsets only advance past records that reached the sink."""
@@ -595,11 +604,16 @@ class BlockPipelineBase:
             n, first_off, t_start = meta
             t_sink = time.monotonic()
             self._emit(out, n, first_off, decode)
-            spans.emit("sink", t_sink, time.monotonic() - t_sink, n=n)
-            lat.observe(time.monotonic() - t_start)
+            t_done = time.monotonic()
+            spans.emit("sink", t_sink, t_done - t_sink, n=n)
+            if ledger is not None:
+                ledger.observe("sink", t_done - t_sink)
+            lat.observe(t_done - t_start)
             records_out.inc(n)
             self.committed_offset = first_off + n
             self._ckpt.maybe_save(self._ckpt_state)
+            if self._slo is not None:
+                self._slo.maybe_tick()
 
         # the overlapped in-flight window: batch N executes on device
         # while batch N+1 is drained, encoded, and staged here — the
@@ -661,6 +675,14 @@ class BlockPipelineBase:
                 disp.launch(
                     lambda h=handle, X=X, n=n: self._dispatch(h, X, n),
                     meta=(n, int(offsets[0]) if n else 0, t_start),
+                    # opts this launch into the sampled device-timing
+                    # pool (rate-limited; obs/profiler.py) — the live
+                    # MFU/membw gauges and the kernel cost ledger;
+                    # skipped entirely when profiling is off
+                    profile=(
+                        attr_mod.dispatch_profile(handle, n)
+                        if disp.profiling else None
+                    ),
                 )
                 batches.inc()
                 fill.inc(n)
@@ -698,6 +720,7 @@ class BlockPipeline(BlockPipelineBase):
         checkpoint=None,
         max_dispatch_chunks: int = 8,
         donate: Optional[bool] = None,
+        slo=None,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -716,6 +739,7 @@ class BlockPipeline(BlockPipelineBase):
             checkpoint=checkpoint,
             max_dispatch_chunks=max_dispatch_chunks,
             donate=donate,
+            slo=slo,
         )
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend

@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flink_jpmml_tpu.obs import attr
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.runtime.block import BlockSource
 from flink_jpmml_tpu.runtime.sources import Polled, Record, Source
@@ -612,6 +613,9 @@ class _KafkaSourceBase:
             metrics.histogram("kafka_fetch_s") if metrics is not None
             else None
         )
+        # resolved once, like _fetch_hist: the per-registry lookup is a
+        # lock + WeakKeyDictionary hit, too much for the per-fetch path
+        self._ledger = attr.ledger_for(metrics)
         self._lag_gauges: Dict[int, object] = {}
         self._topic = topic
         self._parts = (
@@ -675,7 +679,12 @@ class _KafkaSourceBase:
                        t0: float) -> None:
         if self._metrics is None:
             return
-        self._fetch_hist.observe(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._fetch_hist.observe(dt)
+        # the attribution plane's fetch column (obs/attr.py): kafka
+        # fetch RPC time per fetch, merged fleet-wide like every stage
+        if self._ledger is not None:
+            self._ledger.observe("fetch", dt)
         g = self._lag_gauges.get(part)
         if g is None:
             g = self._metrics.gauge(f'kafka_lag{{partition="{part}"}}')
@@ -983,7 +992,10 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         try:
             return decode_record_batches_rows(raw, self._cols)
         finally:
-            self._decode_s.inc(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self._decode_s.inc(dt)
+            if self._ledger is not None:
+                self._ledger.observe("decode", dt)
 
     def _poll_multi(self) -> Optional[Tuple[int, np.ndarray]]:
         """Strict round-robin interleave, vectorized: global index

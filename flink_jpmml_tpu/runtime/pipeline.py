@@ -62,6 +62,8 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from flink_jpmml_tpu.obs import attr as attr_mod
+from flink_jpmml_tpu.obs import profiler as prof_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
@@ -215,16 +217,23 @@ def dispatch_quantized(
         predict = q.predict_padded
     t1 = time.monotonic()
     spans.emit("featurize", t0, t1 - t0, fused=fused)
+    # per-batch stage attribution (obs/attr.py): the same registry's
+    # stage_seconds{stage=...} histograms merge fleet-wide like every
+    # other metric; encode covers featurize+align, h2d the host-side
+    # staging + async dispatch issue
+    ledger = attr_mod.ledger_for(metrics)
     if enc is not None:
         enc.inc(t1 - t0)
+    if ledger is not None:
+        ledger.observe("encode", t1 - t0)
     if h2d is not None:
         h2d.inc(payload.nbytes)
     if not donate:
         out = predict(payload, K)  # async dispatch
-        spans.emit(
-            "h2d_dispatch", t1, time.monotonic() - t1,
-            bytes=payload.nbytes,
-        )
+        t2 = time.monotonic()
+        spans.emit("h2d_dispatch", t1, t2 - t1, bytes=payload.nbytes)
+        if ledger is not None:
+            ledger.observe("h2d", t2 - t1)
         return out
     import jax
 
@@ -232,9 +241,10 @@ def dispatch_quantized(
         filter_donate_warning(rf"float32\[\d+,{payload.shape[1]}\]")
     staged = jax.device_put(payload)  # async H2D staging copy
     out = predict(staged, K, donate=True)
-    spans.emit(
-        "h2d_dispatch", t1, time.monotonic() - t1, bytes=payload.nbytes
-    )
+    t2 = time.monotonic()
+    spans.emit("h2d_dispatch", t1, t2 - t1, bytes=payload.nbytes)
+    if ledger is not None:
+        ledger.observe("h2d", t2 - t1)
     deleted = getattr(staged, "is_deleted", None)
     if deleted is not None and deleted() and donation_hits is not None:
         donation_hits.inc()
@@ -273,6 +283,7 @@ class OverlappedDispatcher:
         depth: Optional[int] = 2,
         metrics: Optional[MetricsRegistry] = None,
         complete: Optional[Callable[[Any, Any], None]] = None,
+        profiler: Optional["prof_mod.DeviceProfiler"] = None,
     ):
         # depth = dispatches allowed to REMAIN in flight after launch
         # returns; 0 = synchronous (each launch finishes its own batch —
@@ -289,8 +300,26 @@ class OverlappedDispatcher:
         self._stall = self.metrics.counter("h2d_stall_s")
         self._dispatches = self.metrics.counter("dispatches")
         self._gauge = self.metrics.gauge("inflight_depth")
+        # attribution + sampled device profiling (obs/attr.py,
+        # obs/profiler.py): the per-registry singletons, so every path
+        # sharing this registry lands in one stage ledger / one set of
+        # live roofline gauges
+        self._ledger = attr_mod.ledger_for(self.metrics)
+        self._profiler = (
+            profiler if profiler is not None
+            else prof_mod.profiler_for(self.metrics)
+        )
 
     # -- introspection -----------------------------------------------------
+
+    @property
+    def profiling(self) -> bool:
+        """True when launches should build a dispatch profile — a
+        sampled device profiler is attached and not disabled, so call
+        sites can skip the per-launch profile build entirely when
+        FJT_PROF_SAMPLE is off."""
+        p = self._profiler
+        return p is not None and p.enabled
 
     def __len__(self) -> int:
         return len(self._window)
@@ -309,33 +338,101 @@ class OverlappedDispatcher:
         self,
         dispatch_fn: Callable[[], Any],
         meta: Any = None,
+        profile: Optional[dict] = None,
     ) -> _InFlight:
         """Dispatch asynchronously and admit the result to the window.
 
         ``dispatch_fn()`` must *dispatch* device work and return without
         blocking on it (the JAX async-dispatch contract).  If admitting
         the new entry overflows ``depth``, the oldest entry is finished
-        first — the only place a healthy steady state ever blocks.
+        first — the only place a healthy steady state ever blocks; the
+        ledger books that wait as ``queue_wait`` (a ready batch waiting
+        for a window slot) rather than ``readback``.
+
+        ``profile`` (see :func:`obs.attr.dispatch_profile`) opts this
+        launch into the sampled device-timing pool: when the profiler's
+        rate limiter fires, the window is drained and the *post-dispatch*
+        wait is bracketed with ``block_until_ready`` — dispatch_fn's own
+        host time (featurize/staging) is excluded, so the delta is pure
+        device execution, feeding the live
+        ``device_mfu``/``device_membw_util`` gauges and the kernel cost
+        ledger. Unsampled launches pay one predicate check.
         """
         if self._closed:
             raise DispatcherClosed("launch() on a closed dispatcher")
-        out = dispatch_fn()
+        prof = self._profiler
+        sampling = (
+            prof is not None
+            and profile is not None
+            and prof.should_sample()
+        )
+        if sampling:
+            t_pre = time.monotonic()
+            # drain so the bracket times THIS dispatch, not the tail of
+            # whatever the device was already running (entries stay in
+            # the window: FIFO completion/callbacks are untouched)
+            try:
+                for h in self._window:
+                    _block_ready(h.out)
+            except Exception:
+                # a poisoned in-flight batch: its error belongs to
+                # finish_oldest (right meta, right caller) — this
+                # launch just forfeits its sample
+                sampling = False
+        if sampling:
+            t_drained = time.monotonic()
+            out = dispatch_fn()
+            # bracket only the post-dispatch wait: dispatch_fn's host
+            # work (featurize/staging on the host-encode path) happens
+            # BEFORE the device kernel is queued, so folding it in
+            # would book host time as device time — inflating
+            # device_ns_per_record, poisoning the kernel cost ledger,
+            # and double-booking the interval dispatch_quantized
+            # already attributed to encode/h2d
+            t_disp = time.monotonic()
+            try:
+                _block_ready(out)
+            except Exception:
+                pass  # the finish path re-raises with attribution
+            else:
+                t1 = time.monotonic()
+                # overhead = drain + bracket wait; dispatch_fn's own
+                # host time is work the caller pays regardless, so it
+                # must not eat the sampling budget
+                prof.record_sample(
+                    t1 - t_disp,
+                    profile,
+                    overhead_s=(t_drained - t_pre) + (t1 - t_disp),
+                )
+        else:
+            out = dispatch_fn()
         _prefetch_host(out)
         handle = _InFlight(out, meta, time.monotonic())
         self._window.append(handle)
         self._dispatches.inc()
         while self._depth is not None and len(self._window) > self._depth:
-            self.finish_oldest()
+            # depth 0 (the latency operating point) has no window for a
+            # ready batch to wait in: this wait is the host blocking on
+            # its OWN just-dispatched batch, i.e. readback — booking it
+            # as queue_wait would tell the operator "window too shallow"
+            # (and fire stage_stall events) on every batch of a normal
+            # synchronous pipeline
+            self.finish_oldest(
+                _stage="queue_wait" if self._depth > 0 else "readback"
+            )
         # gauge records post-enforcement depth: the window's steady
         # occupancy, not the transient overshoot inside this call
         self._gauge.set(len(self._window))
         return handle
 
-    def finish_oldest(self):
+    def finish_oldest(self, _stage: str = "readback"):
         """Finish (wait + complete-callback) the oldest in-flight entry.
 
         → ``(out, meta)`` or None when the window is empty.  Safe to
-        call from pipeline hooks while a batch is held."""
+        call from pipeline hooks while a batch is held. ``_stage`` is
+        the attribution bucket for the blocking wait — ``launch`` books
+        its overflow waits as ``queue_wait`` so one wall-clock interval
+        is never attributed to two stages."""
         if not self._window:
             return None
         handle = self._window[0]
@@ -350,12 +447,18 @@ class OverlappedDispatcher:
         finally:
             # stall time counts even when the wait raised: the host WAS
             # gated on the device for that long either way
-            self._stall.inc(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self._stall.inc(dt)
             # the in-flight window on the trace: how long the host sat
             # on the oldest dispatch, and how deep the window was
-            spans.emit(
-                "readback", t0, time.monotonic() - t0, inflight=depth
-            )
+            spans.emit("readback", t0, dt, inflight=depth)
+            if self._ledger is not None:
+                # ONLY the blocking wait is booked, and under the
+                # caller's stage — launch's overflow loop passes
+                # queue_wait, every other caller is a readback; the
+                # complete-callback below books its own time (sink),
+                # so one wall-clock interval never lands in two stages
+                self._ledger.observe(_stage, dt)
             # the entry leaves the window regardless — a poisoned batch
             # must not wedge every later flush
             self._window.popleft()
@@ -382,7 +485,10 @@ class OverlappedDispatcher:
                 handle.error = e
                 raise
             finally:
-                self._stall.inc(time.monotonic() - t0)
+                dt = time.monotonic() - t0
+                self._stall.inc(dt)
+                if self._ledger is not None:
+                    self._ledger.observe("readback", dt)
                 handle.done = True
         if handle.error is not None:
             raise handle.error

@@ -95,6 +95,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
         mesh=None,
         max_dispatch_chunks: int = 8,
         donate: Optional[bool] = None,
+        slo=None,
     ):
         if batch_size <= 0:
             raise InputValidationException(
@@ -120,6 +121,9 @@ class DynamicBlockPipeline(BlockPipelineBase):
             checkpoint=checkpoint,
             max_dispatch_chunks=max_dispatch_chunks,
             donate=donate,
+            # deadline SLO burn-rate tracking (obs/slo.py) rides the
+            # completion path here exactly as on the static pipeline
+            slo=slo,
         )
         self._control = control
         self._name = name

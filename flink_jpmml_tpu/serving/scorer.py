@@ -45,7 +45,9 @@ from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.models.control import RolloutMessage
 from flink_jpmml_tpu.models.core import ModelId
 from flink_jpmml_tpu.models.prediction import Prediction
+from flink_jpmml_tpu.obs import attr as attr_mod
 from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.obs.slo import SLOTracker
 from flink_jpmml_tpu.rollout import split as rsplit
 from flink_jpmml_tpu.rollout.controller import RolloutController
 from flink_jpmml_tpu.rollout.state import (
@@ -161,6 +163,10 @@ class DynamicScorer(Scorer):
             metrics=self.metrics,
             interval_s=rollout_interval_s,
         )
+        # deadline SLO burn-rate tracking over the submit→finish
+        # latency histogram, ticked from the batch loop like the
+        # rollout controller; inert without FJT_SLO_TARGET_MS
+        self.slo = SLOTracker(self.metrics, source="score_latency_s")
 
     def _drain_control(self) -> None:
         while True:
@@ -363,17 +369,26 @@ class DynamicScorer(Scorer):
         # with encode_s/h2d_bytes accounted into this scorer's
         # metrics registry.
         q = model.quantized_scorer()
+        n = len(payloads)
         if q is not None:
             handle = self._dispatcher.launch(
                 lambda q=q, X=X, M=M: dispatch_quantized(
                     q, X, M, metrics=self.metrics
-                )
+                ),
+                profile=(
+                    attr_mod.dispatch_profile(q, n)
+                    if self._dispatcher.profiling else None
+                ),
             )
             return handle, q
         if model.batch_size is not None:
             X, M, _ = prepare.pad_batch(X, M, model.batch_size)
         handle = self._dispatcher.launch(
-            lambda m=model, X=X, M=M: m.predict(X, M)
+            lambda m=model, X=X, M=M: m.predict(X, M),
+            profile=(
+                attr_mod.dispatch_profile(model, n)
+                if self._dispatcher.profiling else None
+            ),
         )
         return handle, model
 
@@ -417,6 +432,7 @@ class DynamicScorer(Scorer):
             preds[i] = Prediction.empty()
         if tickets:  # an all-unserved batch scored nothing: no sample
             self._lat.observe(time.monotonic() - t_submit)
+        self.slo.maybe_tick()  # burn-rate state rides the batch loop
         if self._emit is not None:
             return self._emit(records, preds)
         if self._emit_pairs:

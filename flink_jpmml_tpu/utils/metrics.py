@@ -122,6 +122,12 @@ class Histogram:
         self._sum = 0.0
         self._n = 0
         self._max = 0.0
+        # bucket idx -> (trace_id, value, unix_ts): the exemplar link
+        # from a scrape's tail bucket back to the flight-recorder event
+        # / span file that explains it (obs/attr.py decides WHICH
+        # observations deserve one — the per-observe cost with none
+        # attached is a single None check)
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
     @property
@@ -132,7 +138,12 @@ class Histogram:
     def layout(self) -> Tuple[float, float, int]:
         return self._layout
 
-    def observe(self, v: float) -> None:
+    def bucket_index(self, v: float) -> int:
+        """The bucket an observation of ``v`` lands in (edges are
+        immutable, so no lock; the overflow bucket is len(edges))."""
+        return bisect.bisect_left(self._edges, v)
+
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         idx = bisect.bisect_left(self._edges, v)
         with self._lock:
             self._counts[idx] += 1
@@ -140,6 +151,17 @@ class Histogram:
             self._n += 1
             if v > self._max:
                 self._max = v
+            if exemplar is not None:
+                # worst-per-bucket, matching merge(): a later smaller
+                # same-bucket capture must not displace the worst
+                # offender's trace link (>= so an equal fresher one wins)
+                have = self._exemplars.get(idx)
+                if have is None or v >= have[1]:
+                    self._exemplars[idx] = (exemplar, v, time.time())
+
+    def exemplars(self) -> Dict[int, Tuple[str, float, float]]:
+        with self._lock:
+            return dict(self._exemplars)
 
     def count(self) -> int:
         with self._lock:
@@ -173,6 +195,7 @@ class Histogram:
         with other._lock:
             counts = list(other._counts)
             s, n, mx = other._sum, other._n, other._max
+            exemplars = dict(other._exemplars)
         with self._lock:
             for i, c in enumerate(counts):
                 self._counts[i] += c
@@ -180,6 +203,12 @@ class Histogram:
             self._n += n
             if mx > self._max:
                 self._max = mx
+            # per bucket keep the worse (larger-value) exemplar: the
+            # fleet view should link to the worst offender it knows of
+            for i, ex in exemplars.items():
+                have = self._exemplars.get(i)
+                if have is None or ex[1] > have[1]:
+                    self._exemplars[i] = ex
         return self
 
     # -- wire format (heartbeat piggyback / BENCH varz / fleet merge) ------
@@ -187,7 +216,7 @@ class Histogram:
     def state(self) -> dict:
         """Compact JSON-shaped state: sparse non-zero buckets only."""
         with self._lock:
-            return {
+            out = {
                 "layout": list(self._layout),
                 "counts": {
                     str(i): c for i, c in enumerate(self._counts) if c
@@ -196,6 +225,11 @@ class Histogram:
                 "n": self._n,
                 "max": self._max,
             }
+            if self._exemplars:
+                out["exemplars"] = {
+                    str(i): list(ex) for i, ex in self._exemplars.items()
+                }
+            return out
 
     @classmethod
     def from_state(cls, state: dict) -> "Histogram":
@@ -206,6 +240,13 @@ class Histogram:
         h._sum = float(state.get("sum", 0.0))
         h._n = int(state.get("n", 0))
         h._max = float(state.get("max", 0.0))
+        for i, ex in (state.get("exemplars") or {}).items():
+            try:
+                h._exemplars[int(i)] = (
+                    str(ex[0]), float(ex[1]), float(ex[2])
+                )
+            except (IndexError, TypeError, ValueError):
+                continue  # a malformed exemplar never poisons the state
         return h
 
 
@@ -324,11 +365,35 @@ class MetricsRegistry:
         }
 
 
+#: Gauge families whose fleet merge is NOT a sum. The default gauge
+#: merge adds values (fleet totals: in-flight depth across workers is a
+#: sum), which is arithmetic nonsense for ratios and booleans — two
+#: workers at 5.8% MFU are not an 11.6% fleet, and one breached worker
+#: among three must not render slo_ok=2 (truthy). Ratio/occupancy
+#: gauges take the max (the worst/busiest worker the fleet knows of);
+#: ``slo_ok`` takes the min (the fleet is breached if ANY worker is).
+_GAUGE_MERGE_MAX_PREFIXES = (
+    "device_mfu", "device_membw_util", "device_ns_per_record",
+    "flops_per_record", "slo_burn_rate",
+)
+_GAUGE_MERGE_MIN = ("slo_ok",)
+
+
+def _gauge_merge_mode(name: str) -> str:
+    if name in _GAUGE_MERGE_MIN:
+        return "min"
+    if name.startswith(_GAUGE_MERGE_MAX_PREFIXES):
+        return "max"
+    return "sum"
+
+
 def merge_structs(structs: Iterable[dict]) -> dict:
     """Merge :meth:`MetricsRegistry.struct_snapshot` dicts into one fleet
     view: counters add, gauge values add (fleet totals: in-flight depth
-    across workers is a sum) with the max-of-maxes high-water, histogram
-    buckets add — the merge whose quantiles are exact.
+    across workers is a sum — except the ratio/boolean families in
+    ``_GAUGE_MERGE_MAX_PREFIXES``/``_GAUGE_MERGE_MIN``, which take the
+    worst value) with the max-of-maxes high-water, histogram buckets
+    add — the merge whose quantiles are exact.
 
     Entries that don't merge are SKIPPED, never raised: the inputs are
     heartbeat-piggybacked snapshots from remote workers (the coordinator
@@ -360,9 +425,20 @@ def merge_structs(structs: Iterable[dict]) -> dict:
                 mx = float(g.get("max", 0.0))
             except (AttributeError, TypeError, ValueError):
                 continue
-            agg = out["gauges"].setdefault(n, {"value": 0.0, "max": 0.0})
-            agg["value"] += value
-            agg["max"] = max(agg["max"], mx)
+            mode = _gauge_merge_mode(n)
+            agg = out["gauges"].get(n)
+            if agg is None:
+                # min/max modes must seed from the first REAL value —
+                # a 0.0 identity would pin min() at zero forever
+                out["gauges"][n] = {"value": value, "max": mx}
+            else:
+                if mode == "sum":
+                    agg["value"] += value
+                elif mode == "max":
+                    agg["value"] = max(agg["value"], value)
+                else:
+                    agg["value"] = min(agg["value"], value)
+                agg["max"] = max(agg["max"], mx)
         for n, hstate in _items(s.get("histograms")):
             try:
                 h = Histogram.from_state(hstate)

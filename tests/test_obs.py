@@ -239,6 +239,53 @@ class TestPrometheusText:
         )
         assert got == expected
 
+    def test_exemplar_exposition_golden(self):
+        """Pinned OpenMetrics exemplar syntax: the bucket line holding
+        an exemplar grows ` # {trace_id="..."} value unix_ts` — the
+        link a p99 scrape follows to the flight-recorder event. Only
+        the exemplar-bearing bucket carries one; the suffix must ride
+        through the struct wire form (state → from_state → render).
+        Exemplars are OpenMetrics-only: the classic 0.0.4 render of the
+        same struct must stay suffix-free (a stock Prometheus text
+        parser rejects a page with them)."""
+        m = MetricsRegistry()
+        h = m.histogram(
+            'stage_seconds{stage="sink"}',
+            lo=0.01, hi=1.0, buckets_per_decade=1,
+        )
+        h.observe(0.05)
+        h.observe(0.5, exemplar="abc-1")
+        s = m.struct_snapshot()
+        s["uptime_s"] = 1.0
+        # pin the exemplar's wall-clock stamp (the one nondeterministic
+        # field on the line)
+        s["histograms"]['stage_seconds{stage="sink"}']["exemplars"]["2"][2] = 99.5
+        got = prometheus_text({None: s}, openmetrics=True)
+        expected = (
+            "# TYPE fjt_stage_seconds histogram\n"
+            'fjt_stage_seconds_bucket{stage="sink",le="0.01"} 0\n'
+            'fjt_stage_seconds_bucket{stage="sink",le="0.1"} 1\n'
+            'fjt_stage_seconds_bucket{stage="sink",le="1"} 2'
+            ' # {trace_id="abc-1"} 0.5 99.5\n'
+            'fjt_stage_seconds_bucket{stage="sink",le="+Inf"} 2\n'
+            'fjt_stage_seconds_sum{stage="sink"} 0.55\n'
+            'fjt_stage_seconds_count{stage="sink"} 2\n'
+            "# TYPE fjt_uptime_s gauge\n"
+            "fjt_uptime_s 1\n"
+            "# EOF\n"
+        )
+        assert got == expected
+        classic = prometheus_text({None: s})
+        assert "trace_id" not in classic and "# EOF" not in classic
+        # classic counters keep their type; OpenMetrics declares them
+        # unknown (same sample names — _total would rename the series)
+        m2 = MetricsRegistry()
+        m2.counter("records_out").inc(3)
+        assert "# TYPE fjt_records_out counter" in prometheus_text({None: m2})
+        om = prometheus_text({None: m2}, openmetrics=True)
+        assert "# TYPE fjt_records_out unknown" in om
+        assert "fjt_records_out 3\n" in om and om.endswith("# EOF\n")
+
     def test_worker_labels_and_unlabeled_aggregate(self):
         agg, w0 = MetricsRegistry(), MetricsRegistry()
         agg.counter("records_out").inc(15)
@@ -386,6 +433,7 @@ class TestSpans:
         spans.emit("featurize", 1.0, 0.5, n=64)
         w = spans.writer()
         assert w is not None and os.path.dirname(w.path) == str(tmp_path)
+        spans.flush()  # the writer buffers now; make the event visible
         raw = open(w.path, encoding="utf-8").read()
         # JSON Array Format, truncated-array tolerant: strip the
         # trailing comma and close it ourselves, like the loaders do
@@ -565,7 +613,14 @@ class TestFleetMetricsDrill:
             varz = json.loads(body_)
             assert set(varz) == {"", "w0", "w1"}
             merged_local = merge_structs([varz["w0"], varz["w1"]])
-            assert varz[""] == merged_local
+            # the aggregate also folds in the supervisor's own (empty
+            # here) registry, whose uptime_s exceeds the young workers'
+            # — uptime is nondeterministic either way, so compare
+            # everything but it
+            agg = dict(varz[""])
+            agg.pop("uptime_s", None)
+            merged_local.pop("uptime_s", None)
+            assert agg == merged_local
 
             # the aggregated histogram's quantiles equal the merge of
             # the individual worker registries' histograms — exactly
