@@ -77,6 +77,7 @@ import collections
 import json
 import os
 import re
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -144,6 +145,7 @@ def _child_cmd(args, force_cpu: bool) -> list:
         "--latency-batch", str(args.latency_batch),
         "--latency-deadline-us", str(args.latency_deadline_us),
         "--latency-offered", str(args.latency_offered),
+        "--load-shape", args.load_shape,
     ]
     for flag, on in (
         ("--f32-wire", args.f32_wire),
@@ -332,6 +334,8 @@ def _orchestrate(args) -> None:
     # healthy attempt) + 3 windows + device-resident + latency mode +
     # kafka mode (one-time producer encode dominates) + pinned interp
     measure_budget = 150.0 + 5.0 * args.seconds + 210.0
+    if _parse_load_shape(args.load_shape):
+        measure_budget += 45.0  # the burst drill's phases + drain window
     cpu_reserve = 180.0 + 4.0 * args.seconds  # always keep room for fallback
     errors = []
     healthy = None
@@ -896,6 +900,9 @@ def run_rollout_drill(
     ctrl.push(RolloutMessage("drill", 3, "rollback", time.time()))
     sc._drain_control()
 
+    # success path only: a FAILED drill's assertion leaves the generated
+    # models on disk for inspection
+    shutil.rmtree(tmp, ignore_errors=True)
     return {
         "metric": "rollout_drill",
         "ok": True,
@@ -907,6 +914,365 @@ def run_rollout_drill(
         "sink_leakage": 0,
         "elapsed_s": round(time.monotonic() - t0, 3),
     }
+
+
+def _parse_load_shape(spec: str) -> float:
+    """``--load-shape`` → burst factor (0.0 = steady). Accepted:
+    ``steady``, ``burst:2x``, ``burst:2`` (any float factor > 1)."""
+    s = (spec or "steady").strip().lower()
+    if s in ("", "steady"):
+        return 0.0
+    if s.startswith("burst:"):
+        raw = s[len("burst:"):].rstrip("x")
+        try:
+            f = float(raw)
+        except ValueError:
+            raise SystemExit(f"bad --load-shape {spec!r}")
+        if f <= 1.0:
+            raise SystemExit(
+                f"--load-shape burst factor must be > 1, got {spec!r}"
+            )
+        return f
+    raise SystemExit(
+        f"bad --load-shape {spec!r} (want steady | burst:<factor>x)"
+    )
+
+
+def run_burst_drill(
+    base_rate: float = 8_000.0,
+    burst_factor: float = 2.0,
+    steady_s: float = 2.0,
+    burst_s: float = 3.5,
+    drain_timeout_s: float = 25.0,
+    batch: int = 512,
+    trees: int = 10,
+    depth: int = 3,
+    features: int = 4,
+    capacity_frac: float = 0.7,
+    scrape: bool = False,
+) -> dict:
+    """``--load-shape burst:2x``: the kafka burst-recovery drill
+    (ROADMAP item 3's "per-partition lag gauges proving drain under 2×
+    bursts"), also the perf-smoke freshness tripwire's engine.
+
+    A paced producer appends timestamped rows to a real
+    ``MiniKafkaBroker`` at ``base_rate``, bursts to ``base_rate ×
+    burst_factor`` for ``burst_s``, then returns to base while the
+    backlog drains. The consumer is the production ``BlockPipeline``
+    over a ``KafkaBlockSource`` whose sink is *deadline-paced* to a
+    capacity BETWEEN base and burst (``capacity_frac × burst``) — so
+    lag provably builds under the burst and provably drains after,
+    independent of host speed (the pacer absorbs scheduling spikes by
+    catch-up instead of accumulating them).
+
+    Asserted (→ ``ok`` + per-check fields):
+
+    - the event-time ``watermark_lag_s`` peaks under the burst and
+      returns below ``recover_threshold`` (2× the steady baseline)
+      within ``drain_timeout_s`` of the burst ending;
+    - ``pressure`` reaches ≥ 0.5 under the burst and decays below it
+      after recovery;
+    - ``lag_drain_eta_s`` reports a FINITE positive ETA at some point
+      during the drain (and the burst itself drives the divergence
+      signal).
+
+    ``scrape=True`` additionally serves the live registry over a real
+    ``ObsServer`` and captures a ``/metrics`` page mid-drain (the
+    perf-smoke acceptance surface). → the drill's JSON line, with the
+    registry's ``varz`` struct embedded like every bench mode."""
+    import threading
+
+    import numpy as np
+
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime.block import BlockPipeline
+    from flink_jpmml_tpu.runtime.kafka import (
+        KafkaBlockSource, MiniKafkaBroker,
+    )
+    from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+    t0 = time.monotonic()
+    burst_rate = base_rate * burst_factor
+    cap_target = capacity_frac * burst_rate
+    assert base_rate < cap_target < burst_rate, (
+        "drill geometry requires base < capacity < burst "
+        f"({base_rate} / {cap_target} / {burst_rate})"
+    )
+    # short forecaster window so drain-ETA estimates turn over within
+    # the drill's seconds-scale phases (restored on exit)
+    prev_win = os.environ.get("FJT_LAG_WINDOW_S")
+    os.environ["FJT_LAG_WINDOW_S"] = "2.0"
+    broker = srv = None
+    pipe = src = prod = None
+    tmp = None
+    stop_producer = threading.Event()
+    try:
+        tmp = tempfile.mkdtemp(prefix="fjt-burst-")
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=trees, depth=depth, n_features=features)
+        )
+        cm = compile_pmml(doc, batch_size=batch)
+        rng = np.random.default_rng(11)
+        pool = rng.normal(0.0, 1.5, size=(4096, features)).astype(
+            np.float32
+        )
+
+        broker = MiniKafkaBroker(topic="burst")
+        km = MetricsRegistry()
+        src = KafkaBlockSource(
+            broker.host, broker.port, "burst",
+            n_cols=features, max_wait_ms=20, metrics=km,
+            # fetch.max.bytes analogue, ~one batch per fetch RPC: an
+            # unbounded fetch would teleport the whole broker backlog
+            # into one blocked ring push and the lag signals the drill
+            # measures (kafka_lag, fetch-time watermark age) would
+            # never see it
+            max_bytes=24 * 1024,
+        )
+
+        scored = [0]
+        next_free = [0.0]
+
+        def sink(out, n, first_off):
+            np.asarray(
+                out.value if hasattr(out, "value")
+                else out[0] if isinstance(out, tuple) else out
+            )
+            scored[0] += n
+            # deadline pacer: the schedule advances n/cap per batch and
+            # sleeps only when AHEAD of it, so transient host-scheduling
+            # spikes are absorbed by catch-up instead of eroding the
+            # drill's capacity floor. The credit is deliberately SHORT
+            # (50 ms): a starved steady phase must not bank enough
+            # schedule slack to swallow the burst surplus unthrottled
+            t = time.monotonic()
+            next_free[0] = max(next_free[0], t - 0.05) + n / cap_target
+            wait = next_free[0] - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+
+        pipe = BlockPipeline(
+            src, cm, sink,
+            RuntimeConfig(batch=BatchConfig(
+                size=batch, deadline_us=5000,
+                # a small ring so producer backlog is VISIBLE as ring
+                # occupancy (the pressure score's producer-side input)
+                queue_capacity=2 * batch,
+            )),
+            metrics=km,
+            # tight-buffer topology, deliberately: a deep in-flight
+            # window + multi-chunk aggregation would swallow the whole
+            # burst into host memory and the BROKER-side lag the drill
+            # exists to exercise (kafka_lag, fetch-time watermark lag)
+            # would never build — backpressure must reach the source
+            in_flight=1,
+            max_dispatch_chunks=1,
+        )
+        q = cm.quantized_scorer()
+        if q is not None:
+            import jax
+
+            jax.block_until_ready(
+                q.predict_wire(q.wire.encode(pool[:batch]))
+            )
+        else:
+            cm.warmup()
+
+        produced = [0]
+        rate_now = [base_rate]
+
+        def produce():
+            CHUNK = 256
+            nxt = time.monotonic()
+            pos = 0
+            while not stop_producer.is_set():
+                nxt = max(nxt, time.monotonic() - 0.5) + CHUNK / rate_now[0]
+                wait = nxt - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
+                    if stop_producer.is_set():
+                        return
+                start = (pos * CHUNK) % (pool.shape[0] - CHUNK)
+                broker.append_rows(
+                    pool[start : start + CHUNK],
+                    timestamp_ms=int(time.time() * 1000),
+                )
+                produced[0] += CHUNK
+                pos += 1
+
+        samples = []
+
+        def sample(tag: str) -> dict:
+            g = km.struct_snapshot()["gauges"]
+
+            def gv(name):
+                v = g.get(name)
+                return v.get("value") if isinstance(v, dict) else None
+
+            s = {
+                "t": round(time.monotonic() - t0, 3),
+                "tag": tag,
+                "wm_lag": gv('watermark_lag_s{partition="0"}'),
+                "pressure": gv("pressure"),
+                "eta": gv("lag_drain_eta_s"),
+                "diverging": gv("lag_diverging"),
+                "kafka_lag": gv('kafka_lag{partition="0"}'),
+            }
+            samples.append(s)
+            return s
+
+        def run_phase(seconds: float, tag: str) -> None:
+            end = time.monotonic() + seconds
+            while time.monotonic() < end:
+                sample(tag)
+                time.sleep(0.1)
+
+        if scrape:
+            from flink_jpmml_tpu.obs.server import ObsServer
+
+            srv = ObsServer.for_registry(km)
+        prod = threading.Thread(target=produce, daemon=True)
+        pipe.start()
+        prod.start()
+
+        run_phase(steady_s, "steady")
+        base_lags = [
+            s["wm_lag"] for s in samples[-8:] if s["wm_lag"] is not None
+        ]
+        baseline = (
+            sorted(base_lags)[len(base_lags) // 2] if base_lags else 0.2
+        )
+        recover_threshold = max(2.0 * baseline, 0.4)
+
+        rate_now[0] = burst_rate
+        run_phase(burst_s, "burst")
+        rate_now[0] = base_rate
+        t_drain0 = time.monotonic()
+        recovery_s = None
+        metrics_text = None
+        while time.monotonic() - t_drain0 < drain_timeout_s:
+            s = sample("drain")
+            if (
+                scrape and metrics_text is None
+                and time.monotonic() - t_drain0 > 0.3
+            ):
+                import urllib.request
+
+                with urllib.request.urlopen(
+                    srv.url + "/metrics", timeout=10
+                ) as r:
+                    metrics_text = r.read().decode()
+            if (
+                s["wm_lag"] is not None
+                and s["wm_lag"] <= recover_threshold
+                and (s["kafka_lag"] or 0) <= batch
+            ):
+                recovery_s = round(time.monotonic() - t_drain0, 3)
+                break
+            time.sleep(0.1)
+        if scrape and metrics_text is None:
+            # an instant recovery never reached the mid-drain capture
+            import urllib.request
+
+            with urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10
+            ) as r:
+                metrics_text = r.read().decode()
+        run_phase(2.5, "post")  # settle: pressure must decay too
+
+        stop_producer.set()
+        prod.join(timeout=5.0)
+        pipe.stop()
+        pipe.join(timeout=15.0)
+
+        burst_drain = [
+            s for s in samples if s["tag"] in ("burst", "drain")
+        ]
+        peak_wm = max(
+            (s["wm_lag"] for s in burst_drain
+             if s["wm_lag"] is not None),
+            default=0.0,
+        )
+        peak_pressure = max(
+            (s["pressure"] for s in burst_drain
+             if s["pressure"] is not None),
+            default=0.0,
+        )
+        post = sorted(
+            s["pressure"] for s in samples[-6:]
+            if s["pressure"] is not None
+        )
+        post_pressure = post[len(post) // 2] if post else 0.0
+        finite_eta = [
+            s["eta"] for s in samples if s["tag"] == "drain"
+            and s["eta"] and s["eta"] > 0 and not s["diverging"]
+            and (s["kafka_lag"] or 0) > 0
+        ]
+        checks = {
+            "recovered": recovery_s is not None,
+            "lag_built": peak_wm > 1.5 * recover_threshold,
+            "pressure_peaked": peak_pressure >= 0.5,
+            "pressure_decayed": post_pressure < 0.5,
+            "eta_finite_during_drain": bool(finite_eta),
+        }
+        return {
+            "metric": "burst_drill",
+            "ok": all(checks.values()),
+            "checks": checks,
+            "load_shape": f"burst:{burst_factor:g}x",
+            "base_rate": base_rate,
+            "burst_rate": burst_rate,
+            "capacity_target": cap_target,
+            "baseline_wm_lag_s": round(baseline, 3),
+            "recover_threshold_s": round(recover_threshold, 3),
+            "peak_wm_lag_s": round(peak_wm, 3),
+            "recovery_s": recovery_s,
+            "peak_pressure": round(peak_pressure, 3),
+            "post_pressure": round(post_pressure, 3),
+            "drain_eta_s": (
+                round(sorted(finite_eta)[len(finite_eta) // 2], 3)
+                if finite_eta else None
+            ),
+            "records_produced": produced[0],
+            "records_scored": scored[0],
+            "elapsed_s": round(time.monotonic() - t0, 3),
+            # the per-phase timeseries (one row per ~0.1 s): a failed
+            # CI drill is debuggable from the artifact alone — when and
+            # why lag/pressure misbehaved, not just that a check is
+            # false
+            "samples": samples,
+            "metrics_scrape": metrics_text,
+            # the scrape-format struct, like every bench mode: the
+            # freshness gauges/staleness histogram land in the artifact
+            "varz": km.struct_snapshot(),
+        }
+    finally:
+        stop_producer.set()
+        if prev_win is None:
+            os.environ.pop("FJT_LAG_WINDOW_S", None)
+        else:
+            os.environ["FJT_LAG_WINDOW_S"] = prev_win
+        if pipe is not None and pipe._threads:
+            try:  # also covers the raised-mid-drill path
+                pipe.stop()
+                pipe.join(timeout=10.0)
+            except Exception:
+                pass
+        for closer in (
+            (lambda: src.close()) if src is not None else None,
+            (lambda: broker.close()) if broker is not None else None,
+            (lambda: srv.close()) if srv is not None else None,
+        ):
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    pass
+        if tmp is not None:  # the generated model: every CI run leaks
+            shutil.rmtree(tmp, ignore_errors=True)  # a dir otherwise
 
 
 def _latency_headline(line: dict, trees: int, backend: str) -> dict:
@@ -973,6 +1339,12 @@ def main() -> None:
     ap.add_argument("--latency-deadline-us", type=int, default=2000)
     ap.add_argument("--latency-offered", type=float, default=100_000.0,
                     help="paced offered load (rec/s) for the latency mode")
+    ap.add_argument("--load-shape", default="steady",
+                    help="steady (default) or burst:<factor>x — the "
+                         "latter appends the kafka burst-recovery "
+                         "drill (watermark catch-up, drain ETA, "
+                         "pressure decay) to the artifact as "
+                         "burst_drill")
     ap.add_argument("--in-child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--force-cpu", action="store_true",
@@ -990,6 +1362,7 @@ def main() -> None:
     ap.add_argument("--rollout-fraction", type=float, default=0.2,
                     help="canary traffic share the drill asserts")
     args = ap.parse_args()
+    burst_factor = _parse_load_shape(args.load_shape)  # validate early
 
     if args.rollout_drill:
         # correctness drill, not a perf capture: runs in-process (no
@@ -1250,6 +1623,12 @@ def main() -> None:
                 cm, pool_f32[0], args, use_quantized=not args.f32_wire
             )
             stage("kafka mode done")
+        if burst_factor:
+            stage(f"burst drill: {burst_factor:g}x load shape")
+            line["burst_drill"] = run_burst_drill(
+                burst_factor=burst_factor
+            )
+            stage("burst drill done")
         if args.latency:
             line = _latency_headline(line, args.trees, line["backend"])
         print(json.dumps(line))
@@ -1509,6 +1888,10 @@ def main() -> None:
             cm, pool_f32[0], args, use_quantized=not args.f32_wire
         )
         stage("kafka mode done")
+    if burst_factor:
+        stage(f"burst drill: {burst_factor:g}x load shape")
+        line["burst_drill"] = run_burst_drill(burst_factor=burst_factor)
+        stage("burst drill done")
     if args.latency:
         line = _latency_headline(line, args.trees, backend)
     print(json.dumps(line))

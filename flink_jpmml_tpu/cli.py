@@ -398,6 +398,111 @@ def _top_render(label: str, struct: dict, out) -> None:
             )
 
 
+def _top_render_freshness(label: str, struct: dict, out) -> None:
+    """The ``--freshness`` panel: event-time watermark lag and kafka
+    lag per partition (with observation age), record staleness
+    quantiles, drain forecast, and the composite pressure score —
+    obs/freshness.py + obs/pressure.py rendered as one operator view."""
+    import re as _re
+
+    from flink_jpmml_tpu.utils.metrics import Histogram
+
+    title = label or "aggregate"
+    print(f"== {title} · freshness ==", file=out)
+    gauges = struct.get("gauges") or {}
+    counters = struct.get("counters") or {}
+
+    def g(name):
+        v = gauges.get(name)
+        return v.get("value") if isinstance(v, dict) else None
+
+    rendered = False
+    p = g("pressure")
+    if p is not None:
+        rendered = True
+        comps = "  ".join(
+            f"{k.split('_', 1)[1]} {g(k):.2f}"
+            for k in ("pressure_ring", "pressure_window", "pressure_wait")
+            if g(k) is not None
+        )
+        breaches = counters.get("pressure_breaches", 0)
+        print(
+            f"pressure {p:5.2f}   [{comps}]   breaches {breaches:.0f}",
+            file=out,
+        )
+    eta, trend = g("lag_drain_eta_s"), g("lag_trend")
+    if eta is not None or trend is not None:
+        rendered = True
+        diverging = bool(g("lag_diverging"))
+        eta_s = (
+            "DIVERGING" if diverging
+            else ("-" if eta is None else f"{eta:,.1f}s")
+        )
+        print(
+            f"drain    eta {eta_s}   trend "
+            f"{trend if trend is not None else 0:+,.1f} rec/s "
+            "(+ = falling behind)",
+            file=out,
+        )
+    hstate = (struct.get("histograms") or {}).get("record_staleness_s")
+    if isinstance(hstate, dict):
+        try:
+            h = Histogram.from_state(hstate)
+            if h.count():
+                rendered = True
+                print(
+                    f"stale    p50 {1000.0 * (h.quantile(0.5) or 0):,.1f} ms"
+                    f"   p99 {1000.0 * (h.quantile(0.99) or 0):,.1f} ms"
+                    f"   n {h.count()}",
+                    file=out,
+                )
+        except (KeyError, TypeError, ValueError):
+            pass
+    wm = g("watermark_ts")
+    if wm is not None:
+        rendered = True
+        import datetime
+
+        ts = datetime.datetime.fromtimestamp(
+            wm, datetime.timezone.utc
+        ).strftime("%H:%M:%S.%f")[:-3]
+        print(f"watermark sink low-watermark {ts}Z", file=out)
+    # per-partition table, keyed across the three labelled families
+    pat = _re.compile(
+        r'^(watermark_lag_s|kafka_lag|kafka_lag_age_s)'
+        r'\{partition="([^"]+)"\}$'
+    )
+    parts: Dict[str, Dict[str, float]] = {}
+    for name, v in gauges.items():
+        m = pat.match(name)
+        if m and isinstance(v, dict):
+            parts.setdefault(m.group(2), {})[m.group(1)] = v["value"]
+    if parts:
+        rendered = True
+        print(
+            f"{'partition':<12}{'wm lag s':>10}{'kafka lag':>12}"
+            f"{'obs age s':>11}",
+            file=out,
+        )
+        for part in sorted(parts):
+            row = parts[part]
+
+            def cell(key, fmt):
+                v = row.get(key)
+                return "-" if v is None else format(v, fmt)
+
+            print(
+                f"{part:<12}{cell('watermark_lag_s', '.3f'):>10}"
+                f"{cell('kafka_lag', ',.0f'):>12}"
+                f"{cell('kafka_lag_age_s', '.1f'):>11}",
+                file=out,
+            )
+    if not rendered:
+        # nothing above actually printed (an eagerly-registered but
+        # empty staleness histogram is not telemetry)
+        print("(no freshness telemetry recorded)", file=out)
+
+
 def top_main(argv: Optional[List[str]] = None) -> int:
     """``fjt-top``: the fleet attribution table (see module docstring).
     Renders every labelled source (the supervisor's /varz serves the
@@ -415,22 +520,63 @@ def top_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--worker", default=None,
                     help="render only this source label "
                          "(default: all, aggregate first)")
+    ap.add_argument("--freshness", action="store_true",
+                    help="render the freshness/backpressure panel "
+                         "(event-time watermark lag, staleness, drain "
+                         "forecast, pressure) instead of the stage table")
+    ap.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="re-render every N seconds from a live source "
+                         "(operator console mode; mid-watch fetch "
+                         "failures retry instead of exiting)")
     args = ap.parse_args(argv)
-    sources = _top_load(args.source)
-    if args.worker is not None:
-        if args.worker not in sources:
-            raise SystemExit(
-                f"no source {args.worker!r}; have "
-                f"{sorted(sources)}"
-            )
-        sources = {args.worker: sources[args.worker]}
-    first = True
-    for label in sorted(sources, key=lambda k: (k != "", k)):
-        if not first:
-            print(file=sys.stdout)
-        _top_render(label, sources[label], sys.stdout)
-        first = False
-    return 0
+    if args.watch is not None and args.watch <= 0:
+        raise SystemExit(f"--watch must be > 0, got {args.watch}")
+    render = _top_render_freshness if args.freshness else _top_render
+
+    def _render_once(sources) -> None:
+        if args.worker is not None:
+            if args.worker not in sources:
+                raise SystemExit(
+                    f"no source {args.worker!r}; have "
+                    f"{sorted(sources)}"
+                )
+            sources = {args.worker: sources[args.worker]}
+        first = True
+        for label in sorted(sources, key=lambda k: (k != "", k)):
+            if not first:
+                print(file=sys.stdout)
+            render(label, sources[label], sys.stdout)
+            first = False
+
+    if args.watch is None:
+        _render_once(_top_load(args.source))
+        return 0
+    import time as _time
+
+    while True:
+        try:
+            sources = _top_load(args.source)
+        except (SystemExit, Exception) as e:
+            # an operator console must ride out a worker restart or a
+            # dropped tunnel: note the failure, keep watching (a
+            # missing --worker label is surfaced the same way — it
+            # reappears when the worker rejoins). Any Exception, not
+            # just the wrapped SystemExit: a proxy's non-UTF-8 error
+            # page or a half-written struct must not kill the console
+            # at exactly the moment it promises to ride out
+            print(f"[fjt-top] {e!r}; retrying in {args.watch:g}s",
+                  file=sys.stderr, flush=True)
+        else:
+            if sys.stdout.isatty():  # console: repaint in place
+                print("\x1b[2J\x1b[H", end="", file=sys.stdout)
+            print(_time.strftime("-- %H:%M:%S "), file=sys.stdout)
+            try:
+                _render_once(sources)
+            except (SystemExit, Exception) as e:
+                print(f"[fjt-top] {e!r}; retrying in {args.watch:g}s",
+                      file=sys.stderr, flush=True)
+            sys.stdout.flush()
+        _time.sleep(args.watch)
 
 
 if __name__ == "__main__":

@@ -25,7 +25,14 @@ the outside:
   ``device_mfu``/``device_membw_util`` gauges and the persisted kernel
   cost ledger;
 - :mod:`flink_jpmml_tpu.obs.slo` — multi-window burn-rate SLO tracking
-  over any latency histogram (``FJT_SLO_*``).
+  over any latency histogram (``FJT_SLO_*``);
+- :mod:`flink_jpmml_tpu.obs.freshness` — event-time watermarks,
+  ``record_staleness_s`` books, and per-partition lag/drain forecasting
+  (the Flink event-time discipline, fleet-merged min-of-workers);
+- :mod:`flink_jpmml_tpu.obs.pressure` — the composite backpressure
+  score over ring occupancy, window-full fraction, and admission wait,
+  with a multi-window breach tracker on ``/healthz``
+  (``FJT_PRESSURE_WINDOWS``).
 """
 
 from flink_jpmml_tpu.obs.recorder import FlightRecorder, record  # noqa: F401

@@ -51,20 +51,35 @@ _WINDOWS_ENV = "FJT_SLO_WINDOWS"
 _DEFAULT_WINDOWS = ((300.0, 14.4), (3600.0, 6.0))
 
 
-def _env_windows() -> Tuple[Tuple[float, float], ...]:
-    raw = os.environ.get(_WINDOWS_ENV)
+def parse_windows_env(
+    env: str,
+    default: Tuple[Tuple[float, float], ...],
+    max_threshold: Optional[float] = None,
+) -> Tuple[Tuple[float, float], ...]:
+    """The shared ``window_seconds:threshold,...`` grammar behind
+    ``FJT_SLO_WINDOWS`` and ``FJT_PRESSURE_WINDOWS`` (obs/pressure.py):
+    garbage entries drop, an all-garbage/empty value falls back to
+    ``default``. ``max_threshold`` bounds the threshold when the domain
+    has one (pressure means live in [0, 1]; burn rates don't)."""
+    raw = os.environ.get(env)
     if not raw:
-        return _DEFAULT_WINDOWS
+        return default
     out: List[Tuple[float, float]] = []
     for part in raw.split(","):
         try:
-            w, burn = part.split(":")
-            w_f, burn_f = float(w), float(burn)
-            if w_f > 0 and burn_f > 0:
-                out.append((w_f, burn_f))
+            w, thr = part.split(":")
+            w_f, thr_f = float(w), float(thr)
+            if w_f > 0 and thr_f > 0 and (
+                max_threshold is None or thr_f <= max_threshold
+            ):
+                out.append((w_f, thr_f))
         except ValueError:
             continue
-    return tuple(out) or _DEFAULT_WINDOWS
+    return tuple(out) or default
+
+
+def _env_windows() -> Tuple[Tuple[float, float], ...]:
+    return parse_windows_env(_WINDOWS_ENV, _DEFAULT_WINDOWS)
 
 
 class SLOTracker:

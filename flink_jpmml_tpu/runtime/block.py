@@ -28,6 +28,8 @@ import numpy as np
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
 from flink_jpmml_tpu.obs import attr as attr_mod
+from flink_jpmml_tpu.obs import freshness as fresh_mod
+from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.runtime.pipeline import (
@@ -596,6 +598,15 @@ class BlockPipelineBase:
         lat = self.metrics.histogram("batch_latency_s")
 
         ledger = attr_mod.ledger_for(self.metrics)
+        # the freshness plane (event-time watermarks + staleness) and
+        # the composite backpressure score: both per-registry singletons
+        # shared with the source (which stamps event times at fetch)
+        # and ticked from this loop — the SLOTracker piggyback pattern,
+        # no thread of their own
+        freshness = fresh_mod.freshness_for(self.metrics)
+        monitor = pressure_mod.pressure_for(self.metrics)
+        ring_occ = self.metrics.gauge("ring_occupancy")
+        ring_cap = float(max(self._config.batch.queue_capacity, 1))
 
         def _complete(pair, meta):
             """FIFO completion off the dispatcher: sink, then commit —
@@ -611,9 +622,16 @@ class BlockPipelineBase:
             lat.observe(t_done - t_start)
             records_out.inc(n)
             self.committed_offset = first_off + n
+            if freshness is not None:
+                # consume the source's ingest stamps for this offset
+                # range: record_staleness_s books + the sink-stage
+                # watermark (watermark_ts) advance here, after delivery
+                freshness.observe_sink(first_off, n)
             self._ckpt.maybe_save(self._ckpt_state)
             if self._slo is not None:
                 self._slo.maybe_tick()
+            if monitor is not None:
+                monitor.maybe_tick()
 
         # the overlapped in-flight window: batch N executes on device
         # while batch N+1 is drained, encoded, and staged here — the
@@ -648,6 +666,10 @@ class BlockPipelineBase:
                         batch_cfg.deadline_us, idle_us
                     )
                 n = X.shape[0]
+                # ring fill fraction AFTER the drain: the producer-side
+                # saturation input to the pressure score (1.0 = the
+                # ingest thread is blocked pushing)
+                ring_occ.set(min(len(self._ring) / ring_cap, 1.0))
                 if (
                     n == self._batch_size  # drain limit = model batch
                     and self._max_dispatch_chunks > 1
@@ -671,6 +693,18 @@ class BlockPipelineBase:
                     # records replay from the committed offset on restore
                     disp.abandon()
                     return
+                if freshness is not None:
+                    # stage-boundary watermark propagation: the batch
+                    # crossing ring→device advances the dispatch-stage
+                    # watermark with ITS OWN ingest-stamp event times
+                    # (exported as watermark_stage_ts{stage="dispatch"},
+                    # fleet MIN) — under backpressure the ring holds old
+                    # records, and the fetch-time watermark would lie;
+                    # monotone by construction, so a replayed or
+                    # out-of-order chunk can never regress it
+                    freshness.propagate_low_watermark(
+                        "dispatch", int(offsets[0]) if n else None, n
+                    )
                 t_start = time.monotonic()
                 disp.launch(
                     lambda h=handle, X=X, n=n: self._dispatch(h, X, n),

@@ -34,9 +34,11 @@ from flink_jpmml_tpu.runtime.checkpoint import (
     CheckpointManager,
     CheckpointPolicy,
 )
+from flink_jpmml_tpu.obs import freshness as fresh_mod
+from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.runtime.queues import BoundedQueue, Closed
 from flink_jpmml_tpu.runtime.sinks import Sink
-from flink_jpmml_tpu.runtime.sources import Source
+from flink_jpmml_tpu.runtime.sources import Source, batch_event_range
 from flink_jpmml_tpu.utils.config import RuntimeConfig
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 from flink_jpmml_tpu.utils.profiling import StageTimer
@@ -301,6 +303,20 @@ class Pipeline:
         in_flight: List[Tuple[Any, List[_Stamped]]] = []
 
         stages = StageTimer(self.metrics)
+        # event-time freshness + backpressure (obs/freshness.py,
+        # obs/pressure.py): the tracker exists only when the source
+        # opts in with an event_time_fn — eagerly creating it would
+        # export a permanently-empty record_staleness_s family on
+        # every pipeline (DynamicScorer gates the same way); the
+        # pressure score always runs (the queue occupancy gauge is
+        # this path's ring input)
+        event_time_fn = getattr(self._source, "event_time_fn", None)
+        freshness = (
+            fresh_mod.freshness_for(self.metrics)
+            if event_time_fn is not None else None
+        )
+        monitor = pressure_mod.pressure_for(self.metrics)
+        queue_occ = self.metrics.gauge("ring_occupancy")
 
         def _finish_one():
             ticket, stamped = in_flight.pop(0)
@@ -314,7 +330,15 @@ class Pipeline:
                 lat.observe(now - s.t_enq)
             records_out.inc(len(stamped))
             self._committed_offset = stamped[-1].offset
+            if freshness is not None and event_time_fn is not None:
+                tr = batch_event_range(
+                    [s.record for s in stamped], event_time_fn
+                )
+                if tr is not None:
+                    freshness.observe_batch(tr[0], tr[1])
             self._ckpt.maybe_save(self._ckpt_state)
+            if monitor is not None:
+                monitor.maybe_tick()
 
         try:
             while True:
@@ -328,6 +352,7 @@ class Pipeline:
                     break
                 if not stamped:
                     continue
+                queue_occ.set(self._queue.occupancy())
                 with stages.stage("featurize_dispatch"):
                     ticket = self._scorer.submit(
                         [s.record for s in stamped]

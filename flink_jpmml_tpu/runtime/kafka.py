@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from flink_jpmml_tpu.obs import attr
+from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.runtime.block import BlockSource
 from flink_jpmml_tpu.runtime.sources import Polled, Record, Source
@@ -332,6 +333,33 @@ def decode_record_batches_rows(
     return offs, rows
 
 
+def record_batch_time_range(buf: bytes):
+    """record-set bytes → (min_event_ts_s, max_event_ts_s) across all
+    whole batches, from the magic-v2 batch headers' first/max timestamp
+    fields — a header-only walk (no varint/CRC work), cheap enough to
+    run per fetch on the hot path. → None when no batch carries a
+    positive timestamp (the native encoder stamps 0 = "no event time";
+    a 1970 watermark would poison every staleness histogram)."""
+    lo = hi = None
+    pos = 0
+    while pos + 12 <= len(buf):
+        (batch_len,) = _I32.unpack_from(buf, pos + 8)
+        end = pos + 12 + batch_len
+        if batch_len < 49 or end > len(buf):
+            break  # truncated trailing batch (cf. decode_record_batches)
+        # header layout after the CRC (pos+21): attributes i16, last
+        # offset delta i32, first timestamp i64, max timestamp i64
+        (first_ms,) = _I64.unpack_from(buf, pos + 27)
+        (max_ms,) = _I64.unpack_from(buf, pos + 35)
+        if max_ms > 0:
+            f = (first_ms if first_ms > 0 else max_ms) / 1000.0
+            m = max_ms / 1000.0
+            lo = f if lo is None else min(lo, f)
+            hi = m if hi is None else max(hi, m)
+        pos = end
+    return None if hi is None else (lo, hi)
+
+
 # ---------------------------------------------------------------------------
 # Client
 # ---------------------------------------------------------------------------
@@ -601,6 +629,7 @@ class _KafkaSourceBase:
         reconnect_backoff_s: float = 0.05,
         interleave: str = "auto",
         metrics=None,
+        max_bytes: int = 4 << 20,
     ):
         self._client = KafkaClient(host, port)
         # observability (optional MetricsRegistry): fetch-RPC latency as
@@ -616,6 +645,21 @@ class _KafkaSourceBase:
         # resolved once, like _fetch_hist: the per-registry lookup is a
         # lock + WeakKeyDictionary hit, too much for the per-fetch path
         self._ledger = attr.ledger_for(metrics)
+        # event-time freshness (obs/freshness.py): the tracker is the
+        # per-REGISTRY singleton — the pipeline sharing this registry
+        # consumes at its sink the stamps this source writes at fetch —
+        # while the lag forecaster is per-SOURCE (partition keys are
+        # ours alone): produced/consumed rates, drain ETA, and the
+        # kafka_lag age-stamping that keeps a stalled partition honest
+        self._freshness = fresh_mod.freshness_for(metrics)
+        self._forecaster = (
+            fresh_mod.LagForecaster(metrics) if metrics is not None
+            else None
+        )
+        # event-time range of the most recent successful fetch (set in
+        # _fetch_raw_part/_fetch_part, read by the poll paths when they
+        # know which global offsets the decoded rows landed on)
+        self._last_trange = None
         self._lag_gauges: Dict[int, object] = {}
         self._topic = topic
         self._parts = (
@@ -657,6 +701,10 @@ class _KafkaSourceBase:
         self._snaps: "collections.deque" = collections.deque()
         self._snap_floor = (start_offset, dict(self._cursors))
         self._max_wait_ms = max_wait_ms
+        # the fetch.max.bytes analogue: bounds how much backlog ONE
+        # fetch RPC can slurp — load drills cap it so broker-side lag
+        # stays observable instead of teleporting into host memory
+        self._max_bytes = int(max_bytes)
         self._backoff = reconnect_backoff_s
         self._eos = False
 
@@ -690,25 +738,38 @@ class _KafkaSourceBase:
             g = self._metrics.gauge(f'kafka_lag{{partition="{part}"}}')
             self._lag_gauges[part] = g
         g.set(max(hw - offset, 0))
+        if self._forecaster is not None:
+            # produced (broker high watermark) vs consumed (our cursor):
+            # the sliding-window drain-ETA/trend estimator, plus the
+            # age-stamp sweep that keeps EVERY partition's lag reading
+            # honest while this one fetches
+            self._forecaster.observe(part, hw, offset)
 
     def _fetch_part(
         self, part: int, offset: int, max_wait_ms: Optional[int] = None
     ) -> List[Tuple[int, bytes]]:
         t0 = time.monotonic()
         try:
-            hw, recs = self._client.fetch(
+            hw, record_set = self._client.fetch_raw(
                 self._topic, part, offset,
                 max_wait_ms=(
                     self._max_wait_ms if max_wait_ms is None else max_wait_ms
                 ),
+                max_bytes=self._max_bytes,
             )
         except KafkaPartitionError:
             raise  # misconfiguration: fail fast, don't reconnect-loop
         except (OSError, ConnectionError, KafkaProtocolError):
             self._reconnect()
+            self._sweep_lag_age()
             return []
+        self._note_event_times(part, record_set)
         self._observe_fetch(part, offset, hw, t0)
-        return recs
+        return [
+            rec
+            for rec in decode_record_batches(record_set)
+            if rec[0] >= offset
+        ]
 
     def _fetch_raw_part(
         self, part: int, offset: int, max_wait_ms: Optional[int] = None
@@ -720,14 +781,57 @@ class _KafkaSourceBase:
                 max_wait_ms=(
                     self._max_wait_ms if max_wait_ms is None else max_wait_ms
                 ),
+                max_bytes=self._max_bytes,
             )
         except KafkaPartitionError:
             raise  # misconfiguration: fail fast, don't reconnect-loop
         except (OSError, ConnectionError, KafkaProtocolError):
             self._reconnect()
+            self._sweep_lag_age()
             return b""
+        self._note_event_times(part, raw)
         self._observe_fetch(part, offset, hw, t0)
         return raw
+
+    def _sweep_lag_age(self) -> None:
+        """A dead broker must not freeze ``kafka_lag_age_s`` at its last
+        fresh-looking value: the poll loop keeps sweeping through the
+        reconnect path even when every fetch fails, so the
+        ``FJT_LAG_STALE_S`` crossing (and its ``kafka_lag_stale``
+        flight event) still fires. Rate-limited inside sweep()."""
+        if self._forecaster is not None:
+            self._forecaster.sweep()
+
+    def _note_event_times(self, part: int, record_set: bytes) -> None:
+        """Advance the partition's event-time watermark from the fetched
+        batches' header timestamps and remember the range for the poll
+        path's ingest stamp (a header-only walk; skipped entirely when
+        no registry is attached)."""
+        if self._freshness is None or not record_set:
+            self._last_trange = None
+            return
+        tr = record_batch_time_range(record_set)
+        self._last_trange = tr
+        if tr is not None:
+            self._freshness.observe_source(part, tr[0], tr[1])
+
+    _TRANGE_LAST = object()  # "use the last fetch's range" default
+
+    def _stamp_ingest(
+        self, first_off: int, n: int, trange=_TRANGE_LAST
+    ) -> None:
+        """Offset-keyed ingest stamp for the sink's staleness books
+        (block sources only: record offsets there are the global domain
+        the pipeline's sink commits in). ``trange`` overrides the last
+        fetch's range for paths that buffer rows across fetches (the
+        strict interleave merges per-slot ranges); an EXPLICIT ``None``
+        means the emitted rows carried no event times at all — it must
+        not fall back to another partition's fetch range, or unstamped
+        rows would be booked with foreign event times."""
+        if trange is self._TRANGE_LAST:
+            trange = self._last_trange
+        if self._freshness is not None and trange is not None:
+            self._freshness.stamp_ingest(first_off, n, trange[0], trange[1])
 
     def _fetch(self) -> List[Tuple[int, bytes]]:
         """Single-partition fetch from the legacy Kafka-offset cursor."""
@@ -836,6 +940,10 @@ class _KafkaSourceBase:
             self._snaps.clear()
             self._snap_floor = (g, dict(cursors))
         self._clear_buffers()
+        if self._freshness is not None:
+            self._freshness.reset_stamps()
+        if self._forecaster is not None:
+            self._forecaster.reset()
         return g
 
     def _clear_buffers(self) -> None:
@@ -861,6 +969,14 @@ class _KafkaSourceBase:
         self._next = offset
         self._g = offset
         self._clear_buffers()
+        # the offset domain restarted (resume, or a cycling bench's
+        # wrap-to-0): pending ingest stamps would mis-key against the
+        # new offsets, and the forecaster's consume rate would read the
+        # cursor jump as a giant negative delta
+        if self._freshness is not None:
+            self._freshness.reset_stamps()
+        if self._forecaster is not None:
+            self._forecaster.reset()
 
     def close(self) -> None:
         self._client.close()
@@ -984,6 +1100,10 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         # per-slot decoded row buffers: slot → [rows...] contiguous from
         # that slot's next needed partition offset (multi-partition only)
         self._rbufs: Dict[int, np.ndarray] = {}
+        # slot → (min_ts, max_ts) of the fetches its buffered rows came
+        # from — batch granularity, so the emitted interleave's ingest
+        # stamp stays an upper bound on staleness
+        self._rbuf_tranges: Dict[int, tuple] = {}
 
     def _decode_rows(self, raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
         if self._decode_s is None:
@@ -1023,12 +1143,17 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
                             )
                         buf = rows
                         self._rbufs[s] = buf
+                        if self._last_trange is not None:
+                            self._rbuf_tranges[s] = self._last_trange
+                        else:
+                            self._rbuf_tranges.pop(s, None)
             avail = 0 if buf is None else buf.shape[0]
             limits.append(off_s + avail * P)
         m = min(limits)
         if m <= 0:
             return None
         out = np.empty((m, self._cols), np.float32)
+        trange = None
         for s in range(P):
             off_s = (s - g0) % P
             c = len(range(off_s, m, P))
@@ -1036,7 +1161,14 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
                 buf = self._rbufs[s]
                 out[off_s:m:P] = buf[:c]
                 self._rbufs[s] = buf[c:]
+                tr = self._rbuf_tranges.get(s)
+                if tr is not None:
+                    trange = tr if trange is None else (
+                        min(trange[0], tr[0]), max(trange[1], tr[1])
+                    )
         self._g = g0 + m
+        # the interleaved run spans every consumed slot's fetch range
+        self._stamp_ingest(g0, m, trange=trange)
         return g0, out
 
     def _poll_multi_auto(self) -> Optional[Tuple[int, np.ndarray]]:
@@ -1078,15 +1210,20 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
                 self._cursors[part] = int(offs[-1]) + 1
                 self._rr = (idx + 1) % P
                 self._snap()
+                # one fetch == one emitted run here, so the fetch's
+                # event-time range stamps these global offsets exactly
+                self._stamp_ingest(g0, rows.shape[0])
                 return g0, rows
         return None
 
     def _clear_buffers(self) -> None:
         self._rbufs.clear()
+        self._rbuf_tranges.clear()
         super()._clear_buffers()
 
     def seek(self, offset: int) -> None:
         self._rbufs.clear()
+        self._rbuf_tranges.clear()
         super().seek(offset)
 
     def poll(self) -> Optional[Tuple[int, np.ndarray]]:
@@ -1113,6 +1250,10 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
             rows = rows[:stop]
         else:
             self._next = int(offs[-1]) + 1
+        # the fetch's batch-header time range bounds these rows' event
+        # times (batch granularity: the cursor filter above may narrow
+        # the rows, never widen them — staleness stays an upper bound)
+        self._stamp_ingest(first, rows.shape[0])
         return first, rows
 
 
@@ -1162,8 +1303,13 @@ class MiniKafkaBroker:
 
     _SEG_RECORDS = 512  # records per stored batch segment
 
-    def append(self, *values: bytes, partition: int = 0) -> int:
-        """→ offset of the first appended value (in ``partition``)."""
+    def append(self, *values: bytes, partition: int = 0,
+               timestamp_ms: Optional[int] = None) -> int:
+        """→ offset of the first appended value (in ``partition``).
+        ``timestamp_ms`` stamps the batch headers (CreateTime) — the
+        event time the freshness plane's watermarks read; the default
+        0 means "no event time" (consumers skip it)."""
+        ts = 0 if timestamp_ms is None else int(timestamp_ms)
         with self._mu:
             first = self._next[partition]
             self._offs[partition].extend(
@@ -1177,15 +1323,22 @@ class MiniKafkaBroker:
                 segs.append((
                     first + i,
                     first + i + len(chunk),
-                    encode_record_batch(first + i, list(chunk)),
+                    encode_record_batch(
+                        first + i, list(chunk), timestamp_ms=ts
+                    ),
                 ))
             self._mu.notify_all()
             return first
 
-    def append_rows(self, rows: np.ndarray, partition: int = 0) -> int:
+    def append_rows(self, rows: np.ndarray, partition: int = 0,
+                    timestamp_ms: Optional[int] = None) -> int:
         """Fixed-width producer fast path: segments encode through the
         C++ batch encoder when available (byte-identical output), so a
-        million-row log appends in tenths of a second instead of tens."""
+        million-row log appends in tenths of a second instead of tens.
+        ``timestamp_ms`` stamps the batch headers with an event time —
+        the native encoder writes timestamp 0, so a stamped append
+        takes the Python encoder (the load generators that stamp append
+        in paced chunks, where the Python path keeps up)."""
         from flink_jpmml_tpu.runtime import native
 
         rows = np.ascontiguousarray(rows, np.float32)
@@ -1199,11 +1352,15 @@ class MiniKafkaBroker:
             for i in range(0, rows.shape[0], self._SEG_RECORDS):
                 chunk = raw[i : i + self._SEG_RECORDS]
                 base = first + i
-                blob = native.kafka_encode_fixed(chunk, base)
+                blob = (
+                    native.kafka_encode_fixed(chunk, base)
+                    if timestamp_ms is None else None
+                )
                 if blob is None:
                     blob = encode_record_batch(
                         base,
                         [chunk[j].tobytes() for j in range(chunk.shape[0])],
+                        timestamp_ms=int(timestamp_ms or 0),
                     )
                 segs.append((base, base + chunk.shape[0], blob))
             self._offs[partition].extend(
@@ -1216,14 +1373,19 @@ class MiniKafkaBroker:
             self._mu.notify_all()
             return first
 
-    def append_rows_round_robin(self, rows: np.ndarray) -> None:
+    def append_rows_round_robin(
+        self, rows: np.ndarray, timestamp_ms: Optional[int] = None
+    ) -> None:
         """Row i → partition i % n_partitions (the producer layout the
         multi-partition sources' strict interleave consumes). Chunked
         producers must pass chunks whose length divides by n_partitions,
         or the round-robin phase restarts mid-stream."""
         rows = np.ascontiguousarray(rows, np.float32)
         for p in range(self.n_partitions):
-            self.append_rows(rows[p :: self.n_partitions], partition=p)
+            self.append_rows(
+                rows[p :: self.n_partitions], partition=p,
+                timestamp_ms=timestamp_ms,
+            )
 
     def append_rows_keyed(self, rows: np.ndarray, keys) -> None:
         """Keyed producer: row i → partition ``hash(keys[i]) %

@@ -104,6 +104,19 @@ def _block_ready(out) -> None:
             fn()
 
 
+def _is_ready(out) -> bool:
+    """Non-blocking probe: would :func:`_block_ready` return instantly?
+
+    Leaves without an ``is_ready`` (numpy, test doubles) count as
+    ready — only a device leaf that reports itself in flight makes the
+    whole value not-ready."""
+    for leaf in _tree_leaves(out):
+        fn = getattr(leaf, "is_ready", None)
+        if fn is not None and not fn():
+            return False
+    return True
+
+
 class DispatcherClosed(FlinkJpmmlTpuError):
     """launch() after close(): the window is shut down."""
 
@@ -299,6 +312,13 @@ class OverlappedDispatcher:
         self.metrics = metrics or MetricsRegistry()
         self._stall = self.metrics.counter("h2d_stall_s")
         self._dispatches = self.metrics.counter("dispatches")
+        # launches that found the window FULL and blocked (depth > 0
+        # only: a depth-0 synchronous window finishes every batch by
+        # design, which is the latency operating point, not saturation).
+        # window_full_launches / dispatches over a tick interval is the
+        # "window-full fraction" input to the composite backpressure
+        # score (obs/pressure.py).
+        self._window_full = self.metrics.counter("window_full_launches")
         self._gauge = self.metrics.gauge("inflight_depth")
         # attribution + sampled device profiling (obs/attr.py,
         # obs/profiler.py): the per-registry singletons, so every path
@@ -410,6 +430,17 @@ class OverlappedDispatcher:
         handle = _InFlight(out, meta, time.monotonic())
         self._window.append(handle)
         self._dispatches.inc()
+        if (
+            self._depth is not None
+            and self._depth > 0
+            and len(self._window) > self._depth
+            # a healthy overlapped pipeline's steady state is a window
+            # trimmed to exactly depth, so overshoot alone is not
+            # saturation — count only launches whose oldest entry is
+            # still in flight, i.e. the trim below will actually block
+            and not _is_ready(self._window[0].out)
+        ):
+            self._window_full.inc()
         while self._depth is not None and len(self._window) > self._depth:
             # depth 0 (the latency operating point) has no window for a
             # ready batch to wait in: this wait is the host blocking on

@@ -95,3 +95,14 @@ class BoundedQueue:
     def __len__(self) -> int:
         with self._lock:
             return len(self._items)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def occupancy(self) -> float:
+        """Fill fraction in [0, 1] — the queue-side input to the
+        composite backpressure score (obs/pressure.py): producers are
+        blocked exactly when this sits at 1.0."""
+        with self._lock:
+            return min(len(self._items) / self._capacity, 1.0)

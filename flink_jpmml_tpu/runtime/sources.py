@@ -23,7 +23,16 @@ Polled = List[Tuple[int, Record]]
 
 
 class Source:
-    """Protocol: poll records in offset order; seek for resume."""
+    """Protocol: poll records in offset order; seek for resume.
+
+    ``event_time_fn`` (optional): ``record -> unix seconds`` (or None
+    for a record with no event time). Sources that know their records'
+    *event* time set it so the engine can stamp batches for the
+    freshness plane (obs/freshness.py) — watermarks and the
+    ``record_staleness_s`` books; the Kafka sources carry event time in
+    the wire batches themselves and need no extractor."""
+
+    event_time_fn = None
 
     def poll(self, max_n: int) -> Polled:
         raise NotImplementedError
@@ -39,14 +48,37 @@ class Source:
         pass
 
 
+def batch_event_range(records, event_time_fn):
+    """min/max event time over a batch of records → (min_ts, max_ts) or
+    None when no record carries one. Out-of-order event times within
+    the batch are exactly what the min/max pair absorbs — the watermark
+    consumer (``FreshnessTracker``) only ever advances monotonically."""
+    if event_time_fn is None:
+        return None
+    lo = hi = None
+    for rec in records:
+        try:
+            ts = event_time_fn(rec)
+        except Exception:
+            continue  # a malformed record never poisons the stamp
+        if ts is None or ts <= 0:
+            continue
+        ts = float(ts)
+        lo = ts if lo is None else min(lo, ts)
+        hi = ts if hi is None else max(hi, ts)
+    return None if hi is None else (lo, hi)
+
+
 class InMemorySource(Source):
     """Replayable in-memory record list (the MiniCluster-test equivalent,
     SURVEY.md §5); optionally cycles forever for throughput benchmarking."""
 
-    def __init__(self, records: Sequence[Record], cycle: bool = False):
+    def __init__(self, records: Sequence[Record], cycle: bool = False,
+                 event_time_fn=None):
         self._records = list(records)
         self._pos = 0
         self._cycle = cycle
+        self.event_time_fn = event_time_fn
 
     def poll(self, max_n: int) -> Polled:
         n = len(self._records)
@@ -77,9 +109,11 @@ class GeneratorSource(Source):
     (synthetic sources are stateless by construction).
     """
 
-    def __init__(self, fn: Callable[[int], Sequence[Record]]):
+    def __init__(self, fn: Callable[[int], Sequence[Record]],
+                 event_time_fn=None):
         self._fn = fn
         self._offset = 0
+        self.event_time_fn = event_time_fn
 
     def poll(self, max_n: int) -> Polled:
         recs = self._fn(max_n)
@@ -100,11 +134,13 @@ class JsonlFileSource(Source):
     ``follow=True`` keeps polling for appended lines (Kafka-less streaming
     ingestion for a single-host deployment)."""
 
-    def __init__(self, path: str, follow: bool = False):
+    def __init__(self, path: str, follow: bool = False,
+                 event_time_fn=None):
         self._path = path
         self._f = open(path, "r", encoding="utf-8")
         self._follow = follow
         self._eof = False
+        self.event_time_fn = event_time_fn
 
     def poll(self, max_n: int) -> Polled:
         out: Polled = []

@@ -46,6 +46,8 @@ from flink_jpmml_tpu.models.control import RolloutMessage
 from flink_jpmml_tpu.models.core import ModelId
 from flink_jpmml_tpu.models.prediction import Prediction
 from flink_jpmml_tpu.obs import attr as attr_mod
+from flink_jpmml_tpu.obs import freshness as fresh_mod
+from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs.slo import SLOTracker
 from flink_jpmml_tpu.rollout import split as rsplit
@@ -60,7 +62,7 @@ from flink_jpmml_tpu.runtime.pipeline import (
     OverlappedDispatcher,
     dispatch_quantized,
 )
-from flink_jpmml_tpu.runtime.sources import ControlSource
+from flink_jpmml_tpu.runtime.sources import ControlSource, batch_event_range
 from flink_jpmml_tpu.serving.registry import ModelRegistry
 from flink_jpmml_tpu.utils.config import CompileConfig
 from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
@@ -98,6 +100,7 @@ class DynamicScorer(Scorer):
         guardrails: Optional[GuardrailSpec] = None,
         auto_rollout: bool = True,
         rollout_interval_s: float = 0.5,
+        event_time_fn: Optional[Callable[[Any], Optional[float]]] = None,
     ):
         """``async_warmup=False`` disables background warming: a newly
         Added model compiles synchronously inside ``submit`` on its first
@@ -122,7 +125,14 @@ class DynamicScorer(Scorer):
         .record_key`); ``guardrails`` is the default spec stamped onto
         ``RolloutMessage``s that carry none; ``auto_rollout=False``
         disables the attached controller's batch-loop ticks (manual
-        promote/rollback via ``scorer.rollout_controller`` only)."""
+        promote/rollback via ``scorer.rollout_controller`` only).
+
+        ``event_time_fn`` (``event -> unix seconds`` or None) opts this
+        scorer into the freshness plane (obs/freshness.py): each
+        finished micro-batch books ``record_staleness_s`` and advances
+        the event-time watermark from the batch's min/max event times —
+        the dynamic-path twin of the block pipelines' offset-keyed
+        ingest stamps."""
         self.registry = ModelRegistry(
             batch_size=batch_size,
             compile_config=compile_config,
@@ -148,6 +158,14 @@ class DynamicScorer(Scorer):
         # submit→finish latency per micro-batch as a MERGEABLE histogram
         # (the fleet /metrics view adds bucket counts across workers)
         self._lat = self.metrics.histogram("score_latency_s")
+        self._event_time_fn = event_time_fn
+        # freshness + backpressure piggybacks (per-registry singletons,
+        # ticked from finish() like the SLO tracker)
+        self._freshness = (
+            fresh_mod.freshness_for(self.metrics)
+            if event_time_fn is not None else None
+        )
+        self._pressure = pressure_mod.pressure_for(self.metrics)
         # models whose load/compile failed: don't re-attempt every batch;
         # cleared when the registry changes (a fixed version can be re-Added)
         self._failed: set = set()
@@ -433,6 +451,15 @@ class DynamicScorer(Scorer):
         if tickets:  # an all-unserved batch scored nothing: no sample
             self._lat.observe(time.monotonic() - t_submit)
         self.slo.maybe_tick()  # burn-rate state rides the batch loop
+        if self._freshness is not None and records:
+            tr = batch_event_range(records, self._event_time_fn)
+            if tr is not None:
+                # micro-batches complete synchronously from the
+                # caller's view: one call books staleness and advances
+                # the sink-stage watermark together
+                self._freshness.observe_batch(tr[0], tr[1])
+        if self._pressure is not None:
+            self._pressure.maybe_tick()
         if self._emit is not None:
             return self._emit(records, preds)
         if self._emit_pairs:

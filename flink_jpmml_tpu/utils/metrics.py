@@ -25,6 +25,7 @@ import bisect
 import math
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -296,6 +297,38 @@ class MetricsRegistry:
         self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
+        self._scrape_hooks: List[weakref.WeakMethod] = []
+
+    def add_scrape_hook(self, method) -> None:
+        """Register a bound method to run just before every
+        :meth:`struct_snapshot` (held weakly — a dead owner
+        unregisters itself). The freshness plane registers its aging
+        sweeps here so observation-age gauges keep counting up from
+        the OBSERVER side: a wedged consumer (full ring, blocked
+        ingest thread) must not freeze its own staleness detectors —
+        the /metrics scrape and the heartbeat piggyback both collect
+        through struct_snapshot and both survive the stall."""
+        with self._lock:
+            self._scrape_hooks.append(weakref.WeakMethod(method))
+
+    def _run_scrape_hooks(self) -> None:
+        with self._lock:
+            hooks = list(self._scrape_hooks)
+        dead = False
+        for ref in hooks:
+            fn = ref()
+            if fn is None:
+                dead = True
+                continue
+            try:
+                fn()
+            except Exception:
+                pass  # an aging hook must never kill a scrape
+        if dead:
+            with self._lock:
+                self._scrape_hooks = [
+                    h for h in self._scrape_hooks if h() is not None
+                ]
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -353,6 +386,7 @@ class MetricsRegistry:
     def struct_snapshot(self) -> dict:
         """Typed, mergeable, JSON-shaped snapshot — the fleet wire format
         (reservoirs are deliberately absent: they cannot merge)."""
+        self._run_scrape_hooks()
         counters, gauges, histograms, _ = self._views()
         return {
             "uptime_s": max(time.monotonic() - self._t0, 1e-9),
@@ -372,15 +406,23 @@ class MetricsRegistry:
 #: among three must not render slo_ok=2 (truthy). Ratio/occupancy
 #: gauges take the max (the worst/busiest worker the fleet knows of);
 #: ``slo_ok`` takes the min (the fleet is breached if ANY worker is).
+#: The freshness plane (obs/freshness.py, obs/pressure.py) follows the
+#: same discipline: lag/age/staleness/pressure gauges take the WORST
+#: worker, and the ``watermark_ts`` low-watermark takes the MIN — fleet
+#: freshness is the slowest worker, never an average.
 _GAUGE_MERGE_MAX_PREFIXES = (
     "device_mfu", "device_membw_util", "device_ns_per_record",
     "flops_per_record", "slo_burn_rate",
+    "watermark_lag_s", "kafka_lag_age_s", "lag_drain_eta_s",
+    "lag_trend", "lag_diverging", "pressure", "ring_occupancy",
 )
-_GAUGE_MERGE_MIN = ("slo_ok",)
+_GAUGE_MERGE_MIN_PREFIXES = (
+    "slo_ok", "watermark_ts", "watermark_stage_ts",
+)
 
 
 def _gauge_merge_mode(name: str) -> str:
-    if name in _GAUGE_MERGE_MIN:
+    if name.startswith(_GAUGE_MERGE_MIN_PREFIXES):
         return "min"
     if name.startswith(_GAUGE_MERGE_MAX_PREFIXES):
         return "max"

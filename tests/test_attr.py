@@ -824,3 +824,170 @@ class TestFjtTop:
             top_main([str(p)])
         with pytest.raises(SystemExit):
             top_main([str(tmp_path / "missing.json")])
+
+
+class TestFjtTopFreshness:
+    """The --freshness panel (ISSUE 7): obs/freshness.py +
+    obs/pressure.py rendered as one operator view."""
+
+    def _struct(self, diverging=False):
+        m = MetricsRegistry()
+        m.gauge("pressure").set(0.72)
+        m.gauge("pressure_ring").set(0.72)
+        m.gauge("pressure_window").set(0.10)
+        m.gauge("pressure_wait").set(0.05)
+        m.counter("pressure_breaches").inc(2)
+        m.gauge("lag_drain_eta_s").set(12.5)
+        m.gauge("lag_trend").set(-340.0)
+        m.gauge("lag_diverging").set(1.0 if diverging else 0.0)
+        m.gauge("watermark_ts").set(1_700_000_000.0)
+        m.gauge('watermark_lag_s{partition="0"}').set(1.25)
+        m.gauge('watermark_lag_s{partition="1"}').set(0.4)
+        m.gauge('kafka_lag{partition="0"}').set(5000.0)
+        m.gauge('kafka_lag_age_s{partition="0"}').set(0.3)
+        h = m.histogram("record_staleness_s")
+        for v in (0.5, 0.8, 1.4, 2.0):
+            h.observe(v)
+        return m.struct_snapshot()
+
+    def test_renders_panel(self, tmp_path, capsys):
+        from flink_jpmml_tpu.cli import top_main
+
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(self._struct()))
+        assert top_main([str(dump), "--freshness"]) == 0
+        out = capsys.readouterr().out
+        assert "freshness" in out
+        assert "pressure  0.72" in out
+        assert "ring 0.72" in out and "breaches 2" in out
+        assert "eta 12.5s" in out and "-340.0 rec/s" in out
+        assert "stale" in out and "p99" in out
+        # per-partition table: both partitions, missing cells dashed
+        assert re.search(r"^0\s+1\.250\s+5,000\s+0\.3$", out, re.M)
+        assert re.search(r"^1\s+0\.400\s+-\s+-$", out, re.M)
+
+    def test_diverging_renders_loudly(self, tmp_path, capsys):
+        from flink_jpmml_tpu.cli import top_main
+
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(self._struct(diverging=True)))
+        assert top_main([str(dump), "--freshness"]) == 0
+        out = capsys.readouterr().out
+        assert "DIVERGING" in out
+        assert "12.5s" not in out  # a frozen ETA must not read as live
+
+    def test_empty_struct_says_so(self, tmp_path, capsys):
+        from flink_jpmml_tpu.cli import top_main
+
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps({"counters": {}, "gauges": {}}))
+        assert top_main([str(dump), "--freshness"]) == 0
+        assert "no freshness telemetry" in capsys.readouterr().out
+
+    def test_fleet_mapping_renders_each_source(self, tmp_path, capsys):
+        from flink_jpmml_tpu.cli import top_main
+
+        s = self._struct()
+        dump = tmp_path / "fleet.json"
+        dump.write_text(json.dumps({"": s, "w0": s}))
+        assert top_main([str(dump), "--freshness"]) == 0
+        out = capsys.readouterr().out
+        assert "== aggregate · freshness ==" in out
+        assert "== w0 · freshness ==" in out
+
+
+class TestFjtTopWatch:
+    """--watch N: the operator-console loop re-renders from a live
+    source and retries through fetch failures instead of exiting."""
+
+    def _interrupt_after(self, monkeypatch, n):
+        import time as time_mod
+
+        calls = {"n": 0}
+
+        def fake_sleep(secs):
+            calls["n"] += 1
+            if calls["n"] >= n:
+                raise KeyboardInterrupt
+
+        monkeypatch.setattr(time_mod, "sleep", fake_sleep)
+
+    def test_watch_rerenders_until_interrupted(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        from flink_jpmml_tpu.cli import top_main
+
+        m = MetricsRegistry()
+        attr.StageLedger(m).observe("sink", 0.002)
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(m.struct_snapshot()))
+        self._interrupt_after(monkeypatch, 2)
+        with pytest.raises(KeyboardInterrupt):
+            top_main([str(dump), "--watch", "0.01"])
+        out = capsys.readouterr().out
+        assert out.count("sink") >= 2  # rendered once per cycle
+
+    def test_watch_retries_through_fetch_failures(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        from flink_jpmml_tpu.cli import top_main
+
+        self._interrupt_after(monkeypatch, 2)
+        with pytest.raises(KeyboardInterrupt):
+            top_main([str(tmp_path / "gone.json"), "--watch", "0.01"])
+        err = capsys.readouterr().err
+        assert "retrying" in err  # noted, not fatal — twice
+        assert err.count("retrying") == 2
+
+    def test_watch_retries_missing_worker_label(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        from flink_jpmml_tpu.cli import top_main
+
+        m = MetricsRegistry()
+        attr.StageLedger(m).observe("sink", 0.002)
+        dump = tmp_path / "fleet.json"
+        dump.write_text(json.dumps({"": m.struct_snapshot()}))
+        self._interrupt_after(monkeypatch, 1)
+        with pytest.raises(KeyboardInterrupt):
+            top_main([str(dump), "--watch", "0.01", "--worker", "w9"])
+        err = capsys.readouterr().err
+        assert "w9" in err and "retrying" in err
+
+    def test_watch_validation(self, tmp_path):
+        from flink_jpmml_tpu.cli import top_main
+
+        with pytest.raises(SystemExit):
+            top_main([str(tmp_path / "x.json"), "--watch", "0"])
+        with pytest.raises(SystemExit):
+            top_main([str(tmp_path / "x.json"), "--watch", "-2"])
+
+    def test_watermark_only_struct_renders_without_fallback(
+        self, tmp_path, capsys
+    ):
+        from flink_jpmml_tpu.cli import top_main
+
+        m = MetricsRegistry()
+        m.gauge("watermark_ts").set(1_700_000_000.0)
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(m.struct_snapshot()))
+        assert top_main([str(dump), "--freshness"]) == 0
+        out = capsys.readouterr().out
+        assert "low-watermark" in out
+        assert "no freshness telemetry" not in out
+
+    def test_empty_staleness_histogram_is_not_telemetry(
+        self, tmp_path, capsys
+    ):
+        """freshness_for registers record_staleness_s eagerly; an
+        all-empty registry that merely touched the tracker must still
+        say 'no freshness telemetry' (review finding, pinned)."""
+        from flink_jpmml_tpu.cli import top_main
+        from flink_jpmml_tpu.obs.freshness import freshness_for
+
+        m = MetricsRegistry()
+        freshness_for(m)  # registers the (empty) staleness histogram
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(m.struct_snapshot()))
+        assert top_main([str(dump), "--freshness"]) == 0
+        assert "no freshness telemetry" in capsys.readouterr().out

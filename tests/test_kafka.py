@@ -1038,3 +1038,318 @@ class TestIdleCommit:
             assert sum(done) == N
         finally:
             broker.close()
+
+
+class TestEventTimeFreshness:
+    """The freshness plane's kafka side (ISSUE 7): event time rides the
+    record-batch headers, fetch advances per-partition watermarks, and
+    the ingest→sink stamp channel books record staleness."""
+
+    def test_record_batch_time_range_header_walk(self):
+        from flink_jpmml_tpu.runtime.kafka import record_batch_time_range
+
+        b1 = encode_record_batch(0, [b"a", b"b"], timestamp_ms=5_000)
+        b2 = encode_record_batch(2, [b"c"], timestamp_ms=9_000)
+        assert record_batch_time_range(b1) == (5.0, 5.0)
+        assert record_batch_time_range(b1 + b2) == (5.0, 9.0)
+        # timestamp 0 (the native encoder's default) = no event time
+        b0 = encode_record_batch(3, [b"d"])
+        assert record_batch_time_range(b0) is None
+        assert record_batch_time_range(b0 + b2) == (9.0, 9.0)
+        # truncated tail: the whole-batch prefix still reads
+        assert record_batch_time_range(b1 + b2[: len(b2) // 2]) == (5.0, 5.0)
+        assert record_batch_time_range(b"") is None
+
+    def test_timestamped_append_rows_roundtrip(self):
+        """A timestamped append_rows takes the Python encoder (the
+        native one writes ts 0) and stays byte-decodable with the same
+        offsets and payloads."""
+        from flink_jpmml_tpu.runtime.kafka import record_batch_time_range
+
+        rng = np.random.default_rng(5)
+        data = rng.normal(size=(700, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="ts")
+        try:
+            broker.append_rows(data, timestamp_ms=42_000)
+            client = KafkaClient(broker.host, broker.port)
+            try:
+                hw, raw = client.fetch_raw("ts", 0, 0, max_wait_ms=20)
+                assert hw == 700
+                recs = decode_record_batches(raw)
+                assert recs[0] == (0, data[0].tobytes())
+                tr = record_batch_time_range(raw)
+                assert tr == (42.0, 42.0)
+            finally:
+                client.close()
+        finally:
+            broker.close()
+
+    def test_block_source_advances_watermark_and_books_staleness(self):
+        from flink_jpmml_tpu.obs.freshness import freshness_for
+        from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+        rng = np.random.default_rng(6)
+        data = rng.normal(size=(256, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="fresh")
+        m = MetricsRegistry()
+        try:
+            now_ms = int(time.time() * 1000)
+            broker.append_rows(data, timestamp_ms=now_ms - 3_000)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "fresh",
+                n_cols=4, max_wait_ms=20, metrics=m,
+            )
+            try:
+                pos = 0
+                deadline = time.monotonic() + 15.0
+                while pos < 256 and time.monotonic() < deadline:
+                    polled = src.poll()
+                    if polled is None:
+                        continue
+                    off, blk = polled
+                    pos = off + blk.shape[0]
+                assert pos == 256
+                g = m.struct_snapshot()["gauges"]
+                wm_lag = g.get('watermark_lag_s{partition="0"}')
+                assert wm_lag is not None
+                # the records were stamped ~3 s ago: end-to-end event-
+                # time lag reads it (bounded well above by test slop)
+                assert 2.5 <= wm_lag["value"] < 60.0
+                # the fetch path fed the forecaster: lag + age gauges
+                assert 'kafka_lag{partition="0"}' in g
+                assert 'kafka_lag_age_s{partition="0"}' in g
+                # the sink side consumes the ingest stamps
+                fr = freshness_for(m)
+                fr.observe_sink(0, 256)
+                h = m.histogram("record_staleness_s")
+                assert h.count() >= 2
+                assert h.quantile(0.5) == pytest.approx(3.0, abs=2.0)
+                assert g_val(m, "watermark_ts") == pytest.approx(
+                    (now_ms - 3_000) / 1000.0, abs=1.0
+                )
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_fetch_failure_still_sweeps_lag_age(self, monkeypatch):
+        """A dead broker must not freeze kafka_lag_age_s at its last
+        fresh-looking value: every fetch skips _observe_fetch on the
+        reconnect path, so the sweep has to ride that path too or the
+        FJT_LAG_STALE_S crossing never fires (review finding, pinned)."""
+        from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+        rng = np.random.default_rng(13)
+        data = rng.normal(size=(32, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="dead")
+        m = MetricsRegistry()
+        src = None
+        try:
+            broker.append_rows(
+                data, timestamp_ms=int(time.time() * 1000)
+            )
+            src = KafkaBlockSource(
+                broker.host, broker.port, "dead",
+                n_cols=4, max_wait_ms=20, metrics=m,
+                reconnect_backoff_s=0.01,
+            )
+            pos = 0
+            deadline = time.monotonic() + 15.0
+            while pos < 32 and time.monotonic() < deadline:
+                polled = src.poll()
+                if polled is None:
+                    continue
+                off, blk = polled
+                pos = off + blk.shape[0]
+            assert pos == 32
+            broker.close()
+            broker = None
+            sweeps = []
+            monkeypatch.setattr(
+                src._forecaster, "sweep",
+                lambda now=None: sweeps.append(1),
+            )
+            src.poll()  # fetch fails → reconnect → sweep still runs
+            assert sweeps
+        finally:
+            if src is not None:
+                src.close()
+            if broker is not None:
+                broker.close()
+
+    def test_unstamped_log_stays_out_of_the_freshness_plane(self):
+        from flink_jpmml_tpu.obs.freshness import freshness_for
+        from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+        rng = np.random.default_rng(7)
+        data = rng.normal(size=(128, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="nots")
+        m = MetricsRegistry()
+        try:
+            broker.append_rows(data)  # native path: timestamp 0
+            src = KafkaBlockSource(
+                broker.host, broker.port, "nots",
+                n_cols=4, max_wait_ms=20, metrics=m,
+            )
+            try:
+                pos = 0
+                deadline = time.monotonic() + 15.0
+                while pos < 128 and time.monotonic() < deadline:
+                    polled = src.poll()
+                    if polled is None:
+                        continue
+                    off, blk = polled
+                    pos = off + blk.shape[0]
+                assert pos == 128
+                fr = freshness_for(m)
+                assert fr.low_watermark() is None
+                fr.observe_sink(0, 128)
+                assert m.histogram("record_staleness_s").count() == 0
+                g = m.struct_snapshot()["gauges"]
+                assert 'watermark_lag_s{partition="0"}' not in g
+                # a 1970 watermark would have read as ~56 years of lag
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_seek_resets_stamps_but_not_watermarks(self):
+        from flink_jpmml_tpu.obs.freshness import freshness_for
+        from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+        rng = np.random.default_rng(8)
+        data = rng.normal(size=(64, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="seek")
+        m = MetricsRegistry()
+        try:
+            broker.append_rows(
+                data, timestamp_ms=int(time.time() * 1000)
+            )
+            src = KafkaBlockSource(
+                broker.host, broker.port, "seek",
+                n_cols=4, max_wait_ms=20, metrics=m,
+            )
+            try:
+                deadline = time.monotonic() + 15.0
+                pos = 0
+                while pos < 64 and time.monotonic() < deadline:
+                    polled = src.poll()
+                    if polled is None:
+                        continue
+                    off, blk = polled
+                    pos = off + blk.shape[0]
+                fr = freshness_for(m)
+                wm = fr.low_watermark()
+                assert wm is not None
+                src.seek(0)  # replay: offset domain restarted
+                fr.observe_sink(0, 64)
+                # the pre-seek stamps were dropped, not mis-keyed
+                assert m.histogram("record_staleness_s").count() == 0
+                assert fr.low_watermark() == wm  # time never regresses
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+
+def g_val(m, name):
+    v = m.struct_snapshot()["gauges"].get(name)
+    return v["value"] if isinstance(v, dict) else None
+
+
+class TestEventTimeStrictInterleave:
+    def test_strict_interleave_stamps_ingest(self):
+        """The strict round-robin path buffers rows across fetches; its
+        emitted runs must still carry ingest stamps so the sink books
+        staleness (review finding: the plane was dark on
+        interleave='strict' topologies, pinned)."""
+        from flink_jpmml_tpu.obs.freshness import freshness_for
+        from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+        rng = np.random.default_rng(9)
+        data = rng.normal(size=(300, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="mpts", n_partitions=2)
+        m = MetricsRegistry()
+        try:
+            now_ms = int(time.time() * 1000)
+            broker.append_rows_round_robin(
+                data, timestamp_ms=now_ms - 4_000
+            )
+            src = KafkaBlockSource(
+                broker.host, broker.port, "mpts", partitions=[0, 1],
+                n_cols=4, max_wait_ms=20, interleave="strict",
+                metrics=m,
+            )
+            try:
+                pos = 0
+                deadline = time.monotonic() + 15.0
+                while pos < 300 and time.monotonic() < deadline:
+                    polled = src.poll()
+                    if polled is None:
+                        continue
+                    off, blk = polled
+                    np.testing.assert_array_equal(
+                        blk, data[off : off + blk.shape[0]]
+                    )
+                    pos = off + blk.shape[0]
+                assert pos == 300
+                g = m.struct_snapshot()["gauges"]
+                assert 'watermark_lag_s{partition="0"}' in g
+                assert 'watermark_lag_s{partition="1"}' in g
+                fr = freshness_for(m)
+                fr.observe_sink(0, 300)
+                h = m.histogram("record_staleness_s")
+                assert h.count() >= 2
+                assert h.quantile(0.5) == pytest.approx(4.0, abs=2.0)
+                assert g_val(m, "watermark_ts") == pytest.approx(
+                    (now_ms - 4_000) / 1000.0, abs=1.0
+                )
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_explicit_none_trange_never_borrows_last_fetch(self):
+        """An interleaved run whose consumed slots carried NO event
+        times merges to trange=None; the stamp must be a no-op — not
+        fall back to the previous (possibly foreign-partition) fetch's
+        range and book unstamped rows with borrowed event times
+        (review finding, pinned)."""
+        from flink_jpmml_tpu.obs.freshness import freshness_for
+        from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+        rng = np.random.default_rng(11)
+        data = rng.normal(size=(64, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="mixed")
+        m = MetricsRegistry()
+        try:
+            now_ms = int(time.time() * 1000)
+            broker.append_rows(data, timestamp_ms=now_ms - 5_000)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "mixed",
+                n_cols=4, max_wait_ms=20, metrics=m,
+            )
+            try:
+                pos = 0
+                deadline = time.monotonic() + 15.0
+                while pos < 64 and time.monotonic() < deadline:
+                    polled = src.poll()
+                    if polled is None:
+                        continue
+                    off, blk = polled
+                    pos = off + blk.shape[0]
+                assert pos == 64
+                assert src._last_trange is not None  # a stamped fetch
+                fr = freshness_for(m)
+                fr.observe_sink(0, 64)
+                h = m.histogram("record_staleness_s")
+                booked = h.count()
+                assert booked >= 2
+                # an unstamped run: explicit None, NOT the default
+                src._stamp_ingest(1_000, 8, trange=None)
+                fr.observe_sink(1_000, 8)
+                assert h.count() == booked
+            finally:
+                src.close()
+        finally:
+            broker.close()

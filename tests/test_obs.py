@@ -676,3 +676,541 @@ class TestFleetMetricsDrill:
             assert "w0" in sup.metrics_snapshots()
         finally:
             sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# Freshness plane (ISSUE 7): watermarks, lag forecasting, backpressure
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class TestFreshnessWatermarks:
+    def test_partition_watermark_never_regresses(self):
+        """Property: under a random out-of-order event-time stream, each
+        partition watermark and the low-watermark advance monotonically."""
+        import random
+
+        from flink_jpmml_tpu.obs.freshness import FreshnessTracker
+
+        rng = random.Random(7)
+        m = MetricsRegistry()
+        tr = FreshnessTracker(m)
+        seen = {}
+        prev_low = None
+        base = 1_700_000_000.0
+        for i in range(500):
+            part = rng.choice(["0", "1", "2"])
+            ts = base + rng.uniform(-60.0, 60.0)
+            tr.observe_source(part, ts - rng.uniform(0, 5), ts, now=base + 120)
+            with tr._mu:
+                wm = tr._part_wm[part]
+            assert wm >= seen.get(part, wm), "partition watermark regressed"
+            assert wm >= ts  # covers this batch
+            had_all = len(seen) == 3
+            seen[part] = wm
+            low = tr.low_watermark()
+            assert low == min(seen.values())
+            # monotone once the partition set is stable (a NEW partition
+            # joining may legitimately lower the min — Flink semantics)
+            if had_all:
+                assert low >= prev_low, "low-watermark regressed"
+            prev_low = low
+        assert tr.low_watermark() == min(seen.values())
+
+    def test_stage_watermark_monotone_across_boundaries(self):
+        from flink_jpmml_tpu.obs.freshness import FreshnessTracker
+
+        tr = FreshnessTracker(MetricsRegistry())
+        assert tr.advance_stage("dispatch", 100.0) == 100.0
+        # an out-of-order / replayed batch never regresses the stage
+        assert tr.advance_stage("dispatch", 40.0) == 100.0
+        assert tr.advance_stage("dispatch", None) == 100.0
+        assert tr.advance_stage("dispatch", 130.0) == 130.0
+        assert tr.stage_watermark("dispatch") == 130.0
+        assert tr.stage_watermark("unknown") is None
+
+    def test_propagate_low_watermark_exports_stage_gauge(self):
+        """The hot-path stage propagation is observable: it exports
+        watermark_stage_ts{stage=*} (fleet MIN, like watermark_ts) and
+        follows the slowest partition in one locked step."""
+        from flink_jpmml_tpu.obs.freshness import FreshnessTracker
+
+        m = MetricsRegistry()
+        tr = FreshnessTracker(m)
+        # no partitions yet: nothing to propagate, no gauge registered
+        # (an eager 0.0 would pin the fleet MIN at the epoch)
+        assert tr.propagate_low_watermark("dispatch") is None
+        assert not any(
+            k.startswith("watermark_stage_ts")
+            for k in m.struct_snapshot()["gauges"]
+        )
+        tr.observe_source("0", 90.0, 100.0, now=200.0)
+        tr.observe_source("1", 140.0, 150.0, now=200.0)
+        assert tr.propagate_low_watermark("dispatch") == 100.0
+        g = m.struct_snapshot()["gauges"]
+        assert g['watermark_stage_ts{stage="dispatch"}']["value"] == 100.0
+        # the slowest partition advances → the stage follows
+        tr.observe_source("0", 110.0, 120.0, now=200.0)
+        assert tr.propagate_low_watermark("dispatch") == 120.0
+        assert tr.stage_watermark("dispatch") == 120.0
+        g = m.struct_snapshot()["gauges"]
+        assert g['watermark_stage_ts{stage="dispatch"}']["value"] == 120.0
+        # a dispatched batch's OWN ingest stamps override the (fresher)
+        # fetch-time watermark: backlogged records crossing ring→device
+        # must read old, not fresh (review finding, pinned)
+        tr.observe_source("0", 900.0, 1000.0, now=1200.0)
+        tr.observe_source("1", 900.0, 1000.0, now=1200.0)
+        tr.stamp_ingest(0, 32, 140.0, 150.0)  # old backlog at ring head
+        assert tr.propagate_low_watermark("dispatch", 0, 32) == 150.0
+        g = m.struct_snapshot()["gauges"]
+        assert g['watermark_stage_ts{stage="dispatch"}']["value"] == 150.0
+        # the stamps were peeked, not consumed: the sink still books them
+        tr.observe_sink(0, 32, now=1200.0)
+        assert m.histogram("record_staleness_s").count() == 2
+
+    def test_no_event_time_is_ignored(self):
+        """timestamp 0 = "no event time" (the native encoder's default):
+        no watermark, no gauges, no 1970-staleness."""
+        from flink_jpmml_tpu.obs.freshness import FreshnessTracker
+
+        m = MetricsRegistry()
+        tr = FreshnessTracker(m)
+        tr.observe_source("0", 0.0, 0.0)
+        tr.stamp_ingest(0, 64, 0.0, 0.0)
+        tr.observe_batch(0.0, 0.0)
+        tr.observe_sink(0, 64)
+        assert tr.low_watermark() is None
+        assert m.histogram("record_staleness_s").count() == 0
+        g = m.struct_snapshot()["gauges"]
+        assert "watermark_ts" not in g  # lazily registered: idle worker
+        # must not pin the fleet MIN merge at 0
+
+    def test_stamp_channel_rechunking_and_staleness(self):
+        """Ingest stamps survive the drain re-chunking offsets between
+        ingest and sink; staleness books two bounding observations per
+        consumed stamp and the sink watermark advances."""
+        from flink_jpmml_tpu.obs.freshness import FreshnessTracker
+
+        m = MetricsRegistry()
+        tr = FreshnessTracker(m)
+        now = 1_700_000_000.0
+        tr.stamp_ingest(0, 100, now - 30.0, now - 10.0)
+        tr.stamp_ingest(100, 100, now - 8.0, now - 4.0)
+        h = m.histogram("record_staleness_s")
+        # sink consumes 0..150: all of stamp 1, half of stamp 2
+        tr.observe_sink(0, 150, now=now)
+        assert h.count() == 4
+        assert abs(h.sum() - (30.0 + 10.0 + 8.0 + 4.0)) < 1e-6
+        assert tr.stage_watermark("sink") == now - 4.0
+        # the remainder of stamp 2 books on the next sink batch
+        tr.observe_sink(150, 50, now=now)
+        assert h.count() == 6
+        assert m.gauge("watermark_ts").get() == now - 4.0
+
+    def test_stamp_bound_drops_oldest(self):
+        from flink_jpmml_tpu.obs import freshness
+
+        tr = freshness.FreshnessTracker(MetricsRegistry())
+        for i in range(freshness._MAX_STAMPS + 10):
+            tr.stamp_ingest(i * 10, 10, 1e9, 1e9 + 1)
+        assert len(tr._stamps) == freshness._MAX_STAMPS
+        assert tr._stamps_dropped == 10
+
+    def test_reset_stamps_keeps_watermarks(self):
+        from flink_jpmml_tpu.obs.freshness import FreshnessTracker
+
+        m = MetricsRegistry()
+        tr = FreshnessTracker(m)
+        tr.observe_source("0", 50.0, 60.0, now=100.0)
+        tr.stamp_ingest(0, 10, 50.0, 60.0)
+        tr.reset_stamps()
+        tr.observe_sink(0, 10, now=100.0)
+        assert m.histogram("record_staleness_s").count() == 0
+        assert tr.low_watermark() == 60.0  # event time never regresses
+
+    def test_freshness_for_is_per_registry_singleton(self):
+        from flink_jpmml_tpu.obs.freshness import freshness_for
+
+        m1, m2 = MetricsRegistry(), MetricsRegistry()
+        assert freshness_for(m1) is freshness_for(m1)
+        assert freshness_for(m1) is not freshness_for(m2)
+        assert freshness_for(None) is None
+
+    def test_fleet_merge_min_watermark_worst_lag(self):
+        """The DrJAX merge-exactly discipline, pinned alongside the PR 6
+        worst-of gauge rules: fleet watermark_ts is the MIN of workers
+        (freshness = the slowest worker), lag/age/pressure gauges the
+        MAX — an average must never hide a straggler."""
+        workers = []
+        for wm, lag, press in ((1000.0, 4.0, 0.2), (940.0, 9.5, 0.9),
+                               (985.0, 0.1, 0.4)):
+            m = MetricsRegistry()
+            m.gauge("watermark_ts").set(wm)
+            m.gauge('watermark_stage_ts{stage="dispatch"}').set(wm + 5)
+            m.gauge('watermark_lag_s{partition="0"}').set(lag)
+            m.gauge('kafka_lag_age_s{partition="0"}').set(lag / 2)
+            m.gauge("lag_drain_eta_s").set(lag * 3)
+            m.gauge("lag_diverging").set(1.0 if lag > 5 else 0.0)
+            m.gauge("pressure").set(press)
+            m.gauge("ring_occupancy").set(press / 2)
+            workers.append(m.struct_snapshot())
+        g = merge_structs(workers)["gauges"]
+        assert g["watermark_ts"]["value"] == 940.0  # MIN of workers
+        assert (
+            g['watermark_stage_ts{stage="dispatch"}']["value"] == 945.0
+        )  # stage watermarks MIN too
+        assert g['watermark_lag_s{partition="0"}']["value"] == 9.5
+        assert g['kafka_lag_age_s{partition="0"}']["value"] == 4.75
+        assert g["lag_drain_eta_s"]["value"] == 28.5
+        assert g["lag_diverging"]["value"] == 1.0  # one diverging worker
+        assert g["pressure"]["value"] == 0.9  # diverges the fleet
+        assert g["ring_occupancy"]["value"] == 0.45
+
+    def test_merge_is_associative_and_order_free(self):
+        import itertools
+
+        structs = []
+        for wm in (300.0, 100.0, 200.0):
+            m = MetricsRegistry()
+            m.gauge("watermark_ts").set(wm)
+            m.gauge("pressure").set(wm / 1000.0)
+            structs.append(m.struct_snapshot())
+        outs = [
+            (merge_structs(list(p))["gauges"]["watermark_ts"]["value"],
+             merge_structs(list(p))["gauges"]["pressure"]["value"])
+            for p in itertools.permutations(structs)
+        ]
+        assert set(outs) == {(100.0, 0.3)}
+
+
+class TestLagForecaster:
+    def _mk(self, clk, **kw):
+        from flink_jpmml_tpu.obs.freshness import LagForecaster
+
+        m = MetricsRegistry()
+        kw.setdefault("window_s", 10.0)
+        kw.setdefault("stale_s", 30.0)
+        return m, LagForecaster(m, clock=clk, **kw)
+
+    def test_finite_eta_while_draining(self):
+        clk = _Clock()
+        m, fc = self._mk(clk)
+        fc.observe("0", produced=10_000, consumed=0)
+        clk.advance(2.0)
+        # 2s later: produced +400 (200/s), consumed +2400 (1200/s),
+        # backlog 8000 → ETA = 8000 / 1000 net-drain = 8 s
+        fc.observe("0", produced=10_400, consumed=2_400)
+        assert m.gauge("lag_drain_eta_s").get() == pytest.approx(8.0)
+        assert m.gauge("lag_trend").get() == pytest.approx(-1000.0)
+        assert m.gauge("lag_diverging").get() == 0.0
+
+    def test_divergence_flag_and_flight_event(self):
+        clk = _Clock()
+        m, fc = self._mk(clk)
+        before = len([e for e in recorder.events()
+                      if e.get("kind") == "lag_divergence"])
+        fc.observe("0", produced=10_000, consumed=0)
+        clk.advance(2.0)
+        fc.observe("0", produced=14_000, consumed=1_000)
+        assert m.gauge("lag_diverging").get() == 1.0
+        ev = [e for e in recorder.events()
+              if e.get("kind") == "lag_divergence"]
+        assert len(ev) == before + 1
+        assert ev[-1]["lag_records"] == 13_000
+        # rate-limited: an immediate second compute does not re-fire
+        clk.advance(1.0)
+        fc.observe("0", produced=16_000, consumed=1_500)
+        assert len([e for e in recorder.events()
+                    if e.get("kind") == "lag_divergence"]) == before + 1
+
+    def test_drained_backlog_reads_zero_eta(self):
+        clk = _Clock()
+        m, fc = self._mk(clk)
+        fc.observe("0", produced=5_000, consumed=4_990)
+        clk.advance(2.0)
+        fc.observe("0", produced=5_200, consumed=5_190)
+        # ~a fetch's worth of lag is healthy pipelining, not backlog
+        assert m.gauge("lag_drain_eta_s").get() == 0.0
+        assert m.gauge("lag_diverging").get() == 0.0
+
+    def test_stalled_partition_age_stamps_and_flags_once(self):
+        clk = _Clock()
+        m, fc = self._mk(clk, stale_s=5.0)
+        before = len([e for e in recorder.events()
+                      if e.get("kind") == "kafka_lag_stale"])
+        fc.observe("0", produced=100, consumed=100)
+        clk.advance(1.5)
+        fc.observe("1", produced=100, consumed=100)
+        clk.advance(8.5)  # partition 0 last observed 10 s ago
+        fc.observe("1", produced=200, consumed=200)
+        age = m.gauge('kafka_lag_age_s{partition="0"}').get()
+        assert age == pytest.approx(10.0)
+        assert fc.stale_partitions() == ("0",)
+        stale = [e for e in recorder.events()
+                 if e.get("kind") == "kafka_lag_stale"]
+        assert len(stale) == before + 1 and stale[-1]["partition"] == "0"
+        # still stale: no second event
+        clk.advance(2.0)
+        fc.observe("1", produced=300, consumed=300)
+        assert len([e for e in recorder.events()
+                    if e.get("kind") == "kafka_lag_stale"]) == before + 1
+        # a fresh observation recovers it (re-stall would re-fire)
+        fc.observe("0", produced=400, consumed=400)
+        assert fc.stale_partitions() == ()
+
+    def test_disabled_without_registry(self):
+        from flink_jpmml_tpu.obs.freshness import LagForecaster
+
+        fc = LagForecaster(None)
+        assert not fc.enabled
+        fc.observe("0", 100, 0)  # no-op, never raises
+        fc.sweep()
+
+    def test_scrape_ages_a_wedged_consumer(self):
+        """A wedged consumer (full ring, blocked ingest thread) never
+        re-enters the fetch path, so neither observe() nor the
+        reconnect-path sweep runs again — the /metrics scrape itself
+        must age kafka_lag_age_s and fire the staleness crossing, or
+        the staleness detector goes stale in exactly the scenario it
+        exists to expose (review finding, pinned)."""
+        clk = _Clock()
+        m, fc = self._mk(clk, stale_s=5.0)
+        fc.observe("0", produced=100, consumed=80)
+        snap = m.struct_snapshot()
+        assert snap["gauges"]['kafka_lag_age_s{partition="0"}'][
+            "value"] == 0.0
+        base_stale = len([e for e in recorder.events()
+                          if e.get("kind") == "kafka_lag_stale"])
+        clk.advance(9.0)  # consumer wedges: no observe, no fetch
+        snap = m.struct_snapshot()  # the scrape drives the sweep
+        assert snap["gauges"]['kafka_lag_age_s{partition="0"}'][
+            "value"] == pytest.approx(9.0)
+        assert len([e for e in recorder.events()
+                    if e.get("kind") == "kafka_lag_stale"]
+                   ) == base_stale + 1
+        # a collected forecaster unregisters its weak hook: the scrape
+        # must not resurrect or crash on it
+        import gc
+
+        del fc
+        gc.collect()
+        m.struct_snapshot()
+
+    def test_env_window_and_stale_config(self, monkeypatch):
+        from flink_jpmml_tpu.obs.freshness import LagForecaster
+
+        monkeypatch.setenv("FJT_LAG_WINDOW_S", "2.5")
+        monkeypatch.setenv("FJT_LAG_STALE_S", "7")
+        fc = LagForecaster(MetricsRegistry())
+        assert fc._window == 2.5 and fc._stale == 7.0
+        monkeypatch.setenv("FJT_LAG_WINDOW_S", "garbage")
+        monkeypatch.setenv("FJT_LAG_STALE_S", "-3")
+        fc = LagForecaster(MetricsRegistry())
+        assert fc._window == 10.0 and fc._stale == 30.0  # defaults
+
+
+class TestPressureMonitor:
+    def _mk(self, clk, windows=((2.0, 0.5),)):
+        from flink_jpmml_tpu.obs.pressure import PressureMonitor
+
+        m = MetricsRegistry()
+        return m, PressureMonitor(m, windows=windows, clock=clk)
+
+    def test_score_is_max_of_components(self):
+        from flink_jpmml_tpu.obs import attr
+
+        clk = _Clock()
+        m, mon = self._mk(clk)
+        mon.tick()  # establish delta baselines
+        m.gauge("ring_occupancy").set(0.3)
+        m.counter("dispatches").inc(10)
+        m.counter("window_full_launches").inc(6)
+        clk.advance(1.0)
+        out = mon.tick()
+        assert out["ring"] == pytest.approx(0.3)
+        assert out["window"] == pytest.approx(0.6)
+        assert out["wait"] == pytest.approx(0.0)
+        assert out["pressure"] == pytest.approx(0.6)
+        assert m.gauge("pressure").get() == pytest.approx(0.6)
+        # admission wait dominates when the window share is idle: 0.8 s
+        # of queue_wait over a 1 s tick = 0.8
+        m.histogram(attr.stage_metric_name("queue_wait")).observe(0.8)
+        clk.advance(1.0)
+        out = mon.tick()
+        assert out["wait"] == pytest.approx(0.8)
+        assert out["pressure"] == pytest.approx(0.8)
+
+    def test_scrape_ticks_a_wedged_pipeline(self):
+        """The batch-completion paths stop calling maybe_tick the
+        moment a sink wedges — the /metrics scrape (struct_snapshot)
+        must keep the breach tracker evaluating, like the freshness
+        detectors' scrape-side aging (review finding, pinned)."""
+        clk = _Clock()
+        m, mon = self._mk(clk, windows=((2.0, 0.5),))
+        m.gauge("ring_occupancy").set(1.0)  # ring filled, then wedge:
+        breached = False                    # nobody ticks from batches
+        for _ in range(6):
+            clk.advance(0.5)
+            m.struct_snapshot()  # the scrape drives the tick
+            breached = breached or mon.breached
+        assert breached
+        assert m.gauge("pressure").get() == 1.0
+
+    def test_concurrent_ticks_cannot_interleave_baselines(self):
+        """The delta baselines are read-modify-write state shared by
+        every submit thread's maybe_tick: two racing ticks interleaving
+        `d = get() - base; base += d` advance the baseline past the
+        real counter, clamping a genuinely saturated window-full
+        fraction to 0 forever (review finding). Pin: a second tick
+        parks on the monitor lock BEFORE reading the counters while a
+        first tick is mid-update."""
+        import threading
+
+        from flink_jpmml_tpu.obs.pressure import PressureMonitor
+
+        m = MetricsRegistry()
+        mon = PressureMonitor(m, windows=((60.0, 0.8),))
+        real = mon._dispatches
+        entered = threading.Event()
+        release = threading.Event()
+        reads: list = []
+
+        class _SlowCounter:
+            def get(self):
+                reads.append(threading.current_thread().name)
+                entered.set()
+                release.wait(5.0)
+                return real.get()
+
+        mon._dispatches = _SlowCounter()
+        t1 = threading.Thread(target=mon.tick, name="tick-1")
+        t1.start()
+        assert entered.wait(5.0)
+        t2 = threading.Thread(target=mon.tick, name="tick-2")
+        t2.start()
+        t2.join(0.3)
+        # unlocked baselines would let tick-2 straight into get();
+        # serialized ticks hold it at the lock with ONE read issued
+        assert t2.is_alive()
+        assert reads == ["tick-1"]
+        release.set()
+        t1.join(5.0)
+        t2.join(5.0)
+        assert not t1.is_alive() and not t2.is_alive()
+        assert reads == ["tick-1", "tick-2"]
+
+    def test_breach_and_clear_transitions(self):
+        clk = _Clock()
+        m, mon = self._mk(clk, windows=((2.0, 0.5),))
+        base_b = len([e for e in recorder.events()
+                      if e.get("kind") == "pressure_breach"])
+        base_c = len([e for e in recorder.events()
+                      if e.get("kind") == "pressure_clear"])
+        m.gauge("ring_occupancy").set(1.0)
+        transitions = []
+        for _ in range(6):
+            out = mon.tick()
+            if out["transition"]:
+                transitions.append(out["transition"])
+            clk.advance(0.5)
+        assert transitions == ["breach"]
+        assert mon.breached
+        assert m.counter("pressure_breaches").get() == 1
+        assert len([e for e in recorder.events()
+                    if e.get("kind") == "pressure_breach"]) == base_b + 1
+        health = mon.health()["pressure"]
+        assert health["ok"] is False and health["score"] == 1.0
+        # pressure collapses: the window mean decays below threshold
+        m.gauge("ring_occupancy").set(0.0)
+        for _ in range(8):
+            out = mon.tick()
+            if out["transition"]:
+                transitions.append(out["transition"])
+            clk.advance(0.5)
+        assert transitions == ["breach", "clear"]
+        assert not mon.breached
+        assert len([e for e in recorder.events()
+                    if e.get("kind") == "pressure_clear"]) == base_c + 1
+        assert mon.health()["pressure"]["ok"] is True
+
+    def test_cold_start_does_not_breach_on_first_tick(self):
+        clk = _Clock()
+        m, mon = self._mk(clk, windows=((60.0, 0.5),))
+        m.gauge("ring_occupancy").set(1.0)
+        out = mon.tick()
+        assert out["transition"] is None and not out["breached"]
+
+    def test_maybe_tick_rate_limit(self):
+        clk = _Clock()
+        m, mon = self._mk(clk)
+        assert mon.maybe_tick() is not None
+        clk.advance(0.1)
+        assert mon.maybe_tick() is None  # < interval_s
+        clk.advance(0.5)
+        assert mon.maybe_tick() is not None
+
+    def test_health_fn_composes(self):
+        clk = _Clock()
+        m, mon = self._mk(clk)
+        fn = mon.health_fn(lambda: {"ok": True, "workers": 2})
+        out = fn()
+        assert out["ok"] is True and out["workers"] == 2
+        assert out["pressure"]["ok"] is True
+
+    def test_env_windows_parsing(self, monkeypatch):
+        from flink_jpmml_tpu.obs.pressure import PressureMonitor
+
+        monkeypatch.setenv("FJT_PRESSURE_WINDOWS", "5:0.9,120:0.4")
+        mon = PressureMonitor(MetricsRegistry())
+        assert mon.windows == ((5.0, 0.9), (120.0, 0.4))
+        # garbage entries drop; all-garbage falls back to the default
+        monkeypatch.setenv("FJT_PRESSURE_WINDOWS", "bogus,:,-1:0.5,0:2")
+        mon = PressureMonitor(MetricsRegistry())
+        assert mon.windows == ((10.0, 0.8), (60.0, 0.6))
+
+    def test_pressure_for_is_per_registry_singleton(self):
+        from flink_jpmml_tpu.obs.pressure import pressure_for
+
+        m1, m2 = MetricsRegistry(), MetricsRegistry()
+        assert pressure_for(m1) is pressure_for(m1)
+        assert pressure_for(m1) is not pressure_for(m2)
+        assert pressure_for(None) is None
+
+class TestSinkWatermarkCap:
+    def test_sink_watermark_capped_by_straggler_partition(self):
+        """A stalled partition holding OLD unscored records must hold
+        watermark_ts back: the sink watermark is capped by the source
+        low-watermark, so 'everything up to watermark_ts was scored'
+        stays true — the straggler the fleet MIN merge exists to
+        surface, not hide (review finding, pinned)."""
+        from flink_jpmml_tpu.obs.freshness import FreshnessTracker
+
+        m = MetricsRegistry()
+        tr = FreshnessTracker(m)
+        now = 1_700_000_000.0
+        # partition 1 stalled 90 s ago; partition 0 is fresh
+        tr.observe_source("1", now - 95.0, now - 90.0, now=now)
+        tr.observe_source("0", now - 1.0, now - 0.5, now=now)
+        tr.stamp_ingest(0, 64, now - 1.0, now - 0.5)
+        tr.observe_sink(0, 64, now=now)
+        # NOT now-0.5: partition 1's 90 s-old records are unscored
+        assert m.gauge("watermark_ts").get() == now - 90.0
+        # the offsetless micro-batch path obeys the same cap
+        tr.observe_batch(now - 0.4, now - 0.2, now=now, partition="0")
+        assert m.gauge("watermark_ts").get() == now - 90.0
+        # the straggler catches up: the sink watermark follows the new
+        # low-watermark (now partition 0's, advanced by observe_batch)
+        tr.observe_source("1", now - 0.3, now - 0.1, now=now)
+        tr.stamp_ingest(64, 64, now - 0.3, now - 0.1)
+        tr.observe_sink(64, 64, now=now)
+        assert m.gauge("watermark_ts").get() == now - 0.2

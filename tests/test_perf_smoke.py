@@ -33,3 +33,4 @@ def test_perf_smoke_passes():
     assert "obs /metrics scrape OK" in proc.stdout
     assert "attribution overhead OK" in proc.stdout
     assert "rollout drill OK" in proc.stdout
+    assert "freshness burst drill OK" in proc.stdout

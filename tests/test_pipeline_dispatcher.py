@@ -44,6 +44,11 @@ class _Leaf:
             raise self.fail
         self.fetched = True
 
+    def is_ready(self):
+        # mirrors jax.Array.is_ready(): False while the async result is
+        # still in flight (here: an unelapsed delay nobody waited on)
+        return self.fetched or self.delay_s == 0.0
+
 
 class TestDispatcherUnit:
     def test_fifo_ordering_under_reversed_readiness(self):
@@ -182,6 +187,26 @@ class TestDispatcherUnit:
         assert disp.abandon() == 3
         assert len(disp) == 0
         assert not any(lf.fetched for lf in leaves)
+
+    def test_window_full_counts_only_blocking_launches(self):
+        """A healthy overlapped pipeline's steady state is a window
+        trimmed to exactly depth: the overshoot inside launch must not
+        count as saturation when the oldest batch is already done —
+        that read pressure_window ≈ 1.0 (and fired permanent
+        pressure_breach events) on every busy default-config pipeline
+        (review finding, pinned)."""
+        m = MetricsRegistry()
+        disp = OverlappedDispatcher(depth=2, metrics=m)
+        for i in range(20):
+            disp.launch(lambda i=i: _Leaf(i))  # instantly ready
+        assert m.counter("window_full_launches").get() == 0
+        # a genuinely in-flight oldest batch: the trim blocks → counted
+        disp.launch(lambda: _Leaf("slow", delay_s=0.02))
+        disp.launch(lambda: _Leaf("slow2", delay_s=0.02))
+        disp.launch(lambda: _Leaf("fast"))
+        assert m.counter("window_full_launches").get() == 1
+        disp.flush()
+        assert m.counter("dispatches").get() == 23
 
     def test_stall_and_depth_metrics(self):
         m = MetricsRegistry()

@@ -30,7 +30,16 @@ fails loudly on exactly the regressions new concurrency code breeds:
   tripwire for anyone adding per-batch work to the obs plane);
 - **rollout-plane drift**: the canary hash split must hand the
   candidate its configured fraction ±1% with zero shadow-traffic sink
-  leakage (the ``bench.py --rollout-drill`` engine at smoke scale).
+  leakage (the ``bench.py --rollout-drill`` engine at smoke scale);
+- **freshness-plane rot**: the ``bench.py --load-shape burst:2x``
+  burst-recovery drill at smoke scale — event-time ``watermark_lag_s``
+  must build under a 2× burst and recover within a bounded drain
+  window with a finite ``lag_drain_eta_s`` en route, the composite
+  ``pressure`` score must reach ≥0.5 under the burst and decay after,
+  and a live mid-drain ``/metrics`` scrape must expose non-zero
+  ``record_staleness_s`` buckets, ``pressure`` in [0,1], and
+  per-partition ``watermark_lag_s`` (the acceptance surface ROADMAP
+  item 5's adaptive-batching controller will read).
 
 Seconds-cheap by design (tier-1 guards it — tests/test_perf_smoke.py);
 exit 0 = healthy, 1 = assertion failure, 2 = watchdog fired.
@@ -490,6 +499,68 @@ def check_rollout_drill() -> None:
     assert line["shadow_compared"] > 0, line
 
 
+def check_freshness_burst_drill() -> None:
+    """Burst-recovery tripwire: the ``--load-shape burst:2x`` drill at
+    smoke scale, with the live mid-drain ``/metrics`` scrape asserted
+    against the freshness plane's acceptance surface. The drill's own
+    geometry (sink deadline-paced between base and burst rate) keeps it
+    host-speed-independent; shrunk phases keep it seconds-cheap."""
+    import re
+
+    from flink_jpmml_tpu.bench import run_burst_drill
+
+    line = run_burst_drill(
+        base_rate=8_000.0,
+        burst_factor=2.0,
+        steady_s=1.5,
+        burst_s=2.5,
+        drain_timeout_s=15.0,
+        scrape=True,
+    )
+    assert line["ok"], {k: line[k] for k in ("checks", "recovery_s",
+                                             "peak_wm_lag_s",
+                                             "peak_pressure")}
+    checks = line["checks"]
+    assert checks["recovered"] and checks["lag_built"], checks
+    assert checks["pressure_peaked"] and checks["pressure_decayed"], checks
+    assert checks["eta_finite_during_drain"], checks
+    assert line["recovery_s"] is not None and line["recovery_s"] <= 15.0
+    assert line["records_scored"] > 0
+
+    # the live scrape captured mid-drain: the fleet dashboard's view of
+    # the same drill must carry the freshness families with real values
+    text = line["metrics_scrape"]
+    assert text, "burst drill captured no /metrics page"
+    samples = {}
+    for ln in text.splitlines():
+        if ln.startswith("#") or not ln.strip():
+            continue
+        name, value = ln.split(" # ", 1)[0].rsplit(" ", 1)
+        samples[name] = float(value)
+    inf = samples.get('fjt_record_staleness_s_bucket{le="+Inf"}')
+    assert inf is not None and inf > 0, (
+        "no record_staleness_s observations in the live scrape"
+    )
+    assert samples.get("fjt_record_staleness_s_count") == inf
+    p = samples.get("fjt_pressure")
+    assert p is not None and 0.0 <= p <= 1.0, f"fjt_pressure={p}"
+    wm_keys = [
+        k for k in samples
+        if re.match(r'fjt_watermark_lag_s\{partition="[^"]+"\}', k)
+    ]
+    assert wm_keys, "no per-partition fjt_watermark_lag_s in the scrape"
+    assert all(samples[k] >= 0 for k in wm_keys)
+    assert "fjt_lag_drain_eta_s" in samples
+    assert samples.get("fjt_watermark_ts", 0) > 1e9  # a real event time
+
+    # the artifact's embedded varz struct carries the same families
+    # (the bench-artifact contract fjt-top --freshness renders)
+    varz = line["varz"]
+    assert varz["histograms"]["record_staleness_s"]["n"] > 0
+    assert "pressure" in varz["gauges"]
+    assert 'watermark_lag_s{partition="0"}' in varz["gauges"]
+
+
 def main() -> int:
     timer = threading.Timer(WATCHDOG_S, _watchdog)
     timer.daemon = True
@@ -508,6 +579,8 @@ def main() -> int:
     print("perf-smoke: attribution overhead OK", flush=True)
     check_rollout_drill()
     print("perf-smoke: rollout drill OK", flush=True)
+    check_freshness_burst_drill()
+    print("perf-smoke: freshness burst drill OK", flush=True)
     timer.cancel()
     return 0
 
