@@ -39,6 +39,7 @@ attack next. No jax import — safe on any host:
     fjt-top http://127.0.0.1:9100          # live /varz scrape
     fjt-top BENCH_r06.json                 # bench artifact's varz
     fjt-top /tmp/varz-dump.json
+    fjt-top --overload http://host:9100    # admission/deadline panel
 """
 
 from __future__ import annotations
@@ -503,6 +504,72 @@ def _top_render_freshness(label: str, struct: dict, out) -> None:
         print("(no freshness telemetry recorded)", file=out)
 
 
+def _top_render_overload(label: str, struct: dict, out) -> None:
+    """The ``--overload`` panel: the admission/adaptive-batching plane
+    (serving/overload.py) as one operator view — deadline vs live p99,
+    the chosen dispatch size, shed level + per-lane shed counts, and
+    the pressure signal the controller sheds on."""
+    from flink_jpmml_tpu.serving import overload as overload_mod
+
+    title = label or "aggregate"
+    print(f"== {title} · overload ==", file=out)
+    gauges = struct.get("gauges") or {}
+
+    def g(name):
+        v = gauges.get(name)
+        return v.get("value") if isinstance(v, dict) else None
+
+    s = overload_mod.summary(struct) or {}
+    rendered = False
+    deadline = s.get("deadline_ms")
+    if deadline:
+        rendered = True
+        p99 = s.get("p99_ms")
+        ratio = s.get("p99_vs_deadline_ratio")
+        verdict = (
+            "-" if ratio is None
+            else ("MET" if ratio <= 1.0 else "BREACHED")
+        )
+        line = f"deadline {deadline:,.1f} ms   {verdict}"
+        if p99 is not None:
+            line += (
+                f"   p99 {p99:,.1f} ms ({ratio:.2f}x, "
+                f"{s.get('latency_source')})"
+            )
+        print(line, file=out)
+    batch = s.get("adaptive_batch")
+    if batch is not None:
+        rendered = True
+        print(f"batch    {batch:,.0f} records/dispatch (adaptive cap)",
+              file=out)
+    p = g("pressure")
+    if p is not None:
+        rendered = True
+        print(f"pressure {p:5.2f}", file=out)
+    level = s.get("shed_level")
+    admitted = s.get("admitted_records")
+    shed = s.get("shed_records") or {}
+    if level is not None or admitted is not None or shed:
+        rendered = True
+        total_shed = sum(shed.values())
+        print(
+            f"admission level {level if level is not None else 0:.0f}   "
+            f"admitted {admitted or 0:,.0f}   shed {total_shed:,.0f}",
+            file=out,
+        )
+        if shed:
+            print(f"{'lane':<12}{'shed records':>14}", file=out)
+            for lane in sorted(shed):
+                print(f"{lane:<12}{shed[lane]:>14,.0f}", file=out)
+    backoff = g("reconnect_backoff_s")
+    if backoff:
+        rendered = True
+        print(f"backoff  {backoff:,.3f}s (retry streak in progress)",
+              file=out)
+    if not rendered:
+        print("(no overload telemetry recorded)", file=out)
+
+
 def top_main(argv: Optional[List[str]] = None) -> int:
     """``fjt-top``: the fleet attribution table (see module docstring).
     Renders every labelled source (the supervisor's /varz serves the
@@ -524,6 +591,10 @@ def top_main(argv: Optional[List[str]] = None) -> int:
                     help="render the freshness/backpressure panel "
                          "(event-time watermark lag, staleness, drain "
                          "forecast, pressure) instead of the stage table")
+    ap.add_argument("--overload", action="store_true",
+                    help="render the overload/admission panel (deadline "
+                         "vs p99, adaptive batch, shed level + per-lane "
+                         "shed counts) instead of the stage table")
     ap.add_argument("--watch", type=float, default=None, metavar="N",
                     help="re-render every N seconds from a live source "
                          "(operator console mode; mid-watch fetch "
@@ -531,7 +602,13 @@ def top_main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     if args.watch is not None and args.watch <= 0:
         raise SystemExit(f"--watch must be > 0, got {args.watch}")
-    render = _top_render_freshness if args.freshness else _top_render
+    if args.freshness and args.overload:
+        raise SystemExit("--freshness and --overload are exclusive")
+    render = (
+        _top_render_freshness if args.freshness
+        else _top_render_overload if args.overload
+        else _top_render
+    )
 
     def _render_once(sources) -> None:
         if args.worker is not None:

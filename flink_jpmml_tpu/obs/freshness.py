@@ -347,6 +347,25 @@ class FreshnessTracker:
         self._staleness.observe(max(now - max_ts, 0.0))
         self._set_wm_gauge(sink_wm)
 
+    def discard_stamps(self, first_off: int, n: int) -> None:
+        """Records [first_off, first_off+n) were explicitly SHED by the
+        admission controller: consume their stamps without booking
+        staleness or advancing the sink watermark — the records were
+        dropped by decision, never scored, and a shed batch booked as
+        "fresh delivery" would lie in both directions. Keeps the
+        offset-ordered channel healthy for the batches that do sink."""
+        if n <= 0:
+            return
+        end = int(first_off) + int(n)
+        with self._mu:
+            while self._stamps and self._stamps[0][0] < end:
+                entry = self._stamps[0]
+                if entry[1] <= end:
+                    self._stamps.popleft()
+                else:
+                    entry[0] = end  # mid-stamp shed: keep the remainder
+                    break
+
     def reset_stamps(self) -> None:
         """A source seek/restore invalidated the offset domain: drop
         pending stamps (watermarks stay — event time never regresses)."""

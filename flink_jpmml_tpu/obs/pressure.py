@@ -79,6 +79,15 @@ class PressureMonitor:
         self._last_tick = 0.0
         self._breached = False
         self._last = {"pressure": 0.0}
+        # pre-drain ring peak since the last tick (note_ring): the
+        # ring_occupancy GAUGE reads post-drain, and under
+        # deadline-capped multi-chunk aggregation (PR 8) one drain can
+        # empty half the ring — a tick sampling only the gauge lands on
+        # either side of that sawtooth at random, so sustained
+        # saturation looks intermittent exactly when the admission
+        # controller needs it steady. The peak-hold keeps the worst
+        # occupancy any drain STARTED from within the interval.
+        self._ring_peak = 0.0
         # delta baselines
         self._dispatches = metrics.counter("dispatches")
         self._window_full = metrics.counter("window_full_launches")
@@ -105,6 +114,14 @@ class PressureMonitor:
         # and heartbeat piggyback survive the stall (rate-limited by
         # the tick interval; held weakly)
         metrics.add_scrape_hook(self.maybe_tick)
+
+    def note_ring(self, occupancy: float) -> None:
+        """Record a PRE-drain ring occupancy observation (the block
+        score loops call this at drain start); the next tick's ring
+        component is the max of the gauge and this peak."""
+        with self._mu:
+            if occupancy > self._ring_peak:
+                self._ring_peak = occupancy
 
     # -- ticking -------------------------------------------------------------
 
@@ -139,7 +156,10 @@ class PressureMonitor:
             self._base_full += d_full
             self._base_wait = wait_sum
             self._base_t = now
-            ring = min(max(self._ring.get(), 0.0), 1.0)
+            ring = min(
+                max(self._ring.get(), self._ring_peak, 0.0), 1.0
+            )
+            self._ring_peak = 0.0
             window = (
                 min(max(d_full / d_disp, 0.0), 1.0) if d_disp > 0 else 0.0
             )

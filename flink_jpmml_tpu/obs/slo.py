@@ -128,6 +128,13 @@ class SLOTracker:
         self._last_burns: dict = {}
         if self.enabled:
             self.metrics.gauge("slo_ok").set(1.0)
+            # the configured deadline as a scrapeable gauge: fjt-top
+            # --overload and the overload drill read p99-vs-deadline
+            # from one struct without re-parsing the env (fleet merge:
+            # worst-of — identical across workers in practice)
+            self.metrics.gauge("slo_deadline_ms").set(
+                round(self.deadline_s * 1e3, 3)
+            )
 
     @property
     def enabled(self) -> bool:

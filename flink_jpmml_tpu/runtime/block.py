@@ -31,6 +31,7 @@ from flink_jpmml_tpu.obs import attr as attr_mod
 from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import spans
+from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.runtime.pipeline import (
     OverlappedDispatcher,
@@ -283,6 +284,9 @@ class BlockPipelineBase:
         max_dispatch_chunks: int = 8,
         donate: Optional[bool] = None,
         slo=None,
+        batcher=None,
+        admission=None,
+        shed_lane: str = "block",
     ):
         self._source = source
         self._sink = sink
@@ -291,6 +295,30 @@ class BlockPipelineBase:
         # piggyback pattern), so burn-rate state stays live without a
         # thread of its own
         self._slo = slo
+        # overload plane (serving/overload.py), both optional:
+        # - batcher: AdaptiveBatcher — caps opportunistic multi-chunk
+        #   aggregation at the size predicted to fit the deadline, fed
+        #   from every completed dispatch (deadline-aware batching with
+        #   no recompile);
+        # - admission: AdmissionController — drained batches it refuses
+        #   ride the FIFO window as no-op entries (offsets commit in
+        #   order, the SINK NEVER SEES a shed record) under
+        #   ``shed_lane``; its controller ticks piggyback on the
+        #   completion path like the SLO tracker's.
+        self._batcher = batcher
+        self._admission = admission
+        self._shed_lane = shed_lane
+        if admission is not None and shed_lane not in admission.lanes:
+            # unknown lanes are never shed (the safe per-record
+            # default), which here would mean a controller that climbs
+            # levels and reports shedding while refusing NOTHING —
+            # silent no-op protection is the wrong default for a
+            # whole-pipeline wire, so fail loudly at construction
+            raise InputValidationException(
+                f"shed_lane {shed_lane!r} is not one of the admission "
+                f"controller's lanes {admission.lanes!r} — this "
+                "pipeline could never shed"
+            )
         self._arity = arity
         self._batch_size = batch_size
         # >1 enables opportunistic multi-chunk dispatch on a backed-up
@@ -448,11 +476,17 @@ class BlockPipelineBase:
         partials rode along). Drained views alias the ring's reuse
         buffer, hence the copies."""
         avail = 1 + len(self._ring) // bs  # full batches on hand NOW
+        k_cap = self._max_dispatch_chunks
+        if self._batcher is not None:
+            # deadline-aware aggregation cap: a backed-up ring wants the
+            # biggest dispatch, the deadline wants the smallest — the
+            # capacity model's max_records() is where they meet (None =
+            # no deadline/no fit yet: keep the static cap)
+            mr = self._batcher.max_records()
+            if mr is not None:
+                k_cap = min(k_cap, max(1, mr // bs))
         k_target = 1
-        while (
-            k_target * 2 <= avail
-            and k_target * 2 <= self._max_dispatch_chunks
-        ):
+        while k_target * 2 <= avail and k_target * 2 <= k_cap:
             k_target *= 2
         if k_target == 1:
             return X, offsets, bs
@@ -610,9 +644,21 @@ class BlockPipelineBase:
 
         def _complete(pair, meta):
             """FIFO completion off the dispatcher: sink, then commit —
-            offsets only advance past records that reached the sink."""
+            offsets only advance past records that reached the sink.
+            A SHED entry (admission refusal, a no-op through the same
+            FIFO window) commits its offsets and consumes its freshness
+            stamps without ever touching the sink — the drop is
+            explicit, bounded, and replay-consistent."""
+            n, first_off, t_start, shed = meta
+            if shed:
+                self.committed_offset = first_off + n
+                if freshness is not None:
+                    freshness.discard_stamps(first_off, n)
+                self._ckpt.maybe_save(self._ckpt_state)
+                if monitor is not None:
+                    monitor.maybe_tick()
+                return
             out, decode = pair
-            n, first_off, t_start = meta
             t_sink = time.monotonic()
             self._emit(out, n, first_off, decode)
             t_done = time.monotonic()
@@ -621,6 +667,10 @@ class BlockPipelineBase:
                 ledger.observe("sink", t_done - t_sink)
             lat.observe(t_done - t_start)
             records_out.inc(n)
+            if self._batcher is not None:
+                # the capacity model's verify half: every completed
+                # dispatch is a (size, latency) observation
+                self._batcher.observe(n, t_done - t_start)
             self.committed_offset = first_off + n
             if freshness is not None:
                 # consume the source's ingest stamps for this offset
@@ -649,6 +699,9 @@ class BlockPipelineBase:
             while True:
                 if self._stop.is_set() and not self._drain_all:
                     break  # stop(): skip the uncommitted backlog
+                # worker-wedge injection point (runtime/faults.py): a
+                # global load + None check when no faults are configured
+                faults.fire("score_loop")
                 # with work in flight the first-record wait must be
                 # bounded: an indefinitely-blocked drain on a paused
                 # feed would pin completed batches uncommitted (and
@@ -658,6 +711,13 @@ class BlockPipelineBase:
                     if len(disp) and self._IDLE_WAIT_US < 0
                     else self._IDLE_WAIT_US
                 )
+                if monitor is not None:
+                    # pre-drain occupancy peak-hold: the saturation
+                    # signal a post-drain gauge read undersamples when
+                    # one aggregated drain empties half the ring
+                    monitor.note_ring(
+                        min(len(self._ring) / ring_cap, 1.0)
+                    )
                 if self._carry_drain is not None:
                     X, offsets = self._carry_drain
                     self._carry_drain = None
@@ -687,6 +747,26 @@ class BlockPipelineBase:
                     disp.flush()
                     self._on_idle()
                     continue
+                if self._admission is not None:
+                    self._admission.maybe_tick()
+                    if not self._admission.admit(self._shed_lane, n):
+                        # explicit load shed: the batch rides the FIFO
+                        # window as a no-op entry, so its offsets still
+                        # commit strictly in launch order behind the
+                        # in-flight dispatches — the sink never sees it
+                        # and a restore replays nothing extra; the
+                        # entry is UNACCOUNTED (no device work — it
+                        # must not dilute the dispatch counters the
+                        # pressure score divides by)
+                        disp.launch(
+                            lambda: None,
+                            meta=(
+                                n, int(offsets[0]) if n else 0,
+                                time.monotonic(), True,
+                            ),
+                            accounted=False,
+                        )
+                        continue
                 handle = self._acquire(disp.finish_oldest)
                 if handle is None:
                     # abandoned (dynamic give-up): drop un-fetched work;
@@ -708,7 +788,7 @@ class BlockPipelineBase:
                 t_start = time.monotonic()
                 disp.launch(
                     lambda h=handle, X=X, n=n: self._dispatch(h, X, n),
-                    meta=(n, int(offsets[0]) if n else 0, t_start),
+                    meta=(n, int(offsets[0]) if n else 0, t_start, False),
                     # opts this launch into the sampled device-timing
                     # pool (rate-limited; obs/profiler.py) — the live
                     # MFU/membw gauges and the kernel cost ledger;
@@ -755,6 +835,9 @@ class BlockPipeline(BlockPipelineBase):
         max_dispatch_chunks: int = 8,
         donate: Optional[bool] = None,
         slo=None,
+        batcher=None,
+        admission=None,
+        shed_lane: str = "block",
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -774,6 +857,9 @@ class BlockPipeline(BlockPipelineBase):
             max_dispatch_chunks=max_dispatch_chunks,
             donate=donate,
             slo=slo,
+            batcher=batcher,
+            admission=admission,
+            shed_lane=shed_lane,
         )
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend

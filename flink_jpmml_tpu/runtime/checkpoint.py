@@ -7,8 +7,15 @@ owns the whole mechanism, but the state is deliberately tiny and JSON-shaped:
 never checkpointed — models reload from their PMML paths on resume, exactly
 like the reference's idempotent ``open()`` reload (capability C2).
 
-Atomicity: write to a temp file in the same directory, fsync, rename.
+Atomicity: write to a temp file in the same directory, fsync, replace,
+fsync the DIRECTORY — the last step makes the rename itself durable, so
+a crash at any instant leaves either the previous snapshot set or the
+new one, never a truncated newest file (pinned by the kill-mid-write
+drill in tests/test_checkpoint.py).
 Retention: the last ``keep`` checkpoints are kept for manual rollback.
+Transient write failures retry through the shared capped-jittered
+backoff (utils/retry.py, the kafka reconnect schedule); only an
+exhausted streak raises.
 """
 
 from __future__ import annotations
@@ -21,7 +28,9 @@ import warnings
 from typing import Any, Dict, Optional
 
 from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.utils.exceptions import CheckpointException
+from flink_jpmml_tpu.utils.retry import Backoff
 
 _PREFIX = "ckpt-"
 
@@ -66,24 +75,79 @@ class CheckpointManager:
         os.makedirs(directory, exist_ok=True)
 
     def save(self, state: Dict[str, Any]) -> str:
+        """Write one snapshot crash-safely, retrying transient failures.
+
+        Each attempt is temp-file → fsync → ``os.replace`` → directory
+        fsync: the file's bytes are durable before the name appears,
+        and the name itself is durable before save() returns — a crash
+        (or SIGKILL) at ANY instant leaves every ``ckpt-*.json``
+        parseable. Transient OSErrors (EMFILE, an NFS hiccup, a full
+        disk that clears) retry with the shared jittered backoff; an
+        exhausted streak raises so the operator sees a checkpoint plane
+        that cannot make progress."""
         payload = {"timestamp": time.time(), "state": state}
-        try:
-            fd, tmp = tempfile.mkstemp(prefix=".tmp-", dir=self._dir)
-            with os.fdopen(fd, "w", encoding="utf-8") as f:
-                json.dump(payload, f)
-                f.flush()
-                os.fsync(f.fileno())
-            path = os.path.join(self._dir, f"{_PREFIX}{int(time.time() * 1e6)}.json")
-            os.rename(tmp, path)
-        except OSError as e:
-            flight.record("checkpoint_save_failed", error=str(e))
-            raise CheckpointException(f"cannot write checkpoint: {e}") from e
+        backoff = Backoff("checkpoint")
+        while True:
+            try:
+                path = self._write_once(payload)
+            except OSError as e:
+                flight.record(
+                    "checkpoint_save_retry",
+                    error=str(e), attempt=backoff.attempts + 1,
+                )
+                if backoff.exhausted:
+                    flight.record("checkpoint_save_failed", error=str(e))
+                    raise CheckpointException(
+                        f"cannot write checkpoint after "
+                        f"{backoff.attempts} retries: {e}"
+                    ) from e
+                backoff.sleep()
+                continue
+            break
         flight.record(
             "checkpoint_save", path=path,
             source_offset=state.get("source_offset"),
+            retries=backoff.attempts,
         )
         self._gc()
         return path
+
+    def _write_once(self, payload: Dict[str, Any]) -> str:
+        """One crash-safe write attempt; raises OSError on failure
+        (the temp file, if any, is removed so retries can't litter)."""
+        fd, tmp = tempfile.mkstemp(prefix=".tmp-", dir=self._dir)
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+                f.flush()
+                # mid-write fault hook (runtime/faults.py): an injected
+                # OSError here leaves a partial temp file — exactly the
+                # crash the atomic-replace protocol must survive
+                faults.fire("checkpoint_write")
+                os.fsync(f.fileno())
+            path = os.path.join(
+                self._dir, f"{_PREFIX}{int(time.time() * 1e6)}.json"
+            )
+            os.replace(tmp, path)
+            tmp = None
+            # durable NAME, not just durable bytes: fsync the directory
+            # so the replace survives a crash (best-effort — some
+            # filesystems refuse directory fds)
+            try:
+                dfd = os.open(self._dir, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+            return path
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
 
     def load_latest(self) -> Optional[Dict[str, Any]]:
         """Newest readable checkpoint's state (None when none exist).

@@ -1,0 +1,253 @@
+"""Deterministic fault injection: overload and recovery, drilled.
+
+PRs 3-7 built the telemetry that *reports* a dying broker, a wedged
+worker, or a failed checkpoint write — but every one of those paths was
+only ever exercised by whatever chaos a test could improvise (monkey-
+patched sockets, killed subprocesses). This harness injects the faults
+into the REAL code paths, deterministically, so the overload drill and
+the recovery tests run the same failure the same way every time:
+
+=================  =========================================  ===========================
+kind               fires in (site)                            effect
+=================  =========================================  ===========================
+``broker_death``   kafka fetch RPC (``runtime/kafka.py``)     raises ``ConnectionError`` →
+                                                              the real reconnect/backoff path
+``slow_fetch``     kafka fetch RPC                            sleeps ``delay_ms``
+``dispatch_delay`` device dispatch                            sleeps ``delay_ms`` before the
+                   (``OverlappedDispatcher.launch``)          dispatch is issued
+``checkpoint_fail`` checkpoint write                          raises ``OSError`` mid-write →
+                   (``CheckpointManager.save``)               the retry/backoff path
+``worker_wedge``   the block score loop                       sleeps ``wedge_s`` per fire —
+                                                              the heartbeat-wedge shape
+=================  =========================================  ===========================
+
+Two front doors:
+
+- **env** — ``FJT_FAULTS`` holds comma-separated specs, each a kind
+  followed by ``:key=value`` params::
+
+      FJT_FAULTS="slow_fetch:delay_ms=40:p=0.5,broker_death:after_s=5:for_s=2"
+
+  parsed once at import (and re-parseable via :func:`install_from_env`);
+  a malformed spec is skipped loudly (stderr), never fatal.
+- **programmatic** — :func:`inject`/:func:`clear` for tests and drills.
+
+Gate params (all optional): ``after_s`` (arm delay from install),
+``for_s`` (active window after arming), ``n`` (max fires), ``p``
+(per-call probability from a seeded RNG — ``seed`` makes it
+deterministic), ``delay_ms`` / ``wedge_s`` (the action magnitudes).
+
+Every fire records a rate-limited ``fault_injected`` flight event (≥1 s
+apart per fault — the flight ring is for rare events; exact counts live
+in :func:`stats`).
+
+**Zero-overhead contract**: with no faults configured, ``fire(site)``
+is one global load and a None check — pinned by the perf-smoke
+tripwire. Hook sites sit on per-fetch / per-batch paths, never
+per-record.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from flink_jpmml_tpu.obs import recorder as flight
+
+_ENV = "FJT_FAULTS"
+_EVENT_MIN_PERIOD_S = 1.0
+
+# the sites the runtime actually hooks; a kind IS its site mapping
+SITES = {
+    "broker_death": "kafka_fetch",
+    "slow_fetch": "kafka_fetch",
+    "dispatch_delay": "dispatch",
+    "checkpoint_fail": "checkpoint_write",
+    "worker_wedge": "score_loop",
+}
+
+
+class InjectedBrokerDeath(ConnectionError):
+    """Injected broker death: rides the kafka sources' real
+    ``except (OSError, ConnectionError, ...)`` → reconnect path."""
+
+
+class InjectedCheckpointFailure(OSError):
+    """Injected checkpoint write failure: rides ``CheckpointManager
+    .save``'s real ``except OSError`` → retry/backoff path."""
+
+
+class _Fault:
+    """One configured fault: its gates (arm delay, active window, count
+    cap, probability) and its action."""
+
+    def __init__(self, kind: str, params: Dict[str, float],
+                 clock=time.monotonic):
+        if kind not in SITES:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (have {sorted(SITES)})"
+            )
+        self.kind = kind
+        self.site = SITES[kind]
+        self._clock = clock
+        self._t0 = clock()
+        self.after_s = float(params.get("after_s", 0.0))
+        self.for_s = params.get("for_s")
+        self.max_fires = (
+            int(params["n"]) if params.get("n") is not None else None
+        )
+        self.p = params.get("p")
+        self.delay_s = float(params.get("delay_ms", 50.0)) / 1000.0
+        self.wedge_s = float(params.get("wedge_s", 0.5))
+        # seeded by default: the SAME drill injects the SAME faults —
+        # determinism is the point of a harness over improvised chaos
+        self._rng = random.Random(int(params.get("seed", 0xFA17)))
+        self.fires = 0
+        self._last_event = 0.0
+        self._mu = threading.Lock()
+
+    def try_claim(self) -> bool:
+        """Evaluate the gates; claim one fire when they all pass."""
+        now = self._clock()
+        armed_at = self._t0 + self.after_s
+        if now < armed_at:
+            return False
+        if self.for_s is not None and now > armed_at + float(self.for_s):
+            return False
+        with self._mu:
+            if self.max_fires is not None and self.fires >= self.max_fires:
+                return False
+            if self.p is not None and self._rng.random() >= float(self.p):
+                return False
+            self.fires += 1
+            event_due = now - self._last_event >= _EVENT_MIN_PERIOD_S
+            if event_due:
+                self._last_event = now
+        if event_due:
+            flight.record(
+                "fault_injected", fault=self.kind, site=self.site,
+                fires=self.fires,
+            )
+        return True
+
+    def act(self) -> None:
+        if self.kind == "broker_death":
+            raise InjectedBrokerDeath("injected broker death")
+        if self.kind == "checkpoint_fail":
+            raise InjectedCheckpointFailure(
+                "injected checkpoint write failure"
+            )
+        if self.kind == "worker_wedge":
+            time.sleep(self.wedge_s)
+        else:  # slow_fetch / dispatch_delay
+            time.sleep(self.delay_s)
+
+
+class FaultPlan:
+    def __init__(self, faults: List[_Fault]):
+        self.faults = faults
+        self._by_site: Dict[str, List[_Fault]] = {}
+        for f in faults:
+            self._by_site.setdefault(f.site, []).append(f)
+
+    def fire(self, site: str) -> None:
+        for f in self._by_site.get(site, ()):
+            if f.try_claim():
+                f.act()
+
+
+# None = no faults configured: fire() is a global load + None check
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def fire(site: str) -> None:
+    """The hook the runtime calls at each injection site. A raised
+    fault propagates to the caller's real error-handling path."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    plan.fire(site)
+
+
+def active() -> bool:
+    return _ACTIVE is not None
+
+
+def inject(kind: str, **params) -> _Fault:
+    """Programmatically add one fault (tests/drills). → the fault, so
+    the caller can read ``fires``."""
+    global _ACTIVE
+    f = _Fault(kind, params)
+    faults = list(_ACTIVE.faults) if _ACTIVE is not None else []
+    faults.append(f)
+    _ACTIVE = FaultPlan(faults)
+    return f
+
+
+def clear() -> None:
+    """Drop every configured fault (idempotent)."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def stats() -> Dict[str, int]:
+    """→ {kind: fires} for every configured fault (summed per kind)."""
+    plan = _ACTIVE
+    out: Dict[str, int] = {}
+    if plan is not None:
+        for f in plan.faults:
+            out[f.kind] = out.get(f.kind, 0) + f.fires
+    return out
+
+
+def parse_spec(spec: str) -> List[_Fault]:
+    """Parse the ``FJT_FAULTS`` grammar → faults. Raises ValueError on
+    an unknown kind or an unparseable param."""
+    faults: List[_Fault] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pieces = part.split(":")
+        kind = pieces[0].strip()
+        params: Dict[str, float] = {}
+        for kv in pieces[1:]:
+            k, _, v = kv.partition("=")
+            if not _ or not k.strip():
+                raise ValueError(f"bad fault param {kv!r} in {part!r}")
+            params[k.strip()] = float(v)
+        faults.append(_Fault(kind, params))
+    return faults
+
+
+def install_from_env(env: Optional[str] = None) -> bool:
+    """(Re)install the plan from ``FJT_FAULTS`` (or ``env``). → True
+    when faults were installed. A malformed spec is skipped loudly on
+    stderr — a typo in a drill config must not crash the pipeline it
+    was meant to drill."""
+    global _ACTIVE
+    raw = os.environ.get(_ENV) if env is None else env
+    if not raw:
+        return False
+    try:
+        faults = parse_spec(raw)
+    except ValueError as e:
+        print(f"[fjt-faults] ignoring {_ENV}={raw!r}: {e}",
+              file=sys.stderr, flush=True)
+        return False
+    if not faults:
+        return False
+    _ACTIVE = FaultPlan(faults)
+    flight.record(
+        "faults_installed", kinds=[f.kind for f in faults], spec=raw,
+    )
+    return True
+
+
+# env faults arm at import so every process in a drill (workers spawned
+# by the supervisor included) picks them up with no plumbing
+install_from_env()

@@ -49,8 +49,10 @@ import numpy as np
 from flink_jpmml_tpu.obs import attr
 from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime.block import BlockSource
 from flink_jpmml_tpu.runtime.sources import Polled, Record, Source
+from flink_jpmml_tpu.utils.retry import Backoff
 
 API_PRODUCE = 0
 API_FETCH = 1
@@ -705,7 +707,15 @@ class _KafkaSourceBase:
         # fetch RPC can slurp — load drills cap it so broker-side lag
         # stays observable instead of teleporting into host memory
         self._max_bytes = int(max_bytes)
-        self._backoff = reconnect_backoff_s
+        # capped exponential backoff with full jitter (utils/retry.py):
+        # the constructor's reconnect_backoff_s is the base delay
+        # (FJT_RETRY_* env overrides); consecutive failures back off to
+        # the cap so N consumers of a dead broker don't storm it in
+        # lockstep the instant it heals, and the current delay rides
+        # the reconnect_backoff_s gauge (fleet merge: worst-of)
+        self._backoff = Backoff(
+            "kafka", base_s=reconnect_backoff_s, metrics=metrics
+        )
         self._eos = False
 
     def _reconnect(self) -> None:
@@ -715,9 +725,10 @@ class _KafkaSourceBase:
         flight.record(
             "kafka_reconnect", topic=self._topic,
             partitions=list(self._parts),
+            attempt=self._backoff.attempts + 1,
         )
         self._client.close()
-        time.sleep(self._backoff)
+        self._backoff.sleep()
         try:
             self._client.connect()
         except OSError:
@@ -750,6 +761,10 @@ class _KafkaSourceBase:
     ) -> List[Tuple[int, bytes]]:
         t0 = time.monotonic()
         try:
+            # fault hooks INSIDE the try: an injected broker death rides
+            # the same except → reconnect/backoff path a real one does,
+            # and an injected slow fetch lands in the fetch histogram
+            faults.fire("kafka_fetch")
             hw, record_set = self._client.fetch_raw(
                 self._topic, part, offset,
                 max_wait_ms=(
@@ -763,6 +778,7 @@ class _KafkaSourceBase:
             self._reconnect()
             self._sweep_lag_age()
             return []
+        self._backoff.reset()  # a successful fetch closes the streak
         self._note_event_times(part, record_set)
         self._observe_fetch(part, offset, hw, t0)
         return [
@@ -776,6 +792,7 @@ class _KafkaSourceBase:
     ) -> bytes:
         t0 = time.monotonic()
         try:
+            faults.fire("kafka_fetch")  # see _fetch_part
             hw, raw = self._client.fetch_raw(
                 self._topic, part, offset,
                 max_wait_ms=(
@@ -789,6 +806,7 @@ class _KafkaSourceBase:
             self._reconnect()
             self._sweep_lag_age()
             return b""
+        self._backoff.reset()
         self._note_event_times(part, raw)
         self._observe_fetch(part, offset, hw, t0)
         return raw

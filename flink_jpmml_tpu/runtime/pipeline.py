@@ -66,6 +66,7 @@ from flink_jpmml_tpu.obs import attr as attr_mod
 from flink_jpmml_tpu.obs import profiler as prof_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import spans
+from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.utils.exceptions import FlinkJpmmlTpuError
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 
@@ -359,6 +360,7 @@ class OverlappedDispatcher:
         dispatch_fn: Callable[[], Any],
         meta: Any = None,
         profile: Optional[dict] = None,
+        accounted: bool = True,
     ) -> _InFlight:
         """Dispatch asynchronously and admit the result to the window.
 
@@ -377,9 +379,19 @@ class OverlappedDispatcher:
         device execution, feeding the live
         ``device_mfu``/``device_membw_util`` gauges and the kernel cost
         ledger. Unsampled launches pay one predicate check.
+
+        ``accounted=False`` keeps this entry out of the ``dispatches``
+        and window-full counters: the admission controller's SHED
+        no-ops ride the window only for FIFO offset commits — counting
+        them as dispatches would dilute the pressure monitor's
+        window-full fraction (real-dispatch denominator) exactly while
+        the shed rate is highest, flapping the gate open mid-overload.
         """
         if self._closed:
             raise DispatcherClosed("launch() on a closed dispatcher")
+        # device-dispatch delay injection (runtime/faults.py): a global
+        # load + None check when no faults are configured
+        faults.fire("dispatch")
         prof = self._profiler
         sampling = (
             prof is not None
@@ -429,9 +441,11 @@ class OverlappedDispatcher:
         _prefetch_host(out)
         handle = _InFlight(out, meta, time.monotonic())
         self._window.append(handle)
-        self._dispatches.inc()
+        if accounted:
+            self._dispatches.inc()
         if (
-            self._depth is not None
+            accounted
+            and self._depth is not None
             and self._depth > 0
             and len(self._window) > self._depth
             # a healthy overlapped pipeline's steady state is a window

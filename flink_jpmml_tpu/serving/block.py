@@ -96,6 +96,9 @@ class DynamicBlockPipeline(BlockPipelineBase):
         max_dispatch_chunks: int = 8,
         donate: Optional[bool] = None,
         slo=None,
+        batcher=None,
+        admission=None,
+        shed_lane: str = "block",
     ):
         if batch_size <= 0:
             raise InputValidationException(
@@ -122,8 +125,13 @@ class DynamicBlockPipeline(BlockPipelineBase):
             max_dispatch_chunks=max_dispatch_chunks,
             donate=donate,
             # deadline SLO burn-rate tracking (obs/slo.py) rides the
-            # completion path here exactly as on the static pipeline
+            # completion path here exactly as on the static pipeline,
+            # and so does the overload plane (serving/overload.py):
+            # deadline-capped aggregation + admission shedding
             slo=slo,
+            batcher=batcher,
+            admission=admission,
+            shed_lane=shed_lane,
         )
         self._control = control
         self._name = name

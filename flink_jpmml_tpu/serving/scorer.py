@@ -81,6 +81,17 @@ def default_route(event: Any) -> Tuple[Optional[str], Optional[int], Any]:
     return None, None, event
 
 
+def default_lane(payload: Any) -> str:
+    """Admission lane of a routed payload: the ``"_lane"`` key on dict
+    records, else ``"normal"`` (cf. the ``"_key"`` canary-split
+    convention in rollout/split.py)."""
+    if isinstance(payload, dict):
+        lane = payload.get("_lane")
+        if isinstance(lane, str):
+            return lane
+    return "normal"
+
+
 class DynamicScorer(Scorer):
     def __init__(
         self,
@@ -101,6 +112,9 @@ class DynamicScorer(Scorer):
         auto_rollout: bool = True,
         rollout_interval_s: float = 0.5,
         event_time_fn: Optional[Callable[[Any], Optional[float]]] = None,
+        admission=None,
+        lane_fn: Optional[Callable[[Any], str]] = None,
+        batcher=None,
     ):
         """``async_warmup=False`` disables background warming: a newly
         Added model compiles synchronously inside ``submit`` on its first
@@ -132,7 +146,19 @@ class DynamicScorer(Scorer):
         finished micro-batch books ``record_staleness_s`` and advances
         the event-time watermark from the batch's min/max event times —
         the dynamic-path twin of the block pipelines' offset-keyed
-        ingest stamps."""
+        ingest stamps.
+
+        Overload plane (serving/overload.py): ``admission`` (an
+        :class:`AdmissionController`) gates every event BEFORE routing
+        — a shed event emits ``Prediction.empty()`` and is never
+        dispatched, mirrored, or shadow-diffed (the pinned
+        zero-leakage invariant); ``lane_fn`` derives its priority lane
+        from the routed payload (default: the ``"_lane"`` key on dict
+        records, else ``"normal"``); the controller's hysteresis ticks
+        piggyback on this batch loop like the rollout controller's.
+        ``batcher`` (an :class:`AdaptiveBatcher`) receives every
+        micro-batch completion as a capacity observation, feeding the
+        persisted per-(model, backend) capacity model."""
         self.registry = ModelRegistry(
             batch_size=batch_size,
             compile_config=compile_config,
@@ -185,6 +211,9 @@ class DynamicScorer(Scorer):
         # latency histogram, ticked from the batch loop like the
         # rollout controller; inert without FJT_SLO_TARGET_MS
         self.slo = SLOTracker(self.metrics, source="score_latency_s")
+        self.admission = admission
+        self.batcher = batcher
+        self._lane_fn = lane_fn or default_lane
 
     def _drain_control(self) -> None:
         while True:
@@ -222,6 +251,8 @@ class DynamicScorer(Scorer):
         self._drain_control()
         if self._auto_rollout:
             self.rollout_controller.maybe_tick()
+        if self.admission is not None:
+            self.admission.maybe_tick()
         active = self.registry.rollouts()  # name -> RolloutState
         n = len(records)
         # model-key -> [scoring model, [indices], [payloads], rollinfo]
@@ -235,8 +266,17 @@ class DynamicScorer(Scorer):
         # registry lock, and the answer cannot change within one batch
         cand_models: dict = {}
         unserved: List[int] = []
+        shed: List[int] = []
         for i, event in enumerate(records):
             name, version, payload = self._route(event)
+            if self.admission is not None and not self.admission.admit(
+                self._lane_fn(payload)
+            ):
+                # shed BEFORE any model work: the event is never
+                # resolved, dispatched, mirrored, or diffed — it leaves
+                # finish() as an explicit empty prediction
+                shed.append(i)
+                continue
             model = None
             ro = active.get(name) if name is not None else None
             cand_model = None
@@ -360,7 +400,10 @@ class DynamicScorer(Scorer):
         for name, (model, idxs, payloads) in mirrors.items():
             handle, scorer = self._launch_group(model, payloads)
             shadows.append((scorer, idxs, handle, name))
-        return (n, records, tickets, shadows, unserved, time.monotonic())
+        return (
+            n, records, tickets, shadows, unserved, shed,
+            time.monotonic(),
+        )
 
     def _launch_group(self, model, payloads):
         """Featurize + async-dispatch one per-model group through the
@@ -411,7 +454,7 @@ class DynamicScorer(Scorer):
         return handle, model
 
     def finish(self, ticket) -> List[Any]:
-        n, records, tickets, shadows, unserved, t_submit = ticket
+        n, records, tickets, shadows, unserved, shed, t_submit = ticket
         preds: List[Optional[Prediction]] = [None] * n
         for model, idxs, handle, rollinfo in tickets:
             role = rollinfo[1] if rollinfo is not None else None
@@ -448,11 +491,34 @@ class DynamicScorer(Scorer):
         self._diff_shadows(shadows, preds)
         for i in unserved:
             preds[i] = Prediction.empty()
+        for i in shed:
+            # explicit degradation, not an error: the lane was refused
+            # by the admission controller at submit (C5 totality holds —
+            # every record gets a prediction, a shed one gets empty)
+            preds[i] = Prediction.empty()
         if tickets:  # an all-unserved batch scored nothing: no sample
-            self._lat.observe(time.monotonic() - t_submit)
+            dt = time.monotonic() - t_submit
+            self._lat.observe(dt)
+            if self.batcher is not None:
+                scored = n - len(unserved) - len(shed)
+                if scored > 0:
+                    self.batcher.observe(scored, dt)
         self.slo.maybe_tick()  # burn-rate state rides the batch loop
         if self._freshness is not None and records:
-            tr = batch_event_range(records, self._event_time_fn)
+            if shed:
+                # shed records were DROPPED, not delivered: booking
+                # their event times would advance the sink watermark
+                # (fleet MIN) and the staleness books exactly while the
+                # worker is refusing load — the same lie the block
+                # path's discard_stamps exists to prevent
+                shed_set = set(shed)
+                served = [
+                    r for i, r in enumerate(records)
+                    if i not in shed_set
+                ]
+            else:
+                served = records
+            tr = batch_event_range(served, self._event_time_fn)
             if tr is not None:
                 # micro-batches complete synchronously from the
                 # caller's view: one call books staleness and advances

@@ -410,14 +410,21 @@ class MetricsRegistry:
 #: same discipline: lag/age/staleness/pressure gauges take the WORST
 #: worker, and the ``watermark_ts`` low-watermark takes the MIN — fleet
 #: freshness is the slowest worker, never an average.
+#: The overload plane (serving/overload.py, utils/retry.py) follows the
+#: same discipline: ``shed_level`` / ``reconnect_backoff_s`` take the
+#: WORST worker (the deepest-shedding / deepest-in-retry one),
+#: ``slo_deadline_ms`` is config (identical across workers — max is a
+#: no-op that beats summing it), and ``adaptive_batch`` takes the MIN
+#: (the most deadline-constrained worker is the one to look at).
 _GAUGE_MERGE_MAX_PREFIXES = (
     "device_mfu", "device_membw_util", "device_ns_per_record",
     "flops_per_record", "slo_burn_rate",
     "watermark_lag_s", "kafka_lag_age_s", "lag_drain_eta_s",
     "lag_trend", "lag_diverging", "pressure", "ring_occupancy",
+    "shed_level", "reconnect_backoff_s", "slo_deadline_ms",
 )
 _GAUGE_MERGE_MIN_PREFIXES = (
-    "slo_ok", "watermark_ts", "watermark_stage_ts",
+    "slo_ok", "watermark_ts", "watermark_stage_ts", "adaptive_batch",
 )
 
 

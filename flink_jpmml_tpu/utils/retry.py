@@ -1,0 +1,162 @@
+"""Capped exponential backoff with full jitter — the shared retry cadence.
+
+Two fixed retry cadences survived into PR 7: the kafka reconnect path
+slept a constant ``reconnect_backoff_s`` per failure (N consumers of a
+dead broker retrying in lockstep are a reconnect storm the instant it
+heals), and checkpoint writes had no retry at all (one transient OSError
+lost the snapshot cadence). Both now share this helper:
+
+    delay_k = uniform(0, min(cap, base * 2**k))
+
+— the classic *full jitter* schedule: exponential growth bounds the
+pressure a dead dependency sees, the jitter decorrelates a fleet's
+retries, the cap bounds the worst-case wait.
+
+Env config (overrides the caller's defaults when set):
+``FJT_RETRY_BASE_S`` (base delay), ``FJT_RETRY_CAP_S`` (delay ceiling),
+``FJT_RETRY_MAX`` (attempts per streak before the give-up signal).
+Crossing the max records ONE ``retry_give_up`` flight event per streak
+(and a ``retry_give_ups`` counter when a registry is attached); what
+"give up" means stays the caller's policy — a streaming consumer keeps
+retrying at the cap (degrade loudly, never die silently), a checkpoint
+write raises. The current delay is exported as the
+``reconnect_backoff_s`` gauge (fleet merge: worst-of) so an operator
+can see which worker is deep in a retry streak.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, Optional
+
+from flink_jpmml_tpu.obs import recorder as flight
+
+_BASE_ENV = "FJT_RETRY_BASE_S"
+_CAP_ENV = "FJT_RETRY_CAP_S"
+_MAX_ENV = "FJT_RETRY_MAX"
+
+_DEFAULT_BASE_S = 0.05
+_DEFAULT_CAP_S = 5.0
+_DEFAULT_MAX = 8
+
+
+def env_float(name: str, fallback: float) -> float:
+    """Positive-float env knob with a silent fallback (the FJT_* knob
+    convention; shared with serving/overload.py — one parse semantics
+    for the retry and shedding thresholds)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return fallback
+    try:
+        v = float(raw)
+    except ValueError:
+        return fallback
+    return v if v > 0 else fallback
+
+
+def env_int(name: str, fallback: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return fallback
+    try:
+        v = int(raw)
+    except ValueError:
+        return fallback
+    return v if v > 0 else fallback
+
+
+class Backoff:
+    """One retry *streak*'s state: consecutive failures, the jittered
+    delay schedule, and the give-up signal.
+
+    ``what`` labels flight events (``"kafka"``, ``"checkpoint"``);
+    ``base_s``/``cap_s``/``max_attempts`` default from the ``FJT_RETRY_*``
+    env, falling back to the caller's values. ``metrics`` (optional)
+    exports the current delay as ``reconnect_backoff_s`` and counts
+    ``retry_give_ups``. Call :meth:`reset` on success — it closes the
+    streak and re-arms the give-up event."""
+
+    def __init__(
+        self,
+        what: str,
+        base_s: float = _DEFAULT_BASE_S,
+        cap_s: float = _DEFAULT_CAP_S,
+        max_attempts: int = _DEFAULT_MAX,
+        metrics=None,
+        rng: Optional[Callable[[], float]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self._what = what
+        self.base_s = env_float(_BASE_ENV, base_s)
+        self.cap_s = max(env_float(_CAP_ENV, cap_s), self.base_s)
+        self.max_attempts = env_int(_MAX_ENV, max_attempts)
+        self._rng = rng if rng is not None else random.random
+        self._sleep = sleep
+        self._attempts = 0
+        self._gave_up = False
+        self._gauge = (
+            metrics.gauge("reconnect_backoff_s")
+            if metrics is not None else None
+        )
+        self._give_ups = (
+            metrics.counter("retry_give_ups")
+            if metrics is not None else None
+        )
+
+    @property
+    def attempts(self) -> int:
+        return self._attempts
+
+    @property
+    def exhausted(self) -> bool:
+        """True once the streak has crossed ``max_attempts`` — the
+        caller's abort signal when it has one (checkpoint writes); loop
+        callers ignore it and keep paying the capped delay."""
+        return self._attempts >= self.max_attempts
+
+    def next_delay(self) -> float:
+        """Advance the streak and return the next jittered delay."""
+        # exponent clamped BEFORE the pow: 2.0**1024 raises
+        # OverflowError, and an overnight broker outage reaches 1024
+        # failures easily — the backoff must never be what kills the
+        # consumer it exists to keep alive (any clamp ≥ log2(cap/base)
+        # leaves the ceiling at the cap)
+        ceiling = min(
+            self.cap_s, self.base_s * (2.0 ** min(self._attempts, 63))
+        )
+        self._attempts += 1
+        delay = self._rng() * ceiling
+        if self._gauge is not None:
+            self._gauge.set(round(delay, 6))
+        if self._attempts >= self.max_attempts and not self._gave_up:
+            # once per streak: the loud marker that this dependency has
+            # been down past the whole schedule, not a per-retry spam
+            self._gave_up = True
+            if self._give_ups is not None:
+                self._give_ups.inc()
+            flight.record(
+                "retry_give_up",
+                what=self._what,
+                attempts=self._attempts,
+                cap_s=self.cap_s,
+            )
+        return delay
+
+    def sleep(self) -> float:
+        """Advance the streak and sleep the jittered delay; → the delay."""
+        delay = self.next_delay()
+        if delay > 0:
+            self._sleep(delay)
+        return delay
+
+    def reset(self) -> None:
+        """Success: close the streak (delay schedule and give-up event
+        both re-arm; the exported gauge drops to 0 — healthy)."""
+        if self._attempts == 0:
+            return
+        self._attempts = 0
+        self._gave_up = False
+        if self._gauge is not None:
+            self._gauge.set(0.0)

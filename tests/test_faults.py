@@ -1,0 +1,300 @@
+"""Fault-injection harness (runtime/faults.py) + retry/backoff
+(utils/retry.py): the ISSUE 8 acceptance faults drilled through the
+REAL code paths —
+
+- **broker death** → the kafka reconnect/backoff path recovers and the
+  stream resumes with nothing lost;
+- **slow fetch** → the delay lands in the real fetch histogram;
+- **checkpoint-write failure** → the retry/backoff path saves anyway
+  (and an unrecoverable streak raises loudly);
+- plus dispatch delay, worker wedge, the env grammar, and the capped
+  full-jitter backoff schedule itself.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.runtime import faults
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+from flink_jpmml_tpu.utils.retry import Backoff
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestGrammar:
+    def test_parse_spec(self):
+        fs = faults.parse_spec(
+            "slow_fetch:delay_ms=40:p=0.5,broker_death:after_s=5:for_s=2"
+        )
+        assert [f.kind for f in fs] == ["slow_fetch", "broker_death"]
+        assert fs[0].delay_s == pytest.approx(0.04)
+        assert fs[0].p == 0.5
+        assert fs[1].after_s == 5.0 and fs[1].for_s == 2.0
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.parse_spec("segfault:delay_ms=1")
+
+    def test_bad_param_raises(self):
+        with pytest.raises(ValueError, match="bad fault param"):
+            faults.parse_spec("slow_fetch:delay_ms")
+
+    def test_install_from_env(self):
+        assert faults.install_from_env("worker_wedge:wedge_s=0.01:n=1")
+        assert faults.active()
+        faults.clear()
+        # garbage is skipped loudly, never fatal; nothing installs
+        assert not faults.install_from_env("not_a_fault:x=1")
+        assert not faults.active()
+        assert not faults.install_from_env("")
+
+    def test_count_and_probability_gates(self):
+        f = faults.inject("dispatch_delay", delay_ms=0, n=3)
+        for _ in range(10):
+            faults.fire("dispatch")
+        assert f.fires == 3
+        # p=0 never fires regardless of the count budget
+        faults.clear()
+        f2 = faults.inject("dispatch_delay", delay_ms=0, p=0.0)
+        for _ in range(50):
+            faults.fire("dispatch")
+        assert f2.fires == 0
+
+    def test_seeded_probability_is_deterministic(self):
+        def run():
+            faults.clear()
+            f = faults.inject("dispatch_delay", delay_ms=0, p=0.5, seed=7)
+            pattern = []
+            for _ in range(32):
+                before = f.fires
+                faults.fire("dispatch")
+                pattern.append(f.fires > before)
+            return pattern
+
+        assert run() == run()
+
+
+class TestBackoff:
+    def test_full_jitter_schedule(self):
+        # rng pinned at 1.0 exposes the ceiling sequence
+        b = Backoff("t", base_s=0.1, cap_s=1.0, max_attempts=10,
+                    rng=lambda: 1.0, sleep=lambda s: None)
+        delays = [b.next_delay() for _ in range(6)]
+        assert delays == pytest.approx([0.1, 0.2, 0.4, 0.8, 1.0, 1.0])
+        # jitter draws UNDER the ceiling
+        b2 = Backoff("t", base_s=0.1, cap_s=1.0, rng=lambda: 0.25,
+                     sleep=lambda s: None)
+        assert b2.next_delay() == pytest.approx(0.025)
+
+    def test_reset_rearms_schedule_and_gauge(self):
+        m = MetricsRegistry()
+        b = Backoff("t", base_s=0.1, cap_s=1.0, metrics=m,
+                    rng=lambda: 1.0, sleep=lambda s: None)
+        b.next_delay()
+        b.next_delay()
+        assert m.snapshot()["reconnect_backoff_s"] == pytest.approx(0.2)
+        b.reset()
+        assert b.attempts == 0
+        assert m.snapshot()["reconnect_backoff_s"] == 0.0
+        assert b.next_delay() == pytest.approx(0.1)  # schedule restarted
+
+    def test_give_up_event_once_per_streak(self):
+        m = MetricsRegistry()
+        b = Backoff("drill", base_s=0.001, max_attempts=3, metrics=m,
+                    sleep=lambda s: None)
+        for _ in range(6):
+            b.sleep()
+        assert b.exhausted
+        give_ups = [
+            e for e in flight.events() if e["kind"] == "retry_give_up"
+            and e.get("what") == "drill"
+        ]
+        assert len(give_ups) == 1  # once per streak, not per retry
+        assert m.snapshot()["retry_give_ups"] == 1.0
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("FJT_RETRY_BASE_S", "0.2")
+        monkeypatch.setenv("FJT_RETRY_CAP_S", "0.5")
+        monkeypatch.setenv("FJT_RETRY_MAX", "2")
+        b = Backoff("t", base_s=0.01, cap_s=9.0, max_attempts=99)
+        assert b.base_s == 0.2 and b.cap_s == 0.5 and b.max_attempts == 2
+
+
+def _broker_and_source(metrics=None, rows=512):
+    from flink_jpmml_tpu.runtime.kafka import (
+        KafkaBlockSource, MiniKafkaBroker,
+    )
+
+    broker = MiniKafkaBroker(topic="faults")
+    data = np.arange(rows * 4, dtype=np.float32).reshape(rows, 4)
+    broker.append_rows(data)
+    src = KafkaBlockSource(
+        broker.host, broker.port, "faults", n_cols=4,
+        max_wait_ms=10, reconnect_backoff_s=0.002, metrics=metrics,
+        # small fetches: the stream must OUTLIVE the injected fault so
+        # recovery has something left to resume
+        max_bytes=2048,
+    )
+    return broker, src, data
+
+
+class TestKafkaFaultDrills:
+    def test_broker_death_recovers_through_backoff(self):
+        """ISSUE 8 acceptance fault #1: injected broker death rides the
+        real reconnect path — polls fail while the fault is active, the
+        backoff streak grows, and when the 'broker' heals the stream
+        resumes exactly where it left off (nothing lost, nothing
+        duplicated)."""
+        m = MetricsRegistry()
+        broker, src, data = _broker_and_source(metrics=m)
+        try:
+            got = src.poll()
+            assert got is not None and got[0] == 0
+            consumed = got[1].shape[0]
+            faults.inject("broker_death", n=4)
+            dead_polls = 0
+            while faults.stats().get("broker_death", 0) < 4:
+                assert src.poll() is None  # the reconnect path, looping
+                dead_polls += 1
+                assert dead_polls < 50
+            # the streak is visible while the broker is down...
+            assert m.snapshot()["reconnect_backoff_s"] > 0.0
+            reconnects = [
+                e for e in flight.events()
+                if e["kind"] == "kafka_reconnect"
+            ]
+            assert len(reconnects) >= 4
+            assert reconnects[-1]["attempt"] >= 2  # a growing streak
+            # ...and the fault budget exhausted = the broker healed
+            healed = None
+            for _ in range(50):
+                healed = src.poll()
+                if healed is not None:
+                    break
+            assert healed is not None
+            assert healed[0] == consumed  # resume AT the cursor
+            assert m.snapshot()["reconnect_backoff_s"] == 0.0  # reset
+        finally:
+            src.close()
+            broker.close()
+
+    def test_slow_fetch_lands_in_fetch_histogram(self):
+        """ISSUE 8 acceptance fault #2: the injected delay is measured
+        by the SAME kafka_fetch_s histogram a real slow broker would
+        feed — the telemetry plane sees the fault, not a synthetic."""
+        m = MetricsRegistry()
+        broker, src, _ = _broker_and_source(metrics=m)
+        try:
+            faults.inject("slow_fetch", delay_ms=60, n=2)
+            polls = 0
+            while faults.stats().get("slow_fetch", 0) < 2 and polls < 50:
+                src.poll()
+                polls += 1
+            h = m.histogram("kafka_fetch_s")
+            state = h.state()
+            assert state["max"] >= 0.06, state
+        finally:
+            src.close()
+            broker.close()
+
+
+class TestDispatchAndWedge:
+    def test_dispatch_delay_injected_at_launch(self):
+        from flink_jpmml_tpu.runtime.pipeline import OverlappedDispatcher
+
+        class _Leaf:
+            def block_until_ready(self):
+                pass
+
+        disp = OverlappedDispatcher(depth=1)
+        faults.inject("dispatch_delay", delay_ms=40, n=1)
+        t0 = time.monotonic()
+        disp.launch(lambda: _Leaf())
+        dt = time.monotonic() - t0
+        disp.close()
+        assert dt >= 0.04
+
+    def test_worker_wedge_stalls_the_score_loop(self):
+        """The wedge fires in the real block score loop: a wedged run
+        takes visibly longer than a clean one over the same stream but
+        still drains completely (the supervisor's wedge-kill plane is
+        what would reap a longer one)."""
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.runtime.block import (
+            BlockPipeline, FiniteBlockSource,
+        )
+        from tests.test_overload import _CONST_XML
+
+        cm = compile_pmml(parse_pmml(_CONST_XML.format(c=1.0)),
+                          batch_size=32)
+        data = np.zeros((128, 1), np.float32)
+
+        def run():
+            sunk = [0]
+            pipe = BlockPipeline(
+                FiniteBlockSource(data, block_size=32), cm,
+                lambda out, n, off: sunk.__setitem__(0, sunk[0] + n),
+                in_flight=2, use_native=False,
+            )
+            t0 = time.monotonic()
+            pipe.run_until_exhausted(timeout=60.0)
+            return time.monotonic() - t0, sunk[0]
+
+        clean_dt, clean_n = run()
+        faults.inject("worker_wedge", wedge_s=0.4, n=1)
+        wedged_dt, wedged_n = run()
+        assert clean_n == wedged_n == 128  # the stream still drains
+        # the wedge sleep sits on the score thread's critical path; the
+        # bound is the wedge itself — a clean-vs-wedged comparison
+        # would flake whenever the (first, cold) clean run pays more
+        # than 0.4 s of compile/scheduling noise
+        assert wedged_dt >= 0.35
+
+
+class TestCheckpointFaultDrill:
+    def test_transient_failures_retry_then_succeed(self, tmp_path,
+                                                   monkeypatch):
+        """ISSUE 8 acceptance fault #3: two injected mid-write failures
+        ride the retry/backoff path and the snapshot still lands."""
+        monkeypatch.setenv("FJT_RETRY_BASE_S", "0.001")
+        from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+
+        faults.inject("checkpoint_fail", n=2)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"source_offset": 11})
+        assert mgr.load_latest() == {"source_offset": 11}
+        retries = [
+            e for e in flight.events()
+            if e["kind"] == "checkpoint_save_retry"
+        ]
+        assert len(retries) >= 2
+        saves = [
+            e for e in flight.events() if e["kind"] == "checkpoint_save"
+        ]
+        assert saves and saves[-1]["retries"] == 2
+
+    def test_persistent_failure_exhausts_and_raises(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("FJT_RETRY_BASE_S", "0.001")
+        monkeypatch.setenv("FJT_RETRY_MAX", "3")
+        from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+        from flink_jpmml_tpu.utils.exceptions import CheckpointException
+
+        faults.inject("checkpoint_fail")  # no budget: never heals
+        mgr = CheckpointManager(str(tmp_path))
+        with pytest.raises(CheckpointException, match="after 3 retries"):
+            mgr.save({"source_offset": 1})
+        assert any(
+            e["kind"] == "checkpoint_save_failed"
+            for e in flight.events()
+        )
+        assert not list(tmp_path.glob("ckpt-*.json"))

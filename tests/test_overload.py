@@ -1,0 +1,533 @@
+"""Overload plane (serving/overload.py): adaptive batching, admission
+control, and the shed invariants ISSUE 8 pins:
+
+- shedding is LANE-ORDERED — low priority always sheds first, the shed
+  set is always a prefix of the lane order;
+- hysteresis (band + dwell) prevents flapping under a sawtooth load;
+- a shed record NEVER reaches the sink or the rollout shadow diff —
+  on the block path (offsets commit, sink untouched) and on the
+  dynamic-scorer path (empty prediction, no dispatch, no mirror).
+"""
+
+import json
+import pathlib
+import time
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.models.control import AddMessage, RolloutMessage
+from flink_jpmml_tpu.models.core import ModelId
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.runtime.sources import ControlSource
+from flink_jpmml_tpu.serving import overload as overload_mod
+from flink_jpmml_tpu.serving.overload import (
+    AdaptiveBatcher,
+    AdmissionController,
+)
+from flink_jpmml_tpu.serving.scorer import DynamicScorer
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+_CONST_XML = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <DataDictionary numberOfFields="2">
+    <DataField name="a" optype="continuous" dataType="double"/>
+    <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <RegressionModel functionName="regression">
+    <MiningSchema>
+      <MiningField name="y" usageType="target"/>
+      <MiningField name="a"/>
+    </MiningSchema>
+    <RegressionTable intercept="{c}"/>
+  </RegressionModel></PMML>"""
+
+
+def _write_const(tmp_path, name, c):
+    p = pathlib.Path(tmp_path, name)
+    p.write_text(_CONST_XML.format(c=c))
+    return str(p)
+
+
+def _wait_warm(reg, mid, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if reg.model_if_warm(mid) is not None:
+            return
+        err = reg.warm_error(mid)
+        assert err is None, f"warm of {mid} failed: {err!r}"
+        time.sleep(0.01)
+    raise AssertionError(f"{mid} never warmed")
+
+
+def _forced_controller(metrics, lanes, level, pressure=1.0):
+    """A controller driven to ``level`` through its own tick machinery
+    (fake clock + fake pressure), then frozen there."""
+    t = [0.0]
+    p = [pressure]
+    adm = AdmissionController(
+        metrics, lanes=lanes, dwell_s=0.1, interval_s=0.01,
+        on_threshold=0.8, off_threshold=0.3,
+        pressure_fn=lambda: p[0], clock=lambda: t[0],
+    )
+    while adm.shed_level < level:
+        t[0] += 0.2
+        adm.tick()
+        assert t[0] < 100.0, "controller never reached the target level"
+    p[0] = 0.5  # inside the band: the level freezes
+    return adm
+
+
+class TestAdaptiveBatcher:
+    def test_fit_and_deadline_cap(self, tmp_path):
+        m = MetricsRegistry()
+        b = AdaptiveBatcher(
+            metrics=m, deadline_s=0.010, target_frac=0.8,
+            min_records=64, max_records=8192,
+            path=str(tmp_path / "cap.json"),
+        )
+        # synthetic truth: c0 = 2 ms, c1 = 10 µs/record
+        for n in (128, 512, 2048):
+            for _ in range(3):
+                b.observe(n, 0.002 + 1e-5 * n)
+        c0, c1 = b.coefficients()
+        assert c0 == pytest.approx(0.002, rel=0.2)
+        assert c1 == pytest.approx(1e-5, rel=0.2)
+        # budget = 8 ms − c0 ⇒ ~600 records
+        cap = b.max_records()
+        assert 400 <= cap <= 800
+        assert b.propose([128, 256, 512, 1024]) == 512
+        assert m.snapshot()["adaptive_batch"] == float(cap)
+
+    def test_single_size_uses_origin_model(self, tmp_path):
+        b = AdaptiveBatcher(
+            deadline_s=0.010, min_records=16,
+            path=str(tmp_path / "cap.json"),
+        )
+        b.observe(100, 0.001)  # 10 µs/record through the origin
+        assert b.max_records() == 800
+
+    def test_no_deadline_means_no_cap(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("FJT_SLO_TARGET_MS", raising=False)
+        b = AdaptiveBatcher(path=str(tmp_path / "cap.json"))
+        b.observe(100, 0.001)
+        assert not b.enabled
+        assert b.max_records() is None
+        assert b.propose([64, 4096]) == 4096  # throughput default
+
+    def test_persistence_roundtrip_and_corruption(self, tmp_path):
+        path = str(tmp_path / "cap.json")
+        b = AdaptiveBatcher(deadline_s=0.01, model="m", backend="b",
+                            path=path)
+        b.observe(128, 0.002)
+        b.observe(512, 0.006)
+        b.flush()
+        data = json.loads(pathlib.Path(path).read_text())
+        assert "m|b" in data["entries"]
+        # a fresh process predicts BEFORE its first observation
+        b2 = AdaptiveBatcher(deadline_s=0.01, model="m", backend="b",
+                             path=path)
+        assert b2.coefficients() == pytest.approx(b.coefficients())
+        assert b2.max_records() is not None
+        # corruption reads as empty, never raises
+        pathlib.Path(path).write_text("\x00garbage{{{")
+        b3 = AdaptiveBatcher(deadline_s=0.01, model="m", backend="b",
+                             path=path)
+        assert b3.coefficients() is None
+        b3.observe(128, 0.002)
+        b3.flush()  # and the rewrite recovers the file
+        assert "m|b" in json.loads(
+            pathlib.Path(path).read_text()
+        )["entries"]
+
+    def test_drift_triggers_reestimate(self, tmp_path):
+        b = AdaptiveBatcher(deadline_s=0.01,
+                            path=str(tmp_path / "cap.json"))
+        for _ in range(4):
+            b.observe(256, 0.002)
+        c1_before = b.coefficients()[1]
+        # the workload got 5x slower (new model version, thermal
+        # throttle): sustained drift must re-estimate, not average out
+        for _ in range(12):
+            b.observe(256, 0.010)
+        c1_after = b.coefficients()[1]
+        assert c1_after > 2.0 * c1_before
+        kinds = [e["kind"] for e in flight.events()]
+        assert "capacity_reestimated" in kinds
+
+
+class TestAdmissionLaneOrder:
+    """Property: at every level, the shed set is exactly the
+    lowest-priority prefix — for any lane configuration."""
+
+    @pytest.mark.parametrize("lanes", [
+        ("low", "normal", "high"),
+        ("bulk", "batch", "interactive", "system"),
+        ("only",),
+    ])
+    def test_shed_is_priority_prefix_at_every_level(self, lanes):
+        for level in range(len(lanes) + 1):
+            adm = _forced_controller(MetricsRegistry(), lanes, level)
+            assert adm.shed_level == level
+            assert adm.shed_lanes() == lanes[:level]
+            for i, lane in enumerate(lanes):
+                assert adm.admit(lane) == (i >= level)
+
+    def test_unknown_lane_is_never_shed(self):
+        adm = _forced_controller(
+            MetricsRegistry(), ("low", "high"), level=2
+        )
+        assert adm.admit("mystery") is True
+
+    def test_counters_and_gauge(self):
+        m = MetricsRegistry()
+        adm = _forced_controller(m, ("low", "high"), level=1)
+        assert adm.admit("low", n=10) is False
+        assert adm.admit("high", n=7) is True
+        snap = m.snapshot()
+        assert snap['shed_records{lane="low"}'] == 10
+        assert snap["admitted_records"] == 7
+        assert snap["shed_level"] == 1.0
+        assert adm.counts() == {"admitted": 7.0, "shed": {"low": 10.0}}
+
+    def test_inverted_band_rejected(self):
+        with pytest.raises(ValueError, match="hysteresis band"):
+            AdmissionController(
+                MetricsRegistry(), on_threshold=0.5, off_threshold=0.6,
+            )
+
+
+class TestAdmissionHysteresis:
+    def _controller(self, dwell=0.5):
+        t = [0.0]
+        p = [0.0]
+        adm = AdmissionController(
+            MetricsRegistry(), lanes=("low", "normal", "high"),
+            dwell_s=dwell, interval_s=0.01,
+            on_threshold=0.8, off_threshold=0.3,
+            pressure_fn=lambda: p[0], clock=lambda: t[0],
+        )
+        return adm, t, p
+
+    def test_sawtooth_never_flaps(self):
+        """A sawtooth crossing the on-threshold every other tick (period
+        << dwell) must never raise the level: each dip resets the dwell
+        clock. This is the anti-flap property the band + dwell buy."""
+        adm, t, p = self._controller(dwell=0.5)
+        for i in range(200):
+            t[0] += 0.05
+            p[0] = 0.95 if i % 2 == 0 else 0.1
+            adm.tick()
+        assert adm.shed_level == 0
+
+    def test_sustained_pressure_climbs_one_lane_per_dwell(self):
+        adm, t, p = self._controller(dwell=0.5)
+        p[0] = 0.95
+        levels = []
+        for _ in range(40):
+            t[0] += 0.1
+            adm.tick()
+            levels.append(adm.shed_level)
+        # monotone climb, one lane at a time, ~one per dwell period
+        assert levels[-1] == 3
+        assert all(b - a in (0, 1) for a, b in zip(levels, levels[1:]))
+        assert levels.index(1) >= 4  # not before the first full dwell
+
+    def test_recovery_requires_sustained_calm(self):
+        adm, t, p = self._controller(dwell=0.5)
+        p[0] = 0.95
+        for _ in range(12):
+            t[0] += 0.1
+            adm.tick()
+        assert adm.shed_level >= 2
+        level_at_peak = adm.shed_level
+        # brief calm below off — shorter than the dwell — must not
+        # lower the level...
+        p[0] = 0.1
+        for _ in range(3):
+            t[0] += 0.1
+            adm.tick()
+        p[0] = 0.5  # back inside the band
+        t[0] += 0.1
+        adm.tick()
+        assert adm.shed_level == level_at_peak
+        # ...sustained calm recovers, one lane per dwell
+        p[0] = 0.1
+        for _ in range(40):
+            t[0] += 0.1
+            adm.tick()
+        assert adm.shed_level == 0
+
+    def test_transitions_record_flight_events(self):
+        adm, t, p = self._controller(dwell=0.2)
+        p[0] = 0.95
+        for _ in range(6):
+            t[0] += 0.1
+            adm.tick()
+        events = [
+            e for e in flight.events()
+            if e["kind"] == "shed_level_change"
+        ]
+        assert events and events[-1]["direction"] == "up"
+        assert events[-1]["lane"] in ("low", "normal", "high")
+
+
+class TestScorerShedInvariants:
+    """ISSUE 8's pinned invariant on the record path: a shed record
+    never reaches the sink (it emits empty, unscored) and never reaches
+    the rollout shadow diff (no mirror, no candidate traffic)."""
+
+    def _scorer(self, tmp_path, level):
+        m = MetricsRegistry()
+        adm = _forced_controller(m, ("low", "normal", "high"), level)
+        ctrl = ControlSource()
+        sc = DynamicScorer(
+            control=ctrl, batch_size=32, metrics=m, admission=adm,
+            auto_rollout=False,
+        )
+        ctrl.push(AddMessage(
+            "m", 1, _write_const(tmp_path, "v1.pmml", 1.0),
+            timestamp=time.time(),
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 1))
+        return sc, ctrl
+
+    @staticmethod
+    def _events(n, lane):
+        return [
+            ("m", {"a": 0.0, "_key": f"k{i}", "_lane": lane})
+            for i in range(n)
+        ]
+
+    def test_shed_lane_emits_empty_and_is_never_scored(self, tmp_path):
+        sc, _ = self._scorer(tmp_path, level=1)
+        out = sc.finish(sc.submit(
+            self._events(8, "low") + self._events(8, "normal")
+        ))
+        assert len(out) == 16  # C5 totality holds through shedding
+        low, normal = out[:8], out[8:]
+        assert all(p.is_empty for p, _ in low)
+        assert all(not p.is_empty for p, _ in normal)
+        counts = sc.admission.counts()
+        assert counts["shed"] == {"low": 8.0}
+        assert counts["admitted"] == 8.0
+
+    def test_shed_never_reaches_shadow_diff(self, tmp_path):
+        sc, ctrl = self._scorer(tmp_path, level=1)
+        # a shadow rollout mirroring ALL incumbent traffic
+        ctrl.push(RolloutMessage(
+            "m", 2, "shadow", time.time(),
+            path=_write_const(tmp_path, "v2.pmml", 1.0),
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 2))
+        sc.finish(sc.submit(
+            self._events(16, "low") + self._events(16, "normal")
+        ))
+        snap = sc.metrics.struct_snapshot()["counters"]
+        compared = snap.get('rollout_shadow_compared{model="m"}', 0.0)
+        # only the ADMITTED records may be mirrored; every shadow spec
+        # defaults to full sampling, so compared == admitted-served
+        assert 0 < compared <= 16
+        assert snap.get('rollout_candidate_records{model="m"}', 0.0) == 0
+        assert snap['shed_records{lane="low"}'] == 16
+
+    def test_disabled_admission_admits_everything(self, tmp_path):
+        sc, _ = self._scorer(tmp_path, level=3)
+        sc.admission.enabled = False
+        out = sc.finish(sc.submit(self._events(8, "low")))
+        assert all(not p.is_empty for p, _ in out)
+
+    def test_shed_never_advances_the_watermark(self, tmp_path):
+        """A shed record was DROPPED, not delivered: its event time
+        must not advance watermark_ts (the fleet-MIN freshness claim)
+        nor book record_staleness_s — the record-path twin of the block
+        path's discard_stamps."""
+        m = MetricsRegistry()
+        adm = _forced_controller(m, ("low", "normal"), level=1)
+        ctrl = ControlSource()
+        sc = DynamicScorer(
+            control=ctrl, batch_size=32, metrics=m, admission=adm,
+            auto_rollout=False,
+            event_time_fn=lambda ev: ev[1].get("_ts"),
+        )
+        ctrl.push(AddMessage(
+            "m", 1, _write_const(tmp_path, "v1.pmml", 1.0),
+            timestamp=time.time(),
+        ))
+        sc._drain_control()
+        _wait_warm(sc.registry, ModelId("m", 1))
+        t_old, t_new = time.time() - 100.0, time.time()
+
+        def ev(i, lane, ts):
+            return ("m", {"a": 0.0, "_key": f"k{i}", "_lane": lane,
+                          "_ts": ts})
+
+        # served records carry OLD event times; the freshest times ride
+        # the shed lane — a leak would report the worker 100 s fresher
+        # than its delivered stream actually is
+        sc.finish(sc.submit(
+            [ev(i, "normal", t_old) for i in range(4)]
+            + [ev(i, "low", t_new) for i in range(4, 8)]
+        ))
+        wm = m.snapshot().get("watermark_ts")
+        assert wm is not None and wm <= t_old + 1e-3
+        n_stale = m.histogram("record_staleness_s").count()
+        # only the served batch's two bounding observations booked
+        assert n_stale == 2
+
+
+class TestBlockShedPath:
+    """The block path's shed protocol: refused batches ride the FIFO
+    window as no-ops — offsets commit in order, the sink is NEVER
+    called, the shed counter carries the record count."""
+
+    def _run(self, level):
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.runtime.block import (
+            BlockPipeline, FiniteBlockSource,
+        )
+
+        doc = parse_pmml(_CONST_XML.format(c=2.5))
+        cm = compile_pmml(doc, batch_size=32)
+        m = MetricsRegistry()
+        adm = _forced_controller(m, ("block",), level)
+        data = np.zeros((256, 1), np.float32)
+        sunk = []
+
+        def sink(out, n, first_off):
+            sunk.append((first_off, n))
+
+        pipe = BlockPipeline(
+            FiniteBlockSource(data, block_size=32), cm, sink,
+            metrics=m, in_flight=2, use_native=False, admission=adm,
+        )
+        pipe.run_until_exhausted(timeout=60.0)
+        return pipe, sunk, m
+
+    def test_full_shed_commits_offsets_without_sinking(self):
+        pipe, sunk, m = self._run(level=1)
+        assert sunk == []  # the sink never saw a shed record
+        assert pipe.committed_offset == 256  # offsets still commit
+        snap = m.snapshot()
+        assert snap['shed_records{lane="block"}'] == 256
+        assert snap["records_out"] == 0
+        # shed no-ops are UNACCOUNTED window entries: counting them as
+        # dispatches would dilute the pressure score's window-full
+        # fraction exactly while the shed rate peaks
+        assert snap["dispatches"] == 0
+
+    def test_lane_mismatch_rejected_at_construction(self):
+        """A shed_lane the controller doesn't know would climb levels
+        and report shedding while refusing nothing — the wire must fail
+        loudly, not no-op silently."""
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml
+        from flink_jpmml_tpu.runtime.block import (
+            BlockPipeline, FiniteBlockSource,
+        )
+        from flink_jpmml_tpu.utils.exceptions import (
+            InputValidationException,
+        )
+
+        cm = compile_pmml(parse_pmml(_CONST_XML.format(c=1.0)),
+                          batch_size=32)
+        adm = AdmissionController(
+            MetricsRegistry(), lanes=("low", "normal", "high"),
+        )
+        with pytest.raises(InputValidationException,
+                           match="could never shed"):
+            BlockPipeline(
+                FiniteBlockSource(np.zeros((32, 1), np.float32), 32),
+                cm, lambda *a: None, use_native=False, admission=adm,
+            )
+
+    def test_disabled_admission_sinks_everything(self):
+        pipe, sunk, m = self._run(level=0)
+        assert sum(n for _, n in sunk) == 256
+        assert pipe.committed_offset == 256
+        assert m.snapshot().get('shed_records{lane="block"}', 0) == 0
+
+
+class TestLatencyModeCalibration:
+    def test_calibration_fits_and_respects_deadline(self, tmp_path,
+                                                    monkeypatch):
+        """bench latency mode's compiled-batch chooser: the batcher is
+        fitted from real timed dispatches, the chosen size is one of
+        the calibrated candidates, and a brutally tight deadline forces
+        the smallest candidate (the knob actually steers the choice)."""
+        import argparse
+
+        from flink_jpmml_tpu import bench as bench_mod
+        from flink_jpmml_tpu.assets_gen import gen_gbm
+        from flink_jpmml_tpu.pmml import parse_pmml_file
+
+        monkeypatch.setenv(
+            "FJT_AUTOTUNE_CACHE", str(tmp_path / "at.json")
+        )
+        doc = parse_pmml_file(
+            gen_gbm(str(tmp_path), n_trees=5, depth=2, n_features=4)
+        )
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(1024, 4)).astype(np.float32)
+
+        def args(deadline_us):
+            return argparse.Namespace(
+                trees=5, depth=2, features=4, latency_batch=1024,
+                latency_deadline_us=deadline_us,
+            )
+
+        chosen, cm, batcher = bench_mod._calibrate_latency_batch(
+            doc, data, args(deadline_us=500_000), True
+        )
+        # half a second of budget: every candidate fits, largest wins
+        assert chosen == 1024 and cm.batch_size == 1024
+        assert batcher.coefficients() is not None
+        assert len(batcher.state()["sizes"]) == 3
+        chosen_tight, cm_tight, _ = bench_mod._calibrate_latency_batch(
+            doc, data, args(deadline_us=1), True
+        )
+        # a 1 µs deadline fits nothing: the chooser degrades to the
+        # smallest calibrated size instead of keeping the static 1024
+        assert chosen_tight == 64 and cm_tight.batch_size == 64
+
+
+class TestOverloadSummary:
+    def test_summary_and_fjt_top_panel(self, tmp_path, capsys):
+        m = MetricsRegistry()
+        adm = _forced_controller(m, ("low", "high"), level=1)
+        adm.admit("low", n=5)
+        adm.admit("high", n=9)
+        m.gauge("slo_deadline_ms").set(10.0)
+        m.gauge("adaptive_batch").set(512.0)
+        for _ in range(20):
+            m.histogram("batch_latency_s").observe(0.004)
+        struct = m.struct_snapshot()
+        s = overload_mod.summary(struct)
+        assert s["shed_records"] == {"low": 5.0}
+        assert s["admitted_records"] == 9.0
+        assert s["adaptive_batch"] == 512.0
+        assert s["deadline_ms"] == 10.0
+        assert s["latency_source"] == "batch_latency_s"
+        assert s["p99_vs_deadline_ratio"] <= 1.0
+        # the CLI panel renders the same struct from a dump file
+        from flink_jpmml_tpu.cli import top_main
+
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(struct))
+        assert top_main(["--overload", str(dump)]) == 0
+        out = capsys.readouterr().out
+        assert "deadline 10.0 ms" in out
+        assert "MET" in out
+        assert "low" in out and "shed" in out
+
+    def test_empty_struct_renders_fallback(self, tmp_path, capsys):
+        from flink_jpmml_tpu.cli import top_main
+
+        assert overload_mod.summary({"gauges": {}, "counters": {}}) is None
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(MetricsRegistry().struct_snapshot()))
+        assert top_main(["--overload", str(dump)]) == 0
+        assert "no overload telemetry" in capsys.readouterr().out

@@ -17,10 +17,11 @@ _SMOKE = (
 def test_perf_smoke_passes():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["FJT_SMOKE_WATCHDOG_S"] = "120"
+    env["FJT_SMOKE_WATCHDOG_S"] = "150"
+    env.pop("FJT_FAULTS", None)  # the no-op check requires a clean env
     proc = subprocess.run(
         [sys.executable, str(_SMOKE)],
-        capture_output=True, text=True, timeout=240, env=env,
+        capture_output=True, text=True, timeout=280, env=env,
     )
     assert proc.returncode == 0, (
         f"perf smoke rc={proc.returncode}\n"
@@ -34,3 +35,5 @@ def test_perf_smoke_passes():
     assert "attribution overhead OK" in proc.stdout
     assert "rollout drill OK" in proc.stdout
     assert "freshness burst drill OK" in proc.stdout
+    assert "overload drill OK" in proc.stdout
+    assert "fault hooks no-op OK" in proc.stdout
