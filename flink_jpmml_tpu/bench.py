@@ -643,6 +643,7 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
             in_flight=1,  # latency point: no completion window to hide in
             use_quantized=use_quantized,
         )
+        drift_fields = _drift_attach(pipe.metrics, cm)
         t0 = time.monotonic()
         pipe.run_for(seconds=seconds)
         elapsed = time.monotonic() - t0
@@ -656,6 +657,10 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
                 "attribution": attr_mod.summary(pipe.metrics),
                 # the mode's exposition snapshot (scrape-format struct)
                 "varz": pipe.metrics.struct_snapshot(),
+                # data-health (obs/drift.py), present iff baselined
+                "drift": (
+                    drift_fields() if drift_fields is not None else None
+                ),
             },
         )
 
@@ -721,6 +726,7 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
         "h2d_bytes_per_record": ostats.get("h2d_bytes_per_record"),
         "attribution": ostats.get("attribution"),
         "varz": ostats.get("varz"),
+        "drift": ostats.get("drift"),
     }
 
 
@@ -787,6 +793,7 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
             metrics=km,
             use_quantized=use_quantized,
         )
+        drift_fields = _drift_attach(km, cm)
         q = cm.quantized_scorer() if use_quantized else None
         if q is not None:
             jax.block_until_ready(
@@ -829,6 +836,8 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
         # readback/sink (score thread), one shared registry
         line["attribution"] = attr_mod.summary(km)
         line["varz"] = varz
+        if drift_fields is not None:
+            line["drift"] = drift_fields()
         return line
     finally:
         broker.close()
@@ -977,6 +986,227 @@ def run_rollout_drill(
         "shadow_compared": int(shadow_compared),
         "shadow_disagree": 0,
         "sink_leakage": 0,
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }
+
+
+def _drift_attach(metrics, model_obj):
+    """Arm the drift plane (obs/drift.py) on a bench mode's registry
+    when a stored baseline exists for the served model — env-
+    independent, so every BENCH round on a baselined model carries the
+    data-health family in its embedded varz (sketches + drift gauges;
+    the registry scrape hook ticks the monitor inside the very
+    ``struct_snapshot`` each mode embeds). → a zero-arg closure
+    producing the compact per-model artifact fields, or None when no
+    baseline is stored (the plane stays dark and the mode's struct is
+    byte-identical to a pre-drift round's)."""
+    from flink_jpmml_tpu.obs import drift as drift_mod
+
+    label = drift_mod.model_label(model_obj)
+    if not label or drift_mod.BaselineStore().load(label) is None:
+        return None
+    drift_mod.install(metrics)
+    return lambda: drift_mod.artifact_fields(metrics)
+
+
+def run_drift_drill(
+    records_per_phase: int = 12_000,
+    batch: int = 256,
+    trees: int = 10,
+    depth: int = 3,
+    features: int = 6,
+    perturb_feature: int = 1,
+    control_feature: int = 0,
+    shift: float = 4.0,
+    psi_alarm: float = 0.25,
+    min_n: int = 500,
+    seed: int = 11,
+) -> dict:
+    """``--drift-drill``: seeded acceptance drill for the data-drift
+    plane (obs/drift.py) — also the perf-smoke tripwire's engine.
+
+    Geometry: TWO simulated workers (two registries sharing one
+    compiled scorer — exactly how N processes share a model) score
+    alternating batches through the REAL ``dispatch_quantized`` path
+    with the drift plane armed at interval 0. Phase 1 profiles the
+    reference distribution and snapshots it as the baseline (through
+    the on-disk :class:`BaselineStore`, exercising save/load). Phase 2
+    perturbs ONE feature's generator (a ``shift``·σ mean shift) and
+    keeps scoring while a fleet :class:`DriftMonitor` windows the
+    MERGED worker structs.
+
+    Asserts the three properties the acceptance criteria pin:
+
+    - **right feature, in the window** — the fleet monitor raises
+      ``drift_alarm`` for the perturbed feature before the phase ends;
+    - **quiet control** — the unperturbed control feature (and every
+      other feature) never alarms;
+    - **merge exactness** — the fleet-merged sketch's quantiles equal
+      the quantiles of merging the per-worker sketch STATES directly
+      (the DrJAX merge-exactly discipline, bitwise).
+
+    Raises ``AssertionError`` on violation; → the drill's JSON line."""
+    import jax
+    import numpy as np
+
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.obs import drift as drift_mod
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+    from flink_jpmml_tpu.utils.metrics import (
+        MetricsRegistry, QuantileSketch, merge_structs,
+    )
+
+    t0 = time.monotonic()
+    tmp = tempfile.mkdtemp(prefix="fjt-drift-drill-")
+    doc = parse_pmml_file(gen_gbm(
+        tmp, n_trees=trees, depth=depth, n_features=features, seed=seed,
+    ))
+    cm = compile_pmml(doc, batch_size=batch)
+    q = cm.quantized_scorer()
+    assert q is not None, "drift drill GBM must be rank-wire eligible"
+    label = q.model_hash
+    fields = list(q.wire.fields)
+    f_perturb = fields[perturb_feature]
+    f_control = fields[control_feature]
+
+    store = drift_mod.BaselineStore(os.path.join(tmp, "baselines"))
+    regs = [MetricsRegistry(), MetricsRegistry()]
+    planes = [
+        # interval 0 (every batch) + budget off: the drill wants
+        # deterministic coverage, not production amortization
+        drift_mod.install(r, interval_s=0.0, budget_frac=0, store=store)
+        for r in regs
+    ]
+    for p in planes:
+        # worker monitors idle at drill speed; the FLEET monitor below
+        # is the asserted surface
+        p.monitor.min_n = min_n
+
+    def fleet_struct() -> dict:
+        return merge_structs([r.struct_snapshot() for r in regs])
+
+    fleet_gauges = MetricsRegistry()
+    monitor = drift_mod.DriftMonitor(
+        struct_fn=fleet_struct,
+        store=store,
+        psi_alarm=psi_alarm,
+        psi_clear=psi_alarm / 2.0,
+        min_n=min_n,
+        window_s=300.0,
+        dwell_s=0.0,
+        interval_s=0.0,
+        gauge_metrics=fleet_gauges,
+    )
+
+    rng = np.random.default_rng(seed)
+    means = np.arange(features, dtype=np.float32) * 0.5
+
+    def gen_batch(perturbed: bool) -> np.ndarray:
+        X = (rng.normal(0.0, 1.0, size=(batch, features))
+             .astype(np.float32) + means[None, :])
+        X[rng.random(size=X.shape) < 0.02] = np.nan  # missing lane
+        if perturbed:
+            X[:, perturb_feature] += shift
+        return X
+
+    def score_phase(perturbed: bool, tick):
+        """Alternate batches across the two workers through the real
+        dispatch path; → the batch index of the first perturbed-feature
+        alarm (None outside phase 2)."""
+        alarm_at = None
+        n_batches = max(1, records_per_phase // batch)
+        for b in range(n_batches):
+            reg = regs[b % len(regs)]
+            X = gen_batch(perturbed)
+            out = dispatch_quantized(q, X, metrics=reg)
+            jax.block_until_ready(out)
+            # sink-side prediction sketching, as the pipelines do it
+            drift_mod.plane_for(reg).record_predictions(q, out, batch)
+            if tick:
+                for tr in monitor.tick():
+                    if (
+                        alarm_at is None
+                        and tr["transition"] == "alarm"
+                        and tr["feature"] == f_perturb
+                    ):
+                        alarm_at = b
+        return alarm_at
+
+    # warm outside any measurement
+    jax.block_until_ready(dispatch_quantized(
+        q, gen_batch(False), metrics=MetricsRegistry()
+    ))
+
+    # -- phase 1: reference distribution + baseline snapshot ---------------
+    score_phase(False, tick=False)
+    fleet = fleet_struct()
+    payloads = drift_mod.snapshot_from_struct(fleet)
+    assert label in payloads and len(payloads[label]["features"]) == (
+        features
+    ), f"baseline incomplete: {list(payloads)}"
+    store.save(label, payloads[label])
+    loaded = store.load(label)
+    assert loaded is not None, "baseline save/load roundtrip failed"
+    monitor.set_baseline(label, loaded)
+
+    # -- merge exactness: fleet merge == direct per-worker state merge -----
+    states = [r.struct_snapshot().get("sketches") or {} for r in regs]
+    checked = 0
+    for name in sorted(set().union(*states)):
+        per_worker = [s[name] for s in states if name in s]
+        direct = QuantileSketch.from_state(per_worker[0])
+        for st in per_worker[1:]:
+            direct.merge(QuantileSketch.from_state(st))
+        merged = QuantileSketch.from_state(fleet["sketches"][name])
+        for qq in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99):
+            mv, dv = merged.quantile(qq), direct.quantile(qq)
+            assert mv == dv, (
+                f"fleet merge inexact for {name} q={qq}: {mv} != {dv}"
+            )
+        checked += 1
+    assert checked >= features + 1, checked  # features + predictions
+
+    # -- phase 2: perturb one feature, watch the fleet monitor -------------
+    alarm_batch = score_phase(True, tick=True)
+    alarmed = {
+        (a["model"], a["feature"]) for a in monitor.alarms()
+    }
+    assert (label, f_perturb) in alarmed, (
+        f"perturbed feature {f_perturb} never alarmed "
+        f"(alarmed={alarmed}, scores={monitor.scores()})"
+    )
+    feature_alarms = {f for (_, f) in alarmed if f is not None}
+    assert feature_alarms == {f_perturb}, (
+        f"alarm bled onto unperturbed features: {feature_alarms}"
+    )
+    scores = {
+        feat: s for (lbl, feat), s in monitor.scores().items()
+        if lbl == label
+    }
+    psi_control = scores.get(f_control)
+    assert psi_control is not None and psi_control < psi_alarm, (
+        f"control feature {f_control} drifted: psi={psi_control}"
+    )
+
+    # success path only: a FAILED drill's assertion leaves the tempdir
+    # (model + baselines) on disk for inspection
+    shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "metric": "drift_drill",
+        "ok": True,
+        "model": label,
+        "records_per_phase": records_per_phase,
+        "perturbed_feature": f_perturb,
+        "control_feature": f_control,
+        "alarm_batch": alarm_batch,
+        "psi_perturbed": round(scores[f_perturb], 4),
+        "psi_control": round(psi_control, 4),
+        "merge_exact": True,
+        "sketches_checked": checked,
+        "drift": drift_mod.artifact_fields(fleet_gauges),
+        "varz": fleet_struct(),
         "elapsed_s": round(time.monotonic() - t0, 3),
     }
 
@@ -1806,6 +2036,16 @@ def main() -> None:
                     help="records per rollout-drill phase")
     ap.add_argument("--rollout-fraction", type=float, default=0.2,
                     help="canary traffic share the drill asserts")
+    ap.add_argument("--drift-drill", action="store_true",
+                    help="run the data-drift acceptance drill instead "
+                         "of the perf capture: perturb one feature's "
+                         "generator mid-run, assert the drift alarm "
+                         "lands on that feature within the window, the "
+                         "control feature stays quiet, and the fleet-"
+                         "merged sketch quantiles equal the per-worker "
+                         "state merge exactly")
+    ap.add_argument("--drift-records", type=int, default=12_000,
+                    help="records per drift-drill phase")
     args = ap.parse_args()
     burst_factor = _parse_load_shape(args.load_shape)  # validate early
 
@@ -1844,6 +2084,24 @@ def main() -> None:
         except AssertionError as e:
             print(json.dumps({
                 "metric": "overload_drill", "ok": False, "error": str(e),
+            }))
+            sys.exit(1)
+        print(json.dumps(line))
+        return
+
+    if args.drift_drill:
+        # data-health drill, not a perf capture: in-process like the
+        # rollout drill (a tiny GBM compiles anywhere, both "workers"
+        # are registries in this process)
+        if args.force_cpu:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            line = run_drift_drill(records_per_phase=args.drift_records)
+        except AssertionError as e:
+            print(json.dumps({
+                "metric": "drift_drill", "ok": False, "error": str(e),
             }))
             sys.exit(1)
         print(json.dumps(line))
@@ -2028,6 +2286,10 @@ def main() -> None:
             )),
             use_quantized=not args.f32_wire,
         )
+        # data-health rides the artifact when a baseline is stored for
+        # this model: features profile inside dispatch_quantized,
+        # predictions at the sink, monitor ticks on the varz snapshot
+        drift_fields = _drift_attach(pipe.metrics, cm)
         q = None if args.f32_wire else cm.quantized_scorer()
         if q is not None:
             jax.block_until_ready(
@@ -2072,6 +2334,8 @@ def main() -> None:
         # /metrics endpoint renders, embedded per operating mode so a
         # BENCH_*.json diff and a Prometheus scrape tell one story
         line["varz"] = pipe.metrics.struct_snapshot()
+        if drift_fields is not None:
+            line["drift"] = drift_fields()
         autotune_fields(line)
         if interp_rate is not None:
             line["interp_rec_s"] = round(interp_rate, 1)
@@ -2302,6 +2566,28 @@ def main() -> None:
                 4.0 * args.features if f32ish else float(args.features)
             ) + 2.0,
         )
+    # data-health for the hand loop: the scan path bypasses
+    # dispatch_quantized, so when a baseline is stored the drift
+    # profile records the pool slices (the exact stream being scored)
+    # and the warm scores into a sidecar registry, whose families merge
+    # into the embedded varz — every mode's artifact then carries the
+    # drift varz family when a baseline is present
+    drift_line = None
+    if q_tuned is not None:
+        from flink_jpmml_tpu.obs import drift as drift_mod
+        from flink_jpmml_tpu.utils.metrics import merge_structs
+
+        if drift_mod.BaselineStore().load(q_tuned.model_hash) is not None:
+            dm = MetricsRegistry()
+            dplane = drift_mod.install(dm, interval_s=0.0)
+            for Xf in pool_f32:
+                dplane.record_features(q_tuned, Xf)
+            dplane.record_predictions(q_tuned, warm, B)
+            drift_line = drift_mod.artifact_fields(dm)
+            ostats["varz"] = merge_structs(
+                [ostats.get("varz") or {}, dm.struct_snapshot()]
+            )
+
     line = {
         "metric": metric,
         "value": round(rate, 1),
@@ -2337,6 +2623,8 @@ def main() -> None:
         "attribution": ostats.get("attribution"),
         "varz": ostats.get("varz"),
     }
+    if drift_line is not None:
+        line["drift"] = drift_line
     autotune_fields(line)
     if interp_rate is not None:
         line["interp_rec_s"] = round(interp_rate, 1)

@@ -40,6 +40,14 @@ attack next. No jax import — safe on any host:
     fjt-top BENCH_r06.json                 # bench artifact's varz
     fjt-top /tmp/varz-dump.json
     fjt-top --overload http://host:9100    # admission/deadline panel
+    fjt-top --drift http://host:9100       # per-feature data-health panel
+
+``fjt-drift``: the data-drift baseline registry (obs/drift.py) —
+snapshot a live pipeline's per-feature profiles as the reference,
+list what's stored, or check a source against it:
+
+    fjt-drift snapshot http://127.0.0.1:9100
+    fjt-drift check http://127.0.0.1:9100   # exit 1 past --psi
 """
 
 from __future__ import annotations
@@ -237,6 +245,14 @@ def rollout_main(argv: Optional[List[str]] = None) -> int:
     g.add_argument("--max-disagree-rate", type=float, default=None)
     g.add_argument("--max-latency-ratio", type=float, default=None)
     g.add_argument("--max-error-rate", type=float, default=None)
+    g.add_argument("--max-prediction-psi", type=float, default=None,
+                   help="roll back when the candidate's windowed score "
+                        "distribution drifts past this PSI vs the "
+                        "incumbent (obs/drift.py)")
+    g.add_argument("--hold-prediction-psi", type=float, default=None,
+                   help="withhold promotion while prediction PSI "
+                        "exceeds this (default: half of "
+                        "--max-prediction-psi)")
     g.add_argument("--min-samples", type=int, default=None)
     g.add_argument("--promote-after-s", type=float, default=None)
     g.add_argument("--window-s", type=float, default=None)
@@ -253,6 +269,8 @@ def rollout_main(argv: Optional[List[str]] = None) -> int:
             ("max_disagree_rate", args.max_disagree_rate),
             ("max_latency_ratio", args.max_latency_ratio),
             ("max_error_rate", args.max_error_rate),
+            ("max_prediction_psi", args.max_prediction_psi),
+            ("hold_prediction_psi", args.hold_prediction_psi),
             ("min_samples", args.min_samples),
             ("promote_after_s", args.promote_after_s),
             ("window_s", args.window_s),
@@ -504,6 +522,64 @@ def _top_render_freshness(label: str, struct: dict, out) -> None:
         print("(no freshness telemetry recorded)", file=out)
 
 
+def _top_render_drift(label: str, struct: dict, out) -> None:
+    """The ``--drift`` panel: the data-health plane (obs/drift.py) as a
+    ranked per-feature table — live-vs-baseline PSI, missing and
+    out-of-domain rates, sketch sample counts, alarm markers — plus the
+    per-model prediction-distribution drift line. Rows rank worst
+    first: the feature to investigate is the top one."""
+    from flink_jpmml_tpu.obs import drift as drift_mod
+
+    title = label or "aggregate"
+    print(f"== {title} · drift ==", file=out)
+    s = drift_mod.summary(struct)
+    counters = struct.get("counters") or {}
+    if not s:
+        print("(no drift telemetry recorded — set FJT_DRIFT_SAMPLE "
+              "and snapshot a baseline with fjt-drift)", file=out)
+        return
+    alarms = counters.get("drift_alarms", 0)
+    if alarms:
+        print(f"alarms   {alarms:.0f} raised (see drift_alarm flight "
+              "events)", file=out)
+    for model in sorted(s):
+        m = s[model]
+        pred = m.get("prediction_psi")
+        head = f"model {model}"
+        if pred is not None:
+            mark = " [ALARM]" if m.get("prediction_alarmed") else ""
+            head += f"   prediction drift PSI {pred:.4f}{mark}"
+        print(head, file=out)
+        rows = m.get("features") or {}
+        if not rows:
+            continue
+        print(
+            f"{'feature':<20}{'psi':>9}{'missing':>9}{'unseen':>9}"
+            f"{'n':>10}  alarm",
+            file=out,
+        )
+        ranked = sorted(
+            rows.items(),
+            key=lambda kv: (
+                kv[1]["psi"] if kv[1]["psi"] is not None else -1.0
+            ),
+            reverse=True,
+        )
+        for name, row in ranked:
+            def cell(key, fmt):
+                v = row.get(key)
+                return "-" if v is None else format(v, fmt)
+
+            print(
+                f"{name:<20}{cell('psi', '.4f'):>9}"
+                f"{cell('missing_rate', '.2%'):>9}"
+                f"{cell('unseen_rate', '.2%'):>9}"
+                f"{cell('n', ',.0f'):>10}"
+                f"  {'ALARM' if row.get('alarmed') else '-'}",
+                file=out,
+            )
+
+
 def _top_render_overload(label: str, struct: dict, out) -> None:
     """The ``--overload`` panel: the admission/adaptive-batching plane
     (serving/overload.py) as one operator view — deadline vs live p99,
@@ -595,6 +671,11 @@ def top_main(argv: Optional[List[str]] = None) -> int:
                     help="render the overload/admission panel (deadline "
                          "vs p99, adaptive batch, shed level + per-lane "
                          "shed counts) instead of the stage table")
+    ap.add_argument("--drift", action="store_true",
+                    help="render the data-drift panel (per-feature "
+                         "live-vs-baseline PSI ranked worst-first, "
+                         "missing/out-of-domain rates, prediction "
+                         "drift, alarms) instead of the stage table")
     ap.add_argument("--watch", type=float, default=None, metavar="N",
                     help="re-render every N seconds from a live source "
                          "(operator console mode; mid-watch fetch "
@@ -602,11 +683,14 @@ def top_main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     if args.watch is not None and args.watch <= 0:
         raise SystemExit(f"--watch must be > 0, got {args.watch}")
-    if args.freshness and args.overload:
-        raise SystemExit("--freshness and --overload are exclusive")
+    if sum((args.freshness, args.overload, args.drift)) > 1:
+        raise SystemExit(
+            "--freshness, --overload, and --drift are exclusive"
+        )
     render = (
         _top_render_freshness if args.freshness
         else _top_render_overload if args.overload
+        else _top_render_drift if args.drift
         else _top_render
     )
 
@@ -654,6 +738,155 @@ def top_main(argv: Optional[List[str]] = None) -> int:
                       file=sys.stderr, flush=True)
             sys.stdout.flush()
         _time.sleep(args.watch)
+
+
+def _drift_merge_sources(sources: Dict[str, dict]) -> dict:
+    """One struct to snapshot/check against: the aggregate (``""``)
+    label when the source carries one, else the fleet merge of every
+    labelled struct (a supervisor /varz without a precomputed
+    aggregate)."""
+    from flink_jpmml_tpu.utils.metrics import merge_structs
+
+    if "" in sources:
+        return sources[""]
+    return merge_structs(list(sources.values()))
+
+
+def drift_main(argv: Optional[List[str]] = None) -> int:
+    """``fjt-drift``: manage the data-drift baseline registry
+    (obs/drift.py) from the shell — no jax import, safe on any host.
+
+        fjt-drift snapshot http://127.0.0.1:9100   # live profile → baseline
+        fjt-drift snapshot BENCH_r09.json --model <hash>
+        fjt-drift list
+        fjt-drift check http://127.0.0.1:9100      # PSI table vs baseline
+
+    ``snapshot`` captures the source's CURRENT cumulative per-feature
+    profiles as the reference the DriftMonitor diffs live windows
+    against, content-addressed beside the autotune cache (override with
+    --dir). A corrupt baseline file on disk reads as absent — simply
+    re-snapshot."""
+    ap = argparse.ArgumentParser(
+        prog="fjt-drift",
+        description="Capture, list, and check data-drift baselines.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ap_snap = sub.add_parser(
+        "snapshot",
+        help="capture a reference profile per (model, feature) from a "
+             "live /varz URL, a struct dump, or a BENCH artifact",
+    )
+    ap_snap.add_argument("source")
+    ap_snap.add_argument("--model", default=None,
+                         help="only this model label (default: all)")
+    ap_snap.add_argument("--dir", default=None,
+                         help="baseline directory (default: "
+                              "drift_baselines/ beside the autotune "
+                              "cache)")
+    ap_list = sub.add_parser("list", help="list stored baselines")
+    ap_list.add_argument("--dir", default=None)
+    ap_check = sub.add_parser(
+        "check",
+        help="PSI of a source's cumulative profiles vs the stored "
+             "baselines (exit 1 when any feature exceeds --psi)",
+    )
+    ap_check.add_argument("source")
+    ap_check.add_argument("--model", default=None)
+    ap_check.add_argument("--dir", default=None)
+    ap_check.add_argument("--psi", type=float, default=0.25,
+                          help="failure threshold (default 0.25)")
+    args = ap.parse_args(argv)
+
+    from flink_jpmml_tpu.obs import drift as drift_mod
+    from flink_jpmml_tpu.utils.metrics import QuantileSketch
+
+    store = drift_mod.BaselineStore(args.dir)
+
+    if args.cmd == "list":
+        models = store.models()
+        if not models:
+            print(f"no baselines under {store.root}", file=sys.stderr)
+            return 0
+        for m in models:
+            payload = store.load(m)
+            feats = sorted((payload or {}).get("features") or {})
+            print(f"{m}  features={len(feats)}  "
+                  f"predictions={'yes' if (payload or {}).get('predictions') else 'no'}  "
+                  f"({store.path(m)})")
+        return 0
+
+    struct = _drift_merge_sources(_top_load(args.source))
+    payloads = drift_mod.snapshot_from_struct(struct)
+    if args.model is not None:
+        payloads = {
+            k: v for k, v in payloads.items() if k == args.model
+        }
+    if not payloads:
+        raise SystemExit(
+            f"no drift profiles in {args.source!r}"
+            + (f" for model {args.model!r}" if args.model else "")
+            + " — is FJT_DRIFT_SAMPLE set on the pipeline?"
+        )
+
+    if args.cmd == "snapshot":
+        for label, payload in sorted(payloads.items()):
+            try:
+                path = store.save(label, payload)
+            except OSError as e:
+                # a snapshot that didn't land must FAIL — the operator
+                # would otherwise believe the drift plane is armed
+                raise SystemExit(
+                    f"cannot write baseline for {label!r}: {e}"
+                )
+            print(
+                f"baselined {label}: {len(payload['features'])} features"
+                + (", predictions" if payload.get("predictions") else "")
+                + f" -> {path}",
+                file=sys.stderr,
+            )
+        return 0
+
+    # check: cumulative-vs-baseline PSI per feature
+    rc = 0
+    for label, payload in sorted(payloads.items()):
+        base = store.load(label)
+        if base is None:
+            print(f"{label}: no baseline stored (fjt-drift snapshot "
+                  "first)", file=sys.stderr)
+            continue
+        print(f"model {label}")
+        rows = []
+        for feat, lstate in sorted(payload.get("features", {}).items()):
+            bstate = (base.get("features") or {}).get(feat)
+            if bstate is None:
+                continue
+            try:
+                score = drift_mod.psi(
+                    QuantileSketch.from_state(bstate),
+                    QuantileSketch.from_state(lstate),
+                )
+            except (KeyError, TypeError, ValueError):
+                score = None
+            rows.append((feat, score))
+        bpred, lpred = base.get("predictions"), payload.get("predictions")
+        if isinstance(bpred, dict) and isinstance(lpred, dict):
+            try:
+                rows.append(("(predictions)", drift_mod.psi(
+                    QuantileSketch.from_state(bpred),
+                    QuantileSketch.from_state(lpred),
+                )))
+            except (KeyError, TypeError, ValueError):
+                pass
+        rows.sort(key=lambda r: -1.0 if r[1] is None else r[1],
+                  reverse=True)
+        for feat, score in rows:
+            verdict = "-"
+            if score is not None and score > args.psi:
+                verdict = "DRIFTED"
+                rc = 1
+            s = "-" if score is None else f"{score:.4f}"
+            print(f"  {feat:<20} psi {s:>9}  {verdict}")
+    return rc
 
 
 if __name__ == "__main__":

@@ -32,7 +32,13 @@ the outside:
 - :mod:`flink_jpmml_tpu.obs.pressure` — the composite backpressure
   score over ring occupancy, window-full fraction, and admission wait,
   with a multi-window breach tracker on ``/healthz``
-  (``FJT_PRESSURE_WINDOWS``).
+  (``FJT_PRESSURE_WINDOWS``);
+- :mod:`flink_jpmml_tpu.obs.drift` — the data plane: sampled
+  per-feature profiles and mergeable value sketches
+  (``FJT_DRIFT_SAMPLE``), a content-addressed baseline registry beside
+  the autotune cache, and windowed PSI/JS drift monitoring with
+  alarm/clear hysteresis — the first sensor plane that sees the
+  payload, not the system.
 """
 
 from flink_jpmml_tpu.obs.recorder import FlightRecorder, record  # noqa: F401

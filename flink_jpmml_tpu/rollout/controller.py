@@ -44,6 +44,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from flink_jpmml_tpu.obs import drift as drift_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.rollout.state import (
     NEXT_STAGE,
@@ -80,6 +81,13 @@ def labelled(base: str, name: str) -> str:
     return f'{base}{{model="{name}"}}'
 
 
+def labelled_role(base: str, name: str, role: str) -> str:
+    """Two-label variant for the per-role score-distribution sketches
+    (``rollout_score_dist{model=...,role=...}`` — the order the scorer
+    registers them in)."""
+    return f'{base}{{model="{name}",role="{role}"}}'
+
+
 def _named_values(section: dict, base: str) -> Dict[str, float]:
     """→ {model name: value} for every ``base{model="..."}`` entry."""
     out: Dict[str, float] = {}
@@ -106,6 +114,16 @@ def _counter_delta(new: dict, old: Optional[dict], key: str) -> float:
     # baseline frame is from a previous incarnation — fall back to the
     # cumulative value rather than reporting impossible negatives
     return d if d >= 0 else float(nc.get(key, 0.0))
+
+
+def _sketch_window(new: dict, old: Optional[dict], key: str):
+    """The observation window's score-distribution sketch (newest
+    cumulative state minus the baseline frame — the
+    ``drift.sketch_window`` delta, with the same worker-restart
+    cumulative fallback as :func:`_hist_window`)."""
+    ns = (new.get("sketches") or {}).get(key) if isinstance(new, dict) else None
+    os_ = (old.get("sketches") or {}).get(key) if isinstance(old, dict) else None
+    return drift_mod.sketch_window(ns, os_)
 
 
 def _hist_window(new: dict, old: Optional[dict], key: str) -> Optional[Histogram]:
@@ -322,18 +340,68 @@ class RolloutController:
                         f"{spec.max_latency_ratio:g}x incumbent "
                         f"{ip99 * 1e3:.2f}ms"
                     )
+        # prediction drift (the data-plane guardrail, obs/drift.py):
+        # PSI of the candidate's windowed score distribution against
+        # the incumbent's — a candidate can agree record-by-record
+        # within tolerance yet shift the score DISTRIBUTION your
+        # downstream thresholds were calibrated on
+        hold_psi = spec.effective_hold_psi
+        pred_psi = None
+        if hold_psi is not None or spec.max_prediction_psi is not None:
+            cw = _sketch_window(
+                new, old,
+                labelled_role("rollout_score_dist", name, "candidate"),
+            )
+            iw = _sketch_window(
+                new, old,
+                labelled_role("rollout_score_dist", name, "incumbent"),
+            )
+            if (
+                cw is not None and iw is not None
+                and cw.count() >= spec.min_samples
+                and iw.count() >= spec.min_samples
+            ):
+                pred_psi = drift_mod.psi(iw, cw)
+            if pred_psi is not None:
+                stats["prediction_psi"] = pred_psi
+                self.metrics.gauge(
+                    f'rollout_prediction_psi{{model="{name}"}}'
+                ).set(round(pred_psi, 4))
+                if (
+                    reason is None
+                    and spec.max_prediction_psi is not None
+                    and pred_psi > spec.max_prediction_psi
+                ):
+                    reason = (
+                        f"prediction PSI {pred_psi:.4f} > "
+                        f"{spec.max_prediction_psi:.4f}"
+                    )
         if reason is not None:
             return self._actuate(
                 name, st, STAGE_ROLLBACK, reason, stats, now
             )
 
         # promotion: healthy + sample floor met this window + dwelt long
-        # enough at the current stage
+        # enough at the current stage; a prediction PSI above the hold
+        # threshold withholds promotion (the candidate keeps serving its
+        # current stage until the drift subsides or crosses max)
         floor = compared if st.stage == STAGE_SHADOW else cand_records
         if (
             floor >= spec.min_samples
             and now - st.stage_since >= spec.promote_after_s
         ):
+            if (
+                hold_psi is not None
+                and pred_psi is not None
+                and pred_psi > hold_psi
+            ):
+                flight.record(
+                    "rollout_promotion_held", model=name,
+                    version=st.candidate_version, stage=st.stage,
+                    prediction_psi=round(pred_psi, 4),
+                    hold_threshold=hold_psi,
+                )
+                return None
             return self._actuate(
                 name, st, NEXT_STAGE[st.stage],
                 f"healthy for {now - st.stage_since:.1f}s", stats, now,

@@ -66,6 +66,14 @@ class GuardrailSpec:
     max_latency_ratio: float = 2.0
     # rollback when candidate dispatch/decode errors exceed this rate
     max_error_rate: float = 0.0
+    # prediction-drift guardrails (obs/drift.py PSI of the candidate's
+    # windowed score distribution against the incumbent's, both sides
+    # past min_samples): above ``hold`` the controller withholds
+    # promotion even after the dwell (the candidate keeps proving
+    # itself); above ``max`` it rolls back. None disables each;
+    # ``hold`` unset with ``max`` set defaults to half of ``max``.
+    max_prediction_psi: Optional[float] = None
+    hold_prediction_psi: Optional[float] = None
     # observations required in-window before any verdict counts
     min_samples: int = 100
     # healthy dwell at a stage before the controller promotes
@@ -90,6 +98,19 @@ class GuardrailSpec:
             raise ValueError(
                 f"max_error_rate must be in [0, 1]: {self.max_error_rate}"
             )
+        for f_name in ("max_prediction_psi", "hold_prediction_psi"):
+            v = getattr(self, f_name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{f_name} must be > 0: {v}")
+        if (
+            self.max_prediction_psi is not None
+            and self.hold_prediction_psi is not None
+            and self.hold_prediction_psi > self.max_prediction_psi
+        ):
+            raise ValueError(
+                "hold_prediction_psi must not exceed max_prediction_psi: "
+                f"{self.hold_prediction_psi} > {self.max_prediction_psi}"
+            )
         if self.min_samples < 1:
             raise ValueError(f"min_samples must be >= 1: {self.min_samples}")
         if not (0.0 < self.canary_fraction <= 1.0):
@@ -101,8 +122,19 @@ class GuardrailSpec:
                 f"shadow_sample must be in (0, 1]: {self.shadow_sample}"
             )
 
+    @property
+    def effective_hold_psi(self) -> Optional[float]:
+        """The promotion-hold threshold actually enforced: the explicit
+        ``hold_prediction_psi``, else half the rollback threshold when
+        only ``max_prediction_psi`` is set, else None (disabled)."""
+        if self.hold_prediction_psi is not None:
+            return self.hold_prediction_psi
+        if self.max_prediction_psi is not None:
+            return self.max_prediction_psi / 2.0
+        return None
+
     def as_dict(self) -> dict:
-        return {
+        out = {
             "max_disagree_rate": self.max_disagree_rate,
             "max_latency_ratio": self.max_latency_ratio,
             "max_error_rate": self.max_error_rate,
@@ -112,6 +144,13 @@ class GuardrailSpec:
             "canary_fraction": self.canary_fraction,
             "shadow_sample": self.shadow_sample,
         }
+        # absent unless configured: the wire form (checkpoints, control
+        # frames) stays byte-compatible with pre-drift readers
+        if self.max_prediction_psi is not None:
+            out["max_prediction_psi"] = self.max_prediction_psi
+        if self.hold_prediction_psi is not None:
+            out["hold_prediction_psi"] = self.hold_prediction_psi
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "GuardrailSpec":
@@ -126,8 +165,10 @@ class GuardrailSpec:
             ("window_s", float),
             ("canary_fraction", float),
             ("shadow_sample", float),
+            ("max_prediction_psi", float),
+            ("hold_prediction_psi", float),
         ):
-            if f_name in d:
+            if f_name in d and d[f_name] is not None:
                 kw[f_name] = conv(d[f_name])
         return replace(base, **kw)
 

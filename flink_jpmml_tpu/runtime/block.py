@@ -28,6 +28,7 @@ import numpy as np
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
 from flink_jpmml_tpu.obs import attr as attr_mod
+from flink_jpmml_tpu.obs import drift as drift_mod
 from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import spans
@@ -247,6 +248,12 @@ class BoundScorer:
             return self.model.decode(out, n)
 
         decode.model_key = key
+        # the drift plane's content-addressed label: matches the
+        # feature-profile label dispatch_quantized records under, so a
+        # model's feature and prediction series share one baseline
+        decode.model_hash = (
+            self.q.model_hash if self.q is not None else None
+        )
         self.decode = decode
 
 
@@ -639,6 +646,11 @@ class BlockPipelineBase:
         # no thread of their own
         freshness = fresh_mod.freshness_for(self.metrics)
         monitor = pressure_mod.pressure_for(self.metrics)
+        # the data-drift plane (obs/drift.py): None unless
+        # FJT_DRIFT_SAMPLE is set or a bench mode armed it — predictions
+        # are sketched at the sink, features already rode
+        # dispatch_quantized; its monitor ticks from these record calls
+        dplane = drift_mod.plane_for(self.metrics)
         ring_occ = self.metrics.gauge("ring_occupancy")
         ring_cap = float(max(self._config.batch.queue_capacity, 1))
 
@@ -665,6 +677,15 @@ class BlockPipelineBase:
             spans.emit("sink", t_sink, t_done - t_sink, n=n)
             if ledger is not None:
                 ledger.observe("sink", t_done - t_sink)
+            if dplane is not None:
+                # score-distribution sketch at the sink (sampled): shed
+                # batches never reach here, so a shed record can no
+                # more skew the prediction baseline than the watermark
+                dplane.record_predictions(
+                    getattr(decode, "model_hash", None)
+                    or getattr(decode, "model_key", None),
+                    out, n,
+                )
             lat.observe(t_done - t_start)
             records_out.inc(n)
             if self._batcher is not None:

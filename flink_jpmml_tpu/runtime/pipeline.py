@@ -63,6 +63,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from flink_jpmml_tpu.obs import attr as attr_mod
+from flink_jpmml_tpu.obs import drift as drift_mod
 from flink_jpmml_tpu.obs import profiler as prof_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import spans
@@ -207,6 +208,14 @@ def dispatch_quantized(
     enc, h2d = (
         _wire_counters(metrics) if metrics is not None else (None, None)
     )
+    # data-drift profiling (obs/drift.py) on the RAW batch, before any
+    # encode touches it: None + one env lookup when FJT_DRIFT_SAMPLE is
+    # unset (the pinned zero-records contract); rate-limited + overhead-
+    # budgeted when armed. Outside the encode timing window below so
+    # encode_s / the encode stage ledger stay honest.
+    dplane = drift_mod.plane_for(metrics)
+    if dplane is not None:
+        dplane.record_features(q, X, M)
     t0 = time.monotonic()
     fused = getattr(q, "encode_mode", "host") == "fused" and q.supports_fused
     if fused:

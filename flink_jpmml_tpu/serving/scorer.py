@@ -46,6 +46,7 @@ from flink_jpmml_tpu.models.control import RolloutMessage
 from flink_jpmml_tpu.models.core import ModelId
 from flink_jpmml_tpu.models.prediction import Prediction
 from flink_jpmml_tpu.obs import attr as attr_mod
+from flink_jpmml_tpu.obs import drift as drift_mod
 from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import recorder as flight
@@ -456,6 +457,10 @@ class DynamicScorer(Scorer):
     def finish(self, ticket) -> List[Any]:
         n, records, tickets, shadows, unserved, shed, t_submit = ticket
         preds: List[Optional[Prediction]] = [None] * n
+        # the data-drift plane (obs/drift.py): inert (None) unless
+        # FJT_DRIFT_SAMPLE armed it — the record-path sink is this
+        # finish loop, so score sketches book here, per served model
+        dplane = drift_mod.plane_for(self.metrics)
         for model, idxs, handle, rollinfo in tickets:
             role = rollinfo[1] if rollinfo is not None else None
             failed = False
@@ -486,6 +491,12 @@ class DynamicScorer(Scorer):
                 self._observe_rollout_group(
                     rollinfo[0], role, len(idxs), handle
                 )
+                # per-role score distributions: the guardrail
+                # controller's prediction-PSI signal (windowed
+                # candidate-vs-incumbent divergence) reads these
+                self._record_score_dist(rollinfo[0], role, decoded)
+            if dplane is not None and not failed:
+                dplane.record_predictions(model, decoded)
             for i, p in zip(idxs, decoded):
                 preds[i] = p
         self._diff_shadows(shadows, preds)
@@ -559,6 +570,24 @@ class DynamicScorer(Scorer):
                 f'rollout_incumbent_latency_s{{model="{name}"}}'
             ).observe(lat)
 
+    def _record_score_dist(self, name: str, role: str, decoded) -> None:
+        """Sketch one rolled-out group's score values per role
+        (``rollout_score_dist{model,role}``): mergeable
+        :class:`~flink_jpmml_tpu.utils.metrics.QuantileSketch` states
+        whose candidate-vs-incumbent window PSI is the guardrail
+        controller's prediction-drift signal. Both roles ride the same
+        batches through the same window, so the comparison is
+        like-for-like."""
+        vals = [
+            float(p.score.value)
+            for p in decoded
+            if p is not None and not p.is_empty and p.score is not None
+        ]
+        if vals:
+            self.metrics.sketch(
+                f'rollout_score_dist{{model="{name}",role="{role}"}}'
+            ).observe_many(np.asarray(vals, np.float64))
+
     def _diff_shadows(self, shadows, preds) -> None:
         """Fetch + decode the mirrored candidate dispatches and diff
         them against the incumbent's emitted predictions: disagreement
@@ -579,10 +608,14 @@ class DynamicScorer(Scorer):
                 continue
             # mirrored dispatches are real candidate work: they feed the
             # candidate latency histogram (the shadow stage's only
-            # latency signal) exactly like canary-served groups
+            # latency signal) exactly like canary-served groups — and
+            # the candidate score sketch, so prediction-PSI guardrails
+            # evaluate at the shadow stage too (hold BEFORE any live
+            # traffic ever routes to a drifted candidate)
             self.metrics.histogram(
                 f'rollout_candidate_latency_s{{model="{name}"}}'
             ).observe(time.monotonic() - handle.t_launch)
+            self._record_score_dist(name, "candidate", decoded)
             disagreements = 0
             drift = self.metrics.histogram(
                 f'rollout_shadow_drift{{model="{name}"}}'

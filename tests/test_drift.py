@@ -1,0 +1,1093 @@
+"""Data-drift plane (obs/drift.py + utils/metrics.QuantileSketch).
+
+Pins the tentpole contracts of the fourth sensor plane:
+
+- the sketch's merge EXACTNESS discipline (associativity under
+  adversarial orderings, state-roundtrip fidelity, fleet merge ==
+  per-worker state merge) and its quantile error bound;
+- baseline save/load/corruption (silent re-snapshot, like the
+  autotune cache);
+- DriftMonitor alarm/clear hysteresis under a fake clock;
+- ZERO drift-plane records when FJT_DRIFT_SAMPLE is unset;
+- the dispatch/sink integrations and the rollout prediction-PSI
+  guardrail (hold promotion / roll back).
+"""
+
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.obs import drift
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.utils.metrics import (
+    MetricsRegistry,
+    QuantileSketch,
+    Reservoir,
+    merge_structs,
+)
+
+
+class _FakeWire:
+    def __init__(self, fields, cuts):
+        self.fields = tuple(fields)
+        self.cuts = tuple(np.asarray(c, np.float32) for c in cuts)
+
+
+class _FakeScorer:
+    def __init__(self, fields=("a", "b", "c"), cuts=None, model_hash="m01"):
+        if cuts is None:
+            cuts = [np.array([-1.0, 0.0, 1.0])] * len(fields)
+        self.wire = _FakeWire(fields, cuts)
+        self.model_hash = model_hash
+
+
+def _plane(reg, store=None, **kw):
+    kw.setdefault("interval_s", 0.0)
+    kw.setdefault("budget_frac", 0)  # drills/tests want determinism
+    return drift.install(reg, store=store, **kw)
+
+
+# ---------------------------------------------------------------------------
+# QuantileSketch
+# ---------------------------------------------------------------------------
+
+
+class TestQuantileSketch:
+    def _adversarial_orderings(self, vals):
+        asc = np.sort(vals)
+        return [
+            vals,
+            asc,
+            asc[::-1],
+            # extremes-first interleave: worst case for compaction-
+            # scheduled sketches, a no-op for value-partition ones
+            np.concatenate([asc[::2], asc[1::2][::-1]]),
+        ]
+
+    def test_merge_associativity_exact_under_orderings(self):
+        rng = np.random.default_rng(0)
+        base = np.concatenate([
+            rng.normal(0, 1, 3000),
+            rng.normal(50, 5, 2000),
+            -rng.lognormal(0, 2, 1000),
+            np.zeros(100),
+        ])
+        for order in self._adversarial_orderings(base):
+            thirds = np.array_split(order, 3)
+            parts = []
+            for t in thirds:
+                s = QuantileSketch()
+                s.observe_many(t)
+                parts.append(s.state())
+
+            def sk(state):
+                return QuantileSketch.from_state(state)
+
+            ab_c = sk(parts[0]).merge(sk(parts[1])).merge(sk(parts[2]))
+            a_bc = sk(parts[0]).merge(sk(parts[1]).merge(sk(parts[2])))
+            c_ab = sk(parts[2]).merge(sk(parts[0]).merge(sk(parts[1])))
+            s1, s2, s3 = ab_c.state(), a_bc.state(), c_ab.state()
+            for key in ("pos", "neg", "zero", "n"):
+                assert s1[key] == s2[key] == s3[key], key
+            for q in (0.001, 0.01, 0.1, 0.5, 0.9, 0.99, 0.999):
+                assert (
+                    ab_c.quantile(q) == a_bc.quantile(q) == c_ab.quantile(q)
+                ), q
+
+    def test_order_independence(self):
+        # bucket membership is a pure function of the value, so the
+        # SAME multiset in any order yields the identical state — the
+        # property that makes fleet merge exact
+        rng = np.random.default_rng(1)
+        vals = rng.normal(2.0, 3.0, 5000)
+        states = []
+        for order in self._adversarial_orderings(vals):
+            s = QuantileSketch()
+            s.observe_many(order)
+            st = s.state()
+            states.append((st["pos"], st["neg"], st["zero"], st["n"]))
+        assert all(st == states[0] for st in states[1:])
+
+    def test_quantile_error_bound(self):
+        # the estimate is the nearest-rank observation's bucket upper
+        # edge: true(q) <= est(q) <= true(q) * gamma for positive data
+        rng = np.random.default_rng(2)
+        vals = rng.lognormal(0.0, 2.0, 20000)
+        s = QuantileSketch()
+        s.observe_many(vals)
+        gamma = 10.0 ** (1.0 / QuantileSketch.DEFAULT_BPD)
+        srt = np.sort(vals)
+        for q in (0.01, 0.1, 0.5, 0.9, 0.99, 0.999):
+            rank = min(max(math.ceil(q * len(srt)) - 1, 0), len(srt) - 1)
+            true = srt[rank]
+            est = s.quantile(q)
+            assert true * (1 - 1e-9) <= est <= true * gamma * (1 + 1e-9), (
+                q, true, est,
+            )
+
+    def test_state_roundtrip_exact(self):
+        rng = np.random.default_rng(3)
+        s = QuantileSketch()
+        s.observe_many(rng.normal(0, 1, 1000))
+        s.observe_many(-rng.lognormal(0, 1, 500))
+        st = s.state()
+        assert QuantileSketch.from_state(st).state() == st
+        # ...and through a JSON wire hop (the heartbeat piggyback)
+        st2 = json.loads(json.dumps(st))
+        assert QuantileSketch.from_state(st2).state() == st
+
+    def test_moments_welford_and_chan_merge(self):
+        rng = np.random.default_rng(4)
+        vals = rng.normal(3.0, 2.0, 10000)
+        whole = QuantileSketch()
+        whole.observe_many(vals)
+        assert whole.mean() == pytest.approx(vals.mean(), rel=1e-9)
+        assert whole.variance() == pytest.approx(vals.var(), rel=1e-9)
+        parts = [QuantileSketch() for _ in range(4)]
+        for p, chunk in zip(parts, np.array_split(vals, 4)):
+            p.observe_many(chunk)
+        merged = parts[0]
+        for p in parts[1:]:
+            merged.merge(p)
+        assert merged.mean() == pytest.approx(vals.mean(), rel=1e-9)
+        assert merged.variance() == pytest.approx(vals.var(), rel=1e-7)
+        assert merged.count() == 10000
+        assert merged.sum() == pytest.approx(vals.sum(), rel=1e-9)
+
+    def test_nonfinite_dropped_and_zero_bucket(self):
+        s = QuantileSketch()
+        n = s.observe_many([1.0, np.nan, np.inf, -np.inf, 0.0, 1e-12])
+        assert n == 3  # 1.0, 0.0, 1e-12 — the tiny ones in the zero bucket
+        assert s.count() == 3
+        assert s.state()["zero"] == 2
+
+    def test_budget_compaction_preserves_counts(self):
+        s = QuantileSketch(budget=16)
+        s.observe_many(np.logspace(-6, 6, 500))
+        st = s.state()
+        assert len(st["pos"]) <= 16
+        assert s.count() == 500
+        # compaction folds toward LARGER magnitude / the zero bucket:
+        # the top quantile is untouched
+        assert s.quantile(0.99) >= np.logspace(-6, 6, 500)[494] * 0.9
+
+    def test_merge_layout_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            QuantileSketch(buckets_per_decade=8).merge(
+                QuantileSketch(buckets_per_decade=4)
+            )
+
+    def test_registry_struct_and_fleet_merge_exact(self):
+        rng = np.random.default_rng(5)
+        regs = [MetricsRegistry(), MetricsRegistry()]
+        chunks = [rng.normal(0, 1, 4000), rng.normal(1, 2, 4000)]
+        for reg, chunk in zip(regs, chunks):
+            reg.sketch("s").observe_many(chunk)
+        fleet = merge_structs([r.struct_snapshot() for r in regs])
+        direct = QuantileSketch.from_state(regs[0].sketch("s").state())
+        direct.merge(QuantileSketch.from_state(regs[1].sketch("s").state()))
+        merged = QuantileSketch.from_state(fleet["sketches"]["s"])
+        assert merged.count() == direct.count() == 8000
+        for q in (0.01, 0.1, 0.5, 0.9, 0.99):
+            assert merged.quantile(q) == direct.quantile(q)
+        # garbage sketch entries are skipped, never raised
+        ok = merge_structs([
+            {"sketches": {"s": "garbage"}},
+            regs[0].struct_snapshot(),
+        ])
+        assert ok["sketches"]["s"]["n"] == 4000
+
+    def test_struct_snapshot_has_no_sketch_key_when_empty(self):
+        # pre-drift consumers (and equality-pinned fleet tests) must
+        # see byte-identical struct shapes
+        assert "sketches" not in MetricsRegistry().struct_snapshot()
+
+
+class TestReservoirRoundtrip:
+    def test_state_roundtrip(self):
+        r = Reservoir(capacity=4)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0):  # wraps: ring keeps recent
+            r.observe(v)
+        st = r.state()
+        r2 = Reservoir.from_state(json.loads(json.dumps(st)))
+        assert r2.state() == st
+        assert r2.quantile(0.5) == r.quantile(0.5)
+        # continued observation honours the restored ring cursor
+        r.observe(6.0)
+        r2.observe(6.0)
+        assert r2.state() == r.state()
+
+    def test_deliberately_not_mergeable(self):
+        assert not hasattr(Reservoir(), "merge")
+
+    def test_still_absent_from_fleet_wire(self):
+        m = MetricsRegistry()
+        m.reservoir("res").observe(1.0)
+        snap = m.struct_snapshot()
+        assert "res" not in str(snap)
+
+
+# ---------------------------------------------------------------------------
+# PSI / JS / windows
+# ---------------------------------------------------------------------------
+
+
+class TestDivergence:
+    def _sk(self, vals):
+        s = QuantileSketch()
+        s.observe_many(vals)
+        return s
+
+    def test_identical_distributions_near_zero(self):
+        rng = np.random.default_rng(6)
+        a = self._sk(rng.normal(0, 1, 8000))
+        b = self._sk(rng.normal(0, 1, 8000))
+        assert drift.psi(a, b) < 0.02
+        assert drift.js_divergence(a, b) < 0.01
+
+    def test_shifted_distribution_scores_high(self):
+        rng = np.random.default_rng(7)
+        a = self._sk(rng.normal(0, 1, 8000))
+        b = self._sk(rng.normal(3, 1, 8000))
+        assert drift.psi(a, b) > 1.0
+        js = drift.js_divergence(a, b)
+        assert 0.1 < js <= math.log(2) + 1e-9
+
+    def test_empty_side_is_none_and_smoothing_is_finite(self):
+        rng = np.random.default_rng(8)
+        a = self._sk(rng.normal(0, 1, 1000))
+        assert drift.psi(a, QuantileSketch()) is None
+        assert drift.psi(QuantileSketch(), a) is None
+        # fully disjoint supports: smoothing keeps PSI finite
+        b = self._sk(rng.normal(1000, 1, 1000))
+        v = drift.psi(a, b)
+        assert v is not None and math.isfinite(v) and v > 1.0
+
+    def test_constant_feature_baseline(self):
+        a = self._sk(np.full(500, 2.5))
+        same = self._sk(np.full(400, 2.5))
+        moved = self._sk(np.full(400, 9.0))
+        assert drift.psi(a, same) < 0.02
+        assert drift.psi(a, moved) > 0.5
+
+    def test_sketch_window_delta_and_fallbacks(self):
+        rng = np.random.default_rng(9)
+        s = QuantileSketch()
+        s.observe_many(rng.normal(0, 1, 1000))
+        old = s.state()
+        s.observe_many(rng.normal(5, 1, 500))
+        new = s.state()
+        w = drift.sketch_window(new, old)
+        assert w.count() == 500
+        assert w.quantile(0.5) > 2.0  # the window is the NEW data only
+        # no delta → None
+        assert drift.sketch_window(new, new) is None
+        # counts going backwards (worker restart) → cumulative fallback
+        w2 = drift.sketch_window(old, new)
+        assert w2 is not None and w2.count() == 1000
+        # no old frame → cumulative
+        assert drift.sketch_window(new, None).count() == 1500
+        assert drift.sketch_window(None, old) is None
+
+
+# ---------------------------------------------------------------------------
+# Baseline store
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineStore:
+    def _payload(self):
+        s = QuantileSketch()
+        s.observe_many(np.arange(100, dtype=np.float64))
+        return {"features": {"a": s.state()}, "stats": {}, "predictions": None}
+
+    def test_save_load_roundtrip(self, tmp_path):
+        store = drift.BaselineStore(tmp_path)
+        store.save("m01", self._payload())
+        loaded = store.load("m01")
+        assert loaded is not None
+        assert loaded["model"] == "m01"
+        assert "a" in loaded["features"]
+        assert store.models() == ["m01"]
+
+    def test_corruption_reads_as_absent(self, tmp_path):
+        store = drift.BaselineStore(tmp_path)
+        path = store.save("m01", self._payload())
+        good = path.read_bytes()
+        for garbage in (b"\x00garbage{{{", b"[]", b"{}"):
+            path.write_bytes(garbage)
+            assert store.load("m01") is None  # silent re-snapshot
+        # a hand-edited payload fails the content hash too
+        doc = json.loads(good)
+        doc["features"]["a"]["n"] = 999999
+        path.write_text(json.dumps(doc))
+        assert store.load("m01") is None
+        path.write_bytes(good)
+        assert store.load("m01") is not None
+
+    def test_missing_and_unreadable(self, tmp_path):
+        store = drift.BaselineStore(tmp_path / "nonexistent")
+        assert store.load("nope") is None
+        assert store.models() == []
+
+    def test_save_failure_raises(self, tmp_path):
+        # UNLIKE load, save must fail loudly: a silently-dropped
+        # snapshot leaves the drift plane dark while the operator
+        # believes it is armed
+        # a regular FILE where the directory chain must go: mkdir
+        # raises NotADirectoryError on any uid (chmod-based denial
+        # would be bypassed by a root test runner)
+        blocker = tmp_path / "blocker"
+        blocker.write_text("")
+        store = drift.BaselineStore(blocker / "bl")
+        with pytest.raises(OSError):
+            store.save("m01", self._payload())
+
+    def test_monitor_adopts_a_resnapshotted_baseline(self, tmp_path):
+        # the accept-the-new-regime remedy: fjt-drift re-snapshot over
+        # HTTP must reach a live monitor via the periodic store
+        # re-probe, not only at process start
+        rng = np.random.default_rng(30)
+        store = drift.BaselineStore(tmp_path)
+        t = [0.0]
+        sk = QuantileSketch()  # the live cumulative stream (N(0,1))
+        sk.observe_many(rng.normal(0, 1, 4000))
+
+        def struct():
+            return {
+                "sketches": {
+                    drift.feature_sketch_name("m", "x"): sk.state()
+                },
+                "counters": {},
+            }
+
+        mon = drift.DriftMonitor(
+            struct_fn=struct, store=store,
+            psi_alarm=0.25, psi_clear=0.1, min_n=50,
+            window_s=0.5, dwell_s=0.0, interval_s=0.0,
+            clock=lambda: t[0],
+        )
+        old_base = QuantileSketch()
+        old_base.observe_many(rng.normal(5, 1, 4000))
+        store.save("m", {"features": {"x": old_base.state()},
+                         "stats": {}, "predictions": None})
+        assert [tr["transition"] for tr in mon.tick()] == ["alarm"]
+        # operator re-baselines onto the CURRENT (N(0,1)) regime; the
+        # stream keeps flowing in-regime
+        store.save("m", {"features": {"x": sk.state()},
+                         "stats": {}, "predictions": None})
+        sk.observe_many(rng.normal(0, 1, 4000))
+        t[0] = drift._BASELINE_REPROBE_S + 1.0
+        # the re-probe adopts the new file within the SAME tick, and
+        # with dwell 0 the alarm clears right there
+        assert [tr["transition"] for tr in mon.tick()] == ["clear"]
+        # ...and a DELETED file never disarms a held baseline
+        store.path("m").unlink()
+        sk.observe_many(rng.normal(0, 1, 4000))
+        t[0] = 2 * (drift._BASELINE_REPROBE_S + 1.0)
+        mon.tick()
+        assert mon.scores()[("m", "x")] is not None
+
+    def test_snapshot_from_struct_shapes(self):
+        reg = MetricsRegistry()
+        plane = _plane(reg)
+        plane.record_features(
+            _FakeScorer(), np.zeros((64, 3), np.float32)
+        )
+        plane.record_predictions("m01", np.arange(32, dtype=np.float32))
+        payloads = drift.snapshot_from_struct(reg.struct_snapshot())
+        assert set(payloads) == {"m01"}
+        p = payloads["m01"]
+        assert set(p["features"]) == {"a", "b", "c"}
+        assert p["predictions"] is not None
+        assert p["stats"]["a"]["records"] == 64
+
+
+# ---------------------------------------------------------------------------
+# DriftPlane (the hot-path recorder)
+# ---------------------------------------------------------------------------
+
+
+class TestDriftPlane:
+    def test_zero_records_when_env_unset(self, monkeypatch):
+        monkeypatch.delenv("FJT_DRIFT_SAMPLE", raising=False)
+        reg = MetricsRegistry()
+        assert drift.plane_for(reg) is None
+        # the real dispatch gate: nothing lands in the registry
+        from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized  # noqa: F401
+
+        snap = reg.struct_snapshot()
+        assert "sketches" not in snap
+        assert not any(
+            k.startswith("drift_") for k in snap["counters"]
+        )
+
+    def test_env_arms_the_plane(self, monkeypatch):
+        monkeypatch.setenv("FJT_DRIFT_SAMPLE", "0")
+        reg = MetricsRegistry()
+        plane = drift.plane_for(reg)
+        assert plane is not None
+        assert drift.plane_for(reg) is plane  # cached
+
+    def test_records_profiles_missing_and_unseen(self):
+        reg = MetricsRegistry()
+        plane = _plane(reg)
+        q = _FakeScorer(
+            fields=("a", "b"),
+            cuts=[np.array([-1.0, 1.0]), np.empty((0,))],
+        )
+        X = np.array(
+            [[0.0, 5.0], [2.0, 5.0], [np.nan, 5.0], [-3.0, np.nan]],
+            np.float32,
+        )
+        assert plane.record_features(q, X)
+        c = reg.struct_snapshot()["counters"]
+        assert c['drift_feature_records{model="m01",feature="a"}'] == 4
+        assert c['drift_feature_missing{model="m01",feature="a"}'] == 1
+        # 2.0 and -3.0 sit beyond [-1, 1]; NaN is missing, not unseen
+        assert c['drift_feature_unseen{model="m01",feature="a"}'] == 2
+        # feature b has no cuts: never out-of-domain
+        assert c['drift_feature_unseen{model="m01",feature="b"}'] == 0
+        assert c['drift_feature_missing{model="m01",feature="b"}'] == 1
+        sk = reg.sketches()['feature_values{model="m01",feature="a"}']
+        assert sk.count() == 3  # missing excluded from the value sketch
+
+    def test_explicit_mask_folds_into_missing(self):
+        reg = MetricsRegistry()
+        plane = _plane(reg)
+        q = _FakeScorer(fields=("a",), cuts=[np.array([0.0])])
+        X = np.array([[1.0], [2.0]], np.float32)
+        M = np.array([[True], [False]])
+        plane.record_features(q, X, M)
+        c = reg.struct_snapshot()["counters"]
+        assert c['drift_feature_missing{model="m01",feature="a"}'] == 1
+        assert reg.sketches()[
+            'feature_values{model="m01",feature="a"}'
+        ].count() == 1
+
+    def test_interval_rate_limit_fake_clock(self):
+        t = [0.0]
+        reg = MetricsRegistry()
+        plane = drift.DriftPlane(
+            reg, interval_s=1.0, budget_frac=None, clock=lambda: t[0],
+        )
+        q = _FakeScorer()
+        X = np.zeros((8, 3), np.float32)
+        assert plane.record_features(q, X)
+        assert not plane.record_features(q, X)  # inside the interval
+        t[0] = 1.5
+        assert plane.record_features(q, X)
+        # the two families rate-limit independently
+        assert plane.record_predictions("m01", np.ones(4))
+        assert not plane.record_predictions("m01", np.ones(4))
+
+    def test_row_cap(self):
+        reg = MetricsRegistry()
+        plane = _plane(reg, max_rows=16)
+        plane.record_features(_FakeScorer(), np.zeros((1000, 3), np.float32))
+        c = reg.struct_snapshot()["counters"]
+        assert c['drift_feature_records{model="m01",feature="a"}'] <= 16
+
+    def test_row_subsample_spans_the_whole_batch(self):
+        # ceil stride: drift clustered in a drain's TAIL must still be
+        # sampled (floor division truncated to the leading rows)
+        reg = MetricsRegistry()
+        plane = _plane(reg, max_rows=512)
+        X = np.zeros((1000, 1), np.float32)
+        X[500:, 0] = np.nan  # the entire second half is missing
+        q = _FakeScorer(fields=("a",), cuts=[np.array([0.0])])
+        plane.record_features(q, X)
+        c = reg.struct_snapshot()["counters"]
+        miss = c['drift_feature_missing{model="m01",feature="a"}']
+        rec = c['drift_feature_records{model="m01",feature="a"}']
+        assert rec <= 512
+        assert 0.4 <= miss / rec <= 0.6, (miss, rec)
+
+    def test_budget_gate_skips(self):
+        t = [0.0]
+        reg = MetricsRegistry()
+        plane = drift.DriftPlane(
+            reg, interval_s=0.0, budget_frac=0.02, clock=lambda: t[0],
+        )
+        q = _FakeScorer()
+        X = np.zeros((64, 3), np.float32)
+        t[0] = 0.001
+        assert plane.record_features(q, X)  # first sample goes through
+        # pretend that sample was expensive relative to elapsed wall
+        with plane._mu:
+            plane._spent = 1.0
+        t[0] = 0.002
+        assert not plane.record_features(q, X)
+        assert plane.stats()["skipped"] >= 1
+        # wall clock catches up past spent/budget → sampling resumes
+        t[0] = 100.0
+        assert plane.record_features(q, X)
+
+    def test_prediction_extraction_shapes(self):
+        reg = MetricsRegistry()
+        plane = _plane(reg)
+        # tuple (classification) → the value plane
+        plane.record_predictions("m01", (np.arange(8.0), None, None), 8)
+        sk = reg.sketches()['prediction_values{model="m01"}']
+        assert sk.count() == 8
+        # unrecognizable input records nothing, never raises
+        t = [10.0]
+        plane._clock = lambda: t[0]
+        assert not plane.record_predictions("m01", object())
+
+    def test_dispatch_quantized_integration(self, tmp_path):
+        # the REAL dispatch path on a real compiled scorer
+        from assets.generate import gen_gbm
+        from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
+        from flink_jpmml_tpu.pmml import parse_pmml_file
+        from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+
+        doc = parse_pmml_file(
+            gen_gbm(str(tmp_path), n_trees=5, depth=2, n_features=3)
+        )
+        q = build_quantized_scorer(doc, batch_size=32)
+        rng = np.random.default_rng(0)
+        X = rng.normal(0, 1, (32, 3)).astype(np.float32)
+
+        # unarmed registry: the dispatch records nothing
+        cold = MetricsRegistry()
+        import jax
+
+        jax.block_until_ready(dispatch_quantized(q, X, metrics=cold))
+        assert "sketches" not in cold.struct_snapshot()
+
+        # armed registry: profiles land, labelled by model_hash
+        reg = MetricsRegistry()
+        _plane(reg)
+        jax.block_until_ready(dispatch_quantized(q, X, metrics=reg))
+        snap = reg.struct_snapshot()
+        key = f'feature_values{{model="{q.model_hash}",feature="f0"}}'
+        assert snap["sketches"][key]["n"] == 32
+
+
+# ---------------------------------------------------------------------------
+# DriftMonitor (hysteresis under a fake clock)
+# ---------------------------------------------------------------------------
+
+
+class _CumFeed:
+    """A worker's CUMULATIVE drift state (what a live registry holds);
+    the monitor windows over deltas of successive ``struct()`` frames,
+    exactly as it does against a real registry or fleet merge."""
+
+    def __init__(self, label="m"):
+        self.label = label
+        self.sk = QuantileSketch()
+        self.pred = QuantileSketch()
+
+    def add(self, vals=None, pred=None):
+        if vals is not None:
+            self.sk.observe_many(vals)
+        if pred is not None:
+            self.pred.observe_many(pred)
+
+    def struct(self):
+        sketches = {
+            drift.feature_sketch_name(self.label, "x"): self.sk.state()
+        }
+        if self.pred.count():
+            sketches[
+                drift.prediction_sketch_name(self.label)
+            ] = self.pred.state()
+        return {"sketches": sketches, "counters": {}}
+
+
+class TestDriftMonitorHysteresis:
+    def _monitor(self, feed, t, **kw):
+        gauges = MetricsRegistry()
+        kw.setdefault("psi_alarm", 0.25)
+        kw.setdefault("psi_clear", 0.1)
+        kw.setdefault("min_n", 50)
+        kw.setdefault("window_s", 1e9)
+        kw.setdefault("dwell_s", 5.0)
+        mon = drift.DriftMonitor(
+            struct_fn=feed.struct,
+            store=drift.BaselineStore("/nonexistent-drift-dir"),
+            interval_s=0.0,
+            clock=lambda: t[0],
+            gauge_metrics=gauges,
+            **kw,
+        )
+        return mon, gauges
+
+    def test_alarm_requires_dwell_then_fires_once(self):
+        rng = np.random.default_rng(10)
+        t = [0.0]
+        feed = _CumFeed()
+        feed.add(rng.normal(0, 1, 4000))
+        mon, gauges = self._monitor(feed, t)
+        mon.set_baseline(
+            "m", drift.snapshot_from_struct(feed.struct())["m"]
+        )
+        assert mon.tick() == []          # baseline frame: psi ≈ 0
+        feed.add(rng.normal(4, 1, 4000))  # the drift arrives
+        t[0] = 1.0
+        assert mon.tick() == []          # above threshold, dwell starts
+        t[0] = 3.0
+        assert mon.tick() == []          # still inside the dwell
+        t[0] = 6.5
+        trans = mon.tick()
+        assert [tr["transition"] for tr in trans] == ["alarm"]
+        assert trans[0]["feature"] == "x"
+        t[0] = 7.0
+        assert mon.tick() == []          # no re-fire while alarmed
+        assert mon.alarms() and not mon.health()["drift"]["ok"]
+        g = gauges.struct_snapshot()["gauges"]
+        assert g['drift_alarmed{model="m",feature="x"}']["value"] == 1.0
+        assert g['drift_score{model="m",feature="x"}']["value"] > 0.25
+        assert gauges.struct_snapshot()["counters"]["drift_alarms"] == 1
+
+    def test_band_wobble_neither_clears_nor_realarms(self):
+        # hysteresis: a score inside (clear, alarm) accrues progress in
+        # NEITHER direction. window_s below the 1s tick spacing makes
+        # each tick's window the delta since the previous tick, so each
+        # phase's distribution is under test control.
+        rng = np.random.default_rng(11)
+        t = [0.0]
+        feed = _CumFeed()
+        feed.add(rng.normal(0, 1, 4000))
+        mon, _ = self._monitor(feed, t, dwell_s=0.0, window_s=0.9)
+        mon.set_baseline(
+            "m", drift.snapshot_from_struct(feed.struct())["m"]
+        )
+        mon.tick()                       # baseline frame
+        feed.add(rng.normal(4, 1, 4000))
+        t[0] = 1.0
+        assert [tr["transition"] for tr in mon.tick()] == ["alarm"]
+        # the next window lands INSIDE the band: psi(N(0,1), N(.35,1))
+        # ≈ 0.14 ∈ (0.1, 0.25)
+        feed.add(rng.normal(0.35, 1.0, 4000))
+        t[0] = 2.0
+        trans = mon.tick()
+        assert trans == [], (trans, mon.scores())
+        score = mon.scores()[("m", "x")]
+        assert 0.1 < score < 0.25, score  # genuinely in the band
+        assert mon.alarms()  # still alarmed: the band held the state
+
+    def test_clear_requires_sustained_below_clear(self):
+        rng = np.random.default_rng(12)
+        t = [0.0]
+        feed = _CumFeed()
+        feed.add(rng.normal(0, 1, 4000))
+        mon, _ = self._monitor(feed, t, dwell_s=2.0, window_s=0.9)
+        mon.set_baseline(
+            "m", drift.snapshot_from_struct(feed.struct())["m"]
+        )
+        mon.tick()                       # baseline frame
+        feed.add(rng.normal(4, 1, 4000))
+        t[0] = 1.0
+        assert mon.tick() == []          # drifted, dwell starts
+        feed.add(rng.normal(4, 1, 4000))
+        t[0] = 3.2
+        assert [tr["transition"] for tr in mon.tick()] == ["alarm"]
+        # recovery: subsequent windows match the baseline again (the
+        # retained baseline frame can be up to window+tick old, so the
+        # first recovered tick still sees the drifted chunk)
+        feed.add(rng.normal(0, 1, 4000))
+        t[0] = 4.0
+        assert mon.tick() == []          # window still spans the drift
+        feed.add(rng.normal(0, 1, 4000))
+        t[0] = 5.0
+        assert mon.tick() == []          # below clear, dwell starts
+        feed.add(rng.normal(0, 1, 4000))
+        t[0] = 6.2
+        assert mon.tick() == []          # 1.2s below < the 2s dwell
+        feed.add(rng.normal(0, 1, 4000))
+        t[0] = 7.3
+        trans = mon.tick()
+        assert [tr["transition"] for tr in trans] == ["clear"]
+        assert not mon.alarms() and mon.health()["drift"]["ok"]
+        ev = [e for e in flight.events() if e.get("kind") == "drift_clear"]
+        assert ev and ev[-1]["model"] == "m"
+
+    def test_prediction_series_alarm(self):
+        rng = np.random.default_rng(13)
+        t = [0.0]
+        feed = _CumFeed()
+        feed.add(rng.normal(0, 1, 4000), pred=rng.normal(2, 1, 4000))
+        mon, gauges = self._monitor(feed, t, dwell_s=0.0)
+        mon.set_baseline(
+            "m", drift.snapshot_from_struct(feed.struct())["m"]
+        )
+        mon.tick()                       # baseline frame
+        # predictions shift; the feature stream stays steady
+        feed.add(rng.normal(0, 1, 4000), pred=rng.normal(9, 1, 4000))
+        t[0] = 1.0
+        trans = mon.tick()
+        kinds = {(tr["feature"], tr["transition"]) for tr in trans}
+        assert (None, "alarm") in kinds  # the prediction series
+        assert ("x", "alarm") not in kinds  # features stayed quiet
+        g = gauges.struct_snapshot()["gauges"]
+        assert g['prediction_drift{model="m"}']["value"] > 0.25
+
+    def test_min_n_floor_blocks_verdicts(self):
+        rng = np.random.default_rng(14)
+        t = [0.0]
+        feed = _CumFeed()
+        feed.add(rng.normal(0, 1, 4000))
+        mon, _ = self._monitor(feed, t, dwell_s=0.0, min_n=10_000)
+        mon.set_baseline(
+            "m", drift.snapshot_from_struct(feed.struct())["m"]
+        )
+        mon.tick()
+        feed.add(rng.normal(9, 1, 4000))
+        t[0] = 1.0
+        assert mon.tick() == []  # window below the sample floor
+        assert mon.scores() == {}
+
+    def test_health_fn_composes(self):
+        t = [0.0]
+        mon, _ = self._monitor(_CumFeed(), t)
+        h = mon.health_fn(lambda: {"ok": True, "base": 1})()
+        assert h["ok"] and h["base"] == 1 and h["drift"]["ok"]
+
+    def test_scrape_hook_ticks_registry_monitor(self):
+        # registry mode: a /metrics scrape (struct_snapshot) must tick
+        # the monitor even when no batch loop is running — the wedged-
+        # consumer guarantee
+        reg = MetricsRegistry()
+        t = [0.0]
+        mon = drift.monitor_for(reg)
+        mon._clock = lambda: t[0]
+        mon._interval = 0.0
+        mon.dwell_s = 0.0
+        mon.min_n = 50
+        rng = np.random.default_rng(15)
+        sk = reg.sketch(drift.feature_sketch_name("m", "x"))
+        sk.observe_many(rng.normal(0, 1, 4000))
+        mon.set_baseline(
+            "m", drift.snapshot_from_struct(reg.struct_snapshot())["m"]
+        )
+        sk.observe_many(rng.normal(8, 1, 4000))
+        t[0] = 1.0
+        reg.struct_snapshot()  # the scrape IS the tick
+        assert mon.alarms(), mon.scores()
+
+
+# ---------------------------------------------------------------------------
+# Rollout prediction-PSI guardrail
+# ---------------------------------------------------------------------------
+
+
+class TestPredictionPsiGuardrail:
+    def _controller(self, spec, structs, t):
+        from flink_jpmml_tpu.rollout.controller import RolloutController
+        from flink_jpmml_tpu.rollout.state import RolloutState
+
+        applied = []
+
+        class _Book:
+            def rollouts(self):
+                return {
+                    "m": RolloutState(
+                        name="m", candidate_version=2, stage="canary",
+                        fraction=0.2, spec=spec, stage_since=0.0,
+                    )
+                }
+
+            def apply(self, msg):
+                applied.append(msg)
+                return True
+
+        ctl = RolloutController(
+            book=_Book(), struct_fn=lambda: structs[0],
+            metrics=MetricsRegistry(), interval_s=0.0,
+            clock=lambda: t[0],
+        )
+        return ctl, applied
+
+    def _struct(self, cand_vals, inc_vals, records):
+        ca, ia = QuantileSketch(), QuantileSketch()
+        ca.observe_many(cand_vals)
+        ia.observe_many(inc_vals)
+        return {
+            "counters": {
+                'rollout_candidate_records{model="m"}': records,
+                'rollout_incumbent_records{model="m"}': records,
+            },
+            "gauges": {},
+            "histograms": {},
+            "sketches": {
+                'rollout_score_dist{model="m",role="candidate"}': ca.state(),
+                'rollout_score_dist{model="m",role="incumbent"}': ia.state(),
+            },
+        }
+
+    def test_rollback_on_prediction_psi(self):
+        from flink_jpmml_tpu.rollout.state import GuardrailSpec
+
+        rng = np.random.default_rng(16)
+        spec = GuardrailSpec(
+            max_prediction_psi=0.25, min_samples=100,
+            promote_after_s=1e9,
+        )
+        inc = rng.normal(0, 1, 2000)
+        structs = [self._struct(rng.normal(0, 1, 2000), inc, 2000)]
+        t = [0.0]
+        ctl, applied = self._controller(spec, structs, t)
+        assert ctl.tick() == []  # healthy: same distribution
+        # candidate's score distribution shifts hard
+        structs[0] = self._struct(
+            np.concatenate([rng.normal(0, 1, 2000), rng.normal(5, 1, 2000)]),
+            np.concatenate([inc, rng.normal(0, 1, 2000)]),
+            4000,
+        )
+        t[0] = 1.0
+        decisions = ctl.tick()
+        assert len(decisions) == 1 and decisions[0]["action"] == "rollback"
+        assert "prediction PSI" in decisions[0]["reason"]
+        assert decisions[0]["prediction_psi"] > 0.25
+        assert applied and applied[0].stage == "rollback"
+        g = ctl.metrics.struct_snapshot()["gauges"]
+        assert g['rollout_prediction_psi{model="m"}']["value"] > 0.25
+
+    def test_hold_promotion_below_max_above_hold(self):
+        from flink_jpmml_tpu.rollout.state import GuardrailSpec
+
+        rng = np.random.default_rng(17)
+        # window_s below the tick spacing: each tick evaluates the
+        # delta since the previous tick, so each phase's distribution
+        # is under test control
+        spec = GuardrailSpec(
+            max_prediction_psi=50.0, hold_prediction_psi=0.05,
+            min_samples=100, promote_after_s=0.0, window_s=0.9,
+        )
+        inc = rng.normal(0, 1, 2000)
+        structs = [self._struct(rng.normal(0, 1, 2000), inc, 2000)]
+        t = [0.0]
+        ctl, applied = self._controller(spec, structs, t)
+        ctl.tick()  # baseline frame (cumulative window: psi ≈ 0 BUT
+        # promotion also needs the dwell evaluation below — accept
+        # either a promote here or not, then reset for the hold phase
+        applied.clear()
+        # moderate shift: psi above hold, far below max → promotion HELD
+        structs[0] = self._struct(
+            np.concatenate([rng.normal(0, 1, 2000), rng.normal(2, 1, 2000)]),
+            np.concatenate([inc, rng.normal(0, 1, 2000)]),
+            4000,
+        )
+        t[0] = 1.0
+        assert ctl.tick() == []
+        assert not applied  # neither promoted nor rolled back
+        held = [
+            e for e in flight.events()
+            if e.get("kind") == "rollout_promotion_held"
+        ]
+        assert held and held[-1]["model"] == "m"
+        # the drift subsides → the same dwell now promotes
+        structs[0] = self._struct(
+            np.concatenate([
+                rng.normal(0, 1, 2000), rng.normal(2, 1, 2000),
+                rng.normal(0, 1, 20000),
+            ]),
+            np.concatenate([inc, rng.normal(0, 1, 22000)]),
+            24000,
+        )
+        t[0] = 2.0
+        decisions = ctl.tick()
+        assert len(decisions) == 1 and decisions[0]["action"] == "promote"
+
+    def test_spec_wire_roundtrip_and_validation(self):
+        from flink_jpmml_tpu.rollout.state import GuardrailSpec
+
+        spec = GuardrailSpec(
+            max_prediction_psi=0.3, hold_prediction_psi=0.2
+        )
+        d = spec.as_dict()
+        assert d["max_prediction_psi"] == 0.3
+        assert GuardrailSpec.from_dict(json.loads(json.dumps(d))) == spec
+        # unset fields stay OFF the wire (pre-drift readers see the
+        # byte-compatible form) and default to disabled
+        d2 = GuardrailSpec().as_dict()
+        assert "max_prediction_psi" not in d2
+        assert GuardrailSpec.from_dict(d2).effective_hold_psi is None
+        assert GuardrailSpec(
+            max_prediction_psi=0.4
+        ).effective_hold_psi == pytest.approx(0.2)
+        with pytest.raises(ValueError):
+            GuardrailSpec(max_prediction_psi=-1.0)
+        with pytest.raises(ValueError):
+            GuardrailSpec(
+                max_prediction_psi=0.1, hold_prediction_psi=0.2
+            )
+
+    def test_scorer_records_score_dists(self, tmp_path):
+        # the live signal source: a rolled-out DynamicScorer sketches
+        # both roles' score distributions
+        from assets.generate import gen_gbm
+        from flink_jpmml_tpu.models.control import (
+            AddMessage, RolloutMessage,
+        )
+        from flink_jpmml_tpu.models.core import ModelId
+        from flink_jpmml_tpu.runtime.sources import ControlSource
+        from flink_jpmml_tpu.serving.scorer import DynamicScorer
+
+        pmml = gen_gbm(str(tmp_path), n_trees=5, depth=2, n_features=3)
+        # the candidate must be a byte-identical COPY at a different
+        # path: registering the SAME path re-attributes the incumbent's
+        # ModelInfo identity (the registry's re-warm optimization) and
+        # every group would count as "candidate"
+        pmml_v2 = str(tmp_path / "v2.pmml")
+        with open(pmml, "rb") as f:
+            doc_bytes = f.read()
+        with open(pmml_v2, "wb") as f:
+            f.write(doc_bytes)
+        ctrl = ControlSource()
+        sc = DynamicScorer(control=ctrl, batch_size=64, auto_rollout=False)
+        ctrl.push(AddMessage("m", 1, pmml, timestamp=time.time()))
+        sc._drain_control()
+        deadline = time.monotonic() + 60.0
+        while sc.registry.model_if_warm(ModelId("m", 1)) is None:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        ctrl.push(RolloutMessage("m", 2, "shadow", time.time(), path=pmml_v2))
+        sc._drain_control()
+        while sc.registry.model_if_warm(ModelId("m", 2)) is None:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        rng = np.random.default_rng(18)
+        fields = ["f0", "f1", "f2"]
+        for _ in range(4):
+            events = [
+                ("m", dict(zip(fields, rng.normal(0, 1, 3).tolist())))
+                for _ in range(64)
+            ]
+            sc.finish(sc.submit(events))
+        sk = sc.metrics.sketches()
+        cand = sk['rollout_score_dist{model="m",role="candidate"}']
+        inc = sk['rollout_score_dist{model="m",role="incumbent"}']
+        assert inc.count() >= 64 and cand.count() >= 1
+        # byte-identical candidate: distributions agree
+        assert drift.psi(inc, cand) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: summary / fjt-top --drift / fjt-drift CLI
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def _drifted_registry(self, tmp_path):
+        reg = MetricsRegistry()
+        store = drift.BaselineStore(tmp_path / "bl")
+        plane = _plane(reg, store=store)
+        mon = plane.monitor
+        mon.min_n = 50
+        mon.dwell_s = 0.0
+        mon._interval = 0.0
+        rng = np.random.default_rng(19)
+        q = _FakeScorer(fields=("a", "b"), cuts=[
+            np.array([-1.0, 1.0]), np.array([-1.0, 1.0]),
+        ])
+        for _ in range(8):
+            plane.record_features(
+                q, rng.normal(0, 1, (128, 2)).astype(np.float32)
+            )
+        drift.snapshot_registry(reg, store=store)
+        for _ in range(8):
+            X = rng.normal(0, 1, (128, 2)).astype(np.float32)
+            X[:, 1] += 5.0
+            plane.record_features(q, X)
+        return reg, store
+
+    def test_summary_and_artifact_fields(self, tmp_path):
+        reg, _ = self._drifted_registry(tmp_path)
+        s = drift.summary(reg)
+        feats = s["m01"]["features"]
+        assert feats["b"]["psi"] > 0.25 and feats["b"]["alarmed"]
+        assert feats["a"]["psi"] < 0.25 and not feats["a"]["alarmed"]
+        assert feats["b"]["n"] > 0
+        art = drift.artifact_fields(reg)
+        assert art["m01"]["worst_feature"] == "b"
+        assert art["m01"]["alarmed_features"] == ["b"]
+        assert drift.summary({}) is None
+        assert drift.artifact_fields({}) is None
+
+    def test_top_render_drift_panel(self, tmp_path):
+        import io
+
+        from flink_jpmml_tpu import cli
+
+        reg, _ = self._drifted_registry(tmp_path)
+        out = io.StringIO()
+        cli._top_render_drift("w0", reg.struct_snapshot(), out)
+        text = out.getvalue()
+        assert "w0 · drift" in text
+        assert "ALARM" in text
+        # ranked worst-first: the drifted feature's row precedes the
+        # quiet one's
+        assert text.index("\nb ") < text.index("\na ")
+        # an empty struct renders the honest fallback
+        out2 = io.StringIO()
+        cli._top_render_drift("", {}, out2)
+        assert "no drift telemetry" in out2.getvalue()
+
+    def test_fjt_drift_cli_roundtrip(self, tmp_path, capsys):
+        from flink_jpmml_tpu import cli
+
+        reg, _ = self._drifted_registry(tmp_path)
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(reg.struct_snapshot()))
+        bl = str(tmp_path / "cli-bl")
+        assert cli.drift_main(
+            ["snapshot", str(dump), "--dir", bl]
+        ) == 0
+        assert cli.drift_main(["list", "--dir", bl]) == 0
+        assert "m01" in capsys.readouterr().out
+        # checking the SAME data against its own snapshot: stable
+        assert cli.drift_main(["check", str(dump), "--dir", bl]) == 0
+        # a shifted source fails the check with exit 1
+        rng = np.random.default_rng(20)
+        plane = drift.plane_for(reg)
+        qsc = _FakeScorer(fields=("a", "b"), cuts=[
+            np.array([-1.0, 1.0]), np.array([-1.0, 1.0]),
+        ])
+        for _ in range(20):
+            X = rng.normal(0, 1, (256, 2)).astype(np.float32)
+            X[:, 0] += 8.0
+            plane.record_features(qsc, X)
+        dump.write_text(json.dumps(reg.struct_snapshot()))
+        assert cli.drift_main(["check", str(dump), "--dir", bl]) == 1
+        out = capsys.readouterr().out
+        assert "DRIFTED" in out
+
+    def test_fjt_rollout_cli_psi_flags(self, tmp_path, capsys):
+        from flink_jpmml_tpu import cli
+        from flink_jpmml_tpu.models.control import from_wire
+
+        ctrl = tmp_path / "ctrl.jsonl"
+        rc = cli.rollout_main([
+            str(ctrl), "canary", "--name", "m", "--version", "2",
+            "--max-prediction-psi", "0.25",
+            "--hold-prediction-psi", "0.1",
+        ])
+        assert rc == 0
+        msg = from_wire(json.loads(ctrl.read_text().strip()))
+        assert msg.guardrails.max_prediction_psi == 0.25
+        assert msg.guardrails.hold_prediction_psi == 0.1
+
+
+# ---------------------------------------------------------------------------
+# The drill (smoke-scale) — the acceptance surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestDriftDrill:
+    def test_drill_passes(self):
+        from flink_jpmml_tpu.bench import run_drift_drill
+
+        line = run_drift_drill(records_per_phase=4096, batch=256)
+        assert line["ok"] and line["merge_exact"]
+        assert line["perturbed_feature"] == "f1"
+        assert line["psi_control"] < 0.25 < line["psi_perturbed"]
+        assert line["varz"]["sketches"]

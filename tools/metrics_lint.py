@@ -1,19 +1,32 @@
 #!/usr/bin/env python
-"""Metric-name drift lint (CI tier-1 via tests/test_metrics_lint.py).
+"""Metric-name + fleet-merge-rule lint (CI tier-1 via
+tests/test_metrics_lint.py).
 
-Every metric name the runtime registers must appear in the operator
-catalogue (docs/operations.md, "Metric name catalogue" table) and vice
-versa — a renamed counter that silently vanishes from dashboards, or a
-documented metric nothing emits, both fail this check.
+Two contracts, both static (regex-level, zero imports of the package —
+runs in milliseconds and cannot be skewed by which code paths a test
+run happened to execute):
 
-Static, regex-level, zero imports of the package (runs in milliseconds
-and cannot be skewed by which code paths a test run happened to
-execute): every ``.counter("...")`` / ``.gauge(...)`` /
-``.histogram(...)`` / ``.reservoir(...)`` call with a literal (or
+1. **Name sync** — every metric name the runtime registers must appear
+   in the operator catalogue (docs/operations.md, "Metric name
+   catalogue" table) and vice versa: a renamed counter that silently
+   vanishes from dashboards, or a documented metric nothing emits,
+   both fail.
+2. **Merge-rule sync** — every catalogue row must declare its
+   fleet-merge semantics in the Merge column (counters `sum`,
+   histograms/sketches `buckets`, gauges `sum`/`min`/`max`/`worst-of`)
+   and the gauge declarations must MATCH what
+   ``utils/metrics.py merge_structs`` actually does (its
+   ``_GAUGE_MERGE_MAX_PREFIXES``/``_GAUGE_MERGE_MIN_PREFIXES`` tables,
+   parsed from source) — a gauge documented worst-of that the code
+   sums renders fleet dashboards arithmetic nonsense. Conversely,
+   every prefix rule in those tables must be exercised by at least one
+   catalogue gauge row, so a dead or typo'd prefix can't linger.
+
+Every ``.counter("...")`` / ``.gauge(...)`` / ``.histogram(...)`` /
+``.reservoir(...)`` / ``.sketch(...)`` call with a literal (or
 f-string-literal) first argument is an emission site. F-string
 placeholders normalize to ``*`` — the same wildcard the catalogue uses
-for dynamic segments (``stage_*_s``, ``scorer_backend_*``,
-``kafka_lag{partition="*"}``).
+for dynamic segments (``stage_*_s``, ``kafka_lag{partition="*"}``).
 
 Exit 0 = in sync; 1 = drift (each direction listed); 2 = the catalogue
 table could not be found (the docs structure changed under the lint —
@@ -22,21 +35,40 @@ fix the parser, don't delete the contract).
 
 from __future__ import annotations
 
+import ast
 import pathlib
 import re
 import sys
-from typing import Set, Tuple
+from typing import Dict, Set, Tuple
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 SRC = REPO / "flink_jpmml_tpu"
 DOCS = REPO / "docs" / "operations.md"
+METRICS_PY = SRC / "utils" / "metrics.py"
 
 # .counter("name") / .gauge(f"...") — single or double quoted literal
 _CALL = re.compile(
-    r"\.(counter|gauge|histogram|reservoir)\(\s*(f?)(\"([^\"]+)\"|'([^']+)')"
+    r"\.(counter|gauge|histogram|reservoir|sketch)"
+    r"\(\s*(f?)(\"([^\"]+)\"|'([^']+)')"
 )
 _CATALOGUE_HEAD = "### Metric name catalogue"
-_ROW_NAME = re.compile(r"^\|\s*`([^`]+)`")
+_ROW = re.compile(
+    r"^\|\s*`([^`]+)`\s*\|\s*([a-z]+)\s*\|\s*([a-z-]+)\s*\|"
+)
+_PREFIX_TABLE = re.compile(
+    r"^(_GAUGE_MERGE_(?:MAX|MIN)_PREFIXES)\s*=\s*(\(.*?\))",
+    re.MULTILINE | re.DOTALL,
+)
+
+# what the Merge column may say, per kind; gauges are checked against
+# the CODE's merge mode below, not just this vocabulary
+_MERGE_VOCAB = {
+    "counter": {"sum"},
+    "histogram": {"buckets"},
+    "sketch": {"buckets"},
+    "gauge": {"sum", "max", "min", "worst-of"},
+    "reservoir": {"none"},
+}
 
 
 def _normalize_fstring(s: str) -> str:
@@ -47,21 +79,60 @@ def _normalize_fstring(s: str) -> str:
     return s.replace("\x00", "{").replace("\x01", "}")
 
 
-def code_names() -> Set[Tuple[str, str]]:
-    """→ {(name, 'file:line')} for every literal registration site."""
-    out: Set[Tuple[str, str]] = set()
+def code_names() -> Set[Tuple[str, str, str]]:
+    """→ {(name, kind, 'file:line')} for every literal registration
+    site."""
+    out: Set[Tuple[str, str, str]] = set()
     for path in sorted(SRC.rglob("*.py")):
         text = path.read_text(encoding="utf-8")
         for m in _CALL.finditer(text):
+            kind = m.group(1)
             is_f = bool(m.group(2))
             raw = m.group(4) if m.group(4) is not None else m.group(5)
             name = _normalize_fstring(raw) if is_f else raw
             line = text.count("\n", 0, m.start()) + 1
-            out.add((name, f"{path.relative_to(REPO)}:{line}"))
+            out.add((name, kind, f"{path.relative_to(REPO)}:{line}"))
     return out
 
 
-def doc_names() -> Set[str]:
+def gauge_merge_prefixes() -> Dict[str, Tuple[str, ...]]:
+    """Parse the merge prefix tables out of utils/metrics.py source
+    (``ast.literal_eval`` on the tuple literals — no package import)."""
+    text = METRICS_PY.read_text(encoding="utf-8")
+    out: Dict[str, Tuple[str, ...]] = {}
+    for m in _PREFIX_TABLE.finditer(text):
+        try:
+            out[m.group(1)] = tuple(ast.literal_eval(m.group(2)))
+        except (SyntaxError, ValueError):
+            pass
+    if (
+        "_GAUGE_MERGE_MAX_PREFIXES" not in out
+        or "_GAUGE_MERGE_MIN_PREFIXES" not in out
+    ):
+        print(
+            "metrics-lint: could not parse the gauge merge prefix "
+            f"tables from {METRICS_PY} — fix the parser, don't drop "
+            "the contract",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    return out
+
+
+def _code_gauge_mode(name: str, prefixes: Dict[str, Tuple[str, ...]]) -> str:
+    """What merge_structs does to this gauge (mirror of
+    ``_gauge_merge_mode``, driven by the parsed tables; min checked
+    first, as in the code)."""
+    base = name.split("{", 1)[0]
+    if base.startswith(prefixes["_GAUGE_MERGE_MIN_PREFIXES"]):
+        return "min"
+    if base.startswith(prefixes["_GAUGE_MERGE_MAX_PREFIXES"]):
+        return "max"
+    return "sum"
+
+
+def doc_rows() -> Dict[str, Tuple[str, str]]:
+    """→ {name: (kind, merge)} from the catalogue table."""
     text = DOCS.read_text(encoding="utf-8")
     try:
         section = text.split(_CATALOGUE_HEAD, 1)[1]
@@ -71,40 +142,43 @@ def doc_names() -> Set[str]:
             f"{DOCS}", file=sys.stderr,
         )
         sys.exit(2)
-    names: Set[str] = set()
+    rows: Dict[str, Tuple[str, str]] = {}
     in_table = False
     for line in section.splitlines():
         if line.startswith("|"):
             in_table = True
-            m = _ROW_NAME.match(line)
+            m = _ROW.match(line)
             if m and m.group(1) not in ("Name",):
-                names.add(m.group(1))
+                rows[m.group(1)] = (m.group(2), m.group(3))
         elif in_table:
             break  # one table; the first non-| line after it ends it
-    if not names:
+    if not rows:
         print(
-            f"metrics-lint: catalogue table empty/unparseable in {DOCS}",
+            f"metrics-lint: catalogue table empty/unparseable in {DOCS} "
+            "(each row needs | `name` | kind | merge | meaning |)",
             file=sys.stderr,
         )
         sys.exit(2)
-    return names
+    return rows
 
 
 def main() -> int:
     emitted = code_names()
-    documented = doc_names()
-    emitted_names = {n for n, _ in emitted}
+    documented = doc_rows()
+    emitted_names = {n for n, _, _ in emitted}
     rc = 0
-    undocumented = sorted(emitted_names - documented)
+
+    # -- direction 1: every emission site documented -----------------------
+    undocumented = sorted(emitted_names - set(documented))
     if undocumented:
         rc = 1
         for n in undocumented:
-            sites = sorted(s for name, s in emitted if name == n)
+            sites = sorted(s for name, _, s in emitted if name == n)
             print(
                 f"metrics-lint: `{n}` is emitted ({', '.join(sites)}) "
                 "but missing from the docs/operations.md catalogue"
             )
-    unemitted = sorted(documented - emitted_names)
+    unemitted = sorted(set(documented) - emitted_names)
     if unemitted:
         rc = 1
         for n in unemitted:
@@ -112,10 +186,60 @@ def main() -> int:
                 f"metrics-lint: `{n}` is in the docs/operations.md "
                 "catalogue but nothing in flink_jpmml_tpu/ registers it"
             )
+
+    # -- direction 2: merge declarations match the code --------------------
+    prefixes = gauge_merge_prefixes()
+    for name, (kind, merge) in sorted(documented.items()):
+        vocab = _MERGE_VOCAB.get(kind)
+        if vocab is None:
+            rc = 1
+            print(
+                f"metrics-lint: `{name}` has unknown kind {kind!r} "
+                f"(one of {sorted(_MERGE_VOCAB)})"
+            )
+            continue
+        if merge not in vocab:
+            rc = 1
+            print(
+                f"metrics-lint: `{name}` ({kind}) declares merge "
+                f"{merge!r}; a {kind}'s merge must be one of "
+                f"{sorted(vocab)}"
+            )
+            continue
+        if kind == "gauge":
+            mode = _code_gauge_mode(name, prefixes)
+            ok = (
+                merge == mode
+                or (merge == "worst-of" and mode in ("max", "min"))
+            )
+            if not ok:
+                rc = 1
+                print(
+                    f"metrics-lint: `{name}` declares merge {merge!r} "
+                    f"but utils/metrics.merge_structs {mode}s it — "
+                    "fix the catalogue row or the "
+                    "_GAUGE_MERGE_*_PREFIXES tables"
+                )
+
+    # -- direction 3: every prefix rule exercised by a catalogue row -------
+    doc_gauges = [
+        name.split("{", 1)[0]
+        for name, (kind, _) in documented.items() if kind == "gauge"
+    ]
+    for table in ("_GAUGE_MERGE_MAX_PREFIXES", "_GAUGE_MERGE_MIN_PREFIXES"):
+        for prefix in prefixes[table]:
+            if not any(g.startswith(prefix) for g in doc_gauges):
+                rc = 1
+                print(
+                    f"metrics-lint: merge prefix {prefix!r} in "
+                    f"utils/metrics.py {table} matches no catalogue "
+                    "gauge row — dead rule or missing documentation"
+                )
+
     if rc == 0:
         print(
             f"metrics-lint: {len(emitted_names)} metric names in sync "
-            "with the catalogue"
+            "with the catalogue (merge rules verified)"
         )
     return rc
 

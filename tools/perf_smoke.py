@@ -45,6 +45,15 @@ fails loudly on exactly the regressions new concurrency code breeds:
   p99 plus a NON-ZERO explicit ``shed_records`` counter at 150%
   offered load, and post-surge recovery to <1.05× the steady-state
   p99 (ROADMAP item 5's acceptance drill, tier-1-guarded);
+- **drift-plane rot**: the ``bench.py --drift-drill`` engine at smoke
+  scale — the perturbed feature's ``drift_alarm`` fires while the
+  control feature stays quiet and the fleet-merged sketch quantiles
+  equal the per-worker state merge exactly — plus a live ``/metrics``
+  scrape of a baselined production pipeline asserting non-zero
+  ``fjt_drift_score`` gauges and feature-profile counters, and the
+  ≤2%-of-dispatch overhead bound on the sampled profile path (the
+  unsampled gate is µs-scale, and the accumulated-overhead budget
+  keeps the sampled work under 2% of wall clock by construction);
 - **fault-hook overhead**: with ``FJT_FAULTS`` unset, the injection
   hooks on the fetch/dispatch/checkpoint/score paths
   (``runtime/faults.py fire()``) must be a genuine no-op — sub-µs per
@@ -597,6 +606,147 @@ def check_overload_drill() -> None:
     assert varz["counters"]["admitted_records"] > 0
 
 
+def check_drift_plane() -> None:
+    """Data-drift-plane tripwire: (1) the bench drill at smoke scale —
+    right feature alarms, control stays quiet, fleet merge exact; (2) a
+    baselined production BlockPipeline whose live /metrics scrape
+    carries real drift telemetry; (3) the dispatch-path overhead bound:
+    the unsampled per-dispatch gate vs a production-shaped ~1 ms launch
+    (the attribution-tripwire estimator), and the sampled path held
+    ≤2% of wall clock by the plane's accumulated-overhead budget."""
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.bench import run_drift_drill
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.obs import drift
+    from flink_jpmml_tpu.obs.server import ObsServer
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+    # 1) the drill engine at smoke scale
+    line = run_drift_drill(records_per_phase=4096, batch=256)
+    assert line["ok"] and line["merge_exact"], line
+    model = line["model"]
+    assert line["perturbed_feature"] in (
+        line["drift"][model]["alarmed_features"]
+    ), line["drift"]
+    assert line["psi_control"] < 0.25, line["psi_control"]
+    # the drill's artifact carries the drift varz family
+    assert line["varz"]["sketches"], "drill varz carries no sketches"
+
+    # 2) live pipeline scrape: baseline → shifted stream → /metrics
+    with tempfile.TemporaryDirectory() as tmp:
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=10, depth=3, n_features=4)
+        )
+        cm = compile_pmml(doc, batch_size=64)
+        rng = np.random.default_rng(5)
+        base = rng.normal(0.0, 1.0, size=(1000, 4)).astype(np.float32)
+        shifted = base.copy()
+        shifted[:, 1] += 4.0
+        metrics = MetricsRegistry()
+        store = drift.BaselineStore(os.path.join(tmp, "bl"))
+        plane = drift.install(
+            metrics, interval_s=0.0, budget_frac=0, store=store
+        )
+        mon = plane.monitor
+        mon.min_n = 200
+        mon.dwell_s = 0.0
+        mon._interval = 0.0
+
+        def sink(out, n, first_off):
+            np.asarray(out if not hasattr(out, "value") else out.value)
+
+        def run_stream(data):
+            pipe = BlockPipeline(
+                FiniteBlockSource(data, block_size=100), cm, sink,
+                in_flight=2, use_native=False, metrics=metrics,
+            )
+            pipe.run_until_exhausted(timeout=60.0)
+
+        run_stream(base)
+        saved = drift.snapshot_registry(metrics, store=store)
+        assert saved, "pipeline recorded no drift profiles to baseline"
+        run_stream(shifted)
+        srv = ObsServer.for_registry(metrics)
+        try:
+            with urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10
+            ) as r:
+                assert r.status == 200
+                text = r.read().decode()
+        finally:
+            srv.close()
+        samples = {}
+        for ln in text.splitlines():
+            if ln.startswith("#") or not ln.strip():
+                continue
+            name, value = ln.split(" # ", 1)[0].rsplit(" ", 1)
+            samples[name] = float(value)
+        score_keys = [
+            k for k in samples if k.startswith("fjt_drift_score{")
+        ]
+        assert score_keys, "no fjt_drift_score gauges in the live scrape"
+        assert any(samples[k] > 0 for k in score_keys), (
+            "every scraped fjt_drift_score is zero after a 4-sigma "
+            f"shift: { {k: samples[k] for k in score_keys} }"
+        )
+        rec_keys = [
+            k for k in samples
+            if k.startswith("fjt_drift_feature_records{")
+        ]
+        assert rec_keys and all(samples[k] > 0 for k in rec_keys), (
+            "feature-profile counters missing from the dispatch path"
+        )
+        assert any(
+            k.startswith("fjt_feature_missing_rate{") for k in samples
+        ), "no missing-rate gauges in the scrape"
+
+        # 3) overhead bound on the dispatch path
+        q = cm.quantized_scorer()
+        assert q is not None
+        X = base[:256]
+        a = rng.normal(size=(128, 128)).astype(np.float32)
+        launches = 200
+        t0 = time.perf_counter()
+        for _ in range(launches):
+            for _ in range(24):  # ~1 ms of real work per launch
+                np.dot(a, a)
+        per_launch = (time.perf_counter() - t0) / launches
+        # (a) the steady-state per-dispatch cost is the unsampled gate
+        m2 = MetricsRegistry()
+        gate_plane = drift.install(m2, interval_s=3600.0)
+        gate_plane.record_features(q, X)  # the one sample; rest gate
+        n = 50_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            gate_plane.record_features(q, X)
+        per_gate = (time.perf_counter() - t0) / n
+        ratio = per_gate / per_launch
+        assert ratio <= 0.02, (
+            f"drift gate costs {100 * ratio:.2f}% of a launch "
+            f"({per_gate * 1e6:.2f}µs vs {per_launch * 1e6:.0f}µs)"
+        )
+        # (b) the sampled path: an interval-0 plane hammered for half a
+        # second must stay within its 2% accumulated-overhead budget
+        m3 = MetricsRegistry()
+        busy_plane = drift.install(m3, interval_s=0.0, budget_frac=0.02)
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.5:
+            busy_plane.record_features(q, X)
+        frac = busy_plane.overhead_fraction()
+        assert frac <= 0.03, (
+            f"sampled drift profiling consumed {100 * frac:.1f}% of "
+            "wall clock — the overhead budget is not holding"
+        )
+        assert busy_plane.stats()["sampled"] >= 2, busy_plane.stats()
+
+
 def check_fault_hooks_noop() -> None:
     """Fault harness zero-overhead contract: with FJT_FAULTS unset,
     fire() must be a global load + None check (≤ 2 µs even on a loaded
@@ -650,6 +800,8 @@ def main() -> int:
     print("perf-smoke: freshness burst drill OK", flush=True)
     check_overload_drill()
     print("perf-smoke: overload drill OK", flush=True)
+    check_drift_plane()
+    print("perf-smoke: drift plane OK", flush=True)
     check_fault_hooks_noop()
     print("perf-smoke: fault hooks no-op OK", flush=True)
     timer.cancel()
