@@ -153,6 +153,8 @@ def _child_cmd(args, force_cpu: bool) -> list:
         ("--skip-latency", args.skip_latency),
         ("--skip-kafka", args.skip_kafka),
         ("--no-autotune", args.no_autotune),
+        ("--kernel-search", args.kernel_search),
+        ("--no-kernel-search", args.no_kernel_search),
         ("--latency", args.latency),
         ("--block-pipeline", args.block_pipeline),
         ("--force-cpu", force_cpu),
@@ -1957,7 +1959,7 @@ def _latency_headline(line: dict, trees: int, backend: str) -> dict:
     }
 
 
-def main() -> None:
+def build_arg_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trees", type=int, default=500)
     ap.add_argument("--depth", type=int, default=6)
@@ -1997,6 +1999,15 @@ def main() -> None:
     ap.add_argument("--no-autotune", action="store_true",
                     help="skip the warmup autotune sweep (ablation: the "
                          "hand-picked defaults + host encode)")
+    ap.add_argument("--kernel-search", action="store_true",
+                    help="force a FRESH learned kernel search during "
+                         "warmup (ignore the autotune cache) so the "
+                         "artifact carries the full predict-then-verify "
+                         "ranking for this run")
+    ap.add_argument("--no-kernel-search", action="store_true",
+                    help="ablation: disable the learned-cost-model "
+                         "layout search (legacy ref-layout tile sweep "
+                         "only — sets FJT_KERNEL_SEARCH_DISABLE=1)")
     ap.add_argument("--latency", action="store_true",
                     help="make the latency operating point the headline "
                          "metric (p50 record latency in ms)")
@@ -2046,7 +2057,11 @@ def main() -> None:
                          "state merge exactly")
     ap.add_argument("--drift-records", type=int, default=12_000,
                     help="records per drift-drill phase")
-    args = ap.parse_args()
+    return ap
+
+
+def main() -> None:
+    args = build_arg_parser().parse_args()
     burst_factor = _parse_load_shape(args.load_shape)  # validate early
 
     if args.rollout_drill:
@@ -2139,6 +2154,10 @@ def main() -> None:
         # a true ablation: the compile-time cache consult must not apply
         # a config an earlier run swept (autotune.lookup honours this)
         os.environ["FJT_AUTOTUNE_DISABLE"] = "1"
+    if args.no_kernel_search:
+        # layout-search ablation: the warmup sweep falls back to the
+        # legacy ref-layout tile sweep (compile/autotune.py honours it)
+        os.environ["FJT_KERNEL_SEARCH_DISABLE"] = "1"
 
     import jax.numpy as jnp
     import numpy as np
@@ -2241,15 +2260,24 @@ def main() -> None:
     if q_tuned is not None and not args.no_autotune:
         from flink_jpmml_tpu.compile import autotune
 
-        stage("autotune: cache consult / warmup sweep")
-        tuned = autotune.ensure_tuned(q_tuned, pool_f32[0][:C], repeats=2)
+        stage("autotune: cache consult / learned kernel search")
+        tuned = autotune.ensure_tuned(
+            q_tuned, pool_f32[0][:C], repeats=2,
+            # --kernel-search: force a fresh predict-then-verify pass
+            # so the artifact embeds THIS run's candidate ranking
+            use_cache=not args.kernel_search,
+        )
         stage(
-            f"autotune: encode={tuned.encode} block_b={tuned.block_b} "
-            f"gt={tuned.gt} source={tuned.source}"
+            f"autotune: encode={tuned.encode} layout={tuned.layout} "
+            f"block_b={tuned.block_b} gt={tuned.gt} source={tuned.source}"
         )
 
     def autotune_fields(line: dict) -> dict:
         line["autotune"] = tuned.as_dict() if tuned is not None else None
+        # the predict-then-verify summary stands alone too: candidates
+        # ranked vs timed, chosen variant, prediction residual — the
+        # --kernel-search / --no-kernel-search story in one field
+        line["kernel_search"] = tuned.search if tuned is not None else None
         line["encode_mode"] = (
             "f32" if args.f32_wire
             else (q_tuned.encode_mode if q_tuned is not None else None)
@@ -2409,10 +2437,20 @@ def main() -> None:
         def run(p, Xq):
             def body(c, xq):
                 return c, qfn(p, xq).astype(jnp.bfloat16)
-            _, vals = jax.lax.scan(body, 0, Xq.reshape(K, C, F))
+            # -1: a packed-wire layout stages W bytes/record, not F
+            _, vals = jax.lax.scan(body, 0, Xq.reshape(K, C, -1))
             return vals.reshape(-1)
 
-        encode = _timed_encode((lambda X: X) if fused else q.wire.encode)
+        if fused:
+            enc_impl = lambda X: X  # noqa: E731 — raw f32 ships as-is
+        elif q._wire_pack is not None:
+            # the kernel search adopted a packed-wire layout: the jit
+            # entry expects packed bytes, so the hand loop (which
+            # bypasses pad_wire) must pack too
+            enc_impl = lambda X: q._wire_pack.pack(q.wire.encode(X))  # noqa: E731
+        else:
+            enc_impl = q.wire.encode
+        encode = _timed_encode(enc_impl)
 
     # ---- pipeline: featurize (threads) → h2d → score → d2h readback ----
     # the window runs through the SAME OverlappedDispatcher as the
@@ -2563,8 +2601,21 @@ def main() -> None:
             records=reps * B,
             flops_per_record=flops_rec,
             bytes_per_record=(
-                4.0 * args.features if f32ish else float(args.features)
-            ) + 2.0,
+                q_tuned.staged_bytes_per_record + 2.0
+                if q_tuned is not None and not args.f32_wire
+                else (4.0 * args.features if f32ish
+                      else float(args.features)) + 2.0
+            ),
+            # the adopted variant's provenance makes this a training
+            # row for the learned cost model (compile/costmodel.py):
+            # device-resident, multi-second — its best data
+            variant=getattr(q_tuned, "_cost_variant", None),
+            features=getattr(q_tuned, "_cost_feat", None),
+            # the SERVING variant's prediction (nulled by autotune when
+            # a cached variant degraded to defaults) — tuned.predicted
+            # records cache provenance, which may describe a kernel
+            # that is not running
+            predicted=getattr(q_tuned, "_pred_s_per_record", None),
         )
     # data-health for the hand loop: the scan path bypasses
     # dispatch_quantized, so when a baseline is stored the drift

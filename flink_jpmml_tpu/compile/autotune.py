@@ -1,34 +1,49 @@
-"""Bench-warmup autotuner + on-disk config cache for the rank-wire path.
+"""Learned-cost-model kernel search + on-disk config cache.
 
-BENCH_r05 showed the chip scoring at 2.8M rec/s while the end-to-end
-stream sat at 1.09M — the gap is host work (featurize) and hand-picked
-kernel tile constants. Following the measured-tuning argument of "A
-Learned Performance Model for Tensor Processing Units" (PAPERS.md), the
-knobs that matter are *swept during warmup* instead of guessed:
+PR 2's warmup sweep measured two axes (encode placement, Pallas tile
+shapes) by timing every candidate. The layout catalogue
+(compile/layouts.py: breadth-first SoA split order, uint8/uint16 wire
+packing, the multi-tree megakernel) crossed with those axes makes the
+candidate space ~20 configs per (model, backend) — too many to time,
+exactly the regime where "A Learned Performance Model for TPUs"
+(PAPERS.md) says to *predict then verify*:
 
-- **encode placement** — host C++ bucketizer shipping uint8 codes
-  (``encode_mode="host"``, the default and the byte-parity oracle) vs
-  the fused on-device encode stage shipping raw f32
-  (``encode_mode="fused"``, one dispatch for encode+pad+score). Which
-  wins depends on the host↔device link: a tunneled 32MB/s link favors
-  the 4x-smaller uint8 wire, local PCIe favors zero host encode.
-- **Pallas tile shapes** — batch block ``block_b`` and trees-per-group
-  ``gt`` (qtrees_pallas.py), swept by re-packing the kernel per
-  candidate and timing a warm batch.
+1. **Predict.** A ridge cost model (compile/costmodel.py) fit on the
+   accumulated kernel cost ledger (``kernel_costs.json`` — every
+   profiler sample and every prior sweep's timings are training rows)
+   ranks the FULL candidate space by predicted device-s/record.
+2. **Verify.** Only the top-K (``FJT_SEARCH_TOPK``, default 5) are
+   re-packed, compiled, and timed on the device; the measured winner
+   is adopted. Every timing lands back in the ledger with its feature
+   vector, so the next search's fit is better than this one's.
+3. **Re-search on drift.** The live profiler (obs/profiler.py)
+   compares sampled device cost against the adopted config's
+   prediction; sustained drift outside the band (PR 8's
+   ``capacity_reestimated`` pattern) invalidates the fit
+   (``costmodel.mark_stale``) and clears this model's cache entry, so
+   the next warmup re-searches instead of trusting a stale prediction.
+
+With no usable fit yet (a cold ledger) the search *bootstraps*: it
+times a heuristic subset — the built defaults first, then one
+candidate per layout, then the remaining tiles — still capped at K,
+and fits the first model from those measurements.
 
 The winning :class:`TunedConfig` is cached per
-``(model_hash, backend_key)`` in a small JSON file
-(``$FJT_AUTOTUNE_CACHE``, default
+``(model_hash, backend_key)`` in ``$FJT_AUTOTUNE_CACHE`` (default
 ``~/.cache/flink_jpmml_tpu/autotune.json``) consulted by
-``build_quantized_scorer`` on every compile, so production pipelines
-inherit bench-measured configs without re-sweeping. Cache problems are
-never fatal: a corrupt or unreadable file reads as empty (silent
-re-tune), and a stale config the current build can't honour falls back
-to defaults.
+``build_quantized_scorer`` on every compile. Every stored entry is
+stamped with the search-space schema tag (``layouts.SPACE_TAG``): an
+entry written against an older space reads as *no entry* — silent
+re-search, the same corrupt-tolerant contract as ever (a pre-layout
+winner can never pin a new binary to an obsolete kernel config).
+``FJT_KERNEL_SEARCH_DISABLE=1`` (the bench's ``--no-kernel-search``
+ablation) restricts the space to the legacy ref-layout tile sweep;
+``FJT_AUTOTUNE_DISABLE=1`` (``--no-autotune``) disables all of it.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import math
@@ -36,15 +51,19 @@ import os
 import pathlib
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from flink_jpmml_tpu.compile import layouts
+
 _CACHE_ENV = "FJT_AUTOTUNE_CACHE"
 _CACHE_VERSION = 1
-# (block_b, gt) candidates for the Pallas tile sweep; None = the
-# module default. Small on purpose — each candidate is a re-pack + a
-# compile, and warmup budgets are seconds, not minutes.
+_SEARCH_DISABLE_ENV = "FJT_KERNEL_SEARCH_DISABLE"
+_TOPK_ENV = "FJT_SEARCH_TOPK"
+_DEFAULT_TOPK = 5
+# (block_b, gt) tile axis of the candidate space; None = the module
+# default. Crossed with the layout catalogue by candidate_space().
 _TILE_CANDIDATES = (
     (None, None),
     (512, None),
@@ -56,18 +75,27 @@ _TILE_CANDIDATES = (
 
 @dataclass
 class TunedConfig:
-    """One measured winner: encode placement + Pallas tile shapes.
+    """One measured winner: encode placement + kernel variant.
 
-    ``block_b``/``gt`` are None for the XLA backend (no tiles to pick);
-    ``rates`` keeps the per-candidate rec/s the sweep observed (for the
-    bench artifact); ``source`` says where the config came from
-    ("default" | "sweep" | "cache")."""
+    ``layout`` is the compile/layouts.py catalogue id; ``block_b``/
+    ``gt`` are None for the XLA backend (no tiles to pick); ``rates``
+    keeps the per-candidate rec/s the search observed;
+    ``predicted_s_per_record`` is the cost model's prediction for the
+    adopted variant (the live profiler verifies it — drift re-opens
+    the search); ``search`` summarizes the predict-then-verify pass
+    for the bench artifact; ``space`` stamps the search-space schema
+    (a mismatched tag reads as no entry); ``source`` says where the
+    config came from ("default" | "sweep" | "cache")."""
 
     encode: str = "host"  # "host" | "fused"
     block_b: Optional[int] = None
     gt: Optional[int] = None
+    layout: str = "ref"
+    space: str = layouts.SPACE_TAG
     rec_s: Optional[float] = None
+    predicted_s_per_record: Optional[float] = None
     rates: Dict[str, float] = dataclasses.field(default_factory=dict)
+    search: Optional[dict] = None
     source: str = "default"
 
     def as_dict(self) -> dict:
@@ -75,24 +103,40 @@ class TunedConfig:
             "encode": self.encode,
             "block_b": self.block_b,
             "gt": self.gt,
+            "layout": self.layout,
+            "space": self.space,
             "rec_s": self.rec_s,
+            "predicted_s_per_record": self.predicted_s_per_record,
             "rates": dict(self.rates),
+            "search": self.search,
             "source": self.source,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "TunedConfig":
         enc = d.get("encode")
+        layout = d.get("layout")
         return cls(
             encode=enc if enc in ("host", "fused") else "host",
             block_b=int(d["block_b"]) if d.get("block_b") else None,
             gt=int(d["gt"]) if d.get("gt") else None,
+            layout=layout if isinstance(layout, str) and layout else "ref",
+            # absent tag = a pre-layout entry: must NOT default to the
+            # current tag or stale winners would survive the schema bump
+            space=str(d.get("space") or ""),
             rec_s=float(d["rec_s"]) if d.get("rec_s") else None,
+            predicted_s_per_record=(
+                float(d["predicted_s_per_record"])
+                if d.get("predicted_s_per_record")
+                else None
+            ),
             rates={
                 str(k): float(v)
                 for k, v in (d.get("rates") or {}).items()
                 if isinstance(v, (int, float))
             },
+            search=d.get("search") if isinstance(d.get("search"), dict)
+            else None,
             source=str(d.get("source") or "cache"),
         )
 
@@ -110,6 +154,37 @@ def cache_path() -> pathlib.Path:
         pathlib.Path(os.path.expanduser("~"))
         / ".cache" / "flink_jpmml_tpu" / "autotune.json"
     )
+
+
+@contextlib.contextmanager
+def _cache_lock():
+    """Exclusive flock over the cache's sidecar lock file (the kernel
+    cost ledger's discipline): ``store``/``clear`` are read-modify-
+    write, and ``clear`` is a live trigger now (the profiler's drift
+    band fires it) — unsynchronized writers would last-writer-wins
+    resurrect a cleared stale entry or drop a sibling's freshly
+    measured winner. No flock available (non-posix, read-only dir) ⇒
+    proceed unlocked; the atomic replace still keeps readers safe."""
+    lock = None
+    try:
+        import fcntl
+
+        path = cache_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        lock = open(f"{path}.lock", "w")
+        fcntl.flock(lock, fcntl.LOCK_EX)
+    except (ImportError, OSError):
+        if lock is not None:
+            lock.close()
+        lock = None
+    try:
+        yield
+    finally:
+        if lock is not None:
+            try:
+                lock.close()  # closing releases the flock
+            except OSError:
+                pass
 
 
 def _load_cache() -> dict:
@@ -142,6 +217,11 @@ def lookup(model_hash: str, backend_key: str) -> Optional[TunedConfig]:
         cfg = TunedConfig.from_dict(raw)
     except (TypeError, ValueError):
         return None
+    if cfg.space != layouts.SPACE_TAG:
+        # cached against an older search space: a pre-layout winner
+        # must not pin this binary to an obsolete kernel config —
+        # reads as no entry (silent re-search)
+        return None
     cfg.source = "cache"
     return cfg
 
@@ -151,28 +231,25 @@ def store(model_hash: str, backend_key: str, cfg: TunedConfig) -> None:
     (a read-only home dir must not break a sweep)."""
     if not model_hash:
         return
-    path = cache_path()
-    entries = _load_cache()
-    entry = cfg.as_dict()
-    entry["ts"] = time.time()
-    entries[f"{model_hash}|{backend_key}"] = entry
-    tmp = path.with_suffix(f".tmp-{os.getpid()}")
-    try:
-        path.parent.mkdir(parents=True, exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump({"version": _CACHE_VERSION, "entries": entries}, f)
-        os.replace(tmp, path)
-    except OSError:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+    from flink_jpmml_tpu.utils.diskio import atomic_write_json
+
+    with _cache_lock():
+        entries = _load_cache()
+        entry = cfg.as_dict()
+        entry["ts"] = time.time()
+        entries[f"{model_hash}|{backend_key}"] = entry
+        atomic_write_json(
+            str(cache_path()),
+            {"version": _CACHE_VERSION, "entries": entries},
+        )
 
 
 def clear(model_hash: Optional[str] = None) -> None:
     """Drop the whole cache file (or, with ``model_hash``, just that
-    model's entries). Test/tooling helper. Scoped rewrites go through
-    the same tmp-file + atomic replace as :func:`store` — a truncating
+    model's entries). Test/tooling helper AND the live re-search
+    trigger (the profiler's drift band clears a model whose adopted
+    prediction went stale). Scoped rewrites go through the same
+    tmp-file + atomic replace as :func:`store` — a truncating
     in-place write would let a concurrent reader (or a crash) see a
     half-written file and, by the silent-corruption contract, lose
     EVERY model's entries instead of only this one's."""
@@ -183,20 +260,16 @@ def clear(model_hash: Optional[str] = None) -> None:
         except OSError:
             pass
         return
-    entries = {
-        k: v for k, v in _load_cache().items()
-        if not k.startswith(f"{model_hash}|")
-    }
-    tmp = path.with_suffix(f".tmp-{os.getpid()}")
-    try:
-        with open(tmp, "w") as f:
-            json.dump({"version": _CACHE_VERSION, "entries": entries}, f)
-        os.replace(tmp, path)
-    except OSError:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+    from flink_jpmml_tpu.utils.diskio import atomic_write_json
+
+    with _cache_lock():
+        entries = {
+            k: v for k, v in _load_cache().items()
+            if not k.startswith(f"{model_hash}|")
+        }
+        atomic_write_json(
+            str(path), {"version": _CACHE_VERSION, "entries": entries}
+        )
 
 
 def backend_key(scorer) -> str:
@@ -214,37 +287,147 @@ def backend_key(scorer) -> str:
 
 
 # ---------------------------------------------------------------------------
-# Apply / sweep
+# Candidate space
+# ---------------------------------------------------------------------------
+
+
+def search_top_k(top_k: Optional[int] = None) -> int:
+    if top_k is not None:
+        return max(1, int(top_k))
+    try:
+        return max(1, int(os.environ.get(_TOPK_ENV) or _DEFAULT_TOPK))
+    except ValueError:
+        return _DEFAULT_TOPK
+
+
+def candidate_space(scorer, legacy: bool = False) -> List[dict]:
+    """Every kernel variant the search may rank for this scorer:
+    (layout × Pallas tiles) on the Pallas backend, the layout
+    catalogue alone on XLA. The built defaults (ref layout, default
+    tiles) are always candidate 0. ``legacy`` restricts to the
+    pre-layout ref-only tile sweep (the ``--no-kernel-search``
+    ablation)."""
+    cands = [{"layout": "ref", "block_b": None, "gt": None}]
+    if scorer.backend == "pallas" and scorer._pallas_rebuild is not None:
+        names = ("ref",) if legacy else layouts.pallas_layouts()
+        for layout in names:
+            for bb, g in _TILE_CANDIDATES:
+                if layout == "ref" and (bb, g) == (None, None):
+                    continue
+                cands.append({"layout": layout, "block_b": bb, "gt": g})
+    elif scorer.backend != "pallas" and scorer._xla_rebuild is not None:
+        if not legacy:
+            for layout in layouts.xla_layouts(scorer.wire):
+                if layout == "ref":
+                    continue
+                cands.append(
+                    {"layout": layout, "block_b": None, "gt": None}
+                )
+    return cands
+
+
+def _cand_name(scorer, c: dict) -> str:
+    return layouts.variant_id(
+        scorer.backend, c["layout"], c["block_b"], c["gt"]
+    )
+
+
+def _cand_features(scorer, c: dict) -> Dict[str, float]:
+    from flink_jpmml_tpu.compile import costmodel
+
+    wire_bytes = float(scorer.wire.bytes_per_record)
+    if "wirepack" in (layouts.flags(c["layout"]) or ()):
+        wp = layouts.plan_wire_pack(scorer.wire)
+        if wp is not None:
+            wire_bytes = float(wp.bytes_per_record)
+    return costmodel.variant_features(
+        costmodel.scorer_meta(scorer), scorer.backend,
+        c["layout"], c["block_b"], c["gt"], wire_bytes=wire_bytes,
+    )
+
+
+def _bootstrap_order(cands: List[dict]) -> List[dict]:
+    """Cold-ledger timing order: defaults first, then one candidate
+    per distinct layout (default tiles where available), then the
+    remaining ref tiles, then everything else — so even a K-bounded
+    first search measures every layout family once."""
+    first: List[dict] = [cands[0]]
+    seen_layouts = {cands[0]["layout"]}
+    rest: List[dict] = []
+    for c in cands[1:]:
+        if c["layout"] not in seen_layouts and (
+            c["block_b"] is None and c["gt"] is None
+        ):
+            seen_layouts.add(c["layout"])
+            first.append(c)
+        else:
+            rest.append(c)
+    rest.sort(key=lambda c: (c["layout"] != "ref",))
+    return first + rest
+
+
+# ---------------------------------------------------------------------------
+# Apply / search / sweep
 # ---------------------------------------------------------------------------
 
 
 def apply(scorer, cfg: TunedConfig) -> None:
-    """Apply a config to a scorer: re-pack the Pallas kernel when the
-    cached tile shapes differ from the built defaults, then set the
-    encode mode (gated on the scorer actually supporting the fused
-    stage — a stale "fused" entry degrades to host, never crashes).
+    """Apply a config to a scorer: rebuild the kernel when the cached
+    variant (layout and/or tile shapes) differs from the built
+    defaults, then set the encode mode (gated on the scorer actually
+    supporting the fused stage — a stale "fused" entry degrades to
+    host, never crashes).
 
-    A scorer is tuned at most once per lifetime, so the rebuild hook is
-    RELEASED afterwards — its closure pins the host-side packing tables
-    (~11MB for the flagship GBM) that would otherwise sit next to the
-    device-resident copies for as long as the model is served."""
-    from flink_jpmml_tpu.compile import qtrees_pallas
+    A scorer is tuned at most once per lifetime, so the rebuild hooks
+    are RELEASED afterwards — their closures pin the host-side packing
+    tables (~11MB for the flagship GBM) that would otherwise sit next
+    to the device-resident copies for as long as the model is served."""
+    from flink_jpmml_tpu.compile import costmodel, qtrees_pallas
 
-    if (
-        scorer.backend == "pallas"
-        and scorer._pallas_rebuild is not None
-        and (cfg.block_b or cfg.gt)
-        and (
-            (cfg.block_b or qtrees_pallas.DEFAULT_BLOCK_B),
-            (cfg.gt or qtrees_pallas.GT),
-        ) != (qtrees_pallas.DEFAULT_BLOCK_B, qtrees_pallas.GT)
-    ):
-        built = scorer._pallas_rebuild(cfg.block_b, cfg.gt)
+    layout = cfg.layout or "ref"
+    needs_variant = False
+    if scorer.backend == "pallas":
+        needs_variant = layout != "ref" or (
+            (cfg.block_b or cfg.gt)
+            and (
+                (cfg.block_b or qtrees_pallas.DEFAULT_BLOCK_B),
+                (cfg.gt or qtrees_pallas.GT),
+            ) != (qtrees_pallas.DEFAULT_BLOCK_B, qtrees_pallas.GT)
+        )
+    else:
+        needs_variant = layout != "ref"
+    applied = not needs_variant
+    if needs_variant:
+        built = scorer.build_variant(layout, cfg.block_b, cfg.gt)
         if built is not None:
-            scorer.adopt_backend(*built)
+            scorer.adopt_variant(built, layout)
+            applied = True
     scorer._pallas_rebuild = None
+    scorer._xla_rebuild = None
     scorer.encode_mode = (
         "fused" if cfg.encode == "fused" and scorer.supports_fused else "host"
+    )
+    # the feature vector / variant id / prediction channels describe
+    # the variant ACTUALLY serving (obs/attr.py dispatch_profile →
+    # kernel cost ledger + live drift band). A cached variant this
+    # build degraded to defaults must not ship its tiles/prediction:
+    # the ledger row would train the cost model on a (features →
+    # cost) pair of a kernel that is not running, and the drift band
+    # would invalidate a perfectly good fit against it.
+    eff_bb = cfg.block_b if applied else None
+    eff_gt = cfg.gt if applied else None
+    try:
+        scorer._cost_feat = _cand_features(
+            scorer,
+            {"layout": scorer.layout, "block_b": eff_bb, "gt": eff_gt},
+        )
+        scorer._cost_variant = layouts.variant_id(
+            scorer.backend, scorer.layout, eff_bb, eff_gt
+        )
+    except Exception:
+        scorer._cost_feat = None
+    scorer._pred_s_per_record = (
+        cfg.predicted_s_per_record if applied else None
     )
     scorer.tuned = cfg
 
@@ -262,21 +445,153 @@ def _time_best(fn, repeats: int) -> float:
     return best
 
 
+def _variant_search(
+    scorer,
+    X: np.ndarray,
+    repeats: int,
+    budget_s: float,
+    t_start: float,
+    rates: Dict[str, float],
+    top_k: Optional[int] = None,
+):
+    """Predict-then-verify over the candidate space → (winning
+    candidate dict, predicted s/record for it, search summary).
+
+    Ranks ALL candidates by the ledger-fit cost model when one exists
+    (bootstrap order otherwise), times at most K on device, adopts the
+    measured winner, and feeds every timing back into the ledger as a
+    (features → device-s/record) training row."""
+    import jax
+
+    from flink_jpmml_tpu.compile import costmodel
+    from flink_jpmml_tpu.obs import profiler as prof_mod
+
+    legacy = bool(os.environ.get(_SEARCH_DISABLE_ENV))
+    cands = candidate_space(scorer, legacy=legacy)
+    K = search_top_k(top_k)
+    feats = {_cand_name(scorer, c): _cand_features(scorer, c) for c in cands}
+    platform = backend_key(scorer).split(":", 1)[0]
+    model = None if legacy else costmodel.current_model(platform=platform)
+    predictions: Dict[str, float] = {}
+    if model is not None:
+        ranked = model.rank(feats)
+        predictions = {
+            n: round(p, 12) for n, p in ranked if math.isfinite(p)
+        }
+        order = [next(c for c in cands if _cand_name(scorer, c) == n)
+                 for n, _ in ranked]
+        # the built default is ALWAYS verified, mispredicted or not:
+        # without it a bad fit could rank the incumbent outside top-K
+        # and the search would adopt-and-persist a variant slower than
+        # the default it replaced (never having measured the default)
+        order = [cands[0]] + [c for c in order if c is not cands[0]]
+        mode = "learned"
+    else:
+        order = _bootstrap_order(cands)
+        mode = "legacy" if legacy else "bootstrap"
+
+    bs = X.shape[0]
+    meta = costmodel.scorer_meta(scorer)
+    flops_rec = (
+        2.0 * meta["trees"] * meta["splits"] * meta["leaves"]
+        + 2.0 * meta["trees"] * meta["leaves"]
+        if meta else None
+    )
+    ledger = prof_mod.KernelCostLedger(flush_interval_s=math.inf)
+    best_rate, best_cand, best_built = -1.0, cands[0], None
+    timed = 0
+    for c in order:
+        if timed >= K:
+            break
+        if time.perf_counter() - t_start > budget_s and timed:
+            break
+        name = _cand_name(scorer, c)
+        is_default = c["layout"] == "ref" and not c["block_b"] and not c["gt"]
+        if is_default:
+            built, params, fn, wp = (
+                None, scorer.params, scorer._jit_fn, scorer._wire_pack,
+            )
+        else:
+            built = scorer.build_variant(c["layout"], c["block_b"], c["gt"])
+            if built is None:
+                continue  # ineligible (VMEM budget, nothing to pack, …)
+            params, fn, wp = (
+                built["params"], built["jit_fn"], built["wire_pack"],
+            )
+        payload = wp.pack(X) if wp is not None else X
+        # stage a FRESH buffer per call: with donate_batches=True the
+        # jitted entry donates (deletes) its batch argument, so a
+        # reused staged buffer would crash the second rep on any
+        # backend that honours donation (uniform per-call staging
+        # keeps the candidate ranking fair)
+        dt = _time_best(
+            lambda fn=fn, params=params, payload=payload: (
+                jax.block_until_ready(fn(params, jax.device_put(payload)))
+            ),
+            repeats,
+        )
+        timed += 1
+        rates[name] = round(bs / dt, 1)
+        ledger.update(
+            scorer.model_hash, scorer.backend, dt, bs,
+            flops_rec,
+            payload.nbytes / bs + 2.0,  # staged wire in + bf16 out
+            variant=name, features=feats[name],
+            predicted=predictions.get(name),
+        )
+        if bs / dt > best_rate:
+            best_rate, best_cand, best_built = bs / dt, c, built
+    if best_built is not None:
+        scorer.adopt_variant(best_built, best_cand["layout"])
+    ledger.flush()
+    # refit from the ledger (now including this search's rows) and
+    # persist, so the NEXT search predicts from these measurements
+    refit = costmodel.fit_from_ledger(platform=platform)
+    best_name = _cand_name(scorer, best_cand)
+    # predicted-vs-measured residual over the verified candidates: the
+    # honest "is the model any good yet" number in the artifact
+    resid = None
+    checked = [
+        (predictions[n], 1.0 / rates[n])
+        for n in rates
+        if n in predictions and rates.get(n)
+    ]
+    if checked:
+        ratios = [
+            abs(math.log(max(p, 1e-18) / max(obs, 1e-18)))
+            for p, obs in checked
+        ]
+        resid = round(sum(ratios) / len(ratios), 4)
+    search_info = {
+        "space": layouts.SPACE_TAG,
+        "mode": mode,
+        "candidates_total": len(cands),
+        "timed": timed,
+        "top_k": K,
+        "chosen": best_name,
+        "predicted": predictions or None,
+        "pred_abs_log_err": resid,
+        "model": (refit or model).stats if (refit or model) else None,
+    }
+    return best_cand, predictions.get(best_name), search_info
+
+
 def sweep(
     scorer,
     X_sample: np.ndarray,
     repeats: int = 2,
     budget_s: float = 30.0,
+    top_k: Optional[int] = None,
 ) -> TunedConfig:
-    """Measure the candidates on THIS backend and adopt the winner.
+    """Search the kernel-variant space and measure encode placement on
+    THIS backend; adopt the winner.
 
     ``X_sample`` is a raw f32 feature batch; it is tiled/trimmed to
     exactly one compile batch so every candidate times the same
     dispatch shape. Returns the applied :class:`TunedConfig`
-    (``source="sweep"``) with per-candidate rates in ``rates``."""
+    (``source="sweep"``) with per-candidate rates in ``rates`` and the
+    predict-then-verify summary in ``search``."""
     import jax
-
-    from flink_jpmml_tpu.compile import qtrees_pallas
 
     t_start = time.perf_counter()
     X = np.ascontiguousarray(np.asarray(X_sample, np.float32))
@@ -285,64 +600,40 @@ def sweep(
         reps = -(-bs // X.shape[0])
         X = np.ascontiguousarray(np.tile(X, (reps, 1))[:bs])
     rates: Dict[str, float] = {}
-    block_b: Optional[int] = None
-    gt: Optional[int] = None
+    chosen = {"layout": "ref", "block_b": None, "gt": None}
+    predicted = None
+    search_info = None
 
-    # -- Pallas tile sweep (kernel only, host-encoded input) --------------
-    if scorer.backend == "pallas" and scorer._pallas_rebuild is not None:
-        Xq, _K = scorer.pad_wire(scorer.wire.encode(X))
-        best_rate = -1.0
-        best_built = None  # None = the currently-built defaults
-        for bb, g in _TILE_CANDIDATES:
-            if time.perf_counter() - t_start > budget_s and rates:
-                break
-            name = (
-                f"pallas_b{bb or qtrees_pallas.DEFAULT_BLOCK_B}"
-                f"_gt{g or qtrees_pallas.GT}"
-            )
-            if (bb, g) == (None, None):
-                params, fn = scorer.params, scorer._jit_fn
-                built = None
-            else:
-                built = scorer._pallas_rebuild(bb, g)
-                if built is None:
-                    continue  # shapes ineligible (VMEM budget etc.)
-                params, fn = built[0], built[1]
-            # stage a FRESH buffer per call: with donate_batches=True
-            # the jitted entry donates (deletes) its batch argument, so
-            # a reused staged buffer would crash the second rep on any
-            # backend that honours donation (uniform per-call staging
-            # keeps the candidate ranking fair)
-            dt = _time_best(
-                lambda fn=fn, params=params: jax.block_until_ready(
-                    fn(params, jax.device_put(Xq))
-                ),
-                repeats,
-            )
-            rates[name] = round(bs / dt, 1)
-            if bs / dt > best_rate:
-                best_rate, best_built = bs / dt, built
-                block_b, gt = bb, g
-        if best_built is not None:
-            scorer.adopt_backend(*best_built)
-        # tuned once: release the rebuild closure so it stops pinning
-        # the host-side packing tables (see apply())
-        scorer._pallas_rebuild = None
+    # -- kernel-variant search (layouts × tiles, host-encoded input) ------
+    has_variants = (
+        scorer.backend == "pallas" and scorer._pallas_rebuild is not None
+    ) or (scorer.backend != "pallas" and scorer._xla_rebuild is not None)
+    if has_variants:
+        # raw (unpacked) rank codes at exactly one compile batch; each
+        # candidate packs them itself when its layout calls for it
+        Xq = scorer.wire.encode(X)
+        chosen, predicted, search_info = _variant_search(
+            scorer, Xq, repeats, budget_s, t_start, rates, top_k
+        )
+    # tuned once: release the rebuild closures so they stop pinning
+    # the host-side packing tables (see apply())
+    scorer._pallas_rebuild = None
+    scorer._xla_rebuild = None
 
     # -- encode placement sweep (end to end from raw f32 on host) ---------
     def _host():
-        Xq, K = scorer.pad_wire(scorer.wire.encode(X))
+        Xq, Kc = scorer.pad_wire(scorer.wire.encode(X))
         jax.block_until_ready(
-            scorer.predict_padded(jax.device_put(Xq), K)
+            scorer.predict_padded(jax.device_put(Xq), Kc)
         )
 
     rates["encode_host"] = round(bs / _time_best(_host, repeats), 1)
     encode = "host"
     if scorer.supports_fused:
         def _fused():
-            Xp, K = scorer.pad_f32(X)
+            Xp, Kc = scorer.pad_f32(X)
             jax.block_until_ready(
-                scorer.predict_fused_padded(jax.device_put(Xp), K)
+                scorer.predict_fused_padded(jax.device_put(Xp), Kc)
             )
 
         rates["encode_fused"] = round(bs / _time_best(_fused, repeats), 1)
@@ -351,15 +642,35 @@ def sweep(
 
     cfg = TunedConfig(
         encode=encode,
-        block_b=block_b,
-        gt=gt,
+        block_b=chosen["block_b"],
+        gt=chosen["gt"],
+        layout=scorer.layout,
         rec_s=rates.get(f"encode_{encode}"),
+        predicted_s_per_record=predicted,
         rates=rates,
+        search=search_info,
         source="sweep",
     )
     scorer.encode_mode = (
         "fused" if encode == "fused" and scorer.supports_fused else "host"
     )
+    try:
+        scorer._cost_feat = _cand_features(
+            scorer,
+            {
+                "layout": scorer.layout,
+                "block_b": chosen["block_b"],
+                "gt": chosen["gt"],
+            },
+        )
+        scorer._cost_variant = layouts.variant_id(
+            scorer.backend, scorer.layout, chosen["block_b"], chosen["gt"]
+        )
+    except Exception:
+        scorer._cost_feat = None
+    # the chosen candidate IS the serving variant here (the search
+    # adopted it), so its prediction is the one the live band verifies
+    scorer._pred_s_per_record = predicted
     scorer.tuned = cfg
     return cfg
 
@@ -370,8 +681,9 @@ def ensure_tuned(
     repeats: int = 2,
     use_cache: bool = True,
     budget_s: float = 30.0,
+    top_k: Optional[int] = None,
 ) -> TunedConfig:
-    """The warmup entry point: cache hit → apply it; miss → sweep and
+    """The warmup entry point: cache hit → apply it; miss → search and
     persist the winner. Always returns the config now in force."""
     from flink_jpmml_tpu.obs import recorder as flight
 
@@ -383,14 +695,19 @@ def ensure_tuned(
             flight.record(
                 "autotune_decision", source="cache", backend=key,
                 model_hash=scorer.model_hash, encode=cfg.encode,
-                block_b=cfg.block_b, gt=cfg.gt,
+                block_b=cfg.block_b, gt=cfg.gt, layout=cfg.layout,
             )
             return cfg
-    cfg = sweep(scorer, X_sample, repeats=repeats, budget_s=budget_s)
+    cfg = sweep(
+        scorer, X_sample, repeats=repeats, budget_s=budget_s, top_k=top_k
+    )
     store(scorer.model_hash, key, cfg)
     flight.record(
         "autotune_decision", source="sweep", backend=key,
         model_hash=scorer.model_hash, encode=cfg.encode,
-        block_b=cfg.block_b, gt=cfg.gt, rec_s=cfg.rec_s,
+        block_b=cfg.block_b, gt=cfg.gt, layout=cfg.layout,
+        rec_s=cfg.rec_s,
+        timed=(cfg.search or {}).get("timed"),
+        candidates=(cfg.search or {}).get("candidates_total"),
     )
     return cfg

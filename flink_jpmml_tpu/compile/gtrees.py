@@ -273,6 +273,31 @@ def _tree_depth(node: ir.TreeNode) -> int:
     return 1 + max(_tree_depth(c) for c in node.children)
 
 
+def _bfs_rows(rows: List[dict]) -> List[dict]:
+    """Renumber a tree's node rows breadth-first (layouts.bfs_order).
+
+    The hop loop gathers rows by explicit ``child_idx`` indices, so any
+    consistent renumbering is semantics-preserving; breadth-first keeps
+    the root at 0 and makes hop ``d``'s gathers touch a contiguous
+    low-index prefix of the [T, N, ...] tables instead of pre-order's
+    leftmost-path scatter — the general backend's slice of the
+    breadth-first SoA layout work (ROADMAP item 2)."""
+    from flink_jpmml_tpu.compile import layouts
+
+    order = layouts.bfs_order([r["children"] for r in rows])
+    if order == list(range(len(rows))):
+        return rows
+    new_of_old = {old: new for new, old in enumerate(order)}
+    out = []
+    for old in order:
+        r = dict(rows[old])
+        r["children"] = [new_of_old[c] for c in r["children"]]
+        if r["default"] >= 0:
+            r["default"] = new_of_old[r["default"]]
+        out.append(r)
+    return out
+
+
 def pack_general(
     trees: Sequence[ir.TreeModelIR], ctx: LowerCtx
 ) -> Tuple[Dict[str, np.ndarray], dict]:
@@ -298,6 +323,7 @@ def pack_general(
         )
         fl = _Flat()
         fl.add(t.root, ctx)
+        fl.rows = _bfs_rows(fl.rows)
         flats.append(fl)
         depth = max(depth, _tree_depth(t.root))
 

@@ -26,6 +26,14 @@ This module builds that wire format:
   (exact to ~2^-17 relative) so the MXU stays in fast dtypes without
   giving up float32-level accuracy.
 
+- **Kernel layouts (round 11).** The packed tables exist in catalogue
+  variants (compile/layouts.py): breadth-first SoA split ordering,
+  per-feature uint8/uint16 wire packing (``pad_wire`` packs
+  transparently when a ``wirepack`` layout is adopted), and the Pallas
+  multi-tree megakernel — every variant byte-identical to this
+  reference packing. The learned kernel search (compile/autotune.py +
+  compile/costmodel.py) ranks them by predicted device-s/record and
+  verifies only the top-K on device.
 - **Fused featurization (round 6).** The same bucketize also exists as
   an on-device XLA pre-stage (``_make_encode_stage``: vmapped
   ``searchsorted`` over +inf-padded cut tables, replacement/sentinel
@@ -296,13 +304,34 @@ class QuantizedScorer:
     # model's cut tables blow the device-table budget
     _fused_inner: object = None
     _encode_stage: object = None
-    # autotune hook: rebuild the pallas backend at (block_b, gt) →
-    # (device params, jit entry, fused inner) or None when those tile
-    # shapes are ineligible; None on the XLA backend. Released by
-    # compile/autotune.py once a config is applied — the closure pins
-    # the host-side packing tables, which a long-lived served model
-    # must not carry next to its device-resident copies.
+    # autotune hook: rebuild the pallas backend at (block_b, gt,
+    # layout) → a built-variant dict or None when ineligible; None on
+    # the XLA backend. Released by compile/autotune.py once a config
+    # is applied — the closure pins the host-side packing tables,
+    # which a long-lived served model must not carry next to its
+    # device-resident copies.
     _pallas_rebuild: object = None
+    # XLA twin of the rebuild hook: _xla_rebuild(layout) → built
+    # variant dict (BFS split order / wire packing) or None; released
+    # with the same discipline (it pins the host numpy param tables)
+    _xla_rebuild: object = None
+    # which catalogue layout (compile/layouts.py) is currently built
+    layout: str = "ref"
+    # active wire packing plan (layouts.WirePack) — pad_wire packs the
+    # rank codes through it before padding/staging; None = raw codes
+    _wire_pack: object = None
+    # packed-shape summary for the learned cost model's features
+    # (compile/costmodel.py): trees/splits/leaves/fields/batch/dtype
+    _meta: dict = field(default_factory=dict)
+    # the adopted variant's feature dict + canonical id (set by
+    # autotune): ride the dispatch profile into the kernel cost ledger
+    _cost_feat: object = None
+    _cost_variant: object = None
+    # the cost model's prediction for the variant ACTUALLY serving —
+    # distinct from tuned.predicted_s_per_record, which records cache
+    # provenance: a cached variant that degrades to the built defaults
+    # must not ship its prediction into the live drift band
+    _pred_s_per_record: object = None
 
     @property
     def is_classification(self) -> bool:
@@ -311,6 +340,18 @@ class QuantizedScorer:
     @property
     def supports_fused(self) -> bool:
         return self._fused_inner is not None
+
+    @property
+    def staged_bytes_per_record(self) -> float:
+        """Bytes one record costs on the wire under the CURRENT layout
+        and encode mode — the honest bytes/record for the roofline and
+        the kernel cost ledger (wire packing shrinks it; fused encode
+        ships raw f32)."""
+        if self.encode_mode == "fused" and self.supports_fused:
+            return 4.0 * len(self.wire.fields)
+        if self._wire_pack is not None:
+            return float(self._wire_pack.bytes_per_record)
+        return float(self.wire.bytes_per_record)
 
     def pad_wire(self, Xq):
         """Host-side batch alignment → ``(Xq_padded, K)``.
@@ -324,7 +365,14 @@ class QuantizedScorer:
         as-is and trim via ``decode(out, n)``.  Split out of
         :meth:`predict_wire` so the overlapped pipeline can stage the
         aligned batch onto the device (``jax.device_put``) *before*
-        dispatch — see :meth:`predict_padded`."""
+        dispatch — see :meth:`predict_padded`.
+
+        Under a ``wirepack`` layout the rank codes pack here (before
+        padding — zero pad rows are packed zero bytes either way), so
+        every caller's staged payload and bytes accounting see the
+        packed wire without code changes."""
+        if self._wire_pack is not None:
+            Xq = self._wire_pack.pack(Xq)
         n = Xq.shape[0]
         bs = self.batch_size
         if bs is None or n == bs:
@@ -494,6 +542,35 @@ class QuantizedScorer:
         self._fused_inner = fused_inner
         self._multi_fns.clear()
         self._donate_fn = None
+
+    def build_variant(self, layout: str = "ref", block_b=None, gt=None):
+        """Kernel-search hook: build (without adopting) the catalogue
+        variant at ``(layout, block_b, gt)`` → a built dict for
+        :meth:`adopt_variant`, or None when this scorer can't honour
+        it (unknown layout, tiles on the XLA backend, hooks already
+        released). Never raises — a stale cached candidate degrades to
+        the built defaults."""
+        try:
+            if self.backend == "pallas":
+                if self._pallas_rebuild is None:
+                    return None
+                return self._pallas_rebuild(block_b, gt, layout=layout)
+            if block_b or gt or self._xla_rebuild is None:
+                return None
+            return self._xla_rebuild(layout)
+        except Exception:
+            return None
+
+    def adopt_variant(self, built: dict, layout: str = "ref") -> None:
+        """Swap in a variant from :meth:`build_variant`: kernel program
+        + params + (possibly) a wire packing plan, atomically enough
+        that pad_wire and the jit entry always agree on the wire
+        format."""
+        self.adopt_backend(
+            built["params"], built["jit_fn"], built["fused_inner"]
+        )
+        self._wire_pack = built.get("wire_pack")
+        self.layout = layout
 
     def score(self, X, M=None) -> List[Prediction]:
         n = np.asarray(X).shape[0]
@@ -758,6 +835,15 @@ def build_quantized_scorer(
     hasher.update(np.asarray(dleft, np.uint8).tobytes())
     model_hash = hasher.hexdigest()[:16]
 
+    # packed-shape summary: the learned cost model's model-shape
+    # features (compile/costmodel.py variant_features)
+    scorer_meta = {
+        "trees": float(T), "splits": float(S), "leaves": float(L),
+        "fields": float(F), "batch": float(batch_size or 0),
+        "dtype_rank": float(np.dtype(dtype).itemsize),
+        "classification": 1.0 if classification else 0.0,
+    }
+
     # fused featurize+score pre-stage (tentpole of ISSUE 2): the same
     # threshold-rank bucketize as wire.encode, but as XLA ops traced
     # into the scoring jit — raw f32 batches go straight to the device
@@ -875,19 +961,35 @@ def build_quantized_scorer(
             vals_lo = None
 
         def _build_pallas(
-            block_b: Optional[int] = None, gt: Optional[int] = None
+            block_b: Optional[int] = None,
+            gt: Optional[int] = None,
+            layout: str = "ref",
         ):
-            """Pack + build the kernel at the given tile shapes →
-            (device params, jit entry, fused inner) or None when
-            build_pallas_fn rejects them. The default shapes build the
-            scorer; the autotuner re-invokes this to sweep candidates
-            and adopts the winner (:meth:`QuantizedScorer
-            .adopt_backend`)."""
+            """Pack + build the kernel at the given tile shapes and
+            catalogue layout → a built-variant dict or None when
+            build_pallas_fn (or the layout catalogue) rejects them.
+            The default shapes build the scorer; the kernel search
+            (compile/autotune.py) re-invokes this per candidate and
+            adopts the winner (:meth:`QuantizedScorer.adopt_variant`)."""
+            from flink_jpmml_tpu.compile import layouts as layouts_mod
+
+            fl = layouts_mod.flags(layout)
+            if fl is None or not fl <= {"bfs", "mega"}:
+                return None  # unknown / XLA-only layout id
+            feat_in = params["feat"].astype(np.int64)
+            qthr_in, dleft_in, P_in = qthr, np.asarray(dleft), params["P_i8"]
+            if "bfs" in fl:
+                perm = layouts_mod.bfs_split_order(P_in)
+                soa = layouts_mod.apply_split_order(
+                    perm, feat_in, qthr_in, dleft_in, P_in
+                )
+                feat_in, qthr_in = soa["feat"], soa["qthr"]
+                dleft_in, P_in = soa["dleft"], soa["P"]
             groups = qtrees_pallas.pack_groups(
-                feat=params["feat"].astype(np.int64),
-                qthr=qthr,
-                dleft=np.asarray(dleft),
-                P=params["P_i8"],
+                feat=feat_in,
+                qthr=qthr_in,
+                dleft=dleft_in,
+                P=P_in,
                 count=params["count_i8"],
                 vals=vals_tbl,
                 n_fields=F,
@@ -898,6 +1000,7 @@ def build_quantized_scorer(
                 groups, batch_size, F, sentinel,
                 block_b=block_b or qtrees_pallas.DEFAULT_BLOCK_B,
                 interpret=pallas_interpret,
+                fuse_groups="mega" in fl,
             )
             if raw is None:
                 return None
@@ -934,24 +1037,29 @@ def build_quantized_scorer(
                 pqfn,
                 donate_argnums=(1,) if config.donate_batches else (),
             )
-            return jax.device_put(groups), jit_fn, fused_inner
+            return {
+                "params": jax.device_put(groups),
+                "jit_fn": jit_fn,
+                "fused_inner": fused_inner,
+                "wire_pack": None,  # pallas is uint8-wire only
+            }
 
         built = _build_pallas()
         if built is not None:
-            gp, jit_fn, fused_inner = built
             scorer = QuantizedScorer(
                 wire=wire,
-                params=gp,
+                params=built["params"],
                 field_space=prepare.FieldSpace(fields=fields, codecs=ctx.codecs),
                 batch_size=batch_size,
                 n_trees=T,
-                _jit_fn=jit_fn,
+                _jit_fn=built["jit_fn"],
                 backend="pallas",
                 labels=packed.labels if classification else (),
                 model_hash=model_hash,
-                _fused_inner=fused_inner,
+                _fused_inner=built["fused_inner"],
                 _encode_stage=encode_stage,
                 _pallas_rebuild=_build_pallas,
+                _meta=scorer_meta,
             )
             _consult_autotune(scorer)
             return scorer
@@ -968,6 +1076,56 @@ def build_quantized_scorer(
         def fused_inner(pp, X):
             return qfn(pp, encode_stage(pp, X))
 
+    def _build_xla_variant(layout: str = "ref"):
+        """XLA twin of the pallas rebuild hook: re-derive the jitted
+        program under a catalogue layout (BFS split order and/or the
+        packed rank wire) → built-variant dict, or None when the
+        layout is unknown here / has nothing to pack. ``qfn`` itself
+        is layout-agnostic (it reads the param tables), so a variant
+        is new params + a new jit entry, never new math."""
+        from flink_jpmml_tpu.compile import layouts as layouts_mod
+
+        fl = layouts_mod.flags(layout)
+        if fl is None or not fl or not fl <= {"bfs", "wirepack"}:
+            return None
+        p2 = dict(params)
+        if "bfs" in fl:
+            perm = layouts_mod.bfs_split_order(params["P_i8"])
+            soa = layouts_mod.apply_split_order(
+                perm, params["feat"], params["qthr"],
+                np.asarray(params["dleft"]), params["P_i8"],
+            )
+            p2["feat"] = soa["feat"].astype(np.int32)
+            p2["qthr"], p2["dleft"] = soa["qthr"], soa["dleft"]
+            p2["P_i8"] = soa["P"].astype(np.int8)
+        inner = qfn
+        wp = None
+        if "wirepack" in fl:
+            wp = layouts_mod.plan_wire_pack(wire)
+            if wp is None:
+                return None
+            unpack = wp.unpack_stage()
+
+            def inner(pp, Xpk, _unpack=unpack):
+                return qfn(pp, _unpack(Xpk))
+
+        v_jit = jax.jit(
+            inner, donate_argnums=(1,) if config.donate_batches else ()
+        )
+        v_fused = None
+        if encode_stage is not None:
+            # fused encode ships raw f32 — it bypasses any wire pack,
+            # so the fused twin always feeds qfn unpacked rank codes
+            def v_fused(pp, X):
+                return qfn(pp, encode_stage(pp, X))
+
+        return {
+            "params": jax.device_put(p2),
+            "jit_fn": v_jit,
+            "fused_inner": v_fused,
+            "wire_pack": wp,
+        }
+
     scorer = QuantizedScorer(
         wire=wire,
         params=jax.device_put(params),
@@ -980,6 +1138,8 @@ def build_quantized_scorer(
         model_hash=model_hash,
         _fused_inner=fused_inner,
         _encode_stage=encode_stage,
+        _xla_rebuild=_build_xla_variant,
+        _meta=scorer_meta,
     )
     _consult_autotune(scorer)
     return scorer

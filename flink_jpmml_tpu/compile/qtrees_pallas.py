@@ -37,6 +37,15 @@ shares, argmaxed outside the kernel). Everything else stays on XLA.
 
 Correctness is tested in interpret mode on CPU against the XLA quantized
 path and the f32 reference (tests/test_qtrees_pallas.py).
+
+Round 11 adds the **multi-tree megakernel** variant
+(``build_pallas_fn(fuse_groups=True)``, the ``mega`` layout of
+compile/layouts.py): the grid keeps only the batch axis and the tree-
+group sweep fuses into an in-kernel ``fori_loop`` accumulating partials
+in registers — one dispatch, one output write per block, same
+accumulation order so scores stay bit-identical (tests/test_layouts.py).
+The learned kernel search (compile/autotune.py) decides per model
+whether it beats the grid form.
 """
 
 from __future__ import annotations
@@ -206,6 +215,55 @@ def _kernel_cls(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
         out_ref[...] = out_ref[...] + part
 
 
+def _kernel_mega(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
+                 vals_ref, out_ref, *, sentinel: float, n_groups: int):
+    """Megakernel regression variant: ALL tree groups fuse into one
+    grid step — an in-kernel ``fori_loop`` accumulates the group
+    partials in registers and the [Bblk] output writes once, instead
+    of the grid's inner axis revisiting the output block per group.
+    Same accumulation order (ascending j, f32 adds of small-integer
+    one-hot contractions), so scores are bit-identical to _kernel."""
+    def body(j, acc):
+        hit = _leaf_hits(
+            xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
+            j, sentinel,
+        )
+        return acc + jnp.sum(hit * vals_ref[pl.ds(j, 1), :], axis=1)
+
+    out_ref[...] = jax.lax.fori_loop(
+        0, n_groups, body, jnp.zeros(out_ref.shape, jnp.float32)
+    )
+
+
+def _kernel_mega_cls(xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref,
+                     count_ref, vals_ref, vlo_ref, out_ref, *,
+                     sentinel: float, n_groups: int):
+    """Megakernel classification variant: fused group loop over the
+    same bf16 hi/lo split-pair dots as _kernel_cls (see there for why
+    the split pair is mandatory on hardware)."""
+    def body(j, acc):
+        hit = _leaf_hits(
+            xq_ref, fsel_ref, qthr_ref, dleft_ref, p_ref, count_ref,
+            j, sentinel,
+        )
+        hb = hit.astype(jnp.bfloat16)
+        # hi+lo FIRST, then fold into the accumulator — the exact
+        # association _kernel_cls uses (out += hi_dot + lo_dot).
+        # acc + hi_dot + lo_dot re-associates the f32 adds and drifts
+        # 1 ULP from the grid kernel on non-integer vote tables,
+        # breaking the catalogue's byte-parity invariant
+        part = jnp.dot(
+            hb, vals_ref[j], preferred_element_type=jnp.float32
+        ) + jnp.dot(
+            hb, vlo_ref[j], preferred_element_type=jnp.float32
+        )
+        return acc + part
+
+    out_ref[...] = jax.lax.fori_loop(
+        0, n_groups, body, jnp.zeros(out_ref.shape, jnp.float32)
+    )
+
+
 def build_pallas_fn(
     groups: Dict[str, np.ndarray],
     batch_size: int,
@@ -213,10 +271,15 @@ def build_pallas_fn(
     sentinel: int,
     block_b: int = DEFAULT_BLOCK_B,
     interpret: bool = False,
+    fuse_groups: bool = False,
 ):
     """→ fn(group_params, Xq u8[B, F]) -> f32[B] ensemble sums (scalar
     ``vals``) or f32[B, C] vote shares (class-row ``vals``), or None when
-    the shapes don't fit this kernel (caller falls back to XLA)."""
+    the shapes don't fit this kernel (caller falls back to XLA).
+
+    ``fuse_groups=True`` builds the multi-tree megakernel (the
+    ``mega`` layout of compile/layouts.py): grid ``(batch blocks,)``
+    only, with the tree-group sweep fused into an in-kernel loop."""
     G = groups["fsel"].shape[0]
     if param_bytes(groups) > _VMEM_PARAM_BUDGET:
         return None
@@ -234,39 +297,61 @@ def build_pallas_fn(
 
     classification = groups["vals"].ndim == 3
     F = n_fields
+    # the megakernel's grid has no group axis: index maps take one
+    # program id; the grid form keeps its (i, j) maps
+    if fuse_groups:
+        batch_map, grid = (lambda i: (i, 0)), (nb,)
+    else:
+        batch_map, grid = (lambda i, j: (i, 0)), (nb, G)
+
+    def _full(shape):
+        zeros = (0,) * len(shape)
+        if fuse_groups:
+            return pl.BlockSpec(shape, lambda i, _z=zeros: _z)
+        return pl.BlockSpec(shape, lambda i, j, _z=zeros: _z)
+
     in_specs = [
-        pl.BlockSpec((block_b, F), lambda i, j: (i, 0)),
-        pl.BlockSpec(groups["fsel"].shape, lambda i, j: (0, 0, 0)),
-        pl.BlockSpec(groups["qthr"].shape, lambda i, j: (0, 0)),
-        pl.BlockSpec(groups["dleft"].shape, lambda i, j: (0, 0)),
-        pl.BlockSpec(groups["Pg"].shape, lambda i, j: (0, 0, 0)),
-        pl.BlockSpec(groups["count"].shape, lambda i, j: (0, 0)),
+        pl.BlockSpec((block_b, F), batch_map),
+        _full(groups["fsel"].shape),
+        _full(groups["qthr"].shape),
+        _full(groups["dleft"].shape),
+        _full(groups["Pg"].shape),
+        _full(groups["count"].shape),
     ]
     if classification:
         assert "vals_lo" in groups, (
             "classification kernel requires the bf16 hi/lo split tables"
         )
         C = groups["vals"].shape[2]
-        kern = functools.partial(_kernel_cls, sentinel=float(sentinel))
-        in_specs.append(
-            pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0, 0))
+        kern = (
+            functools.partial(
+                _kernel_mega_cls, sentinel=float(sentinel), n_groups=G
+            )
+            if fuse_groups
+            else functools.partial(_kernel_cls, sentinel=float(sentinel))
         )
-        in_specs.append(
-            pl.BlockSpec(groups["vals_lo"].shape, lambda i, j: (0, 0, 0))
-        )
-        out_specs = pl.BlockSpec((block_b, C), lambda i, j: (i, 0))
+        in_specs.append(_full(groups["vals"].shape))
+        in_specs.append(_full(groups["vals_lo"].shape))
+        out_specs = pl.BlockSpec((block_b, C), batch_map)
         out_shape = jax.ShapeDtypeStruct((batch_size, C), jnp.float32)
     else:
-        kern = functools.partial(_kernel, sentinel=float(sentinel))
-        in_specs.append(
-            pl.BlockSpec(groups["vals"].shape, lambda i, j: (0, 0))
+        kern = (
+            functools.partial(
+                _kernel_mega, sentinel=float(sentinel), n_groups=G
+            )
+            if fuse_groups
+            else functools.partial(_kernel, sentinel=float(sentinel))
         )
-        out_specs = pl.BlockSpec((block_b,), lambda i, j: (i,))
+        in_specs.append(_full(groups["vals"].shape))
+        out_specs = pl.BlockSpec(
+            (block_b,), (lambda i: (i,)) if fuse_groups else
+            (lambda i, j: (i,))
+        )
         out_shape = jax.ShapeDtypeStruct((batch_size,), jnp.float32)
 
     call = pl.pallas_call(
         kern,
-        grid=(nb, G),
+        grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,

@@ -269,16 +269,16 @@ def dispatch_profile(scorer_or_bound, n: int) -> dict:
     if getattr(q, "params", None) is not None:
         flops = _scorer_flops_per_record(q)
     # HBM stream bytes per record: the staged wire bytes in + a bf16
-    # score out (the bench roofline's convention); fused ships raw f32
+    # score out (the bench roofline's convention). The scorer's own
+    # layout-aware property covers fused f32 AND the packed rank wire;
+    # the wire fallback handles foreign scorer objects
     bpr = None
     wire = getattr(q, "wire", None)
     if wire is not None:
         try:
-            if (
-                getattr(q, "encode_mode", "host") == "fused"
-                and q.supports_fused
-            ):
-                bpr = 4.0 * len(wire.fields) + 2.0
+            staged = getattr(q, "staged_bytes_per_record", None)
+            if staged is not None:
+                bpr = float(staged) + 2.0
             else:
                 bpr = float(wire.bytes_per_record) + 2.0
         except Exception:
@@ -293,7 +293,22 @@ def dispatch_profile(scorer_or_bound, n: int) -> dict:
         "flops_per_record": flops,
         "bytes_per_record": bpr,
         "model": model_key,
+        # the autotune cache key half: the drift-band re-search trigger
+        # clears by model_hash, while ``model`` above may be the
+        # serving registry name (BoundScorer.key)
+        "model_hash": getattr(q, "model_hash", None),
         "backend": getattr(q, "backend", None),
+        # kernel-search provenance: which catalogue variant is serving,
+        # its feature vector (ledger training row), and the prediction
+        # for the variant ACTUALLY running (the live drift band
+        # verifies it; autotune nulls it when a cached variant
+        # degraded to defaults) — all cached scorer attributes, so the
+        # per-launch cost stays a handful of getattrs (the
+        # attribution-overhead tripwire)
+        "layout": getattr(q, "layout", None),
+        "variant": getattr(q, "_cost_variant", None),
+        "features": getattr(q, "_cost_feat", None),
+        "predicted_s_per_record": getattr(q, "_pred_s_per_record", None),
     }
 
 

@@ -48,6 +48,13 @@ _PEAKS_ENV = "FJT_PROF_PEAKS"
 _DEFAULT_INTERVAL_S = 1.0
 _OVERHEAD_BUDGET = 0.01  # ≤1% of wall clock spent inside samples
 _EWMA_ALPHA = 0.3  # smoothing for the per-record device time
+# prediction drift band (PR 8's capacity_reestimated pattern): observed
+# device cost outside [pred/band, pred·band] for this many consecutive
+# samples means the adopted kernel config's prediction went stale —
+# invalidate the cost-model fit and clear the model's autotune entry so
+# the next warmup re-searches
+_PRED_BAND = 1.75
+_PRED_STRIKES = 3
 
 # chip peaks (device_kind substring → (bf16 peak FLOP/s, HBM bytes/s));
 # shared with bench.py's roofline fields
@@ -123,11 +130,76 @@ def _device_kind() -> str:
 def cost_ledger_path() -> str:
     """``kernel_costs.json`` in the autotune cache's directory — the
     measured-cost training data lives next to the measured-config
-    cache it will eventually replace."""
+    cache it feeds (compile/costmodel.py)."""
     from flink_jpmml_tpu.compile import autotune
 
     p = autotune.cache_path()
     return str(p.parent / "kernel_costs.json")
+
+
+def _read_entries(path: str) -> Dict[str, dict]:
+    """Parse one ledger file → entries dict; {} on any problem (the
+    corrupt-tolerant contract every cache-dir artifact follows)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        entries = data.get("entries")
+        if isinstance(entries, dict):
+            return {
+                k: v for k, v in entries.items() if isinstance(v, dict)
+            }
+    except (OSError, ValueError, AttributeError):
+        pass
+    return {}
+
+
+def read_ledger(path: Optional[str] = None) -> Dict[str, dict]:
+    """Merge-on-load entry point for ledger consumers (the cost model's
+    training replay, tooling): the on-disk entries as written by ANY
+    process — each writer merges entry-wise (newest ``ts`` wins per
+    key), so a reader never sees one bench process's view clobbering a
+    sibling's."""
+    if path is None:
+        try:
+            path = cost_ledger_path()
+        except Exception:
+            return {}
+    return _read_entries(path)
+
+
+def _merge_entries(
+    disk: Dict[str, dict], mine: Dict[str, dict]
+) -> Dict[str, dict]:
+    """Entry-wise union: unknown keys survive from either side; for a
+    shared key the newer ``ts`` wins (two sibling processes sampling
+    the same (model, backend, variant) converge on the freshest EWMA
+    instead of last-writer-wins clobbering)."""
+    out = dict(disk)
+    for k, e in mine.items():
+        cur = out.get(k)
+        if cur is None or float(e.get("ts") or 0) >= float(
+            cur.get("ts") or 0
+        ):
+            out[k] = e
+    return out
+
+
+def _platform() -> str:
+    """The jax platform string, resolved once per process — stamped
+    into ledger rows so a cost-model fit can filter CPU-interpret
+    timings out of a TPU fit."""
+    global _PLATFORM
+    if _PLATFORM is None:
+        try:
+            import jax
+
+            _PLATFORM = jax.default_backend()
+        except Exception:
+            _PLATFORM = "unknown"
+    return _PLATFORM
+
+
+_PLATFORM: Optional[str] = None
 
 
 class KernelCostLedger:
@@ -169,10 +241,23 @@ class KernelCostLedger:
         records: int,
         flops_per_record: Optional[float],
         bytes_per_record: Optional[float],
+        variant: Optional[str] = None,
+        features: Optional[dict] = None,
+        predicted: Optional[float] = None,
     ) -> None:
+        """Fold one measured (device_s, records) pair into the entry
+        for (model, backend[, variant]).
+
+        ``variant``/``features`` are the kernel-search extension: a
+        per-variant row whose feature dict is a training sample for
+        the learned cost model (compile/costmodel.py);
+        ``predicted`` records the model's prediction at measurement
+        time, so the row carries its own residual."""
         if not records or device_s <= 0:
             return
         key = f"{model or 'unknown'}|{backend or 'unknown'}"
+        if variant:
+            key = f"{key}|{variant}"
         per_rec = device_s / records
         with self._mu:
             e = self._entries.get(key)
@@ -192,6 +277,16 @@ class KernelCostLedger:
             e["flops_per_record"] = flops_per_record
             e["bytes_per_record"] = bytes_per_record
             e["rec_s"] = round(records / device_s, 1)
+            e["platform"] = _platform()
+            if variant:
+                e["variant"] = variant
+            if isinstance(features, dict) and features:
+                e["features"] = dict(features)
+            if predicted is not None and predicted > 0:
+                e["predicted_s_per_record"] = predicted
+                e["pred_err"] = round(
+                    abs(per_rec - predicted) / predicted, 4
+                )
             e["ts"] = time.time()
             self._dirty = True
             now = self._clock()
@@ -206,9 +301,16 @@ class KernelCostLedger:
             return {k: dict(v) for k, v in self._entries.items()}
 
     def flush(self) -> None:
-        """Merge-write this process's entries into the on-disk ledger
-        (atomic replace; any I/O or parse failure is silent — a
-        read-only cache dir must not break serving)."""
+        """Merge-write this process's entries into the on-disk ledger.
+
+        Concurrency discipline (two bench processes flushing at once
+        used to last-writer-wins clobber each other's entries): the
+        whole read→merge→replace runs under an exclusive ``flock`` on
+        a sidecar lock file, the merge is entry-wise (newest ``ts``
+        wins per key, unknown keys union), and the write itself is the
+        PR 8 checkpoint protocol — temp file, fsync, ``os.replace``,
+        best-effort directory fsync. Any I/O or parse failure is
+        silent — a read-only cache dir must not break serving."""
         path = self._resolve_path()
         if path is None:
             return
@@ -217,26 +319,30 @@ class KernelCostLedger:
                 return
             mine = {k: dict(v) for k, v in self._entries.items()}
             self._dirty = False
-        disk: Dict[str, dict] = {}
+        lock = None
         try:
-            with open(path) as f:
-                data = json.load(f)
-            if isinstance(data.get("entries"), dict):
-                disk = data["entries"]
-        except (OSError, ValueError, AttributeError):
-            disk = {}
-        disk.update(mine)
-        tmp = f"{path}.tmp-{os.getpid()}"
-        try:
+            import fcntl
+
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(tmp, "w") as f:
-                json.dump({"version": 1, "entries": disk}, f)
-            os.replace(tmp, path)
-        except OSError:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+            lock = open(f"{path}.lock", "w")
+            fcntl.flock(lock, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            # no flock (non-posix / read-only dir): the atomic replace
+            # below still guarantees readers never see a torn file
+            if lock is not None:
+                lock.close()
+                lock = None
+        from flink_jpmml_tpu.utils.diskio import atomic_write_json
+
+        try:
+            merged = _merge_entries(_read_entries(path), mine)
+            atomic_write_json(path, {"version": 1, "entries": merged})
+        finally:
+            if lock is not None:
+                try:
+                    lock.close()  # closing releases the flock
+                except OSError:
+                    pass
 
 
 # ---------------------------------------------------------------------------
@@ -290,6 +396,18 @@ class DeviceProfiler:
         self._peaks = None
         self._peaks_resolved = False
         self.cost_ledger = cost_ledger or KernelCostLedger()
+        # predicted-vs-observed tracking per (model, backend): the
+        # kernel_pred_error gauge registers lazily (only pipelines
+        # serving a search-adopted config carry it) and the strike
+        # counters drive the stale-prediction re-search trigger
+        self._pred_err_ewma: Dict[str, float] = {}
+        self._pred_strikes: Dict[str, int] = {}
+        # prediction value that already fired per key: the trigger is
+        # one-shot per prediction — a long-lived server with a stale
+        # config must not keep wiping the fit/cache a sibling's fresh
+        # re-search just wrote; a NEW prediction re-arms the band
+        self._pred_fired: Dict[str, float] = {}
+        self._g_pred_err = None
         self._samples = metrics.counter("device_samples")
         self._g_mfu = metrics.gauge("device_mfu")
         self._g_membw = metrics.gauge("device_membw_util")
@@ -377,10 +495,83 @@ class DeviceProfiler:
         led = attr.ledger_for(self._metrics_ref())
         if led is not None:
             led.observe("device", device_s)
+        self._verify_prediction(profile, per_rec)
         self.cost_ledger.update(
             profile.get("model"), profile.get("backend"),
             device_s, records, flops, bpr,
+            variant=profile.get("variant"),
+            features=profile.get("features"),
+            predicted=profile.get("predicted_s_per_record"),
         )
+
+    def _verify_prediction(self, profile: dict, per_rec: float) -> None:
+        """Predict-then-verify, live: compare the sampled device cost
+        against the adopted kernel config's prediction. Updates the
+        ``kernel_pred_error`` gauge (relative |obs−pred| EWMA) and, on
+        sustained out-of-band drift, invalidates the cost-model fit
+        and clears this model's autotune entry — the next warmup
+        re-searches instead of trusting the stale prediction."""
+        pred = profile.get("predicted_s_per_record")
+        try:
+            pred = float(pred) if pred else 0.0
+        except (TypeError, ValueError):
+            return
+        if pred <= 0 or per_rec <= 0:
+            return
+        key = f"{profile.get('model')}|{profile.get('backend')}"
+        err = abs(per_rec - pred) / pred
+        stale = False
+        with self._mu:
+            prev = self._pred_err_ewma.get(key)
+            ewma = (
+                err if prev is None
+                else (1.0 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * err
+            )
+            self._pred_err_ewma[key] = ewma
+            already_fired = self._pred_fired.get(key) == pred
+            if already_fired:
+                pass  # this prediction is already invalidated; only a
+                # re-search (new prediction value) re-arms the trigger
+            elif pred / _PRED_BAND <= per_rec <= pred * _PRED_BAND:
+                self._pred_strikes[key] = max(
+                    0, self._pred_strikes.get(key, 0) - 1
+                )
+                self._pred_fired.pop(key, None)
+            else:
+                strikes = self._pred_strikes.get(key, 0) + 1
+                stale = strikes >= _PRED_STRIKES
+                self._pred_strikes[key] = 0 if stale else strikes
+                if stale:
+                    self._pred_fired[key] = pred
+            if self._g_pred_err is None:
+                reg = self._metrics_ref()
+                if reg is not None:
+                    self._g_pred_err = reg.gauge("kernel_pred_error")
+        if self._g_pred_err is not None:
+            self._g_pred_err.set(round(ewma, 4))
+        if not stale:
+            return
+        from flink_jpmml_tpu.obs import recorder as flight
+
+        flight.record(
+            "kernel_search_stale",
+            model=profile.get("model"),
+            backend=profile.get("backend"),
+            predicted_s_per_record=pred,
+            observed_s_per_record=round(per_rec, 12),
+        )
+        try:
+            from flink_jpmml_tpu.compile import autotune, costmodel
+
+            costmodel.mark_stale(f"drift band: {key}")
+            # the cache keys on model_hash; profile["model"] may be
+            # the serving registry name (BoundScorer.key) and would
+            # clear nothing
+            model = profile.get("model_hash") or profile.get("model")
+            if model:
+                autotune.clear(str(model))
+        except Exception:
+            pass  # re-search is best-effort; serving never breaks
 
 
 # one profiler per registry (cf. attr.ledger_for); a shared process-wide

@@ -236,10 +236,18 @@ def dispatch_quantized(
             payload = np.array(payload, copy=True)
         predict = q.predict_fused_padded
     else:
+        # layout-aware staging: pad_wire routes the codes through the
+        # scorer's adopted wire packing (compile/layouts.py WirePack)
+        # when the kernel search chose one, so the staged payload,
+        # h2d_bytes, and the donation accounting all see the packed
+        # wire without any per-call-site knowledge
         payload, K = q.pad_wire(q.wire.encode(X, M))
         predict = q.predict_padded
     t1 = time.monotonic()
-    spans.emit("featurize", t0, t1 - t0, fused=fused)
+    spans.emit(
+        "featurize", t0, t1 - t0, fused=fused,
+        layout=getattr(q, "layout", "ref"),
+    )
     # per-batch stage attribution (obs/attr.py): the same registry's
     # stage_seconds{stage=...} histograms merge fleet-wide like every
     # other metric; encode covers featurize+align, h2d the host-side

@@ -766,7 +766,7 @@ class MetricsRegistry:
 #: take the worst worker now.
 _GAUGE_MERGE_MAX_PREFIXES = (
     "device_mfu", "device_membw_util", "device_ns_per_record",
-    "flops_per_record", "slo_burn_rate",
+    "flops_per_record", "kernel_pred_error", "slo_burn_rate",
     "watermark_lag_s", "kafka_lag_age_s", "lag_drain_eta_s",
     "lag_trend", "lag_diverging", "pressure", "ring_occupancy",
     "shed_level", "reconnect_backoff_s", "slo_deadline_ms",

@@ -24,7 +24,8 @@ def _args(**over):
         skip_interp=False, skip_kafka=False,
         skip_latency=False, latency=False, latency_batch=4096,
         latency_deadline_us=2000, latency_offered=100000.0,
-        no_autotune=False,
+        no_autotune=False, kernel_search=False, no_kernel_search=False,
+        load_shape="steady",
         in_child=False, force_cpu=False, block_pipeline=False,
     )
     for k, v in over.items():
@@ -57,6 +58,17 @@ class TestChildCmd:
         assert "--no-autotune" not in bench._child_cmd(_args(), False)
         assert "--no-autotune" in bench._child_cmd(
             _args(no_autotune=True), False
+        )
+
+    def test_kernel_search_flags_passthrough(self):
+        base = bench._child_cmd(_args(), False)
+        assert "--kernel-search" not in base
+        assert "--no-kernel-search" not in base
+        assert "--kernel-search" in bench._child_cmd(
+            _args(kernel_search=True), False
+        )
+        assert "--no-kernel-search" in bench._child_cmd(
+            _args(no_kernel_search=True), False
         )
 
 

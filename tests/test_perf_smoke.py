@@ -31,6 +31,7 @@ def test_perf_smoke_passes():
     assert "block pipeline drain/ordering OK" in proc.stdout
     assert "fused encode parity OK" in proc.stdout
     assert "autotune cache roundtrip OK" in proc.stdout
+    assert "kernel search OK" in proc.stdout
     assert "obs /metrics scrape OK" in proc.stdout
     assert "attribution overhead OK" in proc.stdout
     assert "rollout drill OK" in proc.stdout

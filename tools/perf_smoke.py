@@ -16,6 +16,12 @@ fails loudly on exactly the regressions new concurrency code breeds:
   bucketizer, through the production pipeline too;
 - **autotune-cache fragility**: a corrupt on-disk autotune cache must
   read as empty (silent re-tune) — never crash a compile or a sweep;
+- **kernel-search budget rot**: the learned predict-then-verify search
+  (compile/costmodel.py + autotune) must time at most top-K of the
+  layout×tile candidate space, land its timings in the kernel cost
+  ledger as feature rows a replayed fit predicts within a sane band,
+  treat a stale search-space tag as no cache entry, and keep the
+  ``--no-kernel-search`` ablation flag wired;
 - **scrape-surface rot**: a live pipeline's ``/metrics`` endpoint
   (obs/server.py) must serve parseable Prometheus text whose
   ``fjt_records_out`` is non-zero and whose histogram ``_count``
@@ -288,6 +294,103 @@ def check_autotune_cache_roundtrip() -> None:
                 os.environ.pop("FJT_AUTOTUNE_CACHE", None)
             else:
                 os.environ["FJT_AUTOTUNE_CACHE"] = prev_cache
+
+
+def check_kernel_search() -> None:
+    """Learned kernel search tripwire (ISSUE 11): the predict-then-
+    verify search must complete within its candidate budget (top-K
+    timed, NOT the full layout×tile space), feed the kernel cost
+    ledger rows a ledger-replay fit predicts within a sane band, honor
+    the stale-space-tag invalidation, and keep the
+    ``--no-kernel-search`` bench ablation flag wired."""
+    import json
+    import math
+
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import autotune, costmodel, layouts
+    from flink_jpmml_tpu.compile.qtrees import build_quantized_scorer
+    from flink_jpmml_tpu.obs import profiler
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+
+    with tempfile.TemporaryDirectory() as tmp:
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=12, depth=3, n_features=4)
+        )
+    rng = np.random.default_rng(6)
+    X = rng.normal(0.0, 1.5, size=(64, 4)).astype(np.float32)
+    prev_cache = os.environ.get("FJT_AUTOTUNE_CACHE")
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ["FJT_AUTOTUNE_CACHE"] = os.path.join(tmp, "at.json")
+        try:
+            q = build_quantized_scorer(
+                doc, batch_size=64, backend="pallas", pallas_interpret=True
+            )
+            cfg = autotune.ensure_tuned(q, X, repeats=1, top_k=3)
+            s = cfg.search
+            assert s is not None and s["space"] == layouts.SPACE_TAG
+            # the budget: top-K timed, not the full space
+            assert s["timed"] <= s["top_k"] == 3, s
+            assert s["candidates_total"] > s["top_k"], s
+            assert cfg.layout in (
+                "ref", "bfs", "mega", "mega_bfs"
+            ), cfg.layout
+            # every timed candidate became a ledger training row with
+            # features, and a replayed fit predicts each row within a
+            # sane band (interpret-mode timings are noisy; the band
+            # checks sanity, not precision)
+            rows = costmodel.training_rows(
+                profiler.cost_ledger_path()
+            )
+            assert len(rows) >= s["timed"] > 0, (len(rows), s)
+            model = costmodel.fit_from_ledger(
+                path=profiler.cost_ledger_path(), min_rows=1
+            )
+            assert model is not None, "ledger replay produced no fit"
+            for feats, y in rows:
+                pred = model.predict(feats)
+                assert pred is not None and pred > 0
+                assert abs(math.log(pred / y)) < math.log(16.0), (
+                    f"ledger-replay prediction {pred} vs observed {y} "
+                    "outside the 16x sanity band"
+                )
+            # stale space tag ⇒ silent re-search (the cached pre-layout
+            # winner must never pin a new binary)
+            key = autotune.backend_key(q)
+            path = autotune.cache_path()
+            data = json.load(open(path))
+            entry = data["entries"][f"{q.model_hash}|{key}"]
+            entry["space"] = "space-v0:obsolete"
+            path.write_text(json.dumps(data))
+            assert autotune.lookup(q.model_hash, key) is None, (
+                "an obsolete-space cache entry was honoured"
+            )
+            # the --no-kernel-search ablation gate: legacy ref-only
+            # tile sweep, no layout candidates
+            os.environ["FJT_KERNEL_SEARCH_DISABLE"] = "1"
+            try:
+                q2 = build_quantized_scorer(
+                    doc, batch_size=64, backend="pallas",
+                    pallas_interpret=True,
+                )
+                cfg2 = autotune.sweep(q2, X, repeats=1, top_k=3)
+                assert cfg2.search["mode"] == "legacy", cfg2.search
+                assert cfg2.layout == "ref"
+            finally:
+                os.environ.pop("FJT_KERNEL_SEARCH_DISABLE", None)
+        finally:
+            if prev_cache is None:
+                os.environ.pop("FJT_AUTOTUNE_CACHE", None)
+            else:
+                os.environ["FJT_AUTOTUNE_CACHE"] = prev_cache
+    # the bench flag itself stays wired (parse-level, no measurement)
+    from flink_jpmml_tpu.bench import build_arg_parser
+
+    ns = build_arg_parser().parse_args(["--no-kernel-search"])
+    assert ns.no_kernel_search and not ns.kernel_search
+    ns = build_arg_parser().parse_args(["--kernel-search"])
+    assert ns.kernel_search
 
 
 def check_obs_scrape() -> None:
@@ -790,6 +893,8 @@ def main() -> int:
     print("perf-smoke: fused encode parity OK", flush=True)
     check_autotune_cache_roundtrip()
     print("perf-smoke: autotune cache roundtrip OK", flush=True)
+    check_kernel_search()
+    print("perf-smoke: kernel search OK", flush=True)
     check_obs_scrape()
     print("perf-smoke: obs /metrics scrape OK", flush=True)
     check_attribution_overhead()
