@@ -1942,6 +1942,418 @@ def run_overload_drill(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_RECOVERY_WORKER = r'''
+import os, sys, time
+# per-incarnation fault seed BEFORE the package imports (env faults arm
+# at import): the seeded p-gates draw a fresh pattern per incarnation,
+# so a site-targeted crash can't deterministically re-fire at the same
+# call forever
+os.environ["FJT_FAULTS"] = os.environ.get("FJT_FAULTS", "").replace(
+    "PIDSEED", str(os.getpid())
+)
+sys.path.insert(0, sys.argv[8])
+import jax
+jax.config.update("jax_platforms", "cpu")  # correctness drill: host-side
+import numpy as np
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.runtime.block import BlockPipeline
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+from flink_jpmml_tpu.runtime.kafka import KafkaBlockSource
+from flink_jpmml_tpu.runtime.supervisor import reporter_from_env
+from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+host, port, topic, pmml, ckdir, outfile, total = sys.argv[1:8]
+total = int(total)
+m = MetricsRegistry()
+rep = reporter_from_env(metrics=m)
+dlq = DeadLetterQueue(os.path.join(ckdir, "dlq"), metrics=m)
+src = KafkaBlockSource(
+    host, int(port), topic, n_cols=6, max_wait_ms=20, metrics=m, dlq=dlq,
+)
+cm = compile_pmml(parse_pmml_file(pmml), batch_size=64)
+out = open(outfile, "a", buffering=1)
+wm = m.gauge("watermark_ts")
+
+def sink(o, n, first_off):
+    out.write("E %d %d %d %.3f\n" % (os.getpid(), first_off, n, wm.get()))
+
+pipe = BlockPipeline(
+    src, cm, sink,
+    RuntimeConfig(
+        batch=BatchConfig(size=64, deadline_us=2000, queue_capacity=4096),
+        checkpoint_interval_s=0.05,
+    ),
+    metrics=m,
+    checkpoint=CheckpointManager(ckdir),
+    dlq=dlq,
+    max_dispatch_chunks=4,
+)
+pipe.restore()
+out.write("R %d %d\n" % (os.getpid(), pipe.committed_offset))
+pipe.start()
+while pipe.committed_offset < total and pipe._error is None:
+    time.sleep(0.02)
+pipe.stop()
+pipe.join(timeout=30.0)
+out.write("D %d %d\n" % (os.getpid(), pipe.committed_offset))
+src.close()
+out.close()
+'''
+
+
+def run_recovery_drill(
+    records: int = 24_000,
+    kills: int = 2,
+    poison: int = 2,
+    hard_poison: bool = True,
+    decode_poison_n: int = 2,
+    seed: int = 7,
+    timeout_s: float = 300.0,
+    max_restarts: int = 60,
+    throttle_ms: float = 0.0,
+    kill_dwell: tuple = (0.2, 0.7),
+) -> dict:
+    """``--recovery-drill``: the kill-anywhere delivery-correctness
+    acceptance drill. A supervised worker scores a real Kafka stream
+    (in-process broker, production BlockPipeline, checkpoints + DLQ)
+    while chaos lands from every direction:
+
+    - the PARENT SIGKILLs it at randomized mid-stream instants;
+    - ``FJT_FAULTS`` ``worker_crash`` kinds SIGKILL from inside at the
+      real sites (mid-fetch / mid-dispatch / mid-checkpoint), seeded
+      per incarnation; ``slow_fetch`` rides along;
+    - ``poison_record`` faults make chosen offsets raise in scoring
+      (the catchable-poison path → suspect-mode bisection);
+    - one optional HARD poison offset SIGKILLs the process whenever its
+      batch is dispatched (the crash-loop path → fingerprint + marker
+      convergence, supervisor streak cooperation);
+    - wrong-length producer records exercise the decode-poison path.
+
+    Verified end to end: zero record loss; duplication bounded by the
+    replay windows the restarts admit; every retained checkpoint
+    parseable; watermarks monotone within each incarnation; the
+    injected poison offsets land in the DLQ EXACTLY (and never in the
+    sink); no ``on_give_up`` fired; and ``fjt-dlq redrive`` round-trips
+    a quarantined record back through the live pipeline."""
+    import signal
+
+    import numpy as np
+
+    from flink_jpmml_tpu import cli as cli_mod
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+    from flink_jpmml_tpu.runtime.kafka import MiniKafkaBroker
+    from flink_jpmml_tpu.runtime.supervisor import (
+        RestartPolicy, Supervisor, WorkerSpec,
+    )
+
+    t0 = time.monotonic()
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix="fjt-recovery-")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    broker = None
+    sup = None
+    ok = False
+    try:
+        pmml = gen_gbm(tmp, n_trees=6, depth=3, n_features=6)
+        broker = MiniKafkaBroker(topic="recovery")
+        data = rng.normal(0, 1.2, size=(records, 6)).astype(np.float32)
+
+        # -- produce, stamped with a synthetic-but-ordered event-time
+        #    axis, interleaving wrong-length decode-poison values
+        decode_offsets = []
+        chunk = 512
+        ts0 = int(time.time() * 1000) - records
+        decode_positions = set(
+            int(p) for p in np.linspace(
+                records // 4, records // 2, max(decode_poison_n, 0),
+            )
+        )
+        produced = 0
+        while produced < records:
+            hi = min(produced + chunk, records)
+            broker.append_rows(
+                data[produced:hi], timestamp_ms=ts0 + hi,
+            )
+            produced = hi
+            for p in sorted(decode_positions):
+                if produced - chunk <= p < produced:
+                    decode_offsets.append(
+                        broker.append(b"\xde\xad\xbe\xef-poison")
+                    )
+        total_off = records + len(decode_offsets)
+
+        # -- poison targeting (offsets in the BROKER's domain — the
+        #    decode poisons above shifted everything after them)
+        def log_off(row_idx: int) -> int:
+            return row_idx + sum(
+                1 for d in decode_offsets if d <= row_idx
+            )
+
+        score_poison = sorted(
+            log_off(int(i)) for i in np.linspace(
+                records // 6, 5 * records // 8, max(poison, 0),
+            )
+        )
+        hard_off = (
+            log_off(int(3 * records // 4)) if hard_poison else None
+        )
+        fault_spec = [
+            f"poison_record:offset={o}" for o in score_poison
+        ]
+        if hard_off is not None:
+            fault_spec.append(
+                f"worker_crash:site=score_batch:offset={hard_off}"
+            )
+        fault_spec += [
+            "worker_crash:site=kafka_fetch:p=0.003:n=1"
+            ":after_s=0.5:for_s=1.5:seed=PIDSEED",
+            "worker_crash:site=dispatch:p=0.003:n=1"
+            ":after_s=0.5:for_s=1.5:seed=PIDSEED",
+            "worker_crash:site=checkpoint_write:p=0.02:n=1"
+            ":after_s=0.5:for_s=1.5:seed=PIDSEED",
+            "slow_fetch:delay_ms=3:p=0.02:seed=PIDSEED",
+        ]
+        if throttle_ms > 0:
+            # stretch a smoke-scale stream so the parent's kill cannot
+            # race a sub-second drain (the full drill's hard poison
+            # provides that runway by construction)
+            fault_spec.append(f"dispatch_delay:delay_ms={throttle_ms}")
+        ckdir = os.path.join(tmp, "ck")
+        outfile = os.path.join(tmp, "emissions.log")
+        open(outfile, "w").close()
+        worker_env = {
+            "FJT_FAULTS": ",".join(fault_spec),
+            "FJT_POISON_RESTARTS": "2",
+            "FJT_RESTART_BASE_S": "0.02",
+            "FJT_RESTART_CAP_S": "0.2",
+            "FJT_RETRY_BASE_S": "0.01",
+            "FJT_XLA_CACHE": os.path.join(tmp, "xla"),
+            "FJT_AUTOTUNE_CACHE": os.path.join(tmp, "autotune"),
+            "JAX_PLATFORMS": "cpu",
+        }
+        argv = [
+            sys.executable, "-c", _RECOVERY_WORKER,
+            broker.host, str(broker.port), "recovery", pmml,
+            ckdir, outfile, str(total_off), repo,
+        ]
+        give_ups = []
+        sup = Supervisor(
+            [WorkerSpec("scorer", argv, env=worker_env)],
+            policy=RestartPolicy(
+                max_restarts=max_restarts, backoff_s=0.02,
+                max_backoff_s=0.2,
+            ),
+            heartbeat_timeout_s=None,  # exit detection is the drill's
+            # only death signal; wedges aren't injected here
+            on_give_up=give_ups.append,
+        )
+
+        def committed() -> int:
+            try:
+                from flink_jpmml_tpu.runtime.checkpoint import (
+                    CheckpointManager,
+                )
+                st = CheckpointManager(ckdir).load_latest()
+                return int(st["source_offset"]) if st else 0
+            except Exception:
+                return 0
+
+        sup.start()
+        deadline = time.monotonic() + timeout_s
+        kills_done = 0
+        last_kill_committed = -1
+        while time.monotonic() < deadline:
+            st = sup.status()["scorer"]
+            if st["finished"] or st["gave_up"]:
+                break
+            c = committed()
+            if (
+                kills_done < kills
+                and st["alive"]
+                and c > last_kill_committed
+                and c > 0
+            ):
+                # kill-anywhere: a randomized dwell then SIGKILL, but
+                # only after fresh progress since the last kill — the
+                # in-worker crash faults own the no-progress regimes
+                time.sleep(float(rng.uniform(*kill_dwell)))
+                pid = sup.status()["scorer"]["pid"]
+                if pid is not None:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                        kills_done += 1
+                        last_kill_committed = c
+                    except OSError:
+                        pass
+            time.sleep(0.05)
+        st = sup.status()["scorer"]
+        restarts = int(st["restarts"])
+        assert not give_ups and not st["gave_up"], (
+            f"give-up fired after {restarts} restarts — the poison "
+            f"plane failed to convert the crash loop (status {st})"
+        )
+        assert st["finished"], (
+            f"drill did not drain within {timeout_s}s "
+            f"(committed {committed()}/{total_off}, status {st})"
+        )
+        sup.stop()
+        sup = None
+
+        # ---- verification --------------------------------------------
+        expected_quarantine = sorted(
+            set(score_poison)
+            | set(decode_offsets)
+            | ({hard_off} if hard_off is not None else set())
+        )
+        # every retained checkpoint parses (the atomic-writer contract
+        # under SIGKILL-anywhere)
+        import glob as _glob
+        snaps = sorted(_glob.glob(os.path.join(ckdir, "ckpt-*.json")))
+        assert snaps, "no checkpoint survived the drill"
+        for p in snaps:
+            with open(p, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+            assert "state" in payload, f"torn checkpoint {p}"
+
+        emitted = []   # (pid, first_off, n, wm)
+        restores = []  # (pid, committed-at-restore)
+        for ln in open(outfile, "r", encoding="utf-8"):
+            parts = ln.split()
+            if not parts:
+                continue
+            if parts[0] == "E":
+                emitted.append((
+                    int(parts[1]), int(parts[2]), int(parts[3]),
+                    float(parts[4]),
+                ))
+            elif parts[0] == "R":
+                restores.append((int(parts[1]), int(parts[2])))
+        covered = np.zeros(total_off, np.int64)
+        for _, off, n, _wm in emitted:
+            covered[off: off + n] += 1
+        qset = np.zeros(total_off, bool)
+        qset[expected_quarantine] = True
+        lost = np.flatnonzero((covered == 0) & ~qset)
+        assert lost.size == 0, (
+            f"record loss at offsets {lost[:10].tolist()}"
+        )
+        leaked = np.flatnonzero((covered > 0) & qset)
+        assert leaked.size == 0, (
+            f"quarantined offsets reached the sink: "
+            f"{leaked[:10].tolist()}"
+        )
+        # duplication bounded by the replay windows the restarts admit:
+        # each incarnation can replay at most records-since-last-commit
+        # = the ring capacity + the in-flight window
+        replay_window = 4096 + 4 * 64 * 2
+        excess = int(np.clip(covered - 1, 0, None).sum())
+        n_incarnations = restarts + 1
+        assert excess <= n_incarnations * replay_window, (
+            f"duplicate excess {excess} exceeds "
+            f"{n_incarnations} x {replay_window}"
+        )
+        # watermarks monotone within each incarnation
+        by_pid: dict = {}
+        for pid, _off, _n, wm in emitted:
+            if wm <= 0:
+                continue
+            prev = by_pid.get(pid)
+            assert prev is None or wm >= prev - 1e-9, (
+                f"watermark regressed within pid {pid}: {prev} -> {wm}"
+            )
+            by_pid[pid] = wm
+        # the DLQ holds the injected poison EXACTLY (dedup by offset:
+        # replays may quarantine the same record more than once)
+        dlq = DeadLetterQueue(os.path.join(ckdir, "dlq"))
+        dlq_envs = list(dlq.scan())
+        dlq_offsets = sorted(set(
+            int(e["offset"]) for e in dlq_envs
+        ))
+        assert dlq_offsets == expected_quarantine, (
+            f"DLQ {dlq_offsets} != expected {expected_quarantine}"
+        )
+        reasons = {
+            int(e["offset"]): e["reason"] for e in dlq_envs
+        }
+        for o in decode_offsets:
+            assert reasons[o] == "decode", reasons
+        if hard_off is not None:
+            assert reasons[hard_off] == "crash_loop", reasons
+
+        # ---- redrive round-trip through the LIVE pipeline ------------
+        redrive_off = score_poison[0] if score_poison else None
+        redrive_ok = None
+        if redrive_off is not None:
+            cli_mod.dlq_main([
+                "redrive", ckdir,
+                "--host", broker.host, "--port", str(broker.port),
+                "--topic", "recovery", "--offset", str(redrive_off),
+            ])
+            clean_env = dict(os.environ)
+            clean_env.update(worker_env)
+            clean_env.pop("FJT_FAULTS", None)  # corrected pipeline
+            argv2 = list(argv)
+            # the worker's `total` argument is second-to-last (repo
+            # path trails it): drain through the redriven record
+            assert argv2[-2] == str(total_off)
+            argv2[-2] = str(total_off + 1)
+            proc = subprocess.run(
+                argv2, env=clean_env, capture_output=True, text=True,
+                timeout=120,
+            )
+            assert proc.returncode == 0, (
+                f"redrive consumer failed rc={proc.returncode}: "
+                f"{proc.stderr[-800:]}"
+            )
+            tail = [
+                (int(p[2]), int(p[3]))
+                for p in (
+                    ln.split() for ln in open(outfile, encoding="utf-8")
+                )
+                if p and p[0] == "E"
+            ]
+            redrive_ok = any(
+                off <= total_off < off + n for off, n in tail
+            )
+            assert redrive_ok, (
+                "redriven record never reached the sink"
+            )
+
+        ok = True
+        return {
+            "metric": "recovery_drill",
+            "ok": True,
+            "records": int(records),
+            "log_records": int(total_off),
+            "parent_kills": int(kills_done),
+            "restarts": int(restarts),
+            "incarnations": len(restores),
+            "quarantined": expected_quarantine,
+            "dlq_reasons": {
+                str(k): v for k, v in sorted(reasons.items())
+            },
+            "duplicate_excess": excess,
+            "max_dup": int(covered.max()),
+            "checkpoints_verified": len(snaps),
+            "redrive_ok": redrive_ok,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+    finally:
+        if sup is not None:
+            sup.stop()
+        if broker is not None:
+            broker.close()
+        if ok:  # a failed drill leaves its logs/DLQ for inspection
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            print(f"[recovery-drill] artifacts kept at {tmp}",
+                  file=sys.stderr)
+
+
 def _latency_headline(line: dict, trees: int, backend: str) -> dict:
     """--latency: re-headline the artifact on the latency operating
     point (p50 record latency, ms); the throughput number rides along."""
@@ -2057,6 +2469,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "state merge exactly")
     ap.add_argument("--drift-records", type=int, default=12_000,
                     help="records per drift-drill phase")
+    ap.add_argument("--recovery-drill", action="store_true",
+                    help="run the kill-anywhere delivery-correctness "
+                         "drill instead of the perf capture: SIGKILLs "
+                         "(parent + in-worker fault sites) + poison "
+                         "records against a supervised Kafka pipeline; "
+                         "asserts zero loss, bounded duplication, "
+                         "parseable checkpoints, monotone watermarks, "
+                         "poison offsets exactly in the DLQ, and an "
+                         "fjt-dlq redrive round-trip")
+    ap.add_argument("--recovery-records", type=int, default=24_000,
+                    help="records the recovery drill streams")
+    ap.add_argument("--recovery-kills", type=int, default=2,
+                    help="parent-driven SIGKILLs during the drill")
+    ap.add_argument("--no-hard-poison", action="store_true",
+                    help="skip the crash-loop (process-killing) poison "
+                         "record — the drill's slowest phase")
     return ap
 
 
@@ -2099,6 +2527,25 @@ def main() -> None:
         except AssertionError as e:
             print(json.dumps({
                 "metric": "overload_drill", "ok": False, "error": str(e),
+            }))
+            sys.exit(1)
+        print(json.dumps(line))
+        return
+
+    if args.recovery_drill:
+        # delivery-correctness drill, not a perf capture: the workers
+        # are forced-CPU subprocesses (restart storms against an
+        # exclusive-access tunneled chip would drill the tunnel, not
+        # the runtime)
+        try:
+            line = run_recovery_drill(
+                records=args.recovery_records,
+                kills=args.recovery_kills,
+                hard_poison=not args.no_hard_poison,
+            )
+        except AssertionError as e:
+            print(json.dumps({
+                "metric": "recovery_drill", "ok": False, "error": str(e),
             }))
             sys.exit(1)
         print(json.dumps(line))

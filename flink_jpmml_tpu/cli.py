@@ -55,6 +55,7 @@ from __future__ import annotations
 import argparse
 import csv
 import json
+import os
 import sys
 from typing import Any, Dict, Iterator, List, Optional, TextIO
 
@@ -887,6 +888,190 @@ def drift_main(argv: Optional[List[str]] = None) -> int:
             s = "-" if score is None else f"{score:.4f}"
             print(f"  {feat:<20} psi {s:>9}  {verdict}")
     return rc
+
+
+def _dlq_open(directory: str):
+    """Accept either the DLQ directory itself or the checkpoint
+    directory it sits beside (``<ckpt>/dlq`` — the pipelines' default
+    layout)."""
+    import glob as _glob
+
+    from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+
+    d = directory
+    if not _glob.glob(os.path.join(d, "dlq-*.jsonl")):
+        nested = os.path.join(d, "dlq")
+        if _glob.glob(os.path.join(nested, "dlq-*.jsonl")):
+            d = nested
+        elif not os.path.isdir(d) and os.path.isdir(nested):
+            d = nested
+    if not os.path.isdir(d):
+        raise SystemExit(f"no DLQ at {directory!r}")
+    return DeadLetterQueue(d)
+
+
+def _dlq_payload_preview(env: dict) -> str:
+    from flink_jpmml_tpu.runtime.dlq import payload_bytes
+
+    raw = payload_bytes(env)
+    head = raw[:64]
+    lines = [f"payload: {len(raw)} bytes, hex {head.hex()}"
+             + ("…" if len(raw) > 64 else "")]
+    try:
+        lines.append(f"as text: {raw.decode('utf-8')!r}")
+    except UnicodeDecodeError:
+        pass
+    if len(raw) % 4 == 0 and raw:
+        import numpy as _np
+
+        vals = _np.frombuffer(raw, _np.float32)
+        if vals.size <= 64:
+            lines.append(f"as f32 row: {vals.tolist()}")
+    return "\n".join(lines)
+
+
+def dlq_main(argv: Optional[List[str]] = None) -> int:
+    """``fjt-dlq``: inspect and redrive the dead-letter queue
+    (runtime/dlq.py) from the shell — no jax import, safe on any host.
+
+        fjt-dlq list /data/ckpt              # table of quarantined records
+        fjt-dlq inspect /data/ckpt --offset 1374
+        fjt-dlq redrive /data/ckpt --host b1 --port 9092 --topic records
+
+    ``redrive`` produces the quarantined payload bytes back INTO the
+    topic (Kafka Produce), so a corrected pipeline re-scores them
+    through the live consume path — the quarantine lifecycle's exit.
+    Envelopes stay in place after a redrive (the DLQ is an append-only
+    audit trail); re-running redrive re-produces them."""
+    ap = argparse.ArgumentParser(
+        prog="fjt-dlq",
+        description="List, inspect, and redrive dead-letter records.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ap_list = sub.add_parser("list", help="one line per envelope")
+    ap_list.add_argument("dir")
+    ap_list.add_argument("--limit", type=int, default=64,
+                         help="newest N envelopes (default 64; 0 = all)")
+    ap_ins = sub.add_parser("inspect", help="full envelope + payload")
+    ap_ins.add_argument("dir")
+    g = ap_ins.add_mutually_exclusive_group(required=True)
+    g.add_argument("--offset", type=int, default=None)
+    g.add_argument("--index", type=int, default=None,
+                   help="0-based position in scan order")
+    ap_re = sub.add_parser(
+        "redrive",
+        help="produce quarantined payloads back into a Kafka topic",
+    )
+    ap_re.add_argument("dir")
+    ap_re.add_argument("--host", required=True)
+    ap_re.add_argument("--port", type=int, required=True)
+    ap_re.add_argument("--topic", required=True)
+    ap_re.add_argument("--partition", type=int, default=None,
+                       help="target partition (default: the envelope's "
+                            "own, else 0)")
+    ap_re.add_argument("--offset", type=int, action="append",
+                       default=None,
+                       help="redrive only these quarantined offsets "
+                            "(repeatable; default: every envelope)")
+    ap_re.add_argument("--reason", default=None,
+                       help="redrive only envelopes with this reason "
+                            "(score / decode / crash_loop)")
+    args = ap.parse_args(argv)
+
+    q = _dlq_open(args.dir)
+    envs = list(q.scan())
+
+    if args.cmd == "list":
+        if not envs:
+            print(f"DLQ empty at {q.directory}", file=sys.stderr)
+            return 0
+        shown = envs if args.limit <= 0 else envs[-args.limit:]
+        print(f"{'OFFSET':>10} {'PART':>4} {'REASON':<10} {'ATT':>3} "
+              f"{'FINGERPRINT':<16} EXCEPTION")
+        for e in shown:
+            exc = (e.get("exception") or "-").splitlines()[0]
+            part = e.get("partition")
+            print(f"{e.get('offset', '?'):>10} "
+                  f"{'-' if part is None else part:>4} "
+                  f"{e.get('reason', '?'):<10} "
+                  f"{e.get('attempts', 1):>3} "
+                  f"{e.get('fingerprint', '?'):<16} {exc[:80]}")
+        print(f"{len(envs)} envelope(s) in {q.directory}",
+              file=sys.stderr)
+        return 0
+
+    if args.cmd == "inspect":
+        if args.index is not None:
+            if not (0 <= args.index < len(envs)):
+                raise SystemExit(
+                    f"index {args.index} out of range (have {len(envs)})"
+                )
+            picked = [envs[args.index]]
+        else:
+            picked = [
+                e for e in envs if e.get("offset") == args.offset
+            ]
+            if not picked:
+                raise SystemExit(
+                    f"no envelope with offset {args.offset}"
+                )
+        for e in picked:
+            print(json.dumps(e, indent=2, sort_keys=True))
+            print(_dlq_payload_preview(e))
+        return 0
+
+    # redrive
+    from flink_jpmml_tpu.runtime.dlq import payload_bytes
+    from flink_jpmml_tpu.runtime.kafka import (
+        KafkaClient, KafkaProtocolError,
+    )
+
+    picked = envs
+    if args.offset is not None:
+        want = set(args.offset)
+        picked = [e for e in picked if e.get("offset") in want]
+    if args.reason is not None:
+        picked = [e for e in picked if e.get("reason") == args.reason]
+    # one produce per envelope at most once per fingerprint: replays
+    # across restarts can leave duplicate envelopes for the same record
+    seen: set = set()
+    unique = []
+    for e in picked:
+        key = (e.get("fingerprint"), e.get("offset"))
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(e)
+    if not unique:
+        raise SystemExit("nothing to redrive (filters matched nothing)")
+    client = KafkaClient(args.host, args.port, client_id="fjt-dlq")
+    count = 0
+    try:
+        for e in unique:
+            part = args.partition
+            if part is None:
+                part = e.get("partition")
+            if part is None:
+                part = 0
+            try:
+                base = client.produce(
+                    args.topic, int(part), [payload_bytes(e)]
+                )
+            except (OSError, ConnectionError, KafkaProtocolError) as ex:
+                raise SystemExit(
+                    f"redrive failed at offset {e.get('offset')}: {ex} "
+                    f"({count} redriven before the failure)"
+                )
+            count += 1
+            print(
+                f"redrove offset {e.get('offset')} "
+                f"({e.get('reason')}, {e.get('fingerprint')}) -> "
+                f"{args.topic}[{part}]@{base}"
+            )
+    finally:
+        client.close()
+    print(f"{count} record(s) redriven", file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":

@@ -31,11 +31,21 @@ from flink_jpmml_tpu.obs import attr as attr_mod
 from flink_jpmml_tpu.obs import drift as drift_mod
 from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import pressure as pressure_mod
+from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
+from flink_jpmml_tpu.runtime.dlq import (
+    REASON_CRASH_LOOP,
+    REASON_SCORE,
+    CrashFingerprint,
+    PoisonIsolationOverflow,
+    dlq_for_checkpoint,
+    env_count,
+)
 from flink_jpmml_tpu.runtime.pipeline import (
     OverlappedDispatcher,
+    _block_ready,
     _prefetch_host,  # noqa: F401  (re-export: engine.py imports it here)
     dispatch_quantized,
     filter_donate_warning,
@@ -294,6 +304,7 @@ class BlockPipelineBase:
         batcher=None,
         admission=None,
         shed_lane: str = "block",
+        dlq=None,
     ):
         self._source = source
         self._sink = sink
@@ -345,10 +356,12 @@ class BlockPipelineBase:
         # warning per compile, so tests stay quiet by default)
         self._donate = donate
         self._donation_hits = self.metrics.counter("donation_hits")
-        # one drained-but-undispatched batch carried across loop
+        # drained-but-undispatched batches carried across loop
         # iterations (aggregation stops at an offset discontinuity —
-        # a cycling source's wrap — and the chunk cannot be re-queued)
-        self._carry_drain: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # a cycling source's wrap — and a chunk cannot be re-queued;
+        # the poison plane additionally splits mid-batch gaps, which
+        # can queue a second carry, hence a deque)
+        self._carry_drain: "List[Tuple[np.ndarray, np.ndarray]]" = []
         # see engine.Pipeline: True only for run_until_exhausted's full
         # drain; plain stop() discards the uncommitted ring backlog so it
         # returns promptly under a flooding source
@@ -360,13 +373,44 @@ class BlockPipelineBase:
         self._ckpt = CheckpointPolicy(
             checkpoint, self._config.checkpoint_interval_s
         )
+        # -- delivery-correctness plane (runtime/dlq.py) ------------------
+        # The DLQ defaults to living BESIDE the checkpoints: record-level
+        # error isolation only makes sense when the quarantine survives
+        # the restarts it exists to prevent. dlq=None with no checkpoint
+        # keeps the historical behavior exactly (a scoring error kills
+        # the worker).
+        self._dlq = dlq if dlq is not None else dlq_for_checkpoint(
+            checkpoint, metrics=self.metrics
+        )
+        ckpt_dir = getattr(checkpoint, "directory", None)
+        self._fingerprint = (
+            CrashFingerprint(ckpt_dir)
+            if (ckpt_dir is not None and self._dlq is not None) else None
+        )
+        # highest offset ever handed to a dispatch (+n): checkpointed as
+        # inflight_hi so a restart knows the at-least-once replay region
+        self._dispatched_hi = 0
+        # replay accounting + crash-loop suspect mode, armed by restore()
+        self._replay_until = 0
+        self._suspect_until: Optional[int] = None
+        self._death_marker: Optional[dict] = None
+        # 1 while scoring in suspect mode (fleet merge: worst-of — one
+        # worker bisecting poison flags the fleet)
+        self._suspect_gauge = self.metrics.gauge("poison_suspect_mode")
 
     @property
     def native(self) -> bool:
         return not isinstance(self._ring, _PyRing)
 
     def _ckpt_state(self) -> dict:
-        state = {"source_offset": self.committed_offset}
+        state = {
+            "source_offset": self.committed_offset,
+            # the in-flight offset range's upper bound: on restore,
+            # [source_offset, inflight_hi) is exactly the at-least-once
+            # replay region — what records_replayed counts and what a
+            # crash-loop fingerprint resumes in suspect mode
+            "inflight_hi": max(self._dispatched_hi, self.committed_offset),
+        }
         # sources whose resume needs more than the scalar offset (e.g.
         # multi-partition Kafka's per-partition cursor vector) embed it
         # via the checkpoint_state/restore_state hooks
@@ -385,6 +429,11 @@ class BlockPipelineBase:
         boundary below the scalar commit (at-least-once replay)."""
         state = self._ckpt.restore_latest()
         if state is None:
+            # no snapshot yet — but the crash-loop fingerprint must
+            # still count this restore: a poison record in the FIRST
+            # uncommitted window crash-loops at offset 0 before any
+            # checkpoint ever lands
+            self._init_poison_state({})
             return False
         off = int(state.get("source_offset", 0))
         sstate = state.get("source_state")
@@ -394,8 +443,48 @@ class BlockPipelineBase:
         else:
             self._source.seek(off)
         self.committed_offset = off
+        self._init_poison_state(state)
         self._restore_extra(state)
         return True
+
+    def _init_poison_state(self, state: dict) -> None:
+        """Crash-loop fingerprinting at restore: count consecutive
+        restores stuck at the same committed offset (``crashes.json``
+        beside the checkpoints) and read the supervisor's
+        ``FJT_RESTART_STREAK`` hint — EITHER crossing
+        ``FJT_POISON_RESTARTS`` flips the checkpoint's in-flight range
+        into suspect mode, converting a crash loop into a DLQ entry
+        instead of an ``on_give_up`` outage."""
+        self._replay_until = max(
+            int(state.get("inflight_hi", 0)), self.committed_offset
+        )
+        if self._fingerprint is None:
+            return
+        committed = self.committed_offset
+        count = self._fingerprint.note_restore(committed)
+        streak = env_count("FJT_RESTART_STREAK", 0)
+        self._death_marker = self._fingerprint.read_marker()
+        if (
+            self._death_marker is not None
+            and self._death_marker["hi"] <= committed
+        ):
+            # marker from a range that later committed: stale
+            self._death_marker = None
+            self._fingerprint.clear_marker()
+        threshold = env_count("FJT_POISON_RESTARTS", 3)
+        if max(count - 1, streak) >= threshold:
+            # count-1: the FIRST restore at an offset is a normal
+            # restart, not yet a loop
+            hi = self._replay_until
+            if hi <= committed:
+                hi = committed + self._batch_size
+            self._suspect_until = hi
+            self._suspect_gauge.set(1.0)
+            flight.record(
+                "poison_suspect_mode", lo=committed, hi=hi,
+                restarts=max(count - 1, streak),
+                marker=self._death_marker,
+            )
 
     def _restore_extra(self, state: dict) -> None:
         pass
@@ -516,8 +605,8 @@ class BlockPipelineBase:
                 # across the gap would break the one-dispatch ==
                 # contiguous-commit-range invariant — carry the drained
                 # chunk to the NEXT loop iteration as its own dispatch
-                self._carry_drain = (
-                    np.array(X2, copy=True), np.array(off2, copy=True)
+                self._carry_drain.append(
+                    (np.array(X2, copy=True), np.array(off2, copy=True))
                 )
                 break
             parts.append(np.array(X2, copy=True))
@@ -605,6 +694,172 @@ class BlockPipelineBase:
             Xb = np.array(Xb, copy=True)
         return model.predict(Xb, Mb)  # async dispatch
 
+    # -- poison isolation (runtime/dlq.py) ---------------------------------
+
+    def _dispatch_checked(self, handle, X, n, offsets):
+        """The one dispatch entry carrying the batch's offsets past the
+        fault harness: ``poison_record`` / offset-targeted
+        ``worker_crash`` faults match against exactly the range being
+        scored, so bisection isolates an injected poison the same way
+        it isolates a real one."""
+        faults.fire("score_batch", offsets=offsets)
+        return self._dispatch(handle, X, n)
+
+    def _on_dispatch_error(self, out, meta, error) -> bool:
+        """OverlappedDispatcher error hook: a fetch-side scoring
+        exception enters suspect mode for that batch instead of killing
+        the worker. → False (re-raise) when no DLQ is wired or the
+        entry carries no retained batch (shed no-ops)."""
+        if self._dlq is None or meta is None or len(meta) < 7:
+            return False
+        n, first_off, t_start, shed, handle, X, offsets = meta
+        if shed or X is None or offsets is None:
+            return False
+        self._suspect_scan(handle, X, offsets, error=error)
+        return True
+
+    def _suspect_scan(
+        self, handle, X, offsets, error, persist: bool = False
+    ) -> None:
+        """Bisection ("suspect mode") over one failed batch: dispatch
+        halves synchronously until the offending record(s) are single —
+        those go to the DLQ (never the sink); every clean run proceeds
+        to the sink in offset order. The whole range then commits, so a
+        restart never replays the quarantined record back to life.
+
+        ``persist=True`` (crash-loop fingerprint mode) additionally
+        writes the suspect MARKER before every sub-dispatch: a record
+        that kills the process outright narrows the marker by one
+        bisection level per incarnation, and a single-record marker is
+        quarantined WITHOUT being dispatched at all.
+
+        More than ``FJT_DLQ_MAX_PER_BATCH`` quarantines in one batch
+        aborts isolation (:class:`PoisonIsolationOverflow`): that is a
+        model-level failure, not poison."""
+        n = int(X.shape[0])
+        if n == 0:
+            return
+        freshness = fresh_mod.freshness_for(self.metrics)
+        records_out = self.metrics.counter("records_out")
+        cap = env_count("FJT_DLQ_MAX_PER_BATCH", 32)
+        state = {"q": 0}
+        flight.record(
+            "poison_isolation",
+            first=int(offsets[0]), n=n, persist=persist,
+            error=None if error is None else repr(error),
+        )
+        self._suspect_gauge.set(1.0)
+
+        def quarantine(i: int, exc, reason=REASON_SCORE, attempts=1):
+            if state["q"] >= cap:
+                raise PoisonIsolationOverflow(
+                    state["q"], exc if exc is not None else error
+                )
+            state["q"] += 1
+            off = int(offsets[i])
+            self._dlq.quarantine(
+                X[i].tobytes(), offset=off, reason=reason, error=exc,
+                attempts=attempts, model=getattr(handle, "key", None),
+            )
+            if freshness is not None:
+                # a quarantined record was DROPPED, not delivered: its
+                # ingest stamp must not advance the sink watermark or
+                # the staleness books (the PR 8 shed contract)
+                freshness.discard_stamps(off, 1)
+
+        def emit_run(out, decode, lo: int, hi: int):
+            n_run = hi - lo
+            first = int(offsets[lo])
+            self._emit(out, n_run, first, decode)
+            records_out.inc(n_run)
+            if freshness is not None:
+                freshness.observe_sink(first, n_run)
+
+        def scan(lo: int, hi: int):
+            if hi <= lo:
+                return
+            n_sub = hi - lo
+            off_lo, off_hi = int(offsets[lo]), int(offsets[hi - 1]) + 1
+            dm = self._death_marker if persist else None
+            if dm is not None and off_lo <= dm["lo"] and dm["hi"] <= off_hi:
+                # a previous incarnation DIED dispatching dm's range
+                if dm["hi"] - dm["lo"] == 1:
+                    hit = np.nonzero(
+                        offsets[lo:hi] == np.uint64(dm["lo"])
+                    )[0]
+                    if hit.size:
+                        i = lo + int(hit[0])
+                        scan(lo, i)
+                        quarantine(
+                            i, None, reason=REASON_CRASH_LOOP,
+                            attempts=dm.get("attempts", 1),
+                        )
+                        self._death_marker = None
+                        self._fingerprint.clear_marker()
+                        scan(i + 1, hi)
+                        return
+                elif n_sub > 1:
+                    # never re-dispatch a span that already killed a
+                    # process whole: split first (one narrowing per
+                    # death bounds convergence at log2(batch) restarts)
+                    mid = (lo + hi) // 2
+                    scan(lo, mid)
+                    scan(mid, hi)
+                    return
+            if persist and self._fingerprint is not None:
+                attempts = 1
+                if (
+                    dm is not None
+                    and dm["lo"] == off_lo and dm["hi"] == off_hi
+                ):
+                    attempts = dm.get("attempts", 1) + 1
+                self._fingerprint.write_marker(off_lo, off_hi, attempts)
+            try:
+                out, decode = self._dispatch_checked(
+                    handle, X[lo:hi], n_sub, offsets[lo:hi]
+                )
+                _block_ready(out)
+            except PoisonIsolationOverflow:
+                raise
+            except Exception as e:
+                if n_sub == 1:
+                    quarantine(lo, e)
+                    return
+                mid = (lo + hi) // 2
+                scan(lo, mid)
+                scan(mid, hi)
+                return
+            emit_run(out, decode, lo, hi)
+
+        try:
+            scan(0, n)
+        finally:
+            self._suspect_gauge.set(
+                1.0 if self._suspect_until is not None else 0.0
+            )
+        if persist and self._fingerprint is not None:
+            self._fingerprint.clear_marker()
+            self._death_marker = None
+        # the WHOLE range commits — quarantined offsets included, so a
+        # restart cannot replay a parked poison record back to life
+        self.committed_offset = int(offsets[-1]) + 1
+        if state["q"]:
+            flight.record(
+                "poison_isolated", quarantined=state["q"],
+                first=int(offsets[0]), n=n,
+            )
+        self._ckpt.maybe_save(self._ckpt_state)
+
+    def _exit_suspect_mode(self) -> None:
+        flight.record(
+            "poison_suspect_exit", committed=self.committed_offset
+        )
+        self._suspect_until = None
+        self._death_marker = None
+        if self._fingerprint is not None:
+            self._fingerprint.clear_marker()
+        self._suspect_gauge.set(0.0)
+
     # -- internals ---------------------------------------------------------
 
     def _ingest(self) -> None:
@@ -654,6 +909,8 @@ class BlockPipelineBase:
         ring_occ = self.metrics.gauge("ring_occupancy")
         ring_cap = float(max(self._config.batch.queue_capacity, 1))
 
+        replayed = self.metrics.counter("records_replayed")
+
         def _complete(pair, meta):
             """FIFO completion off the dispatcher: sink, then commit —
             offsets only advance past records that reached the sink.
@@ -661,7 +918,12 @@ class BlockPipelineBase:
             FIFO window) commits its offsets and consumes its freshness
             stamps without ever touching the sink — the drop is
             explicit, bounded, and replay-consistent."""
-            n, first_off, t_start, shed = meta
+            n, first_off, t_start, shed = meta[:4]
+            if first_off < self._replay_until:
+                # at-least-once replay accounting: records below the
+                # previous incarnation's in-flight high-water mark are
+                # re-deliveries, not new progress
+                replayed.inc(min(n, self._replay_until - first_off))
             if shed:
                 self.committed_offset = first_off + n
                 if freshness is not None:
@@ -714,6 +976,11 @@ class BlockPipelineBase:
             depth=self._in_flight_max if self._in_flight_max > 1 else 0,
             metrics=self.metrics,
             complete=_complete,
+            # record-level poison isolation: a scoring exception runs
+            # the suspect-mode bisection instead of killing the worker
+            # (only when a DLQ is wired — without one the historical
+            # fail-fast behavior is unchanged)
+            on_error=self._on_dispatch_error,
         )
 
         try:
@@ -739,9 +1006,8 @@ class BlockPipelineBase:
                     monitor.note_ring(
                         min(len(self._ring) / ring_cap, 1.0)
                     )
-                if self._carry_drain is not None:
-                    X, offsets = self._carry_drain
-                    self._carry_drain = None
+                if self._carry_drain:
+                    X, offsets = self._carry_drain.pop(0)
                 else:
                     X, offsets = self._ring.drain(
                         batch_cfg.deadline_us, idle_us
@@ -768,6 +1034,24 @@ class BlockPipelineBase:
                     disp.flush()
                     self._on_idle()
                     continue
+                if self._dlq is not None and n > 1:
+                    # the delivery-correctness plane needs exact
+                    # (first_off, n) sink labeling and commits, but a
+                    # decode-quarantined record leaves an offset GAP
+                    # that the ring can stitch into one drained batch
+                    # (run tail + next run): split at the first break
+                    # and carry the remainder as its own dispatch
+                    brk = np.nonzero(
+                        np.diff(offsets.astype(np.int64)) != 1
+                    )[0]
+                    if brk.size:
+                        cut = int(brk[0]) + 1
+                        self._carry_drain.insert(0, (
+                            np.array(X[cut:], copy=True),
+                            np.array(offsets[cut:], copy=True),
+                        ))
+                        X, offsets = X[:cut], offsets[:cut]
+                        n = cut
                 if self._admission is not None:
                     self._admission.maybe_tick()
                     if not self._admission.admit(self._shed_lane, n):
@@ -783,7 +1067,7 @@ class BlockPipelineBase:
                             lambda: None,
                             meta=(
                                 n, int(offsets[0]) if n else 0,
-                                time.monotonic(), True,
+                                time.monotonic(), True, None, None, None,
                             ),
                             accounted=False,
                         )
@@ -794,6 +1078,34 @@ class BlockPipelineBase:
                     # records replay from the committed offset on restore
                     disp.abandon()
                     return
+                if self._dlq is not None:
+                    # isolation needs the RAW batch retained past the
+                    # async dispatch (the drained views alias the ring's
+                    # reuse buffer): one private copy per batch, paid
+                    # only when a DLQ is wired
+                    X = np.array(X, copy=True)
+                    offsets = np.array(offsets, copy=True)
+                first_off = int(offsets[0]) if n else 0
+                self._dispatched_hi = max(self._dispatched_hi, first_off + n)
+                if (
+                    self._suspect_until is not None
+                    and first_off < self._suspect_until
+                ):
+                    # crash-loop fingerprint: this range killed previous
+                    # incarnations — score it synchronously under
+                    # persisted suspect markers so a process-killing
+                    # record converges to a DLQ entry across restarts.
+                    # Flush first: the marker protocol and the FIFO
+                    # commit contract both need nothing else in flight.
+                    disp.flush()
+                    self._suspect_scan(
+                        handle, X, offsets, error=None, persist=True
+                    )
+                    if self.committed_offset >= self._suspect_until:
+                        self._exit_suspect_mode()
+                    batches.inc()
+                    fill.inc(n)
+                    continue
                 if freshness is not None:
                     # stage-boundary watermark propagation: the batch
                     # crossing ring→device advances the dispatch-stage
@@ -807,18 +1119,41 @@ class BlockPipelineBase:
                         "dispatch", int(offsets[0]) if n else None, n
                     )
                 t_start = time.monotonic()
-                disp.launch(
-                    lambda h=handle, X=X, n=n: self._dispatch(h, X, n),
-                    meta=(n, int(offsets[0]) if n else 0, t_start, False),
-                    # opts this launch into the sampled device-timing
-                    # pool (rate-limited; obs/profiler.py) — the live
-                    # MFU/membw gauges and the kernel cost ledger;
-                    # skipped entirely when profiling is off
-                    profile=(
-                        attr_mod.dispatch_profile(handle, n)
-                        if disp.profiling else None
-                    ),
-                )
+                try:
+                    disp.launch(
+                        lambda h=handle, X=X, n=n, o=offsets: (
+                            self._dispatch_checked(h, X, n, o)
+                        ),
+                        meta=(
+                            n, first_off, t_start, False,
+                            handle, X if self._dlq is not None else None,
+                            offsets if self._dlq is not None else None,
+                        ),
+                        # opts this launch into the sampled device-timing
+                        # pool (rate-limited; obs/profiler.py) — the live
+                        # MFU/membw gauges and the kernel cost ledger;
+                        # skipped entirely when profiling is off
+                        profile=(
+                            attr_mod.dispatch_profile(handle, n)
+                            if disp.profiling else None
+                        ),
+                    )
+                except PoisonIsolationOverflow:
+                    raise  # isolation already abandoned: die honestly
+                except Exception as e:
+                    # the dispatch itself raised (host featurize, an
+                    # injected poison, a device rejection at trace
+                    # time): with a DLQ wired, isolate in place —
+                    # errors from OLDER window entries were already
+                    # handled (or re-raised) inside launch's trim via
+                    # on_error, so this exception belongs to THIS batch
+                    if self._dlq is None:
+                        raise
+                    # older in-flight batches must commit BEFORE this
+                    # one's synchronous isolation commits its range, or
+                    # committed_offset would regress (FIFO contract)
+                    disp.flush()
+                    self._suspect_scan(handle, X, offsets, error=e)
                 batches.inc()
                 fill.inc(n)
             disp.close()  # drain the window: every dispatched batch sinks
@@ -859,6 +1194,7 @@ class BlockPipeline(BlockPipelineBase):
         batcher=None,
         admission=None,
         shed_lane: str = "block",
+        dlq=None,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -881,6 +1217,7 @@ class BlockPipeline(BlockPipelineBase):
             batcher=batcher,
             admission=admission,
             shed_lane=shed_lane,
+            dlq=dlq,
         )
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend

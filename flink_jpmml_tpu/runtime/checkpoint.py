@@ -74,6 +74,13 @@ class CheckpointManager:
         self._keep = keep
         os.makedirs(directory, exist_ok=True)
 
+    @property
+    def directory(self) -> str:
+        """Public so siblings can colocate durable state with the
+        resume state it protects: the pipelines' default DLQ and the
+        crash-loop fingerprint files live under here (runtime/dlq.py)."""
+        return self._dir
+
     def save(self, state: Dict[str, Any]) -> str:
         """Write one snapshot crash-safely, retrying transient failures.
 

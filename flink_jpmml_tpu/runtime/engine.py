@@ -30,12 +30,23 @@ import numpy as np
 from flink_jpmml_tpu.compile import prepare
 from flink_jpmml_tpu.compile.compiler import CompiledModel
 from flink_jpmml_tpu.models.prediction import Prediction
+from flink_jpmml_tpu.obs import freshness as fresh_mod
+from flink_jpmml_tpu.obs import pressure as pressure_mod
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime.checkpoint import (
     CheckpointManager,
     CheckpointPolicy,
 )
-from flink_jpmml_tpu.obs import freshness as fresh_mod
-from flink_jpmml_tpu.obs import pressure as pressure_mod
+from flink_jpmml_tpu.runtime.dlq import (
+    REASON_CRASH_LOOP,
+    REASON_SCORE,
+    CrashFingerprint,
+    PoisonIsolationOverflow,
+    dlq_for_checkpoint,
+    env_count,
+    serialize_record,
+)
 from flink_jpmml_tpu.runtime.queues import BoundedQueue, Closed
 from flink_jpmml_tpu.runtime.sinks import Sink
 from flink_jpmml_tpu.runtime.sources import Source, batch_event_range
@@ -157,6 +168,7 @@ class Pipeline:
         metrics: Optional[MetricsRegistry] = None,
         checkpoint: Optional[CheckpointManager] = None,
         in_flight: int = 2,
+        dlq=None,
     ):
         self._source = source
         self._scorer = scorer
@@ -169,6 +181,24 @@ class Pipeline:
         self._ckpt = CheckpointPolicy(
             checkpoint, self._config.checkpoint_interval_s
         )
+        # delivery-correctness plane (runtime/dlq.py): record-level
+        # error isolation — a scoring exception bisects the micro-batch
+        # and quarantines the offending record(s) instead of killing
+        # the worker. Defaults to a DLQ beside the checkpoints; without
+        # durable state the historical fail-fast behavior is unchanged.
+        self._dlq = dlq if dlq is not None else dlq_for_checkpoint(
+            checkpoint, metrics=self.metrics
+        )
+        ckpt_dir = getattr(checkpoint, "directory", None)
+        self._fingerprint = (
+            CrashFingerprint(ckpt_dir)
+            if (ckpt_dir is not None and self._dlq is not None) else None
+        )
+        self._dispatched_hi = 0
+        self._replay_until = 0
+        self._suspect_until: Optional[int] = None
+        self._death_marker: Optional[dict] = None
+        self._suspect_gauge = self.metrics.gauge("poison_suspect_mode")
         self._in_flight_max = max(1, in_flight)
         self._queue = BoundedQueue(self._config.batch.queue_capacity)
         self._stop = threading.Event()
@@ -187,6 +217,10 @@ class Pipeline:
     def _ckpt_state(self) -> dict:
         state = {
             "source_offset": self._committed_offset,
+            # the at-least-once replay region's upper bound (offsets of
+            # records handed to submit but not yet committed): restore
+            # reads it for replay accounting + crash-loop suspect mode
+            "inflight_hi": max(self._dispatched_hi, self._committed_offset),
             "scorer": self._scorer.state(),
         }
         # cf. BlockPipelineBase._ckpt_state: vector-resume sources embed
@@ -204,6 +238,10 @@ class Pipeline:
         """Resume from the latest checkpoint, if any (capability C7)."""
         state = self._ckpt.restore_latest()
         if state is None:
+            # no snapshot yet: still count the restore — a poison
+            # record in the first uncommitted window crash-loops at
+            # offset 0 before any checkpoint lands (cf. block.py)
+            self._init_poison_state({})
             return False
         off = int(state.get("source_offset", 0))
         sstate = state.get("source_state")
@@ -214,7 +252,48 @@ class Pipeline:
             self._source.seek(off)
         self._committed_offset = off
         self._scorer.restore(state.get("scorer", {}))
+        self._init_poison_state(state)
         return True
+
+    def _init_poison_state(self, state: dict) -> None:
+        """Crash-loop fingerprinting (the block pipeline's protocol,
+        record-path flavor): either the worker-local restore counter
+        (crashes.json) or the supervisor's ``FJT_RESTART_STREAK``
+        crossing ``FJT_POISON_RESTARTS`` resumes the checkpoint's
+        in-flight range in suspect mode — one record per dispatch under
+        persisted markers, so a process-killing record converges to a
+        DLQ entry instead of an on_give_up outage."""
+        self._replay_until = max(
+            int(state.get("inflight_hi", 0)), self._committed_offset
+        )
+        if self._fingerprint is None:
+            return
+        committed = self._committed_offset
+        count = self._fingerprint.note_restore(committed)
+        streak = env_count("FJT_RESTART_STREAK", 0)
+        # markers live in the RECORD-offset domain (stamp − 1): record
+        # r is committed once committed ≥ r+1, so a marker is stale
+        # exactly when hi ≤ committed — the first uncommitted record's
+        # marker (hi == committed+1) must survive, it IS the suspect
+        self._death_marker = self._fingerprint.read_marker()
+        if (
+            self._death_marker is not None
+            and self._death_marker["hi"] <= committed
+        ):
+            self._death_marker = None
+            self._fingerprint.clear_marker()
+        threshold = env_count("FJT_POISON_RESTARTS", 3)
+        if max(count - 1, streak) >= threshold:
+            hi = self._replay_until
+            if hi <= committed:
+                hi = committed + self._config.batch.size
+            self._suspect_until = hi
+            self._suspect_gauge.set(1.0)
+            flight.record(
+                "poison_suspect_mode", lo=committed, hi=hi,
+                restarts=max(count - 1, streak),
+                marker=self._death_marker,
+            )
 
     def start(self) -> "Pipeline":
         self._ingest_thread = threading.Thread(
@@ -265,6 +344,149 @@ class Pipeline:
     @property
     def committed_offset(self) -> int:
         return self._committed_offset
+
+    # -- poison isolation (runtime/dlq.py) ---------------------------------
+
+    @staticmethod
+    def _record_off(s: "_Stamped") -> int:
+        """A stamp's offset is the RESUME point — one past the record
+        (sources emit ``(consumed_count, rec)``). Fault targeting and
+        DLQ envelopes use the record's own offset, so ``offset=K``
+        means the same record on this path as on the block path, and
+        a score-quarantined record files under the same offset its
+        decode-quarantined twin would."""
+        return s.offset - 1
+
+    def _score_seq(self, seq: List["_Stamped"]) -> List[Any]:
+        """Synchronous submit+finish of a sub-batch (the isolation
+        paths' dispatch primitive), with the fault hook carrying the
+        sub-range's record offsets."""
+        faults.fire(
+            "score_batch", offsets=[self._record_off(s) for s in seq]
+        )
+        ticket = self._scorer.submit([s.record for s in seq])
+        return self._scorer.finish(ticket)
+
+    def _deliver_seq(self, seq, outputs) -> None:
+        self._sink.emit(outputs)
+        self.metrics.counter("records_out").inc(len(seq))
+        event_time_fn = getattr(self._source, "event_time_fn", None)
+        if event_time_fn is not None:
+            freshness = fresh_mod.freshness_for(self.metrics)
+            tr = batch_event_range(
+                [s.record for s in seq], event_time_fn
+            )
+            if tr is not None:
+                # only DELIVERED records advance the watermark/staleness
+                # books — quarantined ones never reach this path
+                freshness.observe_batch(tr[0], tr[1])
+
+    def _quarantine_stamped(
+        self, s: "_Stamped", exc, state: dict,
+        reason: str = REASON_SCORE, attempts: int = 1,
+        original=None,
+    ) -> None:
+        cap = env_count("FJT_DLQ_MAX_PER_BATCH", 32)
+        if state["q"] >= cap:
+            raise PoisonIsolationOverflow(
+                state["q"], exc if exc is not None else original
+            )
+        state["q"] += 1
+        self._dlq.quarantine(
+            serialize_record(s.record), offset=self._record_off(s),
+            reason=reason, error=exc, attempts=attempts,
+        )
+
+    def _isolate(self, stamped: List["_Stamped"], error) -> None:
+        """Bisection over one failed micro-batch: clean runs reach the
+        sink in order, single failing records go to the DLQ, the whole
+        range commits (a parked poison record never replays)."""
+        flight.record(
+            "poison_isolation", first=stamped[0].offset,
+            n=len(stamped), error=repr(error), persist=False,
+        )
+        self._suspect_gauge.set(1.0)
+        state = {"q": 0}
+
+        def scan(seq: List["_Stamped"]):
+            if not seq:
+                return
+            try:
+                outputs = self._score_seq(seq)
+            except PoisonIsolationOverflow:
+                raise
+            except Exception as e:
+                if len(seq) == 1:
+                    self._quarantine_stamped(
+                        seq[0], e, state, original=error
+                    )
+                    return
+                mid = len(seq) // 2
+                scan(seq[:mid])
+                scan(seq[mid:])
+                return
+            self._deliver_seq(seq, outputs)
+
+        try:
+            scan(stamped)
+        finally:
+            self._suspect_gauge.set(
+                1.0 if self._suspect_until is not None else 0.0
+            )
+        self._committed_offset = stamped[-1].offset
+        if state["q"]:
+            flight.record(
+                "poison_isolated", quarantined=state["q"],
+                first=stamped[0].offset, n=len(stamped),
+            )
+        self._ckpt.maybe_save(self._ckpt_state)
+
+    def _isolate_suspect(self, stamped: List["_Stamped"]) -> None:
+        """Fingerprint-triggered suspect mode: one record per dispatch,
+        marker written BEFORE each — a record that kills the process is
+        pre-quarantined by the next incarnation without ever being
+        dispatched again."""
+        state = {"q": 0}
+        for s in stamped:
+            r = self._record_off(s)
+            dm = self._death_marker
+            if (
+                dm is not None
+                and dm["lo"] == r and dm["hi"] == r + 1
+            ):
+                # the previous incarnation died dispatching exactly
+                # this record: quarantine it unscored
+                self._quarantine_stamped(
+                    s, None, state, reason=REASON_CRASH_LOOP,
+                    attempts=dm.get("attempts", 1),
+                )
+                self._death_marker = None
+                self._fingerprint.clear_marker()
+                continue
+            if self._fingerprint is not None:
+                self._fingerprint.write_marker(r, r + 1, attempts=1)
+            try:
+                outputs = self._score_seq([s])
+            except PoisonIsolationOverflow:
+                raise
+            except Exception as e:
+                self._quarantine_stamped(s, e, state)
+                continue
+            self._deliver_seq([s], outputs)
+        if self._fingerprint is not None:
+            self._fingerprint.clear_marker()
+        self._committed_offset = stamped[-1].offset
+        self._ckpt.maybe_save(self._ckpt_state)
+
+    def _exit_suspect_mode(self) -> None:
+        flight.record(
+            "poison_suspect_exit", committed=self._committed_offset
+        )
+        self._suspect_until = None
+        self._death_marker = None
+        if self._fingerprint is not None:
+            self._fingerprint.clear_marker()
+        self._suspect_gauge.set(0.0)
 
     # -- internals ---------------------------------------------------------
 
@@ -318,10 +540,24 @@ class Pipeline:
         monitor = pressure_mod.pressure_for(self.metrics)
         queue_occ = self.metrics.gauge("ring_occupancy")
 
+        replayed = self.metrics.counter("records_replayed")
+
         def _finish_one():
             ticket, stamped = in_flight.pop(0)
-            with stages.stage("readback"):
-                outputs = self._scorer.finish(ticket)
+            try:
+                with stages.stage("readback"):
+                    outputs = self._scorer.finish(ticket)
+            except PoisonIsolationOverflow:
+                raise
+            except Exception as e:
+                # record-level isolation: with a DLQ wired, bisect the
+                # micro-batch instead of killing the worker — entries
+                # ahead of this one already completed (FIFO), so the
+                # isolation's commits stay monotone
+                if self._dlq is None:
+                    raise
+                self._isolate(stamped, e)
+                return
             with stages.stage("sink"):
                 self._sink.emit(outputs)
             now = time.monotonic()
@@ -329,6 +565,10 @@ class Pipeline:
             for s in stamped[:: max(1, len(stamped) // 8)]:
                 lat.observe(now - s.t_enq)
             records_out.inc(len(stamped))
+            if stamped[0].offset <= self._replay_until:
+                replayed.inc(sum(
+                    1 for s in stamped if s.offset <= self._replay_until
+                ))
             self._committed_offset = stamped[-1].offset
             if freshness is not None and event_time_fn is not None:
                 tr = batch_event_range(
@@ -353,10 +593,50 @@ class Pipeline:
                 if not stamped:
                     continue
                 queue_occ.set(self._queue.occupancy())
-                with stages.stage("featurize_dispatch"):
-                    ticket = self._scorer.submit(
-                        [s.record for s in stamped]
-                    )
+                self._dispatched_hi = max(
+                    self._dispatched_hi, stamped[-1].offset
+                )
+                if (
+                    self._suspect_until is not None
+                    and stamped[0].offset <= self._suspect_until
+                ):
+                    # crash-loop fingerprint: the replay region is
+                    # scored one record per dispatch under persisted
+                    # markers (drain the window first — suspect commits
+                    # must not leapfrog in-flight batches)
+                    while in_flight:
+                        _finish_one()
+                    self._isolate_suspect(stamped)
+                    if self._committed_offset >= self._suspect_until:
+                        self._exit_suspect_mode()
+                    batches.inc()
+                    fill.inc(len(stamped))
+                    continue
+                try:
+                    with stages.stage("featurize_dispatch"):
+                        faults.fire(
+                            "score_batch",
+                            offsets=[
+                                self._record_off(s) for s in stamped
+                            ],
+                        )
+                        ticket = self._scorer.submit(
+                            [s.record for s in stamped]
+                        )
+                except PoisonIsolationOverflow:
+                    raise
+                except Exception as e:
+                    # the submit itself raised (featurize, routing, an
+                    # injected poison): older in-flight batches commit
+                    # first, then this one isolates in place
+                    if self._dlq is None:
+                        raise
+                    while in_flight:
+                        _finish_one()
+                    self._isolate(stamped, e)
+                    batches.inc()
+                    fill.inc(len(stamped))
+                    continue
                 in_flight.append((ticket, stamped))
                 batches.inc()
                 fill.inc(len(stamped))

@@ -19,6 +19,13 @@ kind               fires in (site)                            effect
                    (``CheckpointManager.save``)               the retry/backoff path
 ``worker_wedge``   the block score loop                       sleeps ``wedge_s`` per fire —
                                                               the heartbeat-wedge shape
+``poison_record``  per-batch scoring (``score_batch`` site,   raises ``InjectedPoisonRecord``
+                   carries the dispatched offsets)            when ``offset=``/``every=``
+                                                              matches → the record-isolation
+                                                              (suspect-mode bisection) path
+``worker_crash``   any site via ``site=`` (default            SIGKILLs the process — the
+                   ``score_loop``); ``offset=`` targets the   kill-anywhere recovery drill's
+                   batch containing that record               chaos primitive
 =================  =========================================  ===========================
 
 Two front doors:
@@ -61,14 +68,30 @@ from flink_jpmml_tpu.obs import recorder as flight
 _ENV = "FJT_FAULTS"
 _EVENT_MIN_PERIOD_S = 1.0
 
-# the sites the runtime actually hooks; a kind IS its site mapping
+# the sites the runtime actually hooks; a kind IS its DEFAULT site
+# mapping (worker_crash may override via its ``site=`` param — a kill
+# must land ANYWHERE: mid-fetch, mid-dispatch, mid-checkpoint)
 SITES = {
     "broker_death": "kafka_fetch",
     "slow_fetch": "kafka_fetch",
     "dispatch_delay": "dispatch",
     "checkpoint_fail": "checkpoint_write",
     "worker_wedge": "score_loop",
+    # per-batch scoring hook carrying the batch's offsets as context:
+    # an injected poison record raises exactly when its offset is in
+    # the dispatched range, so bisection isolates it like a real one
+    "poison_record": "score_batch",
+    # SIGKILL self at the chosen site — the kill-anywhere recovery
+    # drill's chaos primitive (no Python cleanup runs, like a real OOM
+    # kill); with ``offset=`` it fires only when that offset is in the
+    # batch, the shape of a record that hard-crashes the process
+    "worker_crash": "score_loop",
 }
+
+# sites a ``worker_crash:site=...`` param may name
+KNOWN_SITES = frozenset(
+    list(SITES.values()) + ["score_batch", "dispatch"]
+)
 
 
 class InjectedBrokerDeath(ConnectionError):
@@ -79,6 +102,19 @@ class InjectedBrokerDeath(ConnectionError):
 class InjectedCheckpointFailure(OSError):
     """Injected checkpoint write failure: rides ``CheckpointManager
     .save``'s real ``except OSError`` → retry/backoff path."""
+
+
+class InjectedPoisonRecord(ValueError):
+    """Injected poison record: raised from the per-batch scoring hook
+    when a configured offset lands in the dispatched range — rides the
+    pipelines' real record-isolation (suspect-mode bisection) path.
+    ``offsets`` carries the matched offsets."""
+
+    def __init__(self, offsets):
+        super().__init__(
+            f"injected poison record at offset(s) {list(offsets)}"
+        )
+        self.offsets = tuple(int(o) for o in offsets)
 
 
 class _Fault:
@@ -92,7 +128,21 @@ class _Fault:
                 f"unknown fault kind {kind!r} (have {sorted(SITES)})"
             )
         self.kind = kind
-        self.site = SITES[kind]
+        site = params.get("site")
+        if site is not None:
+            if kind != "worker_crash":
+                raise ValueError(
+                    f"site= is only meaningful on worker_crash, not "
+                    f"{kind!r}"
+                )
+            if site not in KNOWN_SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r} "
+                    f"(have {sorted(KNOWN_SITES)})"
+                )
+            self.site = str(site)
+        else:
+            self.site = SITES[kind]
         self._clock = clock
         self._t0 = clock()
         self.after_s = float(params.get("after_s", 0.0))
@@ -103,6 +153,23 @@ class _Fault:
         self.p = params.get("p")
         self.delay_s = float(params.get("delay_ms", 50.0)) / 1000.0
         self.wedge_s = float(params.get("wedge_s", 0.5))
+        # offset targeting (poison_record / worker_crash at an
+        # offset-carrying site): ``offset=K`` fires exactly when record
+        # K is in the batch; ``every=N`` poisons offsets ≡ 0 (mod N) —
+        # both deterministic across replays, which is what lets the
+        # drill assert "these offsets land in the DLQ exactly"
+        self.offset = (
+            int(params["offset"]) if params.get("offset") is not None
+            else None
+        )
+        self.every = (
+            int(params["every"]) if params.get("every") is not None
+            else None
+        )
+        if kind == "poison_record" and self.offset is None and self.every is None:
+            raise ValueError(
+                "poison_record needs offset= or every= targeting"
+            )
         # seeded by default: the SAME drill injects the SAME faults —
         # determinism is the point of a harness over improvised chaos
         self._rng = random.Random(int(params.get("seed", 0xFA17)))
@@ -110,8 +177,30 @@ class _Fault:
         self._last_event = 0.0
         self._mu = threading.Lock()
 
-    def try_claim(self) -> bool:
-        """Evaluate the gates; claim one fire when they all pass."""
+    def _match_offsets(self, ctx: Optional[dict]):
+        """Offset-targeted gate: → the matched offsets (possibly ()),
+        or True when this fault has no offset constraint."""
+        if self.offset is None and self.every is None:
+            return True
+        offsets = None if ctx is None else ctx.get("offsets")
+        if offsets is None:
+            return ()  # offset-targeted fault at an offset-less site
+        matched = []
+        for o in offsets:
+            o = int(o)
+            if self.offset is not None and o == self.offset:
+                matched.append(o)
+            elif self.every is not None and self.every > 0 and o % self.every == 0:
+                matched.append(o)
+        return tuple(matched)
+
+    def try_claim(self, ctx: Optional[dict] = None):
+        """Evaluate the gates; claim one fire when they all pass.
+        → falsy (no fire), or a fire token: ``True`` / the non-empty
+        tuple of matched offsets for offset-targeted faults."""
+        token = self._match_offsets(ctx)
+        if not token:
+            return False
         now = self._clock()
         armed_at = self._t0 + self.after_s
         if now < armed_at:
@@ -132,15 +221,27 @@ class _Fault:
                 "fault_injected", fault=self.kind, site=self.site,
                 fires=self.fires,
             )
-        return True
+        return token
 
-    def act(self) -> None:
+    def act(self, token=True) -> None:
         if self.kind == "broker_death":
             raise InjectedBrokerDeath("injected broker death")
         if self.kind == "checkpoint_fail":
             raise InjectedCheckpointFailure(
                 "injected checkpoint write failure"
             )
+        if self.kind == "poison_record":
+            raise InjectedPoisonRecord(
+                token if token is not True else ()
+            )
+        if self.kind == "worker_crash":
+            # SIGKILL self: no atexit, no finally, no flushes — the
+            # honest shape of an OOM kill or a segfaulting record. The
+            # flight event above already rode its own fsync'd dump path
+            # only if a dump was triggered; a crash drill reads the
+            # SUPERVISOR's events, not this process's.
+            os.kill(os.getpid(), 9)
+            return  # pragma: no cover - unreachable
         if self.kind == "worker_wedge":
             time.sleep(self.wedge_s)
         else:  # slow_fetch / dispatch_delay
@@ -154,23 +255,28 @@ class FaultPlan:
         for f in faults:
             self._by_site.setdefault(f.site, []).append(f)
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str, ctx: Optional[dict] = None) -> None:
         for f in self._by_site.get(site, ()):
-            if f.try_claim():
-                f.act()
+            token = f.try_claim(ctx)
+            if token:
+                f.act(token)
 
 
 # None = no faults configured: fire() is a global load + None check
 _ACTIVE: Optional[FaultPlan] = None
 
 
-def fire(site: str) -> None:
+def fire(site: str, **ctx) -> None:
     """The hook the runtime calls at each injection site. A raised
-    fault propagates to the caller's real error-handling path."""
+    fault propagates to the caller's real error-handling path.
+    ``ctx`` carries site context for targeted faults (the
+    ``score_batch`` site passes ``offsets=<array>`` so poison faults
+    can match the dispatched range); with no faults configured this
+    stays one global load + a None check."""
     plan = _ACTIVE
     if plan is None:
         return
-    plan.fire(site)
+    plan.fire(site, ctx if ctx else None)
 
 
 def active() -> bool:
@@ -219,7 +325,12 @@ def parse_spec(spec: str) -> List[_Fault]:
             k, _, v = kv.partition("=")
             if not _ or not k.strip():
                 raise ValueError(f"bad fault param {kv!r} in {part!r}")
-            params[k.strip()] = float(v)
+            if k.strip() == "site":
+                # the one string-valued param (worker_crash site
+                # selection); everything else stays numeric
+                params[k.strip()] = v.strip()
+            else:
+                params[k.strip()] = float(v)
         faults.append(_Fault(kind, params))
     return faults
 

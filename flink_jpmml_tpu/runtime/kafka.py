@@ -331,6 +331,15 @@ def decode_record_batches_rows(
     )
     rows = np.empty((len(recs), n_cols), np.float32)
     for i, (_, value) in enumerate(recs):
+        if len(value) != 4 * n_cols:
+            # exact-length contract, matching the C++ decoder (which
+            # refuses non-fixed record sets): np.frombuffer(count=)
+            # would silently TRUNCATE an over-long value into a
+            # plausible-looking row — the worst kind of poison
+            raise ValueError(
+                f"record value length {len(value)} != {4 * n_cols} "
+                f"(n_cols={n_cols})"
+            )
         rows[i] = np.frombuffer(value, np.float32, count=n_cols)
     return offs, rows
 
@@ -563,6 +572,43 @@ class KafkaClient:
                     raise KafkaProtocolError(f"Fetch error {err}")
         return high_watermark, record_set
 
+    def produce(
+        self,
+        topic: str,
+        partition: int,
+        values: Sequence[bytes],
+        timestamp_ms: int = 0,
+        timeout_ms: int = 10_000,
+    ) -> int:
+        """Produce ``values`` as one magic-2 record batch (Produce v3,
+        acks=-1) → the base offset the broker assigned. The consumer
+        side never needed this; the ``fjt-dlq redrive`` path does — a
+        quarantined record goes back INTO the topic so the live
+        pipeline re-scores it through the real consume path."""
+        record_set = encode_record_batch(
+            0, list(values), timestamp_ms=timestamp_ms
+        )
+        w = _Writer()
+        w.string(None)  # transactional id
+        w.i16(-1)  # acks: full ISR
+        w.i32(timeout_ms)
+        w.i32(1).string(topic)
+        w.i32(1).i32(partition).bytes_(record_set)
+        r = self._request(API_PRODUCE, 3, bytes(w.b))
+        base_offset = -1
+        for _ in range(r.i32()):
+            r.string()  # topic
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                if err:
+                    raise KafkaProtocolError(f"Produce error {err}")
+                base_offset = r.i64()
+                r.i64()  # log append time
+        if base_offset < 0:
+            raise KafkaProtocolError("empty Produce response")
+        return base_offset
+
     def fetch(
         self,
         topic: str,
@@ -632,8 +678,17 @@ class _KafkaSourceBase:
         interleave: str = "auto",
         metrics=None,
         max_bytes: int = 4 << 20,
+        dlq=None,
     ):
         self._client = KafkaClient(host, port)
+        # dead-letter queue (runtime/dlq.py): when installed, a record
+        # whose VALUE doesn't decode is counted per partition
+        # (decode_errors), quarantined with its raw bytes, and skipped —
+        # one poisoned producer message stops killing the consumer.
+        # Without one, decode errors raise exactly as before.
+        self._dlq = dlq
+        self._decode_err_counters: Dict[object, object] = {}
+        self._last_decode_event = 0.0
         # observability (optional MetricsRegistry): fetch-RPC latency as
         # a mergeable histogram, and per-partition consumer lag gauges —
         # kafka_lag{partition="p"} = broker high-water mark minus this
@@ -810,6 +865,32 @@ class _KafkaSourceBase:
         self._note_event_times(part, raw)
         self._observe_fetch(part, offset, hw, t0)
         return raw
+
+    def _note_decode_error(self, part, off: int, value: bytes, exc) -> None:
+        """One undecodable record value: count it per partition, park
+        the raw bytes in the DLQ (when installed), rate-limit one
+        flight event — the caller skips the record and advances its
+        cursor past it (never silently, never fatally)."""
+        label = part if part is not None else "na"
+        c = self._decode_err_counters.get(label)
+        if c is None and self._metrics is not None:
+            c = self._metrics.counter(f'decode_errors{{partition="{label}"}}')
+            self._decode_err_counters[label] = c
+        if c is not None:
+            c.inc()
+        now = time.monotonic()
+        if now - self._last_decode_event >= 1.0:
+            self._last_decode_event = now
+            flight.record(
+                "decode_error", topic=self._topic, partition=part,
+                offset=off, size=len(value), error=repr(exc),
+            )
+        if self._dlq is not None:
+            self._dlq.quarantine(
+                value, offset=off, reason="decode",
+                partition=part if isinstance(part, int) else None,
+                error=exc, topic=self._topic,
+            )
 
     def _sweep_lag_age(self) -> None:
         """A dead broker must not freeze ``kafka_lag_age_s`` at its last
@@ -1063,17 +1144,29 @@ class KafkaRecordSource(_KafkaSourceBase, Source):
                 break
         return out
 
+    def _decode_polled(self, pairs, part) -> Polled:
+        """(offset, value) pairs → (offset+1, record), quarantining +
+        skipping values the decoder rejects (counted per partition,
+        raw bytes to the DLQ when installed). With neither metrics nor
+        a DLQ the historical raise stands — an invisible skip would be
+        silent data loss."""
+        out = []
+        for off, value in pairs:
+            try:
+                rec = self._decode(value)
+            except Exception as e:
+                if self._dlq is None and self._metrics is None:
+                    raise
+                self._note_decode_error(part, off, value, e)
+                continue
+            out.append((off + 1, rec))
+        return out
+
     def poll(self, max_n: int) -> Polled:
         if self._vector_mode:
-            return [
-                (g + 1, self._decode(value))
-                for g, value in self._pump_auto(max_n)
-            ]
+            return self._decode_polled(self._pump_auto(max_n), None)
         if self._multi:
-            return [
-                (g + 1, self._decode(value))
-                for g, value in self._pump(max_n)
-            ]
+            return self._decode_polled(self._pump(max_n), None)
         # a fetch may return more than max_n records; the surplus stays
         # buffered so nothing fetched is ever dropped (the fetch cursor
         # has already moved past it)
@@ -1083,7 +1176,7 @@ class KafkaRecordSource(_KafkaSourceBase, Source):
             self._pending[:max_n],
             self._pending[max_n:],
         )
-        return [(off + 1, self._decode(value)) for off, value in take]
+        return self._decode_polled(take, self._partition)
 
     def _clear_buffers(self) -> None:
         self._pending.clear()
@@ -1123,17 +1216,64 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         # stamp stays an upper bound on staleness
         self._rbuf_tranges: Dict[int, tuple] = {}
 
-    def _decode_rows(self, raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
-        if self._decode_s is None:
-            return decode_record_batches_rows(raw, self._cols)
-        t0 = time.monotonic()
+    def _decode_rows(self, raw: bytes, part):
+        """→ (offsets int64, rows f32, bad_hi): the decoded fixed-width
+        rows plus the highest offset of any record whose VALUE was the
+        wrong length (None when all decoded). Bad records are counted
+        (``decode_errors{partition=*}``) and routed to the DLQ when one
+        is installed; the callers advance their cursors past ``bad_hi``
+        so a poisoned producer message is consumed exactly once, not
+        refetched forever. With neither metrics nor DLQ attached the
+        historical ValueError propagates (a skip nobody can see would
+        be silent data loss); the strict interleave also re-raises —
+        its round-robin bijection cannot tolerate a dropped lane."""
+        t0 = time.monotonic() if self._decode_s is not None else None
         try:
-            return decode_record_batches_rows(raw, self._cols)
+            try:
+                offs, rows = decode_record_batches_rows(raw, self._cols)
+                return offs, rows, None
+            except ValueError:
+                if self._strict and self._multi:
+                    raise
+                if self._dlq is None and self._metrics is None:
+                    raise
+                return self._decode_rows_lenient(raw, part)
         finally:
-            dt = time.monotonic() - t0
-            self._decode_s.inc(dt)
-            if self._ledger is not None:
-                self._ledger.observe("decode", dt)
+            if t0 is not None:
+                dt = time.monotonic() - t0
+                self._decode_s.inc(dt)
+                if self._ledger is not None:
+                    self._ledger.observe("decode", dt)
+
+    def _decode_rows_lenient(self, raw: bytes, part):
+        """Per-record decode isolating wrong-length values (CRC and
+        framing errors re-raise from ``decode_record_batches`` — a
+        corrupt record SET is transport damage, not a poison value)."""
+        recs = decode_record_batches(raw)
+        want = 4 * self._cols
+        offs: List[int] = []
+        rows: List[np.ndarray] = []
+        bad_hi = None
+        for off, value in recs:
+            if len(value) == want:
+                offs.append(off)
+                rows.append(np.frombuffer(value, np.float32))
+            else:
+                self._note_decode_error(
+                    part, off, value,
+                    ValueError(
+                        f"value length {len(value)} != {want} "
+                        f"(n_cols={self._cols})"
+                    ),
+                )
+                bad_hi = off if bad_hi is None else max(bad_hi, off)
+        if not offs:
+            return (
+                np.empty((0,), np.int64),
+                np.empty((0, self._cols), np.float32),
+                bad_hi,
+            )
+        return np.asarray(offs, np.int64), np.vstack(rows), bad_hi
 
     def _poll_multi(self) -> Optional[Tuple[int, np.ndarray]]:
         """Strict round-robin interleave, vectorized: global index
@@ -1150,7 +1290,7 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
             if buf is None or buf.shape[0] == 0:
                 raw = self._fetch_raw_part(part, po0)
                 if raw:
-                    offs, rows = self._decode_rows(raw)
+                    offs, rows, _ = self._decode_rows(raw, part)
                     k = int(np.searchsorted(offs, po0))
                     offs, rows = offs[k:], rows[k:]
                     if offs.shape[0]:
@@ -1216,16 +1356,30 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
                     if attempt:
                         break  # one long-poll per dry sweep, not P
                     continue
-                offs, rows = self._decode_rows(raw)
+                offs, rows, bad_hi = self._decode_rows(raw, part)
                 k = int(np.searchsorted(offs, self._cursors[part]))
                 offs, rows = offs[k:], rows[k:]
                 if offs.shape[0] == 0:
+                    if (
+                        bad_hi is not None
+                        and bad_hi >= self._cursors[part]
+                    ):
+                        # an all-poison fetch: advance past it, or the
+                        # next poll refetches and re-quarantines forever
+                        self._cursors[part] = bad_hi + 1
+                        self._snap()
                     if attempt:
                         break
                     continue
                 g0 = self._g
                 self._g = g0 + rows.shape[0]
                 self._cursors[part] = int(offs[-1]) + 1
+                if bad_hi is not None:
+                    # trailing poison records consumed by this fetch:
+                    # the cursor moves past them exactly once
+                    self._cursors[part] = max(
+                        self._cursors[part], bad_hi + 1
+                    )
                 self._rr = (idx + 1) % P
                 self._snap()
                 # one fetch == one emitted run here, so the fetch's
@@ -1252,22 +1406,30 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         raw = self._fetch_raw_part(self._partition, self._next)
         if not raw:
             return None
-        offs, rows = self._decode_rows(raw)
+        offs, rows, bad_hi = self._decode_rows(raw, self._partition)
         # a fetch returns whole batches: drop records below the cursor
         k = int(np.searchsorted(offs, self._next))
         offs, rows = offs[k:], rows[k:]
         if offs.shape[0] == 0:
+            if bad_hi is not None and bad_hi >= self._next:
+                # an all-poison fetch: advance past it, or the next
+                # poll refetches and re-quarantines forever
+                self._next = bad_hi + 1
             return None
         first = int(offs[0])
         gaps = np.nonzero(np.diff(offs) != 1)[0]
         if gaps.size:
-            # a gap means a compacted/partial topic — not the tabular
-            # stream contract; resync the block at the gap
+            # a gap means a compacted/partial topic (or a quarantined
+            # poison value) — not the tabular stream contract; resync
+            # the block at the gap
             stop = int(gaps[0]) + 1
             self._next = int(offs[stop])
             rows = rows[:stop]
         else:
             self._next = int(offs[-1]) + 1
+            if bad_hi is not None:
+                # trailing poison records: consumed exactly once
+                self._next = max(self._next, bad_hi + 1)
         # the fetch's batch-header time range bounds these rows' event
         # times (batch granularity: the cursor filter above may narrow
         # the rows, never widen them — staleness stays an upper bound)
@@ -1560,7 +1722,7 @@ class MiniKafkaBroker:
     def _dispatch(self, api_key: int, v: int, r: _Reader) -> Optional[bytes]:
         if api_key == API_VERSIONS:
             w = _Writer()
-            w.i16(0).i32(4)
+            w.i16(0).i32(5)
             # Advertise exactly the versions _dispatch answers in: the
             # Fetch/ListOffsets/Metadata responses below are fixed v4/v1/v1
             # shapes, so offering lower versions would let a client pick one
@@ -1570,8 +1732,43 @@ class MiniKafkaBroker:
                 (API_LIST_OFFSETS, 1, 1),
                 (API_METADATA, 1, 1),
                 (API_VERSIONS, 0, 0),
+                (API_PRODUCE, 3, 3),
             ):
                 w.i16(k).i16(lo).i16(hi)
+            return bytes(w.b)
+        if api_key == API_PRODUCE and v == 3:
+            # the redrive path (fjt-dlq → KafkaClient.produce): decode
+            # the record batch, append its values like an in-process
+            # append() — offsets are reassigned at the log head, exactly
+            # like a real broker
+            r.string()  # transactional id
+            r.i16()  # acks
+            r.i32()  # timeout
+            r.i32()  # topic count (1)
+            r.string()
+            r.i32()  # partition count (1)
+            part = r.i32()
+            record_set = r.bytes_() or b""
+            ok_part = 0 <= part < len(self._offs)
+            base = -1
+            err = 0 if ok_part else 3
+            if ok_part:
+                try:
+                    recs = decode_record_batches(record_set)
+                    tr = record_batch_time_range(record_set)
+                except ValueError:
+                    recs, tr, err = [], None, 42  # INVALID_RECORD
+                if recs:
+                    base = self.append(
+                        *[val for _, val in recs], partition=part,
+                        timestamp_ms=(
+                            int(tr[1] * 1000) if tr is not None else None
+                        ),
+                    )
+            w = _Writer()
+            w.i32(1).string(self.topic)
+            w.i32(1).i32(part).i16(err).i64(base).i64(-1)
+            w.i32(0)  # throttle time (trails the responses in v1+)
             return bytes(w.b)
         if api_key == API_METADATA:
             for _ in range(max(r.i32(), 0)):

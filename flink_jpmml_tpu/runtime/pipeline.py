@@ -315,6 +315,7 @@ class OverlappedDispatcher:
         metrics: Optional[MetricsRegistry] = None,
         complete: Optional[Callable[[Any, Any], None]] = None,
         profiler: Optional["prof_mod.DeviceProfiler"] = None,
+        on_error: Optional[Callable[[Any, Any, Exception], bool]] = None,
     ):
         # depth = dispatches allowed to REMAIN in flight after launch
         # returns; 0 = synchronous (each launch finishes its own batch —
@@ -326,6 +327,15 @@ class OverlappedDispatcher:
         self._depth = None if depth is None else max(0, int(depth))
         self._window: "deque[_InFlight]" = deque()
         self._complete = complete
+        # on_error(out, meta, exc) -> bool: called on the launching
+        # thread when fetching an entry raised an *Exception* (never a
+        # KeyboardInterrupt/SystemExit). True = handled — the error is
+        # swallowed, the complete-callback is skipped, and the caller's
+        # loop continues; False/None = re-raise as before. The block
+        # pipelines hang record-level poison isolation (suspect-mode
+        # bisection → DLQ) on this hook, so one bad record stops
+        # killing the worker.
+        self._on_error = on_error
         self._closed = False
         self.metrics = metrics or MetricsRegistry()
         self._stall = self.metrics.counter("h2d_stall_s")
@@ -500,12 +510,13 @@ class OverlappedDispatcher:
         handle = self._window[0]
         depth = len(self._window)
         t0 = time.monotonic()
+        error: Optional[BaseException] = None
         try:
             _block_ready(handle.out)
         except BaseException as e:
             handle.error = e  # wait() on this handle re-raises, never
             # returns the unsynchronized result as if it completed
-            raise
+            error = e
         finally:
             # stall time counts even when the wait raised: the host WAS
             # gated on the device for that long either way
@@ -526,6 +537,16 @@ class OverlappedDispatcher:
             self._window.popleft()
             handle.done = True
             self._gauge.set(len(self._window))
+        if error is not None:
+            if (
+                self._on_error is not None
+                and isinstance(error, Exception)
+                and self._on_error(handle.out, handle.meta, error)
+            ):
+                # handled (e.g. isolated to the DLQ): no complete
+                # callback — the handler owns delivery + commit
+                return None
+            raise error
         if self._complete is not None:
             self._complete(handle.out, handle.meta)
         return handle.out, handle.meta

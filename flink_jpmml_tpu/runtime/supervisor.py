@@ -113,7 +113,18 @@ def reporter_from_env(
 class RestartPolicy:
     """Flink restart-strategy analogue. ``window_s=None`` = fixed-delay
     (lifetime budget of ``max_restarts``); a window makes it
-    failure-rate (``max_restarts`` per trailing ``window_s``)."""
+    failure-rate (``max_restarts`` per trailing ``window_s``).
+
+    The backoff draws from the SHARED capped-exponential-full-jitter
+    schedule (utils/retry.full_jitter — the kafka-reconnect and
+    checkpoint-retry cadence): a deterministic exponential synchronizes
+    a fleet's restart storms — every worker of a dead dependency
+    respawns at the same instant and re-kills it — while full jitter
+    decorrelates them. ``backoff_multiplier`` still governs the
+    ceiling's growth (1.0 = a fixed-delay policy's constant ceiling,
+    jittered); ``FJT_RESTART_BASE_S`` / ``FJT_RESTART_CAP_S`` override
+    ``backoff_s`` / ``max_backoff_s`` fleet-wide when set (the
+    ``FJT_RETRY_*`` convention)."""
 
     max_restarts: int = 3
     backoff_s: float = 0.2
@@ -125,11 +136,25 @@ class RestartPolicy:
     # the max backoff forever)
     reset_after_s: float = 10.0
 
-    def backoff(self, consecutive_failures: int) -> float:
-        b = self.backoff_s * (
-            self.backoff_multiplier ** max(consecutive_failures - 1, 0)
+    def backoff(
+        self, consecutive_failures: int,
+        rng: Optional[Callable[[], float]] = None,
+    ) -> float:
+        from flink_jpmml_tpu.utils.retry import env_float, full_jitter
+        import random
+
+        base = env_float("FJT_RESTART_BASE_S", self.backoff_s)
+        cap = max(env_float("FJT_RESTART_CAP_S", self.max_backoff_s), base)
+        return full_jitter(
+            base, cap, max(consecutive_failures - 1, 0),
+            rng if rng is not None else random.random,
+            growth=self.backoff_multiplier,
         )
-        return min(b, self.max_backoff_s)
+
+    def backoff_ceiling(self, consecutive_failures: int) -> float:
+        """The schedule's ceiling at this failure count (what a jitter
+        draw of 1.0 yields) — tests and capacity planning read it."""
+        return self.backoff(consecutive_failures, rng=lambda: 1.0)
 
 
 @dataclass(frozen=True)
@@ -433,6 +458,15 @@ class Supervisor:
         if st.spec.env:
             env.update(st.spec.env)
         env[_ID_ENV] = st.spec.worker_id
+        # the supervisor half of crash-loop fingerprinting: the spawned
+        # incarnation KNOWS how many consecutive failures preceded it,
+        # so a pipeline restoring at the same offset can flip into
+        # suspect mode (runtime/dlq.py) even when the deaths happened
+        # before its first checkpoint ever landed
+        env["FJT_RESTART_STREAK"] = str(max(
+            st.consecutive_failures,
+            self._group_consecutive if self._group else 0,
+        ))
         if self._coord is not None:
             env[_ADDR_ENV] = f"{self._coord.host}:{self._coord.port}"
         try:

@@ -99,6 +99,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
         batcher=None,
         admission=None,
         shed_lane: str = "block",
+        dlq=None,
     ):
         if batch_size <= 0:
             raise InputValidationException(
@@ -132,6 +133,11 @@ class DynamicBlockPipeline(BlockPipelineBase):
             batcher=batcher,
             admission=admission,
             shed_lane=shed_lane,
+            # record-level poison isolation (runtime/dlq.py) works on
+            # the dynamic path exactly as on the static one: the
+            # suspect scan re-dispatches through the CURRENT BoundScorer
+            # and quarantined envelopes carry its model key
+            dlq=dlq,
         )
         self._control = control
         self._name = name
@@ -159,10 +165,12 @@ class DynamicBlockPipeline(BlockPipelineBase):
     #    reference's checkpointed operator state) --------------------------
 
     def _ckpt_state(self) -> dict:
-        return {
-            "source_offset": self.committed_offset,
-            "registry": self.registry.state(),
-        }
+        # the base state (source offset + inflight_hi for the replay
+        # region + optional source cursor vector) plus the served-model
+        # registry — the reference's checkpointed operator state
+        state = super()._ckpt_state()
+        state["registry"] = self.registry.state()
+        return state
 
     def _restore_extra(self, state: dict) -> None:
         self.registry.restore(state.get("registry", {}))

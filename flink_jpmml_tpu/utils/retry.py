@@ -67,6 +67,27 @@ def env_int(name: str, fallback: int) -> int:
     return v if v > 0 else fallback
 
 
+def full_jitter(
+    base_s: float,
+    cap_s: float,
+    attempt: int,
+    rng: Callable[[], float] = random.random,
+    growth: float = 2.0,
+) -> float:
+    """One full-jitter delay draw: ``uniform(0, min(cap, base·g^k))``
+    for 0-based ``attempt`` k — THE retry schedule, shared by kafka
+    reconnects, checkpoint write retries (:class:`Backoff`), and the
+    supervisor's worker-restart backoff
+    (``runtime/supervisor.RestartPolicy``, which feeds its configured
+    multiplier through ``growth``; g ≤ 1 pins the ceiling at the
+    base — a fixed-delay policy keeps its ceiling, jittered). The
+    exponent clamp keeps an overnight outage's attempt count from
+    overflowing the pow."""
+    g = growth if growth > 1.0 else 1.0
+    ceiling = min(cap_s, base_s * (g ** min(max(attempt, 0), 63)))
+    return rng() * ceiling
+
+
 class Backoff:
     """One retry *streak*'s state: consecutive failures, the jittered
     delay schedule, and the give-up signal.
@@ -118,16 +139,10 @@ class Backoff:
 
     def next_delay(self) -> float:
         """Advance the streak and return the next jittered delay."""
-        # exponent clamped BEFORE the pow: 2.0**1024 raises
-        # OverflowError, and an overnight broker outage reaches 1024
-        # failures easily — the backoff must never be what kills the
-        # consumer it exists to keep alive (any clamp ≥ log2(cap/base)
-        # leaves the ceiling at the cap)
-        ceiling = min(
-            self.cap_s, self.base_s * (2.0 ** min(self._attempts, 63))
+        delay = full_jitter(
+            self.base_s, self.cap_s, self._attempts, self._rng
         )
         self._attempts += 1
-        delay = self._rng() * ceiling
         if self._gauge is not None:
             self._gauge.set(round(delay, 6))
         if self._attempts >= self.max_attempts and not self._gave_up:

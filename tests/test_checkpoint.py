@@ -70,6 +70,22 @@ class TestCheckpointManager:
         with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
             assert mgr.load_latest() == {"source_offset": 4}
 
+    def test_two_corrupt_newest_walks_back_to_third(self, tmp_path):
+        # the walk-back must traverse ALL retained snapshots, not fall
+        # back exactly one: correlated damage (a dying disk, a torn
+        # rsync) routinely takes the two newest together, and retention
+        # exists precisely so the third can still resume the job
+        mgr = CheckpointManager(str(tmp_path), keep=4)
+        for off in (1, 2, 3, 4):
+            mgr.save({"source_offset": off})
+            time.sleep(0.002)
+        snaps = sorted(tmp_path.glob("ckpt-*.json"))
+        snaps[-1].write_text("{torn")
+        snaps[-2].write_bytes(b"\xff\xfe not json either")
+        with pytest.warns(RuntimeWarning, match="corrupt checkpoint"):
+            state = mgr.load_latest()
+        assert state == {"source_offset": 2}
+
     def test_all_corrupt_is_typed_error(self, tmp_path):
         mgr = CheckpointManager(str(tmp_path), keep=2)
         mgr.save({"source_offset": 1})

@@ -11,6 +11,7 @@ REAL code paths —
   full-jitter backoff schedule itself.
 """
 
+import os
 import time
 
 import numpy as np
@@ -20,6 +21,8 @@ from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 from flink_jpmml_tpu.utils.retry import Backoff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(autouse=True)
@@ -298,3 +301,164 @@ class TestCheckpointFaultDrill:
             for e in flight.events()
         )
         assert not list(tmp_path.glob("ckpt-*.json"))
+
+
+class TestPoisonAndCrashKinds:
+    """ISSUE 12: the delivery-correctness chaos primitives."""
+
+    def test_poison_record_offset_targeting(self):
+        import numpy as np
+
+        f = faults.inject("poison_record", offset=5)
+        with pytest.raises(faults.InjectedPoisonRecord) as ei:
+            faults.fire("score_batch", offsets=np.arange(3, 8))
+        assert ei.value.offsets == (5,)
+        faults.fire("score_batch", offsets=np.arange(10, 20))  # no hit
+        assert f.fires == 1
+        # an offset-less call at the site never fires a targeted fault
+        faults.fire("score_batch")
+        assert f.fires == 1
+
+    def test_poison_record_every_targeting(self):
+        faults.inject("poison_record", every=4)
+        with pytest.raises(faults.InjectedPoisonRecord) as ei:
+            faults.fire("score_batch", offsets=[1, 2, 3, 8, 12])
+        assert ei.value.offsets == (8, 12)
+
+    def test_poison_record_needs_targeting(self):
+        with pytest.raises(ValueError, match="offset= or every="):
+            faults.inject("poison_record", p=1.0)
+
+    def test_worker_crash_site_selection(self):
+        fs = faults.parse_spec(
+            "worker_crash:site=kafka_fetch:n=1,worker_crash:n=1"
+        )
+        assert [f.site for f in fs] == ["kafka_fetch", "score_loop"]
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.parse_spec("worker_crash:site=bogus")
+        with pytest.raises(ValueError, match="only meaningful"):
+            faults.parse_spec("slow_fetch:site=dispatch")
+
+    def test_worker_crash_sigkills_subprocess(self):
+        # jax-free child: the kill primitive itself is cheap to pin
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-c", (
+                "import os\n"
+                "os.environ['FJT_FAULTS'] = "
+                "'worker_crash:site=dispatch:n=1'\n"
+                "import sys\n"
+                f"sys.path.insert(0, {REPO!r})\n"
+                "from flink_jpmml_tpu.runtime import faults\n"
+                "faults.fire('dispatch')\n"
+                "print('survived')\n"
+            )],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == -9
+        assert "survived" not in proc.stdout
+
+
+_REPLAY_WORKER = r"""
+import glob, os, sys
+sys.path.insert(0, sys.argv[2])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+tmp = sys.argv[1]
+pmml = glob.glob(os.path.join(tmp, "*.pmml"))[0]
+cm = compile_pmml(parse_pmml_file(pmml), batch_size=32)
+rng = np.random.default_rng(0)
+N = 256
+data = rng.normal(0, 1, size=(N, 4)).astype(np.float32)
+out = open(os.path.join(tmp, "sink.log"), "a", buffering=1)
+
+def sink(o, n, first_off):
+    out.write(f"E {first_off} {n}\n")
+
+pipe = BlockPipeline(
+    FiniteBlockSource(data, 64), cm, sink,
+    RuntimeConfig(
+        batch=BatchConfig(size=32, deadline_us=1000),
+        checkpoint_interval_s=0.01,
+    ),
+    checkpoint=CheckpointManager(os.path.join(tmp, "ck")),
+    max_dispatch_chunks=1,
+)
+pipe.restore()
+out.write(f"R {pipe.committed_offset}\n")
+pipe.run_until_exhausted(timeout=60)
+out.write(f"D {pipe.committed_offset}\n")
+"""
+
+
+class TestMidBatchKillReplayBoundary:
+    pytestmark = pytest.mark.slow  # two jax subprocesses
+
+    def test_suffix_replays_exactly_once_per_restart(self, tmp_path):
+        """ISSUE 12 satellite (process-kill half; the deterministic
+        in-process half is in tests/test_runtime.py): SIGKILL landing
+        BETWEEN dispatch and offset commit — incarnation 1 dies the
+        instant offset 130's batch reaches the score_batch hook, after
+        earlier batches committed — and the restart replays the
+        uncommitted suffix exactly once, skipping nothing."""
+        import os
+        import subprocess
+        import sys
+
+        import numpy as np
+
+        from flink_jpmml_tpu.assets_gen import gen_gbm
+
+        gen_gbm(str(tmp_path), n_trees=3, depth=3, n_features=4)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["FJT_XLA_CACHE"] = str(tmp_path / "xla")
+        env.pop("FJT_RESTART_STREAK", None)
+        # incarnation 1: die mid-batch (after drain+dispatch of the
+        # batch holding offset 130, before its commit)
+        env1 = dict(env)
+        env1["FJT_FAULTS"] = "worker_crash:site=score_batch:offset=130"
+        p1 = subprocess.run(
+            [sys.executable, "-c", _REPLAY_WORKER,
+             str(tmp_path), REPO],
+            env=env1, capture_output=True, text=True, timeout=120,
+        )
+        assert p1.returncode == -9, p1.stderr[-2000:]
+        # incarnation 2: clean resume
+        env2 = dict(env)
+        env2.pop("FJT_FAULTS", None)
+        p2 = subprocess.run(
+            [sys.executable, "-c", _REPLAY_WORKER,
+             str(tmp_path), REPO],
+            env=env2, capture_output=True, text=True, timeout=120,
+        )
+        assert p2.returncode == 0, p2.stderr[-2000:]
+
+        emitted, restores = [], []
+        for ln in open(tmp_path / "sink.log"):
+            kind, *rest = ln.split()
+            if kind == "E":
+                emitted.append((int(rest[0]), int(rest[1])))
+            elif kind == "R":
+                restores.append(int(rest[0]))
+        assert restores[0] == 0 and len(restores) == 2
+        c = restores[1]  # the kill landed between c's commit and 130
+        assert 0 < c <= 130
+        covered = np.zeros(256, np.int64)
+        for off, n in emitted:
+            covered[off: off + n] += 1
+        assert (covered >= 1).all(), "a record was skipped"
+        # below the restore point: exactly once; the uncommitted
+        # suffix: at most once per incarnation (== exactly once per
+        # restart); nothing ever thrice
+        assert (covered[:c] == 1).all()
+        assert (covered <= 2).all()

@@ -1353,3 +1353,171 @@ class TestEventTimeStrictInterleave:
                 src.close()
         finally:
             broker.close()
+
+
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry as _MReg
+
+
+class TestDecodePoisonRouting:
+    """ISSUE 12 satellite: decode errors stop being silently filtered —
+    counted per partition, raw bytes to the DLQ, record skipped exactly
+    once (never refetched forever, never fatal with a DLQ installed)."""
+
+    def _broker_with_poison(self):
+        broker = MiniKafkaBroker(topic="p")
+        rows = np.arange(20, dtype=np.float32).reshape(5, 4)
+        broker.append_rows(rows[:2])
+        broker.append(b"short")        # 5 bytes: undecodable
+        broker.append_rows(rows[2:4])
+        broker.append(b"x" * 20)       # 20 bytes: over-long is ALSO
+        # poison — np.frombuffer would silently truncate it into a
+        # plausible row (pinned strict in decode_record_batches_rows)
+        broker.append_rows(rows[4:])
+        return broker, rows
+
+    def test_block_source_skips_counts_and_quarantines(self, tmp_path):
+        from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+
+        broker, rows = self._broker_with_poison()
+        try:
+            m = _MReg()
+            dlq = DeadLetterQueue(str(tmp_path / "dlq"), metrics=m)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "p", n_cols=4,
+                metrics=m, dlq=dlq, max_wait_ms=10,
+            )
+            try:
+                got = []
+                for _ in range(30):
+                    p = src.poll()
+                    if p is None:
+                        if len(got) >= 5:
+                            break
+                        continue
+                    off, blk = p
+                    for i in range(blk.shape[0]):
+                        got.append((off + i, blk[i].tolist()))
+                assert [o for o, _ in got] == [0, 1, 3, 4, 6]
+                # rows decode under their TRUE offsets (no shift)
+                assert got[2][1] == rows[2].tolist()
+                assert sorted(set(dlq.offsets())) == [2, 5]
+                assert all(
+                    e["reason"] == "decode" for e in dlq.scan()
+                )
+                snap = m.struct_snapshot()["counters"]
+                # ≥2: a gap-truncated refetch may see (and re-count) a
+                # trailing poison value once more — the counter is per
+                # rejection EVENT; the DLQ offset set stays exact
+                assert snap['decode_errors{partition="0"}'] >= 2
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_block_source_without_dlq_or_metrics_raises(self):
+        broker, _ = self._broker_with_poison()
+        try:
+            src = KafkaBlockSource(
+                broker.host, broker.port, "p", n_cols=4, max_wait_ms=10,
+            )
+            try:
+                with pytest.raises(ValueError, match="value length"):
+                    for _ in range(10):
+                        src.poll()
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_record_source_skips_bad_json(self, tmp_path):
+        import json as _json
+
+        from flink_jpmml_tpu.runtime.dlq import (
+            DeadLetterQueue, payload_bytes,
+        )
+
+        broker = MiniKafkaBroker(topic="r")
+        try:
+            broker.append(
+                _json.dumps({"a": 1}).encode(),
+                b"not json {{",
+                _json.dumps({"a": 2}).encode(),
+            )
+            m = _MReg()
+            dlq = DeadLetterQueue(str(tmp_path / "dlq"), metrics=m)
+            src = KafkaRecordSource(
+                broker.host, broker.port, "r",
+                metrics=m, dlq=dlq, max_wait_ms=10,
+            )
+            try:
+                recs = src.poll(10)
+                assert [r for _, r in recs] == [{"a": 1}, {"a": 2}]
+                envs = list(dlq.scan())
+                assert [e["offset"] for e in envs] == [1]
+                assert payload_bytes(envs[0]) == b"not json {{"
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_all_poison_fetch_advances_cursor_once(self, tmp_path):
+        # a fetch containing ONLY undecodable values must advance the
+        # cursor past them — otherwise the next poll refetches and
+        # re-quarantines the same bytes forever
+        from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+
+        broker = MiniKafkaBroker(topic="ap")
+        try:
+            broker.append(b"junk1", b"junk2")
+            rows = np.arange(8, dtype=np.float32).reshape(2, 4)
+            broker.append_rows(rows)
+            m = _MReg()
+            dlq = DeadLetterQueue(str(tmp_path / "dlq"), metrics=m)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "ap", n_cols=4,
+                metrics=m, dlq=dlq, max_wait_ms=10,
+            )
+            try:
+                got = []
+                for _ in range(20):
+                    p = src.poll()
+                    if p is not None:
+                        got.append(p[0])
+                        if sum(1 for _ in got) >= 1:
+                            break
+                assert got and got[0] == 2
+                assert sorted(set(dlq.offsets())) == [0, 1]
+                # quarantined exactly once each, not per refetch
+                assert len(dlq.offsets()) == 2
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_strict_interleave_still_raises(self, tmp_path):
+        # the round-robin bijection cannot drop a lane: decode poison
+        # under interleave="strict" stays fatal (use auto mode)
+        from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+
+        broker = MiniKafkaBroker(topic="s", n_partitions=2)
+        try:
+            rows = np.arange(16, dtype=np.float32).reshape(4, 4)
+            broker.append_rows_round_robin(rows)
+            broker.append(b"bad", partition=0)
+            broker.append_rows(rows[:1], partition=1)
+            m = _MReg()
+            dlq = DeadLetterQueue(str(tmp_path / "dlq"), metrics=m)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "s", n_cols=4,
+                partitions=[0, 1], interleave="strict",
+                metrics=m, dlq=dlq, max_wait_ms=10,
+            )
+            try:
+                with pytest.raises(ValueError, match="value length"):
+                    for _ in range(10):
+                        src.poll()
+                assert dlq.count() == 0
+            finally:
+                src.close()
+        finally:
+            broker.close()

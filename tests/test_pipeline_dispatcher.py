@@ -326,7 +326,7 @@ class TestAggregationOffsets:
         assert n == 4
         assert offs2.tolist() == [100, 101, 102, 103]
         # ...and must be carried (not lost) for the next loop iteration
-        assert pipe._carry_drain is not None
-        carry_X, carry_offs = pipe._carry_drain
+        assert len(pipe._carry_drain) == 1
+        carry_X, carry_offs = pipe._carry_drain[0]
         assert carry_offs.tolist() == [0, 1, 2, 3]
         assert carry_X.shape[0] == 4

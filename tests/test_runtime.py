@@ -133,6 +133,75 @@ class TestCheckpointResume:
         env2.execute(timeout=30.0, restore=True)
         assert len(sink2.items) == 0  # everything was already committed
 
+    def test_replay_boundary_uncommitted_suffix_exactly_once(
+        self, iris_reader, tmp_path
+    ):
+        """ISSUE 12 satellite: the at-least-once replay boundary. A
+        restart whose checkpoint trails the dispatched range (the
+        SIGKILL-between-dispatch-and-commit shape; the process-kill
+        twin lives in tests/test_faults.py) replays EXACTLY the
+        uncommitted suffix once — never skips a record, never replays
+        below the committed offset — and books the replay volume in
+        records_replayed."""
+        import json
+        import time as _time
+
+        from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+        from flink_jpmml_tpu.runtime.engine import (
+            Pipeline, StaticScorer,
+        )
+        from flink_jpmml_tpu.runtime.sinks import CollectSink
+        from flink_jpmml_tpu.runtime.sources import InMemorySource
+
+        records = _iris_records(100)
+        cfg = _small_batch_config()
+        model = iris_reader.load(batch_size=cfg.batch.size)
+
+        def run(restore):
+            sink = CollectSink()
+            pipe = Pipeline(
+                InMemorySource(records),
+                StaticScorer(
+                    model,
+                    emit=lambda recs, preds: list(
+                        zip(recs, preds)
+                    ),
+                ),
+                sink,
+                cfg,
+                checkpoint=CheckpointManager(str(tmp_path / "ck")),
+            )
+            if restore:
+                assert pipe.restore()
+            pipe.run_until_exhausted(timeout=30.0)
+            return pipe, sink
+
+        pipe1, sink1 = run(restore=False)
+        assert len(sink1.items) == 100
+        assert pipe1.committed_offset == 100
+        # forge the mid-kill shape: committed trails the dispatched
+        # range (offsets 41..70 were in flight, never committed)
+        _time.sleep(0.002)
+        CheckpointManager(str(tmp_path / "ck")).save(
+            {"source_offset": 40, "inflight_hi": 70, "scorer": {}}
+        )
+        pipe2, sink2 = run(restore=True)
+        assert pipe2.committed_offset == 100
+        # the uncommitted suffix replays exactly once per restart:
+        # records 41..100 once more, 1..40 never again
+        replayed = [r for r, _ in sink2.items]
+        assert replayed == records[40:]
+        snap = pipe2.metrics.struct_snapshot()["counters"]
+        assert snap["records_replayed"] == 70 - 40
+        # and the union over both incarnations has no gaps
+        emitted = [r for r, _ in sink1.items] + replayed
+        assert sorted(
+            json.dumps(r, sort_keys=True) for r in emitted
+        ) == sorted(
+            json.dumps(r, sort_keys=True)
+            for r in records + records[40:]
+        )
+
 
 class TestDynamicServing:
     def test_add_score_del(self, assets_dir):

@@ -85,15 +85,43 @@ def _settles(value_fn, hold_s: float, timeout_s: float,
 
 
 class TestRestartPolicy:
-    def test_backoff_grows_and_caps(self):
-        p = RestartPolicy(
-            backoff_s=0.5, backoff_multiplier=2.0, max_backoff_s=3.0
-        )
-        assert p.backoff(1) == 0.5
-        assert p.backoff(2) == 1.0
-        assert p.backoff(3) == 2.0
-        assert p.backoff(4) == 3.0  # capped
-        assert p.backoff(10) == 3.0
+    def test_backoff_ceiling_grows_and_caps(self):
+        # the jitter draw is uniform(0, ceiling); rng pinned at 1.0
+        # exposes the capped-exponential ceiling schedule — the SAME
+        # schedule as utils/retry.Backoff (kafka reconnects), so a
+        # fleet's restart storm decorrelates instead of synchronizing
+        p = RestartPolicy(backoff_s=0.5, max_backoff_s=3.0)
+        one = lambda: 1.0  # noqa: E731
+        assert p.backoff(1, rng=one) == 0.5
+        assert p.backoff(2, rng=one) == 1.0
+        assert p.backoff(3, rng=one) == 2.0
+        assert p.backoff(4, rng=one) == 3.0  # capped
+        assert p.backoff(10, rng=one) == 3.0
+        assert p.backoff_ceiling(10) == 3.0
+        # full jitter: the draw scales the ceiling
+        assert p.backoff(3, rng=lambda: 0.25) == pytest.approx(0.5)
+        # a configured multiplier is honored (1.0 = fixed-delay
+        # ceiling, still jittered; 3.0 grows faster than the default)
+        flat = RestartPolicy(backoff_s=0.5, backoff_multiplier=1.0,
+                             max_backoff_s=3.0)
+        assert flat.backoff(6, rng=one) == 0.5
+        steep = RestartPolicy(backoff_s=0.5, backoff_multiplier=3.0,
+                              max_backoff_s=50.0)
+        assert steep.backoff(3, rng=one) == pytest.approx(4.5)
+
+    def test_backoff_draws_stay_under_ceiling(self):
+        p = RestartPolicy(backoff_s=0.1, max_backoff_s=1.0)
+        for k in range(1, 12):
+            ceil = p.backoff_ceiling(k)
+            for _ in range(32):
+                assert 0.0 <= p.backoff(k) <= ceil
+
+    def test_backoff_env_override(self, monkeypatch):
+        monkeypatch.setenv("FJT_RESTART_BASE_S", "0.25")
+        monkeypatch.setenv("FJT_RESTART_CAP_S", "0.5")
+        p = RestartPolicy(backoff_s=5.0, max_backoff_s=50.0)
+        assert p.backoff(1, rng=lambda: 1.0) == 0.25
+        assert p.backoff(4, rng=lambda: 1.0) == 0.5  # env cap wins
 
 
 class TestSupervisorUnit:
@@ -166,6 +194,34 @@ class TestSupervisorUnit:
                 ),
                 hold_s=0.3, timeout_s=10.0,
             ), sup.status()
+        finally:
+            sup.stop()
+
+    def test_restart_streak_exported_to_workers(self, tmp_path):
+        # the supervisor half of crash-loop fingerprinting: every
+        # incarnation is told how many consecutive failures preceded it
+        log = tmp_path / "streaks.log"
+        body = f"""
+        import os, sys, time
+        with open({str(log)!r}, "a") as f:
+            f.write(os.environ.get("FJT_RESTART_STREAK", "?") + "\\n")
+        n = len(open({str(log)!r}).read().split())
+        if n < 3:
+            sys.exit(1)
+        time.sleep(60)
+        """
+        sup = Supervisor(
+            [WorkerSpec("w0", _py(body))],
+            policy=RestartPolicy(max_restarts=5, backoff_s=0.01),
+            heartbeat_timeout_s=None,
+        )
+        sup.start()
+        try:
+            assert _wait(
+                lambda: log.exists()
+                and len(log.read_text().split()) >= 3, 15.0,
+            ), log.read_text() if log.exists() else "no log"
+            assert log.read_text().split()[:3] == ["0", "1", "2"]
         finally:
             sup.stop()
 

@@ -206,17 +206,198 @@ FAMILIES = {
 }
 
 
+# --chaos mode: one compiled model shared across every seed (the chaos
+# is in the FAULT composition, not the model)
+_CHAOS_MODEL = None
+
+
+def _chaos_model():
+    global _CHAOS_MODEL
+    if _CHAOS_MODEL is None:
+        import tempfile
+
+        from flink_jpmml_tpu.assets_gen import gen_gbm
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml_file
+
+        tmp = tempfile.mkdtemp(prefix="fjt-chaos-model-")
+        _CHAOS_MODEL = compile_pmml(
+            parse_pmml_file(
+                gen_gbm(tmp, n_trees=4, depth=3, n_features=5)
+            ),
+            batch_size=32,
+        )
+    return _CHAOS_MODEL
+
+
+def _soak_chaos(seed):
+    """One chaos iteration: a seeded random COMPOSITION of fault kinds
+    (broker death, slow fetch, dispatch delay, checkpoint failure,
+    worker wedge, poison records, decode poison — everything except
+    worker_crash, which would kill the soak process itself; the
+    kill-anywhere half lives in ``bench.py --recovery-drill``) against
+    a real Kafka→BlockPipeline stream with checkpoints + DLQ. Verifies
+    the delivery contract every time: every offset either reaches the
+    sink or sits in the DLQ, poison lands in the DLQ exactly, and the
+    stream drains to the end despite the weather."""
+    import os
+    import tempfile
+
+    from flink_jpmml_tpu.runtime import faults
+    from flink_jpmml_tpu.runtime.block import BlockPipeline
+    from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+    from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+    from flink_jpmml_tpu.runtime.kafka import (
+        KafkaBlockSource, MiniKafkaBroker,
+    )
+    from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+    rng = np.random.default_rng(seed)
+    cm = _chaos_model()
+    N = 1500
+    data = rng.normal(0, 1.0, size=(N, 5)).astype(np.float32)
+    tmp = tempfile.mkdtemp(prefix="fjt-chaos-")
+    broker = MiniKafkaBroker(topic="chaos")
+    pipe = None
+    try:
+        # interleave decode poison at random positions
+        decode_offsets = []
+        positions = sorted(
+            int(p) for p in rng.choice(
+                N, size=int(rng.integers(0, 3)), replace=False,
+            )
+        )
+        produced = 0
+        for p in positions:
+            broker.append_rows(data[produced:p])
+            decode_offsets.append(broker.append(b"chaff"))
+            produced = p
+        broker.append_rows(data[produced:])
+        total = N + len(decode_offsets)
+        # score poison via the harness, offsets in the log domain
+        score_poison = []
+        for _ in range(int(rng.integers(0, 3))):
+            o = int(rng.integers(0, total))
+            while o in decode_offsets or o in score_poison:
+                o = (o + 1) % total
+            score_poison.append(o)
+        spec = [
+            f"poison_record:offset={o}" for o in score_poison
+        ]
+        menu = [
+            f"slow_fetch:delay_ms=2:p=0.05:seed={seed}",
+            f"broker_death:n={int(rng.integers(1, 3))}"
+            f":p=0.02:seed={seed}",
+            f"dispatch_delay:delay_ms=1:p=0.05:seed={seed}",
+            f"checkpoint_fail:n={int(rng.integers(1, 3))}",
+            "worker_wedge:wedge_s=0.05:n=1",
+        ]
+        picks = rng.choice(
+            len(menu), size=int(rng.integers(1, len(menu) + 1)),
+            replace=False,
+        )
+        spec += [menu[i] for i in picks]
+        emitted = []
+
+        def sink(out, n, first_off):
+            emitted.append((first_off, n))
+
+        m = MetricsRegistry()
+        dlq = DeadLetterQueue(os.path.join(tmp, "ck", "dlq"), metrics=m)
+        src = KafkaBlockSource(
+            broker.host, broker.port, "chaos", n_cols=5,
+            max_wait_ms=10, metrics=m, dlq=dlq,
+        )
+        os.environ["FJT_RETRY_BASE_S"] = "0.01"
+        assert faults.install_from_env(",".join(spec)), spec
+        pipe = BlockPipeline(
+            src, cm, sink,
+            RuntimeConfig(
+                batch=BatchConfig(size=32, deadline_us=1000),
+                checkpoint_interval_s=0.05,
+            ),
+            metrics=m,
+            checkpoint=CheckpointManager(os.path.join(tmp, "ck")),
+            dlq=dlq,
+            max_dispatch_chunks=4,
+        )
+        pipe.start()
+        deadline = time.perf_counter() + 60.0
+        while (
+            pipe.committed_offset < total
+            and pipe._error is None
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.01)
+        pipe.stop()
+        pipe.join(timeout=20.0)
+        pipe = None
+        src.close()
+        assert pipe is None
+        covered = np.zeros(total, np.int64)
+        for off, n in emitted:
+            covered[off: off + n] += 1
+        quarantined = sorted(set(dlq.offsets()))
+        expected = sorted(set(decode_offsets) | set(score_poison))
+        assert quarantined == expected, (
+            f"chaos seed={seed}: DLQ {quarantined} != {expected} "
+            f"(spec {spec})"
+        )
+        missing = sorted(
+            int(o) for o in np.flatnonzero(covered == 0)
+        )
+        assert missing == expected, (
+            f"chaos seed={seed}: sink gaps {missing[:10]} != "
+            f"quarantined {expected} (spec {spec})"
+        )
+    finally:
+        faults.clear()
+        if pipe is not None:
+            try:
+                pipe.stop()
+                pipe.join(timeout=10.0)
+            except Exception:
+                pass
+        broker.close()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--families", default=",".join(FAMILIES))
     ap.add_argument("--seeds", type=int, default=50)
     ap.add_argument("--start", type=int, default=100_000)
+    ap.add_argument("--chaos", action="store_true",
+                    help="fault-composition soak instead of parity "
+                         "families: each seed drives a random mix of "
+                         "FJT_FAULTS kinds through a Kafka→pipeline "
+                         "stream and verifies the delivery contract "
+                         "(no loss, poison exactly in the DLQ)")
     args = ap.parse_args()
 
     import jax
 
     print(f"backend: {jax.default_backend()}", flush=True)
     failures = 0
+    if args.chaos:
+        t0 = time.perf_counter()
+        ok = 0
+        for s in range(args.start, args.start + args.seeds):
+            try:
+                _soak_chaos(s)
+                ok += 1
+            except AssertionError as e:
+                failures += 1
+                print(f"FAIL chaos seed={s}: {e}", flush=True)
+        dt = time.perf_counter() - t0
+        print(
+            f"chaos: {ok}/{args.seeds} seeds clean in {dt:.1f}s",
+            flush=True,
+        )
+        return 1 if failures else 0
     for fam in args.families.split(","):
         fam = fam.strip()
         if fam not in FAMILIES:

@@ -850,6 +850,30 @@ def check_drift_plane() -> None:
         assert busy_plane.stats()["sampled"] >= 2, busy_plane.stats()
 
 
+def check_recovery_drill() -> None:
+    """Delivery-correctness tripwire: the ``--recovery-drill`` engine
+    at smoke scale — one parent SIGKILL + poison records + decode
+    poison against a supervised Kafka pipeline. Asserts the kill →
+    restart → invariants chain: zero loss, bounded duplication,
+    parseable checkpoints, poison offsets exactly in the DLQ, and the
+    ``fjt-dlq redrive`` round-trip. The crash-loop (hard-poison)
+    convergence needs ~log2(batch) restarts, so it runs only in the
+    full ``bench.py --recovery-drill``, not here."""
+    from flink_jpmml_tpu.bench import run_recovery_drill
+
+    line = run_recovery_drill(
+        records=4_000, kills=1, poison=1, hard_poison=False,
+        decode_poison_n=1, timeout_s=120.0, max_restarts=20,
+        throttle_ms=25.0, kill_dwell=(0.05, 0.25),
+    )
+    assert line["ok"], line
+    assert line["parent_kills"] >= 1, line
+    assert line["restarts"] >= 1, line
+    assert line["redrive_ok"], line
+    assert len(line["quarantined"]) == 2, line  # 1 score + 1 decode
+    assert line["max_dup"] <= line["restarts"] + 1, line
+
+
 def check_fault_hooks_noop() -> None:
     """Fault harness zero-overhead contract: with FJT_FAULTS unset,
     fire() must be a global load + None check (≤ 2 µs even on a loaded
@@ -907,6 +931,8 @@ def main() -> int:
     print("perf-smoke: overload drill OK", flush=True)
     check_drift_plane()
     print("perf-smoke: drift plane OK", flush=True)
+    check_recovery_drill()
+    print("perf-smoke: recovery drill OK", flush=True)
     check_fault_hooks_noop()
     print("perf-smoke: fault hooks no-op OK", flush=True)
     timer.cancel()
