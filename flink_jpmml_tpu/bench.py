@@ -2036,8 +2036,13 @@ def run_recovery_drill(
     replay windows the restarts admit; every retained checkpoint
     parseable; watermarks monotone within each incarnation; the
     injected poison offsets land in the DLQ EXACTLY (and never in the
-    sink); no ``on_give_up`` fired; and ``fjt-dlq redrive`` round-trips
-    a quarantined record back through the live pipeline."""
+    sink); no ``on_give_up`` fired; ``fjt-dlq redrive`` round-trips
+    a quarantined record back through the live pipeline; and the
+    poison record's causal journey (obs/trace.py) reconstructs from
+    durable fragments alone — dispatch hops across the SIGKILL
+    incarnation boundary, suspect-mode bisection, the terminal DLQ
+    quarantine, and (post-redrive) the traceparent-linked re-ingest —
+    embedded in the artifact as ``journeys``/``trace``."""
     import signal
 
     import numpy as np
@@ -2125,6 +2130,7 @@ def run_recovery_drill(
         ckdir = os.path.join(tmp, "ck")
         outfile = os.path.join(tmp, "emissions.log")
         open(outfile, "w").close()
+        jdir = os.path.join(tmp, "journeys")
         worker_env = {
             "FJT_FAULTS": ",".join(fault_spec),
             "FJT_POISON_RESTARTS": "2",
@@ -2133,6 +2139,12 @@ def run_recovery_drill(
             "FJT_RETRY_BASE_S": "0.01",
             "FJT_XLA_CACHE": os.path.join(tmp, "xla"),
             "FJT_AUTOTUNE_CACHE": os.path.join(tmp, "autotune"),
+            # record-journey tracing (obs/trace.py): an armed fault
+            # plan flips the store to write-through, so every
+            # incarnation's dispatch hops are durable BEFORE its kill —
+            # the drill verifies the poison record's journey
+            # reconstructs from these fragments alone
+            "FJT_JOURNEY_DIR": jdir,
             "JAX_PLATFORMS": "cpu",
         }
         argv = [
@@ -2284,6 +2296,51 @@ def run_recovery_drill(
         if hard_off is not None:
             assert reasons[hard_off] == "crash_loop", reasons
 
+        # ---- kill-anywhere journey continuity (obs/trace.py) ---------
+        # the poison record's full journey must reconstruct from the
+        # durable fragments alone: ingest + the dispatch that died
+        # (incarnation boundary = pid change), suspect-mode bisection
+        # hops, and the terminal DLQ quarantine — fjt-trace's own
+        # merge/select logic does the reconstruction
+        from flink_jpmml_tpu.obs import trace as trace_lib  # noqa: F401
+
+        trace_target = (
+            hard_off if hard_off is not None
+            else (score_poison[0] if score_poison else None)
+        )
+        trace_info = None
+        sel: list = []
+        if trace_target is not None:
+            jrows = cli_mod._trace_rows_from_dir(tmp)
+            sel = cli_mod._trace_select(jrows, offset=trace_target)
+            kinds = {r.get("kind") for r in sel}
+            pids = sorted({
+                int(r["pid"]) for r in sel
+                if isinstance(r.get("pid"), int)
+            })
+            assert {"dlq", "dlq_envelope"} & kinds, (
+                f"poison journey at {trace_target} has no terminal "
+                f"DLQ hop (kinds {sorted(k for k in kinds if k)})"
+            )
+            assert {"dispatch", "suspect_dispatch"} & kinds, (
+                f"poison journey at {trace_target} has no dispatch "
+                f"hop (kinds {sorted(k for k in kinds if k)})"
+            )
+            if hard_off is not None:
+                # the crash-loop path: the marker-twin bisection hops
+                # and at least two incarnations must be visible
+                assert "suspect_dispatch" in kinds, sorted(kinds)
+                assert len(pids) >= 2, (
+                    f"no incarnation boundary in the journey "
+                    f"(pids {pids})"
+                )
+            trace_info = {
+                "offset": int(trace_target),
+                "kinds": sorted(k for k in kinds if k),
+                "pids": pids,
+                "rows": len(sel),
+            }
+
         # ---- redrive round-trip through the LIVE pipeline ------------
         redrive_off = score_poison[0] if score_poison else None
         redrive_ok = None
@@ -2322,6 +2379,29 @@ def run_recovery_drill(
             assert redrive_ok, (
                 "redriven record never reached the sink"
             )
+            # journey continuity through the redrive: the envelope's
+            # trace context rode the traceparent header back into the
+            # topic, so the redriven record's ingest hop is a CHILD of
+            # the original journey (same trace id, envelope span as
+            # parent) — pinned end-to-end through the live pipeline
+            env_tid = next(
+                (
+                    e.get("trace_id") for e in dlq_envs
+                    if int(e["offset"]) == redrive_off
+                    and e.get("trace_id")
+                ),
+                None,
+            )
+            assert env_tid is not None, "envelope lost its trace context"
+            jrows2 = cli_mod._trace_rows_from_dir(jdir)
+            redriven = [
+                r for r in jrows2
+                if r.get("redriven") and r.get("trace_id") == env_tid
+            ]
+            assert redriven, (
+                "redriven record's ingest hop does not link the "
+                f"original journey {env_tid}"
+            )
 
         ok = True
         return {
@@ -2340,6 +2420,13 @@ def run_recovery_drill(
             "max_dup": int(covered.max()),
             "checkpoints_verified": len(snaps),
             "redrive_ok": redrive_ok,
+            # the poison journey, reconstructed + embedded so
+            # `fjt-trace BENCH_*.json --grep offset=K` replays the
+            # timeline from the artifact alone
+            "trace": trace_info,
+            "journeys": (
+                sel[:512] if trace_info is not None else []
+            ),
             "elapsed_s": round(time.monotonic() - t0, 3),
         }
     finally:

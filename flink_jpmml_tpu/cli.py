@@ -48,6 +48,19 @@ list what's stored, or check a source against it:
 
     fjt-drift snapshot http://127.0.0.1:9100
     fjt-drift check http://127.0.0.1:9100   # exit 1 past --psi
+
+``fjt-trace``: reconstruct one record's causal journey (obs/trace.py)
+as an ordered timeline by merging journey rows + flight events + DLQ
+envelopes + trace-id'd spans across ALL worker incarnations — from a
+dump directory (journey store / flight dumps / DLQ / span files,
+scanned recursively), a live ``/trace`` endpoint (journeys + flight +
+the active span file's trace-id'd events; DLQ envelopes ride only the
+directory scan — the store's own ``dlq`` hops carry the quarantine
+either way), or a BENCH artifact:
+
+    fjt-trace /data/ckpt --grep offset=1374   # who touched record 1374?
+    fjt-trace http://127.0.0.1:9100 --slowest 5
+    fjt-trace BENCH_r13.json --id 3fa1…       # the fjt-top exemplar pivot
 """
 
 from __future__ import annotations
@@ -346,7 +359,7 @@ def _top_load(source: str) -> Dict[str, dict]:
     return out
 
 
-def _top_render(label: str, struct: dict, out) -> None:
+def _top_render(label: str, struct: dict, out, source: str = None) -> None:
     from flink_jpmml_tpu.obs import attr
 
     title = label or "aggregate"
@@ -411,11 +424,16 @@ def _top_render(label: str, struct: dict, out) -> None:
     if exemplars:
         exemplars.sort(reverse=True)
         print("exemplars (worst observed per bucket):", file=out)
+        # the attribution→journey pivot: an exemplar captured under an
+        # active journey context carries the journey's trace id, so the
+        # printed invocation reconstructs that record's whole timeline
+        src = source if source is not None else "<journey-source>"
         for v, tid, name in exemplars[:5]:
             print(
                 f"  {1000.0 * v:10.3f} ms  trace_id={tid}  {name}",
                 file=out,
             )
+            print(f"      ↳ fjt-trace {src} --id {tid}", file=out)
 
 
 def _top_render_freshness(label: str, struct: dict, out) -> None:
@@ -692,7 +710,11 @@ def top_main(argv: Optional[List[str]] = None) -> int:
         _top_render_freshness if args.freshness
         else _top_render_overload if args.overload
         else _top_render_drift if args.drift
-        else _top_render
+        else (
+            lambda label, struct, out: _top_render(
+                label, struct, out, source=args.source
+            )
+        )
     )
 
     def _render_once(sources) -> None:
@@ -890,6 +912,464 @@ def drift_main(argv: Optional[List[str]] = None) -> int:
     return rc
 
 
+# ---------------------------------------------------------------------------
+# fjt-trace: causal record-journey reconstruction (obs/trace.py)
+# ---------------------------------------------------------------------------
+
+# flight-event kinds worth placing on a journey timeline (others are
+# process-wide noise for this view); offset-carrying ones get their
+# range fields normalized below
+_TRACE_FLIGHT_KINDS = {
+    "poison_suspect_mode", "poison_suspect_exit", "poison_isolation",
+    "poison_isolated", "poison_quarantined", "latency_exemplar",
+    "decode_error", "dispatch_abandon", "dlq_truncated",
+    "worker_death", "worker_restart", "worker_spawn", "worker_give_up",
+    "fault_injected", "drift_alarm",
+}
+
+
+def _trace_norm_flight(ev: dict) -> Optional[dict]:
+    """Flight-recorder event → journey-row shape (None = not journey-
+    relevant). ``lo``/``hi`` and ``first``/``n`` normalize to the
+    journey rows' ``first_off``/``n`` so offset selection is uniform."""
+    kind = ev.get("kind")
+    if kind not in _TRACE_FLIGHT_KINDS:
+        return None
+    row = dict(ev)
+    row["src"] = "flight"
+    if "lo" in ev and "hi" in ev:
+        try:
+            row["first_off"] = int(ev["lo"])
+            row["n"] = int(ev["hi"]) - int(ev["lo"])
+        except (TypeError, ValueError):
+            pass
+    elif "first" in ev:
+        try:
+            row["first_off"] = int(ev["first"])
+            if ev.get("n") is not None:
+                row["n"] = int(ev["n"])
+        except (TypeError, ValueError):
+            pass
+    return row
+
+
+def _trace_norm_dlq(env: dict) -> dict:
+    return {
+        "t": env.get("t"),
+        "pid": env.get("pid"),
+        "kind": "dlq_envelope",
+        "offset": env.get("offset"),
+        "partition": env.get("partition"),
+        "reason": env.get("reason"),
+        "attempts": env.get("attempts"),
+        "fingerprint": env.get("fingerprint"),
+        "exception": env.get("exception"),
+        "trace_id": env.get("trace_id"),
+        "span_id": env.get("span_id"),
+        "src": "dlq",
+    }
+
+
+def _trace_norm_span(ev: dict) -> Optional[dict]:
+    """Chrome-trace span event → journey-row shape, ONLY when it
+    carries a trace id (an uncorrelated span belongs in Perfetto, not
+    here). Spans ride the monotonic clock, not unix time — they render
+    in their own section, never interleaved by wall clock."""
+    args = ev.get("args") or {}
+    tid = args.get("trace_id")
+    if not tid:
+        return None
+    row = {
+        "t": None,  # monotonic clock: not comparable to unix rows
+        "mono_us": ev.get("ts"),
+        "dur_us": ev.get("dur"),
+        "pid": ev.get("pid"),
+        "kind": f"span:{ev.get('name')}",
+        "trace_id": tid,
+        "span_id": args.get("span_id"),
+        "src": "span",
+    }
+    for k in ("first_off", "n", "offset"):
+        if args.get(k) is not None:
+            row[k] = args[k]
+    return row
+
+
+def _trace_rows_from_dir(directory: str) -> List[Dict[str, Any]]:
+    """Recursively scan a dump directory for every durable journey
+    fragment: journey-store segments (``journeys-*.jsonl``), flight
+    dumps (``flight-*.jsonl``), DLQ segments (``dlq-*.jsonl``), and
+    span files (``spans-*.trace.json``). Torn/garbage lines skip (the
+    shared tolerant reader, ``obs.trace.iter_jsonl``)."""
+    from flink_jpmml_tpu.obs.trace import iter_jsonl as _jsonl
+
+    rows: List[Dict[str, Any]] = []
+    for root, _dirs, names in os.walk(directory):
+        for nm in sorted(names):
+            path = os.path.join(root, nm)
+            if nm.startswith("journeys-") and nm.endswith(".jsonl"):
+                for obj in _jsonl(path):
+                    obj.setdefault("src", "journey")
+                    rows.append(obj)
+            elif nm.startswith("flight-") and nm.endswith(".jsonl"):
+                for obj in _jsonl(path):
+                    norm = _trace_norm_flight(obj)
+                    if norm is not None:
+                        rows.append(norm)
+            elif nm.startswith("dlq-") and nm.endswith(".jsonl"):
+                for obj in _jsonl(path):
+                    rows.append(_trace_norm_dlq(obj))
+            elif nm.startswith("spans-") and nm.endswith(".trace.json"):
+                for obj in _jsonl(path):
+                    norm = _trace_norm_span(obj)
+                    if norm is not None:
+                        rows.append(norm)
+    return rows
+
+
+def _trace_load(source: str) -> List[Dict[str, Any]]:
+    """→ normalized journey rows from a dump directory, a live
+    ``/trace`` endpoint, or a BENCH artifact's embedded ``journeys``."""
+    if source.startswith(("http://", "https://")):
+        import urllib.error
+        import urllib.request
+
+        url = source.rstrip("/")
+        if not url.endswith("/trace"):
+            url += "/trace"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                payload = json.loads(r.read().decode())
+        except (urllib.error.URLError, OSError,
+                json.JSONDecodeError) as e:
+            raise SystemExit(f"cannot read {url!r}: {e}")
+        rows = []
+        for obj in payload.get("journeys") or []:
+            if isinstance(obj, dict):
+                obj.setdefault("src", "journey")
+                rows.append(obj)
+        for ev in payload.get("flight") or []:
+            if isinstance(ev, dict):
+                norm = _trace_norm_flight(ev)
+                if norm is not None:
+                    rows.append(norm)
+        for ev in payload.get("spans") or []:
+            if isinstance(ev, dict):
+                norm = _trace_norm_span(ev)
+                if norm is not None:
+                    rows.append(norm)
+        return rows
+    if os.path.isdir(source):
+        return _trace_rows_from_dir(source)
+    try:
+        with open(source, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise SystemExit(f"cannot read {source!r}: {e}")
+    if not isinstance(payload, dict):
+        raise SystemExit(f"{source!r} is not a JSON object")
+    if isinstance(payload.get("parsed"), dict):
+        payload = payload["parsed"]  # the bench driver's artifact wrap
+    rows = payload.get("journeys")
+    if rows is None:
+        for v in payload.values():  # one nested level (drill sub-line)
+            if isinstance(v, dict) and isinstance(v.get("journeys"), list):
+                rows = v["journeys"]
+                break
+    if not isinstance(rows, list):
+        raise SystemExit(
+            f"no journey rows in {source!r} (need a dump dir, a /trace "
+            "URL, or an artifact with an embedded 'journeys' list)"
+        )
+    out = []
+    for obj in rows:
+        if isinstance(obj, dict):
+            obj.setdefault("src", "journey")
+            out.append(obj)
+    return out
+
+
+def _trace_row_covers(row: dict, offset: int) -> bool:
+    if row.get("offset") is not None:
+        try:
+            if int(row["offset"]) == offset:
+                return True
+        except (TypeError, ValueError):
+            pass
+    fo = row.get("first_off")
+    if fo is not None:
+        try:
+            fo = int(fo)
+            n = int(row.get("n") or 1)
+            return fo <= offset < fo + n
+        except (TypeError, ValueError):
+            return False
+    return False
+
+
+def _trace_select(
+    rows: List[dict],
+    trace_id: Optional[str] = None,
+    offset: Optional[int] = None,
+) -> List[dict]:
+    """The journey join: rows matching the selector directly, expanded
+    one round through what the direct matches carry — an offset pulls
+    in the trace ids of every batch that contained it (other
+    incarnations' fragments), a trace id pulls in the per-record
+    terminal hops (dlq/shed — minted under per-RECORD ids) whose
+    offset falls inside its batches' ``(first_off, n)`` ranges, so the
+    fjt-top exemplar pivot's timeline shows a quarantine that happened
+    inside the slow batch."""
+    direct = []
+    id_ranges: List[tuple] = []  # (lo, hi) of rows matched BY trace id
+    for r in rows:
+        if trace_id is not None and r.get("trace_id") == trace_id:
+            direct.append(r)
+            fo, n = r.get("first_off"), r.get("n")
+            if fo is not None:
+                try:
+                    id_ranges.append((int(fo), int(fo) + int(n or 1)))
+                except (TypeError, ValueError):
+                    pass
+        elif offset is not None and _trace_row_covers(r, offset):
+            direct.append(r)
+    ids = {r["trace_id"] for r in direct if r.get("trace_id")}
+    offsets = set()
+    if offset is not None:
+        offsets.add(offset)
+    for r in direct:
+        if r.get("offset") is not None:
+            try:
+                offsets.add(int(r["offset"]))
+            except (TypeError, ValueError):
+                pass
+    direct_ids = {id(r) for r in direct}
+
+    def _off_in_id_ranges(r: dict) -> bool:
+        # only rows with an EXPLICIT per-record offset join through a
+        # batch range (range∩range would let a fetch-run ingest row
+        # matched by offset pull in every batch it ever fed)
+        if not id_ranges or r.get("offset") is None:
+            return False
+        try:
+            o = int(r["offset"])
+        except (TypeError, ValueError):
+            return False
+        return any(lo <= o < hi for lo, hi in id_ranges)
+
+    seen = set()
+    out = []
+    for r in rows:
+        match = (
+            id(r) in direct_ids
+            or (r.get("trace_id") in ids)
+            or any(_trace_row_covers(r, o) for o in offsets)
+            or _off_in_id_ranges(r)
+        )
+        if not match:
+            continue
+        key = id(r)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(r)
+    return out
+
+
+def _trace_render(rows: List[dict], out, title: str = "journey") -> None:
+    timed = [r for r in rows if isinstance(r.get("t"), (int, float))]
+    spans_ = [r for r in rows if r.get("src") == "span"]
+    timed.sort(key=lambda r: r["t"])
+    ids = sorted({
+        str(r["trace_id"])[:12] for r in rows if r.get("trace_id")
+    })
+    print(f"== {title} · trace ids [{', '.join(ids) or '-'}] ==",
+          file=out)
+    if not timed:
+        print("(no timeline rows matched)", file=out)
+    t0 = timed[0]["t"] if timed else 0.0
+    last_pid = None
+    for r in timed:
+        pid = r.get("pid")
+        if last_pid is not None and pid is not None and pid != last_pid:
+            print(
+                f"-- incarnation boundary: pid {last_pid} → pid {pid} --",
+                file=out,
+            )
+        if pid is not None:
+            last_pid = pid
+        where = ""
+        if r.get("offset") is not None:
+            where = f"offset={r['offset']}"
+        elif r.get("first_off") is not None:
+            n = r.get("n")
+            where = (
+                f"[{r['first_off']}..{int(r['first_off']) + int(n)})"
+                if n is not None else f"@{r['first_off']}"
+            )
+        detail = "  ".join(
+            f"{k}={r[k]}" for k in (
+                "reason", "lane", "attempts", "restarts", "latency_s",
+                "sampled", "stage", "seconds", "model", "error",
+                "exception", "redriven",
+            )
+            if r.get(k) not in (None, False)
+        )
+        tid = str(r.get("trace_id") or "")[:8]
+        sid = str(r.get("span_id") or "")[:8]
+        par = str(r.get("parent_id") or "")[:8]
+        link = f"{tid}/{sid}" + (f"<-{par}" if par else "")
+        print(
+            f"+{r['t'] - t0:9.3f}s  pid {pid or '?':>7}  "
+            f"{r.get('kind', '?'):<18} {where:<18} {link:<28} {detail}",
+            file=out,
+        )
+    if spans_:
+        print("spans (monotonic clock, per pid — not wall-aligned):",
+              file=out)
+        spans_.sort(key=lambda r: (r.get("pid") or 0,
+                                   r.get("mono_us") or 0))
+        for r in spans_[:64]:
+            dur = r.get("dur_us")
+            print(
+                f"  pid {r.get('pid') or '?':>7}  "
+                f"{r.get('kind', '?'):<24} "
+                f"dur {0.0 if dur is None else dur / 1000.0:9.3f} ms  "
+                f"trace {str(r.get('trace_id'))[:8]}",
+                file=out,
+            )
+
+
+def _trace_summary(rows: List[dict], out, limit: int) -> None:
+    """No selector: one line per known journey, newest last."""
+    by_id: Dict[str, List[dict]] = {}
+    for r in rows:
+        tid = r.get("trace_id")
+        if tid:
+            by_id.setdefault(str(tid), []).append(r)
+    if not by_id:
+        print("(no journeys found)", file=out)
+        return
+    items = sorted(
+        by_id.items(),
+        key=lambda kv: max(
+            (r.get("t") or 0) for r in kv[1]
+        ),
+    )[-limit:]
+    print(f"{'TRACE':<14}{'HOPS':>5}  {'KINDS':<40} OFFSETS", file=out)
+    for tid, rs in items:
+        kinds = sorted({r.get("kind", "?") for r in rs})
+        offs = sorted({
+            int(r["first_off"]) for r in rs
+            if r.get("first_off") is not None
+        } | {
+            int(r["offset"]) for r in rs
+            if r.get("offset") is not None
+        })
+        off_s = (
+            f"{offs[0]}..{offs[-1]}" if len(offs) > 1
+            else (str(offs[0]) if offs else "-")
+        )
+        print(
+            f"{tid[:12]:<14}{len(rs):>5}  "
+            f"{','.join(kinds)[:40]:<40} {off_s}",
+            file=out,
+        )
+    print(
+        f"{len(by_id)} journey(s); fjt-trace <source> --id <TRACE> or "
+        "--grep offset=K for a timeline",
+        file=out,
+    )
+
+
+def trace_main(argv: Optional[List[str]] = None) -> int:
+    """``fjt-trace``: reconstruct causal record journeys (see module
+    docstring) — no jax import, safe on any host."""
+    ap = argparse.ArgumentParser(
+        prog="fjt-trace",
+        description="Reconstruct a record's causal journey from journey "
+                    "rows, flight events, DLQ envelopes, and spans.",
+    )
+    ap.add_argument("source",
+                    help="dump directory (journey store / checkpoint "
+                         "dir — scanned recursively), obs-server base "
+                         "URL (its /trace endpoint), or a BENCH "
+                         "artifact with embedded journeys")
+    ap.add_argument("--id", dest="trace_id", default=None,
+                    help="render the journey with this trace id (the "
+                         "id an fjt-top exemplar row shows)")
+    ap.add_argument("--grep", default=None, metavar="KEY=VAL",
+                    help="find journeys without knowing ids; supported: "
+                         "offset=K (every fragment whose offset range "
+                         "contains record K)")
+    ap.add_argument("--slowest", type=int, default=None, metavar="N",
+                    help="rank completed journeys by sink latency and "
+                         "list the N slowest (with their trace ids)")
+    ap.add_argument("--limit", type=int, default=32,
+                    help="journeys shown in the no-selector summary "
+                         "(default 32)")
+    args = ap.parse_args(argv)
+
+    rows = _trace_load(args.source)
+    offset = None
+    if args.grep is not None:
+        key, _, val = args.grep.partition("=")
+        if key.strip() != "offset" or not val.strip():
+            raise SystemExit(
+                f"unsupported --grep {args.grep!r} (supported: offset=K)"
+            )
+        try:
+            offset = int(val)
+        except ValueError:
+            raise SystemExit(f"--grep offset wants an int, got {val!r}")
+
+    if args.slowest is not None:
+        sinks = [
+            r for r in rows
+            if r.get("kind") == "sink"
+            and isinstance(r.get("latency_s"), (int, float))
+        ]
+        sinks.sort(key=lambda r: -float(r["latency_s"]))
+        if not sinks:
+            print("(no completed journeys with latencies)",
+                  file=sys.stdout)
+            return 0
+        print(f"{'LATENCY':>11}  {'TRACE':<14}{'RANGE':<18}PID",
+              file=sys.stdout)
+        for r in sinks[: args.slowest]:
+            fo, n = r.get("first_off"), r.get("n")
+            rng = (
+                f"[{fo}..{int(fo) + int(n)})"
+                if fo is not None and n is not None else "-"
+            )
+            print(
+                f"{1000.0 * float(r['latency_s']):9.3f}ms  "
+                f"{str(r.get('trace_id'))[:12]:<14}{rng:<18}"
+                f"{r.get('pid', '?')}",
+                file=sys.stdout,
+            )
+        print("pivot: fjt-trace <source> --id <TRACE>", file=sys.stdout)
+        return 0
+
+    if args.trace_id is None and offset is None:
+        _trace_summary(rows, sys.stdout, max(1, args.limit))
+        return 0
+
+    sel = _trace_select(rows, trace_id=args.trace_id, offset=offset)
+    if not sel:
+        raise SystemExit(
+            "no fragments matched "
+            + (f"trace id {args.trace_id!r}" if args.trace_id
+               else f"offset {offset}")
+        )
+    title = (
+        f"offset {offset}" if offset is not None
+        else f"id {str(args.trace_id)[:12]}"
+    )
+    _trace_render(sel, sys.stdout, title=title)
+    return 0
+
+
 def _dlq_open(directory: str):
     """Accept either the DLQ directory itself or the checkpoint
     directory it sits beside (``<ckpt>/dlq`` — the pipelines' default
@@ -1053,9 +1533,22 @@ def dlq_main(argv: Optional[List[str]] = None) -> int:
                 part = e.get("partition")
             if part is None:
                 part = 0
+            # journey continuity (obs/trace.py): the envelope carries
+            # the quarantined record's trace context — stamp it back
+            # into the topic as a traceparent record header, so the
+            # redriven record's ingest opens a CHILD span of the
+            # original journey instead of starting an unlinked one
+            headers = None
+            tid, sid = e.get("trace_id"), e.get("span_id")
+            if tid and sid:
+                from flink_jpmml_tpu.obs.trace import TraceContext
+
+                tp = TraceContext(str(tid), str(sid)).to_traceparent()
+                headers = [[("traceparent", tp.encode("ascii"))]]
             try:
                 base = client.produce(
-                    args.topic, int(part), [payload_bytes(e)]
+                    args.topic, int(part), [payload_bytes(e)],
+                    headers=headers,
                 )
             except (OSError, ConnectionError, KafkaProtocolError) as ex:
                 raise SystemExit(

@@ -38,7 +38,12 @@ the outside:
   (``FJT_DRIFT_SAMPLE``), a content-addressed baseline registry beside
   the autotune cache, and windowed PSI/JS drift monitoring with
   alarm/clear hysteresis — the first sensor plane that sees the
-  payload, not the system.
+  payload, not the system;
+- :mod:`flink_jpmml_tpu.obs.trace` — the causal layer joining all of
+  the above: deterministic per-record trace contexts propagated
+  through the real paths (Kafka ``traceparent`` record headers
+  included), a tail-sampled journey store (``FJT_JOURNEY_DIR``), the
+  ``/trace`` endpoint, and the ``fjt-trace`` timeline reconstructor.
 """
 
 from flink_jpmml_tpu.obs.recorder import FlightRecorder, record  # noqa: F401

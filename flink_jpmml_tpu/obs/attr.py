@@ -63,6 +63,7 @@ from typing import Dict, Optional
 
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import spans
+from flink_jpmml_tpu.obs import trace as trace_mod
 from flink_jpmml_tpu.utils.metrics import Histogram, MetricsRegistry
 
 STAGES = (
@@ -154,6 +155,13 @@ class StageLedger:
             h = self._hist(stage)
         idx = h.bucket_index(seconds)
         exemplar = None
+        # journey linkage (obs/trace.py): with a record-journey context
+        # active on this thread, the exemplar id IS the journey's trace
+        # id — the fjt-top exemplar row pivots straight to fjt-trace —
+        # and capturing one marks the journey interesting, which is
+        # exactly the "top-latency journeys survive tail-sampling"
+        # policy (the exemplar path already decides what the tail is)
+        jctx = trace_mod.current()
         with self._mu:
             st = self._ex_state.get(stage)
             # st = [max bucket idx seen, last capture t, hits since check]
@@ -163,7 +171,9 @@ class StageLedger:
                 st[0] = idx
                 st[1] = time.monotonic()
                 st[2] = 0
-                exemplar = new_trace_id()
+                exemplar = (
+                    jctx.trace_id if jctx is not None else new_trace_id()
+                )
             elif idx == st[0]:
                 # the steady-state outcome for a stage whose tail sits
                 # in one bucket: an int compare, no clock read
@@ -173,7 +183,14 @@ class StageLedger:
                     now = time.monotonic()
                     if now - st[1] >= _EXEMPLAR_MIN_PERIOD_S:
                         st[1] = now
-                        exemplar = new_trace_id()
+                        exemplar = (
+                            jctx.trace_id if jctx is not None
+                            else new_trace_id()
+                        )
+        if exemplar is not None and jctx is not None:
+            jstore = trace_mod.store_for(self._metrics_ref())
+            if jstore is not None:
+                jstore.mark(jctx.trace_id, "exemplar")
         if exemplar is not None:
             w = spans.writer()
             flight.record(

@@ -1014,6 +1014,15 @@ class DriftMonitor:
         if transition == "alarm":
             if reg is not None:
                 reg.counter("drift_alarms").inc()
+                # journey tail-sampling hook (obs/trace.py): keep the
+                # next few finishing record journeys so the timeline
+                # AROUND the drift alarm survives — "drift-alarmed"
+                # is one of the interesting-journey classes
+                from flink_jpmml_tpu.obs import trace as trace_mod
+
+                jstore = trace_mod.store_for(reg)
+                if jstore is not None:
+                    jstore.note_alarm("drift")
             flight.record(
                 "drift_alarm", model=label, feature=feat_out,
                 psi=round(score, 4), threshold=self.psi_alarm,

@@ -16,6 +16,10 @@ same discipline as utils/netio.py's hand-rolled framing):
   callback adds); HTTP 503 when the callback says not-ok.
 - ``/varz`` — the raw JSON snapshot(s), the same struct format the
   heartbeats piggyback and BENCH artifacts embed.
+- ``/trace`` — the record-journey payload (obs/trace.py): this
+  process's durable journey rows, its live flight-ring events, and the
+  active span file — what ``fjt-trace <url>`` reconstructs timelines
+  from.
 
 Sources are pluggable: a single registry
 (:meth:`ObsServer.for_registry`) or a callable returning
@@ -169,10 +173,16 @@ class ObsServer:
         port: int = 0,
         health_fn: Optional[Callable[[], dict]] = None,
         varz_fn: Optional[Callable[[], dict]] = None,
+        trace_fn: Optional[Callable[[], dict]] = None,
     ):
         self._collect = collect
         self._health = health_fn
         self._varz = varz_fn
+        # /trace: the record-journey payload (obs/trace.py) — durable
+        # journey rows + the live flight ring + the active span file,
+        # so `fjt-trace <url>` reconstructs without filesystem access.
+        # Default: this process's journey store, when one is armed.
+        self._trace = trace_fn
         obs = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -226,6 +236,18 @@ class ObsServer:
                             json.dumps(payload, default=repr),
                             "application/json",
                         )
+                    elif path == "/trace":
+                        if obs._trace is not None:
+                            payload = obs._trace()
+                        else:
+                            from flink_jpmml_tpu.obs import trace as tm
+
+                            payload = tm.trace_payload()
+                        self._reply(
+                            200,
+                            json.dumps(payload, default=repr),
+                            "application/json",
+                        )
                     else:
                         self._reply(404, "not found\n", "text/plain")
                 except Exception as e:  # a scrape must never kill serving
@@ -244,6 +266,10 @@ class ObsServer:
 
     @classmethod
     def for_registry(cls, metrics: MetricsRegistry, **kw) -> "ObsServer":
+        if "trace_fn" not in kw:
+            from flink_jpmml_tpu.obs import trace as tm
+
+            kw["trace_fn"] = lambda: tm.trace_payload(metrics)
         return cls(lambda: {None: metrics}, **kw)
 
     @property

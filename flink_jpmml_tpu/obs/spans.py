@@ -38,6 +38,8 @@ import threading
 import time
 from typing import List, Optional
 
+from flink_jpmml_tpu.obs import trace as trace_mod
+
 _DIR_ENV = "FJT_TRACE_DIR"
 _MAX_ENV = "FJT_TRACE_MAX_MB"
 
@@ -180,6 +182,16 @@ def enabled() -> bool:
 def emit(name: str, t0_s: float, dur_s: float, **args) -> None:
     w = writer()
     if w is not None:
+        # causal linkage (obs/trace.py): when a journey context is
+        # active on this thread, every span — StageTimer stages,
+        # annotate blocks, featurize/h2d/readback/sink — carries the
+        # journey's trace/span ids, so fjt-trace can attach the span
+        # timeline to the record journey it belongs to. One
+        # thread-local read; only paid when tracing is on at all.
+        ctx = trace_mod.current()
+        if ctx is not None and "trace_id" not in args:
+            args["trace_id"] = ctx.trace_id
+            args["span_id"] = ctx.span_id
         w.emit(name, t0_s, dur_s, **args)
 
 

@@ -19,6 +19,7 @@ side.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, List, Optional, Tuple
@@ -33,6 +34,7 @@ from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import spans
+from flink_jpmml_tpu.obs import trace as trace_mod
 from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.runtime.dlq import (
@@ -471,6 +473,15 @@ class BlockPipelineBase:
             # marker from a range that later committed: stale
             self._death_marker = None
             self._fingerprint.clear_marker()
+        jstore = trace_mod.store_for(self.metrics)
+        if jstore is not None:
+            # the incarnation boundary, durable: fjt-trace renders the
+            # pid change + the committed offset this restore resumed at
+            jstore.hop(
+                "restore", trace_mod.context_for(committed),
+                first_off=committed, durable=True,
+                restarts=max(count - 1, streak),
+            )
         threshold = env_count("FJT_POISON_RESTARTS", 3)
         if max(count - 1, streak) >= threshold:
             # count-1: the FIRST restore at an offset is a normal
@@ -480,6 +491,17 @@ class BlockPipelineBase:
                 hi = committed + self._batch_size
             self._suspect_until = hi
             self._suspect_gauge.set(1.0)
+            if jstore is not None:
+                # suspect mode flips the journey store to write-through:
+                # every hop of the bisection protocol must be on disk
+                # BEFORE a process-killing record strikes again — the
+                # marker protocol's observability twin
+                jstore.write_through = True
+                jstore.hop(
+                    "suspect_mode", trace_mod.context_for(committed),
+                    first_off=committed, n=hi - committed, durable=True,
+                    restarts=max(count - 1, streak),
+                )
             flight.record(
                 "poison_suspect_mode", lo=committed, hi=hi,
                 restarts=max(count - 1, streak),
@@ -712,14 +734,18 @@ class BlockPipelineBase:
         entry carries no retained batch (shed no-ops)."""
         if self._dlq is None or meta is None or len(meta) < 7:
             return False
-        n, first_off, t_start, shed, handle, X, offsets = meta
+        n, first_off, t_start, shed, handle, X, offsets = meta[:7]
         if shed or X is None or offsets is None:
             return False
-        self._suspect_scan(handle, X, offsets, error=error)
+        self._suspect_scan(
+            handle, X, offsets, error=error,
+            ctx=meta[7] if len(meta) > 7 else None,
+        )
         return True
 
     def _suspect_scan(
-        self, handle, X, offsets, error, persist: bool = False
+        self, handle, X, offsets, error, persist: bool = False,
+        ctx=None,
     ) -> None:
         """Bisection ("suspect mode") over one failed batch: dispatch
         halves synchronously until the offending record(s) are single —
@@ -743,10 +769,22 @@ class BlockPipelineBase:
         records_out = self.metrics.counter("records_out")
         cap = env_count("FJT_DLQ_MAX_PER_BATCH", 32)
         state = {"q": 0}
+        # journey trail (obs/trace.py): isolation is exactly the story
+        # fjt-trace exists to tell, so every bisection hop is durable
+        jstore = trace_mod.store_for(self.metrics)
+        if ctx is None and jstore is not None:
+            ctx = trace_mod.context_for(int(offsets[0]))
+        if jstore is not None:
+            jstore.hop(
+                "suspect_scan", ctx, int(offsets[0]), n, durable=True,
+                persist=persist,
+                error=None if error is None else repr(error),
+            )
         flight.record(
             "poison_isolation",
             first=int(offsets[0]), n=n, persist=persist,
             error=None if error is None else repr(error),
+            trace_id=None if ctx is None else ctx.trace_id,
         )
         self._suspect_gauge.set(1.0)
 
@@ -757,9 +795,22 @@ class BlockPipelineBase:
                 )
             state["q"] += 1
             off = int(offsets[i])
+            # the terminal hop + the envelope's trace context: the ids
+            # the DLQ carries are what fjt-dlq redrive stamps into the
+            # traceparent header, linking the redriven journey segment
+            rctx = trace_mod.TraceContext(
+                trace_mod.trace_id_for(off),
+                parent_id=None if ctx is None else ctx.span_id,
+            )
+            if jstore is not None:
+                jstore.terminal(
+                    "dlq", rctx, offset=off, reason=reason,
+                    attempts=attempts,
+                )
             self._dlq.quarantine(
                 X[i].tobytes(), offset=off, reason=reason, error=exc,
                 attempts=attempts, model=getattr(handle, "key", None),
+                trace_id=rctx.trace_id, span_id=rctx.span_id,
             )
             if freshness is not None:
                 # a quarantined record was DROPPED, not delivered: its
@@ -772,6 +823,11 @@ class BlockPipelineBase:
             first = int(offsets[lo])
             self._emit(out, n_run, first, decode)
             records_out.inc(n_run)
+            if jstore is not None:
+                jstore.hop(
+                    "sink", ctx.child(), first, n_run, durable=True,
+                    isolated=True,
+                )
             if freshness is not None:
                 freshness.observe_sink(first, n_run)
 
@@ -814,6 +870,16 @@ class BlockPipelineBase:
                 ):
                     attempts = dm.get("attempts", 1) + 1
                 self._fingerprint.write_marker(off_lo, off_hi, attempts)
+                if jstore is not None:
+                    # the marker's journey twin, written BEFORE the
+                    # sub-dispatch: if this range kills the process the
+                    # hop survives — "the dispatch that died" stays
+                    # visible across the incarnation boundary
+                    jstore.hop(
+                        "suspect_dispatch", ctx.child(),
+                        off_lo, off_hi - off_lo, durable=True,
+                        attempts=attempts,
+                    )
             try:
                 out, decode = self._dispatch_checked(
                     handle, X[lo:hi], n_sub, offsets[lo:hi]
@@ -859,6 +925,18 @@ class BlockPipelineBase:
         if self._fingerprint is not None:
             self._fingerprint.clear_marker()
         self._suspect_gauge.set(0.0)
+        jstore = trace_mod.store_for(self.metrics)
+        if jstore is not None:
+            jstore.hop(
+                "suspect_exit",
+                trace_mod.context_for(self.committed_offset),
+                first_off=self.committed_offset, durable=True,
+            )
+            # back to tail-sampled buffering — unless a fault drill (or
+            # FJT_JOURNEY_SYNC) armed write-through for the process
+            jstore.write_through = bool(
+                faults.active() or os.environ.get("FJT_JOURNEY_SYNC")
+            )
 
     # -- internals ---------------------------------------------------------
 
@@ -906,6 +984,10 @@ class BlockPipelineBase:
         # are sketched at the sink, features already rode
         # dispatch_quantized; its monitor ticks from these record calls
         dplane = drift_mod.plane_for(self.metrics)
+        # record-journey tracing (obs/trace.py): None unless
+        # FJT_JOURNEY_DIR armed it — one env check at loop start, and
+        # with it None every per-batch site below is a None test
+        jstore = trace_mod.store_for(self.metrics)
         ring_occ = self.metrics.gauge("ring_occupancy")
         ring_cap = float(max(self._config.batch.queue_capacity, 1))
 
@@ -919,6 +1001,7 @@ class BlockPipelineBase:
             stamps without ever touching the sink — the drop is
             explicit, bounded, and replay-consistent."""
             n, first_off, t_start, shed = meta[:4]
+            jctx = meta[7] if len(meta) > 7 else None
             if first_off < self._replay_until:
                 # at-least-once replay accounting: records below the
                 # previous incarnation's in-flight high-water mark are
@@ -934,11 +1017,19 @@ class BlockPipelineBase:
                 return
             out, decode = pair
             t_sink = time.monotonic()
-            self._emit(out, n, first_off, decode)
-            t_done = time.monotonic()
-            spans.emit("sink", t_sink, t_done - t_sink, n=n)
-            if ledger is not None:
-                ledger.observe("sink", t_done - t_sink)
+            # the completing batch's OWN context wraps the sink: its
+            # span (and any exemplar the sink stage captures) must
+            # carry THIS journey's ids, not whichever batch the score
+            # loop happens to be launching right now
+            with trace_mod.use(jctx):
+                self._emit(out, n, first_off, decode)
+                t_done = time.monotonic()
+                spans.emit(
+                    "sink", t_sink, t_done - t_sink, n=n,
+                    first_off=first_off,
+                )
+                if ledger is not None:
+                    ledger.observe("sink", t_done - t_sink)
             if dplane is not None:
                 # score-distribution sketch at the sink (sampled): shed
                 # batches never reach here, so a shed record can no
@@ -947,6 +1038,13 @@ class BlockPipelineBase:
                     getattr(decode, "model_hash", None)
                     or getattr(decode, "model_key", None),
                     out, n,
+                )
+            if jstore is not None and jctx is not None:
+                # the sink hop closes the journey: tail-sampling keeps
+                # it only if it is interesting (exemplar-marked, head
+                # sample, terminal elsewhere)
+                jstore.finish(
+                    jctx, first_off, n, latency_s=t_done - t_start,
                 )
             lat.observe(t_done - t_start)
             records_out.inc(n)
@@ -1063,6 +1161,15 @@ class BlockPipelineBase:
                         # entry is UNACCOUNTED (no device work — it
                         # must not dilute the dispatch counters the
                         # pressure score divides by)
+                        if jstore is not None and n:
+                            # the shed decision IS the journey's point:
+                            # terminal hop, always kept
+                            jstore.terminal(
+                                "shed",
+                                trace_mod.context_for(int(offsets[0])),
+                                int(offsets[0]), n,
+                                lane=self._shed_lane,
+                            )
                         disp.launch(
                             lambda: None,
                             meta=(
@@ -1099,7 +1206,11 @@ class BlockPipelineBase:
                     # commit contract both need nothing else in flight.
                     disp.flush()
                     self._suspect_scan(
-                        handle, X, offsets, error=None, persist=True
+                        handle, X, offsets, error=None, persist=True,
+                        ctx=(
+                            trace_mod.context_for(first_off)
+                            if jstore is not None else None
+                        ),
                     )
                     if self.committed_offset >= self._suspect_until:
                         self._exit_suspect_mode()
@@ -1119,25 +1230,44 @@ class BlockPipelineBase:
                         "dispatch", int(offsets[0]) if n else None, n
                     )
                 t_start = time.monotonic()
-                try:
-                    disp.launch(
-                        lambda h=handle, X=X, n=n, o=offsets: (
-                            self._dispatch_checked(h, X, n, o)
-                        ),
-                        meta=(
-                            n, first_off, t_start, False,
-                            handle, X if self._dlq is not None else None,
-                            offsets if self._dlq is not None else None,
-                        ),
-                        # opts this launch into the sampled device-timing
-                        # pool (rate-limited; obs/profiler.py) — the live
-                        # MFU/membw gauges and the kernel cost ledger;
-                        # skipped entirely when profiling is off
-                        profile=(
-                            attr_mod.dispatch_profile(handle, n)
-                            if disp.profiling else None
-                        ),
+                # the batch's journey context: trace id derived purely
+                # from first_off (deterministic across incarnations and
+                # — later — chips), one dispatch hop per BATCH so the
+                # fan-out to per-record journeys costs nothing per
+                # record; active around the launch so the featurize/
+                # h2d/readback spans and any exemplar carry its ids
+                jctx = (
+                    trace_mod.context_for(first_off)
+                    if jstore is not None else None
+                )
+                if jstore is not None:
+                    jstore.hop(
+                        "dispatch", jctx, first_off, n,
+                        model=getattr(handle, "key", None),
                     )
+                try:
+                    with trace_mod.use(jctx):
+                        disp.launch(
+                            lambda h=handle, X=X, n=n, o=offsets: (
+                                self._dispatch_checked(h, X, n, o)
+                            ),
+                            meta=(
+                                n, first_off, t_start, False,
+                                handle,
+                                X if self._dlq is not None else None,
+                                offsets if self._dlq is not None else None,
+                                jctx,
+                            ),
+                            # opts this launch into the sampled
+                            # device-timing pool (rate-limited;
+                            # obs/profiler.py) — the live MFU/membw
+                            # gauges and the kernel cost ledger; skipped
+                            # entirely when profiling is off
+                            profile=(
+                                attr_mod.dispatch_profile(handle, n)
+                                if disp.profiling else None
+                            ),
+                        )
                 except PoisonIsolationOverflow:
                     raise  # isolation already abandoned: die honestly
                 except Exception as e:
@@ -1153,7 +1283,7 @@ class BlockPipelineBase:
                     # one's synchronous isolation commits its range, or
                     # committed_offset would regress (FIFO contract)
                     disp.flush()
-                    self._suspect_scan(handle, X, offsets, error=e)
+                    self._suspect_scan(handle, X, offsets, error=e, ctx=jctx)
                 batches.inc()
                 fill.inc(n)
             disp.close()  # drain the window: every dispatched batch sinks

@@ -33,6 +33,12 @@ Envelope per quarantined record::
      "attempts": int, "fingerprint": sha256-hex-16, "t": unix-s,
      "pid": int, ...extra}
 
+The quarantine paths additionally stamp ``trace_id``/``span_id`` (the
+record's journey context, obs/trace.py) into ``extra``: the envelope
+is the journey's terminal hop, and ``fjt-dlq redrive`` carries those
+ids back into the topic as a ``traceparent`` record header so the
+redriven record's new journey segment links the original.
+
 Bounded: at most ``max_records`` envelopes are retained; when a
 rotation overflows the budget the OLDEST segments are dropped, counted
 in ``dlq_dropped`` and marked with one ``dlq_truncated`` flight event —
@@ -217,6 +223,11 @@ class DeadLetterQueue:
                 reason=envelope.get("reason"),
                 fingerprint=envelope.get("fingerprint"),
                 exception=envelope.get("exception"),
+                # the journey handle (obs/trace.py): callers stamp the
+                # record's trace context into the envelope so the
+                # quarantine links its journey — and fjt-dlq redrive
+                # carries it back into the topic as a traceparent header
+                trace_id=envelope.get("trace_id"),
             )
         if rotated:
             self._gc()

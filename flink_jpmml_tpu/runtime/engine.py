@@ -20,6 +20,7 @@ Metrics (BASELINE §metrics): records/sec, p50/p99 per-record latency
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -33,6 +34,7 @@ from flink_jpmml_tpu.models.prediction import Prediction
 from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.obs import trace as trace_mod
 from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime.checkpoint import (
     CheckpointManager,
@@ -282,6 +284,13 @@ class Pipeline:
         ):
             self._death_marker = None
             self._fingerprint.clear_marker()
+        jstore = trace_mod.store_for(self.metrics)
+        if jstore is not None:
+            jstore.hop(
+                "restore", trace_mod.context_for(committed),
+                first_off=committed, durable=True,
+                restarts=max(count - 1, streak),
+            )
         threshold = env_count("FJT_POISON_RESTARTS", 3)
         if max(count - 1, streak) >= threshold:
             hi = self._replay_until
@@ -289,6 +298,14 @@ class Pipeline:
                 hi = committed + self._config.batch.size
             self._suspect_until = hi
             self._suspect_gauge.set(1.0)
+            if jstore is not None:
+                # see block.py: suspect mode → write-through journeys
+                jstore.write_through = True
+                jstore.hop(
+                    "suspect_mode", trace_mod.context_for(committed),
+                    first_off=committed, n=hi - committed, durable=True,
+                    restarts=max(count - 1, streak),
+                )
             flight.record(
                 "poison_suspect_mode", lo=committed, hi=hi,
                 restarts=max(count - 1, streak),
@@ -384,7 +401,7 @@ class Pipeline:
     def _quarantine_stamped(
         self, s: "_Stamped", exc, state: dict,
         reason: str = REASON_SCORE, attempts: int = 1,
-        original=None,
+        original=None, parent_ctx=None,
     ) -> None:
         cap = env_count("FJT_DLQ_MAX_PER_BATCH", 32)
         if state["q"] >= cap:
@@ -392,18 +409,44 @@ class Pipeline:
                 state["q"], exc if exc is not None else original
             )
         state["q"] += 1
+        off = self._record_off(s)
+        # terminal journey hop + the envelope's trace context (the ids
+        # fjt-dlq redrive stamps into the traceparent header)
+        rctx = trace_mod.TraceContext(
+            trace_mod.trace_id_for(off),
+            parent_id=(
+                None if parent_ctx is None else parent_ctx.span_id
+            ),
+        )
+        jstore = trace_mod.store_for(self.metrics)
+        if jstore is not None:
+            jstore.terminal(
+                "dlq", rctx, offset=off, reason=reason,
+                attempts=attempts,
+            )
         self._dlq.quarantine(
-            serialize_record(s.record), offset=self._record_off(s),
+            serialize_record(s.record), offset=off,
             reason=reason, error=exc, attempts=attempts,
+            trace_id=rctx.trace_id, span_id=rctx.span_id,
         )
 
-    def _isolate(self, stamped: List["_Stamped"], error) -> None:
+    def _isolate(self, stamped: List["_Stamped"], error, ctx=None) -> None:
         """Bisection over one failed micro-batch: clean runs reach the
         sink in order, single failing records go to the DLQ, the whole
         range commits (a parked poison record never replays)."""
+        jstore = trace_mod.store_for(self.metrics)
+        if ctx is None and jstore is not None:
+            ctx = trace_mod.context_for(self._record_off(stamped[0]))
+        if jstore is not None:
+            jstore.hop(
+                "suspect_scan", ctx, self._record_off(stamped[0]),
+                len(stamped), durable=True, persist=False,
+                error=repr(error),
+            )
         flight.record(
             "poison_isolation", first=stamped[0].offset,
             n=len(stamped), error=repr(error), persist=False,
+            trace_id=None if ctx is None else ctx.trace_id,
         )
         self._suspect_gauge.set(1.0)
         state = {"q": 0}
@@ -418,7 +461,8 @@ class Pipeline:
             except Exception as e:
                 if len(seq) == 1:
                     self._quarantine_stamped(
-                        seq[0], e, state, original=error
+                        seq[0], e, state, original=error,
+                        parent_ctx=ctx,
                     )
                     return
                 mid = len(seq) // 2
@@ -426,6 +470,14 @@ class Pipeline:
                 scan(seq[mid:])
                 return
             self._deliver_seq(seq, outputs)
+            if jstore is not None:
+                # surviving runs get durable sink hops, like the block
+                # path's emit_run — both hot paths render the same
+                # documented isolation timeline
+                jstore.hop(
+                    "sink", ctx.child(), self._record_off(seq[0]),
+                    len(seq), durable=True, isolated=True,
+                )
 
         try:
             scan(stamped)
@@ -447,8 +499,12 @@ class Pipeline:
         pre-quarantined by the next incarnation without ever being
         dispatched again."""
         state = {"q": 0}
+        jstore = trace_mod.store_for(self.metrics)
         for s in stamped:
             r = self._record_off(s)
+            rctx = (
+                trace_mod.context_for(r) if jstore is not None else None
+            )
             dm = self._death_marker
             if (
                 dm is not None
@@ -458,21 +514,32 @@ class Pipeline:
                 # this record: quarantine it unscored
                 self._quarantine_stamped(
                     s, None, state, reason=REASON_CRASH_LOOP,
-                    attempts=dm.get("attempts", 1),
+                    attempts=dm.get("attempts", 1), parent_ctx=rctx,
                 )
                 self._death_marker = None
                 self._fingerprint.clear_marker()
                 continue
             if self._fingerprint is not None:
                 self._fingerprint.write_marker(r, r + 1, attempts=1)
+                if jstore is not None:
+                    # the marker's journey twin (see block.py): written
+                    # BEFORE the dispatch so a kill leaves it behind
+                    jstore.hop(
+                        "suspect_dispatch", rctx, r, 1, durable=True,
+                    )
             try:
                 outputs = self._score_seq([s])
             except PoisonIsolationOverflow:
                 raise
             except Exception as e:
-                self._quarantine_stamped(s, e, state)
+                self._quarantine_stamped(s, e, state, parent_ctx=rctx)
                 continue
             self._deliver_seq([s], outputs)
+            if jstore is not None:
+                jstore.hop(
+                    "sink", rctx.child(), r, 1, durable=True,
+                    isolated=True,
+                )
         if self._fingerprint is not None:
             self._fingerprint.clear_marker()
         self._committed_offset = stamped[-1].offset
@@ -487,6 +554,16 @@ class Pipeline:
         if self._fingerprint is not None:
             self._fingerprint.clear_marker()
         self._suspect_gauge.set(0.0)
+        jstore = trace_mod.store_for(self.metrics)
+        if jstore is not None:
+            jstore.hop(
+                "suspect_exit",
+                trace_mod.context_for(self._committed_offset),
+                first_off=self._committed_offset, durable=True,
+            )
+            jstore.write_through = bool(
+                faults.active() or os.environ.get("FJT_JOURNEY_SYNC")
+            )
 
     # -- internals ---------------------------------------------------------
 
@@ -522,7 +599,9 @@ class Pipeline:
         # mergeable histogram (not a reservoir): fleet aggregation adds
         # bucket counts, so multi-worker p50/p99/p999 stay correct
         lat = self.metrics.histogram("record_latency_s")
-        in_flight: List[Tuple[Any, List[_Stamped]]] = []
+        in_flight: List[Tuple[Any, List[_Stamped], Any]] = []
+        # record-journey tracing (obs/trace.py): None unless armed
+        jstore = trace_mod.store_for(self.metrics)
 
         stages = StageTimer(self.metrics)
         # event-time freshness + backpressure (obs/freshness.py,
@@ -543,10 +622,14 @@ class Pipeline:
         replayed = self.metrics.counter("records_replayed")
 
         def _finish_one():
-            ticket, stamped = in_flight.pop(0)
+            ticket, stamped, jctx = in_flight.pop(0)
             try:
-                with stages.stage("readback"):
-                    outputs = self._scorer.finish(ticket)
+                # the finishing batch's context wraps readback + sink:
+                # DynamicScorer.finish's span (and any exemplar those
+                # stages capture) carries THIS journey's ids
+                with trace_mod.use(jctx):
+                    with stages.stage("readback"):
+                        outputs = self._scorer.finish(ticket)
             except PoisonIsolationOverflow:
                 raise
             except Exception as e:
@@ -556,11 +639,17 @@ class Pipeline:
                 # isolation's commits stay monotone
                 if self._dlq is None:
                     raise
-                self._isolate(stamped, e)
+                self._isolate(stamped, e, ctx=jctx)
                 return
-            with stages.stage("sink"):
-                self._sink.emit(outputs)
+            with trace_mod.use(jctx):
+                with stages.stage("sink"):
+                    self._sink.emit(outputs)
             now = time.monotonic()
+            if jstore is not None and jctx is not None:
+                jstore.finish(
+                    jctx, self._record_off(stamped[0]), len(stamped),
+                    latency_s=now - stamped[0].t_enq,
+                )
             # sample a handful of lanes, not all (host-side cost control)
             for s in stamped[:: max(1, len(stamped) // 8)]:
                 lat.observe(now - s.t_enq)
@@ -612,17 +701,30 @@ class Pipeline:
                     batches.inc()
                     fill.inc(len(stamped))
                     continue
+                jctx = None
+                if jstore is not None:
+                    # one dispatch hop per micro-batch, keyed
+                    # (first record offset, n) — the record-path twin
+                    # of the block pipeline's batch journey
+                    jctx = trace_mod.context_for(
+                        self._record_off(stamped[0])
+                    )
+                    jstore.hop(
+                        "dispatch", jctx,
+                        self._record_off(stamped[0]), len(stamped),
+                    )
                 try:
-                    with stages.stage("featurize_dispatch"):
-                        faults.fire(
-                            "score_batch",
-                            offsets=[
-                                self._record_off(s) for s in stamped
-                            ],
-                        )
-                        ticket = self._scorer.submit(
-                            [s.record for s in stamped]
-                        )
+                    with trace_mod.use(jctx):
+                        with stages.stage("featurize_dispatch"):
+                            faults.fire(
+                                "score_batch",
+                                offsets=[
+                                    self._record_off(s) for s in stamped
+                                ],
+                            )
+                            ticket = self._scorer.submit(
+                                [s.record for s in stamped]
+                            )
                 except PoisonIsolationOverflow:
                     raise
                 except Exception as e:
@@ -633,11 +735,11 @@ class Pipeline:
                         raise
                     while in_flight:
                         _finish_one()
-                    self._isolate(stamped, e)
+                    self._isolate(stamped, e, ctx=jctx)
                     batches.inc()
                     fill.inc(len(stamped))
                     continue
-                in_flight.append((ticket, stamped))
+                in_flight.append((ticket, stamped, jctx))
                 batches.inc()
                 fill.inc(len(stamped))
                 if len(in_flight) >= self._in_flight_max:

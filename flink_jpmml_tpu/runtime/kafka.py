@@ -49,6 +49,7 @@ import numpy as np
 from flink_jpmml_tpu.obs import attr
 from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.obs import trace as trace_mod
 from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime.block import BlockSource
 from flink_jpmml_tpu.runtime.sources import Polled, Record, Source
@@ -217,9 +218,17 @@ class _Reader:
 
 
 def encode_record_batch(
-    base_offset: int, values: Sequence[bytes], timestamp_ms: int = 0
+    base_offset: int,
+    values: Sequence[bytes],
+    timestamp_ms: int = 0,
+    headers: Optional[Sequence[Optional[Sequence[Tuple[str, bytes]]]]] = None,
 ) -> bytes:
-    """values → one magic-2 record batch (null keys, no headers)."""
+    """values → one magic-2 record batch (null keys). ``headers`` is an
+    optional per-record list aligned with ``values``: each entry None
+    (no headers) or ``[(key, value_bytes), ...]`` — the carrier the
+    record-journey tracing plane uses for ``traceparent`` propagation
+    (obs/trace.py; ``fjt-dlq redrive`` stamps one so a redriven
+    record's journey links its original)."""
     recs = bytearray()
     for i, v in enumerate(values):
         body = bytearray()
@@ -229,7 +238,18 @@ def encode_record_batch(
         write_varint(body, -1)  # null key
         write_varint(body, len(v))
         body += v
-        write_varint(body, 0)  # headers count
+        hdrs = headers[i] if headers is not None else None
+        if hdrs:
+            write_varint(body, len(hdrs))
+            for hk, hv in hdrs:
+                hk_raw = hk.encode() if isinstance(hk, str) else bytes(hk)
+                hv_raw = bytes(hv)
+                write_varint(body, len(hk_raw))
+                body += hk_raw
+                write_varint(body, len(hv_raw))
+                body += hv_raw
+        else:
+            write_varint(body, 0)  # headers count
         rec = bytearray()
         write_varint(rec, len(body))
         rec += body
@@ -305,6 +325,126 @@ def decode_record_batches(buf: bytes) -> List[Tuple[int, bytes]]:
             out.append((base_offset + off_delta, bytes(value)))
             p = rec_end
         pos = end
+    return out
+
+
+def decode_record_batches_h(
+    buf: bytes,
+) -> List[Tuple[int, bytes, Optional[List[Tuple[str, bytes]]]]]:
+    """record-set bytes → [(absolute offset, value, headers)] across
+    all whole batches — the header-aware decoder shape (headers is
+    None when a record carries none). :func:`decode_record_batches`
+    stays the fast header-skipping path; this one exists for the
+    consumers that NEED headers: traceparent pickup (record-journey
+    tracing) and the MiniKafkaBroker's Produce handler (headers must
+    survive a redrive round-trip)."""
+    out: List[Tuple[int, bytes, Optional[List[Tuple[str, bytes]]]]] = []
+    pos = 0
+    while pos + 12 <= len(buf):
+        (base_offset,) = _I64.unpack_from(buf, pos)
+        (batch_len,) = _I32.unpack_from(buf, pos + 8)
+        end = pos + 12 + batch_len
+        if batch_len < 49 or end > len(buf):
+            break  # partial trailing batch
+        magic = buf[pos + 16]
+        if magic != 2:
+            raise ValueError(f"unsupported record-batch magic {magic}")
+        (crc_stored,) = _U32.unpack_from(buf, pos + 17)
+        body = buf[pos + 21 : end]
+        if crc32c(body) != crc_stored:
+            raise ValueError("record batch CRC32C mismatch")
+        r = _Reader(body)
+        r.i16()  # attributes
+        r.i32()  # last offset delta
+        r.i64()  # first ts
+        r.i64()  # max ts
+        r.i64()  # producer id
+        r.i16()  # producer epoch
+        r.i32()  # base sequence
+        count = r.i32()
+        p = r.pos
+        for _ in range(count):
+            rec_len, p = read_varint(body, p)
+            rec_end = p + rec_len
+            p += 1  # record attributes
+            _, p = read_varint(body, p)  # timestamp delta
+            off_delta, p = read_varint(body, p)
+            klen, p = read_varint(body, p)
+            if klen > 0:
+                p += klen
+            vlen, p = read_varint(body, p)
+            value = body[p : p + vlen] if vlen >= 0 else b""
+            p += max(vlen, 0)
+            n_hdrs, p = read_varint(body, p)
+            hdrs: Optional[List[Tuple[str, bytes]]] = None
+            if n_hdrs > 0:
+                hdrs = []
+                for _h in range(n_hdrs):
+                    hklen, p = read_varint(body, p)
+                    hkey = body[p : p + hklen].decode(
+                        "utf-8", "replace"
+                    )
+                    p += hklen
+                    hvlen, p = read_varint(body, p)
+                    hval = bytes(body[p : p + max(hvlen, 0)])
+                    p += max(hvlen, 0)
+                    hdrs.append((hkey, hval))
+            out.append((base_offset + off_delta, bytes(value), hdrs))
+            p = rec_end
+        pos = end
+    return out
+
+
+def record_batch_traceparents(buf: bytes) -> Dict[int, str]:
+    """record-set bytes → {absolute offset: traceparent string} for
+    the records carrying a ``traceparent`` header. A HEADER-ONLY walk:
+    no CRC pass (the real decode path already verified it, or will),
+    no value copies — key/value payloads are skipped by length, and
+    the common no-headers record costs the varint walk up to its zero
+    headers-count. The sources run this at all only when the journey
+    plane is armed (the PR 7 timestamp plumbing's gating template);
+    malformed bytes return what was parsed so far — transport damage
+    raises on the DECODE path, not here."""
+    out: Dict[int, str] = {}
+    try:
+        pos = 0
+        while pos + 12 <= len(buf):
+            (base_offset,) = _I64.unpack_from(buf, pos)
+            (batch_len,) = _I32.unpack_from(buf, pos + 8)
+            end = pos + 12 + batch_len
+            if batch_len < 49 or end > len(buf):
+                break  # partial trailing batch
+            if buf[pos + 16] != 2:
+                break  # foreign magic: the decode path will raise
+            body = memoryview(buf)[pos + 21 : end]
+            count = _I32.unpack_from(body, 36)[0]
+            p = 40  # first record (past the fixed batch header tail)
+            for _ in range(count):
+                rec_len, p = read_varint(body, p)
+                rec_end = p + rec_len
+                p += 1  # record attributes
+                _, p = read_varint(body, p)  # timestamp delta
+                off_delta, p = read_varint(body, p)
+                klen, p = read_varint(body, p)
+                if klen > 0:
+                    p += klen
+                vlen, p = read_varint(body, p)
+                p += max(vlen, 0)  # skip the value, no copy
+                n_hdrs, p = read_varint(body, p)
+                for _h in range(n_hdrs):
+                    hklen, p = read_varint(body, p)
+                    hkey = bytes(body[p : p + hklen])
+                    p += hklen
+                    hvlen, p = read_varint(body, p)
+                    if hkey == b"traceparent":
+                        out[base_offset + off_delta] = bytes(
+                            body[p : p + max(hvlen, 0)]
+                        ).decode("ascii", "replace")
+                    p += max(hvlen, 0)
+                p = rec_end
+            pos = end
+    except (IndexError, ValueError, struct.error):
+        return out
     return out
 
 
@@ -579,14 +719,18 @@ class KafkaClient:
         values: Sequence[bytes],
         timestamp_ms: int = 0,
         timeout_ms: int = 10_000,
+        headers: Optional[Sequence] = None,
     ) -> int:
         """Produce ``values`` as one magic-2 record batch (Produce v3,
         acks=-1) → the base offset the broker assigned. The consumer
         side never needed this; the ``fjt-dlq redrive`` path does — a
         quarantined record goes back INTO the topic so the live
-        pipeline re-scores it through the real consume path."""
+        pipeline re-scores it through the real consume path.
+        ``headers`` (per-record, aligned with ``values``) carries the
+        redrive's ``traceparent`` so the record's new journey segment
+        links its original (obs/trace.py)."""
         record_set = encode_record_batch(
-            0, list(values), timestamp_ms=timestamp_ms
+            0, list(values), timestamp_ms=timestamp_ms, headers=headers
         )
         w = _Writer()
         w.string(None)  # transactional id
@@ -717,6 +861,14 @@ class _KafkaSourceBase:
         # _fetch_raw_part/_fetch_part, read by the poll paths when they
         # know which global offsets the decoded rows landed on)
         self._last_trange = None
+        # traceparent record headers awaiting their poll-path ingest
+        # hop ({offset: str}; populated only when the journey plane is
+        # armed). Keyed persistently — NOT per fetch — because the
+        # record-source poll path buffers fetch surplus across polls,
+        # and the next fetch must not clobber an unconsumed header
+        # (the redrive-continuity contract). Bounded; consumed by
+        # _journey_ingest, cleared with the buffers on seek/restore.
+        self._tps_pending: Dict[int, str] = {}
         self._lag_gauges: Dict[int, object] = {}
         self._topic = topic
         self._parts = (
@@ -878,18 +1030,30 @@ class _KafkaSourceBase:
             self._decode_err_counters[label] = c
         if c is not None:
             c.inc()
+        # terminal journey hop + the envelope's trace context: the
+        # quarantine is this record's journey exit, and the carried ids
+        # are what fjt-dlq redrive stamps back into the topic header
+        rctx = trace_mod.context_for(off)
+        jstore = trace_mod.store_for(self._metrics)
+        if jstore is not None:
+            jstore.terminal(
+                "decode_error", rctx, offset=int(off),
+                partition=part if isinstance(part, int) else None,
+            )
         now = time.monotonic()
         if now - self._last_decode_event >= 1.0:
             self._last_decode_event = now
             flight.record(
                 "decode_error", topic=self._topic, partition=part,
                 offset=off, size=len(value), error=repr(exc),
+                trace_id=rctx.trace_id,
             )
         if self._dlq is not None:
             self._dlq.quarantine(
                 value, offset=off, reason="decode",
                 partition=part if isinstance(part, int) else None,
                 error=exc, topic=self._topic,
+                trace_id=rctx.trace_id, span_id=rctx.span_id,
             )
 
     def _sweep_lag_age(self) -> None:
@@ -906,6 +1070,7 @@ class _KafkaSourceBase:
         batches' header timestamps and remember the range for the poll
         path's ingest stamp (a header-only walk; skipped entirely when
         no registry is attached)."""
+        self._note_traceparents(record_set)
         if self._freshness is None or not record_set:
             self._last_trange = None
             return
@@ -913,6 +1078,47 @@ class _KafkaSourceBase:
         self._last_trange = tr
         if tr is not None:
             self._freshness.observe_source(part, tr[0], tr[1])
+
+    def _note_traceparents(self, record_set: bytes) -> None:
+        """Stash the fetch's ``traceparent`` record headers for the
+        poll path's journey ingest hop (record-journey tracing,
+        obs/trace.py). Only walked when the journey plane is armed —
+        the unarmed cost is the store_for gate; and only on
+        single-partition sources, where record offsets ARE the global
+        offset domain the journey fragments key on."""
+        if self._multi or not record_set:
+            return
+        if trace_mod.store_for(self._metrics) is None:
+            return
+        tps = record_batch_traceparents(record_set)
+        if tps:
+            self._tps_pending.update(tps)
+            while len(self._tps_pending) > 4096:
+                # headers of records that were never polled out (a
+                # seek away, a re-fetch overlap): oldest first
+                self._tps_pending.pop(next(iter(self._tps_pending)))
+
+    def _journey_ingest(self, first_off: int, n: int) -> None:
+        """One fetched run's ingest hop (batch-keyed — per-record cost
+        only for the rare header-carrying records, i.e. redrives).
+        Consumes the emitted range's pending traceparents, however many
+        fetches ago they arrived."""
+        store = trace_mod.store_for(self._metrics)
+        if store is None or n <= 0:
+            return
+        tps = None
+        if self._tps_pending:
+            hits = [
+                off for off in self._tps_pending
+                if first_off <= off < first_off + n
+            ]
+            if hits:
+                tps = {off: self._tps_pending.pop(off) for off in hits}
+        store.ingest(
+            first_off, n,
+            partition=self._partition if not self._multi else None,
+            traceparents=tps,
+        )
 
     _TRANGE_LAST = object()  # "use the last fetch's range" default
 
@@ -1048,6 +1254,9 @@ class _KafkaSourceBase:
     def _clear_buffers(self) -> None:
         for buf in self._bufs.values():
             buf.clear()
+        # the offset domain is about to restart: pending traceparents
+        # would mis-key against the new offsets (cf. reset_stamps)
+        self._tps_pending.clear()
 
     def seek(self, offset: int) -> None:
         # engine offset k ("k records consumed") == next Kafka offset
@@ -1160,6 +1369,10 @@ class KafkaRecordSource(_KafkaSourceBase, Source):
                 self._note_decode_error(part, off, value, e)
                 continue
             out.append((off + 1, rec))
+        if pairs:
+            # record-path ingest hop, in the RECORD-offset domain the
+            # engine's journeys key on (stamp − 1; see _record_off)
+            self._journey_ingest(int(pairs[0][0]), len(pairs))
         return out
 
     def poll(self, max_n: int) -> Polled:
@@ -1327,6 +1540,7 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         self._g = g0 + m
         # the interleaved run spans every consumed slot's fetch range
         self._stamp_ingest(g0, m, trange=trange)
+        self._journey_ingest(g0, m)
         return g0, out
 
     def _poll_multi_auto(self) -> Optional[Tuple[int, np.ndarray]]:
@@ -1385,6 +1599,7 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
                 # one fetch == one emitted run here, so the fetch's
                 # event-time range stamps these global offsets exactly
                 self._stamp_ingest(g0, rows.shape[0])
+                self._journey_ingest(g0, rows.shape[0])
                 return g0, rows
         return None
 
@@ -1434,6 +1649,7 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         # times (batch granularity: the cursor filter above may narrow
         # the rows, never widen them — staleness stays an upper bound)
         self._stamp_ingest(first, rows.shape[0])
+        self._journey_ingest(first, rows.shape[0])
         return first, rows
 
 
@@ -1458,6 +1674,12 @@ class MiniKafkaBroker:
         # gaps, like a real broker's; _next[p] = next offset to assign
         self._offs: List[List[int]] = [[] for _ in range(n_partitions)]
         self._vals: List[List[bytes]] = [[] for _ in range(n_partitions)]
+        # per-record header lists (None = no headers): a real broker
+        # stores headers with the record, so a redriven traceparent
+        # must survive produce→fetch here too
+        self._hdrs: List[List[Optional[list]]] = [
+            [] for _ in range(n_partitions)
+        ]
         self._next: List[int] = [0] * n_partitions
         # per-partition encoded segments (base_offset, end_offset, batch
         # bytes): like a real broker's log, the wire format is the
@@ -1484,18 +1706,31 @@ class MiniKafkaBroker:
     _SEG_RECORDS = 512  # records per stored batch segment
 
     def append(self, *values: bytes, partition: int = 0,
-               timestamp_ms: Optional[int] = None) -> int:
+               timestamp_ms: Optional[int] = None,
+               headers: Optional[Sequence] = None) -> int:
         """→ offset of the first appended value (in ``partition``).
         ``timestamp_ms`` stamps the batch headers (CreateTime) — the
         event time the freshness plane's watermarks read; the default
-        0 means "no event time" (consumers skip it)."""
+        0 means "no event time" (consumers skip it). ``headers`` is a
+        per-value list (aligned; each entry None or
+        ``[(key, value_bytes), ...]``) stored with the records like a
+        real broker stores record headers."""
         ts = 0 if timestamp_ms is None else int(timestamp_ms)
+        hdr_list = (
+            list(headers) if headers is not None
+            else [None] * len(values)
+        )
+        if len(hdr_list) != len(values):
+            raise ValueError(
+                f"{len(hdr_list)} header lists for {len(values)} values"
+            )
         with self._mu:
             first = self._next[partition]
             self._offs[partition].extend(
                 range(first, first + len(values))
             )
             self._vals[partition].extend(values)
+            self._hdrs[partition].extend(hdr_list)
             self._next[partition] = first + len(values)
             segs = self._segs[partition]
             for i in range(0, len(values), self._SEG_RECORDS):
@@ -1504,7 +1739,8 @@ class MiniKafkaBroker:
                     first + i,
                     first + i + len(chunk),
                     encode_record_batch(
-                        first + i, list(chunk), timestamp_ms=ts
+                        first + i, list(chunk), timestamp_ms=ts,
+                        headers=hdr_list[i : i + len(chunk)],
                     ),
                 ))
             self._mu.notify_all()
@@ -1549,6 +1785,7 @@ class MiniKafkaBroker:
             self._vals[partition].extend(
                 raw[i].tobytes() for i in range(raw.shape[0])
             )
+            self._hdrs[partition].extend([None] * rows.shape[0])
             self._next[partition] = first + rows.shape[0]
             self._mu.notify_all()
             return first
@@ -1595,18 +1832,21 @@ class MiniKafkaBroker:
         with self._mu:
             offs = self._offs[partition]
             vals = self._vals[partition]
+            hdrs = self._hdrs[partition]
             keep = [
-                (o, v) for o, v in zip(offs, vals) if o not in remove
+                (o, v, h) for o, v, h in zip(offs, vals, hdrs)
+                if o not in remove
             ]
-            self._offs[partition] = [o for o, _ in keep]
-            self._vals[partition] = [v for _, v in keep]
+            self._offs[partition] = [o for o, _, _ in keep]
+            self._vals[partition] = [v for _, v, _ in keep]
+            self._hdrs[partition] = [h for _, _, h in keep]
             segs: List[Tuple[int, int, bytes]] = []
-            run: List[Tuple[int, bytes]] = []
-            for o, v in keep:
+            run: List[Tuple[int, bytes, Optional[list]]] = []
+            for o, v, h in keep:
                 if run and o != run[-1][0] + 1:
                     segs.append(self._encode_run(run))
                     run = []
-                run.append((o, v))
+                run.append((o, v, h))
                 if len(run) >= self._SEG_RECORDS:
                     segs.append(self._encode_run(run))
                     run = []
@@ -1621,7 +1861,11 @@ class MiniKafkaBroker:
         return (
             base,
             run[-1][0] + 1,
-            encode_record_batch(base, [v for _, v in run]),
+            encode_record_batch(
+                base,
+                [v for _, v, _ in run],
+                headers=[h for _, _, h in run],
+            ),
         )
 
     @property
@@ -1754,16 +1998,19 @@ class MiniKafkaBroker:
             err = 0 if ok_part else 3
             if ok_part:
                 try:
-                    recs = decode_record_batches(record_set)
+                    # the header-aware decode: a redriven traceparent
+                    # must survive the produce→append→fetch round trip
+                    recs = decode_record_batches_h(record_set)
                     tr = record_batch_time_range(record_set)
                 except ValueError:
                     recs, tr, err = [], None, 42  # INVALID_RECORD
                 if recs:
                     base = self.append(
-                        *[val for _, val in recs], partition=part,
+                        *[val for _, val, _ in recs], partition=part,
                         timestamp_ms=(
                             int(tr[1] * 1000) if tr is not None else None
                         ),
+                        headers=[h for _, _, h in recs],
                     )
             w = _Writer()
             w.i32(1).string(self.topic)
@@ -1863,6 +2110,7 @@ class MiniKafkaBroker:
                             offs_l = self._offs[part]
                             k = bisect.bisect_left(offs_l, fetch_offset)
                             values = []
+                            hdrs_l = []
                             size2 = 0
                             base = None
                             while k < len(offs_l):
@@ -1875,9 +2123,12 @@ class MiniKafkaBroker:
                                 if values and size2 > part_max_bytes:
                                     break
                                 values.append(val)
+                                hdrs_l.append(self._hdrs[part][k])
                                 k += 1
                             parts = [
-                                encode_record_batch(base, values)
+                                encode_record_batch(
+                                    base, values, headers=hdrs_l
+                                )
                             ] if values else []
                             break
                         parts.append(blob)

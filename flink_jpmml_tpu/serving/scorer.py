@@ -50,6 +50,7 @@ from flink_jpmml_tpu.obs import drift as drift_mod
 from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.obs.slo import SLOTracker
 from flink_jpmml_tpu.rollout import split as rsplit
 from flink_jpmml_tpu.rollout.controller import RolloutController
@@ -510,6 +511,15 @@ class DynamicScorer(Scorer):
         if tickets:  # an all-unserved batch scored nothing: no sample
             dt = time.monotonic() - t_submit
             self._lat.observe(dt)
+            # the micro-batch's submit→finish span: when the engine ran
+            # finish under a journey context (obs/trace.py), the span
+            # picks up the journey's trace/span ids automatically, so
+            # fjt-trace can attach the serving-side timeline to the
+            # record journey it belongs to
+            spans.emit(
+                "score_finish", t_submit, dt,
+                groups=len(tickets), n=n,
+            )
             if self.batcher is not None:
                 scored = n - len(unserved) - len(shed)
                 if scored > 0:

@@ -773,10 +773,10 @@ _GAUGE_MERGE_MAX_PREFIXES = (
     "drift_score", "prediction_drift", "feature_missing_rate",
     "unseen_category_rate", "drift_alarmed", "rollout_prediction_psi",
     "rollout_stage", "kafka_lag",
-    # delivery-correctness plane, runtime/dlq.py: 1 while a worker is
-    # bisecting poison — one suspect worker flags the fleet. NB: no
-    # parens in these comments — metrics_lint's table parser is a
-    # non-greedy regex to the closing paren
+    # delivery-correctness plane (runtime/dlq.py): 1 while a worker is
+    # bisecting poison — one suspect worker flags the fleet. (Parens in
+    # these comments are fine now: metrics_lint parses the real AST,
+    # not a to-the-closing-paren regex.)
     "poison_suspect_mode",
 )
 _GAUGE_MERGE_MIN_PREFIXES = (

@@ -23,6 +23,7 @@ def test_perf_smoke_passes():
     env["FJT_SMOKE_WATCHDOG_S"] = "210"
     env.pop("FJT_FAULTS", None)  # the no-op check requires a clean env
     env.pop("FJT_RESTART_STREAK", None)
+    env.pop("FJT_JOURNEY_DIR", None)  # the journey gate check likewise
     proc = subprocess.run(
         [sys.executable, str(_SMOKE)],
         capture_output=True, text=True, timeout=380, env=env,
@@ -41,5 +42,6 @@ def test_perf_smoke_passes():
     assert "rollout drill OK" in proc.stdout
     assert "freshness burst drill OK" in proc.stdout
     assert "overload drill OK" in proc.stdout
+    assert "journey trace OK" in proc.stdout
     assert "recovery drill OK" in proc.stdout
     assert "fault hooks no-op OK" in proc.stdout

@@ -1,0 +1,934 @@
+"""Causal record-journey tracing (obs/trace.py): trace contexts,
+cross-process traceparent propagation through Kafka record headers, the
+tail-sampled journey store, hot-path wiring on both pipelines, the
+/trace endpoint, redrive continuity, and the fjt-trace CLI.
+
+The kill-anywhere acceptance (journey reconstruction across SIGKILL
+incarnations) lives in bench.py --recovery-drill with a smoke-scale
+tripwire in tools/perf_smoke.py; this file pins the mechanisms one at
+a time.
+"""
+
+import io
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu import cli as cli_mod
+from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.obs import trace as trace_mod
+from flink_jpmml_tpu.runtime import faults
+from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue, payload_bytes
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("FJT_JOURNEY_DIR", raising=False)
+    monkeypatch.delenv("FJT_JOURNEY_SYNC", raising=False)
+    monkeypatch.delenv("FJT_RESTART_STREAK", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def small_gbm():
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+
+    tmp = tempfile.mkdtemp(prefix="fjt-trace-model-")
+    return compile_pmml(
+        parse_pmml_file(gen_gbm(tmp, n_trees=3, depth=3, n_features=4)),
+        batch_size=32,
+    )
+
+
+class TestTraceContext:
+    def test_traceparent_roundtrip(self):
+        ctx = trace_mod.context_for(1374)
+        tp = ctx.to_traceparent()
+        assert tp.startswith("00-") and tp.endswith("-01")
+        back = trace_mod.TraceContext.from_traceparent(tp)
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+
+    @pytest.mark.parametrize("bad", [
+        "", "garbage", "00-zz-yy-01", "00-abc-def-01", None, 42,
+        "00-" + "0" * 31 + "-" + "0" * 16 + "-01",  # short trace id
+    ])
+    def test_malformed_traceparent_is_none(self, bad):
+        assert trace_mod.TraceContext.from_traceparent(bad) is None
+
+    def test_trace_id_deterministic_across_processes(self):
+        # the fleet-merge property: any process derives the SAME id
+        # for the same offset with zero coordination
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable, "-c", (
+                "import sys; sys.path.insert(0, %r); "
+                "from flink_jpmml_tpu.obs.trace import trace_id_for; "
+                "print(trace_id_for(1374))" % REPO
+            )],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.stdout.strip() == trace_mod.trace_id_for(1374)
+        assert trace_mod.trace_id_for(1374) != trace_mod.trace_id_for(1375)
+        assert len(trace_mod.trace_id_for(0)) == 32
+
+    def test_child_parenting_and_current(self):
+        ctx = trace_mod.context_for(5)
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.parent_id == ctx.span_id
+        assert kid.span_id != ctx.span_id
+        assert trace_mod.current() is None
+        with trace_mod.use(ctx):
+            assert trace_mod.current() is ctx
+            with trace_mod.use(kid):
+                assert trace_mod.current() is kid
+            assert trace_mod.current() is ctx
+        assert trace_mod.current() is None
+        # None context is a no-op wrapper, not a clear
+        with trace_mod.use(ctx):
+            with trace_mod.use(None):
+                assert trace_mod.current() is ctx
+
+
+class TestJourneyStore:
+    def _store(self, tmp_path, **kw):
+        m = MetricsRegistry()
+        kw.setdefault("head_n", 0)
+        kw.setdefault("budget_frac", 1.0)
+        return trace_mod.JourneyStore(
+            str(tmp_path / "j"), metrics=m, **kw
+        ), m
+
+    def test_tail_sampling_keeps_marked_drops_rest(self, tmp_path):
+        store, m = self._store(tmp_path)
+        kept = trace_mod.context_for(0)
+        store.hop("dispatch", kept, 0, 64)
+        store.mark(kept.trace_id, "exemplar")
+        store.finish(kept, 0, 64, latency_s=0.5)
+        dropped = trace_mod.context_for(64)
+        store.hop("dispatch", dropped, 64, 64)
+        store.finish(dropped, 64, 64, latency_s=0.001)
+        rows = trace_mod.read_rows(store.directory)
+        ids = {r["trace_id"] for r in rows}
+        assert ids == {kept.trace_id}
+        sink = [r for r in rows if r["kind"] == "sink"][0]
+        assert sink["sampled"] == "exemplar"
+        assert sink["latency_s"] == pytest.approx(0.5)
+        snap = m.struct_snapshot()["counters"]
+        assert snap["journeys_sampled"] == 1
+        assert snap['journeys_dropped{reason="unsampled"}'] == 1
+
+    def test_head_sample_and_continuation(self, tmp_path):
+        store, m = self._store(tmp_path, head_n=1)
+        a = trace_mod.context_for(0)
+        store.hop("dispatch", a, 0, 32)
+        store.finish(a, 0, 32)  # head sample → kept
+        # a later hop of a KEPT journey writes straight through
+        store.hop("extra", a.child(), 0, 32)
+        b = trace_mod.context_for(32)
+        store.hop("dispatch", b, 32, 32)
+        store.finish(b, 32, 32)  # head exhausted → dropped
+        rows = trace_mod.read_rows(store.directory)
+        kinds = sorted(r["kind"] for r in rows)
+        assert kinds == ["dispatch", "extra", "sink"]
+        assert all(r["trace_id"] == a.trace_id for r in rows)
+
+    def test_terminal_always_durable_and_flushes_pending(self, tmp_path):
+        store, m = self._store(tmp_path)
+        ctx = trace_mod.context_for(7)
+        store.hop("dispatch", ctx, 7, 1)
+        store.terminal("dlq", ctx.child(), offset=7, reason="score")
+        rows = trace_mod.read_rows(store.directory)
+        assert sorted(r["kind"] for r in rows) == ["dispatch", "dlq"]
+        assert m.struct_snapshot()["counters"]["journeys_sampled"] == 1
+
+    def test_budget_drops_only_nonterminal(self, tmp_path):
+        store, m = self._store(tmp_path, budget_frac=0.0)
+        c1 = trace_mod.context_for(0)
+        # the first hop finds zero accrued overhead (0 > 0 is false)
+        # and buffers; it also accrues the overhead that trips the gate
+        store.hop("dispatch", c1, 0, 32)
+        c2 = trace_mod.context_for(32)
+        store.hop("dispatch", c2, 32, 32)  # over budget → dropped
+        store.terminal("dlq", c2, offset=32, reason="score")  # kept
+        rows = trace_mod.read_rows(store.directory)
+        assert [r["kind"] for r in rows] == ["dlq"]
+        snap = m.struct_snapshot()["counters"]
+        assert snap['journeys_dropped{reason="budget"}'] == 1
+
+    def test_pending_eviction_bound(self, tmp_path):
+        store, m = self._store(tmp_path)
+        for i in range(trace_mod._PENDING_TRACES + 10):
+            store.hop("dispatch", trace_mod.context_for(i), i, 1)
+        snap = m.struct_snapshot()["counters"]
+        assert snap['journeys_dropped{reason="evicted"}'] == 10
+
+    def test_write_through_persists_everything(self, tmp_path):
+        store, m = self._store(tmp_path)
+        store.write_through = True
+        ctx = trace_mod.context_for(0)
+        store.hop("dispatch", ctx, 0, 32)
+        rows = trace_mod.read_rows(store.directory)
+        assert [r["kind"] for r in rows] == ["dispatch"]
+
+    def test_faults_arm_write_through(self, tmp_path):
+        faults.inject("slow_fetch", delay_ms=1, n=0)
+        store, _ = self._store(tmp_path)
+        assert store.write_through
+
+    def test_ring_gc_bounds_bytes(self, tmp_path):
+        store, m = self._store(
+            tmp_path, max_bytes=4096, segment_bytes=512,
+        )
+        store.write_through = True
+        for i in range(200):
+            store.hop("dispatch", trace_mod.context_for(i), i, 1,
+                      pad="x" * 64)
+        total = sum(
+            os.path.getsize(os.path.join(store.directory, nm))
+            for nm in os.listdir(store.directory)
+        )
+        assert total <= 4096 + 1024  # one open segment of slack
+        snap = m.struct_snapshot()["counters"]
+        assert snap.get('journeys_dropped{reason="ring_gc"}', 0) > 0
+        assert m.struct_snapshot()["gauges"][
+            "journey_store_bytes"
+        ]["value"] > 0
+
+    def test_read_rows_orders_by_mtime_not_filename(self, tmp_path):
+        # review fix: pid 100045's segment sorts lexically BEFORE pid
+        # 99870's, but it is the NEWER incarnation — the newest-limit
+        # deque must keep its rows, so segments read oldest-mtime-first
+        d = tmp_path / "j"
+        d.mkdir()
+        old = d / "journeys-99870-00000000.jsonl"
+        new = d / "journeys-100045-00000000.jsonl"
+        old.write_text(json.dumps(
+            {"t": 1.0, "pid": 99870, "kind": "old", "trace_id": "a",
+             "span_id": "s"}
+        ) + "\n")
+        new.write_text(json.dumps(
+            {"t": 2.0, "pid": 100045, "kind": "dlq", "trace_id": "b",
+             "span_id": "s"}
+        ) + "\n")
+        os.utime(old, (1_000, 1_000))
+        os.utime(new, (2_000, 2_000))
+        rows = trace_mod.read_rows(str(d), limit=1)
+        assert [r["kind"] for r in rows] == ["dlq"]
+
+    def test_read_rows_skips_torn_lines(self, tmp_path):
+        store, _ = self._store(tmp_path)
+        store.terminal("dlq", trace_mod.context_for(1), offset=1)
+        seg = [
+            nm for nm in os.listdir(store.directory)
+            if nm.startswith("journeys-")
+        ][0]
+        path = os.path.join(store.directory, seg)
+        with open(path, "a") as f:
+            f.write('{"torn')  # a SIGKILL mid-write
+        rows = trace_mod.read_rows(store.directory)
+        assert len(rows) == 1 and rows[0]["kind"] == "dlq"
+
+    def test_mark_bound_evicts_oldest_keeps_sampling(self, tmp_path):
+        # review fix: orphaned marks (journeys that never finish) must
+        # not permanently exhaust the mark table — eviction, not refusal
+        store, _ = self._store(tmp_path)
+        for i in range(trace_mod._PENDING_TRACES * 2 + 5):
+            store.mark(f"orphan-{i}", "exemplar")
+        late = trace_mod.context_for(999)
+        store.hop("dispatch", late, 999, 1)
+        store.mark(late.trace_id, "exemplar")  # must still register
+        store.finish(late, 999, 1)
+        rows = trace_mod.read_rows(store.directory)
+        assert any(r["trace_id"] == late.trace_id for r in rows)
+
+    def test_ingest_hops_durable_but_uncounted(self, tmp_path):
+        # review fix: per-fetch ingest hops persist WITHOUT a finish()
+        # (nothing ever finishes a fetch-run id) and without inflating
+        # journeys_sampled or adopting the run id as a kept journey
+        store, m = self._store(tmp_path)
+        store.ingest(0, 512, partition=0)
+        rows = trace_mod.read_rows(store.directory)
+        assert [r["kind"] for r in rows] == ["ingest"]
+        snap = m.struct_snapshot()["counters"]
+        assert snap.get("journeys_sampled", 0) == 0
+        # the run id was NOT registered: a later same-id hop buffers
+        ctx = trace_mod.context_for(0)
+        store.hop("dispatch", ctx, 0, 64)
+        assert len(trace_mod.read_rows(store.directory)) == 1
+
+    def test_store_for_gate_and_install(self, tmp_path, monkeypatch):
+        m = MetricsRegistry()
+        assert trace_mod.store_for(m) is None  # env unset: nothing
+        assert trace_mod.peek(m) is None
+        monkeypatch.setenv("FJT_JOURNEY_DIR", str(tmp_path / "env"))
+        s = trace_mod.store_for(m)
+        assert s is not None and trace_mod.store_for(m) is s
+        assert trace_mod.peek(m) is s
+        m2 = MetricsRegistry()
+        s2 = trace_mod.install(m2, str(tmp_path / "explicit"))
+        assert s2.directory.endswith("explicit")
+
+
+class TestKafkaHeaders:
+    def test_encode_decode_roundtrip(self):
+        from flink_jpmml_tpu.runtime.kafka import (
+            decode_record_batches,
+            decode_record_batches_h,
+            encode_record_batch,
+            record_batch_traceparents,
+        )
+
+        ctx = trace_mod.context_for(11)
+        tp = ctx.to_traceparent().encode()
+        hdrs = [
+            None,
+            [("traceparent", tp), ("other", b"\x00\x01")],
+            [],
+        ]
+        blob = encode_record_batch(
+            10, [b"a", b"bb", b"ccc"], timestamp_ms=123, headers=hdrs
+        )
+        # the fast decoder still skips headers correctly
+        assert decode_record_batches(blob) == [
+            (10, b"a"), (11, b"bb"), (12, b"ccc")
+        ]
+        got = decode_record_batches_h(blob)
+        assert got[0][2] is None
+        assert got[1][2] == [("traceparent", tp), ("other", b"\x00\x01")]
+        assert got[2][2] is None
+        assert record_batch_traceparents(blob) == {
+            11: ctx.to_traceparent()
+        }
+
+    def test_broker_produce_fetch_preserves_headers(self):
+        from flink_jpmml_tpu.runtime.kafka import (
+            KafkaClient,
+            MiniKafkaBroker,
+            decode_record_batches_h,
+        )
+
+        broker = MiniKafkaBroker(topic="t")
+        try:
+            client = KafkaClient(broker.host, broker.port)
+            tp = trace_mod.context_for(3).to_traceparent().encode()
+            base = client.produce(
+                "t", 0, [b"v0", b"v1"],
+                headers=[None, [("traceparent", tp)]],
+            )
+            assert base == 0
+            _, raw = client.fetch_raw("t", 0, 0)
+            got = decode_record_batches_h(raw)
+            assert [(o, v) for o, v, _ in got] == [(0, b"v0"), (1, b"v1")]
+            assert got[0][2] is None
+            assert got[1][2] == [("traceparent", tp)]
+            client.close()
+        finally:
+            broker.close()
+
+    def test_compaction_keeps_headers(self):
+        from flink_jpmml_tpu.runtime.kafka import (
+            MiniKafkaBroker,
+            decode_record_batches_h,
+            encode_record_batch,  # noqa: F401 (API under test above)
+            KafkaClient,
+        )
+
+        broker = MiniKafkaBroker(topic="t")
+        try:
+            hx = [("traceparent", b"00-" + b"a" * 32 + b"-" + b"b" * 16
+                   + b"-01")]
+            broker.append(
+                b"x", b"y", b"z", headers=[None, hx, None]
+            )
+            broker.compact(0, [0])
+            client = KafkaClient(broker.host, broker.port)
+            _, raw = client.fetch_raw("t", 0, 0)
+            got = decode_record_batches_h(raw)
+            assert [(o, v) for o, v, _ in got] == [(1, b"y"), (2, b"z")]
+            assert got[0][2] == [
+                (k, v) for k, v in hx
+            ]
+            client.close()
+        finally:
+            broker.close()
+
+
+class TestTraceparentSurplus:
+    def test_header_survives_poll_surplus_across_fetches(self, tmp_path):
+        """Review fix: a traceparent whose record sits in the record
+        source's fetch SURPLUS must survive the next fetch's header
+        walk — pending headers are keyed persistently by offset, not
+        clobbered per fetch."""
+        from flink_jpmml_tpu.runtime.kafka import (
+            KafkaRecordSource, MiniKafkaBroker,
+        )
+
+        broker = MiniKafkaBroker(topic="t")
+        try:
+            origin = trace_mod.context_for(12345)
+            tp = origin.to_traceparent().encode()
+            vals = [json.dumps({"i": i}).encode() for i in range(5)]
+            broker.append(
+                *vals,
+                headers=[None, None, None, None, [("traceparent", tp)]],
+            )
+            m = MetricsRegistry()
+            trace_mod.install(m, str(tmp_path / "j"))
+            src = KafkaRecordSource(
+                broker.host, broker.port, "t", max_wait_ms=20,
+                metrics=m,
+            )
+            got = src.poll(3)  # fetches all 5, serves 3, 2 surplus
+            assert len(got) == 3
+            # a NEW fetch (header-free batch) lands before the header
+            # record is served from the surplus
+            broker.append(*[
+                json.dumps({"i": i}).encode() for i in range(5, 10)
+            ])
+            got2 = src.poll(3)  # serves the surplus (incl. offset 4)
+            assert [r["i"] for _, r in got2] == [3, 4, 5]
+            src.close()
+            rows = trace_mod.read_rows(str(tmp_path / "j"))
+            redriven = [r for r in rows if r.get("redriven")]
+            assert redriven and redriven[0]["offset"] == 4
+            assert redriven[0]["trace_id"] == origin.trace_id
+            assert redriven[0]["parent_id"] == origin.span_id
+        finally:
+            broker.close()
+
+
+class TestSpanTraceArgs:
+    def test_spans_carry_active_context(self, tmp_path, monkeypatch):
+        from flink_jpmml_tpu.obs import spans
+        from flink_jpmml_tpu.utils.profiling import StageTimer
+
+        monkeypatch.setenv("FJT_TRACE_DIR", str(tmp_path))
+        ctx = trace_mod.context_for(9)
+        timer = StageTimer(MetricsRegistry())
+        with trace_mod.use(ctx):
+            with timer.stage("featurize"):
+                pass
+            spans.emit("manual", 0.0, 0.001)
+        spans.emit("untraced", 0.0, 0.001)  # no active ctx
+        w = spans.writer()
+        assert w is not None
+        w.flush()
+        events = []
+        with open(w.path) as f:
+            for ln in f:
+                ln = ln.strip().rstrip(",")
+                if not ln or ln == "[":
+                    continue
+                events.append(json.loads(ln))
+        by_name = {e["name"]: e for e in events}
+        for name in ("featurize", "manual"):
+            args = by_name[name].get("args") or {}
+            assert args.get("trace_id") == ctx.trace_id
+            assert args.get("span_id") == ctx.span_id
+        assert "trace_id" not in (by_name["untraced"].get("args") or {})
+        # explicit trace_id args win over the ambient context
+        with trace_mod.use(ctx):
+            spans.emit("explicit", 0.0, 0.001, trace_id="custom")
+        w.flush()
+        with open(w.path) as f:
+            tail = [
+                json.loads(ln.strip().rstrip(","))
+                for ln in f
+                if ln.strip().rstrip(",") not in ("", "[")
+            ]
+        ex = [e for e in tail if e["name"] == "explicit"][0]
+        assert ex["args"]["trace_id"] == "custom"
+        # cleanup: drop the module singleton so later tests (and other
+        # files) don't inherit a writer bound to this tmp dir
+        monkeypatch.delenv("FJT_TRACE_DIR")
+        assert spans.writer() is None
+
+
+class TestBlockPipelineJourneys:
+    def _run(self, small_gbm, tmp_path, data, metrics, **pipe_kw):
+        from flink_jpmml_tpu.runtime.block import (
+            BlockPipeline, FiniteBlockSource,
+        )
+        from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+        from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+        emitted = []
+
+        def sink(out, n, first_off):
+            emitted.append((first_off, n))
+
+        pipe = BlockPipeline(
+            FiniteBlockSource(data, 64), small_gbm, sink,
+            RuntimeConfig(
+                batch=BatchConfig(size=32, deadline_us=1000),
+                checkpoint_interval_s=0.05,
+            ),
+            metrics=metrics,
+            checkpoint=CheckpointManager(str(tmp_path / "ck")),
+            **pipe_kw,
+        )
+        pipe.run_until_exhausted(timeout=60)
+        return pipe, emitted
+
+    def test_complete_journeys_and_exemplar_linkage(
+        self, small_gbm, tmp_path
+    ):
+        m = MetricsRegistry()
+        store = trace_mod.install(m, str(tmp_path / "j"), head_n=2)
+        rng = np.random.default_rng(0)
+        data = rng.normal(0, 1, size=(256, 4)).astype(np.float32)
+        self._run(small_gbm, tmp_path, data, m)
+        rows = trace_mod.read_rows(store.directory)
+        by_id = {}
+        for r in rows:
+            by_id.setdefault(r["trace_id"], set()).add(r["kind"])
+        complete = {
+            t for t, k in by_id.items() if {"dispatch", "sink"} <= k
+        }
+        assert complete, by_id
+        # the exemplar path marks journeys: a first-batch exemplar's
+        # trace id must name a persisted journey (the fjt-top pivot)
+        ex = {
+            e.get("trace_id") for e in flight.events()
+            if e.get("kind") == "latency_exemplar"
+        }
+        assert complete & ex
+        # the dispatch hop is batch-keyed: (first_off, n) present
+        d = [r for r in rows if r["kind"] == "dispatch"][0]
+        assert "first_off" in d and "n" in d
+
+    def test_poison_isolation_leaves_trace_trail(
+        self, small_gbm, tmp_path
+    ):
+        m = MetricsRegistry()
+        store = trace_mod.install(m, str(tmp_path / "j"), head_n=0)
+        rng = np.random.default_rng(1)
+        data = rng.normal(0, 1, size=(200, 4)).astype(np.float32)
+        faults.clear()  # install() precedes: keep buffering mode
+        store.write_through = False
+        faults.inject("poison_record", offset=97)
+        self._run(small_gbm, tmp_path, data, m)
+        rows = trace_mod.read_rows(store.directory)
+        kinds = {r["kind"] for r in rows}
+        assert "suspect_scan" in kinds and "dlq" in kinds
+        dlq_row = [r for r in rows if r["kind"] == "dlq"][0]
+        assert dlq_row["offset"] == 97
+        assert dlq_row["trace_id"] == trace_mod.trace_id_for(97)
+        # the envelope carries the SAME context (satellite: redrive
+        # continuity rests on this)
+        envs = {
+            e["offset"]: e
+            for e in DeadLetterQueue(str(tmp_path / "ck" / "dlq")).scan()
+        }
+        assert envs[97]["trace_id"] == dlq_row["trace_id"]
+        assert envs[97]["span_id"] == dlq_row["span_id"]
+        # isolated sink runs are durable and offset-labelled
+        sinks = [r for r in rows if r["kind"] == "sink"]
+        assert any(r.get("isolated") for r in sinks)
+
+    def test_shed_terminal_hop(self, small_gbm, tmp_path):
+        from flink_jpmml_tpu.serving.overload import AdmissionController
+
+        m = MetricsRegistry()
+        store = trace_mod.install(m, str(tmp_path / "j"), head_n=0)
+        store.write_through = False
+        admission = AdmissionController(m, lanes=("block",))
+        admission._level = 1  # shed everything on the block lane
+        rng = np.random.default_rng(2)
+        data = rng.normal(0, 1, size=(64, 4)).astype(np.float32)
+        pipe, emitted = self._run(
+            small_gbm, tmp_path, data, m, admission=admission,
+            shed_lane="block",
+        )
+        assert emitted == []  # everything shed
+        rows = trace_mod.read_rows(store.directory)
+        shed = [r for r in rows if r["kind"] == "shed"]
+        assert shed and shed[0]["lane"] == "block"
+
+
+class TestEnginePathJourneys:
+    class _ListSource:
+        def __init__(self, rows):
+            self._rows = rows
+            self._i = 0
+
+        def poll(self, max_n):
+            out = []
+            while self._i < len(self._rows) and len(out) < max_n:
+                out.append((self._i + 1, self._rows[self._i]))
+                self._i += 1
+            return out
+
+        def seek(self, offset):
+            self._i = offset
+
+        @property
+        def exhausted(self):
+            return self._i >= len(self._rows)
+
+    def test_engine_journeys_and_isolation(self, small_gbm, tmp_path):
+        from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+        from flink_jpmml_tpu.runtime.engine import Pipeline, StaticScorer
+        from flink_jpmml_tpu.runtime.sinks import CollectSink
+        from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+        m = MetricsRegistry()
+        store = trace_mod.install(m, str(tmp_path / "j"), head_n=2)
+        store.write_through = False
+        N = 100
+        rng = np.random.default_rng(3)
+        rows_in = [
+            rng.normal(0, 1, size=4).astype(np.float32).tolist()
+            for _ in range(N)
+        ]
+        faults.inject("poison_record", offset=56)
+        sink = CollectSink()
+        pipe = Pipeline(
+            self._ListSource(rows_in), StaticScorer(small_gbm), sink,
+            RuntimeConfig(
+                batch=BatchConfig(size=32, deadline_us=1000),
+                checkpoint_interval_s=0.05,
+            ),
+            metrics=m,
+            checkpoint=CheckpointManager(str(tmp_path / "ck")),
+        )
+        pipe.run_until_exhausted(timeout=60)
+        assert len(sink.items) == N - 1
+        rows = trace_mod.read_rows(store.directory)
+        kinds = {r["kind"] for r in rows}
+        assert {"dispatch", "suspect_scan", "dlq"} <= kinds
+        dlq_row = [r for r in rows if r["kind"] == "dlq"][0]
+        assert dlq_row["offset"] == 56
+        envs = list(
+            DeadLetterQueue(str(tmp_path / "ck" / "dlq")).scan()
+        )
+        assert envs[0]["trace_id"] == trace_mod.trace_id_for(56)
+        # surviving runs of the isolation get durable sink hops, like
+        # the block path (review fix: both hot paths render the same
+        # isolation timeline)
+        iso_sinks = [
+            r for r in rows
+            if r["kind"] == "sink" and r.get("isolated")
+        ]
+        assert iso_sinks
+        # head-sampled complete journeys exist on this path too
+        by_id = {}
+        for r in rows:
+            by_id.setdefault(r["trace_id"], set()).add(r["kind"])
+        assert any(
+            {"dispatch", "sink"} <= k for k in by_id.values()
+        ), by_id
+
+
+class TestServerTraceEndpoint:
+    def test_trace_endpoint_payload(self, tmp_path):
+        import urllib.request
+
+        from flink_jpmml_tpu.obs.server import ObsServer
+
+        m = MetricsRegistry()
+        store = trace_mod.install(m, str(tmp_path / "j"))
+        store.terminal("dlq", trace_mod.context_for(4), offset=4,
+                       reason="score")
+        srv = ObsServer.for_registry(m)
+        try:
+            with urllib.request.urlopen(
+                srv.url + "/trace", timeout=10
+            ) as r:
+                assert r.status == 200
+                payload = json.loads(r.read().decode())
+        finally:
+            srv.close()
+        assert payload["dir"] == store.directory
+        assert any(
+            row["kind"] == "dlq" and row["offset"] == 4
+            for row in payload["journeys"]
+        )
+        assert isinstance(payload["flight"], list)
+
+    def test_trace_endpoint_serves_spans_and_url_load(
+        self, tmp_path, monkeypatch
+    ):
+        # review fix: the URL source must carry the trace-id'd span
+        # timeline the dump-dir scan shows (docs parity)
+        from flink_jpmml_tpu.obs import spans
+        from flink_jpmml_tpu.obs.server import ObsServer
+
+        monkeypatch.setenv("FJT_TRACE_DIR", str(tmp_path / "spans"))
+        m = MetricsRegistry()
+        store = trace_mod.install(m, str(tmp_path / "j"))
+        ctx = trace_mod.context_for(8)
+        store.terminal("dlq", ctx, offset=8, reason="score")
+        with trace_mod.use(ctx):
+            spans.emit("featurize", 0.0, 0.002, first_off=8, n=1)
+        spans.emit("uncorrelated", 0.0, 0.001)
+        srv = ObsServer.for_registry(m)
+        try:
+            rows = cli_mod._trace_load(srv.url)
+        finally:
+            srv.close()
+        span_rows = [r for r in rows if r.get("src") == "span"]
+        assert span_rows, "URL source carried no spans"
+        assert span_rows[0]["trace_id"] == ctx.trace_id
+        assert span_rows[0]["kind"] == "span:featurize"
+        assert not any(
+            r["kind"] == "span:uncorrelated" for r in rows
+        )
+        # and the selection joins them to the journey
+        sel = cli_mod._trace_select(rows, trace_id=ctx.trace_id)
+        assert {"dlq", "span:featurize"} <= {r["kind"] for r in sel}
+        # cleanup the module-level span writer singleton
+        monkeypatch.delenv("FJT_TRACE_DIR")
+        assert spans.writer() is None
+
+
+@pytest.mark.slow
+class TestRedriveContinuity:
+    def test_redrive_links_original_journey_live(
+        self, small_gbm, tmp_path, capsys
+    ):
+        """The satellite pin: quarantine → envelope carries the trace
+        context → fjt-dlq redrive stamps it as a traceparent header →
+        the LIVE pipeline's re-consume opens a child ingest hop of the
+        original journey and scores the record."""
+        from flink_jpmml_tpu.runtime.block import BlockPipeline
+        from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+        from flink_jpmml_tpu.runtime.kafka import (
+            KafkaBlockSource, MiniKafkaBroker,
+        )
+        from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+        N, poison_off = 192, 70
+        broker = MiniKafkaBroker(topic="t")
+        try:
+            rng = np.random.default_rng(4)
+            data = rng.normal(0, 1, size=(N, 4)).astype(np.float32)
+            broker.append_rows(data)
+
+            def run_consumer(total, fault_offset=None):
+                faults.clear()
+                if fault_offset is not None:
+                    faults.inject("poison_record", offset=fault_offset)
+                m = MetricsRegistry()
+                trace_mod.install(m, str(tmp_path / "j"))
+                dlq = DeadLetterQueue(
+                    str(tmp_path / "ck" / "dlq"), metrics=m
+                )
+                src = KafkaBlockSource(
+                    broker.host, broker.port, "t", n_cols=4,
+                    max_wait_ms=20, metrics=m, dlq=dlq,
+                )
+                emitted = []
+                pipe = BlockPipeline(
+                    src, small_gbm,
+                    lambda out, n, first: emitted.append((first, n)),
+                    RuntimeConfig(
+                        batch=BatchConfig(size=32, deadline_us=1000),
+                        checkpoint_interval_s=0.05,
+                    ),
+                    metrics=m,
+                    checkpoint=CheckpointManager(str(tmp_path / "ck")),
+                    dlq=dlq,
+                )
+                pipe.restore()
+                pipe.start()
+                import time as _t
+
+                deadline = _t.monotonic() + 60
+                while (
+                    pipe.committed_offset < total
+                    and pipe._error is None
+                    and _t.monotonic() < deadline
+                ):
+                    _t.sleep(0.02)
+                pipe.stop()
+                pipe.join(timeout=30)
+                src.close()
+                return emitted
+
+            emitted = run_consumer(N, fault_offset=poison_off)
+            covered = np.zeros(N + 1, np.int64)
+            for off, n in emitted:
+                covered[off: off + n] += 1
+            assert covered[poison_off] == 0
+            dlq = DeadLetterQueue(str(tmp_path / "ck" / "dlq"))
+            env = [
+                e for e in dlq.scan() if e["offset"] == poison_off
+            ][0]
+            assert env.get("trace_id") and env.get("span_id")
+            assert payload_bytes(env) == data[poison_off].tobytes()
+
+            # redrive through the CLI: the traceparent header rides
+            cli_mod.dlq_main([
+                "redrive", str(tmp_path / "ck"),
+                "--host", broker.host, "--port", str(broker.port),
+                "--topic", "t", "--offset", str(poison_off),
+            ])
+            capsys.readouterr()
+
+            # the corrected (fault-free) pipeline consumes the new
+            # record through the real path
+            emitted2 = run_consumer(N + 1)
+            assert any(
+                off <= N < off + n for off, n in emitted2
+            ), "redriven record never reached the sink"
+            rows = trace_mod.read_rows(str(tmp_path / "j"))
+            redriven = [r for r in rows if r.get("redriven")]
+            assert redriven, "no traceparent-linked ingest hop"
+            hop = redriven[0]
+            # same journey, child span of the envelope's quarantine hop
+            assert hop["trace_id"] == env["trace_id"]
+            assert hop["parent_id"] == env["span_id"]
+            assert hop["offset"] == N  # the new log offset
+            # and fjt-trace joins the whole story by the original offset
+            sel = cli_mod._trace_select(
+                rows + [cli_mod._trace_norm_dlq(env)],
+                trace_id=env["trace_id"],
+            )
+            kinds = {r["kind"] for r in sel}
+            assert {"dlq", "ingest", "dlq_envelope"} <= kinds
+        finally:
+            broker.close()
+
+
+class TestTraceCLI:
+    def _dump(self, tmp_path):
+        m = MetricsRegistry()
+        store = trace_mod.JourneyStore(
+            str(tmp_path / "journeys"), metrics=m, head_n=100,
+            budget_frac=1.0,
+        )
+        for i, off in enumerate((0, 64, 128)):
+            ctx = trace_mod.context_for(off)
+            store.hop("dispatch", ctx, off, 64)
+            store.finish(ctx, off, 64, latency_s=0.01 * (i + 1))
+        store.terminal(
+            "dlq", trace_mod.context_for(70), offset=70, reason="score",
+        )
+        return store
+
+    def test_summary_grep_slowest_id(self, tmp_path, capsys):
+        self._dump(tmp_path)
+        assert cli_mod.trace_main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "journey(s)" in out
+        # --grep offset=K: the batch containing 70 AND its terminal hop
+        assert cli_mod.trace_main(
+            [str(tmp_path), "--grep", "offset=70"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "dlq" in out and "[64..128)" in out
+        assert cli_mod.trace_main([str(tmp_path), "--slowest", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "30.000ms" in out.replace(" ", "") or "30.000" in out
+        tid = trace_mod.trace_id_for(128)
+        assert cli_mod.trace_main([str(tmp_path), "--id", tid]) == 0
+        out = capsys.readouterr().out
+        assert tid[:12] in out
+
+    def test_id_selection_pulls_batch_terminal_hops(self, tmp_path):
+        """Review fix: --id <batch-tid> (the fjt-top pivot) must pull
+        in per-record terminal hops whose offset falls inside the
+        batch's (first_off, n) range — a quarantine inside the slow
+        batch must not vanish from the id-selected timeline."""
+        store = self._dump(tmp_path)
+        rows = cli_mod._trace_rows_from_dir(str(tmp_path))
+        batch_tid = trace_mod.trace_id_for(64)
+        sel = cli_mod._trace_select(rows, trace_id=batch_tid)
+        kinds = {r["kind"] for r in sel}
+        assert "dlq" in kinds, kinds  # offset 70 ∈ [64..128)
+        # and by-offset selection agrees with by-id selection
+        sel2 = cli_mod._trace_select(rows, offset=70)
+        assert {r["kind"] for r in sel2} >= kinds
+
+    def test_grep_rejects_unknown_key(self, tmp_path):
+        self._dump(tmp_path)
+        with pytest.raises(SystemExit):
+            cli_mod.trace_main([str(tmp_path), "--grep", "pid=3"])
+        with pytest.raises(SystemExit):
+            cli_mod.trace_main([str(tmp_path), "--grep", "offset=x"])
+
+    def test_no_match_exits(self, tmp_path):
+        self._dump(tmp_path)
+        with pytest.raises(SystemExit):
+            cli_mod.trace_main(
+                [str(tmp_path), "--grep", "offset=99999"]
+            )
+
+    def test_artifact_source(self, tmp_path, capsys):
+        store = self._dump(tmp_path)
+        rows = trace_mod.read_rows(store.directory)
+        art = tmp_path / "BENCH_x.json"
+        art.write_text(json.dumps({
+            "metric": "recovery_drill", "journeys": rows,
+        }))
+        assert cli_mod.trace_main(
+            [str(art), "--grep", "offset=70"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "dlq" in out
+
+    def test_incarnation_boundary_render(self, capsys):
+        rows = [
+            {"t": 1.0, "pid": 10, "kind": "dispatch",
+             "trace_id": "aa", "span_id": "s1", "first_off": 0, "n": 8},
+            {"t": 2.0, "pid": 20, "kind": "restore",
+             "trace_id": "aa", "span_id": "s2", "first_off": 0},
+        ]
+        buf = io.StringIO()
+        cli_mod._trace_render(rows, buf)
+        out = buf.getvalue()
+        assert "incarnation boundary: pid 10 → pid 20" in out
+
+    def test_flight_and_dlq_normalization(self, tmp_path, capsys):
+        # flight dumps + DLQ segments in the scanned tree join the
+        # journey rows (the recovery-drill reconstruction path)
+        store = self._dump(tmp_path)
+        flight_path = tmp_path / "flight-1-2.jsonl"
+        flight_path.write_text(json.dumps({
+            "t": 5.0, "kind": "poison_suspect_mode", "lo": 64,
+            "hi": 128, "restarts": 3, "pid": 99,
+        }) + "\n" + json.dumps({
+            "t": 5.1, "kind": "kafka_reconnect",  # not journey-relevant
+        }) + "\n")
+        q = DeadLetterQueue(str(tmp_path / "dlq"))
+        q.quarantine(b"\x00" * 16, offset=70, reason="score",
+                     trace_id="tt", span_id="ss")
+        rows = cli_mod._trace_rows_from_dir(str(tmp_path))
+        kinds = {r["kind"] for r in rows}
+        assert "poison_suspect_mode" in kinds
+        assert "dlq_envelope" in kinds
+        assert "kafka_reconnect" not in kinds
+        sus = [r for r in rows if r["kind"] == "poison_suspect_mode"][0]
+        assert sus["first_off"] == 64 and sus["n"] == 64
+        sel = cli_mod._trace_select(rows, offset=70)
+        sel_kinds = {r["kind"] for r in sel}
+        assert {"poison_suspect_mode", "dlq_envelope", "dlq"} <= sel_kinds
+
+    def test_fjt_top_exemplar_pivot_hint(self, tmp_path, capsys):
+        # an exemplar row renders the fjt-trace invocation (satellite)
+        m = MetricsRegistry()
+        h = m.histogram("stage_seconds{stage=\"sink\"}")
+        h.observe(0.5, exemplar="abcd1234")
+        struct = m.struct_snapshot()
+        dump = tmp_path / "varz.json"
+        dump.write_text(json.dumps(struct))
+        assert cli_mod.top_main([str(dump)]) == 0
+        out = capsys.readouterr().out
+        assert "fjt-trace" in out and "--id abcd1234" in out
+        assert str(dump) in out

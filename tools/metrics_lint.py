@@ -55,9 +55,8 @@ _CATALOGUE_HEAD = "### Metric name catalogue"
 _ROW = re.compile(
     r"^\|\s*`([^`]+)`\s*\|\s*([a-z]+)\s*\|\s*([a-z-]+)\s*\|"
 )
-_PREFIX_TABLE = re.compile(
-    r"^(_GAUGE_MERGE_(?:MAX|MIN)_PREFIXES)\s*=\s*(\(.*?\))",
-    re.MULTILINE | re.DOTALL,
+_PREFIX_TABLE_NAMES = (
+    "_GAUGE_MERGE_MAX_PREFIXES", "_GAUGE_MERGE_MIN_PREFIXES",
 )
 
 # what the Merge column may say, per kind; gauges are checked against
@@ -95,24 +94,46 @@ def code_names() -> Set[Tuple[str, str, str]]:
     return out
 
 
-def gauge_merge_prefixes() -> Dict[str, Tuple[str, ...]]:
-    """Parse the merge prefix tables out of utils/metrics.py source
-    (``ast.literal_eval`` on the tuple literals — no package import)."""
-    text = METRICS_PY.read_text(encoding="utf-8")
+def gauge_merge_prefixes(
+    path: pathlib.Path = METRICS_PY,
+) -> Dict[str, Tuple[str, ...]]:
+    """Parse the merge prefix tables out of utils/metrics.py via
+    ``ast.parse`` (no package import). Walking the real AST instead of
+    a to-the-closing-paren regex means comments INSIDE the tuple
+    literals — parens, quotes, whatever — can't truncate the match
+    and silently fail the lint with exit 2 (the PR 12 wart)."""
     out: Dict[str, Tuple[str, ...]] = {}
-    for m in _PREFIX_TABLE.finditer(text):
-        try:
-            out[m.group(1)] = tuple(ast.literal_eval(m.group(2)))
-        except (SyntaxError, ValueError):
-            pass
-    if (
-        "_GAUGE_MERGE_MAX_PREFIXES" not in out
-        or "_GAUGE_MERGE_MIN_PREFIXES" not in out
-    ):
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except SyntaxError as e:
+        print(
+            f"metrics-lint: {path} does not parse ({e}) — fix the "
+            "module, the lint reads its assignments",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id in _PREFIX_TABLE_NAMES
+            ):
+                try:
+                    val = ast.literal_eval(node.value)
+                except (SyntaxError, ValueError):
+                    continue
+                if isinstance(val, (tuple, list)) and all(
+                    isinstance(s, str) for s in val
+                ):
+                    out[target.id] = tuple(val)
+    missing = [n for n in _PREFIX_TABLE_NAMES if n not in out]
+    if missing:
         print(
             "metrics-lint: could not parse the gauge merge prefix "
-            f"tables from {METRICS_PY} — fix the parser, don't drop "
-            "the contract",
+            f"table(s) {missing} from {path} — fix the parser, don't "
+            "drop the contract",
             file=sys.stderr,
         )
         sys.exit(2)

@@ -60,6 +60,13 @@ fails loudly on exactly the regressions new concurrency code breeds:
   ≤2%-of-dispatch overhead bound on the sampled profile path (the
   unsampled gate is µs-scale, and the accumulated-overhead budget
   keeps the sampled work under 2% of wall clock by construction);
+- **journey-trace rot**: the record-journey plane (``obs/trace.py``) —
+  the unarmed per-dispatch gate must stay ≤2µs, the accumulated-
+  overhead budget must hold when armed (a zero-budget store sheds its
+  own bookkeeping, never the pipeline's throughput), and a live
+  ``/trace`` scrape must retrieve ≥1 complete journey whose sink hop's
+  trace id matches a ``latency_exemplar`` flight event (the
+  fjt-top → fjt-trace pivot's ground truth);
 - **fault-hook overhead**: with ``FJT_FAULTS`` unset, the injection
   hooks on the fetch/dispatch/checkpoint/score paths
   (``runtime/faults.py fire()``) must be a genuine no-op — sub-µs per
@@ -850,6 +857,114 @@ def check_drift_plane() -> None:
         assert busy_plane.stats()["sampled"] >= 2, busy_plane.stats()
 
 
+def check_journey_trace() -> None:
+    """Record-journey-tracing tripwire (obs/trace.py): (1) the
+    unarmed hot-path gate — ``store_for`` with ``FJT_JOURNEY_DIR``
+    unset — must cost ≤2µs per dispatch (a dict miss + one env
+    lookup); (2) armed, the accumulated-overhead budget must hold (a
+    zero-budget store drops every non-terminal hop); (3) a live
+    pipeline's ``/trace`` scrape must retrieve ≥1 COMPLETE journey
+    (dispatch + sink hops) whose sink hop's trace id matches a
+    ``latency_exemplar`` flight event's — the fjt-top → fjt-trace
+    pivot's ground truth."""
+    import json
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.obs import recorder as flight
+    from flink_jpmml_tpu.obs import trace as trace_mod
+    from flink_jpmml_tpu.obs.server import ObsServer
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+    # 1) the unsampled gate: env unset, nothing armed
+    assert not os.environ.get("FJT_JOURNEY_DIR"), (
+        "FJT_JOURNEY_DIR leaked into the smoke env"
+    )
+    m_gate = MetricsRegistry()
+    assert trace_mod.store_for(m_gate) is None
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        trace_mod.store_for(m_gate)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call <= 2e-6, (
+        f"unarmed journey gate costs {per_call * 1e6:.2f}µs/dispatch > 2µs"
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # 2) the budget: a zero-budget store must shed its own work
+        m_budget = MetricsRegistry()
+        store = trace_mod.install(
+            m_budget, os.path.join(tmp, "b"), budget_frac=0.0, head_n=0
+        )
+        for i in range(2000):
+            ctx = trace_mod.context_for(i * 64)
+            store.hop("dispatch", ctx, i * 64, 64)
+            store.finish(ctx, i * 64, 64, latency_s=0.001)
+        snap = m_budget.struct_snapshot()["counters"]
+        dropped = sum(
+            v for k, v in snap.items() if k.startswith("journeys_dropped")
+        )
+        assert dropped > 0 and snap.get("journeys_sampled", 0) == 0, (
+            f"zero-budget store persisted work: {snap}"
+        )
+
+        # 3) live pipeline + /trace scrape + exemplar linkage
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=10, depth=3, n_features=4)
+        )
+        cm = compile_pmml(doc, batch_size=64)
+        rng = np.random.default_rng(7)
+        data = rng.normal(0.0, 1.0, size=(1000, 4)).astype(np.float32)
+        metrics = MetricsRegistry()
+        trace_mod.install(metrics, os.path.join(tmp, "journeys"))
+
+        def sink(out, n_, first_off):
+            np.asarray(out if not hasattr(out, "value") else out.value)
+
+        pipe = BlockPipeline(
+            FiniteBlockSource(data, block_size=100), cm, sink,
+            in_flight=2, use_native=False, metrics=metrics,
+        )
+        srv = ObsServer.for_registry(metrics)
+        try:
+            pipe.run_until_exhausted(timeout=60.0)
+            with urllib.request.urlopen(
+                srv.url + "/trace", timeout=10
+            ) as r:
+                assert r.status == 200
+                payload = json.loads(r.read().decode())
+        finally:
+            srv.close()
+        rows = payload["journeys"]
+        assert rows, "live /trace scrape returned no journey rows"
+        by_id = {}
+        for row in rows:
+            by_id.setdefault(row.get("trace_id"), set()).add(row["kind"])
+        complete = {
+            tid for tid, kinds in by_id.items()
+            if {"dispatch", "sink"} <= kinds
+        }
+        assert complete, f"no complete journeys in the scrape: {by_id}"
+        exemplar_tids = {
+            e.get("trace_id") for e in flight.events()
+            if e.get("kind") == "latency_exemplar"
+        }
+        assert complete & exemplar_tids, (
+            "no scraped journey's sink hop matches a latency_exemplar "
+            f"trace id (journeys {sorted(complete)[:4]}, exemplars "
+            f"{sorted(t for t in exemplar_tids if t)[:4]})"
+        )
+        snap = metrics.struct_snapshot()["counters"]
+        assert snap.get("journeys_sampled", 0) >= 1, snap
+
+
 def check_recovery_drill() -> None:
     """Delivery-correctness tripwire: the ``--recovery-drill`` engine
     at smoke scale — one parent SIGKILL + poison records + decode
@@ -931,6 +1046,8 @@ def main() -> int:
     print("perf-smoke: overload drill OK", flush=True)
     check_drift_plane()
     print("perf-smoke: drift plane OK", flush=True)
+    check_journey_trace()
+    print("perf-smoke: journey trace OK", flush=True)
     check_recovery_drill()
     print("perf-smoke: recovery drill OK", flush=True)
     check_fault_hooks_noop()
