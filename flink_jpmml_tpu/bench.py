@@ -34,6 +34,12 @@ also carries:
     REAL Kafka wire-protocol stream (in-process broker serving magic-v2
     batches on loopback, C++ record-batch decoder on the consume side,
     production BlockPipeline scoring), reporting {rec_s, log_records}.
+    Round 14: ingest is PIPELINED by default — a prefetch/decode
+    sidecar (runtime/prefetch.py) overlaps fetch RPC + wire decode with
+    scoring, with zero-copy memoryviews socket→decoder; the line embeds
+    the sidecar's counters under "prefetch" and the decode-tier
+    microbench (tools/decode_bench.py) under "decode_bench".
+    --no-prefetch is the serial ablation.
   "interp_rec_s" / "interp_ratio" — a per-record oracle-interpreter
     (pmml/interp.py) baseline on the same model and host, and the measured
     speedup of the compiled path over it: the backend-independent
@@ -152,6 +158,7 @@ def _child_cmd(args, force_cpu: bool) -> list:
         ("--skip-interp", args.skip_interp),
         ("--skip-latency", args.skip_latency),
         ("--skip-kafka", args.skip_kafka),
+        ("--no-prefetch", args.no_prefetch),
         ("--no-autotune", args.no_autotune),
         ("--kernel-search", args.kernel_search),
         ("--no-kernel-search", args.no_kernel_search),
@@ -732,6 +739,115 @@ def _measure_latency_mode(doc, data_f32, args, use_quantized: bool):
     }
 
 
+def _probe_zero_copy_fetch() -> bool:
+    """Does ``fetch_raw`` hand back a view into the response payload
+    (zero-copy) rather than a bytes copy? Probed through the REAL
+    path — one fetch against an ephemeral loopback broker — so any
+    regression anywhere in client→reader→record-set extraction flips
+    the artifact field."""
+    from flink_jpmml_tpu.runtime.kafka import KafkaClient, MiniKafkaBroker
+
+    broker = MiniKafkaBroker(topic="probe")
+    try:
+        broker.append(b"\x00\x00\x00\x00")
+        client = KafkaClient(broker.host, broker.port)
+        try:
+            _, record_set = client.fetch_raw(
+                "probe", 0, 0, max_wait_ms=50
+            )
+        finally:
+            client.close()
+        return isinstance(record_set, memoryview) and len(record_set) > 0
+    except Exception:
+        return False  # a broken probe must not kill the bench
+    finally:
+        broker.close()
+
+
+def run_decode_bench(
+    records: int = 40_000, n_cols: int = 28, py_records: int = 4_000
+) -> dict:
+    """Decode-tier microbench: python-walk vs vectorized-numpy vs
+    native C++ record-batch decode over one synthetic fixed-width
+    record set (the tabular wire contract), parity-checked before
+    timing. → the JSON row ``tools/decode_bench.py`` prints and the
+    bench artifact embeds as ``kafka_mode.decode_bench``. The python
+    walk is timed on a subset (``py_records``) — it is two decades
+    slower and exists as the parity oracle, not a contender."""
+    import numpy as np
+
+    from flink_jpmml_tpu.runtime import native
+    from flink_jpmml_tpu.runtime.kafka import (
+        decode_record_batches_rows,
+        decode_record_batches_rows_py,
+        decode_record_batches_rows_vec,
+        encode_record_batch,
+    )
+
+    rng = np.random.default_rng(7)
+    rows = rng.normal(size=(records, n_cols)).astype(np.float32)
+
+    def record_set(arr):
+        parts = []
+        for i in range(0, arr.shape[0], 512):
+            chunk = arr[i : i + 512]
+            parts.append(encode_record_batch(
+                i, [chunk[j].tobytes() for j in range(chunk.shape[0])]
+            ))
+        return b"".join(parts)
+
+    buf = record_set(rows)
+    py_n = min(py_records, records)
+    buf_py = record_set(rows[:py_n])
+
+    # parity before stopwatch: every tier that will be timed must be
+    # byte-identical to the oracle on the subset (incl. the native
+    # decoder when present — a stale .so must not post a fast number)
+    o_py, r_py = decode_record_batches_rows_py(buf_py, n_cols)
+    o_vec, r_vec = decode_record_batches_rows_vec(buf_py, n_cols)
+    parity = bool(
+        (o_py == o_vec).all() and r_py.tobytes() == r_vec.tobytes()
+    )
+    if native.available():
+        o_nat, r_nat = decode_record_batches_rows(buf_py, n_cols)
+        parity = parity and bool(
+            (o_py == o_nat).all() and r_py.tobytes() == r_nat.tobytes()
+        )
+
+    def rate(fn, b, n, repeats):
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            fn(b, n_cols)
+        return n * repeats / (time.perf_counter() - t0)
+
+    line = {
+        "records": records,
+        "n_cols": n_cols,
+        "parity": parity,
+        # fetch_raw hands the decoder a memoryview of the response
+        # payload (no socket→decode copy) — probed, not asserted, so a
+        # regression to bytes-copying in the reader tier actually
+        # flips the field in artifacts
+        "zero_copy_fetch": _probe_zero_copy_fetch(),
+        "python_rec_s": round(
+            rate(decode_record_batches_rows_py, buf_py, py_n, 1), 1
+        ),
+        "vectorized_rec_s": round(
+            rate(decode_record_batches_rows_vec, buf, records, 3), 1
+        ),
+    }
+    if native.available():
+        line["native_rec_s"] = round(
+            rate(decode_record_batches_rows, buf, records, 3), 1
+        )
+    else:
+        line["native_rec_s"] = None
+    line["vectorized_speedup"] = round(
+        line["vectorized_rec_s"] / max(line["python_rec_s"], 1e-9), 1
+    )
+    return line
+
+
 def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
     """BASELINE config 2, literally: the GBM scored over a REAL Kafka
     wire-protocol stream — an in-process broker serving magic-v2 record
@@ -794,6 +910,11 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
             )),
             metrics=km,
             use_quantized=use_quantized,
+            # pipelined ingest (runtime/prefetch.py): fetch+decode on a
+            # sidecar thread, decoded blocks across a bounded handoff
+            # queue — the round-14 default; --no-prefetch is the serial
+            # ablation this line's rec_s used to measure
+            prefetch=not args.no_prefetch,
         )
         drift_fields = _drift_attach(km, cm)
         q = cm.quantized_scorer() if use_quantized else None
@@ -816,6 +937,39 @@ def _measure_kafka_mode(cm, data_f32, args, use_quantized: bool):
             "overlap_efficiency": ostats["overlap_efficiency"],
             "h2d_stall_ms": ostats["h2d_stall_ms"],
         }
+        # pipelined-ingest accounting (runtime/prefetch.py): queue
+        # depth high-water proves the sidecar actually ran ahead;
+        # stall vs block says which side of the handoff bounds rec_s
+        # (stall = ingest-bound, block = score-bound — the healthy one)
+        snap = km.struct_snapshot()
+        if not args.no_prefetch:
+            from flink_jpmml_tpu.runtime import prefetch as prefetch_mod
+
+            cs, gs = snap["counters"], snap["gauges"]
+            line["prefetch"] = {
+                "enabled": True,
+                "depth": prefetch_mod.env_depth(),
+                "batches": int(cs.get("prefetch_batches", 0)),
+                "records": int(cs.get("prefetch_records", 0)),
+                "depth_max": gs.get("prefetch_depth", {}).get("max", 0.0),
+                "occupancy_max": gs.get(
+                    "prefetch_occupancy", {}
+                ).get("max", 0.0),
+                "stall_ms": round(
+                    1000 * cs.get("prefetch_stall_s", 0.0), 1
+                ),
+                "block_ms": round(
+                    1000 * cs.get("prefetch_block_s", 0.0), 1
+                ),
+            }
+        else:
+            line["prefetch"] = {"enabled": False}
+        # the decode-tier microbench (tools/decode_bench.py), embedded
+        # so every artifact carries the python/vectorized/native ladder
+        # measured on THIS host
+        line["decode_bench"] = run_decode_bench(
+            records=20_000, n_cols=data_f32.shape[1], py_records=2_000
+        )
         # encode placement + consumer decode accounting (encode_ms ≈ 0
         # when the autotuner fused the bucketize onto the device)
         line.update(wire_stats(pipe.metrics, count[0]))
@@ -1364,9 +1518,14 @@ def run_burst_drill(
             # window + multi-chunk aggregation would swallow the whole
             # burst into host memory and the BROKER-side lag the drill
             # exists to exercise (kafka_lag, fetch-time watermark lag)
-            # would never build — backpressure must reach the source
+            # would never build — backpressure must reach the source.
+            # The prefetch sidecar is one more such buffer (its handoff
+            # queue absorbs several fetches of burst surplus at this
+            # smoke scale), so the drill runs serial ingest: it
+            # measures the LAG PLANE, not ingest throughput
             in_flight=1,
             max_dispatch_chunks=1,
+            prefetch=False,
         )
         q = cm.quantized_scorer()
         if q is not None:
@@ -2495,6 +2654,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="skip the latency-mode operating point")
     ap.add_argument("--skip-kafka", action="store_true",
                     help="skip the Kafka wire-protocol operating point")
+    ap.add_argument("--no-prefetch", action="store_true",
+                    help="ablation: run kafka mode WITHOUT the "
+                         "pipelined-ingest sidecar (runtime/prefetch.py)"
+                         " — fetch+decode back on the ingest thread, "
+                         "the pre-round-14 serial operating point")
     ap.add_argument("--no-autotune", action="store_true",
                     help="skip the warmup autotune sweep (ablation: the "
                          "hand-picked defaults + host encode)")

@@ -399,16 +399,21 @@ def _top_render(label: str, struct: dict, out, source: str = None) -> None:
         print("(no stage attribution recorded)", file=out)
         return
     print(
-        f"{'stage':<12}{'batches':>9}{'p50 ms':>10}{'p99 ms':>10}"
-        f"{'total ms':>12}{'share':>8}",
+        f"{'stage':<14}{'thread':<10}{'batches':>9}{'p50 ms':>10}"
+        f"{'p99 ms':>10}{'total ms':>12}{'share':>8}",
         file=out,
     )
     ranked = sorted(
         summary.items(), key=lambda kv: kv[1]["total_ms"], reverse=True
     )
     for stage, row in ranked:
+        # decode-thread stages vs hot-path stages (obs/attr.py): with
+        # pipelined ingest armed, "ingest" time runs on the prefetch
+        # sidecar and overlaps scoring — only "score"/"ring-feed" rows
+        # steal from the hot path
+        thread = attr.STAGE_THREADS.get(stage, "-")
         print(
-            f"{stage:<12}{row['n']:>9}{row['p50_ms']:>10.3f}"
+            f"{stage:<14}{thread:<10}{row['n']:>9}{row['p50_ms']:>10.3f}"
             f"{row['p99_ms']:>10.3f}{row['total_ms']:>12.3f}"
             f"{100.0 * row['share']:>7.1f}%",
             file=out,

@@ -18,8 +18,15 @@ fleet-wide ranked list of which stage to attack next.
 
 Stage semantics (who observes what):
 
-- ``fetch``     — source fetch RPC (kafka consumer, per fetch);
-- ``decode``    — wire → f32 block decode (kafka consumer thread);
+- ``fetch``     — source fetch RPC (kafka consumer, per fetch; on the
+                  prefetch sidecar when pipelined ingest is armed);
+- ``decode``    — wire → f32 block decode (kafka consumer thread /
+                  prefetch sidecar);
+- ``prefetch_wait`` — the ring-feeding thread waiting on an EMPTY
+                  prefetch handoff queue (runtime/prefetch.py): the
+                  residual ingest cost once fetch+decode moved
+                  off-thread — if this ranks high, the sidecar is the
+                  bottleneck, not the hot path;
 - ``encode``    — host featurize+align on the dispatch path
                   (``dispatch_quantized``; ≈0 when the encode is fused
                   on-device);
@@ -67,9 +74,27 @@ from flink_jpmml_tpu.obs import trace as trace_mod
 from flink_jpmml_tpu.utils.metrics import Histogram, MetricsRegistry
 
 STAGES = (
-    "fetch", "decode", "encode", "h2d",
+    "fetch", "decode", "prefetch_wait", "encode", "h2d",
     "queue_wait", "device", "readback", "sink",
 )
+
+# which thread each stage is observed on — rendered as the fjt-top
+# stage table's thread column so an operator reading a pipelined-ingest
+# profile knows which stages burn SIDECAR time (overlapped with
+# scoring; runtime/prefetch.py moves fetch/decode there) vs hot-path
+# time. "ingest" = the source-facing thread: the prefetch sidecar when
+# one is armed, the pipeline's own ingest thread otherwise.
+STAGE_THREADS = {
+    "fetch": "ingest",
+    "decode": "ingest",
+    "prefetch_wait": "ring-feed",  # hot path waiting on the handoff
+    "encode": "score",
+    "h2d": "score",
+    "queue_wait": "score",
+    "device": "device",
+    "readback": "score",
+    "sink": "score",
+}
 
 _STALL_MS_ENV = "FJT_SLO_TARGET_MS"
 _STALL_FRAC_ENV = "FJT_SLO_STALL_FRAC"

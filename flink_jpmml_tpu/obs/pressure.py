@@ -14,15 +14,19 @@ runtime, each partial on its own:
   ``runtime/pipeline.py``): the device outrunning its readback budget;
 - **admission wait** — the share of wall clock batches spent waiting
   for a window slot (the ``queue_wait`` stage histogram's sum delta
-  over the tick interval, ``obs/attr.py``).
+  over the tick interval, ``obs/attr.py``);
+- **prefetch fill** — the pipelined-ingest handoff queue's occupancy
+  (``prefetch_occupancy`` gauge + the sidecar's ``note_prefetch``
+  peak-hold, ``runtime/prefetch.py``): the fetch/decode sidecar
+  outrunning the ring/score side.
 
 :class:`PressureMonitor` folds them into one ``pressure`` score in
 [0, 1] — the MAX of the components (saturation anywhere is saturation;
 averaging would let an empty ring excuse a blocked window) — exposed as
 ``pressure`` (+ per-component ``pressure_ring`` / ``pressure_window`` /
-``pressure_wait`` gauges, fleet merge worst-of like the PR 6 ratio
-gauges) on ``/metrics`` and ``/varz``, rendered by ``fjt-top
---freshness``.
+``pressure_wait`` / ``pressure_prefetch`` gauges, fleet merge worst-of
+like the PR 6 ratio gauges) on ``/metrics`` and ``/varz``, rendered by
+``fjt-top --freshness``.
 
 Sustained pressure raises a **multi-window breach** exactly like the
 ``obs/slo.py`` burn-rate tracker (the machinery this reuses: trailing
@@ -88,10 +92,16 @@ class PressureMonitor:
         # controller needs it steady. The peak-hold keeps the worst
         # occupancy any drain STARTED from within the interval.
         self._ring_peak = 0.0
+        # prefetch handoff-queue fill peak since the last tick
+        # (runtime/prefetch.py note_prefetch) — the pipelined-ingest
+        # twin of the ring peak-hold: a full handoff queue means the
+        # fetch side is outrunning everything downstream
+        self._prefetch_peak = 0.0
         # delta baselines
         self._dispatches = metrics.counter("dispatches")
         self._window_full = metrics.counter("window_full_launches")
         self._ring = metrics.gauge("ring_occupancy")
+        self._prefetch = metrics.gauge("prefetch_occupancy")
         # the queue_wait stage histogram (obs/attr.py naming), resolved
         # through stage_metric_name so the lint's catalogue keeps one
         # wildcard row for the whole stage family
@@ -102,6 +112,7 @@ class PressureMonitor:
         self._g_ring = metrics.gauge("pressure_ring")
         self._g_window = metrics.gauge("pressure_window")
         self._g_wait = metrics.gauge("pressure_wait")
+        self._g_prefetch = metrics.gauge("pressure_prefetch")
         self._breaches = metrics.counter("pressure_breaches")
         self._base_disp = self._dispatches.get()
         self._base_full = self._window_full.get()
@@ -122,6 +133,14 @@ class PressureMonitor:
         with self._mu:
             if occupancy > self._ring_peak:
                 self._ring_peak = occupancy
+
+    def note_prefetch(self, occupancy: float) -> None:
+        """Record a prefetch handoff-queue fill observation (the
+        sidecar calls this on every push); the next tick's prefetch
+        component is the max of the gauge and this peak."""
+        with self._mu:
+            if occupancy > self._prefetch_peak:
+                self._prefetch_peak = occupancy
 
     # -- ticking -------------------------------------------------------------
 
@@ -160,13 +179,17 @@ class PressureMonitor:
                 max(self._ring.get(), self._ring_peak, 0.0), 1.0
             )
             self._ring_peak = 0.0
+            prefetch = min(
+                max(self._prefetch.get(), self._prefetch_peak, 0.0), 1.0
+            )
+            self._prefetch_peak = 0.0
             window = (
                 min(max(d_full / d_disp, 0.0), 1.0) if d_disp > 0 else 0.0
             )
             wait = (
                 min(max(d_wait / dt, 0.0), 1.0) if dt is not None else 0.0
             )
-            p = max(ring, window, wait)
+            p = max(ring, window, wait, prefetch)
             self._last_tick = now
             self._frames.append((now, p))
             widest = max(w for w, _ in self.windows)
@@ -202,12 +225,13 @@ class PressureMonitor:
             breached = self._breached
             self._last = {
                 "pressure": p, "ring": ring, "window": window,
-                "wait": wait, "means": means,
+                "wait": wait, "prefetch": prefetch, "means": means,
             }
         self._gauge.set(round(p, 4))
         self._g_ring.set(round(ring, 4))
         self._g_window.set(round(window, 4))
         self._g_wait.set(round(wait, 4))
+        self._g_prefetch.set(round(prefetch, 4))
         if transition == "breach":
             self._breaches.inc()
             flight.record(
@@ -226,6 +250,7 @@ class PressureMonitor:
             "ring": ring,
             "window": window,
             "wait": wait,
+            "prefetch": prefetch,
             "breached": breached,
             "transition": transition,
         }
@@ -247,7 +272,7 @@ class PressureMonitor:
                     "score": round(self._last.get("pressure", 0.0), 4),
                     "components": {
                         k: round(self._last.get(k, 0.0), 4)
-                        for k in ("ring", "window", "wait")
+                        for k in ("ring", "window", "wait", "prefetch")
                     },
                 },
             }

@@ -36,6 +36,7 @@ from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.obs import trace as trace_mod
 from flink_jpmml_tpu.runtime import faults
+from flink_jpmml_tpu.runtime import prefetch as prefetch_mod
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.runtime.dlq import (
     REASON_CRASH_LOOP,
@@ -307,6 +308,7 @@ class BlockPipelineBase:
         admission=None,
         shed_lane: str = "block",
         dlq=None,
+        prefetch: Optional[bool] = None,
     ):
         self._source = source
         self._sink = sink
@@ -346,6 +348,15 @@ class BlockPipelineBase:
         self._max_dispatch_chunks = max(1, max_dispatch_chunks)
         self._config = config or RuntimeConfig()
         self.metrics = metrics or MetricsRegistry()
+        # pipelined ingest (runtime/prefetch.py): sources that mark
+        # themselves prefetchable (the Kafka sources — real network
+        # fetch + wire decode) get a sidecar thread running their poll
+        # loop, so this pipeline's ingest thread only moves decoded
+        # blocks into the ring. prefetch=None is auto; the wrapper
+        # proxies seek/checkpoint hooks, so restore() is unchanged.
+        self._source = prefetch_mod.maybe_wrap_block(
+            self._source, metrics=self.metrics, enable=prefetch
+        )
         self._ring = make_ring(
             self._config.batch.queue_capacity,
             arity,
@@ -529,6 +540,12 @@ class BlockPipelineBase:
 
     def stop(self) -> None:
         self._stop.set()
+        stop_sidecar = getattr(self._source, "stop_prefetch", None)
+        if stop_sidecar is not None:
+            # park the prefetch sidecar too: without this it would keep
+            # fetching into the (bounded) handoff queue until the
+            # process exits — harmless but dishonest in lag gauges
+            stop_sidecar()
         self._ring.close()
 
     def join(self, timeout: Optional[float] = None) -> None:
@@ -1325,6 +1342,7 @@ class BlockPipeline(BlockPipelineBase):
         admission=None,
         shed_lane: str = "block",
         dlq=None,
+        prefetch: Optional[bool] = None,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -1348,6 +1366,7 @@ class BlockPipeline(BlockPipelineBase):
             admission=admission,
             shed_lane=shed_lane,
             dlq=dlq,
+            prefetch=prefetch,
         )
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend

@@ -36,6 +36,7 @@ from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import trace as trace_mod
 from flink_jpmml_tpu.runtime import faults
+from flink_jpmml_tpu.runtime import prefetch as prefetch_mod
 from flink_jpmml_tpu.runtime.checkpoint import (
     CheckpointManager,
     CheckpointPolicy,
@@ -171,12 +172,19 @@ class Pipeline:
         checkpoint: Optional[CheckpointManager] = None,
         in_flight: int = 2,
         dlq=None,
+        prefetch: Optional[bool] = None,
     ):
         self._source = source
         self._scorer = scorer
         self._sink = sink
         self._config = config or RuntimeConfig()
         self.metrics = metrics or MetricsRegistry()
+        # pipelined ingest (runtime/prefetch.py): prefetchable sources
+        # (Kafka — network fetch + decode) poll on a sidecar thread and
+        # hand decoded records across a bounded queue; cf. block.py
+        self._source = prefetch_mod.maybe_wrap_records(
+            self._source, metrics=self.metrics, enable=prefetch
+        )
         backend = getattr(scorer, "backend", None)
         if backend:
             self.metrics.counter(f"scorer_backend_{backend}").inc()
@@ -325,6 +333,9 @@ class Pipeline:
 
     def stop(self) -> None:
         self._stop.set()
+        stop_sidecar = getattr(self._source, "stop_prefetch", None)
+        if stop_sidecar is not None:
+            stop_sidecar()  # park the prefetch sidecar (cf. block.py)
         self._queue.close()
 
     def join(self, timeout: Optional[float] = None) -> None:

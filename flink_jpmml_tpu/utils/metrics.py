@@ -773,6 +773,9 @@ _GAUGE_MERGE_MAX_PREFIXES = (
     "drift_score", "prediction_drift", "feature_missing_rate",
     "unseen_category_rate", "drift_alarmed", "rollout_prediction_psi",
     "rollout_stage", "kafka_lag",
+    # pipelined ingest (runtime/prefetch.py): handoff-queue fill is a
+    # saturation fraction — the fleet view wants the worst worker
+    "prefetch_occupancy",
     # delivery-correctness plane (runtime/dlq.py): 1 while a worker is
     # bisecting poison — one suspect worker flags the fleet. (Parens in
     # these comments are fine now: metrics_lint parses the real AST,

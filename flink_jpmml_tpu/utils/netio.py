@@ -15,13 +15,19 @@ from typing import Optional
 
 
 def recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
-    buf = bytearray()
-    while len(buf) < n:
+    # recv_into a preallocated buffer: no per-chunk bytes objects or
+    # append-resize churn; ONE final copy remains, to keep the bytes
+    # return type (KafkaClient._recv_exact, client-side and hotter,
+    # returns the bytearray itself)
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
         try:
-            chunk = conn.recv(n - len(buf))
+            r = conn.recv_into(view[got:])
         except OSError:
             return None
-        if not chunk:
+        if not r:
             return None
-        buf += chunk
+        got += r
     return bytes(buf)

@@ -25,6 +25,7 @@ def _args(**over):
         skip_latency=False, latency=False, latency_batch=4096,
         latency_deadline_us=2000, latency_offered=100000.0,
         no_autotune=False, kernel_search=False, no_kernel_search=False,
+        no_prefetch=False,
         load_shape="steady",
         in_child=False, force_cpu=False, block_pipeline=False,
     )
@@ -58,6 +59,14 @@ class TestChildCmd:
         assert "--no-autotune" not in bench._child_cmd(_args(), False)
         assert "--no-autotune" in bench._child_cmd(
             _args(no_autotune=True), False
+        )
+
+    def test_no_prefetch_flag_passthrough(self):
+        # the serial-ingest ablation must reach the measurement child,
+        # or --no-prefetch silently measures the pipelined path
+        assert "--no-prefetch" not in bench._child_cmd(_args(), False)
+        assert "--no-prefetch" in bench._child_cmd(
+            _args(no_prefetch=True), False
         )
 
     def test_kernel_search_flags_passthrough(self):

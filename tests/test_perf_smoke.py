@@ -34,6 +34,7 @@ def test_perf_smoke_passes():
     )
     assert "dispatcher ordering OK" in proc.stdout
     assert "block pipeline drain/ordering OK" in proc.stdout
+    assert "kafka pipeline OK" in proc.stdout
     assert "fused encode parity OK" in proc.stdout
     assert "autotune cache roundtrip OK" in proc.stdout
     assert "kernel search OK" in proc.stdout

@@ -1,0 +1,616 @@
+"""Pipelined ingest (ISSUE 14): the prefetch/decode sidecar
+(runtime/prefetch.py) and the vectorized/zero-copy decode tiers
+(runtime/kafka.py).
+
+The sidecar is a PERFORMANCE change, not a semantics change — these
+tests pin every contract that has to survive the move off-thread:
+byte parity of the vectorized decoder against the python-walk oracle
+(NaN/±inf payloads, wrong-length poison, header-carrying records,
+CRC damage), strict delivery ordering, seek / reconnect / shutdown
+drills, freshness-stamp and journey-hop preservation through the
+handoff queue, and DLQ routing from the decode thread.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.runtime import prefetch as prefetch_mod
+from flink_jpmml_tpu.runtime.kafka import (
+    KafkaBlockSource,
+    KafkaPartitionError,
+    KafkaRecordSource,
+    MiniKafkaBroker,
+    crc32c,
+    crc32c_vec,
+    decode_record_batches_rows,
+    decode_record_batches_rows_py,
+    decode_record_batches_rows_vec,
+    encode_record_batch,
+)
+from flink_jpmml_tpu.runtime.prefetch import (
+    PrefetchedBlockSource,
+    PrefetchedRecordSource,
+    maybe_wrap_block,
+    maybe_wrap_records,
+)
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+
+def _drain_blocks(src, want, timeout=30.0):
+    got = []
+    pos = 0
+    deadline = time.monotonic() + timeout
+    while pos < want and time.monotonic() < deadline:
+        polled = src.poll()
+        if polled is None:
+            time.sleep(0.002)
+            continue
+        got.append(polled)
+        pos += polled[1].shape[0]
+    return got, pos
+
+
+class TestCrcVec:
+    def test_concurrent_cold_start_is_race_free(self):
+        """The engine is shared across decode sidecars and broker
+        handler threads; a lazily-extended operator chain raced and
+        poisoned the table caches PERMANENTLY (review finding, pinned:
+        the chain is now frozen at construction)."""
+        from flink_jpmml_tpu.runtime.kafka import _Crc32cVec
+
+        import random
+
+        rng = random.Random(5)
+        datas = [
+            bytes(rng.randrange(256) for _ in range(1500 + i * 53))
+            for i in range(8)
+        ]
+        expected = [crc32c(d) for d in datas]
+        for _ in range(20):
+            eng = _Crc32cVec()  # cold caches every round
+            results = [None] * 8
+            threads = [
+                threading.Thread(
+                    target=lambda i=i: results.__setitem__(
+                        i, eng.crc(datas[i])
+                    )
+                )
+                for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results == expected
+            # and nothing sticky: serial rechecks stay right
+            assert [eng.crc(d) for d in datas] == expected
+
+    def test_known_vector_and_parity(self):
+        assert crc32c_vec(b"123456789") == 0xE3069283
+        import random
+
+        rng = random.Random(11)
+        for ln in (0, 1, 7, 8, 9, 63, 64, 65, 127, 509, 4096, 40001):
+            data = bytes(rng.randrange(256) for _ in range(ln))
+            assert crc32c_vec(data) == crc32c(data), ln
+            assert crc32c_vec(memoryview(data)) == crc32c(data)
+
+
+class TestVectorizedDecodeParity:
+    N_COLS = 6
+
+    def _rows(self, n, seed=0):
+        rng = np.random.default_rng(seed)
+        rows = rng.normal(size=(n, self.N_COLS)).astype(np.float32)
+        rows[min(3, n - 1), 0] = np.nan
+        rows[min(5, n - 1), 1] = np.inf
+        rows[min(7, n - 1), 2] = -np.inf
+        return rows
+
+    def _buf(self, rows, base=0, headers=None, timestamp_ms=0):
+        vals = [rows[i].tobytes() for i in range(rows.shape[0])]
+        return encode_record_batch(
+            base, vals, timestamp_ms=timestamp_ms, headers=headers
+        )
+
+    def _assert_parity(self, buf):
+        o1, r1 = decode_record_batches_rows_py(buf, self.N_COLS)
+        o2, r2 = decode_record_batches_rows_vec(buf, self.N_COLS)
+        assert (o1 == o2).all()
+        assert r1.tobytes() == r2.tobytes()
+        # the native-or-vec dispatcher agrees too
+        o3, r3 = decode_record_batches_rows(buf, self.N_COLS)
+        assert (o1 == o3).all() and r1.tobytes() == r3.tobytes()
+        return o1, r1
+
+    def test_canonical_multi_batch_with_partial_tail(self):
+        rows = self._rows(1200, seed=1)
+        buf = b"".join(
+            self._buf(rows[i : i + 512], base=i)
+            for i in range(0, 1200, 512)
+        )
+        offs, dec = self._assert_parity(buf + buf[:25])
+        assert offs.shape[0] == 1200
+        assert dec.tobytes() == rows.tobytes()
+        # memoryview input: the zero-copy fetch path's shape
+        o2, r2 = decode_record_batches_rows_vec(
+            memoryview(buf), self.N_COLS
+        )
+        assert r2.tobytes() == rows.tobytes()
+
+    def test_varint_width_boundary(self):
+        # offset deltas cross the 1→2-byte varint width at 64: the
+        # closed-form offset table must track it exactly
+        rows = self._rows(130, seed=2)
+        self._assert_parity(self._buf(rows, base=1_000_000))
+
+    def test_header_records_fall_back_byte_identically(self):
+        rows = self._rows(100, seed=3)
+        hdrs = [None] * 100
+        hdrs[4] = [("traceparent", b"00-aa-bb-01")]
+        buf = self._buf(rows, headers=hdrs)
+        offs, dec = self._assert_parity(buf)
+        assert dec.tobytes() == rows.tobytes()
+
+    def test_wrong_length_value_raises_on_every_tier(self):
+        buf = encode_record_batch(0, [b"\x01" * 9])
+        for fn in (
+            decode_record_batches_rows_py,
+            decode_record_batches_rows_vec,
+            decode_record_batches_rows,
+        ):
+            with pytest.raises(ValueError):
+                fn(buf, self.N_COLS)
+
+    def test_crc_damage_raises_on_every_tier(self):
+        rows = self._rows(64, seed=4)
+        buf = bytearray(self._buf(rows))
+        buf[70] ^= 0xFF  # inside the records region
+        for fn in (
+            decode_record_batches_rows_py,
+            decode_record_batches_rows_vec,
+        ):
+            with pytest.raises(ValueError, match="CRC32C"):
+                fn(bytes(buf), self.N_COLS)
+
+    def test_empty_buffer(self):
+        o, r = decode_record_batches_rows_vec(b"", self.N_COLS)
+        assert o.shape == (0,) and r.shape == (0, self.N_COLS)
+
+
+class TestPrefetchedBlockSource:
+    def test_ordering_and_no_loss(self):
+        data = np.arange(3000 * 3, dtype=np.float32).reshape(3000, 3)
+        broker = MiniKafkaBroker(topic="p")
+        try:
+            broker.append_rows(data)
+            m = MetricsRegistry()
+            src = PrefetchedBlockSource(
+                KafkaBlockSource(
+                    broker.host, broker.port, "p",
+                    n_cols=3, max_wait_ms=20,
+                ),
+                depth=3, metrics=m,
+            )
+            try:
+                got, pos = _drain_blocks(src, 3000)
+                assert pos == 3000
+                cursor = 0
+                merged = []
+                for off, blk in got:
+                    assert off == cursor
+                    cursor += blk.shape[0]
+                    merged.append(blk)
+                assert np.concatenate(merged).tobytes() == data.tobytes()
+                snap = m.struct_snapshot()
+                assert snap["counters"]["prefetch_records"] == 3000
+                assert snap["gauges"]["prefetch_depth"]["max"] >= 1
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_seek_discards_prefetched_batches(self):
+        data = np.arange(2000 * 2, dtype=np.float32).reshape(2000, 2)
+        broker = MiniKafkaBroker(topic="s")
+        try:
+            broker.append_rows(data)
+            src = PrefetchedBlockSource(
+                KafkaBlockSource(
+                    broker.host, broker.port, "s",
+                    n_cols=2, max_wait_ms=20,
+                    # small fetches so several batches queue ahead
+                    max_bytes=2048,
+                ),
+                depth=4,
+            )
+            try:
+                got, pos = _drain_blocks(src, 200)
+                assert pos >= 200
+                # let the sidecar run ahead, then rewind mid-stream
+                time.sleep(0.05)
+                src.seek(100)
+                polled = None
+                deadline = time.monotonic() + 15.0
+                while polled is None and time.monotonic() < deadline:
+                    polled = src.poll()
+                off, blk = polled
+                # the first post-seek block starts EXACTLY at the seek
+                # offset: nothing stale crossed the handoff queue
+                assert off == 100
+                assert blk[0, 0] == data[100, 0]
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_survives_broker_restart(self):
+        data = np.arange(400 * 3, dtype=np.float32).reshape(400, 3)
+        broker = MiniKafkaBroker(topic="r")
+        port = broker.port
+        src = PrefetchedBlockSource(
+            KafkaBlockSource(
+                broker.host, port, "r", n_cols=3, max_wait_ms=20,
+            ),
+            depth=2,
+        )
+        broker.append_rows(data[:250])
+        got, pos = _drain_blocks(src, 250)
+        assert pos == 250
+        broker.close()  # broker dies mid-stream
+        # outage: polls yield None (inner reconnect/backoff), no raise
+        assert src.poll() is None
+        broker2 = MiniKafkaBroker(topic="r", port=port)
+        try:
+            broker2.append_rows(data)
+            got2, pos2 = _drain_blocks(src, 150)
+            assert pos2 == 150
+            assert got2[0][0] == 250  # resumed at exactly the cursor
+            src.close()
+        finally:
+            broker2.close()
+
+    def test_partition_error_propagates(self):
+        broker = MiniKafkaBroker(topic="x", n_partitions=1)
+        try:
+            src = PrefetchedBlockSource(
+                KafkaBlockSource(
+                    broker.host, broker.port, "x",
+                    partition=7,  # phantom partition: fail fast
+                    n_cols=2, max_wait_ms=20,
+                ),
+                depth=2,
+            )
+            with pytest.raises(KafkaPartitionError):
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    src.poll()
+                    time.sleep(0.002)
+            # sticky: the next poll re-raises instead of hanging
+            with pytest.raises(KafkaPartitionError):
+                src.poll()
+            src.close()
+        finally:
+            broker.close()
+
+    def test_seek_after_sidecar_error_recovers(self):
+        """A seek/restore after a sidecar exception must discard the
+        queued pre-seek batches, drop the sticky error, and spawn a
+        fresh sidecar (review finding, pinned: the dead-thread pause
+        used to skip all three)."""
+
+        class _Inner:
+            prefetchable = True
+            exhausted = False
+
+            def __init__(self):
+                self.cursor = 0
+                self.fail_at = 3  # batches 0,1,2 queue, then death
+
+            def poll(self):
+                off = self.cursor
+                if off == self.fail_at:
+                    self.fail_at = None  # fail once
+                    raise ConnectionError("boom")
+                self.cursor += 1
+                return off, np.full((1, 2), off, np.float32)
+
+            def seek(self, offset):
+                self.cursor = offset
+
+            def close(self):
+                pass
+
+        src = PrefetchedBlockSource(_Inner(), depth=8)
+        src.poll()  # start the sidecar
+        t = src._thread
+        deadline = time.monotonic() + 10.0
+        while t.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.002)  # sidecar queues 0..2, then dies
+        assert not t.is_alive()
+        # seek with stale batches STILL QUEUED: they must not survive
+        src.seek(0)
+        polled = None
+        deadline = time.monotonic() + 10.0
+        while polled is None and time.monotonic() < deadline:
+            polled = src.poll()
+        # fresh sidecar, re-seeked source, nothing stale: offset 0 again
+        assert polled is not None and polled[0] == 0
+        src.close()
+
+    def test_shutdown_joins_sidecar(self):
+        broker = MiniKafkaBroker(topic="c")
+        try:
+            src = PrefetchedBlockSource(
+                KafkaBlockSource(
+                    broker.host, broker.port, "c",
+                    n_cols=2, max_wait_ms=20,
+                ),
+                depth=2,
+            )
+            src.poll()  # start the sidecar
+            t = src._thread
+            assert t is not None and t.is_alive()
+            src.close()
+            assert not t.is_alive()
+        finally:
+            broker.close()
+
+    def test_checkpoint_hooks_proxy_to_inner(self):
+        broker = MiniKafkaBroker(topic="h", n_partitions=2)
+        try:
+            inner = KafkaBlockSource(
+                broker.host, broker.port, "h",
+                partitions=[0, 1], n_cols=2, max_wait_ms=20,
+            )
+            src = maybe_wrap_block(inner, enable=True)
+            assert isinstance(src, PrefetchedBlockSource)
+            # vector-mode checkpoint state resolves through the wrapper
+            state = src.checkpoint_state(0)
+            assert state == {"offset": 0, "cursors": {"0": 0, "1": 0}}
+            assert src.restore_state(state) == 0
+            src.close()
+        finally:
+            broker.close()
+
+    def test_freshness_stamps_survive_the_handoff(self):
+        rng = np.random.default_rng(6)
+        data = rng.normal(size=(256, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="fresh")
+        m = MetricsRegistry()
+        try:
+            now_ms = int(time.time() * 1000)
+            broker.append_rows(data, timestamp_ms=now_ms - 3_000)
+            src = maybe_wrap_block(
+                KafkaBlockSource(
+                    broker.host, broker.port, "fresh",
+                    n_cols=4, max_wait_ms=20, metrics=m,
+                ),
+                metrics=m,
+            )
+            assert isinstance(src, PrefetchedBlockSource)
+            try:
+                got, pos = _drain_blocks(src, 256, timeout=15.0)
+                assert pos == 256
+                g = m.struct_snapshot()["gauges"]
+                wm_lag = g.get('watermark_lag_s{partition="0"}')
+                assert wm_lag is not None
+                assert 2.5 <= wm_lag["value"] < 60.0
+                # the sink side still consumes the sidecar's stamps
+                from flink_jpmml_tpu.obs.freshness import freshness_for
+
+                freshness_for(m).observe_sink(0, 256)
+                h = m.histogram("record_staleness_s")
+                assert h.count() >= 1
+                assert h.quantile(0.5) == pytest.approx(3.0, abs=2.0)
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_journey_ingest_hops_from_decode_thread(
+        self, tmp_path, monkeypatch
+    ):
+        from flink_jpmml_tpu.obs import trace as trace_mod
+
+        monkeypatch.setenv("FJT_JOURNEY_DIR", str(tmp_path / "j"))
+        rng = np.random.default_rng(8)
+        data = rng.normal(size=(64, 3)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="j")
+        m = MetricsRegistry()
+        try:
+            broker.append_rows(data)
+            src = maybe_wrap_block(
+                KafkaBlockSource(
+                    broker.host, broker.port, "j",
+                    n_cols=3, max_wait_ms=20, metrics=m,
+                ),
+                metrics=m,
+            )
+            try:
+                got, pos = _drain_blocks(src, 64, timeout=15.0)
+                assert pos == 64
+                store = trace_mod.store_for(m)
+                assert store is not None
+                # the ingest hop was recorded (durably) from the
+                # SIDECAR thread, keyed to the fetched run's offsets
+                rows = trace_mod.read_rows(store.directory)
+                ingests = [r for r in rows if r["kind"] == "ingest"]
+                assert ingests, rows
+                assert ingests[0]["first_off"] == 0
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+    def test_dlq_routing_from_decode_thread(self, tmp_path):
+        from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+
+        rng = np.random.default_rng(9)
+        data = rng.normal(size=(100, 4)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="d")
+        m = MetricsRegistry()
+        dlq = DeadLetterQueue(str(tmp_path / "dlq"), metrics=m)
+        try:
+            broker.append_rows(data[:50])
+            broker.append(b"\xde\xad")  # poison: wrong-length value
+            broker.append_rows(data[50:])
+            src = maybe_wrap_block(
+                KafkaBlockSource(
+                    broker.host, broker.port, "d",
+                    n_cols=4, max_wait_ms=20, metrics=m, dlq=dlq,
+                ),
+                metrics=m,
+            )
+            assert isinstance(src, PrefetchedBlockSource)
+            try:
+                got, pos = _drain_blocks(src, 100, timeout=15.0)
+                assert pos == 100  # 100 good rows; poison skipped
+                offsets = set()
+                for off, blk in got:
+                    offsets.update(range(off, off + blk.shape[0]))
+                assert 50 not in offsets  # the poison offset
+                entries = list(dlq.scan())
+                assert len(entries) == 1
+                assert entries[0]["offset"] == 50
+                assert entries[0]["reason"] == "decode"
+                snap = m.struct_snapshot()["counters"]
+                assert snap['decode_errors{partition="0"}'] == 1
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+
+class TestPrefetchedRecordSource:
+    def test_rechunks_to_max_n_in_order(self):
+        broker = MiniKafkaBroker(topic="rec")
+        try:
+            vals = [b'{"i": %d}' % i for i in range(500)]
+            broker.append(*vals)
+            src = maybe_wrap_records(
+                KafkaRecordSource(
+                    broker.host, broker.port, "rec", max_wait_ms=20,
+                ),
+            )
+            assert isinstance(src, PrefetchedRecordSource)
+            try:
+                out = []
+                deadline = time.monotonic() + 20.0
+                while len(out) < 500 and time.monotonic() < deadline:
+                    out.extend(src.poll(64))
+                assert [r["i"] for _, r in out] == list(range(500))
+                # record-source offsets are "position after": 1-based
+                assert [o for o, _ in out] == list(range(1, 501))
+            finally:
+                src.close()
+        finally:
+            broker.close()
+
+
+class TestWrapPolicy:
+    def test_env_kill_switch_wins(self, monkeypatch):
+        class _Src:
+            prefetchable = True
+
+        monkeypatch.setenv(prefetch_mod.ENV_DISABLE, "1")
+        s = _Src()
+        assert maybe_wrap_block(s, enable=True) is s
+        assert maybe_wrap_records(s, enable=True) is s
+
+    def test_auto_wraps_only_marked_sources(self):
+        class _Plain:
+            pass
+
+        class _Marked:
+            prefetchable = True
+
+            def close(self):
+                pass
+
+        assert maybe_wrap_block(_Plain()) is not None
+        assert not isinstance(maybe_wrap_block(_Plain()),
+                              PrefetchedBlockSource)
+        wrapped = maybe_wrap_block(_Marked())
+        assert isinstance(wrapped, PrefetchedBlockSource)
+        # no double wrap
+        assert maybe_wrap_block(wrapped) is wrapped
+
+    def test_depth_env(self, monkeypatch):
+        monkeypatch.setenv(prefetch_mod.ENV_DEPTH, "9")
+        assert prefetch_mod.env_depth() == 9
+        monkeypatch.setenv(prefetch_mod.ENV_DEPTH, "junk")
+        assert prefetch_mod.env_depth() == prefetch_mod.DEFAULT_DEPTH
+
+
+class TestPressurePrefetchComponent:
+    def test_occupancy_feeds_the_composite(self):
+        from flink_jpmml_tpu.obs.pressure import PressureMonitor
+
+        clk = {"t": 1000.0}
+        m = MetricsRegistry()
+        mon = PressureMonitor(
+            m, windows=((2.0, 0.5),), clock=lambda: clk["t"]
+        )
+        mon.tick()
+        mon.note_prefetch(0.9)  # sidecar peak-hold between ticks
+        clk["t"] += 1.0
+        out = mon.tick()
+        assert out["prefetch"] == pytest.approx(0.9)
+        assert out["pressure"] == pytest.approx(0.9)
+        assert m.gauge("pressure_prefetch").get() == pytest.approx(0.9)
+        # gauge-read path too (no peak noted since)
+        m.gauge("prefetch_occupancy").set(0.4)
+        clk["t"] += 1.0
+        out = mon.tick()
+        assert out["prefetch"] == pytest.approx(0.4)
+        assert "prefetch" in mon.health()["pressure"]["components"]
+
+
+class TestPipelineIntegration:
+    def test_stop_parks_the_sidecar(self):
+        """BlockPipelineBase.stop() must stop the sidecar it created."""
+        import tempfile
+
+        from assets.generate import gen_gbm
+        from flink_jpmml_tpu.compile import compile_pmml
+        from flink_jpmml_tpu.pmml import parse_pmml_file
+        from flink_jpmml_tpu.runtime.block import BlockPipeline
+
+        with tempfile.TemporaryDirectory() as tmp:
+            doc = parse_pmml_file(
+                gen_gbm(tmp, n_trees=5, depth=2, n_features=3)
+            )
+        cm = compile_pmml(doc, batch_size=32)
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(2000, 3)).astype(np.float32)
+        broker = MiniKafkaBroker(topic="pi")
+        src = None
+        try:
+            broker.append_rows(data)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "pi",
+                n_cols=3, max_wait_ms=20,
+            )
+            seen = []
+            pipe = BlockPipeline(
+                src, cm, lambda out, n, off: seen.append(n),
+                use_native=False,
+            )
+            assert isinstance(pipe._source, PrefetchedBlockSource)
+            pipe.start()
+            deadline = time.monotonic() + 30.0
+            while sum(seen) < 2000 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sum(seen) == 2000
+            pipe.stop()
+            pipe.join(timeout=15.0)
+            t = pipe._source._thread
+            assert t is None or not t.is_alive()
+        finally:
+            if src is not None:
+                src.close()
+            broker.close()

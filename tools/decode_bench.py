@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Standalone Kafka record-batch decode microbench.
+
+Races the three decode tiers of ``runtime/kafka.py``'s
+``decode_record_batches_rows`` — the per-record Python walk (the
+parity oracle), the vectorized numpy decoder (offset tables + bulk
+gather + word-parallel CRC32C), and the native C++ decoder — over one
+synthetic fixed-width tabular record set, parity-checking byte
+equality before timing. Prints the same JSON row the bench artifact
+embeds as ``kafka_mode.decode_bench``, so a regression in any tier is
+visible both standalone and in every captured bench line.
+
+    python tools/decode_bench.py [--records N] [--n-cols C]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+# runnable from anywhere, package install not required (cf. perf_smoke)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--records", type=int, default=40_000,
+                    help="record count for the vectorized/native tiers")
+    ap.add_argument("--n-cols", type=int, default=28,
+                    help="f32 features per record (wire value = 4×this)")
+    ap.add_argument("--py-records", type=int, default=4_000,
+                    help="record count for the (slow) python-walk tier")
+    args = ap.parse_args(argv)
+
+    from flink_jpmml_tpu.bench import run_decode_bench
+
+    line = run_decode_bench(
+        records=args.records, n_cols=args.n_cols,
+        py_records=args.py_records,
+    )
+    print(json.dumps(line))
+    return 0 if line["parity"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
