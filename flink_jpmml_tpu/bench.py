@@ -2101,6 +2101,364 @@ def run_overload_drill(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_DEVFAULT_WORKER = r'''
+import os, sys, time
+# per-incarnation fault seed BEFORE the package imports (env faults arm
+# at import); each incarnation re-arms its own device-fault counts, so
+# a restart mid-outage resumes INTO an outage — the hard case
+os.environ["FJT_FAULTS"] = os.environ.get("FJT_FAULTS", "").replace(
+    "PIDSEED", str(os.getpid())
+)
+sys.path.insert(0, sys.argv[8])
+import jax
+jax.config.update("jax_platforms", "cpu")  # correctness drill: host-side
+import numpy as np
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.runtime.block import BlockPipeline
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+from flink_jpmml_tpu.runtime.kafka import KafkaBlockSource
+from flink_jpmml_tpu.runtime.supervisor import reporter_from_env
+from flink_jpmml_tpu.serving.overload import AdaptiveBatcher
+from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+host, port, topic, pmml, ckdir, outfile, total = sys.argv[1:8]
+total = int(total)
+m = MetricsRegistry()
+rep = reporter_from_env(metrics=m)
+dlq = DeadLetterQueue(os.path.join(ckdir, "dlq"), metrics=m)
+src = KafkaBlockSource(
+    host, int(port), topic, n_cols=6, max_wait_ms=20, metrics=m, dlq=dlq,
+)
+cm = compile_pmml(parse_pmml_file(pmml), batch_size=64)
+batcher = AdaptiveBatcher(metrics=m, model="drill", backend="cpu")
+out = open(outfile, "a", buffering=1)
+wm = m.gauge("watermark_ts")
+
+def sink(o, n, first_off):
+    out.write("E %d %d %d %.3f\n" % (os.getpid(), first_off, n, wm.get()))
+
+pipe = BlockPipeline(
+    src, cm, sink,
+    RuntimeConfig(
+        batch=BatchConfig(size=64, deadline_us=2000, queue_capacity=4096),
+        checkpoint_interval_s=0.05,
+    ),
+    metrics=m,
+    checkpoint=CheckpointManager(ckdir),
+    dlq=dlq,
+    batcher=batcher,
+    max_dispatch_chunks=4,
+)
+pipe.restore()
+out.write("R %d %d\n" % (os.getpid(), pipe.committed_offset))
+pipe.start()
+
+def telemetry():
+    snap = m.struct_snapshot()
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+    fstate = max(
+        [float(v.get("value", 0.0)) for k, v in g.items()
+         if k.startswith("failover_state")] or [0.0]
+    )
+    out.write("F %d %.0f %.0f %.0f %.1f\n" % (
+        os.getpid(),
+        c.get("fallback_records", 0), c.get("redispatch_records", 0),
+        c.get("oom_shrinks", 0), fstate,
+    ))
+
+last_t = 0.0
+while pipe.committed_offset < total and pipe._error is None:
+    time.sleep(0.02)
+    if time.monotonic() - last_t >= 0.1:
+        last_t = time.monotonic()
+        telemetry()
+pipe.stop()
+pipe.join(timeout=30.0)
+telemetry()
+p99 = m.histogram("batch_latency_s").quantile(0.99)
+out.write("P %d %.3f\n" % (os.getpid(), -1.0 if p99 is None else p99 * 1e3))
+out.write("D %d %d\n" % (os.getpid(), pipe.committed_offset))
+src.close()
+out.close()
+'''
+
+
+def run_device_fault_drill(
+    records: int = 24_000,
+    seed: int = 11,
+    timeout_s: float = 240.0,
+    max_restarts: int = 20,
+    kill_during_fallback: bool = True,
+    device_error_fires: int = 14,
+    oom_fires: int = 3,
+    throttle_ms: float = 1.0,
+) -> dict:
+    """``--device-fault-drill``: the device-fault resilience acceptance
+    drill (ISSUE 15 / ROADMAP item 1's fault half). A supervised worker
+    scores a real Kafka stream (production BlockPipeline, checkpoints +
+    DLQ + failover plane) while injected DEVICE faults land at the real
+    launch/readback sites:
+
+    - ``device_oom`` (n=``oom_fires``) forces the batch-size bisection
+      and the AdaptiveBatcher cap feedback;
+    - ``device_error`` (n=``device_error_fires``, persistent past the
+      retry budget) trips the circuit breaker onto the host fallback
+      tier, then heals — the breaker must re-close via green probes
+      with NO operator action;
+    - with ``kill_during_fallback`` the parent SIGKILLs the worker the
+      moment it observes the circuit OPEN (fallback serving) — the
+      kill-during-fallback member of the recovery-drill family; the
+      restarted incarnation re-enters an outage (fault counts re-arm
+      per process) and must converge again.
+
+    Verified end to end: zero record loss; duplication bounded by the
+    replay windows the restarts admit; the DLQ stays EMPTY (a sick
+    device never quarantines clean records); non-zero fallback-tier
+    records during the outage; ≥1 OOM shrink with a standing adaptive
+    cap; non-zero redispatched records; the final incarnation ends
+    with every circuit CLOSED (``failover_state`` 0); watermarks
+    monotone within each incarnation; p99 bounded."""
+    import signal
+
+    import numpy as np
+
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+    from flink_jpmml_tpu.runtime.kafka import MiniKafkaBroker
+    from flink_jpmml_tpu.runtime.supervisor import (
+        RestartPolicy, Supervisor, WorkerSpec,
+    )
+
+    t0 = time.monotonic()
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix="fjt-devfault-")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    broker = None
+    sup = None
+    ok = False
+    try:
+        pmml = gen_gbm(tmp, n_trees=6, depth=3, n_features=6)
+        broker = MiniKafkaBroker(topic="devfault")
+        data = rng.normal(0, 1.2, size=(records, 6)).astype(np.float32)
+        ts0 = int(time.time() * 1000) - records
+        # pre-produce one ring's worth; the REST is paced from the
+        # supervision loop below — on a CPU host the fallback tier runs
+        # at device speed, and an eagerly-produced stream would drain
+        # entirely inside one open-circuit window, leaving no traffic
+        # for the half-open probes that must re-close the breaker
+        produced = min(4096, records)
+        broker.append_rows(data[:produced], timestamp_ms=ts0 + produced)
+
+        fault_spec = [
+            # persistent-past-retries device errors → circuit breaker
+            f"device_error:site=device_readback:n={device_error_fires}",
+            # an OOM streak deep enough that the bisection must split
+            f"device_oom:site=device_dispatch:n={oom_fires}",
+        ]
+        if throttle_ms > 0:
+            fault_spec.append(f"dispatch_delay:delay_ms={throttle_ms}")
+        ckdir = os.path.join(tmp, "ck")
+        outfile = os.path.join(tmp, "emissions.log")
+        open(outfile, "w").close()
+        worker_env = {
+            "FJT_FAULTS": ",".join(fault_spec),
+            "FJT_RESTART_BASE_S": "0.02",
+            "FJT_RESTART_CAP_S": "0.2",
+            "FJT_RETRY_BASE_S": "0.01",
+            # fast breaker geometry so the open→half-open→closed
+            # lifecycle completes several times inside one drill
+            "FJT_FAILOVER_COOLDOWN_S": "0.3",
+            "FJT_FAILOVER_GREENS": "2",
+            "FJT_XLA_CACHE": os.path.join(tmp, "xla"),
+            "FJT_AUTOTUNE_CACHE": os.path.join(tmp, "autotune"),
+            "JAX_PLATFORMS": "cpu",
+        }
+        argv = [
+            sys.executable, "-c", _DEVFAULT_WORKER,
+            broker.host, str(broker.port), "devfault", pmml,
+            ckdir, outfile, str(records), repo,
+        ]
+        give_ups = []
+        sup = Supervisor(
+            [WorkerSpec("scorer", argv, env=worker_env)],
+            policy=RestartPolicy(
+                max_restarts=max_restarts, backoff_s=0.02,
+                max_backoff_s=0.2,
+            ),
+            heartbeat_timeout_s=None,
+            on_give_up=give_ups.append,
+        )
+
+        def tail_f_lines():
+            rows = []
+            try:
+                for ln in open(outfile, "r", encoding="utf-8"):
+                    p = ln.split()
+                    if p and p[0] == "F":
+                        rows.append((
+                            int(p[1]), float(p[2]), float(p[3]),
+                            float(p[4]), float(p[5]),
+                        ))
+            except OSError:
+                pass
+            return rows
+
+        sup.start()
+        deadline = time.monotonic() + timeout_s
+        kills_done = 0
+        pace_chunk = max(records // 100, 64)
+        while time.monotonic() < deadline:
+            st = sup.status()["scorer"]
+            if st["finished"] or st["gave_up"]:
+                break
+            if produced < records:
+                hi = min(produced + pace_chunk, records)
+                broker.append_rows(
+                    data[produced:hi], timestamp_ms=ts0 + hi
+                )
+                produced = hi
+            if kill_during_fallback and kills_done == 0:
+                rows = tail_f_lines()
+                if rows and rows[-1][4] >= 2.0:
+                    # the circuit is OPEN — the worker is serving on
+                    # the fallback tier RIGHT NOW: kill it there
+                    pid = st["pid"]
+                    if pid is not None and st["alive"]:
+                        try:
+                            os.kill(pid, signal.SIGKILL)
+                            kills_done += 1
+                        except OSError:
+                            pass
+            time.sleep(0.05)
+        st = sup.status()["scorer"]
+        restarts = int(st["restarts"])
+        assert not give_ups and not st["gave_up"], (
+            f"give-up fired after {restarts} restarts (status {st})"
+        )
+        assert st["finished"], (
+            f"drill did not drain within {timeout_s}s (status {st})"
+        )
+        sup.stop()
+        sup = None
+
+        # ---- verification --------------------------------------------
+        emitted = []
+        f_rows = []
+        p99_by_pid = {}
+        for ln in open(outfile, "r", encoding="utf-8"):
+            p = ln.split()
+            if not p:
+                continue
+            if p[0] == "E":
+                emitted.append((
+                    int(p[1]), int(p[2]), int(p[3]), float(p[4]),
+                ))
+            elif p[0] == "F":
+                f_rows.append((
+                    int(p[1]), float(p[2]), float(p[3]), float(p[4]),
+                    float(p[5]),
+                ))
+            elif p[0] == "P":
+                p99_by_pid[int(p[1])] = float(p[2])
+        covered = np.zeros(records, np.int64)
+        for _, off, n, _wm in emitted:
+            covered[off: off + n] += 1
+        lost = np.flatnonzero(covered == 0)
+        assert lost.size == 0, (
+            f"record loss at offsets {lost[:10].tolist()}"
+        )
+        replay_window = 4096 + 4 * 64 * 2
+        excess = int(np.clip(covered - 1, 0, None).sum())
+        n_incarnations = restarts + 1
+        assert excess <= n_incarnations * replay_window, (
+            f"duplicate excess {excess} exceeds "
+            f"{n_incarnations} x {replay_window}"
+        )
+        # a sick device must never quarantine clean records
+        dlq_offsets = sorted(
+            set(DeadLetterQueue(os.path.join(ckdir, "dlq")).offsets())
+        )
+        assert dlq_offsets == [], (
+            f"device faults quarantined clean records: {dlq_offsets}"
+        )
+        # per-incarnation counter maxima (counters reset per process)
+        by_pid: dict = {}
+        for pid, fb, rd, oo, stv in f_rows:
+            prev = by_pid.get(pid, (0.0, 0.0, 0.0, 0.0))
+            by_pid[pid] = (
+                max(prev[0], fb), max(prev[1], rd), max(prev[2], oo),
+                stv,  # last state seen for this pid
+            )
+        fallback_total = sum(v[0] for v in by_pid.values())
+        redispatch_total = sum(v[1] for v in by_pid.values())
+        oom_total = sum(v[2] for v in by_pid.values())
+        assert fallback_total > 0, (
+            "no fallback-tier records served during the outage"
+        )
+        assert oom_total >= 1, "no OOM batch shrink recorded"
+        assert redispatch_total > 0, "no redispatched records"
+        assert f_rows, "no failover telemetry lines"
+        final_state = f_rows[-1][4]
+        assert final_state == 0.0, (
+            f"circuit did not re-close (final failover_state "
+            f"{final_state})"
+        )
+        saw_open = any(r[4] >= 2.0 for r in f_rows)
+        assert saw_open, "circuit never opened — the outage was a no-op"
+        if kill_during_fallback:
+            assert kills_done == 1, (
+                f"kill-during-fallback never landed (kills {kills_done})"
+            )
+        # watermarks monotone within each incarnation
+        wm_by_pid: dict = {}
+        for pid, _off, _n, wm in emitted:
+            if wm <= 0:
+                continue
+            prev = wm_by_pid.get(pid)
+            assert prev is None or wm >= prev - 1e-9, (
+                f"watermark regressed within pid {pid}: {prev} -> {wm}"
+            )
+            wm_by_pid[pid] = wm
+        # p99 bounded: degraded batches (ladder backoffs + host-tier
+        # scoring) are booked honestly, and must still stay bounded
+        final_p99 = max(p99_by_pid.values()) if p99_by_pid else None
+        assert final_p99 is not None and 0 < final_p99 <= 5_000.0, (
+            f"p99 unbounded or unmeasured: {final_p99} ms"
+        )
+
+        ok = True
+        return {
+            "metric": "device_fault_drill",
+            "ok": True,
+            "records": int(records),
+            "restarts": restarts,
+            "kill_during_fallback": bool(kills_done),
+            "fallback_records": fallback_total,
+            "redispatch_records": redispatch_total,
+            "oom_shrinks": oom_total,
+            "circuit_reclosed": final_state == 0.0,
+            "duplicate_excess": excess,
+            "max_dup": int(covered.max()),
+            "dlq_empty": True,
+            "p99_ms": final_p99,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+    finally:
+        if sup is not None:
+            sup.stop()
+        if broker is not None:
+            broker.close()
+        if ok:
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            print(f"[device-fault-drill] artifacts kept at {tmp}",
+                  file=sys.stderr)
+
+
 _RECOVERY_WORKER = r'''
 import os, sys, time
 # per-incarnation fault seed BEFORE the package imports (env faults arm
@@ -2736,6 +3094,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--no-hard-poison", action="store_true",
                     help="skip the crash-loop (process-killing) poison "
                          "record — the drill's slowest phase")
+    ap.add_argument("--device-fault-drill", action="store_true",
+                    help="run the device-fault resilience drill "
+                         "instead of the perf capture: injected "
+                         "device_oom / device_error faults at the real "
+                         "launch/readback sites against a supervised "
+                         "Kafka pipeline, a SIGKILL while the circuit "
+                         "is open; asserts zero loss, an EMPTY DLQ, "
+                         "non-zero fallback-tier records, OOM batch "
+                         "shrink, circuit re-close, monotone "
+                         "watermarks, bounded p99")
+    ap.add_argument("--device-fault-records", type=int, default=24_000,
+                    help="records the device-fault drill streams")
+    ap.add_argument("--no-fallback-kill", action="store_true",
+                    help="skip the SIGKILL-during-fallback phase of "
+                         "the device-fault drill")
     return ap
 
 
@@ -2797,6 +3170,25 @@ def main() -> None:
         except AssertionError as e:
             print(json.dumps({
                 "metric": "recovery_drill", "ok": False, "error": str(e),
+            }))
+            sys.exit(1)
+        print(json.dumps(line))
+        return
+
+    if args.device_fault_drill:
+        # device-fault resilience drill, not a perf capture: the
+        # worker is a forced-CPU subprocess (restart + failover storms
+        # against an exclusive-access tunneled chip would drill the
+        # tunnel, not the runtime)
+        try:
+            line = run_device_fault_drill(
+                records=args.device_fault_records,
+                kill_during_fallback=not args.no_fallback_kill,
+            )
+        except AssertionError as e:
+            print(json.dumps({
+                "metric": "device_fault_drill", "ok": False,
+                "error": str(e),
             }))
             sys.exit(1)
         print(json.dumps(line))

@@ -670,6 +670,66 @@ def _top_render_overload(label: str, struct: dict, out) -> None:
         print("(no overload telemetry recorded)", file=out)
 
 
+def _top_render_failover(label: str, struct: dict, out,
+                         source: str = None) -> None:
+    """The ``--failover`` panel: the device-fault resilience plane
+    (runtime/devfault.py + serving/failover.py) as one operator view —
+    circuit state per served model, the fallback tier's share of
+    delivered records, redispatch/OOM-shrink counts, the device-fault
+    taxonomy totals, and the checkpoint-suspension flag. The last
+    device error itself rides the rate-limited ``device_fault`` flight
+    event with the journey's trace id — the printed ``fjt-trace``
+    invocation is the pivot."""
+    from flink_jpmml_tpu.serving import failover as failover_mod
+
+    title = label or "aggregate"
+    print(f"== {title} · failover ==", file=out)
+    s = failover_mod.summary(struct) or {}
+    rendered = False
+    states = s.get("states") or {}
+    if states:
+        rendered = True
+        print(f"{'model':<24}{'circuit':>10}", file=out)
+        for model in sorted(states):
+            print(f"{model:<24}{states[model]:>10}", file=out)
+    share = s.get("fallback_share")
+    fb = s.get("fallback_records")
+    if fb:
+        rendered = True
+        line = f"fallback   {fb:,.0f} records"
+        if share is not None:
+            line += f" ({100.0 * share:.2f}% of delivered)"
+        print(line, file=out)
+    rd = s.get("redispatch_records")
+    if rd:
+        rendered = True
+        print(f"redispatch {rd:,.0f} records", file=out)
+    oo = s.get("oom_shrinks")
+    if oo:
+        rendered = True
+        print(f"oom-shrink {oo:,.0f} batch-size bisections", file=out)
+    faults_by_kind = s.get("device_faults") or {}
+    if faults_by_kind:
+        rendered = True
+        print(f"{'fault kind':<24}{'observed':>10}", file=out)
+        for kind in sorted(faults_by_kind):
+            print(f"{kind:<24}{faults_by_kind[kind]:>10,.0f}", file=out)
+    if s.get("checkpoint_suspended"):
+        rendered = True
+        print("checkpoint plane SUSPENDED (disk full — replay window "
+              "widening)", file=out)
+    if s.get("mesh_lost_devices"):
+        rendered = True
+        print(f"mesh: {s['mesh_lost_devices']:.0f} chip(s) lost "
+              "(degraded-mesh mode)", file=out)
+    if not rendered:
+        print("(no failover telemetry recorded)", file=out)
+    elif source:
+        # the trace pivot: device_fault flight events carry trace ids
+        print(f"pivot: fjt-trace {source} --id <trace_id>   "
+              "(ids ride device_fault flight events)", file=out)
+
+
 def top_main(argv: Optional[List[str]] = None) -> int:
     """``fjt-top``: the fleet attribution table (see module docstring).
     Renders every labelled source (the supervisor's /varz serves the
@@ -700,6 +760,12 @@ def top_main(argv: Optional[List[str]] = None) -> int:
                          "live-vs-baseline PSI ranked worst-first, "
                          "missing/out-of-domain rates, prediction "
                          "drift, alarms) instead of the stage table")
+    ap.add_argument("--failover", action="store_true",
+                    help="render the device-fault/failover panel "
+                         "(circuit state per model, fallback-tier "
+                         "share, redispatch/OOM-shrink counts, device "
+                         "fault taxonomy, checkpoint suspension) "
+                         "instead of the stage table")
     ap.add_argument("--watch", type=float, default=None, metavar="N",
                     help="re-render every N seconds from a live source "
                          "(operator console mode; mid-watch fetch "
@@ -707,14 +773,21 @@ def top_main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     if args.watch is not None and args.watch <= 0:
         raise SystemExit(f"--watch must be > 0, got {args.watch}")
-    if sum((args.freshness, args.overload, args.drift)) > 1:
+    if sum((args.freshness, args.overload, args.drift,
+            args.failover)) > 1:
         raise SystemExit(
-            "--freshness, --overload, and --drift are exclusive"
+            "--freshness, --overload, --drift, and --failover are "
+            "exclusive"
         )
     render = (
         _top_render_freshness if args.freshness
         else _top_render_overload if args.overload
         else _top_render_drift if args.drift
+        else (
+            lambda label, struct, out: _top_render_failover(
+                label, struct, out, source=args.source
+            )
+        ) if args.failover
         else (
             lambda label, struct, out: _top_render(
                 label, struct, out, source=args.source

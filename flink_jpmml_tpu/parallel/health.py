@@ -307,6 +307,101 @@ class HealthCoordinator:
                 pass
 
 
+class DeviceHealth:
+    """Chip-liveness registry: the worker-health discipline applied to
+    DEVICES (ROADMAP item 1's degraded-mesh requirement). A chip is
+    treated exactly like a worker is today — registered, declared lost
+    on an unrecoverable device fault (``runtime/devfault.py``'s
+    ``chip_loss``), recovered when it comes back — and the callbacks
+    are where shard re-balancing hangs:
+    ``on_lost(device) → ShardedModel.without_devices([device])``
+    (parallel/sharding.py) rebuilds the mesh over the survivors, and
+    because per-chip metrics/sketches fleet-merge EXACTLY (the DrJAX
+    map/reduce discipline — utils/metrics.merge_structs), a mesh minus
+    one chip is just a smaller fleet: no telemetry rebaselining, no
+    state migration.
+
+    Transitions fire callbacks once (idempotent mark calls), under no
+    lock (the coordinator discipline: a crash-prone callback must not
+    poison liveness tracking). ``mesh_lost_devices`` (fleet merge:
+    worst-of) exports the count."""
+
+    def __init__(self, metrics=None, on_lost=None, on_recover=None):
+        self._on_lost = on_lost
+        self._on_recover = on_recover
+        self._mu = threading.Lock()
+        self._known: Dict[object, object] = {}  # id -> device
+        self._lost: Dict[object, object] = {}
+        self._gauge = (
+            metrics.gauge("mesh_lost_devices")
+            if metrics is not None else None
+        )
+
+    @staticmethod
+    def _key(device):
+        return getattr(device, "id", device)
+
+    def watch(self, devices) -> "DeviceHealth":
+        with self._mu:
+            for d in devices:
+                self._known.setdefault(self._key(d), d)
+        return self
+
+    def alive(self) -> List[object]:
+        with self._mu:
+            return [
+                d for k, d in self._known.items() if k not in self._lost
+            ]
+
+    def lost(self) -> List[object]:
+        with self._mu:
+            return list(self._lost.values())
+
+    def survivors(self, devices) -> List[object]:
+        with self._mu:
+            return [d for d in devices if self._key(d) not in self._lost]
+
+    def mark_lost(self, device, error=None) -> bool:
+        """Declare one chip lost; → True on the transition (False when
+        already lost). The callback + flight event fire once."""
+        k = self._key(device)
+        with self._mu:
+            self._known.setdefault(k, device)
+            if k in self._lost:
+                return False
+            self._lost[k] = device
+            n_lost = len(self._lost)
+        if self._gauge is not None:
+            self._gauge.set(float(n_lost))
+        flight.record(
+            "chip_lost", device=str(k), lost=n_lost,
+            error=None if error is None else repr(error),
+        )
+        if self._on_lost is not None:
+            try:
+                self._on_lost(device)
+            except Exception:
+                pass  # a broken hook must not disable chip tracking
+        return True
+
+    def mark_recovered(self, device) -> bool:
+        k = self._key(device)
+        with self._mu:
+            if k not in self._lost:
+                return False
+            del self._lost[k]
+            n_lost = len(self._lost)
+        if self._gauge is not None:
+            self._gauge.set(float(n_lost))
+        flight.record("chip_recovered", device=str(k), lost=n_lost)
+        if self._on_recover is not None:
+            try:
+                self._on_recover(device)
+            except Exception:
+                pass
+        return True
+
+
 class HealthReporter:
     """Worker-side heartbeat: beats every ``interval_s``, reconnecting
     with backoff through coordinator outages/restarts."""

@@ -27,8 +27,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_jpmml_tpu.compile.common import HIGHEST, ModelOutput
 from flink_jpmml_tpu.compile.compiler import CompiledModel
+from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
-from flink_jpmml_tpu.utils.exceptions import InputValidationException
+from flink_jpmml_tpu.utils.exceptions import (
+    FlinkJpmmlTpuError,
+    InputValidationException,
+)
 
 # ``shard_map`` moved to the top-level jax namespace only after 0.4.x;
 # on the image's jax it still lives in jax.experimental. Resolve once —
@@ -154,6 +158,54 @@ class ShardedModel:
         from flink_jpmml_tpu.compile.verify import run_verification
 
         return run_verification(self, self.base._target_field)
+
+    def without_devices(self, lost) -> "ShardedModel":
+        """Degraded-mesh mode (ROADMAP item 1): rebuild this model
+        over the mesh MINUS ``lost`` — the recovery move for an
+        unrecoverable ``chip_loss`` (runtime/devfault.py). The DrJAX
+        map/reduce framing is what makes this a small operation:
+        per-chip state already fleet-merges exactly, so a mesh minus
+        one chip is just a smaller fleet — params re-place onto the
+        survivors from the host copy, the batch divisor shrinks, and
+        the scoring contract is unchanged. TP sharding is preserved
+        when the survivor count still honours the model axis
+        (:func:`degraded_mesh`)."""
+        new_mesh = degraded_mesh(self.mesh, lost)
+        if self.tp_sharded_leaves:
+            rebuilt = mesh_sharded(self.base, new_mesh)
+        else:
+            rebuilt = dp_sharded(self.base, new_mesh)
+        flight.record(
+            "mesh_degraded",
+            lost=[str(getattr(d, "id", d)) for d in lost],
+            data=new_mesh.shape[DATA_AXIS],
+            model=new_mesh.shape[MODEL_AXIS],
+        )
+        return rebuilt
+
+
+def degraded_mesh(mesh: Mesh, lost) -> Mesh:
+    """→ the ``data × model`` mesh over ``mesh``'s devices minus
+    ``lost`` (devices or device ids). The MODEL axis width is
+    preserved — TP shards partition param tensors, so shrinking that
+    axis would change the program; the DATA axis absorbs the loss
+    (shards re-balance onto survivors). Survivors that no longer fill
+    a whole data row are trimmed (idle beats wrong). Raises when no
+    full data row survives."""
+    lost_ids = {getattr(d, "id", d) for d in lost}
+    survivors = [
+        d for d in mesh.devices.flat
+        if getattr(d, "id", d) not in lost_ids
+    ]
+    n_model = mesh.shape[MODEL_AXIS]
+    data = len(survivors) // n_model
+    if data < 1:
+        raise FlinkJpmmlTpuError(
+            f"degraded mesh unsurvivable: {len(survivors)} device(s) "
+            f"left cannot fill one {n_model}-wide model-axis row"
+        )
+    grid = np.asarray(survivors[: data * n_model]).reshape(data, n_model)
+    return Mesh(grid, axis_names=(DATA_AXIS, MODEL_AXIS))
 
 
 def dp_sharded(model: CompiledModel, mesh: Mesh) -> ShardedModel:

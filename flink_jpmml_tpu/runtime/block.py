@@ -35,6 +35,7 @@ from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import spans
 from flink_jpmml_tpu.obs import trace as trace_mod
+from flink_jpmml_tpu.runtime import devfault
 from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime import prefetch as prefetch_mod
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
@@ -309,6 +310,7 @@ class BlockPipelineBase:
         shed_lane: str = "block",
         dlq=None,
         prefetch: Optional[bool] = None,
+        failover=None,
     ):
         self._source = source
         self._sink = sink
@@ -384,7 +386,8 @@ class BlockPipelineBase:
         self._error: Optional[BaseException] = None
         self.committed_offset = 0
         self._ckpt = CheckpointPolicy(
-            checkpoint, self._config.checkpoint_interval_s
+            checkpoint, self._config.checkpoint_interval_s,
+            metrics=self.metrics,
         )
         # -- delivery-correctness plane (runtime/dlq.py) ------------------
         # The DLQ defaults to living BESIDE the checkpoints: record-level
@@ -399,6 +402,31 @@ class BlockPipelineBase:
         self._fingerprint = (
             CrashFingerprint(ckpt_dir)
             if (ckpt_dir is not None and self._dlq is not None) else None
+        )
+        # -- device-fault resilience (runtime/devfault.py +
+        #    serving/failover.py) ------------------------------------------
+        # The recovery ladder (redispatch → OOM batch bisection →
+        # circuit breaker → fallback tier) arms by default wherever the
+        # staging batches are ALREADY retained past the async dispatch
+        # (a DLQ is wired — the production shape), or explicitly via
+        # failover=<plane> / FJT_FAILOVER=1. A bare bench loop with no
+        # durable state pays neither the retention copy nor the plane.
+        # failover=False disables outright (historical fail-fast).
+        if failover is False:
+            self._failover = None
+        elif failover is not None:
+            self._failover = failover
+        elif self._dlq is not None or os.environ.get("FJT_FAILOVER"):
+            from flink_jpmml_tpu.serving import failover as failover_mod
+
+            self._failover = failover_mod.plane_for(self.metrics)
+        else:
+            self._failover = None
+        # retain the drained batch (private copy) past the async
+        # dispatch: poison isolation AND device-fault recovery both
+        # re-dispatch from this host-retained staging copy
+        self._retain_batches = (
+            self._dlq is not None or self._failover is not None
         )
         # highest offset ever handed to a dispatch (+n): checkpointed as
         # inflight_hi so a restart knows the at-least-once replay region
@@ -745,24 +773,267 @@ class BlockPipelineBase:
         return self._dispatch(handle, X, n)
 
     def _on_dispatch_error(self, out, meta, error) -> bool:
-        """OverlappedDispatcher error hook: a fetch-side scoring
-        exception enters suspect mode for that batch instead of killing
-        the worker. → False (re-raise) when no DLQ is wired or the
-        entry carries no retained batch (shed no-ops)."""
-        if self._dlq is None or meta is None or len(meta) < 7:
+        """OverlappedDispatcher error hook, with device-fault triage
+        FIRST (runtime/devfault.py): a sick device runs the recovery
+        ladder (redispatch → OOM bisection → fallback tier) and record
+        poison enters suspect mode — the PR 12 bisection must never
+        quarantine clean records for a device fault. → False (re-raise)
+        when the entry carries no retained batch (shed no-ops) or the
+        matching plane isn't wired."""
+        if meta is None or len(meta) < 7:
             return False
         n, first_off, t_start, shed, handle, X, offsets = meta[:7]
         if shed or X is None or offsets is None:
             return False
-        self._suspect_scan(
-            handle, X, offsets, error=error,
-            ctx=meta[7] if len(meta) > 7 else None,
-        )
+        ctx = meta[7] if len(meta) > 7 else None
+        kind = devfault.classify(error)
+        if kind is not None:
+            if self._failover is None:
+                return False  # historical fail-fast: die, restart
+            self._device_recover(handle, X, offsets, error, kind, ctx=ctx)
+            return True
+        if self._dlq is None:
+            return False
+        self._suspect_scan(handle, X, offsets, error=error, ctx=ctx)
         return True
+
+    # -- device-fault recovery ladder (runtime/devfault.py) ----------------
+
+    def _redispatch_sync(self, handle, X, n, offsets):
+        """One synchronous re-dispatch of a host-retained staging copy
+        through the REAL dispatch path (fault hook sites included, so
+        an injected persistent fault keeps failing here exactly like a
+        real one) → (out, decode), device-synchronized."""
+        faults.fire("device_dispatch")
+        out, decode = self._dispatch_checked(handle, X, n, offsets)
+        faults.fire("device_readback")
+        _block_ready(out)
+        return out, decode
+
+    def _emit_recovered(self, out, decode, offsets, lo, hi,
+                        ctx=None, t0=None) -> None:
+        """Deliver + commit one recovered run (redispatch, OOM
+        sub-batch, or fallback-tier score): sink in offset order,
+        freshness stamps consumed, offsets committed — idempotent with
+        the sink contract because the failed dispatch never reached
+        ``_complete`` (zero loss, no duplication beyond restart
+        replay)."""
+        n_run = hi - lo
+        first = int(offsets[lo])
+        self._emit(out, n_run, first, decode)
+        self.metrics.counter("records_out").inc(n_run)
+        freshness = fresh_mod.freshness_for(self.metrics)
+        if freshness is not None:
+            freshness.observe_sink(first, n_run)
+        jstore = trace_mod.store_for(self.metrics)
+        if jstore is not None:
+            c = ctx if ctx is not None else trace_mod.context_for(first)
+            jstore.hop(
+                "sink", c.child(), first, n_run, durable=True,
+                recovered=True,
+            )
+        if t0 is not None:
+            # fallback/recovered batches are real deliveries: their
+            # latency belongs in the histogram the SLO plane watches —
+            # a degraded tier must not flatter p99
+            self.metrics.histogram("batch_latency_s").observe(
+                time.monotonic() - t0
+            )
+        self.committed_offset = int(offsets[hi - 1]) + 1
+        self._ckpt.maybe_save(self._ckpt_state)
+
+    def _device_recover(self, handle, X, offsets, error, kind,
+                        ctx=None) -> None:
+        """The recovery ladder for one device-classified dispatch
+        failure: (1) transient errors re-dispatch the retained batch
+        under the shared full-jitter backoff; (2) OOM bisects the
+        BATCH SIZE (never the records) and feeds the proven cap back
+        into the AdaptiveBatcher; (3) exhausted retries fall through
+        to the fallback tier (the circuit breaker keeps later batches
+        off the device entirely); (4) chip loss escalates to the
+        supervisor (restart with FJT_RESTART_STREAK context)."""
+        from flink_jpmml_tpu.utils.retry import Backoff
+
+        plane = self._failover
+        n = int(X.shape[0])
+        first = int(offsets[0])
+        key = getattr(handle, "key", None) or "default"
+        plane.note_fault(kind, key, first_off=first, n=n, error=error)
+        if kind == devfault.KIND_LOST:
+            flight.record(
+                "device_lost_escalate", model=key, first=first, n=n,
+                error=repr(error),
+            )
+            raise error
+        breaker = plane.breaker_for(key)
+        breaker.record_failure(kind)
+        if kind == devfault.KIND_OOM:
+            self._oom_recover(handle, X, offsets, error, ctx=ctx)
+            return
+        bo = Backoff(
+            "device", base_s=0.02, cap_s=0.5,
+            max_attempts=plane.retries,
+        )
+        while not bo.exhausted:
+            bo.sleep()
+            try:
+                out, decode = self._redispatch_sync(
+                    handle, X, n, offsets
+                )
+            except Exception as e2:
+                k2 = devfault.classify(e2)
+                if k2 is None:
+                    # the device fault cleared and a RECORD error
+                    # surfaced underneath: that is poison's jurisdiction
+                    if self._dlq is not None:
+                        self._suspect_scan(
+                            handle, X, offsets, error=e2, ctx=ctx
+                        )
+                        return
+                    raise
+                plane.note_fault(k2, key, first_off=first, n=n, error=e2)
+                if k2 == devfault.KIND_LOST:
+                    flight.record(
+                        "device_lost_escalate", model=key, first=first,
+                        n=n, error=repr(e2),
+                    )
+                    raise e2
+                breaker.record_failure(k2)
+                if k2 == devfault.KIND_OOM:
+                    self._oom_recover(handle, X, offsets, e2, ctx=ctx)
+                    return
+                error = e2
+                continue
+            breaker.record_success()
+            plane.redispatch_records.inc(n)
+            flight.record(
+                "device_redispatch", model=key, first=first, n=n,
+                attempts=bo.attempts,
+            )
+            self._emit_recovered(out, decode, offsets, 0, n, ctx=ctx)
+            return
+        # retries exhausted: degraded-mode serving beats a crash loop
+        if plane.tier.supports(handle):
+            self._serve_fallback(handle, X, offsets, jctx=ctx)
+            return
+        raise error
+
+    def _oom_recover(self, handle, X, offsets, error, ctx=None) -> None:
+        """Device-OOM ladder step: bisect the BATCH SIZE until runs
+        fit, deliver each run in offset order, and feed the largest
+        proven size into the AdaptiveBatcher as the standing dispatch
+        cap. Records are never quarantined — an allocator refusal says
+        nothing about the data."""
+        plane = self._failover
+        n = int(X.shape[0])
+        key = getattr(handle, "key", None) or "default"
+        state = {"max_ok": 0}
+
+        def attempt(lo: int, hi: int) -> None:
+            size = hi - lo
+            try:
+                out, decode = self._redispatch_sync(
+                    handle, X[lo:hi], size, offsets[lo:hi]
+                )
+            except Exception as e2:
+                k2 = devfault.classify(e2)
+                if k2 is None:
+                    if self._dlq is not None:
+                        self._suspect_scan(
+                            handle, X[lo:hi], offsets[lo:hi],
+                            error=e2, ctx=ctx,
+                        )
+                        return
+                    raise
+                plane.note_fault(
+                    k2, key, first_off=int(offsets[lo]), n=size,
+                    error=e2,
+                )
+                if k2 == devfault.KIND_LOST:
+                    flight.record(
+                        "device_lost_escalate", model=key,
+                        first=int(offsets[lo]), n=size, error=repr(e2),
+                    )
+                    raise e2
+                plane.breaker_for(key).record_failure(k2)
+                if size == 1:
+                    # one record alone exceeds the device: the host
+                    # tier serves it (or the worker escalates) — a
+                    # sick device never quarantines a clean record
+                    if plane.tier.supports(handle):
+                        self._serve_fallback(
+                            handle, X[lo:hi], offsets[lo:hi], jctx=ctx
+                        )
+                        return
+                    raise e2
+                mid = (lo + hi) // 2
+                attempt(lo, mid)
+                attempt(mid, hi)
+                return
+            state["max_ok"] = max(state["max_ok"], size)
+            plane.redispatch_records.inc(size)
+            self._emit_recovered(
+                out, decode, offsets, lo, hi, ctx=ctx
+            )
+
+        attempt(0, n)
+        plane.oom_shrinks.inc()
+        cap = state["max_ok"] or None
+        if cap and self._batcher is not None:
+            cap = self._batcher.note_oom_cap(cap)
+        flight.record(
+            "oom_batch_shrink", model=key, from_records=n,
+            to_records=cap,
+        )
+        plane.record_success(key)
+
+    def _fallback_dispatch(self, handle, X, n):
+        """Host-tier scoring hook → (out, decode) in the subclass's
+        sink shape (the static path's sink takes no decode)."""
+        return self._failover.tier.score_bound(handle, X), None
+
+    def _fallback_checked(self, handle, X, n, offsets):
+        """The fallback tier's ``_dispatch_checked`` twin: still a
+        real scoring site, so record-targeted faults (and real record
+        poison) strike it exactly like the device path."""
+        faults.fire("score_batch", offsets=offsets)
+        return self._fallback_dispatch(handle, X, n)
+
+    def _serve_fallback(self, handle, X, offsets, jctx=None) -> None:
+        """Score one batch on the host fallback tier — the pipeline
+        keeps serving degraded instead of crash-looping while the
+        circuit is open (or the ladder exhausted its retries). Record
+        poison that surfaces HERE isolates on the tier that hit it
+        (the suspect scan's sub-dispatches route through the fallback
+        twin) — an open circuit must not exempt poison from the DLQ
+        contract, nor isolation re-dispatch to the sick device."""
+        plane = self._failover
+        n = int(X.shape[0])
+        first = int(offsets[0])
+        key = getattr(handle, "key", None) or "default"
+        freshness = fresh_mod.freshness_for(self.metrics)
+        if freshness is not None:
+            # the fallback tier IS the dispatch stage while degraded
+            freshness.propagate_low_watermark("dispatch", first, n)
+        t0 = time.monotonic()
+        try:
+            out, decode = self._fallback_checked(handle, X, n, offsets)
+        except Exception as e:
+            if devfault.classify(e) is not None or self._dlq is None:
+                raise
+            self._suspect_scan(
+                handle, X, offsets, error=e, ctx=jctx,
+                dispatch=self._fallback_checked,
+            )
+            return
+        plane.note_fallback(n, key)
+        self._emit_recovered(
+            out, decode, offsets, 0, n, ctx=jctx, t0=t0
+        )
 
     def _suspect_scan(
         self, handle, X, offsets, error, persist: bool = False,
-        ctx=None,
+        ctx=None, dispatch=None,
     ) -> None:
         """Bisection ("suspect mode") over one failed batch: dispatch
         halves synchronously until the offending record(s) are single —
@@ -778,7 +1049,16 @@ class BlockPipelineBase:
 
         More than ``FJT_DLQ_MAX_PER_BATCH`` quarantines in one batch
         aborts isolation (:class:`PoisonIsolationOverflow`): that is a
-        model-level failure, not poison."""
+        model-level failure, not poison.
+
+        ``dispatch`` overrides the sub-dispatch primitive (default:
+        the device path's ``_dispatch_checked``) — the fallback tier
+        passes its host-tier twin so poison that surfaces while the
+        circuit is OPEN isolates on the tier that hit it, never by
+        re-dispatching to the sick device."""
+        dispatch = dispatch if dispatch is not None else (
+            self._dispatch_checked
+        )
         n = int(X.shape[0])
         if n == 0:
             return
@@ -898,13 +1178,20 @@ class BlockPipelineBase:
                         attempts=attempts,
                     )
             try:
-                out, decode = self._dispatch_checked(
+                out, decode = dispatch(
                     handle, X[lo:hi], n_sub, offsets[lo:hi]
                 )
                 _block_ready(out)
             except PoisonIsolationOverflow:
                 raise
             except Exception as e:
+                if devfault.classify(e) is not None:
+                    # a SICK DEVICE mid-bisection is not record
+                    # poison: quarantining clean records for it is the
+                    # one thing this scan must never do — escalate
+                    # (already-emitted runs replay on restore, the
+                    # at-least-once contract)
+                    raise
                 if n_sub == 1:
                     quarantine(lo, e)
                     return
@@ -1065,6 +1352,13 @@ class BlockPipelineBase:
                 )
             lat.observe(t_done - t_start)
             records_out.inc(n)
+            if self._failover is not None:
+                # green completion: clears strike streaks / counts a
+                # half-open probe (a dict miss while no breaker exists)
+                self._failover.record_success(
+                    getattr(meta[4], "key", None) if len(meta) > 4
+                    else None
+                )
             if self._batcher is not None:
                 # the capacity model's verify half: every completed
                 # dispatch is a (size, latency) observation
@@ -1202,11 +1496,12 @@ class BlockPipelineBase:
                     # records replay from the committed offset on restore
                     disp.abandon()
                     return
-                if self._dlq is not None:
-                    # isolation needs the RAW batch retained past the
-                    # async dispatch (the drained views alias the ring's
-                    # reuse buffer): one private copy per batch, paid
-                    # only when a DLQ is wired
+                if self._retain_batches:
+                    # isolation AND device-fault recovery need the RAW
+                    # batch retained past the async dispatch (the
+                    # drained views alias the ring's reuse buffer): one
+                    # private copy per batch, paid only when a DLQ or
+                    # the failover plane is wired
                     X = np.array(X, copy=True)
                     offsets = np.array(offsets, copy=True)
                 first_off = int(offsets[0]) if n else 0
@@ -1231,6 +1526,27 @@ class BlockPipelineBase:
                     )
                     if self.committed_offset >= self._suspect_until:
                         self._exit_suspect_mode()
+                    batches.inc()
+                    fill.inc(n)
+                    continue
+                if (
+                    self._failover is not None
+                    and self._failover.should_fallback(
+                        getattr(handle, "key", None), handle
+                    )
+                ):
+                    # circuit OPEN for this model: the window must
+                    # drain first (FIFO commit order), then this batch
+                    # serves synchronously on the host fallback tier —
+                    # degraded, not down
+                    disp.flush()
+                    self._serve_fallback(
+                        handle, X, offsets,
+                        jctx=(
+                            trace_mod.context_for(first_off)
+                            if jstore is not None else None
+                        ),
+                    )
                     batches.inc()
                     fill.inc(n)
                     continue
@@ -1271,8 +1587,8 @@ class BlockPipelineBase:
                             meta=(
                                 n, first_off, t_start, False,
                                 handle,
-                                X if self._dlq is not None else None,
-                                offsets if self._dlq is not None else None,
+                                X if self._retain_batches else None,
+                                offsets if self._retain_batches else None,
                                 jctx,
                             ),
                             # opts this launch into the sampled
@@ -1289,18 +1605,27 @@ class BlockPipelineBase:
                     raise  # isolation already abandoned: die honestly
                 except Exception as e:
                     # the dispatch itself raised (host featurize, an
-                    # injected poison, a device rejection at trace
-                    # time): with a DLQ wired, isolate in place —
-                    # errors from OLDER window entries were already
-                    # handled (or re-raised) inside launch's trim via
-                    # on_error, so this exception belongs to THIS batch
-                    if self._dlq is None:
+                    # injected poison, a device fault at launch time):
+                    # device-fault triage FIRST — errors from OLDER
+                    # window entries were already handled (or
+                    # re-raised) inside launch's trim via on_error, so
+                    # this exception belongs to THIS batch
+                    kind = devfault.classify(e)
+                    if kind is not None and self._failover is not None:
+                        # older in-flight batches must commit BEFORE
+                        # this one's synchronous recovery commits its
+                        # range (FIFO contract)
+                        disp.flush()
+                        self._device_recover(
+                            handle, X, offsets, e, kind, ctx=jctx
+                        )
+                    elif kind is not None or self._dlq is None:
                         raise
-                    # older in-flight batches must commit BEFORE this
-                    # one's synchronous isolation commits its range, or
-                    # committed_offset would regress (FIFO contract)
-                    disp.flush()
-                    self._suspect_scan(handle, X, offsets, error=e, ctx=jctx)
+                    else:
+                        disp.flush()
+                        self._suspect_scan(
+                            handle, X, offsets, error=e, ctx=jctx
+                        )
                 batches.inc()
                 fill.inc(n)
             disp.close()  # drain the window: every dispatched batch sinks
@@ -1343,6 +1668,7 @@ class BlockPipeline(BlockPipelineBase):
         shed_lane: str = "block",
         dlq=None,
         prefetch: Optional[bool] = None,
+        failover=None,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -1367,6 +1693,7 @@ class BlockPipeline(BlockPipelineBase):
             shed_lane=shed_lane,
             dlq=dlq,
             prefetch=prefetch,
+            failover=failover,
         )
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend

@@ -20,6 +20,7 @@ exhausted streak raises.
 
 from __future__ import annotations
 
+import errno as errno_mod
 import json
 import os
 import tempfile
@@ -35,16 +36,49 @@ from flink_jpmml_tpu.utils.retry import Backoff
 _PREFIX = "ckpt-"
 
 
+_FULL_DISK_ERRNOS = (errno_mod.ENOSPC, errno_mod.EDQUOT)
+_SUSPEND_EVENT_MIN_PERIOD_S = 5.0
+
+
+def _is_disk_full(exc: BaseException) -> bool:
+    """Does this CheckpointException trace back to a full disk/quota?
+    ENOSPC is a DEGRADE signal, not a die signal: the records are
+    safe (they replay from the last committed offset), it is only the
+    snapshot cadence that stalls."""
+    cause = exc.__cause__
+    return (
+        isinstance(cause, OSError)
+        and cause.errno in _FULL_DISK_ERRNOS
+    )
+
+
 class CheckpointPolicy:
     """Interval-gated save/restore shared by the record and block pipelines
     (one implementation of the timing + enablement logic, so the two
-    engines cannot drift on checkpoint semantics)."""
+    engines cannot drift on checkpoint semantics).
+
+    Persistent-ENOSPC degrade: a save streak exhausted by a FULL DISK
+    does not raise out of the score loop (that crash-looped the worker
+    against a disk a restart cannot empty) — checkpointing SUSPENDS
+    instead: serving continues, the ``checkpoint_suspended`` gauge
+    (fleet merge: worst-of) and a rate-limited flight event flag the
+    widened replay window, and each subsequent interval sends ONE
+    cheap write probe — the first one that lands resumes the cadence
+    automatically (``checkpoint_resumed``). Any other exhausted save
+    failure keeps the historical raise."""
 
     def __init__(self, manager: Optional["CheckpointManager"],
-                 interval_s: float):
+                 interval_s: float, metrics=None):
         self._mgr = manager
         self._interval = interval_s
         self._last = 0.0
+        self._metrics = metrics
+        self.suspended = False
+        # gauge registered lazily at the first suspension (the
+        # adaptive_batch discipline: healthy pipelines don't export a
+        # permanent 0 row)
+        self._suspended_gauge = None
+        self._last_suspend_event = 0.0
 
     @property
     def enabled(self) -> bool:
@@ -64,8 +98,41 @@ class CheckpointPolicy:
     def save_now(self, state_fn) -> None:
         if self._mgr is None:
             return
-        self._mgr.save(state_fn())
+        try:
+            # suspended → one cheap probe per interval instead of a
+            # full retry streak per attempt
+            self._mgr.save(state_fn(), probe=self.suspended)
+        except CheckpointException as e:
+            if not _is_disk_full(e):
+                raise
+            self._note_suspended(e)
+            # probe cadence: next attempt only after another interval
+            self._last = time.monotonic()
+            return
+        if self.suspended:
+            self.suspended = False
+            if self._suspended_gauge is not None:
+                self._suspended_gauge.set(0.0)
+            flight.record("checkpoint_resumed")
         self._last = time.monotonic()
+
+    def _note_suspended(self, exc: BaseException) -> None:
+        first = not self.suspended
+        self.suspended = True
+        if self._metrics is not None:
+            if self._suspended_gauge is None:
+                self._suspended_gauge = self._metrics.gauge(
+                    "checkpoint_suspended"
+                )
+            self._suspended_gauge.set(1.0)
+        now = time.monotonic()
+        if first or now - self._last_suspend_event >= (
+            _SUSPEND_EVENT_MIN_PERIOD_S
+        ):
+            self._last_suspend_event = now
+            flight.record(
+                "checkpoint_suspended", error=str(exc), first=first,
+            )
 
 
 class CheckpointManager:
@@ -81,7 +148,7 @@ class CheckpointManager:
         crash-loop fingerprint files live under here (runtime/dlq.py)."""
         return self._dir
 
-    def save(self, state: Dict[str, Any]) -> str:
+    def save(self, state: Dict[str, Any], probe: bool = False) -> str:
         """Write one snapshot crash-safely, retrying transient failures.
 
         Each attempt is temp-file → fsync → ``os.replace`` → directory
@@ -91,30 +158,47 @@ class CheckpointManager:
         parseable. Transient OSErrors (EMFILE, an NFS hiccup, a full
         disk that clears) retry with the shared jittered backoff; an
         exhausted streak raises so the operator sees a checkpoint plane
-        that cannot make progress."""
+        that cannot make progress.
+
+        ``probe=True`` (the suspended-checkpointing resume probe,
+        :class:`CheckpointPolicy`): ONE write attempt, no backoff, no
+        retry flight events — a known-full disk must not re-pay the
+        whole schedule (or spam the flight ring) every interval."""
         payload = {"timestamp": time.time(), "state": state}
-        backoff = Backoff("checkpoint")
-        while True:
+        retries = 0
+        if probe:
             try:
                 path = self._write_once(payload)
             except OSError as e:
-                flight.record(
-                    "checkpoint_save_retry",
-                    error=str(e), attempt=backoff.attempts + 1,
-                )
-                if backoff.exhausted:
-                    flight.record("checkpoint_save_failed", error=str(e))
-                    raise CheckpointException(
-                        f"cannot write checkpoint after "
-                        f"{backoff.attempts} retries: {e}"
-                    ) from e
-                backoff.sleep()
-                continue
-            break
+                raise CheckpointException(
+                    f"checkpoint probe failed: {e}"
+                ) from e
+        else:
+            backoff = Backoff("checkpoint")
+            while True:
+                try:
+                    path = self._write_once(payload)
+                except OSError as e:
+                    flight.record(
+                        "checkpoint_save_retry",
+                        error=str(e), attempt=backoff.attempts + 1,
+                    )
+                    if backoff.exhausted:
+                        flight.record(
+                            "checkpoint_save_failed", error=str(e)
+                        )
+                        raise CheckpointException(
+                            f"cannot write checkpoint after "
+                            f"{backoff.attempts} retries: {e}"
+                        ) from e
+                    backoff.sleep()
+                    continue
+                break
+            retries = backoff.attempts
         flight.record(
             "checkpoint_save", path=path,
             source_offset=state.get("source_offset"),
-            retries=backoff.attempts,
+            retries=retries,
         )
         self._gc()
         return path

@@ -35,6 +35,7 @@ from flink_jpmml_tpu.obs import freshness as fresh_mod
 from flink_jpmml_tpu.obs import pressure as pressure_mod
 from flink_jpmml_tpu.obs import recorder as flight
 from flink_jpmml_tpu.obs import trace as trace_mod
+from flink_jpmml_tpu.runtime import devfault
 from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime import prefetch as prefetch_mod
 from flink_jpmml_tpu.runtime.checkpoint import (
@@ -189,7 +190,8 @@ class Pipeline:
         if backend:
             self.metrics.counter(f"scorer_backend_{backend}").inc()
         self._ckpt = CheckpointPolicy(
-            checkpoint, self._config.checkpoint_interval_s
+            checkpoint, self._config.checkpoint_interval_s,
+            metrics=self.metrics,
         )
         # delivery-correctness plane (runtime/dlq.py): record-level
         # error isolation — a scoring exception bisects the micro-batch
@@ -203,6 +205,14 @@ class Pipeline:
         self._fingerprint = (
             CrashFingerprint(ckpt_dir)
             if (ckpt_dir is not None and self._dlq is not None) else None
+        )
+        # device-fault recovery (runtime/devfault.py) arms on the same
+        # terms as the block path: durable state wired (DLQ) or the
+        # explicit FJT_FAILOVER opt-in — a bare pipeline keeps the
+        # historical fail-fast (die, let the supervisor restart onto a
+        # healthy device)
+        self._devfault_armed = (
+            self._dlq is not None or bool(os.environ.get("FJT_FAILOVER"))
         )
         self._dispatched_hi = 0
         self._replay_until = 0
@@ -386,13 +396,17 @@ class Pipeline:
         return s.offset - 1
 
     def _score_seq(self, seq: List["_Stamped"]) -> List[Any]:
-        """Synchronous submit+finish of a sub-batch (the isolation
-        paths' dispatch primitive), with the fault hook carrying the
-        sub-range's record offsets."""
+        """Synchronous submit+finish of a sub-batch (the isolation and
+        device-recovery paths' dispatch primitive), with the fault
+        hooks carrying the sub-range's record offsets — the device
+        sites fire here too, so a persistent injected device fault
+        keeps failing redispatches exactly like a real one."""
         faults.fire(
             "score_batch", offsets=[self._record_off(s) for s in seq]
         )
+        faults.fire("device_dispatch")
         ticket = self._scorer.submit([s.record for s in seq])
+        faults.fire("device_readback")
         return self._scorer.finish(ticket)
 
     def _deliver_seq(self, seq, outputs) -> None:
@@ -470,6 +484,11 @@ class Pipeline:
             except PoisonIsolationOverflow:
                 raise
             except Exception as e:
+                if devfault.classify(e) is not None:
+                    # a sick device mid-bisection is not record
+                    # poison: never quarantine clean records for it —
+                    # escalate (cf. block.py's suspect scan)
+                    raise
                 if len(seq) == 1:
                     self._quarantine_stamped(
                         seq[0], e, state, original=error,
@@ -543,6 +562,8 @@ class Pipeline:
             except PoisonIsolationOverflow:
                 raise
             except Exception as e:
+                if devfault.classify(e) is not None:
+                    raise  # device fault ≠ poison: never quarantine
                 self._quarantine_stamped(s, e, state, parent_ctx=rctx)
                 continue
             self._deliver_seq([s], outputs)
@@ -555,6 +576,91 @@ class Pipeline:
             self._fingerprint.clear_marker()
         self._committed_offset = stamped[-1].offset
         self._ckpt.maybe_save(self._ckpt_state)
+
+    def _recover_device(self, stamped: List["_Stamped"], error,
+                        kind: str, ctx=None) -> None:
+        """Record-path device-fault ladder (runtime/devfault.py):
+        transient errors re-dispatch the micro-batch through the real
+        submit/finish path under the shared full-jitter backoff; OOM
+        drains in halves (batch-size bisection, never record
+        quarantine); chip loss or an exhausted streak escalates to
+        the supervisor. The record path has no fallback tier — its
+        dynamic scorer already absorbs per-model failures — so
+        persistence means restart, with every delivered run committed
+        first (zero loss, bounded replay)."""
+        from flink_jpmml_tpu.utils.retry import Backoff
+
+        devfault.note(
+            self.metrics, kind, first_off=self._record_off(stamped[0]),
+            n=len(stamped), error=error,
+        )
+        if kind == devfault.KIND_LOST:
+            flight.record(
+                "device_lost_escalate",
+                first=self._record_off(stamped[0]), n=len(stamped),
+                error=repr(error),
+            )
+            raise error
+        redispatched = self.metrics.counter("redispatch_records")
+        retries = env_count("FJT_DEVICE_RETRIES", 2)
+        bo = Backoff(
+            "device", base_s=0.02, cap_s=0.5, max_attempts=retries
+        )
+        pending = list(stamped)
+        # OOM dispatch-size cap: HALVES on every OOM failure (true
+        # bisection — a device that only fits a quarter of the batch
+        # must converge, not retry the same half forever); a proven
+        # size sticks for the remainder
+        size = len(pending)
+        while pending and not bo.exhausted:
+            bo.sleep()
+            if kind == devfault.KIND_OOM and size > 1:
+                size = max(1, size // 2)
+                kind = devfault.KIND_ERROR  # halve once per OOM seen
+                # a halving IS progress: the bisection must converge to
+                # size 1 (≤ log2(batch) halvings) independent of the
+                # transient-retry budget — only repeated failures at
+                # the SAME size spend the streak
+                bo.reset()
+            seq = pending[:min(size, len(pending))]
+            try:
+                outputs = self._score_seq(seq)
+            except Exception as e2:
+                k2 = devfault.classify(e2)
+                if k2 is None:
+                    # the device fault cleared and record poison
+                    # surfaced underneath: isolation's jurisdiction
+                    if self._dlq is None:
+                        raise
+                    self._isolate(pending, e2, ctx=ctx)
+                    return
+                devfault.note(
+                    self.metrics, k2,
+                    first_off=self._record_off(seq[0]), n=len(seq),
+                    error=e2,
+                )
+                if k2 == devfault.KIND_LOST:
+                    flight.record(
+                        "device_lost_escalate",
+                        first=self._record_off(seq[0]), n=len(seq),
+                        error=repr(e2),
+                    )
+                    raise e2
+                kind = k2
+                error = e2
+                continue
+            self._deliver_seq(seq, outputs)
+            redispatched.inc(len(seq))
+            self._committed_offset = seq[-1].offset
+            self._ckpt.maybe_save(self._ckpt_state)
+            pending = pending[size:]
+            bo.reset()  # progress re-arms the schedule
+        if pending:
+            raise error  # exhausted: supervisor restart (streak ctx)
+        flight.record(
+            "device_redispatch",
+            first=self._record_off(stamped[0]), n=len(stamped),
+        )
 
     def _exit_suspect_mode(self) -> None:
         flight.record(
@@ -640,14 +746,24 @@ class Pipeline:
                 # stages capture) carries THIS journey's ids
                 with trace_mod.use(jctx):
                     with stages.stage("readback"):
+                        # readback-time device-fault hook: one global
+                        # load + None check unarmed (cf. the block
+                        # dispatcher's finish_oldest site)
+                        faults.fire("device_readback")
                         outputs = self._scorer.finish(ticket)
             except PoisonIsolationOverflow:
                 raise
             except Exception as e:
-                # record-level isolation: with a DLQ wired, bisect the
-                # micro-batch instead of killing the worker — entries
-                # ahead of this one already completed (FIFO), so the
-                # isolation's commits stay monotone
+                # device-fault triage FIRST (runtime/devfault.py): a
+                # sick device re-dispatches, record poison bisects —
+                # entries ahead of this one already completed (FIFO),
+                # so either path's commits stay monotone
+                kind = devfault.classify(e)
+                if kind is not None:
+                    if not self._devfault_armed:
+                        raise  # historical fail-fast: restart instead
+                    self._recover_device(stamped, e, kind, ctx=jctx)
+                    return
                 if self._dlq is None:
                     raise
                 self._isolate(stamped, e, ctx=jctx)
@@ -733,6 +849,7 @@ class Pipeline:
                                     self._record_off(s) for s in stamped
                                 ],
                             )
+                            faults.fire("device_dispatch")
                             ticket = self._scorer.submit(
                                 [s.record for s in stamped]
                             )
@@ -740,13 +857,20 @@ class Pipeline:
                     raise
                 except Exception as e:
                     # the submit itself raised (featurize, routing, an
-                    # injected poison): older in-flight batches commit
-                    # first, then this one isolates in place
-                    if self._dlq is None:
+                    # injected poison, a launch-time device fault):
+                    # older in-flight batches commit first, then this
+                    # one recovers or isolates in place
+                    kind = devfault.classify(e)
+                    if kind is not None and not self._devfault_armed:
+                        raise  # historical fail-fast: restart instead
+                    if kind is None and self._dlq is None:
                         raise
                     while in_flight:
                         _finish_one()
-                    self._isolate(stamped, e, ctx=jctx)
+                    if kind is not None:
+                        self._recover_device(stamped, e, kind, ctx=jctx)
+                    else:
+                        self._isolate(stamped, e, ctx=jctx)
                     batches.inc()
                     fill.inc(len(stamped))
                     continue

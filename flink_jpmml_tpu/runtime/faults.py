@@ -26,7 +26,22 @@ kind               fires in (site)                            effect
 ``worker_crash``   any site via ``site=`` (default            SIGKILLs the process — the
                    ``score_loop``); ``offset=`` targets the   kill-anywhere recovery drill's
                    batch containing that record               chaos primitive
+``device_oom``     device launch/readback (``device_dispatch``raises ``InjectedDeviceOOM``
+                   / ``device_readback`` via ``site=``)       → batch-size bisection
+``device_error``   device launch/readback                     raises ``InjectedDeviceError``
+                                                              → redispatch / circuit breaker
+``chip_loss``      device launch/readback                     raises ``InjectedChipLoss``
+                                                              → supervisor escalation /
+                                                              degraded-mesh mode
 =================  =========================================  ===========================
+
+The device kinds ride the real launch/readback hook sites in
+``runtime/pipeline.OverlappedDispatcher`` and the record engine's
+submit/finish path; ``runtime/devfault.classify`` recognizes their
+exceptions exactly like real XLA runtime errors, so the drills prove
+the production recovery ladder, not a parallel test-only path.
+``checkpoint_fail`` accepts ``errno=`` (e.g. ``errno=28`` = ENOSPC) so
+a persistent-full-disk outage is drillable end to end.
 
 Two front doors:
 
@@ -86,12 +101,22 @@ SITES = {
     # kill); with ``offset=`` it fires only when that offset is in the
     # batch, the shape of a record that hard-crashes the process
     "worker_crash": "score_loop",
+    # device faults (runtime/devfault.py's taxonomy): default to the
+    # readback site — async dispatch errors surface where the host
+    # first blocks, like the real thing; ``site=device_dispatch``
+    # moves them to launch time
+    "device_oom": "device_readback",
+    "device_error": "device_readback",
+    "chip_loss": "device_readback",
 }
 
-# sites a ``worker_crash:site=...`` param may name
+# sites a ``site=`` param may name (worker_crash: any; device kinds:
+# the two device hook sites only)
 KNOWN_SITES = frozenset(
-    list(SITES.values()) + ["score_batch", "dispatch"]
+    list(SITES.values()) + ["score_batch", "dispatch", "device_dispatch"]
 )
+_DEVICE_KINDS = frozenset(("device_oom", "device_error", "chip_loss"))
+_DEVICE_SITES = frozenset(("device_dispatch", "device_readback"))
 
 
 class InjectedBrokerDeath(ConnectionError):
@@ -102,6 +127,39 @@ class InjectedBrokerDeath(ConnectionError):
 class InjectedCheckpointFailure(OSError):
     """Injected checkpoint write failure: rides ``CheckpointManager
     .save``'s real ``except OSError`` → retry/backoff path."""
+
+
+class InjectedDeviceOOM(RuntimeError):
+    """Injected device OOM: message mirrors XLA's RESOURCE_EXHAUSTED
+    status so ``runtime/devfault.classify`` routes it exactly like a
+    real allocator refusal → the batch-size bisection ladder."""
+
+    def __init__(self):
+        super().__init__(
+            "RESOURCE_EXHAUSTED: Out of memory allocating device "
+            "buffer (injected device OOM)"
+        )
+
+
+class InjectedDeviceError(RuntimeError):
+    """Injected transient XLA runtime failure → the redispatch /
+    circuit-breaker ladder."""
+
+    def __init__(self):
+        super().__init__(
+            "INTERNAL: injected XLA runtime error (transient device "
+            "failure)"
+        )
+
+
+class InjectedChipLoss(RuntimeError):
+    """Injected unrecoverable device loss → supervisor escalation
+    (and, on a mesh, degraded-mesh mode)."""
+
+    def __init__(self):
+        super().__init__(
+            "UNAVAILABLE: device lost (injected chip loss)"
+        )
 
 
 class InjectedPoisonRecord(ValueError):
@@ -130,15 +188,21 @@ class _Fault:
         self.kind = kind
         site = params.get("site")
         if site is not None:
-            if kind != "worker_crash":
+            if kind == "worker_crash":
+                allowed = KNOWN_SITES
+            elif kind in _DEVICE_KINDS:
+                # a device fault can only strike where device work is
+                # launched or waited on
+                allowed = _DEVICE_SITES
+            else:
                 raise ValueError(
-                    f"site= is only meaningful on worker_crash, not "
-                    f"{kind!r}"
+                    f"site= is only meaningful on worker_crash and the "
+                    f"device kinds, not {kind!r}"
                 )
-            if site not in KNOWN_SITES:
+            if site not in allowed:
                 raise ValueError(
-                    f"unknown fault site {site!r} "
-                    f"(have {sorted(KNOWN_SITES)})"
+                    f"unknown fault site {site!r} for {kind!r} "
+                    f"(have {sorted(allowed)})"
                 )
             self.site = str(site)
         else:
@@ -164,6 +228,13 @@ class _Fault:
         )
         self.every = (
             int(params["every"]) if params.get("every") is not None
+            else None
+        )
+        # checkpoint_fail only: stamp this errno on the injected
+        # OSError (errno=28 drills persistent ENOSPC → the checkpoint
+        # plane's degrade-don't-die path)
+        self.errno = (
+            int(params["errno"]) if params.get("errno") is not None
             else None
         )
         if kind == "poison_record" and self.offset is None and self.every is None:
@@ -227,9 +298,18 @@ class _Fault:
         if self.kind == "broker_death":
             raise InjectedBrokerDeath("injected broker death")
         if self.kind == "checkpoint_fail":
-            raise InjectedCheckpointFailure(
+            e = InjectedCheckpointFailure(
                 "injected checkpoint write failure"
             )
+            if self.errno is not None:
+                e.errno = self.errno
+            raise e
+        if self.kind == "device_oom":
+            raise InjectedDeviceOOM()
+        if self.kind == "device_error":
+            raise InjectedDeviceError()
+        if self.kind == "chip_loss":
+            raise InjectedChipLoss()
         if self.kind == "poison_record":
             raise InjectedPoisonRecord(
                 token if token is not True else ()

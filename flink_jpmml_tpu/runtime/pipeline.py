@@ -289,14 +289,18 @@ class _InFlight:
     fetch failure when it left poisoned — a later ``wait`` re-raises it
     instead of handing back a never-synchronized result."""
 
-    __slots__ = ("out", "meta", "t_launch", "done", "error")
+    __slots__ = ("out", "meta", "t_launch", "done", "error", "accounted")
 
-    def __init__(self, out: Any, meta: Any, t_launch: float):
+    def __init__(self, out: Any, meta: Any, t_launch: float,
+                 accounted: bool = True):
         self.out = out
         self.meta = meta
         self.t_launch = t_launch
         self.done = False
         self.error: Optional[BaseException] = None
+        # False for shed no-op entries (no device work was launched):
+        # the device-readback fault hook must not fire for them
+        self.accounted = accounted
 
 
 class OverlappedDispatcher:
@@ -416,9 +420,14 @@ class OverlappedDispatcher:
         """
         if self._closed:
             raise DispatcherClosed("launch() on a closed dispatcher")
-        # device-dispatch delay injection (runtime/faults.py): a global
-        # load + None check when no faults are configured
+        # device-dispatch delay + launch-time device-fault injection
+        # (runtime/faults.py): each a global load + None check when no
+        # faults are configured. A device fault raised HERE propagates
+        # out of launch to the caller's direct-dispatch handler —
+        # classified by runtime/devfault.py, never quarantined as
+        # record poison
         faults.fire("dispatch")
+        faults.fire("device_dispatch")
         prof = self._profiler
         sampling = (
             prof is not None
@@ -466,7 +475,7 @@ class OverlappedDispatcher:
         else:
             out = dispatch_fn()
         _prefetch_host(out)
-        handle = _InFlight(out, meta, time.monotonic())
+        handle = _InFlight(out, meta, time.monotonic(), accounted=accounted)
         self._window.append(handle)
         if accounted:
             self._dispatches.inc()
@@ -512,6 +521,13 @@ class OverlappedDispatcher:
         t0 = time.monotonic()
         error: Optional[BaseException] = None
         try:
+            # readback-time device-fault injection: raises inside the
+            # same try as the real fetch, so an injected device error
+            # takes exactly the real error path (handle.error +
+            # on_error classification); shed no-ops (accounted=False)
+            # launched no device work and are skipped
+            if handle.accounted:
+                faults.fire("device_readback")
             _block_ready(handle.out)
         except BaseException as e:
             handle.error = e  # wait() on this handle re-raises, never
@@ -563,6 +579,8 @@ class OverlappedDispatcher:
         if not handle.done:
             t0 = time.monotonic()
             try:
+                if handle.accounted:
+                    faults.fire("device_readback")
                 _block_ready(handle.out)
             except BaseException as e:
                 handle.error = e

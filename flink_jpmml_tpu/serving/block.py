@@ -100,6 +100,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
         admission=None,
         shed_lane: str = "block",
         dlq=None,
+        failover=None,
     ):
         if batch_size <= 0:
             raise InputValidationException(
@@ -138,6 +139,11 @@ class DynamicBlockPipeline(BlockPipelineBase):
             # suspect scan re-dispatches through the CURRENT BoundScorer
             # and quarantined envelopes carry its model key
             dlq=dlq,
+            # device-fault recovery (runtime/devfault.py) works per
+            # served model: the circuit breaker keys on the bound
+            # scorer's model key, so one sick model's failover does
+            # not gate its siblings
+            failover=failover,
         )
         self._control = control
         self._name = name
@@ -274,6 +280,12 @@ class DynamicBlockPipeline(BlockPipelineBase):
 
     def _dispatch(self, bound, X, n):
         return self._dispatch_bound(bound, X, n), bound.decode
+
+    def _fallback_dispatch(self, bound, X, n):
+        # host-tier output decodes through the SAME bound decode (the
+        # tier re-runs the identical XLA program on CPU), so a swap
+        # mid-outage keeps per-batch decode correctness
+        return self._failover.tier.score_bound(bound, X), bound.decode
 
     def _emit(self, out, n, first_off, decode) -> None:
         self._sink(out, n, first_off, decode)

@@ -139,6 +139,11 @@ class AdaptiveBatcher:
         self._obs: Dict[int, list] = {}
         self._c0: Optional[float] = None
         self._c1: Optional[float] = None
+        # device-OOM ceiling (runtime/devfault.py ladder): a proven-
+        # fitting dispatch size after an allocator refusal; applies
+        # even with no deadline configured — memory is a hard wall,
+        # the deadline is a soft one
+        self._oom_cap: Optional[int] = None
         self._fitted_from = 0  # distinct sizes behind the current fit
         self._samples = 0
         self._drift_strikes = 0
@@ -259,18 +264,43 @@ class AdaptiveBatcher:
                 return None
             return (self._c0 or 0.0) + self._c1 * int(records)
 
+    def note_oom_cap(self, records: int) -> int:
+        """Device-OOM feedback from the recovery ladder
+        (``runtime/block.py _oom_recover``): ``records`` is the largest
+        dispatch size the bisection PROVED fits device memory. The cap
+        only ever shrinks (min-of) and outlives the deadline logic —
+        an OOM wall binds throughput mode too. → the effective cap."""
+        cap = max(self.min_records, int(records))
+        with self._mu:
+            if self._oom_cap is not None:
+                cap = min(cap, self._oom_cap)
+            self._oom_cap = cap
+        flight.record(
+            "oom_batch_cap", key=self._key, max_records=cap,
+        )
+        return cap
+
     def max_records(self) -> Optional[int]:
         """Largest dispatch size predicted to finish inside
-        ``target_frac × deadline``; None when no deadline or no fit
-        (callers keep their own defaults)."""
-        if self.deadline_s is None:
+        ``target_frac × deadline``, clamped by any device-OOM ceiling
+        (:meth:`note_oom_cap`); None when neither constrains (callers
+        keep their own defaults)."""
+        n: Optional[int] = None
+        if self.deadline_s is not None:
+            with self._mu:
+                if self._c1 is not None and self._c1 > 0:
+                    budget = (
+                        self.target_frac * self.deadline_s
+                        - (self._c0 or 0.0)
+                    )
+                    n = int(budget / self._c1) if budget > 0 else 0
+            if n is not None:
+                n = max(self.min_records, n)
+        oom = self._oom_cap
+        if oom is not None:
+            n = oom if n is None else min(n, oom)
+        if n is None:
             return None
-        with self._mu:
-            if self._c1 is None or self._c1 <= 0:
-                return None
-            budget = self.target_frac * self.deadline_s - (self._c0 or 0.0)
-            n = int(budget / self._c1) if budget > 0 else 0
-        n = max(self.min_records, n)
         if self.max_records_bound is not None:
             n = min(n, self.max_records_bound)
         if self._metrics is not None:

@@ -59,6 +59,7 @@ from flink_jpmml_tpu.rollout.state import (
     STAGE_CANARY,
     GuardrailSpec,
 )
+from flink_jpmml_tpu.runtime import devfault
 from flink_jpmml_tpu.runtime.engine import Scorer
 from flink_jpmml_tpu.runtime.pipeline import (
     OverlappedDispatcher,
@@ -117,6 +118,7 @@ class DynamicScorer(Scorer):
         admission=None,
         lane_fn: Optional[Callable[[Any], str]] = None,
         batcher=None,
+        device_retry: Optional[bool] = None,
     ):
         """``async_warmup=False`` disables background warming: a newly
         Added model compiles synchronously inside ``submit`` on its first
@@ -216,6 +218,14 @@ class DynamicScorer(Scorer):
         self.admission = admission
         self.batcher = batcher
         self._lane_fn = lane_fn or default_lane
+        # device-fault group redispatch (runtime/devfault.py): default
+        # ON — the retry is bounded (FJT_DEVICE_RETRIES full-jitter
+        # draws), payloads are already retained, and C5 totality wants
+        # a transient chip hiccup absorbed rather than surfaced;
+        # device_retry=False restores pure fail-fast
+        self._device_retry = (
+            device_retry if device_retry is not None else True
+        )
 
     def _drain_control(self) -> None:
         while True:
@@ -397,7 +407,11 @@ class DynamicScorer(Scorer):
         tickets = []
         for key, (model, idxs, payloads, rollinfo) in groups.items():
             handle, scorer = self._launch_group(model, payloads)
-            tickets.append((scorer, idxs, handle, rollinfo))
+            # model + payloads ride along so a device-classified fetch
+            # failure can re-dispatch the group (runtime/devfault.py)
+            tickets.append(
+                (scorer, idxs, handle, rollinfo, model, payloads)
+            )
         shadows = []
         for name, (model, idxs, payloads) in mirrors.items():
             handle, scorer = self._launch_group(model, payloads)
@@ -462,27 +476,54 @@ class DynamicScorer(Scorer):
         # FJT_DRIFT_SAMPLE armed it — the record-path sink is this
         # finish loop, so score sketches book here, per served model
         dplane = drift_mod.plane_for(self.metrics)
-        for model, idxs, handle, rollinfo in tickets:
+        for scorer, idxs, handle, rollinfo, gmodel, payloads in tickets:
+            model = scorer
             role = rollinfo[1] if rollinfo is not None else None
             failed = False
             try:
                 out = self._dispatcher.wait(handle)
                 decoded = model.decode(out, len(idxs))
             except Exception as e:
-                if role != "candidate":
-                    raise
-                # a poisoned candidate must not kill the stream: its
-                # lanes go empty (C5) and the failure lands where the
-                # guardrail controller reads it — the rollback signal
-                failed = True
-                name = rollinfo[0]
-                self.metrics.counter(
-                    f'rollout_candidate_errors{{model="{name}"}}'
-                ).inc(len(idxs))
-                flight.record(
-                    "rollout_candidate_error", model=name, error=repr(e)
-                )
-                decoded = [Prediction.empty()] * len(idxs)
+                kind = devfault.classify(e)
+                decoded = None
+                if kind is not None:
+                    # book EVERY classified fault here — chip loss
+                    # included, which never enters the retry below but
+                    # must still land in device_fault_total and the
+                    # trace-carrying device_fault flight event
+                    devfault.note(
+                        self.metrics, kind, n=len(idxs), error=e
+                    )
+                if (
+                    kind is not None
+                    and kind != devfault.KIND_LOST
+                    and self._device_retry
+                ):
+                    # device-fault ladder, record-path flavor: re-
+                    # dispatch the group from its retained payloads
+                    # under the shared full-jitter backoff — a sick
+                    # device must not surface as a scoring failure
+                    # (nor poison the candidate's rollback signal)
+                    decoded, e = self._redispatch_group(
+                        gmodel, payloads, len(idxs), e
+                    )
+                if decoded is None and role != "candidate":
+                    raise e
+                if decoded is None:
+                    # a poisoned candidate must not kill the stream:
+                    # its lanes go empty (C5) and the failure lands
+                    # where the guardrail controller reads it — the
+                    # rollback signal
+                    failed = True
+                    name = rollinfo[0]
+                    self.metrics.counter(
+                        f'rollout_candidate_errors{{model="{name}"}}'
+                    ).inc(len(idxs))
+                    flight.record(
+                        "rollout_candidate_error", model=name,
+                        error=repr(e),
+                    )
+                    decoded = [Prediction.empty()] * len(idxs)
             if rollinfo is not None and not failed:
                 # failed groups count ONLY as errors: adding them to the
                 # served-records counter would halve the controller's
@@ -552,6 +593,35 @@ class DynamicScorer(Scorer):
         if self._emit_pairs:
             return [(p, r) for p, r in zip(preds, records)]
         return list(preds)
+
+    def _redispatch_group(self, model, payloads, n_idxs, error):
+        """Device-fault recovery for one per-model group: re-launch it
+        from the retained payloads through the same overlapped window
+        under the shared full-jitter backoff. → (decoded, last_error)
+        with ``decoded=None`` when the streak exhausted (the caller's
+        raise/absorb policy then applies — but never quarantine)."""
+        from flink_jpmml_tpu.utils.retry import Backoff, env_int
+
+        bo = Backoff(
+            "device", base_s=0.02, cap_s=0.5,
+            max_attempts=env_int("FJT_DEVICE_RETRIES", 2),
+        )
+        while not bo.exhausted:
+            bo.sleep()
+            try:
+                handle, scorer = self._launch_group(model, payloads)
+                out = self._dispatcher.wait(handle)
+                decoded = scorer.decode(out, n_idxs)
+            except Exception as e2:
+                error = e2
+                k2 = devfault.classify(e2)
+                if k2 is None or k2 == devfault.KIND_LOST:
+                    return None, e2
+                devfault.note(self.metrics, k2, n=n_idxs, error=e2)
+                continue
+            self.metrics.counter("redispatch_records").inc(n_idxs)
+            return decoded, error
+        return None, error
 
     # -- rollout accounting / shadow diffing -------------------------------
 

@@ -781,6 +781,11 @@ _GAUGE_MERGE_MAX_PREFIXES = (
     # these comments are fine now: metrics_lint parses the real AST,
     # not a to-the-closing-paren regex.)
     "poison_suspect_mode",
+    # device-fault resilience (serving/failover.py, runtime/devfault.py):
+    # circuit state 0 closed / 1 half-open / 2 open — the fleet view is
+    # the sickest worker; same worst-of logic for a suspended
+    # checkpoint plane and for lost mesh chips
+    "failover_state", "checkpoint_suspended", "mesh_lost_devices",
 )
 _GAUGE_MERGE_MIN_PREFIXES = (
     "slo_ok", "watermark_ts", "watermark_stage_ts", "adaptive_batch",

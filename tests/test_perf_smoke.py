@@ -17,16 +17,17 @@ _SMOKE = (
 def test_perf_smoke_passes():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    # +60s over the pre-recovery-drill budget: the drill spawns one
-    # supervised worker, kills it once, and re-drives a quarantined
-    # record (~20-30s on a loaded CI host)
-    env["FJT_SMOKE_WATCHDOG_S"] = "210"
+    # +30s over the pre-device-fault budget: the device-fault check
+    # paces a ~12k-record stream through a breaker lifecycle (~3-6s)
+    # plus one extra GBM compile
+    env["FJT_SMOKE_WATCHDOG_S"] = "240"
     env.pop("FJT_FAULTS", None)  # the no-op check requires a clean env
     env.pop("FJT_RESTART_STREAK", None)
     env.pop("FJT_JOURNEY_DIR", None)  # the journey gate check likewise
+    env.pop("FJT_FAILOVER", None)  # the fail-fast default likewise
     proc = subprocess.run(
         [sys.executable, str(_SMOKE)],
-        capture_output=True, text=True, timeout=380, env=env,
+        capture_output=True, text=True, timeout=420, env=env,
     )
     assert proc.returncode == 0, (
         f"perf smoke rc={proc.returncode}\n"
@@ -45,4 +46,5 @@ def test_perf_smoke_passes():
     assert "overload drill OK" in proc.stdout
     assert "journey trace OK" in proc.stdout
     assert "recovery drill OK" in proc.stdout
+    assert "device fault plane OK" in proc.stdout
     assert "fault hooks no-op OK" in proc.stdout

@@ -233,13 +233,17 @@ def _chaos_model():
 def _soak_chaos(seed):
     """One chaos iteration: a seeded random COMPOSITION of fault kinds
     (broker death, slow fetch, dispatch delay, checkpoint failure,
-    worker wedge, poison records, decode poison — everything except
-    worker_crash, which would kill the soak process itself; the
-    kill-anywhere half lives in ``bench.py --recovery-drill``) against
-    a real Kafka→BlockPipeline stream with checkpoints + DLQ. Verifies
-    the delivery contract every time: every offset either reaches the
-    sink or sits in the DLQ, poison lands in the DLQ exactly, and the
-    stream drains to the end despite the weather."""
+    worker wedge, poison records, decode poison, DEVICE faults —
+    everything except worker_crash and chip_loss, which would kill the
+    soak process itself; the kill-anywhere half lives in ``bench.py
+    --recovery-drill`` / ``--device-fault-drill``) against a real
+    Kafka→BlockPipeline stream with checkpoints + DLQ. Verifies the
+    delivery contract every time: every offset either reaches the sink
+    or sits in the DLQ, poison lands in the DLQ exactly — and device
+    faults land NOWHERE (the ladder re-dispatches or serves the
+    fallback tier; a sick device must never quarantine clean records
+    nor lose any, even composed with e.g. a concurrent broker death) —
+    and the stream drains to the end despite the weather."""
     import os
     import tempfile
 
@@ -292,6 +296,13 @@ def _soak_chaos(seed):
             f"dispatch_delay:delay_ms=1:p=0.05:seed={seed}",
             f"checkpoint_fail:n={int(rng.integers(1, 3))}",
             "worker_wedge:wedge_s=0.05:n=1",
+            # device kinds (runtime/devfault.py): persistent-ish error
+            # streaks exercise redispatch→breaker→fallback, OOM streaks
+            # the batch-size bisection — composed freely with the rest
+            f"device_error:site=device_readback"
+            f":n={int(rng.integers(2, 10))}",
+            f"device_oom:site=device_dispatch"
+            f":n={int(rng.integers(1, 4))}",
         ]
         picks = rng.choice(
             len(menu), size=int(rng.integers(1, len(menu) + 1)),
@@ -310,6 +321,10 @@ def _soak_chaos(seed):
             max_wait_ms=10, metrics=m, dlq=dlq,
         )
         os.environ["FJT_RETRY_BASE_S"] = "0.01"
+        # fast breaker geometry so a device_error streak can complete
+        # its open→half-open→closed lifecycle within one soak seed
+        os.environ["FJT_FAILOVER_COOLDOWN_S"] = "0.1"
+        os.environ["FJT_FAILOVER_GREENS"] = "1"
         assert faults.install_from_env(",".join(spec)), spec
         pipe = BlockPipeline(
             src, cm, sink,

@@ -67,6 +67,14 @@ fails loudly on exactly the regressions new concurrency code breeds:
   ``/trace`` scrape must retrieve ≥1 complete journey whose sink hop's
   trace id matches a ``latency_exemplar`` flight event (the
   fjt-top → fjt-trace pivot's ground truth);
+- **device-fault-plane rot**: the recovery ladder (``runtime/
+  devfault.py`` + ``serving/failover.py``) at smoke scale — an
+  injected persistent ``device_error`` streak must trip the circuit
+  breaker onto the host fallback tier (a live ``/metrics`` scrape
+  mid-outage shows ``fjt_failover_state`` open and non-zero
+  ``fjt_fallback_records``), the breaker must re-close on green
+  probes, redispatch must land records, the stream must drain with
+  zero loss, and the unarmed device fault-hook sites must stay ≤2µs;
 - **fault-hook overhead**: with ``FJT_FAULTS`` unset, the injection
   hooks on the fetch/dispatch/checkpoint/score paths
   (``runtime/faults.py fire()``) must be a genuine no-op — sub-µs per
@@ -1095,6 +1103,179 @@ def check_recovery_drill() -> None:
     assert line["max_dup"] <= line["restarts"] + 1, line
 
 
+def check_device_fault() -> None:
+    """Device-fault resilience tripwire (runtime/devfault.py +
+    serving/failover.py): unarmed hook-site overhead ≤2µs; then a
+    smoke-scale outage — a persistent injected ``device_error`` streak
+    trips the circuit onto the host fallback tier while a live
+    ``/metrics`` scrape observes it (``fjt_failover_state`` open,
+    non-zero ``fjt_fallback_records``), the breaker re-closes on green
+    probes, redispatch lands records, and the paced stream drains with
+    zero loss and in-order sinks."""
+    import re
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.obs.server import ObsServer
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime import faults
+    from flink_jpmml_tpu.runtime.block import BlockPipeline, BlockSource
+
+    # -- unarmed overhead: the new device hook sites ride the same
+    #    no-op contract as every other fault site
+    assert not faults.active(), "faults armed — no-op check invalid"
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        faults.fire("device_readback")
+        faults.fire("device_dispatch")
+    per_call = (time.perf_counter() - t0) / (2 * n)
+    assert per_call <= 2e-6, (
+        f"inactive device fault hook costs {per_call * 1e6:.2f}µs/call"
+    )
+
+    class PacedSource(BlockSource):
+        """One block per interval: on a CPU host the fallback tier
+        runs at device speed, and an instantly-available stream would
+        drain inside one open-circuit window — pacing leaves traffic
+        for the half-open probes that must re-close the breaker."""
+
+        def __init__(self, data, block, interval_s):
+            self._data = data
+            self._block = block
+            self._interval = interval_s
+            self._pos = 0
+            self._next_t = 0.0
+
+        def poll(self):
+            if self._pos >= self._data.shape[0]:
+                return None
+            now = time.monotonic()
+            if now < self._next_t:
+                return None
+            self._next_t = now + self._interval
+            blk = self._data[self._pos: self._pos + self._block]
+            off = self._pos
+            self._pos += blk.shape[0]
+            return off, blk
+
+        @property
+        def exhausted(self):
+            return self._pos >= self._data.shape[0]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=8, depth=3, n_features=5)
+        )
+    cm = compile_pmml(doc, batch_size=64)
+    rng = np.random.default_rng(17)
+    N = 12_288
+    data = rng.normal(0.0, 1.0, size=(N, 5)).astype(np.float32)
+    emitted = []
+
+    def sink(out, n_rec, first_off):
+        emitted.append((first_off, n_rec))
+
+    env_saved = {
+        k: os.environ.get(k)
+        for k in ("FJT_FAILOVER", "FJT_FAILOVER_COOLDOWN_S",
+                  "FJT_FAILOVER_GREENS", "FJT_RETRY_BASE_S")
+    }
+    os.environ["FJT_FAILOVER"] = "1"  # arm without a DLQ: env opt-in
+    os.environ["FJT_FAILOVER_COOLDOWN_S"] = "0.2"
+    os.environ["FJT_FAILOVER_GREENS"] = "1"
+    os.environ["FJT_RETRY_BASE_S"] = "0.005"
+    srv = None
+    pipe = None
+    try:
+        # 7 fires: batch 1 (1 + 2 retries) opens the circuit; probe 1
+        # burns 3 more and re-opens; probe 2's initial readback burns
+        # the last, its first RETRY succeeds (redispatch_records), and
+        # the next green completion closes the circuit
+        faults.inject("device_error", site="device_readback", n=7)
+        pipe = BlockPipeline(
+            PacedSource(data, 64, 0.004), cm, sink,
+            in_flight=2, use_native=False, max_dispatch_chunks=1,
+        )
+        srv = ObsServer.for_registry(pipe.metrics)
+        pipe.start()
+        saw_open = False
+        saw_fallback = 0.0
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if pipe._error is not None:
+                raise pipe._error
+            try:
+                with urllib.request.urlopen(
+                    srv.url + "/metrics", timeout=5
+                ) as r:
+                    page = r.read().decode()
+            except OSError:
+                page = ""
+            m = re.search(
+                r'fjt_failover_state\{model="static"\} ([0-9.]+)', page
+            )
+            if m and float(m.group(1)) >= 2.0:
+                saw_open = True
+                fb = re.search(r"fjt_fallback_records ([0-9.e+]+)", page)
+                if fb:
+                    saw_fallback = max(saw_fallback, float(fb.group(1)))
+            if pipe._source.exhausted and not len(pipe._ring):
+                break
+            time.sleep(0.02)
+        pipe._drain_all = True
+        pipe.stop()
+        pipe.join(timeout=30.0)
+        assert saw_open, (
+            "live scrape never observed fjt_failover_state open"
+        )
+        assert saw_fallback > 0, (
+            "live scrape never observed non-zero fjt_fallback_records "
+            "during the outage"
+        )
+        snap = pipe.metrics.struct_snapshot()
+        g = snap.get("gauges", {})
+        state = g.get('failover_state{model="static"}', {}).get("value")
+        assert state == 0.0, (
+            f"circuit did not re-close (failover_state {state})"
+        )
+        c = snap.get("counters", {})
+        assert c.get("fallback_records", 0) > 0
+        assert c.get("redispatch_records", 0) > 0, (
+            "no redispatched records — the transient ladder never won"
+        )
+        assert c.get('device_fault_total{kind="device_error"}', 0) >= 7
+        covered = np.zeros(N, np.int64)
+        for off, n_rec in emitted:
+            covered[off: off + n_rec] += 1
+        assert (covered == 1).all(), (
+            f"loss/dup under device faults: "
+            f"lost={int((covered == 0).sum())} "
+            f"dup={int((covered > 1).sum())}"
+        )
+        offs = [o for o, _ in emitted]
+        assert offs == sorted(offs), "sink order violated under faults"
+    finally:
+        faults.clear()
+        if pipe is not None:
+            try:
+                pipe.stop()
+                pipe.join(timeout=10.0)
+            except Exception:
+                pass
+        if srv is not None:
+            srv.close()
+        for k, v in env_saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def check_fault_hooks_noop() -> None:
     """Fault harness zero-overhead contract: with FJT_FAULTS unset,
     fire() must be a global load + None check (≤ 2 µs even on a loaded
@@ -1158,6 +1339,8 @@ def main() -> int:
     print("perf-smoke: journey trace OK", flush=True)
     check_recovery_drill()
     print("perf-smoke: recovery drill OK", flush=True)
+    check_device_fault()
+    print("perf-smoke: device fault plane OK", flush=True)
     check_fault_hooks_noop()
     print("perf-smoke: fault hooks no-op OK", flush=True)
     timer.cancel()
