@@ -2459,6 +2459,397 @@ def run_device_fault_drill(
                   file=sys.stderr)
 
 
+def _ensure_virtual_mesh(min_devices: int = 4):
+    """Force-CPU plus a simulated multi-chip host for the mesh modes:
+    the virtual-device flag must land before the first backend init
+    (the same trick tests/conftest.py uses), so both mesh entrypoints
+    run before bench's own ``import jax``. → (jax, device_count)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n = jax.device_count()
+    assert n >= min_devices, (
+        f"mesh mode needs >= {min_devices} devices, found {n} — jax "
+        "initialized before the virtual-device flag could land "
+        "(set XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+    )
+    return jax, n
+
+
+def run_mesh_bench(
+    records: int = 40_000,
+    seed: int = 7,
+    batch: int = 512,
+    timeout_s: float = 300.0,
+) -> dict:
+    """``--mesh``: the per-chip scaling curve for the MULTICHIP
+    artifact. One production BlockPipeline per data-axis width w ∈
+    {1, 2, 4, 8} (capped at the device count) scores the SAME GBM over
+    a real Kafka stream with w partitions — each chip owns its
+    partitions via the rendezvous ChipAssignment (parallel/assignment)
+    and the batch splits across the data axis through
+    ShardedModel.shard_map dispatch. The line carries:
+
+    - ``curve``       — per-width {rec_per_s, per_chip_rec_per_s,
+      scaling_vs_1chip, per-chip record counts, partition ownership}
+    - ``fleet``       — the width runs' metrics structs merged under
+      the fleet rules (per-chip counters SUM, mesh_data_width MIN,
+      mesh_chip_state worst-of): the supervisor's merged view stays
+      exact at any mesh width.
+
+    On a CPU host every "chip" is the same silicon, so the curve is a
+    geometry capture (flat-to-falling), not a speedup claim — the
+    capture-gated v5e-8 run is where near-linear shows up (same
+    protocol as the PR 11/14 MULTICHIP rounds)."""
+    import threading
+
+    import numpy as np
+
+    _, n_dev = _ensure_virtual_mesh(4)
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.obs import mesh as mesh_obs
+    from flink_jpmml_tpu.parallel.mesh import make_mesh
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime.block import BlockPipeline
+    from flink_jpmml_tpu.runtime.kafka import (
+        KafkaBlockSource, MiniKafkaBroker,
+    )
+    from flink_jpmml_tpu.utils.config import (
+        BatchConfig, MeshConfig, RuntimeConfig,
+    )
+    from flink_jpmml_tpu.utils.metrics import (
+        MetricsRegistry, merge_structs,
+    )
+
+    t0 = time.monotonic()
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix="fjt-meshbench-")
+    widths = [w for w in (1, 2, 4, 8) if w <= n_dev]
+    curve = []
+    snaps = []
+    try:
+        pmml = gen_gbm(tmp, n_trees=6, depth=3, n_features=6)
+        doc = parse_pmml_file(pmml)
+        cm = compile_pmml(doc, batch_size=batch)
+        data = rng.normal(0, 1.2, size=(records, 6)).astype(np.float32)
+
+        for w in widths:
+            # scaling-curve geometry: width w deliberately uses a
+            # SUBSET mesh (the remaining chips idle) — that is the
+            # point of the curve, not a throughput bug
+            mesh = (
+                make_mesh(MeshConfig(data=w, model=1),
+                          allow_subset=True)
+                if w > 1 else None
+            )
+            m = MetricsRegistry()
+            # 2 partitions per chip (w > 1): rendezvous ownership
+            # spreads far better over-partitioned, exactly like a real
+            # Kafka topic sized above its consumer count
+            n_parts = 2 * w if w > 1 else 1
+            broker = MiniKafkaBroker(topic="mesh", n_partitions=n_parts)
+            broker.append_rows_round_robin(data)
+            src = KafkaBlockSource(
+                broker.host, broker.port, "mesh",
+                partitions=list(range(n_parts)), n_cols=6,
+                max_wait_ms=20, metrics=m,
+            )
+            rows = []
+            lock = threading.Lock()
+
+            def sink(o, n, first_off, rows=rows, lock=lock):
+                with lock:
+                    rows.append((time.monotonic(), n))
+
+            pipe = BlockPipeline(
+                src, cm, sink,
+                RuntimeConfig(batch=BatchConfig(
+                    size=batch, deadline_us=5000, queue_capacity=8192,
+                )),
+                metrics=m, max_dispatch_chunks=4, mesh=mesh,
+            )
+            pipe.start()
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                with lock:
+                    total = sum(n for _, n in rows)
+                if total >= records or pipe._error is not None:
+                    break
+                time.sleep(0.02)
+            pipe.stop()
+            pipe.join(timeout=30.0)
+            src.close()
+            broker.close()
+            assert pipe._error is None, (
+                f"width {w} pipeline died: {pipe._error!r}"
+            )
+            assert len(rows) >= 2, f"width {w} drained {len(rows)} batches"
+            # rate over steady state: the first sunk batch absorbs the
+            # shard_map compile + window fill, so timing starts there
+            warm_t = rows[0][0]
+            steady = sum(n for t, n in rows[1:])
+            elapsed = max(rows[-1][0] - warm_t, 1e-9)
+            rate = steady / elapsed
+            snap = m.struct_snapshot()
+            snaps.append(snap)
+            msum = mesh_obs.summary(snap)
+            model = pipe._bound.model
+            owner = {}
+            if getattr(model, "assignment", None) is not None:
+                owner = {
+                    str(c): list(model.assignment.partitions_for(c))
+                    for c in model.assignment.chips
+                }
+            curve.append({
+                "data_width": w,
+                "rec_per_s": round(rate, 1),
+                "per_chip_rec_per_s": round(rate / w, 1),
+                "in_flight": pipe._in_flight_max,
+                "chip_records": (
+                    {c: round(v["records"], 1)
+                     for c, v in msum["chips"].items()}
+                    if msum else {}
+                ),
+                "chip_partitions": owner,
+            })
+        base = curve[0]["rec_per_s"] or 1.0
+        for entry in curve:
+            entry["scaling_vs_1chip"] = round(
+                entry["rec_per_s"] / (base * entry["data_width"]), 3
+            )
+        fleet = merge_structs(snaps)
+        fg, fc = fleet.get("gauges", {}), fleet.get("counters", {})
+        fleet_line = {
+            "workers": len(snaps),
+            "mesh_chip_records": {
+                k.split('"')[1]: round(float(v), 1)
+                for k, v in fc.items()
+                if k.startswith("mesh_chip_records{")
+            },
+            # MIN-merged: the most-degraded worker's surviving width
+            "mesh_data_width": (
+                fg.get("mesh_data_width", {}) or {}
+            ).get("value"),
+            "records_out": float(fc.get("records_out", 0.0)),
+        }
+        import jax
+
+        return {
+            "metric": "mesh_scaling",
+            "ok": True,
+            "backend": jax.default_backend(),
+            "devices": n_dev,
+            "batch": batch,
+            "records_per_width": int(records),
+            "curve": curve,
+            "fleet": fleet_line,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_mesh_fault_drill(
+    records: int = 24_000,
+    seed: int = 11,
+    batch: int = 512,
+    timeout_s: float = 300.0,
+) -> dict:
+    """``--device-fault-drill --mesh``: chip loss ON the mesh hot path.
+    A mesh-sharded BlockPipeline (data=4) scores a Kafka stream; at
+    half-stream an injected ``chip_loss`` lands at the real readback
+    site. The KIND_LOST rung (runtime/block.py) must rebuild over the
+    surviving chips IN PLACE (``ShardedModel.without_devices`` — no
+    process restart, no supervisor) and keep serving degraded:
+
+    - zero record loss and zero duplication (no restart ⇒ no replay);
+    - the DLQ stays EMPTY (a dead chip never quarantines records);
+    - exactly one mesh rebuild, surviving width N−1, dead chip flagged
+      ``mesh_chip_state`` = lost;
+    - steady-state degraded throughput ≥ (N−1)/N of the pre-loss rate
+      (the rebuild stall itself is reported separately, not smeared
+      into the steady-state rate)."""
+    import threading
+
+    import numpy as np
+
+    _, n_dev = _ensure_virtual_mesh(4)
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.obs import mesh as mesh_obs
+    from flink_jpmml_tpu.parallel.mesh import make_mesh
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime import faults
+    from flink_jpmml_tpu.runtime.block import BlockPipeline
+    from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+    from flink_jpmml_tpu.runtime.kafka import (
+        KafkaBlockSource, MiniKafkaBroker,
+    )
+    from flink_jpmml_tpu.utils.config import (
+        BatchConfig, MeshConfig, RuntimeConfig,
+    )
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+    t0 = time.monotonic()
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix="fjt-meshfault-")
+    data_w = 4
+    model_w = 2 if n_dev >= 8 else 1
+    half = (records // 2 // batch) * batch
+    broker = None
+    src = None
+    pipe = None
+    ok = False
+    try:
+        pmml = gen_gbm(tmp, n_trees=6, depth=3, n_features=6)
+        cm = compile_pmml(parse_pmml_file(pmml), batch_size=batch)
+        mesh = make_mesh(MeshConfig(data=data_w, model=model_w))
+        data = rng.normal(0, 1.2, size=(records, 6)).astype(np.float32)
+
+        m = MetricsRegistry()
+        dlq = DeadLetterQueue(os.path.join(tmp, "dlq"), metrics=m)
+        broker = MiniKafkaBroker(topic="meshfault")
+        broker.append_rows(data[:half])
+        src = KafkaBlockSource(
+            broker.host, broker.port, "meshfault", n_cols=6,
+            max_wait_ms=20, metrics=m, dlq=dlq,
+        )
+        rows = []
+        lock = threading.Lock()
+
+        def sink(o, n, first_off):
+            with lock:
+                rows.append((time.monotonic(), first_off, n))
+
+        pipe = BlockPipeline(
+            src, cm, sink,
+            RuntimeConfig(batch=BatchConfig(
+                size=batch, deadline_us=5000, queue_capacity=8192,
+            )),
+            metrics=m, max_dispatch_chunks=4, dlq=dlq, mesh=mesh,
+        )
+
+        def total():
+            with lock:
+                return sum(n for _, _, n in rows)
+
+        def wait_total(target, deadline):
+            while time.monotonic() < deadline:
+                if total() >= target or pipe._error is not None:
+                    return
+                time.sleep(0.02)
+
+        pipe.start()
+        wait_total(half, time.monotonic() + timeout_s)
+        assert pipe._error is None, f"pre-loss error: {pipe._error!r}"
+        assert total() >= half, "pre-loss phase never drained"
+        t_kill = time.monotonic()
+        # the chip dies at the REAL readback site of the next dispatch
+        faults.inject("chip_loss", n=1)
+        broker.append_rows(data[half:])
+        wait_total(records, time.monotonic() + timeout_s)
+        pipe.stop()
+        pipe.join(timeout=30.0)
+        assert pipe._error is None, f"post-loss error: {pipe._error!r}"
+
+        # ---- verification -------------------------------------------
+        with lock:
+            emitted = list(rows)
+        covered = np.zeros(records, np.int64)
+        for _, off, n in emitted:
+            covered[off: off + n] += 1
+        lost_offs = np.flatnonzero(covered == 0)
+        assert lost_offs.size == 0, (
+            f"record loss at offsets {lost_offs[:10].tolist()}"
+        )
+        assert int(covered.max()) == 1, (
+            f"duplication without a restart (max {int(covered.max())})"
+        )
+        assert sorted(set(dlq.offsets())) == [], (
+            "chip loss quarantined clean records"
+        )
+        assert faults.stats().get("chip_loss", 0) == 1, (
+            "the injected chip loss never fired"
+        )
+        snap = m.struct_snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        assert c.get("mesh_rebuilds", 0) >= 1, "no mesh rebuild ran"
+        width = (g.get("mesh_data_width", {}) or {}).get("value")
+        assert width == float(data_w - 1), (
+            f"surviving width {width}, expected {data_w - 1}"
+        )
+        msum = mesh_obs.summary(snap)
+        assert msum is not None
+        lost_chips = [
+            chip for chip, v in msum["chips"].items()
+            if v["state"] == "lost"
+        ]
+        assert len(lost_chips) == 1, (
+            f"expected exactly one lost chip, saw {lost_chips}"
+        )
+        # throughput: steady-state degraded rate vs pre-loss rate. The
+        # first post-loss emission carries the rebuild (re-jit on the
+        # degraded mesh) — that stall is reported, not averaged in.
+        pre = [(t, n) for t, _, n in emitted if t <= t_kill]
+        post = [(t, n) for t, _, n in emitted if t > t_kill]
+        assert len(pre) >= 3 and len(post) >= 3, (
+            f"too few batches to rate ({len(pre)} pre / {len(post)} post)"
+        )
+        pre_rate = (
+            sum(n for _, n in pre[1:])
+            / max(pre[-1][0] - pre[0][0], 1e-9)
+        )
+        rebuild_stall_s = post[0][0] - t_kill
+        post_rate = (
+            sum(n for _, n in post[2:])
+            / max(post[-1][0] - post[1][0], 1e-9)
+        )
+        floor = (data_w - 1) / data_w
+        assert post_rate >= floor * pre_rate, (
+            f"degraded rate {post_rate:.0f} rec/s under the "
+            f"{floor:.2f}x floor of pre-loss {pre_rate:.0f} rec/s"
+        )
+        ok = True
+        return {
+            "metric": "mesh_device_fault_drill",
+            "ok": True,
+            "devices": n_dev,
+            "mesh": {"data": data_w, "model": model_w},
+            "records": int(records),
+            "records_lost": 0,
+            "duplicates": 0,
+            "dlq_empty": True,
+            "mesh_rebuilds": int(c.get("mesh_rebuilds", 0)),
+            "surviving_width": int(width),
+            "lost_chips": lost_chips,
+            "pre_rate_rec_s": round(pre_rate, 1),
+            "post_rate_rec_s": round(post_rate, 1),
+            "degraded_ratio": round(post_rate / max(pre_rate, 1e-9), 3),
+            "rebuild_stall_s": round(rebuild_stall_s, 3),
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+    finally:
+        faults.clear()
+        if pipe is not None:
+            pipe.stop()
+            pipe.join(timeout=10.0)
+        if src is not None:
+            src.close()
+        if broker is not None:
+            broker.close()
+        if ok:
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            print(f"[mesh-fault-drill] artifacts kept at {tmp}",
+                  file=sys.stderr)
+
+
 _RECOVERY_WORKER = r'''
 import os, sys, time
 # per-incarnation fault seed BEFORE the package imports (env faults arm
@@ -3109,6 +3500,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--no-fallback-kill", action="store_true",
                     help="skip the SIGKILL-during-fallback phase of "
                          "the device-fault drill")
+    ap.add_argument("--mesh", action="store_true",
+                    help="multichip mode: alone, run the per-chip "
+                         "scaling-curve bench (one mesh-sharded "
+                         "BlockPipeline per data-axis width over a "
+                         "partitioned Kafka stream, fleet-merged "
+                         "metrics) for the MULTICHIP artifact; "
+                         "combined with --device-fault-drill, run the "
+                         "on-mesh chip-loss drill (in-place "
+                         "without_devices rebuild, zero loss, empty "
+                         "DLQ, >=(N-1)/N degraded throughput). Both "
+                         "force CPU with a simulated 8-device host "
+                         "when no mesh hardware is present")
+    ap.add_argument("--mesh-records", type=int, default=40_000,
+                    help="records per width the mesh bench streams")
     return ap
 
 
@@ -3170,6 +3575,38 @@ def main() -> None:
         except AssertionError as e:
             print(json.dumps({
                 "metric": "recovery_drill", "ok": False, "error": str(e),
+            }))
+            sys.exit(1)
+        print(json.dumps(line))
+        return
+
+    if args.device_fault_drill and args.mesh:
+        # chip loss ON the mesh hot path: in-process (the loss is
+        # survivable now — the KIND_LOST rung rebuilds in place, so no
+        # supervisor choreography is needed), forced-CPU with a
+        # simulated multi-chip host
+        try:
+            line = run_mesh_fault_drill(
+                records=args.device_fault_records,
+            )
+        except AssertionError as e:
+            print(json.dumps({
+                "metric": "mesh_device_fault_drill", "ok": False,
+                "error": str(e),
+            }))
+            sys.exit(1)
+        print(json.dumps(line))
+        return
+
+    if args.mesh:
+        # per-chip scaling capture for the MULTICHIP artifact: runs
+        # end-to-end on a CPU host via the simulated 8-device mesh;
+        # the capture-gated v5e-8 run uses the same entrypoint
+        try:
+            line = run_mesh_bench(records=args.mesh_records)
+        except AssertionError as e:
+            print(json.dumps({
+                "metric": "mesh_scaling", "ok": False, "error": str(e),
             }))
             sys.exit(1)
         print(json.dumps(line))

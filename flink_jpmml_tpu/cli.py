@@ -730,6 +730,45 @@ def _top_render_failover(label: str, struct: dict, out,
               "(ids ride device_fault flight events)", file=out)
 
 
+def _top_render_mesh(label: str, struct: dict, out) -> None:
+    """The ``--mesh`` panel: per-chip serving telemetry (obs/mesh.py)
+    as one operator view — rec/s, cumulative records, in-flight window
+    depth, and health state per chip, plus the surviving data-axis
+    width and the degraded-mesh rebuild count. On a fleet struct the
+    per-chip counters arrive SUM-merged and ``mesh_data_width``
+    MIN-merged (the most-degraded worker), per the catalogue rules."""
+    from flink_jpmml_tpu.obs import mesh as mesh_mod
+
+    title = label or "aggregate"
+    print(f"== {title} · mesh ==", file=out)
+    s = mesh_mod.summary(struct)
+    if not s:
+        print("(no mesh telemetry recorded — single-chip serving)",
+              file=out)
+        return
+    print(f"{'chip':<10}{'rec/s':>12}{'records':>14}{'in-flight':>11}"
+          f"{'state':>10}", file=out)
+    for chip, v in s["chips"].items():
+        rate = v.get("rec_per_s")
+        print(
+            f"{chip:<10}"
+            f"{(f'{rate:,.0f}' if rate is not None else '-'):>12}"
+            f"{v['records']:>14,.0f}"
+            f"{v['inflight']:>11,.0f}"
+            f"{v['state']:>10}",
+            file=out,
+        )
+    width = s.get("data_width")
+    if width is not None:
+        print(f"data width {width:.0f} surviving chip(s)", file=out)
+    if s.get("rebuilds"):
+        print(f"rebuilds   {s['rebuilds']:,.0f} degraded-mesh "
+              "rebuild(s)", file=out)
+    if s.get("lost_devices"):
+        print(f"lost       {s['lost_devices']:.0f} device(s) retired "
+              "(degraded-mesh mode)", file=out)
+
+
 def top_main(argv: Optional[List[str]] = None) -> int:
     """``fjt-top``: the fleet attribution table (see module docstring).
     Renders every labelled source (the supervisor's /varz serves the
@@ -766,6 +805,11 @@ def top_main(argv: Optional[List[str]] = None) -> int:
                          "share, redispatch/OOM-shrink counts, device "
                          "fault taxonomy, checkpoint suspension) "
                          "instead of the stage table")
+    ap.add_argument("--mesh", action="store_true",
+                    help="render the multichip panel (per-chip rec/s, "
+                         "in-flight depth, health state, surviving "
+                         "data width, degraded-mesh rebuilds) instead "
+                         "of the stage table")
     ap.add_argument("--watch", type=float, default=None, metavar="N",
                     help="re-render every N seconds from a live source "
                          "(operator console mode; mid-watch fetch "
@@ -774,15 +818,16 @@ def top_main(argv: Optional[List[str]] = None) -> int:
     if args.watch is not None and args.watch <= 0:
         raise SystemExit(f"--watch must be > 0, got {args.watch}")
     if sum((args.freshness, args.overload, args.drift,
-            args.failover)) > 1:
+            args.failover, args.mesh)) > 1:
         raise SystemExit(
-            "--freshness, --overload, --drift, and --failover are "
-            "exclusive"
+            "--freshness, --overload, --drift, --failover, and "
+            "--mesh are exclusive"
         )
     render = (
         _top_render_freshness if args.freshness
         else _top_render_overload if args.overload
         else _top_render_drift if args.drift
+        else _top_render_mesh if args.mesh
         else (
             lambda label, struct, out: _top_render_failover(
                 label, struct, out, source=args.source

@@ -46,6 +46,27 @@ def stable_hash(key: Any) -> int:
     return zlib.crc32(data)
 
 
+def rendezvous_pick(key: Any, lanes: Sequence[Any]) -> Any:
+    """Highest-random-weight (rendezvous) choice of one lane for ``key``.
+
+    Unlike ``stable_hash(key) % n``, removing a lane moves ONLY the keys
+    that mapped to the removed lane — every other key keeps its lane.
+    That is exactly the degraded-mesh contract: a chip loss re-homes the
+    dead chip's keys/partitions onto survivors without reshuffling the
+    healthy chips' work (per-key ordering and canary splits stay put).
+    Deterministic across processes (rides :func:`stable_hash`); ties
+    break on the lane value itself so every host agrees."""
+    if not lanes:
+        raise ValueError("rendezvous_pick needs at least one lane")
+    best = None
+    best_w = -1
+    for lane in lanes:
+        w = stable_hash((key, lane))
+        if w > best_w or (w == best_w and str(lane) < str(best)):
+            best, best_w = lane, w
+    return best
+
+
 class HashPartitioner:
     """Assigns records to ``n_lanes`` by stable key hash (Flink keyBy
     parity). ``partition`` returns per-record lane ids; ``split`` groups a

@@ -56,10 +56,40 @@ class ShardedModel:
     # names of param leaves sharded over the model axis ("" = none):
     # observability for tests/dryruns asserting the TP path is real
     tp_sharded_leaves: tuple = ()
+    # hot-path serving state carried THROUGH a degraded-mesh rebuild
+    # (ISSUE 16 satellite: callers used to re-derive both by hand):
+    # - dispatch_state: the dispatcher/window geometry the pipelines
+    #   configured (in-flight depth, donation, staging knobs) — opaque
+    #   dict, copied verbatim onto the rebuilt model;
+    # - assignment: the ChipAssignment (parallel/assignment.py) mapping
+    #   kafka partitions / record keys to chips — re-balanced with
+    #   ``.without(lost)`` so only the dead chip's work moves.
+    dispatch_state: Optional[dict] = None
+    assignment: object = None
 
     @property
     def batch_divisor(self) -> int:
         return self.mesh.shape[DATA_AXIS]
+
+    def in_flight_depth(self, base_depth: int) -> int:
+        """Mesh-aware in-flight window: the carried dispatch_state's
+        depth when one was configured, else the data-width heuristic
+        (parallel/assignment.mesh_in_flight)."""
+        from flink_jpmml_tpu.parallel.assignment import mesh_in_flight
+
+        ds = self.dispatch_state or {}
+        if "in_flight" in ds:
+            return int(ds["in_flight"])
+        return mesh_in_flight(self.mesh, base_depth)
+
+    def with_dispatch_state(self, **kv) -> "ShardedModel":
+        """Attach/merge dispatcher-window state (returns self — the
+        pipelines call this at bind time; dataclass stays mutable by
+        design, mirroring how _params_sharded is owned)."""
+        ds = dict(self.dispatch_state or {})
+        ds.update(kv)
+        self.dispatch_state = ds
+        return self
 
     def predict(self, X, M) -> ModelOutput:
         if X.shape[0] % self.batch_divisor != 0:
@@ -169,12 +199,24 @@ class ShardedModel:
         survivors from the host copy, the batch divisor shrinks, and
         the scoring contract is unchanged. TP sharding is preserved
         when the survivor count still honours the model axis
-        (:func:`degraded_mesh`)."""
+        (:func:`degraded_mesh`).
+
+        Serving state CARRIES THROUGH the rebuild: the dispatcher/
+        window geometry (``dispatch_state``) copies verbatim, and the
+        partition/key assignment re-balances via ``assignment
+        .without(lost)`` — only the dead chip's partitions and keys
+        move (rendezvous hashing), so healthy chips keep their kafka
+        partitions and canary slices with zero re-derivation by the
+        caller."""
         new_mesh = degraded_mesh(self.mesh, lost)
         if self.tp_sharded_leaves:
             rebuilt = mesh_sharded(self.base, new_mesh)
         else:
             rebuilt = dp_sharded(self.base, new_mesh)
+        if self.dispatch_state is not None:
+            rebuilt.dispatch_state = dict(self.dispatch_state)
+        if self.assignment is not None:
+            rebuilt.assignment = self.assignment.without(lost)
         flight.record(
             "mesh_degraded",
             lost=[str(getattr(d, "id", d)) for d in lost],

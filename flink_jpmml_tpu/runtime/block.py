@@ -55,7 +55,10 @@ from flink_jpmml_tpu.runtime.pipeline import (
     filter_donate_warning,
 )
 from flink_jpmml_tpu.utils.config import RuntimeConfig
-from flink_jpmml_tpu.utils.exceptions import InputValidationException
+from flink_jpmml_tpu.utils.exceptions import (
+    FlinkJpmmlTpuError,
+    InputValidationException,
+)
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 
 
@@ -438,6 +441,10 @@ class BlockPipelineBase:
         # 1 while scoring in suspect mode (fleet merge: worst-of — one
         # worker bisecting poison flags the fleet)
         self._suspect_gauge = self.metrics.gauge("poison_suspect_mode")
+        # per-chip mesh telemetry (obs/mesh.MeshTelemetry), attached by
+        # the subclass when the bound model is mesh-sharded; None keeps
+        # the single-chip hot path at one attribute test per batch
+        self._mesh_obs = None
 
     @property
     def native(self) -> bool:
@@ -745,13 +752,20 @@ class BlockPipelineBase:
         convention on this path; one isnan pass builds the mask (any()
         on bools is cheap), not a scan-then-rescan."""
         B = model.batch_size
+        # a mesh-sharded model's data axis must divide the dispatch: a
+        # degraded-mesh rebuild can leave a divisor that no longer
+        # divides B (or an aggregated multiple of B), so the pad target
+        # rounds up to the divisor — single-chip models (divisor 1)
+        # keep the exact historical pad-to-B geometry
+        target = max(B, n)
+        target += (-target) % getattr(model, "batch_divisor", 1)
         Mb = np.isnan(X)
         if Mb.any():
             Xb = np.where(Mb, 0.0, X).astype(np.float32)
         else:
             Xb, Mb = X, _ZEROS_M.get(n, self._arity)
-        if n < B:
-            Xb, Mb, _ = prepare.pad_batch(Xb, Mb, B)
+        if n < target:
+            Xb, Mb, _ = prepare.pad_batch(Xb, Mb, target)
         if Xb is X:
             # a full, NaN-free batch reaches here still aliasing the
             # ring's reuse buffer; jax's CPU backend can zero-copy that
@@ -860,11 +874,8 @@ class BlockPipelineBase:
         key = getattr(handle, "key", None) or "default"
         plane.note_fault(kind, key, first_off=first, n=n, error=error)
         if kind == devfault.KIND_LOST:
-            flight.record(
-                "device_lost_escalate", model=key, first=first, n=n,
-                error=repr(error),
-            )
-            raise error
+            self._lost_recover(handle, X, offsets, error, ctx=ctx)
+            return
         breaker = plane.breaker_for(key)
         breaker.record_failure(kind)
         if kind == devfault.KIND_OOM:
@@ -893,11 +904,8 @@ class BlockPipelineBase:
                     raise
                 plane.note_fault(k2, key, first_off=first, n=n, error=e2)
                 if k2 == devfault.KIND_LOST:
-                    flight.record(
-                        "device_lost_escalate", model=key, first=first,
-                        n=n, error=repr(e2),
-                    )
-                    raise e2
+                    self._lost_recover(handle, X, offsets, e2, ctx=ctx)
+                    return
                 breaker.record_failure(k2)
                 if k2 == devfault.KIND_OOM:
                     self._oom_recover(handle, X, offsets, e2, ctx=ctx)
@@ -917,6 +925,114 @@ class BlockPipelineBase:
             self._serve_fallback(handle, X, offsets, jctx=ctx)
             return
         raise error
+
+    def _lost_recover(self, handle, X, offsets, error, ctx=None) -> None:
+        """The KIND_LOST rung of the ladder, mesh-aware: a sharded
+        model rebuilds over the surviving chips in place
+        (``ShardedModel.without_devices`` — dispatcher state and the
+        partition/key assignment carry through) and the retained batch
+        redispatches synchronously on the degraded mesh: zero loss,
+        (N−1)/N capacity, no process restart. A single-chip model (or
+        an unsurvivable mesh) keeps the historical contract — escalate
+        to the supervisor via the raise."""
+        plane = self._failover
+        n = int(X.shape[0])
+        first = int(offsets[0])
+        key = getattr(handle, "key", None) or "default"
+        rebuilt = self._mesh_rebuild(handle, error)
+        if rebuilt is None:
+            flight.record(
+                "device_lost_escalate", model=key, first=first, n=n,
+                error=repr(error),
+            )
+            raise error
+        try:
+            out, decode = self._redispatch_sync(handle, X, n, offsets)
+        except Exception as e2:
+            k2 = devfault.classify(e2)
+            if k2 is None:
+                # the chip loss cleared and a RECORD error surfaced
+                # underneath: poison's jurisdiction
+                if self._dlq is not None:
+                    self._suspect_scan(
+                        handle, X, offsets, error=e2, ctx=ctx
+                    )
+                    return
+                raise
+            # the degraded mesh is live but THIS dispatch failed again:
+            # re-enter the ladder from the top (another KIND_LOST
+            # shrinks once more — bounded, without_devices raises once
+            # no full data row survives)
+            self._device_recover(handle, X, offsets, e2, k2, ctx=ctx)
+            return
+        plane.redispatch_records.inc(n)
+        flight.record(
+            "mesh_rebuild_redispatch", model=key, first=first, n=n,
+            data=rebuilt.batch_divisor,
+        )
+        self._emit_recovered(out, decode, offsets, 0, n, ctx=ctx)
+
+    def _mesh_rebuild(self, handle, error):
+        """Chip loss on a mesh-sharded model: rebuild over the
+        survivors and adopt the rebuilt model into the live scoring
+        handle → the rebuilt :class:`ShardedModel`, or None when there
+        is no mesh to shrink (single-chip model, one data row left) or
+        no survivable rebuild."""
+        model = getattr(handle, "model", None)
+        if not hasattr(model, "without_devices"):
+            return None
+        lost = self._lost_devices(model, error)
+        if not lost:
+            return None
+        try:
+            rebuilt = model.without_devices(lost)
+        except FlinkJpmmlTpuError:
+            return None  # unsurvivable: escalate like a single chip
+        self._adopt_rebuilt(handle, rebuilt)
+        self.metrics.counter("mesh_rebuilds").inc()
+        self.metrics.gauge("mesh_lost_devices").set(float(len(lost)))
+        if self._mesh_obs is not None:
+            self._mesh_obs.note_rebuild(rebuilt, lost)
+        flight.record(
+            "mesh_rebuild",
+            lost=[str(getattr(d, "id", d)) for d in lost],
+            data=rebuilt.batch_divisor,
+        )
+        return rebuilt
+
+    def _lost_devices(self, model, error) -> list:
+        """Which device(s) died. The runtime rarely names the chip in
+        the raised error (XLA's loss surfaces as a bare UNAVAILABLE),
+        so: an explicit ``error.devices``/``error.device`` attribute
+        wins; otherwise the LAST data row of the mesh is retired —
+        retiring any one full row restores (N−1)/N capacity with the
+        model axis intact, and last-row is the choice every process
+        derives identically with no coordination (row identity — the
+        first device of each surviving row — is what the carried
+        ChipAssignment's rendezvous weights key on, so survivor rows
+        keep their partitions and keys)."""
+        dev = getattr(error, "devices", None)
+        if dev is None:
+            dev = getattr(error, "device", None)
+        if dev is not None:
+            if isinstance(dev, (list, tuple, set, frozenset)):
+                return list(dev)
+            return [dev]
+        mesh = getattr(model, "mesh", None)
+        if mesh is None:
+            return []
+        from flink_jpmml_tpu.parallel.mesh import DATA_AXIS
+
+        rows = mesh.devices.reshape(mesh.shape[DATA_AXIS], -1)
+        if rows.shape[0] <= 1:
+            return []  # one data row left: nothing to shrink onto
+        return list(rows[-1])
+
+    def _adopt_rebuilt(self, handle, rebuilt) -> None:
+        """Swap the rebuilt model into the live scoring handle (the
+        BoundScorer's decode closure follows ``handle.model``, so the
+        sink path needs no rebind)."""
+        handle.model = rebuilt
 
     def _oom_recover(self, handle, X, offsets, error, ctx=None) -> None:
         """Device-OOM ladder step: bisect the BATCH SIZE until runs
@@ -950,11 +1066,10 @@ class BlockPipelineBase:
                     error=e2,
                 )
                 if k2 == devfault.KIND_LOST:
-                    flight.record(
-                        "device_lost_escalate", model=key,
-                        first=int(offsets[lo]), n=size, error=repr(e2),
+                    self._lost_recover(
+                        handle, X[lo:hi], offsets[lo:hi], e2, ctx=ctx
                     )
-                    raise e2
+                    return
                 plane.breaker_for(key).record_failure(k2)
                 if size == 1:
                     # one record alone exceeds the device: the host
@@ -1352,6 +1467,11 @@ class BlockPipelineBase:
                 )
             lat.observe(t_done - t_start)
             records_out.inc(n)
+            if self._mesh_obs is not None:
+                # per-chip accounting (obs/mesh.py): one call per BATCH
+                # — a data-parallel dispatch spans every chip equally,
+                # so the split is arithmetic, not a per-record loop
+                self._mesh_obs.note_batch(n, len(disp))
             if self._failover is not None:
                 # green completion: clears strike streaks / counts a
                 # half-open probe (a dict miss while no breaker exists)
@@ -1669,12 +1789,45 @@ class BlockPipeline(BlockPipelineBase):
         dlq=None,
         prefetch: Optional[bool] = None,
         failover=None,
+        mesh=None,
     ):
         if model.batch_size is None:
             raise InputValidationException(
                 "BlockPipeline needs a fixed-batch compiled model "
                 "(compile_pmml(batch_size=...))"
             )
+        if mesh is not None and not hasattr(model, "without_devices"):
+            # promote the compiled model onto the mesh (ROADMAP item
+            # 1): batch sharded over the data axis, wide params TP-
+            # sharded over the model axis — the scoring contract and
+            # the sink shape are unchanged (ShardedModel proxies the
+            # CompiledModel surface). An already-sharded model passes
+            # through untouched.
+            from flink_jpmml_tpu.parallel.mesh import DATA_AXIS
+            from flink_jpmml_tpu.parallel.sharding import mesh_sharded
+
+            n_data = int(mesh.shape.get(DATA_AXIS, 1))
+            if model.batch_size % max(n_data, 1) != 0:
+                raise InputValidationException(
+                    f"batch_size {model.batch_size} must divide by the "
+                    f"mesh data-axis size {n_data}"
+                )
+            model = mesh_sharded(model, mesh)
+        if hasattr(model, "in_flight_depth"):
+            # mesh-aware in-flight window: deep enough to cover the
+            # data rows (parallel/assignment.mesh_in_flight), recorded
+            # as carried dispatch state so a degraded-mesh rebuild
+            # keeps the window geometry without re-derivation
+            in_flight = model.in_flight_depth(in_flight)
+            model.with_dispatch_state(in_flight=in_flight)
+            if getattr(model, "assignment", None) is None:
+                from flink_jpmml_tpu.parallel.assignment import (
+                    assignment_for,
+                )
+
+                model.assignment = assignment_for(
+                    model.mesh, getattr(source, "partitions", ()) or ()
+                )
         super().__init__(
             source=source,
             sink=sink,
@@ -1698,6 +1851,10 @@ class BlockPipeline(BlockPipelineBase):
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend
         self.metrics.counter(f"scorer_backend_{self.backend}").inc()
+        if hasattr(model, "batch_divisor"):
+            from flink_jpmml_tpu.obs import mesh as mesh_obs
+
+            self._mesh_obs = mesh_obs.telemetry_for(self.metrics, model)
 
     def decode(self, out, n: int):
         """Sink-received raw output → ``Prediction`` list (host-side)."""

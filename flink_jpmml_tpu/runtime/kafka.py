@@ -1487,6 +1487,13 @@ class _KafkaSourceBase:
         return len(self._parts) > 1
 
     @property
+    def partitions(self) -> Tuple[int, ...]:
+        """The partition set this source drains — the mesh ingest
+        split (parallel/assignment.ChipAssignment) reads it to attach
+        per-chip partition ownership."""
+        return self._parts
+
+    @property
     def _vector_mode(self) -> bool:
         return self._multi and not self._strict
 
@@ -1967,6 +1974,42 @@ class KafkaBlockSource(_KafkaSourceBase, BlockSource):
         self._stamp_ingest(first, rows.shape[0])
         self._journey_ingest(first, rows.shape[0])
         return first, rows
+
+
+def chip_block_sources(
+    assignment,
+    host: str,
+    port: int,
+    topic: str,
+    *,
+    n_cols: int,
+    metrics=None,
+    **kw,
+) -> dict:
+    """One :class:`KafkaBlockSource` per mesh chip, each draining
+    exactly the partitions the rendezvous assignment
+    (parallel/assignment.ChipAssignment) owns it — the mesh ingest
+    split: each chip's pipeline fetches only its own partitions, so
+    ingest bandwidth scales with the data width instead of funneling
+    every partition through one consumer. Chips owning no partition
+    are omitted (fewer partitions than chips). Ownership is key-stable:
+    after a degraded-mesh resize only the dead chip's partitions
+    re-home (``assignment.without``), so the surviving chips' sources —
+    and their per-partition checkpoint cursors — remain valid as-is.
+
+    → ``{chip: KafkaBlockSource}``; extra kwargs pass through to the
+    source (``dlq=``, ``interleave=``, ...)."""
+    sources = {}
+    for chip in assignment.chips:
+        parts = assignment.partitions_for(chip)
+        if not parts:
+            continue
+        sources[chip] = KafkaBlockSource(
+            host, port, topic,
+            partitions=list(parts),
+            n_cols=n_cols, metrics=metrics, **kw,
+        )
+    return sources
 
 
 # ---------------------------------------------------------------------------

@@ -114,6 +114,12 @@ class DynamicBlockPipeline(BlockPipelineBase):
                     f"data-axis size {n_data} (sharded dispatch pads to "
                     "the batch, which must split evenly across devices)"
                 )
+            # mesh-aware in-flight window: deep enough to cover the
+            # data rows (parallel/assignment.mesh_in_flight); the
+            # single-chip depth is untouched when data=1
+            from flink_jpmml_tpu.parallel.assignment import mesh_in_flight
+
+            in_flight = mesh_in_flight(mesh, in_flight)
         super().__init__(
             source=source,
             sink=sink,
@@ -247,6 +253,26 @@ class DynamicBlockPipeline(BlockPipelineBase):
         # nothing pins superseded models (in-flight batches hold their
         # own decode references until sunk; the registry owns the rest)
         bound = BoundScorer(best_mid.key(), best_model, self._use_quantized)
+        if hasattr(best_model, "with_dispatch_state"):
+            # sharded serving: record the window geometry + partition
+            # ownership on the adopted model so a degraded-mesh rebuild
+            # carries both (ShardedModel.without_devices), and arm the
+            # per-chip telemetry for the adopted mesh
+            best_model.with_dispatch_state(in_flight=self._in_flight_max)
+            if getattr(best_model, "assignment", None) is None:
+                from flink_jpmml_tpu.parallel.assignment import (
+                    assignment_for,
+                )
+
+                best_model.assignment = assignment_for(
+                    best_model.mesh,
+                    getattr(self._source, "partitions", None) or (),
+                )
+            from flink_jpmml_tpu.obs import mesh as mesh_obs
+
+            self._mesh_obs = mesh_obs.telemetry_for(
+                self.metrics, best_model
+            )
         self._current = bound
         self.swaps.inc()
         self.metrics.counter(f"scorer_backend_{bound.backend}").inc()
@@ -280,6 +306,13 @@ class DynamicBlockPipeline(BlockPipelineBase):
 
     def _dispatch(self, bound, X, n):
         return self._dispatch_bound(bound, X, n), bound.decode
+
+    def _adopt_rebuilt(self, handle, rebuilt) -> None:
+        # degraded-mesh rebuild (runtime/block.py KIND_LOST rung): the
+        # registry's compiled instance must follow, or the next
+        # latest-wins re-adoption would swap the dead mesh back in
+        super()._adopt_rebuilt(handle, rebuilt)
+        self.registry.adopt_rebuilt(handle.key, rebuilt)
 
     def _fallback_dispatch(self, bound, X, n):
         # host-tier output decodes through the SAME bound decode (the

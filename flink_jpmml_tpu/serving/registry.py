@@ -408,6 +408,21 @@ class ModelRegistry:
                 self._compiled[mid] = compiled
         return compiled
 
+    def adopt_rebuilt(self, mid_key: str, rebuilt) -> None:
+        """Replace the compiled instance for a served id in place —
+        the degraded-mesh rebuild path (runtime/block.py's KIND_LOST
+        rung rebuilt the serving ``ShardedModel`` over the surviving
+        chips). Without this, the next latest-wins re-adoption would
+        compare against the pre-loss instance and swap the dead mesh
+        back into service."""
+        try:
+            mid = ModelId.from_key(mid_key)
+        except (ValueError, TypeError):
+            return
+        with self._lock:
+            if mid in self._compiled:
+                self._compiled[mid] = rebuilt
+
     @property
     def served(self) -> Dict[ModelId, ModelInfo]:
         with self._lock:

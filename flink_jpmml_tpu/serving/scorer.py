@@ -459,7 +459,14 @@ class DynamicScorer(Scorer):
             )
             return handle, q
         if model.batch_size is not None:
-            X, M, _ = prepare.pad_batch(X, M, model.batch_size)
+            # a mesh-sharded model's data axis must divide the dispatch
+            # (parallel/sharding.ShardedModel); after a degraded-mesh
+            # rebuild the divisor can stop dividing batch_size, so the
+            # pad target rounds up — single-chip models (divisor 1)
+            # keep the exact historical pad-to-batch geometry
+            target = model.batch_size
+            target += (-target) % getattr(model, "batch_divisor", 1)
+            X, M, _ = prepare.pad_batch(X, M, target)
         handle = self._dispatcher.launch(
             lambda m=model, X=X, M=M: m.predict(X, M),
             profile=(

@@ -786,9 +786,16 @@ _GAUGE_MERGE_MAX_PREFIXES = (
     # the sickest worker; same worst-of logic for a suspended
     # checkpoint plane and for lost mesh chips
     "failover_state", "checkpoint_suspended", "mesh_lost_devices",
+    # multichip serving (obs/mesh.py): per-chip health state follows
+    # the failover_state convention (0 healthy / 2 lost) — the fleet
+    # view is the sickest worker's view of the chip
+    "mesh_chip_state",
 )
 _GAUGE_MERGE_MIN_PREFIXES = (
     "slo_ok", "watermark_ts", "watermark_stage_ts", "adaptive_batch",
+    # multichip serving (obs/mesh.py): surviving data-axis width — the
+    # fleet value is the most-degraded worker's mesh, never a sum
+    "mesh_data_width",
 )
 
 

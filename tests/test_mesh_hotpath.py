@@ -1,0 +1,345 @@
+"""Multichip hot-path serving (PR 16): ShardedModel promoted into the
+streaming pipelines.
+
+The contracts under test:
+
+- key-stable splits (parallel/assignment.py): rendezvous-hashed chip
+  ownership of kafka partitions and record keys moves ONLY the dead
+  chip's work across a degraded-mesh resize — every healthy chip keeps
+  exactly what it had, composed end-to-end with the producer-side
+  HashPartitioner lanes;
+- canary splits across shards (rollout/split.py): assign_candidate is
+  a pure function of the key, so per-shard canary fractions match the
+  global fraction and survive a resize untouched;
+- ``ShardedModel.without_devices`` carries the dispatcher/window state
+  and the partition assignment through the rebuild;
+- chip loss ON the mesh hot path (runtime/block.py KIND_LOST rung):
+  the pipeline rebuilds over the survivors in place — zero loss, zero
+  duplication, EMPTY DLQ, per-chip telemetry flags the dead chip;
+- the mesh chaos-soak profile (tools/fuzz_soak.py --chaos --mesh),
+  slow-marked.
+
+Runs on the virtual 8-CPU mesh (tests/conftest.py).
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.parallel.assignment import (
+    ChipAssignment, assignment_for, mesh_in_flight,
+)
+from flink_jpmml_tpu.parallel.partitioner import HashPartitioner
+from flink_jpmml_tpu.rollout import split as rsplit
+from flink_jpmml_tpu.runtime import faults
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def gbm(tmp_path_factory):
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+
+    tmp = tmp_path_factory.mktemp("mesh-hotpath-gbm")
+    pmml = gen_gbm(str(tmp), n_trees=4, depth=3, n_features=5)
+    return compile_pmml(parse_pmml_file(pmml), batch_size=32)
+
+
+def _data(n, seed=0, cols=5):
+    rng = np.random.default_rng(seed)
+    return rng.normal(0, 1.0, size=(n, cols)).astype(np.float32)
+
+
+def _mesh_4x2():
+    import jax
+
+    from flink_jpmml_tpu.parallel.mesh import make_mesh
+    from flink_jpmml_tpu.utils.config import MeshConfig
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    return make_mesh(MeshConfig(data=4, model=2))
+
+
+KEYS = [f"user-{i}" for i in range(2000)]
+
+
+# ---------------------------------------------------------------------------
+# key-stable splits across a degraded-mesh resize
+# ---------------------------------------------------------------------------
+
+
+class TestKeyStability:
+    def test_healthy_chips_keep_their_keys(self):
+        a = ChipAssignment((0, 1, 2, 3))
+        before = {k: a.chip_for_key(k) for k in KEYS}
+        shrunk = a.without([2])
+        for k, chip in before.items():
+            if chip == 2:
+                assert shrunk.chip_for_key(k) in (0, 1, 3)
+            else:
+                # the rendezvous property: survivors keep every key
+                assert shrunk.chip_for_key(k) == chip
+
+    def test_healthy_chips_keep_their_partitions(self):
+        a = ChipAssignment((0, 1, 2, 3), partitions=range(16))
+        shrunk = a.without([1])
+        for p in range(16):
+            owner = a.chip_for_partition(p)
+            if owner != 1:
+                assert shrunk.chip_for_partition(p) == owner
+        # the dead chip's partitions all re-homed onto survivors
+        orphans = a.partitions_for(1)
+        assert orphans  # 16 partitions over 4 chips: never empty
+        for p in orphans:
+            assert shrunk.chip_for_partition(p) in (0, 2, 3)
+
+    def test_producer_lane_to_chip_end_to_end(self):
+        """Composed stability: producer-side HashPartitioner lanes
+        (fixed partition count — the topic doesn't resize when a chip
+        dies) plus rendezvous partition→chip ownership ⇒ a record key
+        scored on a healthy chip stays on that chip across the
+        resize."""
+        n_parts = 16
+        hp = HashPartitioner(n_parts)
+        a = ChipAssignment((0, 1, 2, 3), partitions=range(n_parts))
+        shrunk = a.without([3])
+        for k in KEYS:
+            part = hp.lane(k)
+            before = a.chip_for_partition(part)
+            if before != 3:
+                assert shrunk.chip_for_partition(part) == before
+
+    def test_split_groups_by_owner(self):
+        a = ChipAssignment((0, 1, 2, 3))
+        groups = a.split(KEYS)
+        assert sorted(sum(groups.values(), [])) == sorted(KEYS)
+        for chip, members in groups.items():
+            for k in members:
+                assert a.chip_for_key(k) == chip
+
+    def test_mesh_row_ids_survive_resize(self):
+        """for_mesh labels lanes by each data row's FIRST device id, so
+        the surviving rows keep their identity (and weights) after
+        degraded_mesh trims a row."""
+        mesh = _mesh_4x2()
+        a = assignment_for(mesh, partitions=range(8))
+        row_ids = a.chips
+        assert len(row_ids) == 4
+        lost_row = list(mesh.devices.reshape(4, -1)[-1])
+        shrunk = a.without(lost_row)
+        assert shrunk.chips == tuple(
+            c for c in row_ids
+            if c not in {d.id for d in lost_row}
+        )
+
+    def test_in_flight_geometry(self):
+        mesh = _mesh_4x2()
+        assert mesh_in_flight(None, 2) == 2
+        assert mesh_in_flight(mesh, 2) == 4
+        assert mesh_in_flight(mesh, 6) == 6
+
+
+# ---------------------------------------------------------------------------
+# canary fractions per shard
+# ---------------------------------------------------------------------------
+
+
+class TestCanaryAcrossShards:
+    def test_fraction_preserved_per_shard(self):
+        """assign_candidate is a pure function of the key (chip-blind),
+        so each shard's canary fraction tracks the global fraction and
+        a degraded-mesh resize cannot change any key's canary side."""
+        fraction = 0.2
+        a = ChipAssignment((0, 1, 2, 3))
+        flags = {
+            k: rsplit.assign_candidate("m", 2, fraction, k)
+            for k in KEYS
+        }
+        global_frac = sum(flags.values()) / len(KEYS)
+        assert abs(global_frac - fraction) < 0.05
+        for chip, members in a.split(KEYS).items():
+            assert len(members) > 100  # rendezvous spreads the keys
+            frac = sum(flags[k] for k in members) / len(members)
+            assert abs(frac - global_frac) < 0.07, (
+                f"chip {chip} canary fraction {frac:.3f} drifted from "
+                f"global {global_frac:.3f}"
+            )
+
+    def test_resize_never_flips_a_canary_side(self):
+        """Each surviving chip's canary population is IDENTICAL before
+        and after the resize: keys neither re-home off survivors nor
+        change canary side (assign_candidate is key-pure), so a mid-
+        rollout chip loss cannot skew the canary comparison."""
+        fraction = 0.3
+        a = ChipAssignment((0, 1, 2, 3))
+        shrunk = a.without([0])
+        canary = {
+            k for k in KEYS
+            if rsplit.assign_candidate("m", 2, fraction, k)
+        }
+        before = {
+            chip: {k for k in ks if k in canary}
+            for chip, ks in a.split(KEYS).items()
+        }
+        after = {
+            chip: {k for k in ks if k in canary}
+            for chip, ks in shrunk.split(KEYS).items()
+        }
+        for chip in (1, 2, 3):
+            # survivors keep their exact canary slice; the dead chip's
+            # slice re-homes as a whole
+            assert before[chip] <= after[chip]
+            assert after[chip] - before[chip] <= before[0]
+
+
+# ---------------------------------------------------------------------------
+# without_devices carries serving state
+# ---------------------------------------------------------------------------
+
+
+class TestRebuildCarry:
+    def test_dispatch_state_and_assignment_carry(self, gbm):
+        from flink_jpmml_tpu.parallel.sharding import mesh_sharded
+
+        mesh = _mesh_4x2()
+        sm = mesh_sharded(gbm, mesh)
+        sm.with_dispatch_state(in_flight=4, donate=False)
+        sm.assignment = assignment_for(mesh, partitions=range(8))
+        lost = list(mesh.devices.reshape(4, -1)[-1])
+        rebuilt = sm.without_devices(lost)
+        assert rebuilt.dispatch_state == sm.dispatch_state
+        assert rebuilt.dispatch_state is not sm.dispatch_state
+        assert rebuilt.assignment is not None
+        assert rebuilt.assignment.chips == sm.assignment.without(
+            lost
+        ).chips
+        assert rebuilt.assignment.partitions == (
+            sm.assignment.partitions
+        )
+        assert rebuilt.in_flight_depth(2) == 4  # carried window depth
+
+
+# ---------------------------------------------------------------------------
+# chip loss on the mesh hot path
+# ---------------------------------------------------------------------------
+
+
+class TestMeshChipLoss:
+    def test_pipeline_survives_chip_loss(self, gbm, tmp_path,
+                                         monkeypatch):
+        from flink_jpmml_tpu.obs import mesh as mesh_obs
+        from flink_jpmml_tpu.runtime.block import (
+            BlockPipeline, FiniteBlockSource,
+        )
+        from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+        from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+        from flink_jpmml_tpu.utils.config import (
+            BatchConfig, RuntimeConfig,
+        )
+
+        monkeypatch.setenv("FJT_RETRY_BASE_S", "0.005")
+        mesh = _mesh_4x2()
+        N = 640
+        emitted = []
+        m = MetricsRegistry()
+        faults.inject("chip_loss", n=1)
+        pipe = BlockPipeline(
+            FiniteBlockSource(_data(N), 32), gbm,
+            lambda o, n, f: emitted.append((f, n)),
+            RuntimeConfig(
+                batch=BatchConfig(size=32, deadline_us=500),
+                checkpoint_interval_s=0.05,
+            ),
+            metrics=m,
+            # the checkpoint auto-wires the DLQ beside it, which arms
+            # the failover plane — the production shape of the ladder
+            checkpoint=CheckpointManager(str(tmp_path / "ck")),
+            use_native=False,
+            max_dispatch_chunks=1,
+            mesh=mesh,
+        )
+        pipe.run_until_exhausted(timeout=120)
+        cov = np.zeros(N, np.int64)
+        for off, n in emitted:
+            cov[off: off + n] += 1
+        assert (cov == 1).all()
+        assert list(
+            DeadLetterQueue(str(tmp_path / "ck" / "dlq")).offsets()
+        ) == []
+        snap = m.struct_snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        assert c.get("mesh_rebuilds", 0) == 1
+        assert g["mesh_data_width"]["value"] == 3.0
+        assert g["mesh_lost_devices"]["value"] == 2.0  # one 4x2 row
+        s = mesh_obs.summary(snap)
+        assert s is not None and s["data_width"] == 3.0
+        lost = [
+            chip for chip, v in s["chips"].items()
+            if v["state"] == "lost"
+        ]
+        assert len(lost) == 1
+
+    def test_single_chip_still_escalates(self, gbm, tmp_path,
+                                         monkeypatch):
+        """The historical contract is untouched off the mesh: a
+        single-chip model's chip loss raises to the supervisor."""
+        from flink_jpmml_tpu.runtime.block import (
+            BlockPipeline, FiniteBlockSource,
+        )
+        from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+        from flink_jpmml_tpu.utils.config import (
+            BatchConfig, RuntimeConfig,
+        )
+
+        monkeypatch.setenv("FJT_RETRY_BASE_S", "0.005")
+        m = MetricsRegistry()
+        faults.inject("chip_loss", n=1)
+        pipe = BlockPipeline(
+            FiniteBlockSource(_data(320), 32), gbm,
+            lambda o, n, f: None,
+            RuntimeConfig(batch=BatchConfig(size=32, deadline_us=500)),
+            metrics=m,
+            checkpoint=CheckpointManager(str(tmp_path / "ck")),
+            use_native=False,
+            max_dispatch_chunks=1,
+        )
+        with pytest.raises(faults.InjectedChipLoss):
+            pipe.run_until_exhausted(timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# chaos soak: mesh profile (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh_chaos_soak_profile():
+    root = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("FJT_FAULTS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, str(root / "tools" / "fuzz_soak.py"),
+            "--chaos", "--mesh", "--seeds", "3", "--start", "7",
+        ],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"mesh chaos soak rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
+    )
+    assert "mesh-chaos: 3/3 seeds clean" in proc.stdout

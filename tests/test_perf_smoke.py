@@ -48,3 +48,4 @@ def test_perf_smoke_passes():
     assert "recovery drill OK" in proc.stdout
     assert "device fault plane OK" in proc.stdout
     assert "fault hooks no-op OK" in proc.stdout
+    assert "mesh gate no-op OK" in proc.stdout

@@ -18,6 +18,7 @@ passing --start <seed> --seeds 1).
 """
 
 import argparse
+import os
 import pathlib
 import sys
 import time
@@ -380,6 +381,135 @@ def _soak_chaos(seed):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _soak_mesh_chaos(seed):
+    """One MESH chaos iteration (PR 16): ``chip_loss`` — survivable on
+    a mesh since the KIND_LOST rung rebuilds over the surviving chips
+    in place — composed with kafka-side weather (slow fetch, broker
+    death, dispatch delay) against a mesh-sharded Kafka→BlockPipeline
+    stream. Verifies degraded-mesh mode under churn: every offset
+    reaches the sink exactly (zero loss, zero duplication — no
+    restart), the DLQ stays EMPTY (a dead chip never quarantines
+    records), every injected chip loss performed a rebuild, and the
+    surviving data width dropped accordingly."""
+    import os
+    import tempfile
+
+    from flink_jpmml_tpu.parallel.mesh import make_mesh
+    from flink_jpmml_tpu.runtime import faults
+    from flink_jpmml_tpu.runtime.block import BlockPipeline
+    from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+    from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+    from flink_jpmml_tpu.runtime.kafka import (
+        KafkaBlockSource, MiniKafkaBroker,
+    )
+    from flink_jpmml_tpu.utils.config import (
+        BatchConfig, MeshConfig, RuntimeConfig,
+    )
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+    import jax
+
+    n_dev = jax.device_count()
+    assert n_dev >= 4, (
+        f"mesh chaos needs >= 4 devices, found {n_dev} (set XLA_FLAGS="
+        "--xla_force_host_platform_device_count=8)"
+    )
+    mesh = make_mesh(
+        MeshConfig(data=4, model=2 if n_dev >= 8 else 1),
+        allow_subset=True,
+    )
+    rng = np.random.default_rng(seed)
+    cm = _chaos_model()
+    N = 1504  # divides by 32; the mesh pad keeps partials dispatchable
+    data = rng.normal(0, 1.0, size=(N, 5)).astype(np.float32)
+    tmp = tempfile.mkdtemp(prefix="fjt-meshchaos-")
+    broker = MiniKafkaBroker(topic="meshchaos")
+    pipe = None
+    try:
+        broker.append_rows(data)
+        # chip loss is the profile's anchor; width 4 survives two
+        losses = int(rng.integers(1, 3))
+        spec = [f"chip_loss:n={losses}"]
+        menu = [
+            f"slow_fetch:delay_ms=2:p=0.05:seed={seed}",
+            f"broker_death:n={int(rng.integers(1, 3))}"
+            f":p=0.02:seed={seed}",
+            f"dispatch_delay:delay_ms=1:p=0.05:seed={seed}",
+        ]
+        picks = rng.choice(
+            len(menu), size=int(rng.integers(1, len(menu) + 1)),
+            replace=False,
+        )
+        spec += [menu[i] for i in picks]
+        emitted = []
+
+        def sink(out, n, first_off):
+            emitted.append((first_off, n))
+
+        m = MetricsRegistry()
+        dlq = DeadLetterQueue(os.path.join(tmp, "ck", "dlq"), metrics=m)
+        src = KafkaBlockSource(
+            broker.host, broker.port, "meshchaos", n_cols=5,
+            max_wait_ms=10, metrics=m, dlq=dlq,
+        )
+        os.environ["FJT_RETRY_BASE_S"] = "0.01"
+        assert faults.install_from_env(",".join(spec)), spec
+        pipe = BlockPipeline(
+            src, cm, sink,
+            RuntimeConfig(
+                batch=BatchConfig(size=32, deadline_us=1000),
+                checkpoint_interval_s=0.05,
+            ),
+            metrics=m,
+            checkpoint=CheckpointManager(os.path.join(tmp, "ck")),
+            dlq=dlq,
+            max_dispatch_chunks=4,
+            mesh=mesh,
+        )
+        pipe.start()
+        deadline = time.perf_counter() + 120.0
+        while (
+            pipe.committed_offset < N
+            and pipe._error is None
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.01)
+        pipe.stop()
+        pipe.join(timeout=20.0)
+        err = pipe._error
+        pipe = None
+        src.close()
+        assert err is None, f"mesh chaos seed={seed}: died {err!r}"
+        covered = np.zeros(N, np.int64)
+        for off, n in emitted:
+            covered[off: off + n] += 1
+        assert (covered == 1).all(), (
+            f"mesh chaos seed={seed}: coverage "
+            f"min={covered.min()} max={covered.max()} (spec {spec})"
+        )
+        assert sorted(set(dlq.offsets())) == [], (
+            f"mesh chaos seed={seed}: chip loss quarantined records"
+        )
+        fired = faults.stats().get("chip_loss", 0)
+        c = m.struct_snapshot()["counters"]
+        assert c.get("mesh_rebuilds", 0) >= fired >= 1, (
+            f"mesh chaos seed={seed}: {fired} chip losses but "
+            f"{c.get('mesh_rebuilds', 0)} rebuilds (spec {spec})"
+        )
+    finally:
+        faults.clear()
+        if pipe is not None:
+            try:
+                pipe.stop()
+                pipe.join(timeout=10.0)
+            except Exception:
+                pass
+        broker.close()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--families", default=",".join(FAMILIES))
@@ -391,25 +521,44 @@ def main() -> int:
                          "FJT_FAULTS kinds through a Kafka→pipeline "
                          "stream and verifies the delivery contract "
                          "(no loss, poison exactly in the DLQ)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="with --chaos: the MESH profile instead — "
+                         "chip_loss composed with kafka faults against "
+                         "a mesh-sharded pipeline (simulated 8-device "
+                         "host), verifying degraded-mesh serving under "
+                         "churn")
     args = ap.parse_args()
 
+    if args.mesh:
+        # the virtual-device flag must land before the backend inits
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     import jax
+
+    if args.mesh:
+        jax.config.update("jax_platforms", "cpu")
 
     print(f"backend: {jax.default_backend()}", flush=True)
     failures = 0
     if args.chaos:
+        fn = _soak_mesh_chaos if args.mesh else _soak_chaos
+        name = "mesh-chaos" if args.mesh else "chaos"
         t0 = time.perf_counter()
         ok = 0
         for s in range(args.start, args.start + args.seeds):
             try:
-                _soak_chaos(s)
+                fn(s)
                 ok += 1
             except AssertionError as e:
                 failures += 1
-                print(f"FAIL chaos seed={s}: {e}", flush=True)
+                print(f"FAIL {name} seed={s}: {e}", flush=True)
         dt = time.perf_counter() - t0
         print(
-            f"chaos: {ok}/{args.seeds} seeds clean in {dt:.1f}s",
+            f"{name}: {ok}/{args.seeds} seeds clean in {dt:.1f}s",
             flush=True,
         )
         return 1 if failures else 0

