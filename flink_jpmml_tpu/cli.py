@@ -769,6 +769,104 @@ def _top_render_mesh(label: str, struct: dict, out) -> None:
               "(degraded-mesh mode)", file=out)
 
 
+def _top_render_zoo(label: str, struct: dict, out) -> None:
+    """The ``--zoo`` panel: multi-tenant packed-serving telemetry
+    (serving/zoo.py + the per-tenant families) as one operator view —
+    pack dispatch/occupancy/pad-waste, warm-pool and cold-start
+    economics, and the per-tenant table ranked by delivered records
+    with shed counts and latency quantiles. On a fleet struct the
+    counters arrive SUM-merged, ``pack_occupancy`` MIN-merged (the
+    worst-filled worker) and ``pack_pad_waste`` MAX-merged (the most
+    wasteful), per the catalogue rules."""
+    import re as _re
+
+    from flink_jpmml_tpu.utils.metrics import Histogram
+
+    title = label or "aggregate"
+    print(f"== {title} · zoo ==", file=out)
+    gauges = struct.get("gauges") or {}
+    counters = struct.get("counters") or {}
+    hists = struct.get("histograms") or {}
+
+    def g(name):
+        v = gauges.get(name)
+        return v.get("value") if isinstance(v, dict) else None
+
+    def hq(name, q):
+        hstate = hists.get(name)
+        if not isinstance(hstate, dict):
+            return None
+        try:
+            h = Histogram.from_state(hstate)
+            return h.quantile(q) if h.count() else None
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    rendered = False
+    disp = counters.get("pack_dispatches", 0)
+    occ, waste = g("pack_occupancy"), g("pack_pad_waste")
+    res = g("zoo_resident_bytes")
+    if disp or occ is not None or res is not None:
+        rendered = True
+        parts = [f"dispatches {disp:,.0f}"]
+        if occ is not None:
+            parts.append(f"occupancy {100.0 * occ:.1f}%")
+        if waste is not None:
+            parts.append(f"pad-waste {100.0 * waste:.1f}%")
+        if res is not None:
+            parts.append(f"resident {res / 1e6:,.1f} MB")
+        print("packs    " + "   ".join(parts), file=out)
+    hits = counters.get("warm_pool_hits", 0)
+    miss = counters.get("warm_pool_misses", 0)
+    evict = counters.get("zoo_evictions", 0)
+    if hits or miss or evict:
+        rendered = True
+        line = (f"warm     hits {hits:,.0f}   misses {miss:,.0f}"
+                f"   evictions {evict:,.0f}")
+        p50, p99 = hq("cold_start_s", 0.5), hq("cold_start_s", 0.99)
+        if p50 is not None:
+            line += (f"   cold-start p50 {1000.0 * p50:,.1f} ms"
+                     f"  p99 {1000.0 * (p99 or p50):,.1f} ms")
+        print(line, file=out)
+    # per-tenant table: the three {model=*} families joined on label
+    pat = _re.compile(
+        r'^(tenant_records|tenant_shed_records)\{model="([^"]+)"\}$'
+    )
+    tenants: Dict[str, Dict[str, float]] = {}
+    for name, v in counters.items():
+        m = pat.match(name)
+        if m:
+            tenants.setdefault(m.group(2), {})[m.group(1)] = float(v)
+    if tenants:
+        rendered = True
+        print(
+            f"{'tenant':<24}{'records':>12}{'shed':>9}{'p50 ms':>10}"
+            f"{'p99 ms':>10}",
+            file=out,
+        )
+        ranked = sorted(
+            tenants.items(),
+            key=lambda kv: kv[1].get("tenant_records", 0.0),
+            reverse=True,
+        )
+        for tenant, row in ranked[:20]:
+            lname = f'tenant_latency_s{{model="{tenant}"}}'
+            p50, p99 = hq(lname, 0.5), hq(lname, 0.99)
+            print(
+                f"{tenant:<24}"
+                f"{row.get('tenant_records', 0.0):>12,.0f}"
+                f"{row.get('tenant_shed_records', 0.0):>9,.0f}"
+                f"{(f'{1000.0 * p50:,.2f}' if p50 is not None else '-'):>10}"
+                f"{(f'{1000.0 * p99:,.2f}' if p99 is not None else '-'):>10}",
+                file=out,
+            )
+        if len(ranked) > 20:
+            print(f"... and {len(ranked) - 20} more tenant(s)", file=out)
+    if not rendered:
+        print("(no zoo telemetry recorded — single-tenant serving or "
+              "zoo mode off)", file=out)
+
+
 def top_main(argv: Optional[List[str]] = None) -> int:
     """``fjt-top``: the fleet attribution table (see module docstring).
     Renders every labelled source (the supervisor's /varz serves the
@@ -810,6 +908,12 @@ def top_main(argv: Optional[List[str]] = None) -> int:
                          "in-flight depth, health state, surviving "
                          "data width, degraded-mesh rebuilds) instead "
                          "of the stage table")
+    ap.add_argument("--zoo", action="store_true",
+                    help="render the multi-tenant zoo panel (pack "
+                         "dispatch/occupancy/pad-waste, warm-pool and "
+                         "cold-start economics, per-tenant records/"
+                         "shed/latency ranked by traffic) instead of "
+                         "the stage table")
     ap.add_argument("--watch", type=float, default=None, metavar="N",
                     help="re-render every N seconds from a live source "
                          "(operator console mode; mid-watch fetch "
@@ -818,16 +922,17 @@ def top_main(argv: Optional[List[str]] = None) -> int:
     if args.watch is not None and args.watch <= 0:
         raise SystemExit(f"--watch must be > 0, got {args.watch}")
     if sum((args.freshness, args.overload, args.drift,
-            args.failover, args.mesh)) > 1:
+            args.failover, args.mesh, args.zoo)) > 1:
         raise SystemExit(
-            "--freshness, --overload, --drift, --failover, and "
-            "--mesh are exclusive"
+            "--freshness, --overload, --drift, --failover, --mesh, "
+            "and --zoo are exclusive"
         )
     render = (
         _top_render_freshness if args.freshness
         else _top_render_overload if args.overload
         else _top_render_drift if args.drift
         else _top_render_mesh if args.mesh
+        else _top_render_zoo if args.zoo
         else (
             lambda label, struct, out: _top_render_failover(
                 label, struct, out, source=args.source

@@ -287,6 +287,175 @@ def backend_key(scorer) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Cross-model pack plans (the zoo's layout decision, keyed per model SET)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PackPlan:
+    """One adopted packing partition for a model set.
+
+    ``groups`` are lists of model hashes sharing a packed buffer
+    (singleton = solo). Cached per ``(model-set hash, platform)`` —
+    the SET hash, not any member's hash: adding or removing a tenant
+    changes the set hash, so the stale winner simply misses and the
+    partition re-searches (satellite: stale-winner invalidation,
+    pinned by tests/test_zoo.py)."""
+
+    groups: List[List[str]]
+    set_hash: str
+    pred_s_per_record: Optional[float] = None
+    waste: float = 0.0
+    space: str = layouts.PACK_SPACE_TAG
+    source: str = "search"
+
+    def as_dict(self) -> dict:
+        return {
+            "kind": "pack_plan",
+            "groups": [list(g) for g in self.groups],
+            "set_hash": self.set_hash,
+            "pred_s_per_record": self.pred_s_per_record,
+            "waste": self.waste,
+            "space": self.space,
+            "source": self.source,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> Optional["PackPlan"]:
+        try:
+            groups = [
+                [str(h) for h in g] for g in d["groups"]
+            ]
+            return cls(
+                groups=groups,
+                set_hash=str(d.get("set_hash") or ""),
+                pred_s_per_record=(
+                    float(d["pred_s_per_record"])
+                    if d.get("pred_s_per_record") is not None
+                    else None
+                ),
+                waste=float(d.get("waste") or 0.0),
+                # absent tag must NOT default to the current one (the
+                # TunedConfig rule): a pre-packspace entry re-searches
+                space=str(d.get("space") or ""),
+                source=str(d.get("source") or "cache"),
+            )
+        except (KeyError, TypeError, ValueError):
+            return None
+
+
+def platform_key() -> str:
+    """Pack-plan cache key half: platform + device kind. No scorer
+    backend dimension — packs are XLA-only by eligibility."""
+    try:
+        import jax
+
+        plat = jax.default_backend()
+        kind = getattr(jax.devices()[0], "device_kind", "") or ""
+    except Exception:
+        plat, kind = "unknown", ""
+    return f"{plat}:{kind.replace(' ', '_')}"
+
+
+def _pack_key(set_hash: str, plat: str) -> str:
+    return f"packset:{set_hash}|{plat}"
+
+
+def lookup_pack_plan(
+    set_hash: str, plat: Optional[str] = None
+) -> Optional[PackPlan]:
+    if os.environ.get("FJT_AUTOTUNE_DISABLE"):
+        return None
+    if not set_hash:
+        return None
+    raw = _load_cache().get(_pack_key(set_hash, plat or platform_key()))
+    if not isinstance(raw, dict):
+        return None
+    plan = PackPlan.from_dict(raw)
+    if plan is None or plan.space != layouts.PACK_SPACE_TAG:
+        return None
+    plan.source = "cache"
+    return plan
+
+
+def store_pack_plan(plan: PackPlan, plat: Optional[str] = None) -> None:
+    """Same read-modify-write + atomic-replace discipline as
+    :func:`store`; silent on failure."""
+    if not plan.set_hash or os.environ.get("FJT_AUTOTUNE_DISABLE"):
+        return
+    from flink_jpmml_tpu.utils.diskio import atomic_write_json
+
+    with _cache_lock():
+        entries = _load_cache()
+        entry = plan.as_dict()
+        entry["ts"] = time.time()
+        entries[_pack_key(plan.set_hash, plat or platform_key())] = entry
+        atomic_write_json(
+            str(cache_path()),
+            {"version": _CACHE_VERSION, "entries": entries},
+        )
+
+
+def ensure_pack_plan(
+    metas: Dict[str, dict], plat: Optional[str] = None
+) -> PackPlan:
+    """The zoo's layout decision: adopted pack partition for a model
+    set, cache-else-search-else-store.
+
+    ``metas`` maps model_hash → packed-shape summary
+    (``QuantizedScorer._meta``). The search enumerates
+    ``layouts.pack_partitions`` and prices each with
+    ``costmodel.pack_partition_cost`` (predicted device-s/record
+    inflated by padded waste — the two ranking axes the issue names);
+    the argmin is adopted and persisted under the model-SET hash. A
+    cached plan whose member union no longer matches the live set
+    (possible only through a hash collision or a corrupt file) reads
+    as no entry."""
+    from flink_jpmml_tpu.compile import costmodel, packs
+    from flink_jpmml_tpu.obs import recorder as flight
+
+    plat = plat or platform_key()
+    set_hash = packs.model_set_hash(list(metas))
+    cached = lookup_pack_plan(set_hash, plat)
+    if cached is not None:
+        members = {h for g in cached.groups for h in g}
+        if members == set(metas):
+            return cached
+    model = costmodel.current_model()
+    best = None
+    best_cost = math.inf
+    best_waste = 0.0
+    n_cands = 0
+    for part in layouts.pack_partitions(metas):
+        n_cands += 1
+        cost, waste = costmodel.pack_partition_cost(metas, part, model)
+        if cost < best_cost:
+            best, best_cost, best_waste = part, cost, waste
+    if best is None:  # empty set: degenerate, nothing to pack
+        return PackPlan(groups=[], set_hash=set_hash, source="empty")
+    plan = PackPlan(
+        groups=[list(g) for g in best],
+        set_hash=set_hash,
+        pred_s_per_record=(
+            best_cost if math.isfinite(best_cost) else None
+        ),
+        waste=best_waste,
+        source="search",
+    )
+    store_pack_plan(plan, plat)
+    flight.record(
+        "pack_plan_adopted",
+        set_hash=set_hash,
+        models=len(metas),
+        groups=len(plan.groups),
+        candidates=n_cands,
+        waste=round(best_waste, 4),
+        pred_s_per_record=plan.pred_s_per_record,
+    )
+    return plan
+
+
+# ---------------------------------------------------------------------------
 # Candidate space
 # ---------------------------------------------------------------------------
 

@@ -257,6 +257,84 @@ class CostModel:
             return None
 
 
+# ---------------------------------------------------------------------------
+# Cross-model pack pricing (the zoo layout search's cost axis)
+# ---------------------------------------------------------------------------
+
+# per-dispatch host+launch overhead the pack amortizes: the quantity
+# packing exists to defeat. Overridable for hosts whose measured launch
+# cost differs (a tunneled chip is worse than a local one).
+_PACK_OVERHEAD_ENV = "FJT_PACK_DISPATCH_OVERHEAD_S"
+_PACK_OVERHEAD_DEFAULT_S = 5e-4
+# relative weight of padded waste in the ranking: waste is wasted
+# bytes staged AND wasted rows scored, so it prices like a throughput
+# multiplier on the compute term
+_PACK_WASTE_WEIGHT = 0.5
+
+
+def pack_dispatch_overhead_s() -> float:
+    try:
+        v = float(
+            os.environ.get(_PACK_OVERHEAD_ENV) or _PACK_OVERHEAD_DEFAULT_S
+        )
+        return v if v > 0 and math.isfinite(v) else _PACK_OVERHEAD_DEFAULT_S
+    except ValueError:
+        return _PACK_OVERHEAD_DEFAULT_S
+
+
+def _member_compute_s(meta: Dict[str, float], model) -> float:
+    """Predicted device seconds for one member's full batch-B slot —
+    the learned fit when one exists for this platform, else an analytic
+    bytes-proportional proxy (enough to ORDER partitions; absolute
+    scale cancels against the shared overhead term only, which is why
+    the proxy's constant matters and is conservative)."""
+    meta = meta or {}
+    b = max(float(meta.get("batch", 0.0)), 1.0)
+    if model is not None:
+        f = variant_features(meta, "xla", "ref", None, None)
+        p = model.predict(f)
+        if p is not None and math.isfinite(p) and p > 0:
+            return p * b
+    # proxy: einsum work ~ B * T * L; ~1e9 tiny-gather ops/s
+    work = b * max(meta.get("trees", 1.0), 1.0) * max(
+        meta.get("leaves", 1.0), 1.0
+    )
+    return work / 1e9
+
+
+def pack_partition_cost(
+    metas: Dict[str, Dict[str, float]],
+    partition,
+    model: Optional[CostModel] = None,
+    overhead_s: Optional[float] = None,
+) -> Tuple[float, float]:
+    """Price one packing partition → ``(pred_s_per_record, waste)``.
+
+    One scoring round dispatches every group once with full slots:
+    round time = Σ_groups (dispatch overhead + Σ_members member
+    compute), records = Σ_members B. Packing moves the overhead term
+    from per-model to per-group — exactly the amortization the zoo
+    needs — while padded waste inflates the compute term (padding rows
+    are scored and discarded). The returned cost is the ranking key
+    used by :func:`flink_jpmml_tpu.compile.autotune.ensure_pack_plan`."""
+    from flink_jpmml_tpu.compile import layouts
+
+    ov = pack_dispatch_overhead_s() if overhead_s is None else overhead_s
+    total_s = 0.0
+    total_records = 0.0
+    for group in partition:
+        total_s += ov
+        for h in group:
+            m = metas.get(h) or {}
+            total_s += _member_compute_s(m, model)
+            total_records += max(float(m.get("batch", 0.0)), 1.0)
+    waste = layouts.pack_pad_waste(metas, partition)
+    if total_records <= 0:
+        return math.inf, waste
+    s_per_record = total_s / total_records
+    return s_per_record * (1.0 + _PACK_WASTE_WEIGHT * waste), waste
+
+
 def _current_platform() -> str:
     from flink_jpmml_tpu.obs import profiler
 

@@ -252,6 +252,110 @@ def plan_wire_pack(wire) -> Optional[WirePack]:
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# Cross-model packing partitions (the multi-tenant zoo layout axis)
+# ---------------------------------------------------------------------------
+
+# versions the PACK candidate space independently of the per-model
+# variant space: adding a partition family must invalidate adopted pack
+# plans without forcing every per-model winner to re-search (SPACE_TAG
+# stays put)
+PACK_SPACE_TAG = "packspace-v1"
+
+# candidate pack widths for the bucketed-greedy family; each is capped
+# by packs.pack_max() at enumeration time
+_PACK_WIDTHS = (4, 8, 16)
+
+
+def pack_partitions(
+    metas: Dict[str, Dict[str, float]]
+) -> List[Tuple[Tuple[str, ...], ...]]:
+    """Enumerate candidate packing partitions of a model set.
+
+    ``metas`` maps model_hash → packed-shape summary
+    (``QuantizedScorer._meta``). A partition is a tuple of groups, each
+    group a tuple of model hashes sharing one packed buffer (singleton
+    group = solo dispatch). The family is deliberately small — this is
+    a ranked search, not exhaustive set partitioning (Bell numbers):
+
+    - **solo** — every model alone (the packing-off baseline; always
+      candidate 0 so an empty cost model still has a safe winner),
+    - **bucketed-greedy(k)** for k in 4/8/16 — models sorted by
+      (wire dtype rank, classification, field count, hash) so lookalike
+      shapes land in the same bucket (minimal padded waste), chunked
+      into groups of ≤ k,
+    - **single-bucket** — one pack per ``packs.pack_max()`` chunk over
+      the whole sorted set (maximal launch amortization, maximal
+      padding).
+
+    Deterministic: same meta set → same candidate list, so the adopted
+    plan is stable under re-search."""
+    from flink_jpmml_tpu.compile import packs
+
+    hashes = sorted(metas)
+    if not hashes:
+        return []
+    solo = tuple((h,) for h in hashes)
+    if len(hashes) == 1:
+        return [solo]
+
+    def shape_key(h):
+        # param shape (trees × leaves) ranks BEFORE the wire shape: the
+        # packed kernel pads every member to the group max on both axes,
+        # and the T·L contraction — not the input buffer — dominates the
+        # padded compute, so compute-identical models must neighbour
+        m = metas[h] or {}
+        return (
+            float(m.get("dtype_rank", 1.0)),
+            float(m.get("classification", 0.0)),
+            float(m.get("trees", 0.0)),
+            float(m.get("leaves", 0.0)),
+            float(m.get("splits", 0.0)),
+            float(m.get("fields", 0.0)),
+            h,
+        )
+
+    ordered = sorted(hashes, key=shape_key)
+    cap = packs.pack_max()
+    cands: List[Tuple[Tuple[str, ...], ...]] = [solo]
+    seen = {solo}
+    for k in tuple(w for w in _PACK_WIDTHS if w <= cap) + (cap,):
+        part = tuple(
+            tuple(ordered[i: i + k]) for i in range(0, len(ordered), k)
+        )
+        if part not in seen:
+            seen.add(part)
+            cands.append(part)
+    return cands
+
+
+def pack_pad_waste(
+    metas: Dict[str, Dict[str, float]],
+    partition: Sequence[Sequence[str]],
+) -> float:
+    """Fraction of the partition's padded work that is padding (one of
+    the two ranking axes; the batch dimension divides out so this is
+    batch-free). Counts BOTH padded axes: the input buffer
+    (fields × dtype) and the param contraction (trees × leaves) — the
+    latter is where an over-mixed pack actually burns device time."""
+    used = 0.0
+    total = 0.0
+    for group in partition:
+        ms = [metas.get(h) or {} for h in group]
+        rank = max(float(m.get("dtype_rank", 1.0)) for m in ms)
+        f_max = max(float(m.get("fields", 0.0)) for m in ms)
+        t_max = max(float(m.get("trees", 0.0)) for m in ms)
+        l_max = max(float(m.get("leaves", 0.0)) for m in ms)
+        total += len(ms) * (f_max * rank + t_max * l_max)
+        used += sum(
+            float(m.get("fields", 0.0))
+            * float(m.get("dtype_rank", 1.0))
+            + float(m.get("trees", 0.0)) * float(m.get("leaves", 0.0))
+            for m in ms
+        )
+    return 1.0 - used / total if total > 0 else 0.0
+
+
 def variant_id(
     backend: str, layout: str, block_b: Optional[int], gt: Optional[int]
 ) -> str:

@@ -332,6 +332,12 @@ class QuantizedScorer:
     # provenance: a cached variant that degrades to the built defaults
     # must not ship its prediction into the live drift band
     _pred_s_per_record: object = None
+    # cross-model packing hook (compile/packs.py): the un-jitted kernel
+    # body + wire facts a PackedScorer needs to re-run this model as
+    # one subgraph of a multi-tenant program. A small closure (no param
+    # tables pinned — the pack reads the live ``params``); None on the
+    # Pallas backend, whose program bakes its own grid.
+    _pack_info: object = None
 
     @property
     def is_classification(self) -> bool:
@@ -859,6 +865,23 @@ def build_quantized_scorer(
     on_cpu = common.backend_is_cpu()
     sent = dtype(sentinel)
 
+    # Order-stable reductions for pack-eligible (small) models. XLA's
+    # gemv lowering for the final tree-sum contraction is context
+    # dependent: compiled inside a multi-model packed program
+    # (compile/packs.py) the same einsum can round differently by 1 ULP
+    # on some rows, breaking the pack's byte-parity contract. The leaf
+    # axis is a one-hot SELECTION (exact in any order), so contracting
+    # to a per-tree plane and finishing with a plain axis reduce — whose
+    # sequential lowering is module-independent — pins the float order.
+    # Gated by size so the flagship big-model solo path keeps the fused
+    # single-contraction form.
+    from flink_jpmml_tpu.compile import packs as _packs
+
+    stable_small = (
+        sum(int(v.nbytes) for v in params.values())
+        <= _packs.member_bytes_cap()
+    )
+
     def _hit(pp, Xq):
         """[B,T,L] leaf one-hot (f32 on CPU — no int8/bf16 dot kernels
         there — bf16 on TPU)."""
@@ -894,7 +917,15 @@ def build_quantized_scorer(
         def qfn(pp, Xq):
             hit = _hit(pp, Xq)
             if fused_linear:
-                value = _pair_einsum("btl,tl->b", hit, pp["vhi"], pp["vlo"])
+                if stable_small:
+                    per = _pair_einsum(
+                        "btl,tl->bt", hit, pp["vhi"], pp["vlo"]
+                    )
+                    value = per.sum(axis=1)
+                else:
+                    value = _pair_einsum(
+                        "btl,tl->b", hit, pp["vhi"], pp["vlo"]
+                    )
             else:
                 per_tree = jnp.einsum(
                     "btl,tl->bt", hit.astype(jnp.float32), pp["vals_f32"],
@@ -910,7 +941,15 @@ def build_quantized_scorer(
     else:
         def qfn(pp, Xq):
             hit = _hit(pp, Xq)
-            probs = _pair_einsum("btl,tlc->bc", hit, pp["phi"], pp["plo"])
+            if stable_small:
+                per = _pair_einsum(
+                    "btl,tlc->btc", hit, pp["phi"], pp["plo"]
+                )
+                probs = per.sum(axis=1)
+            else:
+                probs = _pair_einsum(
+                    "btl,tlc->bc", hit, pp["phi"], pp["plo"]
+                )
             if method == "single":
                 # the label is the leaf's score attribute, not argmax
                 lab = jnp.round(
@@ -1140,6 +1179,17 @@ def build_quantized_scorer(
         _encode_stage=encode_stage,
         _xla_rebuild=_build_xla_variant,
         _meta=scorer_meta,
+        # cross-model packing hook (compile/packs.py): qfn is layout-
+        # agnostic (it reads whatever param tables are live), so a
+        # pack stays byte-identical across bfs re-adoption; wirepack
+        # members are screened out at pack time (pack_eligible)
+        _pack_info={
+            "qfn": qfn,
+            "fields": F,
+            "dtype": dtype,
+            "sentinel": sentinel,
+            "classification": classification,
+        },
     )
     _consult_autotune(scorer)
     return scorer

@@ -314,7 +314,13 @@ class BlockPipelineBase:
         dlq=None,
         prefetch: Optional[bool] = None,
         failover=None,
+        tenant: Optional[str] = None,
     ):
+        # per-tenant delivery label (serving/zoo.py plane): see
+        # engine.Pipeline — records_out stays the total, the labelled
+        # counter adds the tenant axis. Mutable via set_tenant so the
+        # dynamic block pipeline re-labels on a served-model swap.
+        self._tenant = tenant
         self._source = source
         self._sink = sink
         # optional obs/slo.SLOTracker: ticked from the completion path
@@ -824,6 +830,18 @@ class BlockPipelineBase:
         _block_ready(out)
         return out, decode
 
+    def set_tenant(self, tenant) -> None:
+        """Re-label delivered records (the dynamic block pipeline calls
+        this on a served-model swap so tenant_records follows the key
+        actually serving)."""
+        self._tenant = tenant
+
+    def _book_tenant(self, n: int) -> None:
+        if self._tenant is not None:
+            self.metrics.counter(
+                f'tenant_records{{model="{self._tenant}"}}'
+            ).inc(n)
+
     def _emit_recovered(self, out, decode, offsets, lo, hi,
                         ctx=None, t0=None) -> None:
         """Deliver + commit one recovered run (redispatch, OOM
@@ -836,6 +854,7 @@ class BlockPipelineBase:
         first = int(offsets[lo])
         self._emit(out, n_run, first, decode)
         self.metrics.counter("records_out").inc(n_run)
+        self._book_tenant(n_run)
         freshness = fresh_mod.freshness_for(self.metrics)
         if freshness is not None:
             freshness.observe_sink(first, n_run)
@@ -1235,6 +1254,7 @@ class BlockPipelineBase:
             first = int(offsets[lo])
             self._emit(out, n_run, first, decode)
             records_out.inc(n_run)
+            self._book_tenant(n_run)
             if jstore is not None:
                 jstore.hop(
                     "sink", ctx.child(), first, n_run, durable=True,
@@ -1467,6 +1487,7 @@ class BlockPipelineBase:
                 )
             lat.observe(t_done - t_start)
             records_out.inc(n)
+            self._book_tenant(n)
             if self._mesh_obs is not None:
                 # per-chip accounting (obs/mesh.py): one call per BATCH
                 # — a data-parallel dispatch spans every chip equally,

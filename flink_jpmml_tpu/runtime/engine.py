@@ -174,7 +174,13 @@ class Pipeline:
         in_flight: int = 2,
         dlq=None,
         prefetch: Optional[bool] = None,
+        tenant: Optional[str] = None,
     ):
+        # ``tenant`` labels this pipeline's delivered records for the
+        # multi-tenant zoo plane (serving/zoo.py): records_out stays
+        # the unlabelled total, tenant_records{model=...} adds the
+        # per-tenant axis the fjt-top --zoo panel ranks by
+        self._tenant = tenant
         self._source = source
         self._scorer = scorer
         self._sink = sink
@@ -409,9 +415,16 @@ class Pipeline:
         faults.fire("device_readback")
         return self._scorer.finish(ticket)
 
+    def _book_tenant(self, n: int) -> None:
+        if self._tenant is not None:
+            self.metrics.counter(
+                f'tenant_records{{model="{self._tenant}"}}'
+            ).inc(n)
+
     def _deliver_seq(self, seq, outputs) -> None:
         self._sink.emit(outputs)
         self.metrics.counter("records_out").inc(len(seq))
+        self._book_tenant(len(seq))
         event_time_fn = getattr(self._source, "event_time_fn", None)
         if event_time_fn is not None:
             freshness = fresh_mod.freshness_for(self.metrics)
@@ -781,6 +794,7 @@ class Pipeline:
             for s in stamped[:: max(1, len(stamped) // 8)]:
                 lat.observe(now - s.t_enq)
             records_out.inc(len(stamped))
+            self._book_tenant(len(stamped))
             if stamped[0].offset <= self._replay_until:
                 replayed.inc(sum(
                     1 for s in stamped if s.offset <= self._replay_until

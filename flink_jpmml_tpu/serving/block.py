@@ -274,6 +274,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
                 self.metrics, best_model
             )
         self._current = bound
+        self.set_tenant(best_mid.key())
         self.swaps.inc()
         self.metrics.counter(f"scorer_backend_{bound.backend}").inc()
 

@@ -24,6 +24,7 @@ hot before the first event arrives.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
@@ -76,6 +77,7 @@ class ModelRegistry:
         warm_workers: int = 3,
         warm_join_timeout_s: float = 300.0,
         mesh=None,
+        metrics=None,
     ):
         """``mesh`` (a ``jax.sharding.Mesh``) makes every load/warm
         produce a mesh-aware ``ShardedModel`` (same predict/decode
@@ -83,6 +85,10 @@ class ModelRegistry:
         the incoming version for the mesh during the background warm, so
         the swap itself stays compile-free (C6 on a mesh)."""
         self._meta: managers.Metadata = {}
+        # name -> served versions, rebuilt on every _meta change: the
+        # per-event resolve() must not scan a 1,000-model zoo under the
+        # lock (it did, and the packed multi-tenant path paid it 6x)
+        self._by_name: Dict[str, List[int]] = {}
         self._compiled: Dict[ModelId, CompiledModel] = {}
         self._warming: Dict[ModelId, _WarmTask] = {}
         self._warm_failed: Dict[ModelId, BaseException] = {}
@@ -105,6 +111,25 @@ class ModelRegistry:
         # bounded join for in-flight warms (a wedged backend init must
         # surface as ModelLoadingException, not hang the scoring thread)
         self._warm_join_timeout_s = warm_join_timeout_s
+        # cold-start observability (ISSUE 17 satellite): every full
+        # parse+compile+jit — background warm or synchronous lazy load —
+        # lands in cold_start_s; resolve_warm books whether the
+        # double-buffer fallback found a warm body (warm_pool_hits) or
+        # came up empty (warm_pool_misses). Optional: a registry without
+        # a metrics registry stays silent, not broken.
+        self._metrics = metrics
+        self._h_cold = (
+            metrics.histogram("cold_start_s") if metrics is not None
+            else None
+        )
+        self._c_warm_hit = (
+            metrics.counter("warm_pool_hits") if metrics is not None
+            else None
+        )
+        self._c_warm_miss = (
+            metrics.counter("warm_pool_misses") if metrics is not None
+            else None
+        )
 
     @property
     def async_warmup(self) -> bool:
@@ -121,6 +146,7 @@ class ModelRegistry:
             if changed:
                 removed = set(self._meta) - set(new_meta)
                 self._meta = new_meta
+                self._reindex_locked()
                 for mid in removed:
                     self._compiled.pop(mid, None)
                     self._warm_failed.pop(mid, None)
@@ -155,6 +181,7 @@ class ModelRegistry:
                     meta = dict(self._meta)
                     meta[mid] = ModelInfo(path=msg.path)
                     self._meta = meta
+                    self._reindex_locked()
                     changed = True
                 cur = self._rollouts.get(msg.name)
                 if cur is not None and cur.candidate_version != msg.version:
@@ -166,6 +193,7 @@ class ModelRegistry:
                         meta = dict(self._meta)
                         del meta[old]
                         self._meta = meta
+                        self._reindex_locked()
                     self._compiled.pop(old, None)
                     self._warm_failed.pop(old, None)
                     events.append((
@@ -202,6 +230,7 @@ class ModelRegistry:
                         meta = dict(self._meta)
                         del meta[mid]
                         self._meta = meta
+                        self._reindex_locked()
                     self._compiled.pop(mid, None)
                     self._warm_failed.pop(mid, None)
                     events.append((
@@ -236,6 +265,12 @@ class ModelRegistry:
             ):
                 del self._rollouts[name]
 
+    def _reindex_locked(self) -> None:
+        by: Dict[str, List[int]] = {}
+        for mid in self._meta:
+            by.setdefault(mid.name, []).append(mid.version)
+        self._by_name = by
+
     def resolve(
         self, name: str, version: Optional[int] = None
     ) -> Optional[ModelId]:
@@ -250,11 +285,11 @@ class ModelRegistry:
                 return mid if mid in self._meta else None
             ro = self._rollouts.get(name)
             cand = ro.candidate_version if ro is not None else None
-            versions = [
-                m.version for m in self._meta
-                if m.name == name and m.version != cand
-            ]
-            return ModelId(name, max(versions)) if versions else None
+            best = max(
+                (v for v in self._by_name.get(name, ()) if v != cand),
+                default=None,
+            )
+            return ModelId(name, best) if best is not None else None
 
     def resolve_warm(self, name: str) -> Optional[ModelId]:
         """Newest *compiled-and-ready* version of ``name`` (the
@@ -268,7 +303,13 @@ class ModelRegistry:
                 mid.version for mid in self._compiled
                 if mid.name == name and mid.version != cand
             ]
-        return ModelId(name, max(versions)) if versions else None
+        if versions:
+            if self._c_warm_hit is not None:
+                self._c_warm_hit.inc()
+            return ModelId(name, max(versions))
+        if self._c_warm_miss is not None:
+            self._c_warm_miss.inc()
+        return None
 
     # -- rollout views -----------------------------------------------------
 
@@ -330,8 +371,11 @@ class ModelRegistry:
 
     def _warm_one(self, mid: ModelId, task: _WarmTask) -> None:
         try:
+            t0 = time.monotonic()
             compiled = self._load(task.info)
             self._prewarm(compiled)
+            if self._h_cold is not None:
+                self._h_cold.observe(time.monotonic() - t0)
             task.result = compiled
             with self._lock:
                 # attribute only to the registration this warm started
@@ -401,7 +445,12 @@ class ModelRegistry:
             if task.error is not None:
                 return self.model(mid)  # re-enter to raise the recorded error
             return task.result
+        t0 = time.monotonic()
         compiled = self._load(info)
+        if self._h_cold is not None:
+            # the synchronous lazy-load cold start: the stall the warm
+            # pool exists to avoid, so it books in the same histogram
+            self._h_cold.observe(time.monotonic() - t0)
         with self._lock:
             # attribute only to this registration (see _warm_one)
             if self._meta.get(mid) is info:
@@ -486,6 +535,7 @@ class ModelRegistry:
                     if cm is not None:
                         preserved[mid] = cm
             self._meta = meta
+            self._reindex_locked()
             self._compiled = preserved
             self._warm_failed.clear()
             # a rollout whose candidate vanished from the served map is

@@ -75,6 +75,53 @@ from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 RouteFn = Callable[[Any], Tuple[Optional[str], Optional[int], Any]]
 
 
+class _PackFetch:
+    """Memoized fetch of one packed multi-model dispatch, shared by the
+    member tickets riding it: the first member's ``finish`` pays the
+    FIFO wait, the rest read the cached tuple (or re-raise the cached
+    failure so each member runs its OWN solo devfault recovery)."""
+
+    __slots__ = ("_dispatcher", "handle", "_done", "_out", "_err")
+
+    def __init__(self, dispatcher, handle):
+        self._dispatcher = dispatcher
+        self.handle = handle
+        self._done = False
+        self._out = None
+        self._err: Optional[BaseException] = None
+
+    def result(self):
+        if not self._done:
+            try:
+                self._out = self._dispatcher.wait(self.handle)
+            except Exception as e:
+                self._err = e
+            self._done = True
+        if self._err is not None:
+            raise self._err
+        return self._out
+
+
+class _PackSlice:
+    """One member's view of a packed dispatch: the per-ticket 'handle'
+    whose fetch de-multiplexes the member's slot from the pack output
+    tuple (byte-identical to the member's solo dispatch — the pack's
+    core contract)."""
+
+    __slots__ = ("_shared", "slot")
+
+    def __init__(self, shared: _PackFetch, slot: int):
+        self._shared = shared
+        self.slot = slot
+
+    @property
+    def t_launch(self) -> float:
+        return self._shared.handle.t_launch
+
+    def fetch(self):
+        return self._shared.result()[self.slot]
+
+
 def default_route(event: Any) -> Tuple[Optional[str], Optional[int], Any]:
     if isinstance(event, tuple) and len(event) == 2:
         return event[0], None, event[1]
@@ -119,6 +166,7 @@ class DynamicScorer(Scorer):
         lane_fn: Optional[Callable[[Any], str]] = None,
         batcher=None,
         device_retry: Optional[bool] = None,
+        zoo=None,
     ):
         """``async_warmup=False`` disables background warming: a newly
         Added model compiles synchronously inside ``submit`` on its first
@@ -162,13 +210,32 @@ class DynamicScorer(Scorer):
         piggyback on this batch loop like the rollout controller's.
         ``batcher`` (an :class:`AdaptiveBatcher`) receives every
         micro-batch completion as a capacity observation, feeding the
-        persisted per-(model, backend) capacity model."""
+        persisted per-(model, backend) capacity model.
+
+        Multi-tenant zoo (serving/zoo.py): ``zoo=True`` (or a
+        :class:`~flink_jpmml_tpu.serving.zoo.ZooManager` instance)
+        turns on cross-model packed dispatch — pack-eligible per-model
+        groups of a micro-batch ride ONE device launch per planned
+        pack, with per-member outputs de-multiplexed byte-identically
+        to solo dispatch; the manager owns pack residency (LRU +
+        warm pool) and the per-tenant fairness quota."""
+        # metrics FIRST: the registry's cold-start accounting and the
+        # zoo manager both book into the shared registry
+        self.metrics = metrics or MetricsRegistry()
         self.registry = ModelRegistry(
             batch_size=batch_size,
             compile_config=compile_config,
             async_warmup=async_warmup,
             mesh=mesh,
+            metrics=self.metrics,
         )
+        self._batch_size = batch_size
+        if zoo is True:
+            from flink_jpmml_tpu.serving.zoo import ZooManager
+
+            zoo = ZooManager(metrics=self.metrics)
+        self._zoo = zoo or None
+        self._zoo_sync_needed = False
         self._control = control
         self._route = route or default_route
         self._default_model = (
@@ -181,7 +248,6 @@ class DynamicScorer(Scorer):
         self._replace_nan = replace_nan
         self._emit_pairs = emit_pairs
         self._emit = emit
-        self.metrics = metrics or MetricsRegistry()
         self._dispatcher = OverlappedDispatcher(
             depth=in_flight, metrics=self.metrics
         )
@@ -258,6 +324,10 @@ class DynamicScorer(Scorer):
                     )
                 if self.registry.apply(msg):
                     self._failed.clear()
+                    # a Del changes the zoo's membership multiset: the
+                    # manager must drop the dead tenant (and re-plan)
+                    # before the next pack dispatch
+                    self._zoo_sync_needed = True
 
     def submit(self, records: Sequence[Any]):
         self._drain_control()
@@ -277,6 +347,12 @@ class DynamicScorer(Scorer):
         # per-batch candidate-model cache: model_if_warm takes the
         # registry lock, and the answer cannot change within one batch
         cand_models: dict = {}
+        # per-batch (name, version) -> (model, key) memo for the plain
+        # (no-rollout) resolve branch: the answer cannot change within
+        # one batch, and a 100-tenant zoo micro-batch otherwise pays
+        # the registry lock + resolve once per EVENT instead of once
+        # per tenant
+        resolved: dict = {}
         unserved: List[int] = []
         shed: List[int] = []
         for i, event in enumerate(records):
@@ -325,54 +401,61 @@ class DynamicScorer(Scorer):
                 model = cand_model
                 key = ModelId(name, ro.candidate_version).key()
             else:
-                mid = self.registry.resolve(name, version)
-                key = mid.key() if mid else None
-                if mid is not None and not self.registry.async_warmup:
-                    # warming disabled: reference-style lazy load — the
-                    # compile happens synchronously in the operator, and
-                    # the batch loop stalls for it (the cost async_warmup
-                    # exists to avoid; see tests/test_async_serving.py SLO)
-                    if mid not in self._failed:
-                        try:
-                            model = self.registry.model(mid)
-                        except FlinkJpmmlTpuError:
-                            self._failed.add(mid)
-                            model = None
-                elif mid is not None:
-                    # double-buffered swap (SURVEY §8(d)): a ready model is
-                    # used as-is; while a *new* version is still compiling
-                    # in the background (or failed to), unpinned events
-                    # keep scoring the newest warm version and pinned-cold
-                    # events go empty — the batch loop never stalls on a
-                    # compile. Only the first deployment of a name (nothing
-                    # warm to serve) blocks, joining the in-flight warm.
-                    if mid not in self._failed:
-                        model = self.registry.model_if_warm(mid)
-                        if (
-                            model is None
-                            and self.registry.warm_error(mid) is not None
-                        ):
-                            self._failed.add(mid)
-                    if model is None:
-                        fb = self.registry.resolve_warm(name)
-                        if version is None and fb is not None and fb != mid:
-                            model = self.registry.model_if_warm(fb)
-                            if model is not None:
-                                key = fb.key()
-                        if model is None and mid not in self._failed:
-                            if fb is not None and self.registry.is_warming(
-                                mid
+                ck = (name, version)
+                hit = resolved.get(ck) if ro is None else None
+                if hit is not None:
+                    model, key = hit
+                else:
+                    mid = self.registry.resolve(name, version)
+                    key = mid.key() if mid else None
+                    if mid is not None and not self.registry.async_warmup:
+                        # warming disabled: reference-style lazy load — the
+                        # compile happens synchronously in the operator, and
+                        # the batch loop stalls for it (the cost async_warmup
+                        # exists to avoid; see tests/test_async_serving.py SLO)
+                        if mid not in self._failed:
+                            try:
+                                model = self.registry.model(mid)
+                            except FlinkJpmmlTpuError:
+                                self._failed.add(mid)
+                                model = None
+                    elif mid is not None:
+                        # double-buffered swap (SURVEY §8(d)): a ready model is
+                        # used as-is; while a *new* version is still compiling
+                        # in the background (or failed to), unpinned events
+                        # keep scoring the newest warm version and pinned-cold
+                        # events go empty — the batch loop never stalls on a
+                        # compile. Only the first deployment of a name (nothing
+                        # warm to serve) blocks, joining the in-flight warm.
+                        if mid not in self._failed:
+                            model = self.registry.model_if_warm(mid)
+                            if (
+                                model is None
+                                and self.registry.warm_error(mid) is not None
                             ):
-                                pass  # empty lanes this batch, no stall
-                            else:
-                                try:
-                                    model = self.registry.model(mid)
-                                except FlinkJpmmlTpuError:
-                                    # bad path / uncompilable document →
-                                    # lanes go empty, id quarantined, the
-                                    # stream lives
-                                    self._failed.add(mid)
-                                    model = None
+                                self._failed.add(mid)
+                        if model is None:
+                            fb = self.registry.resolve_warm(name)
+                            if version is None and fb is not None and fb != mid:
+                                model = self.registry.model_if_warm(fb)
+                                if model is not None:
+                                    key = fb.key()
+                            if model is None and mid not in self._failed:
+                                if fb is not None and self.registry.is_warming(
+                                    mid
+                                ):
+                                    pass  # empty lanes this batch, no stall
+                                else:
+                                    try:
+                                        model = self.registry.model(mid)
+                                    except FlinkJpmmlTpuError:
+                                        # bad path / uncompilable document →
+                                        # lanes go empty, id quarantined, the
+                                        # stream lives
+                                        self._failed.add(mid)
+                                        model = None
+                    if ro is None:
+                        resolved[ck] = (model, key)
             if model is None:
                 unserved.append(i)
                 continue
@@ -405,12 +488,16 @@ class DynamicScorer(Scorer):
                 g[2].append(payload)
 
         tickets = []
+        if self._zoo is not None:
+            self._submit_packed(groups, tickets, shed)
+        zoo_on = self._zoo is not None
         for key, (model, idxs, payloads, rollinfo) in groups.items():
             handle, scorer = self._launch_group(model, payloads)
             # model + payloads ride along so a device-classified fetch
             # failure can re-dispatch the group (runtime/devfault.py)
             tickets.append(
-                (scorer, idxs, handle, rollinfo, model, payloads)
+                (scorer, idxs, handle, rollinfo, model, payloads,
+                 key if zoo_on else None)
             )
         shadows = []
         for name, (model, idxs, payloads) in mirrors.items():
@@ -420,6 +507,94 @@ class DynamicScorer(Scorer):
             n, records, tickets, shadows, unserved, shed,
             time.monotonic(),
         )
+
+    def _submit_packed(self, groups, tickets, shed) -> None:
+        """Zoo fast path for one micro-batch: quota-shed oversize
+        tenants, then collapse pack-eligible per-model groups into one
+        device launch per planned pack (serving/zoo.py decides which
+        models share a buffer). Packed groups are POPPED from
+        ``groups``; the remainder launches solo as ever. Rollout-role
+        groups always stay solo — their per-role latency/error
+        accounting is the guardrail controller's signal and must not
+        blend into a shared launch."""
+        from flink_jpmml_tpu.compile import packs
+
+        if self._zoo_sync_needed:
+            self._zoo.sync({m.key() for m in self.registry.served})
+            self._zoo_sync_needed = False
+        quota = (
+            self._zoo.quota_rows(self._batch_size)
+            if self._batch_size else None
+        )
+        if quota is not None:
+            for key, g in groups.items():
+                if len(g[1]) > quota:
+                    # fairness over the shared slots: the excess rows
+                    # shed EXACTLY like admission-lane shedding — an
+                    # explicit empty prediction, never dispatched
+                    excess = g[1][quota:]
+                    g[1] = g[1][:quota]
+                    g[2] = g[2][:quota]
+                    shed.extend(excess)
+                    self.metrics.counter(
+                        f'tenant_shed_records{{model="{key}"}}'
+                    ).inc(len(excess))
+        eligible = {}
+        for key, g in groups.items():
+            if g[3] is not None:
+                continue
+            model = g[0]
+            qs = getattr(model, "quantized_scorer", None)
+            q = qs() if qs is not None else None
+            if (
+                q is not None
+                and packs.pack_eligible(q)
+                and len(g[2]) <= (q.batch_size or 0)
+            ):
+                eligible[key] = q
+        if not eligible:
+            return
+        for unit in self._zoo.batch_plan(eligible):
+            rows = {}
+            t0 = time.monotonic()
+            for slot, key in unit.slots:
+                model, _idxs, payloads, _ = groups[key]
+                q = eligible[key]
+                first = payloads[0]
+                if isinstance(first, dict):
+                    X, M = prepare.from_records(model.field_space, payloads)
+                else:
+                    X, M = prepare.from_dense(
+                        model.field_space,
+                        np.asarray(payloads, np.float32),
+                        self._replace_nan,
+                    )
+                # the pack always stages host-encoded rank codes — the
+                # byte-parity oracle every other encode path is pinned
+                # against — so a member's slot content is exactly its
+                # solo host dispatch's
+                rows[slot] = q.wire.encode(X, M)
+            Xp, total = unit.pack.assemble(rows)
+            self.metrics.counter("encode_s").inc(time.monotonic() - t0)
+            self.metrics.counter("h2d_bytes").inc(Xp.nbytes)
+            handle = self._dispatcher.launch(
+                lambda p=unit.pack, Xp=Xp: p.dispatch(Xp)
+            )
+            shared = _PackFetch(self._dispatcher, handle)
+            self._zoo.book_dispatch(unit, total)
+            for slot, key in unit.slots:
+                model, idxs, payloads, _ = groups.pop(key)
+                tickets.append((
+                    eligible[key], idxs, _PackSlice(shared, slot),
+                    None, model, payloads, key,
+                ))
+
+    def _wait_handle(self, handle):
+        """FIFO wait for a solo handle; memoized slot fetch for a
+        packed member's :class:`_PackSlice`."""
+        if isinstance(handle, _PackSlice):
+            return handle.fetch()
+        return self._dispatcher.wait(handle)
 
     def _launch_group(self, model, payloads):
         """Featurize + async-dispatch one per-model group through the
@@ -483,12 +658,13 @@ class DynamicScorer(Scorer):
         # FJT_DRIFT_SAMPLE armed it — the record-path sink is this
         # finish loop, so score sketches book here, per served model
         dplane = drift_mod.plane_for(self.metrics)
-        for scorer, idxs, handle, rollinfo, gmodel, payloads in tickets:
+        for (scorer, idxs, handle, rollinfo, gmodel, payloads,
+             tenant) in tickets:
             model = scorer
             role = rollinfo[1] if rollinfo is not None else None
             failed = False
             try:
-                out = self._dispatcher.wait(handle)
+                out = self._wait_handle(handle)
                 decoded = model.decode(out, len(idxs))
             except Exception as e:
                 kind = devfault.classify(e)
@@ -544,6 +720,16 @@ class DynamicScorer(Scorer):
                 # controller's prediction-PSI signal (windowed
                 # candidate-vs-incumbent divergence) reads these
                 self._record_score_dist(rollinfo[0], role, decoded)
+            if tenant is not None and not failed:
+                # per-tenant telemetry (zoo mode): counters/histograms
+                # labelled by served key merge fleet-wide like every
+                # other {model=*} family
+                self.metrics.counter(
+                    f'tenant_records{{model="{tenant}"}}'
+                ).inc(len(idxs))
+                self.metrics.histogram(
+                    f'tenant_latency_s{{model="{tenant}"}}'
+                ).observe(time.monotonic() - handle.t_launch)
             if dplane is not None and not failed:
                 dplane.record_predictions(model, decoded)
             for i, p in zip(idxs, decoded):

@@ -790,9 +790,16 @@ _GAUGE_MERGE_MAX_PREFIXES = (
     # the failover_state convention (0 healthy / 2 lost) — the fleet
     # view is the sickest worker's view of the chip
     "mesh_chip_state",
+    # multi-tenant zoo (serving/zoo.py): padded-waste fraction of the
+    # packed input buffers — the fleet view wants the worst buffer
+    "pack_pad_waste",
 )
 _GAUGE_MERGE_MIN_PREFIXES = (
     "slo_ok", "watermark_ts", "watermark_stage_ts", "adaptive_batch",
+    # multi-tenant zoo (serving/zoo.py): pack slot occupancy is a
+    # utilization fraction — the fleet view is the emptiest pack (the
+    # one wasting dispatches), so MIN, not a meaningless sum
+    "pack_occupancy",
     # multichip serving (obs/mesh.py): surviving data-axis width — the
     # fleet value is the most-degraded worker's mesh, never a sum
     "mesh_data_width",

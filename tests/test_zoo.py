@@ -1,0 +1,398 @@
+"""Multi-tenant packed scoring (ISSUE 17): the zoo's acceptance pins.
+
+- **Byte-identical packed-vs-solo.** The same interleaved multi-tenant
+  event stream through a ``zoo=True`` DynamicScorer and a packing-off
+  twin must produce bit-equal predictions per (tenant, record) — across
+  NaN lanes, ±inf cells, missing-key masks, mining-schema
+  ``missingValueReplacement``, and a pack mixing uint8 and uint16
+  wires in one shared buffer.
+- **Eviction / re-admit identity.** Under a starvation-level
+  ``FJT_ZOO_BYTES`` cap the LRU evicts packs between rounds; replaying
+  the identical round must reproduce identical bytes, with
+  ``zoo_evictions`` and ``warm_pool_hits`` proving the churn happened.
+- **Layout invalidation by model-SET hash** (the autotune satellite):
+  a tenant add/remove changes ``model_set_hash`` and therefore misses
+  the adopted plan; restoring the set restores the cached winner.
+- **Fairness quota.** ``FJT_TENANT_QUOTA_FRAC`` sheds a hog tenant's
+  excess rows as explicit empties (``tenant_shed_records{model=*}``)
+  without touching its neighbours.
+- **Cold-start accounting** (the registry satellite): every full
+  parse+compile+jit lands in ``cold_start_s``; ``resolve_warm`` books
+  ``warm_pool_hits`` / ``warm_pool_misses``.
+"""
+
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.models.control import AddMessage
+from flink_jpmml_tpu.models.core import ModelId
+from flink_jpmml_tpu.runtime.sources import ControlSource
+from flink_jpmml_tpu.serving.scorer import DynamicScorer
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+BATCH = 32
+
+
+# ---------------------------------------------------------------------------
+# Fixtures: a heterogeneous tenant mix (tree counts, field spaces,
+# wire dtypes, missing-value semantics)
+# ---------------------------------------------------------------------------
+
+MVR_XML = """<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <DataDictionary numberOfFields="3">
+    <DataField name="y" optype="continuous" dataType="double"/>
+    <DataField name="a" optype="continuous" dataType="double"/>
+    <DataField name="b" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <TreeModel functionName="regression" missingValueStrategy="defaultChild"
+             splitCharacteristic="binarySplit">
+    <MiningSchema>
+      <MiningField name="y" usageType="target"/>
+      <MiningField name="a" missingValueReplacement="0.25"/>
+      <MiningField name="b"/>
+    </MiningSchema>
+    <Node id="0" defaultChild="1"><True/>
+      <Node id="1" score="1.5">
+        <SimplePredicate field="a" operator="lessOrEqual" value="0.1"/>
+      </Node>
+      <Node id="2" score="-2.0">
+        <SimplePredicate field="a" operator="greaterThan" value="0.1"/>
+      </Node>
+    </Node>
+  </TreeModel>
+</PMML>"""
+
+
+def _stump_forest_xml(n_a=300, n_b=5):
+    """Depth-1 stump sum-forest with >254 distinct thresholds on ``a``
+    → the uint16 wire (the mixed-width pack member)."""
+    segs = []
+    i = 0
+    for field, n in (("a", n_a), ("b", n_b)):
+        for k in range(n):
+            thr = round(-3.0 + 6.0 * (k + 1) / (n + 1), 6)
+            i += 1
+            segs.append(f"""
+      <Segment><True/>
+        <TreeModel functionName="regression"
+                   missingValueStrategy="defaultChild"
+                   splitCharacteristic="binarySplit">
+          <MiningSchema><MiningField name="y" usageType="target"/>
+            <MiningField name="a"/><MiningField name="b"/></MiningSchema>
+          <Node id="r" defaultChild="l"><True/>
+            <Node id="l" score="{0.01 * i}">
+              <SimplePredicate field="{field}" operator="lessOrEqual"
+                               value="{thr}"/></Node>
+            <Node id="g" score="{-0.01 * i}">
+              <SimplePredicate field="{field}" operator="greaterThan"
+                               value="{thr}"/></Node>
+          </Node>
+        </TreeModel>
+      </Segment>""")
+    return f"""<PMML xmlns="http://www.dmg.org/PMML-4_3" version="4.3">
+  <Header/>
+  <DataDictionary numberOfFields="3">
+    <DataField name="y" optype="continuous" dataType="double"/>
+    <DataField name="a" optype="continuous" dataType="double"/>
+    <DataField name="b" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <MiningModel functionName="regression">
+    <MiningSchema><MiningField name="y" usageType="target"/>
+      <MiningField name="a"/><MiningField name="b"/></MiningSchema>
+    <Segmentation multipleModelMethod="sum">{"".join(segs)}
+    </Segmentation>
+  </MiningModel>
+</PMML>"""
+
+
+def _tenant_docs(tmp_path):
+    """name -> (path, fields): two GBM shapes, an MVR doc, a uint16
+    stump forest — four tenants, three field spaces, two wire dtypes."""
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+
+    g0 = gen_gbm(str(tmp_path), n_trees=3, depth=3, n_features=4,
+                 seed=7, name="zg0")
+    g1 = gen_gbm(str(tmp_path), n_trees=5, depth=2, n_features=4,
+                 seed=8, name="zg1")
+    mvr = tmp_path / "mvr.pmml"
+    mvr.write_text(MVR_XML)
+    wide = tmp_path / "wide.pmml"
+    wide.write_text(_stump_forest_xml())
+    gf = [f"f{j}" for j in range(4)]
+    return {
+        "gbm0": (g0, gf),
+        "gbm1": (g1, gf),
+        "mvr": (str(mvr), ["a", "b"]),
+        "wide": (str(wide), ["a", "b"]),
+    }
+
+
+def _build(docs, zoo, batch=BATCH, timeout_s=300.0):
+    ctrl = ControlSource()
+    sc = DynamicScorer(control=ctrl, batch_size=batch,
+                       auto_rollout=False, zoo=zoo)
+    for name, (path, _) in docs.items():
+        ctrl.push(AddMessage(name, 1, path, timestamp=time.time()))
+    sc._drain_control()
+    deadline = time.monotonic() + timeout_s
+    for name in docs:
+        mid = ModelId(name, 1)
+        while sc.registry.model_if_warm(mid) is None:
+            err = sc.registry.warm_error(mid)
+            assert err is None, f"{name} warm failed: {err!r}"
+            assert time.monotonic() < deadline, f"{name} never warmed"
+            time.sleep(0.01)
+    return sc
+
+
+def _events(docs, rows=BATCH, seed=5):
+    """One interleaved multi-tenant submit list with hostile lanes:
+    NaN, +inf, -inf, and missing keys (the mask — and for the MVR
+    tenant, the replacement path)."""
+    rng = np.random.default_rng(seed)
+    ev = []
+    for t, (name, (_, fields)) in enumerate(docs.items()):
+        for i in range(rows):
+            vals = rng.normal(0.0, 1.5, size=len(fields))
+            rec = dict(zip(fields, vals.tolist()))
+            k = i % 5
+            if k == 1:
+                rec[fields[i % len(fields)]] = float("nan")
+            elif k == 2:
+                rec[fields[i % len(fields)]] = float("inf")
+            elif k == 3:
+                rec[fields[i % len(fields)]] = float("-inf")
+            elif k == 4:
+                del rec[fields[i % len(fields)]]  # mask / MVR lane
+            rec["_key"] = f"{name}-{i}"
+            ev.append((name, rec))
+    # interleave tenants so every pack dispatch mixes them
+    by_t = [ev[t * rows:(t + 1) * rows] for t in range(len(docs))]
+    return [e for row in zip(*by_t) for e in row]
+
+
+def _sig(p):
+    """Bit-exact identity signature for one prediction."""
+    if p.is_empty:
+        return b"empty"
+    t = p.target
+    return (struct.pack("<d", float(p.score.value)),
+            None if t is None else repr(t))
+
+
+def _run(sc, ev):
+    return [_sig(p) for p, _ in sc.finish(sc.submit(ev))]
+
+
+# ---------------------------------------------------------------------------
+# Packed-vs-solo byte identity
+# ---------------------------------------------------------------------------
+
+class TestPackedSoloParity:
+    def test_byte_identity_hostile_lanes_mixed_wires(self, tmp_path):
+        docs = _tenant_docs(tmp_path)
+        sc_zoo = _build(docs, zoo=True)
+        sc_solo = _build(docs, zoo=False)
+        for rnd in range(3):
+            ev = _events(docs, seed=5 + rnd)
+            got = _run(sc_zoo, ev)
+            want = _run(sc_solo, ev)
+            assert got == want, f"packed-vs-solo divergence, round {rnd}"
+        counters = sc_zoo.metrics.struct_snapshot()["counters"]
+        assert counters.get("pack_dispatches", 0) > 0, (
+            "zoo never packed — the parity above proved nothing"
+        )
+        # a delivered (non-empty) lane exists for every tenant: the
+        # hostile lanes above must not have emptied a whole tenant
+        for name in docs:
+            n = counters.get(f'tenant_records{{model="{name}_1"}}', 0)
+            assert n > 0, f"tenant {name} delivered no records"
+
+    def test_pack_mixes_uint8_and_uint16_wires(self, tmp_path):
+        docs = _tenant_docs(tmp_path)
+        sc = _build(docs, zoo=True)
+        _run(sc, _events(docs))
+        packs_resident = list(sc._zoo._resident.values())
+        assert packs_resident, "no resident pack after a packed round"
+        dtypes = set()
+        for pk in packs_resident:
+            for info in pk._infos:
+                dtypes.add(np.dtype(info["dtype"]).name)
+        assert "uint16" in dtypes, "uint16 member never packed"
+        assert "uint8" in dtypes, "uint8 member never packed"
+        widened = [pk for pk in packs_resident
+                   if pk.in_dtype is np.uint16
+                   and any(i["dtype"] is np.uint8 for i in pk._infos)]
+        assert widened, (
+            "no pack actually shares a widened uint16 buffer across "
+            "mixed-width members — the exact-narrowing path is untested"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Eviction / re-admit identity
+# ---------------------------------------------------------------------------
+
+class TestEvictionReadmit:
+    def test_identity_across_eviction_churn(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FJT_PACK_MAX", "2")
+        monkeypatch.setenv("FJT_ZOO_BYTES", "1")  # nothing stays resident
+        monkeypatch.setenv("FJT_AUTOTUNE_DISABLE", "1")
+        docs = _tenant_docs(tmp_path)
+        sc_zoo = _build(docs, zoo=True)
+        sc_solo = _build(docs, zoo=False)
+        ev = _events(docs, seed=9)
+        want = _run(sc_solo, ev)
+        first = _run(sc_zoo, ev)
+        again = _run(sc_zoo, ev)  # replay after the LRU churned
+        assert first == want
+        assert again == want, "re-admitted pack broke byte identity"
+        counters = sc_zoo.metrics.struct_snapshot()["counters"]
+        assert counters.get("pack_dispatches", 0) > 0
+        assert counters.get("zoo_evictions", 0) > 0, (
+            "byte cap of 1 evicted nothing — the churn never happened"
+        )
+        assert counters.get("warm_pool_hits", 0) > 0, (
+            "re-admit never hit the warm pool — every round paid a "
+            "cold rebuild"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Layout invalidation: the model-SET hash (autotune satellite)
+# ---------------------------------------------------------------------------
+
+def _meta(trees, leaves=8, fields=4, dtype_rank=1.0):
+    return {
+        "trees": float(trees), "splits": float(trees * (leaves - 1)),
+        "leaves": float(leaves), "fields": float(fields),
+        "batch": float(BATCH), "dtype_rank": float(dtype_rank),
+        "classification": 0.0,
+    }
+
+
+class TestPlanSetHashInvalidation:
+    def test_set_hash_is_order_free_and_multiset_sensitive(self):
+        from flink_jpmml_tpu.compile.packs import model_set_hash
+
+        a = model_set_hash(["h1", "h2", "h3"])
+        assert a == model_set_hash(["h3", "h1", "h2"])
+        assert a != model_set_hash(["h1", "h2"])
+        assert a != model_set_hash(["h1", "h2", "h3", "h3"]), (
+            "two tenants sharing one document must change the set hash"
+        )
+
+    def test_tenant_add_remove_invalidates_adopted_plan(self):
+        from flink_jpmml_tpu.compile import autotune
+
+        metas4 = {f"m{i:02d}": _meta(3 + i) for i in range(4)}
+        plan1 = autotune.ensure_pack_plan(metas4)
+        assert plan1.source == "search"
+        assert {h for g in plan1.groups for h in g} == set(metas4)
+
+        # same set again: the adopted winner is served from the cache
+        plan1b = autotune.ensure_pack_plan(metas4)
+        assert plan1b.set_hash == plan1.set_hash
+        assert plan1b.groups == plan1.groups
+        assert plan1b.source != "search", (
+            "unchanged model set re-searched — the adopted layout "
+            "never persisted"
+        )
+
+        # tenant ADD: different set hash, fresh search over the union
+        metas5 = dict(metas4, m99=_meta(11))
+        plan2 = autotune.ensure_pack_plan(metas5)
+        assert plan2.set_hash != plan1.set_hash
+        assert {h for g in plan2.groups for h in g} == set(metas5)
+
+        # tenant REMOVE back to the original set: the stale 5-member
+        # winner must NOT serve — the original cached plan returns
+        plan3 = autotune.ensure_pack_plan(metas4)
+        assert plan3.set_hash == plan1.set_hash
+        assert plan3.groups == plan1.groups
+        assert "m99" not in {h for g in plan3.groups for h in g}
+
+
+# ---------------------------------------------------------------------------
+# Fairness quota
+# ---------------------------------------------------------------------------
+
+class TestQuotaShed:
+    def test_hog_sheds_neighbours_unharmed(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FJT_TENANT_QUOTA_FRAC", "0.25")
+        docs = _tenant_docs(tmp_path)
+        docs = {k: docs[k] for k in ("gbm0", "gbm1")}
+        sc = _build(docs, zoo=True)
+        quota = max(1, int(0.25 * BATCH))
+        rng = np.random.default_rng(3)
+        ev = []
+        for i in range(BATCH):  # the hog: a full batch of rows
+            rec = {f"f{j}": float(v)
+                   for j, v in enumerate(rng.normal(size=4))}
+            rec["_key"] = f"hog-{i}"
+            ev.append(("gbm0", rec))
+        for i in range(quota):  # the mouse: within quota
+            rec = {f"f{j}": float(v)
+                   for j, v in enumerate(rng.normal(size=4))}
+            rec["_key"] = f"mouse-{i}"
+            ev.append(("gbm1", rec))
+        out = sc.finish(sc.submit(ev))
+        assert len(out) == len(ev)
+        hog = [p for p, (_, r) in out if r["_key"].startswith("hog")]
+        mouse = [p for p, (_, r) in out if r["_key"].startswith("mouse")]
+        assert sum(1 for p in hog if not p.is_empty) == quota
+        assert sum(1 for p in hog if p.is_empty) == BATCH - quota, (
+            "shed rows must surface as explicit empties (C5 totality)"
+        )
+        assert all(not p.is_empty for p in mouse), (
+            "the quota shed a tenant that was inside its share"
+        )
+        counters = sc.metrics.struct_snapshot()["counters"]
+        assert counters.get(
+            'tenant_shed_records{model="gbm0_1"}', 0
+        ) == BATCH - quota
+        assert counters.get(
+            'tenant_shed_records{model="gbm1_1"}', 0
+        ) == 0
+
+
+# ---------------------------------------------------------------------------
+# Cold-start accounting (registry satellite)
+# ---------------------------------------------------------------------------
+
+class TestColdStartAccounting:
+    def test_resolve_warm_books_hits_misses_and_cold_start(
+        self, tmp_path
+    ):
+        from flink_jpmml_tpu.serving.registry import ModelRegistry
+
+        path = tmp_path / "m.pmml"
+        path.write_text(MVR_XML)
+        metrics = MetricsRegistry()
+        reg = ModelRegistry(batch_size=BATCH, metrics=metrics)
+        reg.apply(AddMessage("m", 1, str(path), timestamp=1.0))
+
+        assert reg.resolve_warm("m") is None  # served but still cold
+        mid = ModelId("m", 1)
+        deadline = time.monotonic() + 120.0
+        while reg.model_if_warm(mid) is None:  # kicks the warm
+            assert reg.warm_error(mid) is None
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert reg.resolve_warm("m") == mid
+
+        snap = metrics.struct_snapshot()
+        counters = snap["counters"]
+        assert counters.get("warm_pool_misses", 0) >= 1
+        assert counters.get("warm_pool_hits", 0) >= 1
+        hist = (snap.get("histograms") or {}).get("cold_start_s")
+        assert hist is not None, "cold start never hit cold_start_s"
+        from flink_jpmml_tpu.utils.metrics import Histogram
+
+        h = Histogram.from_state(hist)
+        assert h.count() >= 1
+        assert (h.quantile(0.5) or 0) > 0

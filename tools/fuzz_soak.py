@@ -510,6 +510,166 @@ def _soak_mesh_chaos(seed):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_ZOO_DOCS = []
+
+
+def _zoo_docs():
+    """Six tiny distinct GBMs, built once per soak process (the chaos
+    seeds churn tenants, not documents)."""
+    if not _ZOO_DOCS:
+        import tempfile
+
+        from flink_jpmml_tpu.assets_gen import gen_gbm
+
+        tmp = tempfile.mkdtemp(prefix="fjt-zoochaos-docs-")
+        _ZOO_DOCS.extend(
+            gen_gbm(tmp, n_trees=4 + i, depth=3, n_features=4,
+                    seed=70 + i, name=f"zc{i}")
+            for i in range(6)
+        )
+    return _ZOO_DOCS
+
+
+def _soak_zoo_chaos(seed):
+    """One ZOO chaos iteration: seeded tenant churn (Del / re-Add /
+    version bump) composed with device faults against a zoo-enabled
+    DynamicScorer. Verifies the per-tenant delivery contract every
+    round: every submitted record gets exactly one prediction (C5
+    totality), warm-served tenants' lanes are non-empty — a device
+    fault mid-pack must redispatch, never surface — and unserved
+    (churned-out) tenants' lanes are empty, never misrouted to a
+    packmate."""
+    import os
+    import time as _t
+
+    from flink_jpmml_tpu.models.control import AddMessage, DelMessage
+    from flink_jpmml_tpu.models.core import ModelId
+    from flink_jpmml_tpu.runtime import faults
+    from flink_jpmml_tpu.runtime.sources import ControlSource
+    from flink_jpmml_tpu.serving.scorer import DynamicScorer
+
+    rng = np.random.default_rng(seed)
+    docs = _zoo_docs()
+    tenants = [f"zc{i}" for i in range(len(docs))]
+    fields = [f"f{j}" for j in range(4)]
+    data = rng.normal(0, 1.2, size=(4096, 4)).astype(np.float32)
+    data[rng.random(size=data.shape) < 0.02] = np.nan
+
+    os.environ["FJT_RETRY_BASE_S"] = "0.01"
+    ctrl = ControlSource()
+    sc = DynamicScorer(control=ctrl, batch_size=128, auto_rollout=False,
+                       zoo=True)
+    version = {}
+    served = {}  # name -> every version currently registered: a Del
+    # must cover ALL of them — deleting only the newest correctly
+    # falls back to the older served version (latest-wins), which is
+    # not "dead"
+    for i, name in enumerate(tenants):
+        version[name] = 1
+        served[name] = {1}
+        ctrl.push(AddMessage(name, 1, docs[i], timestamp=_t.time()))
+    sc._drain_control()
+    live = set(tenants)
+
+    def wait_live(timeout_s=120.0):
+        deadline = _t.monotonic() + timeout_s
+        for name in sorted(live):
+            mid = ModelId(name, version[name])
+            while sc.registry.model_if_warm(mid) is None:
+                err = sc.registry.warm_error(mid)
+                assert err is None, (
+                    f"zoo chaos seed={seed}: {mid.key()} warm "
+                    f"failed {err!r}"
+                )
+                assert _t.monotonic() < deadline, (
+                    f"zoo chaos seed={seed}: {mid.key()} never warmed"
+                )
+                _t.sleep(0.005)
+
+    wait_live()
+    cursor = 0
+    try:
+        for rnd in range(8):
+            # seeded churn between rounds: Del a live tenant, revive a
+            # dead one, or bump a live tenant's version (same document
+            # - the swap re-packs, the outputs stay total)
+            act = rng.integers(0, 4)
+            if act == 0 and len(live) > 2:
+                victim = sorted(live)[int(rng.integers(0, len(live)))]
+                # a version bump leaves the PRIOR version served;
+                # latest-wins routing falls back to it after a Del of
+                # the newest — "dead" means NO version remains, so the
+                # Del must cover every version ever registered
+                for v in sorted(served[victim]):
+                    ctrl.push(DelMessage(victim, v,
+                                         timestamp=_t.time()))
+                served[victim] = set()
+                live.discard(victim)
+            elif act == 1 and len(live) < len(tenants):
+                dead = sorted(set(tenants) - live)
+                name = dead[int(rng.integers(0, len(dead)))]
+                version[name] += 1
+                served[name].add(version[name])
+                ctrl.push(AddMessage(
+                    name, version[name], docs[tenants.index(name)],
+                    timestamp=_t.time(),
+                ))
+                live.add(name)
+            elif act == 2:
+                name = sorted(live)[int(rng.integers(0, len(live)))]
+                version[name] += 1
+                served[name].add(version[name])
+                ctrl.push(AddMessage(
+                    name, version[name], docs[tenants.index(name)],
+                    timestamp=_t.time(),
+                ))
+            sc._drain_control()
+            wait_live()
+            if rng.random() < 0.6:
+                # readback site only: the record-path scorer's fault
+                # ladder lives in finish() (classify → redispatch); a
+                # launch-time fault propagates to the BLOCK pipelines'
+                # direct-dispatch handler, which this soak doesn't drive
+                # streaks stay within the FJT_DEVICE_RETRIES budget
+                # (2): the record path has no fallback tier below the
+                # retry ladder — a longer streak escalates BY CONTRACT
+                kind = ("device_error", "device_oom")[
+                    int(rng.integers(0, 2))
+                ]
+                faults.inject(kind, site="device_readback",
+                              n=int(rng.integers(1, 3)))
+            rows = int(rng.integers(8, 64))
+            ev, owner = [], []
+            for name in tenants:
+                for _ in range(rows):
+                    rec = dict(zip(
+                        fields, data[cursor % len(data)].tolist()
+                    ))
+                    rec["_key"] = f"k{cursor}"
+                    cursor += 1
+                    ev.append((name, rec))
+                    owner.append(name)
+            out = sc.finish(sc.submit(ev))
+            assert len(out) == len(ev), (
+                f"zoo chaos seed={seed} round={rnd}: "
+                f"{len(out)} predictions for {len(ev)} records"
+            )
+            for (p, _), name in zip(out, owner):
+                if name in live:
+                    assert not p.is_empty, (
+                        f"zoo chaos seed={seed} round={rnd}: live "
+                        f"tenant {name} got an empty lane"
+                    )
+                else:
+                    assert p.is_empty, (
+                        f"zoo chaos seed={seed} round={rnd}: dead "
+                        f"tenant {name} got a prediction (misrouted "
+                        "packmate output)"
+                    )
+    finally:
+        faults.clear()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--families", default=",".join(FAMILIES))
@@ -527,6 +687,12 @@ def main() -> int:
                          "a mesh-sharded pipeline (simulated 8-device "
                          "host), verifying degraded-mesh serving under "
                          "churn")
+    ap.add_argument("--zoo", action="store_true",
+                    help="with --chaos: the ZOO profile instead — "
+                         "tenant churn (Del/re-Add/version bump) "
+                         "composed with device faults against the "
+                         "packed multi-tenant scorer, verifying the "
+                         "per-tenant delivery contract")
     args = ap.parse_args()
 
     if args.mesh:
@@ -545,8 +711,12 @@ def main() -> int:
     print(f"backend: {jax.default_backend()}", flush=True)
     failures = 0
     if args.chaos:
-        fn = _soak_mesh_chaos if args.mesh else _soak_chaos
-        name = "mesh-chaos" if args.mesh else "chaos"
+        if args.zoo:
+            fn, name = _soak_zoo_chaos, "zoo-chaos"
+        elif args.mesh:
+            fn, name = _soak_mesh_chaos, "mesh-chaos"
+        else:
+            fn, name = _soak_chaos, "chaos"
         t0 = time.perf_counter()
         ok = 0
         for s in range(args.start, args.start + args.seeds):

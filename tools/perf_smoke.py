@@ -88,6 +88,7 @@ exit 0 = healthy, 1 = assertion failure, 2 = watchdog fired.
 import faulthandler
 import os
 import pathlib
+import shutil
 import sys
 import tempfile
 import threading
@@ -1345,6 +1346,126 @@ def check_mesh_gate_noop() -> None:
     assert mesh_in_flight(None, 2) == 2
 
 
+def check_zoo_pack() -> None:
+    """Multi-tenant packed-scoring tripwire: byte parity packed-vs-solo
+    (zero cross-tenant leakage), LRU eviction + warm-pool re-admit
+    identity under a 1-byte FJT_ZOO_BYTES cap, and a lenient
+    pack-vs-solo wall-clock ratio. (The 1,000-model acceptance capture
+    is ``bench.py --zoo``; this guards the pack path's correctness on
+    every smoke run.)"""
+    import time
+
+    import numpy as np
+
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.models.control import AddMessage
+    from flink_jpmml_tpu.models.core import ModelId
+    from flink_jpmml_tpu.runtime.sources import ControlSource
+    from flink_jpmml_tpu.serving.scorer import DynamicScorer
+
+    tmp = tempfile.mkdtemp(prefix="fjt-smoke-zoo-")
+    tenants, features, rows = 6, 4, 64
+    docs = [
+        gen_gbm(tmp, n_trees=4 + i, depth=3, n_features=features,
+                seed=50 + i, name=f"z{i}")
+        for i in range(tenants)
+    ]
+    fields = [f"f{j}" for j in range(features)]
+    rng = np.random.default_rng(5)
+    data = rng.normal(0.0, 1.0, size=(
+        tenants * rows * 8, features)).astype(np.float32)
+    data[rng.random(size=data.shape) < 0.02] = np.nan  # missing lanes
+
+    def build(zoo):
+        ctrl = ControlSource()
+        sc = DynamicScorer(control=ctrl, batch_size=256,
+                           auto_rollout=False, zoo=zoo)
+        for i in range(tenants):
+            ctrl.push(AddMessage(f"z{i}", 1, docs[i],
+                                 timestamp=time.time()))
+        sc._drain_control()
+        deadline = time.monotonic() + 120.0
+        for i in range(tenants):
+            mid = ModelId(f"z{i}", 1)
+            while sc.registry.model_if_warm(mid) is None:
+                assert sc.registry.warm_error(mid) is None, mid.key()
+                assert time.monotonic() < deadline, (
+                    f"{mid.key()} never warmed"
+                )
+                time.sleep(0.01)
+        return sc
+
+    def batch(round_i):
+        ev = []
+        for i in range(tenants):
+            base = (round_i * tenants + i) * rows
+            for j in range(rows):
+                rec = dict(zip(
+                    fields, data[(base + j) % len(data)].tolist()
+                ))
+                rec["_key"] = f"k{base + j}"
+                ev.append((f"z{i}", rec))
+        return ev
+
+    def run(sc, rounds):
+        out = []
+        for r in rounds:
+            for p, _ in sc.finish(sc.submit(batch(r))):
+                out.append(None if p.is_empty else p.score.value)
+        return out
+
+    sc_solo = build(None)
+
+    # tight caps: width-2 packs, a byte cap that can hold exactly one —
+    # every group admit evicts the previous pack, round 2 re-admits
+    # from the warm pool; parity across both rounds pins the
+    # eviction/re-admit identity
+    env_keys = ("FJT_PACK_MAX", "FJT_ZOO_BYTES", "FJT_AUTOTUNE_DISABLE")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update({"FJT_PACK_MAX": "2", "FJT_ZOO_BYTES": "1",
+                       "FJT_AUTOTUNE_DISABLE": "1"})
+    try:
+        sc_zoo = build(True)
+        want = run(sc_solo, [0, 1])
+        got = run(sc_zoo, [0, 1])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert got == want, (
+        "packed-vs-solo parity broke (cross-tenant leakage or "
+        "reduction-order drift)"
+    )
+    c = sc_zoo.metrics.struct_snapshot()["counters"]
+    assert c.get("pack_dispatches", 0) > 0, "zoo never packed a dispatch"
+    assert c.get("zoo_evictions", 0) > 0, (
+        "1-byte FJT_ZOO_BYTES cap never evicted a pack"
+    )
+    assert c.get("warm_pool_hits", 0) > 0, (
+        "round 2 rebuilt its packs instead of re-admitting from the "
+        "warm pool"
+    )
+
+    # lenient wall-clock tripwire under default caps (one wide pack,
+    # no thrash): packed dispatch must not be pathologically slower
+    # than solo — the real >=75% throughput gate lives in bench --zoo
+    sc_fast = build(True)
+    run(sc_fast, [0])  # plan + pack compile outside timing
+    t0 = time.perf_counter()
+    run(sc_fast, [1, 2, 3])
+    dt_zoo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(sc_solo, [1, 2, 3])
+    dt_solo = time.perf_counter() - t0
+    assert dt_zoo <= 3.0 * dt_solo + 0.25, (
+        f"packed path took {dt_zoo:.3f}s vs solo {dt_solo:.3f}s "
+        "(> 3x tripwire)"
+    )
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     timer = threading.Timer(WATCHDOG_S, _watchdog)
     timer.daemon = True
@@ -1383,6 +1504,8 @@ def main() -> int:
     print("perf-smoke: fault hooks no-op OK", flush=True)
     check_mesh_gate_noop()
     print("perf-smoke: mesh gate no-op OK", flush=True)
+    check_zoo_pack()
+    print("perf-smoke: zoo pack OK", flush=True)
     timer.cancel()
     return 0
 
