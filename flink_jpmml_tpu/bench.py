@@ -2101,6 +2101,327 @@ def run_overload_drill(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_HISTORY_WORKER = r'''
+import os, sys, time
+sys.path.insert(0, sys.argv[2])
+hist_dir = sys.argv[1]
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+from flink_jpmml_tpu.obs import history
+from flink_jpmml_tpu.serving.overload import (
+    AdaptiveBatcher, AdmissionController,
+)
+
+m = MetricsRegistry()
+# teach the capacity model a ~10k rec/s fit through the production
+# observe() -> refit path (c1 = 1e-4 s/record -> capacity_rec_s = 10k),
+# so the recorder's headroom telemetry reads the same gauge a serving
+# worker would publish
+batcher = AdaptiveBatcher(
+    metrics=m, model="hist-drill", backend="cpu",
+    path=os.path.join(hist_dir, "capacity_model.json"),
+)
+for _rep in range(6):
+    for n in (64, 128, 256, 512):
+        batcher.observe(n, 0.002 + 1e-4 * n)
+admission = AdmissionController(
+    m, lanes=("valid",), interval_s=0.02, dwell_s=0.05,
+    on_threshold=0.6, off_threshold=0.3,
+)
+rec = history.install(
+    m, directory=hist_dir, src="w0", interval_s=0.1,
+    resolutions=(0.1, 1.0), start_thread=False,
+)
+c_in = m.counter("records_in")
+c_out = m.counter("records_out")
+g_p = m.gauge("pressure")
+h_lat = m.histogram("batch_latency_s")
+# synthetic members of the catalogued tenant_records{model="*"} family
+# (names prebuilt: the serving plane owns the literal emission site)
+tenants = ["seg%02d" % i for i in range(int(sys.argv[3]))]
+tnames = ['tenant_records{model="%s"}' % t for t in tenants]
+tcs = [m.counter(n) for n in tnames]
+weights = [1.0 / (i + 1) for i in range(len(tenants))]
+wsum = sum(weights)
+capacity = 10000.0
+print("READY", flush=True)
+t0 = time.time()
+while True:  # runs until the parent SIGKILLs it mid-incident
+    now = time.time()
+    el = now - t0
+    # the incident: offered load ramps 25% -> 160% of fitted capacity
+    # over ~1.1 s and holds there until the kill
+    offered = capacity * min(0.25 + 1.2 * el, 1.6)
+    n = max(1, int(offered * 0.02))
+    c_in.inc(n)
+    g_p.set(min(1.0, 0.625 * offered / capacity))
+    admission.maybe_tick()
+    if admission.admit("valid", n):
+        c_out.inc(n)
+        for w, tc in zip(weights, tcs):
+            k = int(n * w / wsum)
+            if k:
+                tc.inc(k)
+    h_lat.observe(0.002 + 1e-4 * n)
+    rec.maybe_capture(now)
+    time.sleep(0.02)
+'''
+
+
+def run_history_drill(
+    tenants: int = 30,
+    max_series: int = 8,
+    zoo_scale: int = 1000,
+    timeout_s: float = 60.0,
+) -> dict:
+    """``--history-drill``: the incident-replay acceptance drill. A
+    child process arms the telemetry history plane (0.1 s frames
+    cascading to 1 s, ``FJT_METRICS_MAX_SERIES`` governing its
+    per-tenant families) and drives a real overload incident — the
+    production AdmissionController shedding on a rising pressure gauge,
+    the AdaptiveBatcher's fitted ``capacity_rec_s`` feeding per-frame
+    headroom. The parent waits until the incident is in full swing
+    (shed counters recorded, headroom collapsed), then **SIGKILLs the
+    child mid-append** and reconstructs the whole story from the
+    durable frames ALONE:
+
+    - pressure rise, a non-zero shed counter trail, and the headroom
+      collapse are all read back from disk across the process death;
+    - the governed per-tenant table stays within the series bound in
+      every frame, with an exact-sum ``_other`` fold;
+    - the cascaded 1 s frames equal direct downsamples of the 0.1 s
+      frames BITWISE (canonical JSON equality), and the fleet merge is
+      invariant under adversarial input orderings — on this very run's
+      frames, not synthetic ones;
+    - ``fjt-replay`` renders the timeline and the zoo/overload panels
+      from the directory;
+    - separately, a ``zoo_scale``-tenant registry is governed through
+      the same path a ``/metrics`` scrape and a heartbeat frame use,
+      asserting the series bound with fleet totals exact.
+
+    Raises AssertionError on violation; → the drill's JSON line."""
+    import contextlib
+    import io
+    import random
+    import signal
+
+    from flink_jpmml_tpu import cli
+    from flink_jpmml_tpu.obs import history
+    from flink_jpmml_tpu.utils.metrics import (
+        MetricsRegistry, govern_struct,
+    )
+
+    t0 = time.monotonic()
+    tmp = tempfile.mkdtemp(prefix="fjt-history-")
+    hist = os.path.join(tmp, "history")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = None
+    try:
+        env = dict(os.environ)
+        env["FJT_METRICS_MAX_SERIES"] = str(max_series)
+        env.pop("FJT_HISTORY_DIR", None)  # the child gets an explicit dir
+        env.pop("FJT_HISTORY_RES", None)
+        env.pop("FJT_HISTORY_INTERVAL_S", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _HISTORY_WORKER, hist, repo,
+             str(tenants)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+
+        def _gv(frame, name):
+            g = (frame.get("gauges") or {}).get(name)
+            if not isinstance(g, dict):
+                return None
+            return history.combined_last(name, g.get("last"))
+
+        def _shed_total(frames):
+            tot = 0.0
+            for f in frames:
+                for n, v in (f.get("counters") or {}).items():
+                    if n.split("{", 1)[0] == "shed_records":
+                        tot += history.wire_float(v)
+            return tot
+
+        # wait for the incident to be fully on disk: shed counters
+        # recorded AND headroom collapsed in some frame
+        deadline = time.monotonic() + timeout_s
+        frames = []
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                err = proc.stderr.read().decode(errors="replace")
+                raise AssertionError(
+                    f"history worker died rc={proc.returncode}: "
+                    f"{err[-2000:]}"
+                )
+            frames = history.read_frames(hist, res=0.1)
+            if (
+                _shed_total(frames) > 0
+                and any(
+                    (h := _gv(f, "headroom_frac")) is not None
+                    and h < 0.1
+                    for f in frames
+                )
+                and any(
+                    (p := _gv(f, "pressure")) is not None and p > 0.9
+                    for f in frames
+                )
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"incident never fully recorded within {timeout_s}s "
+                f"({len(frames)} frames, shed={_shed_total(frames)})"
+            )
+        # mid-incident, mid-append-cadence: the torn-tail case
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10.0)
+
+        # -- everything below reads the durable frames ALONE ---------------
+        fine = history.read_frames(hist, res=0.1)
+        assert len(fine) >= 5, f"only {len(fine)} fine frames survived"
+
+        # pressure rise + headroom collapse, reconstructed from disk
+        p_first = _gv(fine[0], "pressure")
+        p_peak = max(
+            (p for f in fine if (p := _gv(f, "pressure")) is not None),
+            default=None,
+        )
+        assert p_first is not None and p_peak is not None
+        assert p_first < 0.35 and p_peak > 0.9, (
+            f"pressure rise not reconstructed: first {p_first} "
+            f"peak {p_peak}"
+        )
+        heads = [
+            h for f in fine
+            if (h := _gv(f, "headroom_frac")) is not None
+        ]
+        assert heads and heads[0] > 0.3 and min(heads) < 0.1, (
+            f"headroom collapse not reconstructed: {heads[:3]}... "
+            f"min {min(heads) if heads else None}"
+        )
+        shed_records = _shed_total(fine)
+        assert shed_records > 0, "no shed counters in the durable frames"
+
+        # the governed per-tenant table: bounded in EVERY frame, with
+        # the exact-sum _other fold present once folding began
+        tseries_max = 0
+        saw_other = False
+        for f in fine:
+            tnames = [
+                n for n in (f.get("counters") or {})
+                if n.split("{", 1)[0] == "tenant_records"
+            ]
+            tseries_max = max(tseries_max, len(tnames))
+            saw_other = saw_other or any(
+                '="_other"' in n for n in tnames
+            )
+        assert 0 < tseries_max <= max_series, (
+            f"tenant series bound violated: {tseries_max} > {max_series}"
+        )
+        assert saw_other, "governor never folded a _other series"
+
+        # bitwise commutation ON THIS RUN: cascaded 1 s frames vs
+        # direct downsamples of the fine frames, slot by slot
+        coarse = history.read_frames(hist, res=1.0)
+        direct = {
+            int(f["t0"] // 1.0): f
+            for f in history.downsample(fine, 1.0)
+        }
+        matched = 0
+        for f in coarse:
+            d = direct.get(int(f["t0"] // 1.0))
+            assert d is not None, f"cascaded slot {f['t0']} not in direct"
+            assert history.canonical(f) == history.canonical(d), (
+                f"cascade != direct downsample at t0={f['t0']}"
+            )
+            matched += 1
+        assert matched >= 1, "no complete coarse slot survived the kill"
+
+        # merge invariance under adversarial orderings, same frames
+        shuffled = list(fine)
+        random.Random(11).shuffle(shuffled)
+        assert history.canonical(
+            history.merge_frames(fine)
+        ) == history.canonical(history.merge_frames(shuffled)), (
+            "merge not order-invariant on the drill's own frames"
+        )
+
+        # fjt-replay renders the incident from the directory
+        buf_zoo, buf_over = io.StringIO(), io.StringIO()
+        with contextlib.redirect_stdout(buf_zoo):
+            rc = cli.replay_main([hist, "--step", "1", "--panel", "zoo"])
+        assert rc == 0, f"fjt-replay --panel zoo rc={rc}"
+        out_zoo = buf_zoo.getvalue()
+        assert "seg00" in out_zoo and "_other" in out_zoo, (
+            f"replayed zoo table missing top tenant / _other:\n{out_zoo}"
+        )
+        with contextlib.redirect_stdout(buf_over):
+            rc = cli.replay_main(
+                [hist, "--step", "1", "--panel", "overload"]
+            )
+        assert rc == 0, f"fjt-replay --panel overload rc={rc}"
+        assert "shed" in buf_over.getvalue(), (
+            "replayed overload panel missing shed counters"
+        )
+
+        # zoo-scale governor: 1000 tenants through the same fold the
+        # /metrics page and the heartbeat frame apply — bounded series,
+        # fleet totals EXACT
+        zm = MetricsRegistry()
+        for i in range(zoo_scale):
+            zname = 'tenant_records{model="z%04d"}' % i
+            zm.counter(zname).inc(i + 1)
+        governed = govern_struct(
+            zm.struct_snapshot(), max_series=max_series
+        )
+        znames = [
+            n for n in governed["counters"]
+            if n.split("{", 1)[0] == "tenant_records"
+        ]
+        assert len(znames) == max_series, (
+            f"zoo-scale page not bounded: {len(znames)} series"
+        )
+        ztotal = sum(governed["counters"][n] for n in znames)
+        assert ztotal == zoo_scale * (zoo_scale + 1) / 2, (
+            f"governed fleet total inexact: {ztotal}"
+        )
+
+        return {
+            "metric": "history_drill",
+            "ok": True,
+            "checks": {
+                "survives_sigkill_mid_append": True,
+                "pressure_rise_reconstructed": True,
+                "headroom_collapse_reconstructed": True,
+                "shed_trail_reconstructed": True,
+                "tenant_table_governed": True,
+                "cascade_bitwise_equals_direct": True,
+                "merge_order_invariant": True,
+                "replay_renders_panels": True,
+                "zoo_scale_totals_exact": True,
+            },
+            "fine_frames": len(fine),
+            "coarse_frames_matched": matched,
+            "shed_records": int(shed_records),
+            "pressure_first": round(p_first, 4),
+            "pressure_peak": round(p_peak, 4),
+            "headroom_first": round(heads[0], 4),
+            "headroom_min": round(min(heads), 4),
+            "tenant_series_max": tseries_max,
+            "max_series": max_series,
+            "zoo_scale": zoo_scale,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+    finally:
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+                proc.wait(timeout=5.0)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 _DEVFAULT_WORKER = r'''
 import os, sys, time
 # per-incarnation fault seed BEFORE the package imports (env faults arm
@@ -3745,6 +4066,23 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="records per rollout-drill phase")
     ap.add_argument("--rollout-fraction", type=float, default=0.2,
                     help="canary traffic share the drill asserts")
+    ap.add_argument("--history-drill", action="store_true",
+                    help="run the incident-replay acceptance drill "
+                         "instead of the perf capture: a child process "
+                         "records governed telemetry history through a "
+                         "real overload incident, the parent SIGKILLs "
+                         "it mid-append and reconstructs the incident "
+                         "(pressure rise, shed trail, headroom "
+                         "collapse, governed tenant table) from the "
+                         "durable frames alone, with the downsample/"
+                         "merge commutation asserted bitwise on the "
+                         "same run's frames")
+    ap.add_argument("--history-tenants", type=int, default=30,
+                    help="synthetic tenants the history drill's child "
+                         "books per-tenant counters for")
+    ap.add_argument("--history-max-series", type=int, default=8,
+                    help="FJT_METRICS_MAX_SERIES bound the history "
+                         "drill governs under")
     ap.add_argument("--drift-drill", action="store_true",
                     help="run the data-drift acceptance drill instead "
                          "of the perf capture: perturb one feature's "
@@ -3879,6 +4217,22 @@ def main() -> None:
         except AssertionError as e:
             print(json.dumps({
                 "metric": "overload_drill", "ok": False, "error": str(e),
+            }))
+            sys.exit(1)
+        print(json.dumps(line))
+        return
+
+    if args.history_drill:
+        # observability drill, not a perf capture: the child is a
+        # jax-free synthetic-load process, so no probe dance needed
+        try:
+            line = run_history_drill(
+                tenants=args.history_tenants,
+                max_series=args.history_max_series,
+            )
+        except AssertionError as e:
+            print(json.dumps({
+                "metric": "history_drill", "ok": False, "error": str(e),
             }))
             sys.exit(1)
         print(json.dumps(line))

@@ -61,6 +61,16 @@ either way), or a BENCH artifact:
     fjt-trace /data/ckpt --grep offset=1374   # who touched record 1374?
     fjt-trace http://127.0.0.1:9100 --slowest 5
     fjt-trace BENCH_r13.json --id 3fa1…       # the fjt-top exemplar pivot
+
+``fjt-replay``: retrospective incident replay from the durable
+telemetry history (obs/history.py) — a per-window timeline (records,
+shed, pressure, offered vs capacity, headroom) plus any fjt-top panel
+rendered over the merged range, reconstructed from on-disk frames
+alone, so it works after every involved process is dead:
+
+    fjt-replay /data/history --last 600 --step 15
+    fjt-replay http://127.0.0.1:9100 --panel zoo
+    fjt-replay /data/history --source _fleet --panel overload
 """
 
 from __future__ import annotations
@@ -945,7 +955,9 @@ def top_main(argv: Optional[List[str]] = None) -> int:
         )
     )
 
-    def _render_once(sources) -> None:
+    def _render_once(sources, stale_after=None, now=None) -> None:
+        from flink_jpmml_tpu.obs import attr as _attr
+
         if args.worker is not None:
             if args.worker not in sources:
                 raise SystemExit(
@@ -957,13 +969,30 @@ def top_main(argv: Optional[List[str]] = None) -> int:
         for label in sorted(sources, key=lambda k: (k != "", k)):
             if not first:
                 print(file=sys.stdout)
-            render(label, sources[label], sys.stdout)
+            disp = label
+            if stale_after is not None:
+                # the snapshot's OWN capture timestamp, not fetch time:
+                # a supervisor keeps serving a dead worker's last struct,
+                # and that panel must say so instead of reading as live
+                tag = _attr.staleness_tag(
+                    sources[label], stale_after, now=now
+                )
+                if tag:
+                    disp = (label or "aggregate") + tag
+            render(disp, sources[label], sys.stdout)
             first = False
 
     if args.watch is None:
         _render_once(_top_load(args.source))
         return 0
     import time as _time
+
+    from flink_jpmml_tpu.obs import attr as _attr
+
+    try:
+        stale_after = float(os.environ["FJT_TOP_STALE_S"])
+    except (KeyError, ValueError):
+        stale_after = max(10.0, 3.0 * args.watch)
 
     while True:
         try:
@@ -981,14 +1010,241 @@ def top_main(argv: Optional[List[str]] = None) -> int:
         else:
             if sys.stdout.isatty():  # console: repaint in place
                 print("\x1b[2J\x1b[H", end="", file=sys.stdout)
-            print(_time.strftime("-- %H:%M:%S "), file=sys.stdout)
+            now = _time.time()
+            ages = [
+                a for a in (
+                    _attr.snapshot_age_s(s, now=now)
+                    for s in sources.values()
+                )
+                if a is not None
+            ]
+            hdr = _time.strftime("-- %H:%M:%S ")
+            if ages:
+                lo, hi = min(ages), max(ages)
+                hdr += f" (frame age {lo:.1f}s"
+                if hi - lo > 0.05:
+                    hdr += f" .. {hi:.1f}s"
+                hdr += ")"
+            print(hdr, file=sys.stdout)
             try:
-                _render_once(sources)
+                _render_once(sources, stale_after=stale_after, now=now)
             except (SystemExit, Exception) as e:
                 print(f"[fjt-top] {e!r}; retrying in {args.watch:g}s",
                       file=sys.stderr, flush=True)
             sys.stdout.flush()
         _time.sleep(args.watch)
+
+
+def _replay_load(source: str, qargs: dict) -> dict:
+    """→ a ``/history`` payload (obs/history.py ``query`` shape) from a
+    history directory or an obs-server base (or /history) URL."""
+    if source.startswith(("http://", "https://")):
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        url = source.rstrip("/")
+        if not url.endswith("/history"):
+            url += "/history"
+        q = {}
+        if qargs.get("names"):
+            q["name"] = ",".join(qargs["names"])
+        if qargs.get("sources"):
+            q["source"] = ",".join(qargs["sources"])
+        for k in ("start", "end", "step"):
+            if qargs.get(k) is not None:
+                q[k] = repr(float(qargs[k]))
+        if q:
+            url += "?" + urllib.parse.urlencode(q)
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                payload = json.loads(r.read().decode())
+        except (urllib.error.URLError, OSError,
+                json.JSONDecodeError) as e:
+            raise SystemExit(f"cannot read {url!r}: {e}")
+        if not isinstance(payload, dict):
+            raise SystemExit(f"{url!r} is not a JSON object")
+        return payload
+    from flink_jpmml_tpu.obs import history
+
+    if not os.path.isdir(source):
+        raise SystemExit(
+            f"{source!r} is neither a history directory nor an "
+            "obs-server URL"
+        )
+    return history.query(source, **qargs)
+
+
+def replay_main(argv: Optional[List[str]] = None) -> int:
+    """``fjt-replay``: retrospective incident replay from the durable
+    telemetry history (obs/history.py). Reads delta frames from a
+    history directory (``FJT_HISTORY_DIR``) or a live ``/history``
+    endpoint, prints a per-window timeline (records, shed, pressure,
+    offered vs fitted capacity, headroom), then renders the whole range
+    through the selected ``fjt-top`` panel — the console a worker's
+    SIGKILL cannot erase, because the frames are already on disk:
+
+        fjt-replay /data/history --last 600 --step 15
+        fjt-replay http://127.0.0.1:9100 --panel zoo
+        fjt-replay /data/history --source _fleet --panel overload
+    """
+    ap = argparse.ArgumentParser(
+        prog="fjt-replay",
+        description="Replay recorded telemetry history: a per-window "
+                    "incident timeline plus any fjt-top panel rendered "
+                    "over the range, from durable frames alone.",
+    )
+    ap.add_argument("path", metavar="DIR|URL",
+                    help="history directory (FJT_HISTORY_DIR) or "
+                         "obs-server base / /history URL")
+    ap.add_argument("--start", type=float, default=None, metavar="TS",
+                    help="range start (unix seconds)")
+    ap.add_argument("--end", type=float, default=None, metavar="TS",
+                    help="range end (unix seconds)")
+    ap.add_argument("--last", type=float, default=None, metavar="S",
+                    help="shorthand: the trailing S seconds "
+                         "(end defaults to now)")
+    ap.add_argument("--step", type=float, default=None, metavar="S",
+                    help="timeline window width in seconds (default: "
+                         "the finest stored resolution)")
+    ap.add_argument("--source", default=None,
+                    help="comma-separated frame sources (worker ids, "
+                         "or _fleet for the supervisor's aggregate; "
+                         "default: all workers — _fleet excluded, it "
+                         "re-counts the same traffic)")
+    ap.add_argument("--name", default=None,
+                    help="comma-separated metric name patterns "
+                         "(fnmatch) to project frames down to")
+    ap.add_argument("--panel", default="stage",
+                    choices=["stage", "freshness", "overload", "drift",
+                             "failover", "mesh", "zoo", "none"],
+                    help="fjt-top panel to render over the merged "
+                         "range (default: stage; none = timeline only)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw query payload (frames keep the "
+                         "exact wire encoding) instead of rendering")
+    args = ap.parse_args(argv)
+    if args.last is not None and args.last <= 0:
+        raise SystemExit(f"--last must be > 0, got {args.last}")
+    import time as _time
+
+    from flink_jpmml_tpu.obs import history as _hist
+
+    qargs = {
+        "names": (
+            [p for p in args.name.split(",") if p] if args.name else None
+        ),
+        "sources": (
+            [p for p in args.source.split(",") if p]
+            if args.source else None
+        ),
+        "start": args.start,
+        "end": args.end,
+        "step": args.step,
+    }
+    if args.last is not None:
+        qargs["end"] = args.end if args.end is not None else _time.time()
+        qargs["start"] = qargs["end"] - args.last
+    payload = _replay_load(args.path, qargs)
+    if args.json:
+        json.dump(payload, sys.stdout, sort_keys=True)
+        print(file=sys.stdout)
+        return 0
+    frames = [
+        f for f in (payload.get("frames") or []) if isinstance(f, dict)
+    ]
+    if not frames:
+        res = payload.get("resolutions") or []
+        print(
+            "no frames in range"
+            + (f" (stored resolutions: {res})" if res
+               else " (nothing recorded — FJT_HISTORY_DIR armed?)"),
+            file=sys.stderr,
+        )
+        return 1
+
+    def _cnt(f: dict, *bases: str) -> float:
+        """Exact-wire counter sum over the given base families (label
+        series included), rendered as a float."""
+        tot = 0.0
+        for n, v in (f.get("counters") or {}).items():
+            if n.split("{", 1)[0] in bases:
+                try:
+                    tot += _hist.wire_float(v)
+                except (TypeError, ValueError, ZeroDivisionError):
+                    pass
+        return tot
+
+    def _gv(f: dict, name: str) -> Optional[float]:
+        g = (f.get("gauges") or {}).get(name)
+        if not isinstance(g, dict):
+            return None
+        try:
+            return _hist.combined_last(name, g.get("last"))
+        except (AttributeError, TypeError, ValueError):
+            return None
+
+    def _fmt(v: Optional[float], spec: str) -> str:
+        return format(v, spec) if v is not None else "-"
+
+    print(
+        f"{'time':<10}{'records':>10}{'rec/s':>9}{'shed':>8}"
+        f"{'press':>7}{'offered':>9}{'capacity':>9}{'headroom':>9}"
+        f"{'resets':>7}",
+        file=sys.stdout,
+    )
+    for f in frames:
+        t0, t1 = float(f.get("t0", 0.0)), float(f.get("t1", 0.0))
+        span = max(t1 - t0, 1e-9)
+        rec = _cnt(f, "records_out")
+        shed = _cnt(f, "shed_records", "tenant_shed_records")
+        hr = _gv(f, "headroom_frac")
+        print(
+            f"{_time.strftime('%H:%M:%S', _time.localtime(t0)):<10}"
+            f"{rec:>10,.0f}"
+            f"{rec / span:>9,.0f}"
+            f"{shed:>8,.0f}"
+            f"{_fmt(_gv(f, 'pressure'), '.2f'):>7}"
+            f"{_fmt(_gv(f, 'offered_rec_s'), ',.0f'):>9}"
+            f"{_fmt(_gv(f, 'capacity_rec_s'), ',.0f'):>9}"
+            f"{_fmt(100.0 * hr if hr is not None else None, '.1f'):>8}"
+            f"{'%' if hr is not None else ' '}"
+            f"{int(f.get('resets', 0) or 0):>7}",
+            file=sys.stdout,
+        )
+    merged = _hist.merge_frames(frames)
+    srcs = str(merged.get("src", ""))
+    total_resets = int(merged.get("resets", 0) or 0)
+    print(
+        f"{len(frames)} window(s)   sources [{srcs}]"
+        + (f"   {total_resets} counter reset(s) — worker restart(s) "
+           "inside the range" if total_resets else ""),
+        file=sys.stdout,
+    )
+    if args.panel == "none":
+        return 0
+    struct = _hist.frame_to_struct(merged)
+    t0s = _time.strftime(
+        "%H:%M:%S", _time.localtime(float(merged.get("t0", 0.0)))
+    )
+    t1s = _time.strftime(
+        "%H:%M:%S", _time.localtime(float(merged.get("t1", 0.0)))
+    )
+    label = f"replay {t0s}..{t1s}"
+    render = {
+        "stage": lambda l, s, o: _top_render(l, s, o, source=args.path),
+        "freshness": _top_render_freshness,
+        "overload": _top_render_overload,
+        "drift": _top_render_drift,
+        "failover": lambda l, s, o: _top_render_failover(
+            l, s, o, source=args.path
+        ),
+        "mesh": _top_render_mesh,
+        "zoo": _top_render_zoo,
+    }[args.panel]
+    print(file=sys.stdout)
+    render(label, struct, sys.stdout)
+    return 0
 
 
 def _drift_merge_sources(sources: Dict[str, dict]) -> dict:

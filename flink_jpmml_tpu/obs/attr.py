@@ -394,3 +394,38 @@ def summary(struct_or_registry) -> Optional[dict]:
     for stage, row in out.items():
         row["share"] = round((row["total_ms"] / 1000.0) / total, 4) if total else 0.0
     return out
+
+
+# ---------------------------------------------------------------------------
+# Snapshot staleness (fjt-top --watch honesty, fjt-replay frame ages)
+# ---------------------------------------------------------------------------
+
+
+def snapshot_age_s(struct, now: Optional[float] = None) -> Optional[float]:
+    """Age of a metrics struct from its OWN capture timestamp (the
+    ``ts`` every ``struct_snapshot`` self-reports; a merged struct
+    carries its stalest member's). None for pre-``ts`` structs (old
+    BENCH artifacts, version-skewed workers) — unknown age, not zero:
+    a watch loop re-rendering a wedged source must say 'stale', never
+    imply freshness it can't prove."""
+    if not isinstance(struct, dict):
+        return None
+    try:
+        ts = float(struct["ts"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    return max(0.0, (time.time() if now is None else now) - ts)
+
+
+def staleness_tag(
+    struct,
+    threshold_s: float = 10.0,
+    now: Optional[float] = None,
+) -> str:
+    """Render suffix for a panel title: empty while fresh, a loud
+    ``[STALE <age>]`` past ``threshold_s`` — identical numbers from a
+    dead source must not keep looking live."""
+    age = snapshot_age_s(struct, now=now)
+    if age is None or age <= threshold_s:
+        return ""
+    return f"  [STALE {age:.0f}s]"

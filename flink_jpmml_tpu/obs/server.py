@@ -20,6 +20,10 @@ same discipline as utils/netio.py's hand-rolled framing):
   process's durable journey rows, its live flight-ring events, and the
   active span file — what ``fjt-trace <url>`` reconstructs timelines
   from.
+- ``/history`` — the telemetry-history range query (obs/history.py):
+  durable downsampled delta frames, selected by
+  ``?name=<fnmatch,..>&start=<ts>&end=<ts>&step=<s>&source=<src,..>``
+  — what ``fjt-replay <url>`` renders past windows from.
 
 Sources are pluggable: a single registry
 (:meth:`ObsServer.for_registry`) or a callable returning
@@ -36,7 +40,11 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Mapping, Optional, Union
 
-from flink_jpmml_tpu.utils.metrics import Histogram, MetricsRegistry
+from flink_jpmml_tpu.utils.metrics import (
+    Histogram,
+    MetricsRegistry,
+    govern_struct,
+)
 
 _PREFIX = "fjt_"
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
@@ -45,9 +53,13 @@ _LABELLED = re.compile(r'^([^{]+)\{(.*)\}$')
 
 
 def _struct(source: Union[MetricsRegistry, dict]) -> dict:
+    # the cardinality governor bounds every scrape page the same way
+    # it bounds heartbeat and history frames (FJT_METRICS_MAX_SERIES
+    # unset: identity) — at zoo scale a /metrics or /varz page must
+    # not grow one series per registered tenant
     if isinstance(source, MetricsRegistry):
-        return source.struct_snapshot()
-    return source or {}
+        return govern_struct(source.struct_snapshot())
+    return govern_struct(source or {})
 
 
 def _fmt(v: float) -> str:
@@ -174,6 +186,7 @@ class ObsServer:
         health_fn: Optional[Callable[[], dict]] = None,
         varz_fn: Optional[Callable[[], dict]] = None,
         trace_fn: Optional[Callable[[], dict]] = None,
+        history_fn: Optional[Callable[[dict], dict]] = None,
     ):
         self._collect = collect
         self._health = health_fn
@@ -183,6 +196,11 @@ class ObsServer:
         # so `fjt-trace <url>` reconstructs without filesystem access.
         # Default: this process's journey store, when one is armed.
         self._trace = trace_fn
+        # /history: the telemetry-history range query (obs/history.py)
+        # — called with the parsed query string (name/start/end/step/
+        # source), returns durable downsampled frames. Default: this
+        # process's history directory, when one is armed.
+        self._history = history_fn
         obs = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -198,7 +216,7 @@ class ObsServer:
                 self.wfile.write(raw)
 
             def do_GET(self) -> None:
-                path = self.path.split("?", 1)[0]
+                path, _, qs = self.path.partition("?")
                 try:
                     if path == "/metrics":
                         om = "application/openmetrics-text" in (
@@ -231,6 +249,23 @@ class ObsServer:
                                 (k if k is not None else ""): _struct(v)
                                 for k, v in obs._collect().items()
                             }
+                        self._reply(
+                            200,
+                            json.dumps(payload, default=repr),
+                            "application/json",
+                        )
+                    elif path == "/history":
+                        from urllib.parse import parse_qs
+
+                        params = parse_qs(qs)
+                        if obs._history is not None:
+                            payload = obs._history(params)
+                        else:
+                            from flink_jpmml_tpu.obs import (
+                                history as hm,
+                            )
+
+                            payload = hm.history_payload(None, params)
                         self._reply(
                             200,
                             json.dumps(payload, default=repr),
@@ -270,6 +305,16 @@ class ObsServer:
             from flink_jpmml_tpu.obs import trace as tm
 
             kw["trace_fn"] = lambda: tm.trace_payload(metrics)
+        if "history_fn" not in kw:
+            from flink_jpmml_tpu.obs import history as hm
+
+            # exposing metrics is the natural arming point for history
+            # too: with FJT_HISTORY_DIR set, the recorder starts with
+            # the server (idempotent per registry)
+            hm.history_for(metrics)
+            kw["history_fn"] = (
+                lambda params: hm.history_payload(metrics, params)
+            )
         return cls(lambda: {None: metrics}, **kw)
 
     @property

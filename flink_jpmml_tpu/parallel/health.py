@@ -64,6 +64,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from flink_jpmml_tpu.obs import recorder as flight
+from flink_jpmml_tpu.utils.metrics import govern_struct
 from flink_jpmml_tpu.utils.netio import recv_exact
 
 _U32 = struct.Struct(">I")
@@ -501,7 +502,13 @@ class HealthReporter:
             beat = {"id": self._id, "seq": self._seq}
             if self._snapshot_fn is not None:
                 try:
-                    beat["metrics"] = self._snapshot_fn()
+                    # the cardinality governor bounds the heartbeat
+                    # frame exactly like scrape pages and history
+                    # frames: at zoo scale an ungoverned snapshot
+                    # carries one series per tenant toward _MAX_FRAME
+                    # every beat (FJT_METRICS_MAX_SERIES unset:
+                    # identity)
+                    beat["metrics"] = govern_struct(self._snapshot_fn())
                 except Exception:
                     # a broken snapshot hook must not stop the
                     # heartbeat — liveness outranks metrics

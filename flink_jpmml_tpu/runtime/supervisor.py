@@ -100,6 +100,14 @@ def reporter_from_env(
     host, port = addr.rsplit(":", 1)
     if on_control is None and rollout_registry is not None:
         on_control = rollout_control_hook(rollout_registry)
+    if metrics is not None:
+        # supervision is also the worker's history opt-in: with
+        # FJT_HISTORY_DIR set (inherited from the supervisor), the
+        # recorder starts capturing durable delta frames — the frames
+        # a SIGKILLed worker's incident window is reconstructed from
+        from flink_jpmml_tpu.obs import history
+
+        history.history_for(metrics)
     return HealthReporter(
         host, int(port), wid, interval_s=interval_s,
         snapshot_fn=(
@@ -223,6 +231,12 @@ class Supervisor:
         }
         self._closing = False
         self._obs: Optional[ObsServer] = None
+        # telemetry history (obs/history.py): armed in start() when
+        # FJT_HISTORY_DIR is set — the supervisor records the FLEET
+        # AGGREGATE (merge of heartbeat snapshots, which outlive their
+        # workers) under the reserved "_fleet" source
+        self._history = None
+        self._history_due = 0.0
         self._group = restart_group
         # group mode: ONE shared failure budget + backoff clock
         self._group_failures: List[float] = []
@@ -243,6 +257,14 @@ class Supervisor:
 
     def start(self) -> None:
         give_up: List[str] = []
+        if os.environ.get("FJT_HISTORY_DIR"):
+            from flink_jpmml_tpu.obs import history
+
+            # no capture thread: the watch loop is the tick source, so
+            # fleet frames stop exactly when supervision stops
+            self._history = history.install(
+                self.metrics, start_thread=False
+            )
         with self._mu:
             if self._group:
                 ok = all(
@@ -302,6 +324,9 @@ class Supervisor:
         if self._obs is not None:
             self._obs.close()
             self._obs = None
+        if self._history is not None:
+            self._history.close()  # flushes pending coarse frames
+            self._history = None
 
     # -- views -------------------------------------------------------------
 
@@ -435,8 +460,18 @@ class Supervisor:
                 },
             }
 
+        from flink_jpmml_tpu.obs import history
+
         self._obs = ObsServer(
-            collect, host=host, port=port, health_fn=health
+            collect, host=host, port=port, health_fn=health,
+            # /history serves the shared frame directory: per-worker
+            # sources by default, the supervisor's "_fleet" aggregate
+            # on ?source=_fleet
+            history_fn=(
+                lambda params: history.history_payload(
+                    self.metrics, params
+                )
+            ),
         )
         return self._obs
 
@@ -762,4 +797,32 @@ class Supervisor:
                         self._on_give_up(wid)
                     except Exception:
                         pass
+            self._history_tick()
             time.sleep(self._poll_interval)
+
+    def _history_tick(self) -> None:
+        """Capture the fleet aggregate into the history store at the
+        recorder's interval. The aggregate merges the workers' LAST
+        heartbeat snapshots — the coordinator keeps a dead worker's —
+        so the ``_fleet`` timeline stays continuous across worker
+        death, which is what lets an incident window be read back
+        after the victim is gone."""
+        rec = self._history
+        if rec is None:
+            return
+        now = time.time()
+        if now < self._history_due:
+            return
+        self._history_due = now + rec.interval_s
+        from flink_jpmml_tpu.obs import history
+
+        try:
+            fm = self.fleet_metrics()
+            # the merged struct's ts is the STALEST member's capture
+            # time (frozen once a worker dies) — the frame's clock is
+            # the supervisor's capture, or the timeline would stop
+            # advancing with the victim
+            fm["ts"] = now
+            rec.capture_struct(history.FLEET_SRC, fm, now=now)
+        except Exception:
+            pass  # history must never take supervision down

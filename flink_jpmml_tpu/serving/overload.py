@@ -155,7 +155,14 @@ class AdaptiveBatcher:
         # worker's cap with a permanent zero
         self._metrics = metrics
         self._gauge = None
+        # capacity_rec_s = 1/c1: the fitted sustainable record rate —
+        # the capacity half of the history plane's headroom telemetry
+        # (obs/history.py pairs it with offered_rec_s per frame). Same
+        # lazy discipline as the cap gauge: no fit, no gauge.
+        self._cap_gauge = None
         self._load()
+        with self._mu:
+            self._publish_capacity_locked()
 
     # -- the model -----------------------------------------------------------
 
@@ -209,6 +216,7 @@ class AdaptiveBatcher:
                     and self._drift_strikes >= _DRIFT_STRIKES
                 )
                 self._refit_locked()
+                self._publish_capacity_locked()
                 self._drift_strikes = 0
                 self._dirty = True
                 if drifted:
@@ -257,6 +265,13 @@ class AdaptiveBatcher:
                     self._c0 = max(0.0, my - c1 * mx)
                     self._c1 = c1
         self._fitted_from = len(self._obs)
+
+    def _publish_capacity_locked(self) -> None:
+        if self._metrics is None or not self._c1 or self._c1 <= 0:
+            return
+        if self._cap_gauge is None:
+            self._cap_gauge = self._metrics.gauge("capacity_rec_s")
+        self._cap_gauge.set(1.0 / self._c1)
 
     def predicted_latency(self, records: int) -> Optional[float]:
         with self._mu:

@@ -118,6 +118,11 @@ class ZooManager:
         self._g_occ = self.metrics.gauge("pack_occupancy")
         self._g_waste = self.metrics.gauge("pack_pad_waste")
         self._g_bytes = self.metrics.gauge("zoo_resident_bytes")
+        # registered-tenant count: the cardinality-governor's scale
+        # signal (how far over FJT_METRICS_MAX_SERIES the per-tenant
+        # families would grow ungoverned) — MAX across the fleet,
+        # workers serve the same zoo
+        self._g_tenants = self.metrics.gauge("zoo_tenants")
 
     # -- membership --------------------------------------------------------
 
@@ -131,6 +136,7 @@ class ZooManager:
         self._members[key] = q
         self._member_ids[key] = f"{q.model_hash}:{key}"
         self._plan_dirty = True
+        self._g_tenants.set(float(len(self._members)))
 
     def sync(self, live_keys) -> None:
         """Drop tenants no longer served (a Del control message): their
@@ -142,6 +148,7 @@ class ZooManager:
             del self._member_ids[k]
         if dead:
             self._plan_dirty = True
+            self._g_tenants.set(float(len(self._members)))
 
     def tenant_count(self) -> int:
         return len(self._members)

@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import bisect
 import math
+import os
+import re
 import threading
 import time
 import weakref
@@ -717,15 +719,26 @@ class MetricsRegistry:
                     out[f"{name}_{tag}"] = v
         return out
 
-    def struct_snapshot(self) -> dict:
+    def struct_snapshot(self, run_hooks: bool = True) -> dict:
         """Typed, mergeable, JSON-shaped snapshot — the fleet wire format
         (reservoirs are deliberately absent: they cannot merge).
         ``"sketches"`` appears only when drift-plane sketches exist, so
-        pre-drift consumers see byte-identical structs."""
-        self._run_scrape_hooks()
+        pre-drift consumers see byte-identical structs.
+
+        ``run_hooks=False`` is for collectors that are THEMSELVES scrape
+        hooks (the history recorder captures from inside a scrape) —
+        re-running the hook list there would recurse.
+
+        ``"ts"`` is the capture wall-clock: every snapshot self-reports
+        when it was taken, so a consumer re-rendering a wedged or dead
+        source can tell a fresh frame from a fossil (fjt-top staleness,
+        history frame ages) without trusting its own receive time."""
+        if run_hooks:
+            self._run_scrape_hooks()
         counters, gauges, histograms, _ = self._views()
         out = {
             "uptime_s": max(time.monotonic() - self._t0, 1e-9),
+            "ts": time.time(),
             "counters": {n: c.get() for n, c in counters.items()},
             "gauges": {
                 n: {"value": g.get(), "max": g.max}
@@ -793,6 +806,10 @@ _GAUGE_MERGE_MAX_PREFIXES = (
     # multi-tenant zoo (serving/zoo.py): padded-waste fraction of the
     # packed input buffers — the fleet view wants the worst buffer
     "pack_pad_waste",
+    # multi-tenant zoo (serving/zoo.py): registered-tenant count —
+    # workers serve the same zoo, so summing double-counts tenants;
+    # the fleet value is the fullest worker's registry
+    "zoo_tenants",
 )
 _GAUGE_MERGE_MIN_PREFIXES = (
     "slo_ok", "watermark_ts", "watermark_stage_ts", "adaptive_batch",
@@ -803,6 +820,10 @@ _GAUGE_MERGE_MIN_PREFIXES = (
     # multichip serving (obs/mesh.py): surviving data-axis width — the
     # fleet value is the most-degraded worker's mesh, never a sum
     "mesh_data_width",
+    # capacity-headroom telemetry (obs/history.py): remaining capacity
+    # fraction — the fleet is as constrained as its tightest worker, so
+    # MIN; averaging (or summing) headroom hides the saturated worker
+    "headroom_frac",
 )
 
 
@@ -842,6 +863,14 @@ def merge_structs(structs: Iterable[dict]) -> dict:
             )
         except (TypeError, ValueError):
             pass
+        try:
+            ts = float(s["ts"])
+        except (KeyError, TypeError, ValueError):
+            pass
+        else:
+            # the fleet view is only as fresh as its stalest member —
+            # min, for the same reason watermark_ts is
+            out["ts"] = min(out.get("ts", ts), ts)
         for n, v in _items(s.get("counters")):
             try:
                 out["counters"][n] = out["counters"].get(n, 0.0) + float(v)
@@ -895,3 +924,194 @@ def merge_structs(structs: Iterable[dict]) -> dict:
 
 def _items(d):
     return d.items() if isinstance(d, dict) else ()
+
+
+# ---------------------------------------------------------------------------
+# Cardinality governor: top-K series per labelled family + exact-sum
+# "_other" rollup. At zoo scale (PR 17: 1,000 registered tenants) the
+# per-tenant families — tenant_records / tenant_shed_records /
+# tenant_latency_s{model=…} — put one series per tenant on every
+# /metrics page, every heartbeat frame, and every history frame. The
+# governor bounds each labelled family to the K highest-ranked series
+# and folds the remainder into one `{…="_other"}` series using the SAME
+# merge rules as the fleet (counters add, histogram buckets add, gauges
+# by their declared mode), so family TOTALS are unchanged by the rollup
+# and fleet merges of governed structs still reconcile exactly.
+
+#: Labelled family used to rank series that share its label key: tenants
+#: are kept by traffic volume, so tenant_latency_s keeps the SAME top-K
+#: tenants as tenant_records and cross-family tables stay joinable.
+_RANK_FAMILY_DEFAULT = "tenant_records"
+
+_SERIES_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)\{([A-Za-z_][A-Za-z0-9_]*)="(.*)"\}$'
+)
+
+
+def govern_limit() -> int:
+    """Series bound per labelled family from ``FJT_METRICS_MAX_SERIES``
+    (0 / unset / garbage → governor off)."""
+    try:
+        return int(os.environ.get("FJT_METRICS_MAX_SERIES", "0"))
+    except ValueError:
+        return 0
+
+
+def _series_split(name: str):
+    m = _SERIES_RE.match(name)
+    if m is None:
+        return None
+    return m.group(1), m.group(2), m.group(3)
+
+
+def _state_weight(st) -> float:
+    try:
+        return float(st.get("n", 0.0))
+    except (AttributeError, TypeError, ValueError):
+        return 0.0
+
+
+def govern_struct(
+    struct: dict,
+    max_series: Optional[int] = None,
+    rank_family: Optional[str] = None,
+) -> dict:
+    """Return ``struct`` with every labelled family bounded to
+    ``max_series`` series (default: :func:`govern_limit`); the input is
+    never mutated and is returned untouched when the governor is off or
+    nothing exceeds the bound.
+
+    Ranking: series whose label key matches the rank family's
+    (``FJT_METRICS_RANK_FAMILY``, default ``tenant_records``) rank by
+    that family's counter value — heaviest-traffic tenants survive in
+    every family; other label keys rank by the series' own magnitude.
+    The fold into ``_other`` reuses the fleet merge ops (counter add via
+    ``math.fsum``, histogram/sketch bucket-merge, gauge min/max/sum by
+    :func:`_gauge_merge_mode`), so the governed family total equals the
+    ungoverned one."""
+    k = govern_limit() if max_series is None else int(max_series)
+    if k <= 0 or not isinstance(struct, dict):
+        return struct
+    if rank_family is None:
+        rank_family = os.environ.get(
+            "FJT_METRICS_RANK_FAMILY", _RANK_FAMILY_DEFAULT
+        )
+
+    # rank scores: (label_key, label_value) -> rank-family counter value
+    scores: Dict[Tuple[str, str], float] = {}
+    for n, v in _items(struct.get("counters")):
+        parts = _series_split(n)
+        if parts is not None and parts[0] == rank_family:
+            try:
+                scores[(parts[1], parts[2])] = float(v)
+            except (TypeError, ValueError):
+                pass
+
+    def _govern_section(section: dict, weight, fold) -> Optional[dict]:
+        families: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        for n in section:
+            parts = _series_split(n)
+            if parts is not None:
+                families.setdefault(
+                    (parts[0], parts[1]), []
+                ).append((parts[2], n))
+        over = {
+            fam: members
+            for fam, members in families.items()
+            if len(members) > k
+        }
+        if not over:
+            return None
+        def _safe_weight(v) -> float:
+            try:
+                return weight(v)
+            except (AttributeError, TypeError, ValueError):
+                return 0.0
+
+        out = dict(section)
+        for (base, key), members in over.items():
+            ranked = sorted(
+                members,
+                key=lambda lv: (
+                    -scores.get((key, lv[0]), 0.0),
+                    -_safe_weight(section[lv[1]]),
+                    lv[0],
+                ),
+            )
+            # "_other" always folds itself (re-governing is idempotent)
+            keep = [
+                lv for lv in ranked if lv[0] != "_other"
+            ][: max(k - 1, 0)]
+            kept = {lv[1] for lv in keep}
+            folded = [section[n] for _, n in members if n not in kept]
+            for _, n in members:
+                if n not in kept:
+                    del out[n]
+            other = fold(base, folded)
+            if other is not None:
+                out[f'{base}{{{key}="_other"}}'] = other
+        return out
+
+    def _fold_counters(base, vals):
+        total, any_ok = [], False
+        for v in vals:
+            try:
+                total.append(float(v))
+                any_ok = True
+            except (TypeError, ValueError):
+                continue
+        return math.fsum(total) if any_ok else None
+
+    def _fold_gauges(base, vals):
+        mode = _gauge_merge_mode(base)
+        out = None
+        for g in vals:
+            try:
+                value = float(g.get("value", 0.0))
+                mx = float(g.get("max", 0.0))
+            except (AttributeError, TypeError, ValueError):
+                continue
+            if out is None:
+                out = {"value": value, "max": mx}
+            else:
+                if mode == "sum":
+                    out["value"] += value
+                elif mode == "max":
+                    out["value"] = max(out["value"], value)
+                else:
+                    out["value"] = min(out["value"], value)
+                out["max"] = max(out["max"], mx)
+        return out
+
+    def _fold_states(cls):
+        def _fold(base, states):
+            merged = None
+            for st in states:
+                try:
+                    obj = cls.from_state(st)
+                    if merged is None:
+                        merged = obj
+                    else:
+                        merged.merge(obj)
+                except (KeyError, IndexError, TypeError, ValueError):
+                    continue
+            return merged.state() if merged is not None else None
+        return _fold
+
+    out = None
+    for section, weight, fold in (
+        ("counters", lambda v: float(v or 0.0), _fold_counters),
+        ("gauges",
+         lambda g: float((g or {}).get("value", 0.0)), _fold_gauges),
+        ("histograms", _state_weight, _fold_states(Histogram)),
+        ("sketches", _state_weight, _fold_states(QuantileSketch)),
+    ):
+        sec = struct.get(section)
+        if not isinstance(sec, dict):
+            continue
+        governed = _govern_section(sec, weight, fold)
+        if governed is not None:
+            if out is None:
+                out = dict(struct)
+            out[section] = governed
+    return struct if out is None else out

@@ -620,6 +620,10 @@ class TestFleetMetricsDrill:
             agg = dict(varz[""])
             agg.pop("uptime_s", None)
             merged_local.pop("uptime_s", None)
+            # capture timestamps: the aggregate's min-of-ts folds in a
+            # third (supervisor) snapshot — nondeterministic like uptime
+            agg.pop("ts", None)
+            merged_local.pop("ts", None)
             assert agg == merged_local
 
             # the aggregated histogram's quantiles equal the merge of

@@ -1,0 +1,217 @@
+#!/usr/bin/env python3
+"""Per-metric trend lines over the repo's bench history — and a
+regression tripwire against the best prior capture.
+
+Sources, oldest→newest:
+
+- ``BENCH_r*.json`` — the driver's end-of-round artifacts (their
+  ``parsed`` JSON line, ordered by the embedded round number ``n``);
+- ``docs/bench_captures.jsonl`` — verbatim mid-round captures, in file
+  order (rows without a ``metric`` field, like the header note, skip).
+
+Every numeric field of a capture becomes one series keyed
+``metric.field`` and split by ``backend`` (a cpu-fallback capture must
+never be judged against a TPU best — they are different machines).
+Latency-named fields (``*latency*``, ``*_ms``) trend lower-better;
+everything else higher-better.
+
+The tripwire: for each series with ≥2 points, the LATEST point is
+compared against the best PRIOR point; worse by more than
+``--tolerance`` (default 10%) prints ``REGRESSED`` and exits 2 —
+wire-able into CI next to tools/metrics_lint.py. ``--metric`` narrows
+the check, ``--json`` emits the trajectories machine-readably.
+
+    python tools/bench_trend.py
+    python tools/bench_trend.py --metric gbm500_records_per_sec_per_chip.value
+    python tools/bench_trend.py --tolerance 0.25 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+#: Fields that are never performance series (identity / free-text /
+#: config echo / orchestration bookkeeping), whatever their type.
+_SKIP_FIELDS = {"metric", "unit", "backend", "error", "note", "cmd",
+                "rc", "n", "ok", "attempts", "probes", "elapsed_s"}
+
+
+def _lower_better(field: str) -> bool:
+    f = field.lower()
+    return "latency" in f or f.endswith("_ms") or "stall" in f
+
+
+def _numeric_fields(row: dict) -> Dict[str, float]:
+    out = {}
+    for k, v in row.items():
+        if k in _SKIP_FIELDS or isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def load_rows(repo: str) -> List[Tuple[str, dict]]:
+    """→ [(origin label, capture row)] oldest→newest: round artifacts
+    by round number, then the captures log in file order."""
+    rows: List[Tuple[str, dict]] = []
+    arts = []
+    for p in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = _ROUND_RE.search(p)
+        if not m:
+            continue
+        try:
+            with open(p, encoding="utf-8") as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = d.get("parsed") if isinstance(d, dict) else None
+        if isinstance(parsed, dict) and parsed.get("metric"):
+            arts.append((int(d.get("n") or m.group(1)),
+                         os.path.basename(p), parsed))
+    for _, label, parsed in sorted(arts):
+        rows.append((label, parsed))
+    cap = os.path.join(repo, "docs", "bench_captures.jsonl")
+    try:
+        with open(cap, encoding="utf-8") as f:
+            for i, ln in enumerate(f):
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    r = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue  # torn/annotated line: log, not data
+                if isinstance(r, dict) and r.get("metric"):
+                    rows.append((f"captures:{i + 1}", r))
+    except OSError:
+        pass
+    return rows
+
+
+def trajectories(
+    rows: List[Tuple[str, dict]],
+) -> Dict[Tuple[str, str], List[Tuple[str, float]]]:
+    """→ {(series key "metric.field", backend): [(origin, value)]}."""
+    out: Dict[Tuple[str, str], List[Tuple[str, float]]] = {}
+    for origin, row in rows:
+        backend = str(row.get("backend") or "")
+        metric = str(row.get("metric"))
+        for field, v in _numeric_fields(row).items():
+            out.setdefault(
+                (f"{metric}.{field}", backend), []
+            ).append((origin, v))
+    return out
+
+
+def check(
+    series: Dict[Tuple[str, str], List[Tuple[str, float]]],
+    tolerance: float,
+    only: Optional[List[str]] = None,
+) -> Tuple[List[dict], List[dict]]:
+    """→ (report rows, regressions). Latest vs best PRIOR per series."""
+    report, regressions = [], []
+    for (key, backend), pts in sorted(series.items()):
+        if only and key not in only:
+            continue
+        values = [v for _, v in pts]
+        latest_origin, latest = pts[-1]
+        row = {
+            "series": key,
+            "backend": backend,
+            "points": len(pts),
+            "values": values[-8:],
+            "latest": latest,
+            "latest_origin": latest_origin,
+        }
+        if len(pts) >= 2:
+            prior = values[:-1]
+            lower = _lower_better(key.rsplit(".", 1)[1])
+            best = min(prior) if lower else max(prior)
+            row["best_prior"] = best
+            if best:
+                delta = (
+                    (latest - best) / abs(best) if not lower
+                    else (best - latest) / abs(best)
+                )
+                # delta > 0 = improvement in the metric's own direction
+                row["delta_vs_best"] = round(delta, 4)
+                row["regressed"] = delta < -tolerance
+                if row["regressed"]:
+                    regressions.append(row)
+        report.append(row)
+    return report, regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench-trend",
+        description="Per-metric bench trajectories + regression "
+                    "tripwire vs the best prior capture.",
+    )
+    ap.add_argument("--repo", default=None,
+                    help="repo root (default: this file's parent's "
+                         "parent)")
+    ap.add_argument("--metric", action="append", default=None,
+                    help="only this series key (metric.field); "
+                         "repeatable")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed fractional slack vs the best prior "
+                         "before a series counts as regressed "
+                         "(default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    args = ap.parse_args(argv)
+    repo = args.repo or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    rows = load_rows(repo)
+    if not rows:
+        print(f"no bench captures under {repo!r}", file=sys.stderr)
+        return 1
+    report, regressions = check(
+        trajectories(rows), args.tolerance, only=args.metric
+    )
+    if args.json:
+        json.dump(
+            {"rows": len(rows), "series": report,
+             "regressions": [r["series"] for r in regressions]},
+            sys.stdout, indent=1, sort_keys=True,
+        )
+        print()
+    else:
+        for r in report:
+            traj = " -> ".join(f"{v:g}" for v in r["values"])
+            line = (
+                f"{r['series']}"
+                + (f" [{r['backend']}]" if r["backend"] else "")
+                + f"  ({r['points']} pts)  {traj}"
+            )
+            if "delta_vs_best" in r:
+                line += (
+                    f"   {100 * r['delta_vs_best']:+.1f}% vs best prior"
+                )
+                if r.get("regressed"):
+                    line += "   REGRESSED"
+            print(line)
+    if regressions:
+        print(
+            f"{len(regressions)} series regressed past "
+            f"{100 * args.tolerance:.0f}% tolerance: "
+            + ", ".join(r["series"] for r in regressions),
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

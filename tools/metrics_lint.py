@@ -152,6 +152,31 @@ def _code_gauge_mode(name: str, prefixes: Dict[str, Tuple[str, ...]]) -> str:
     return "sum"
 
 
+def rank_family_default(path: pathlib.Path = METRICS_PY) -> str:
+    """Parse ``_RANK_FAMILY_DEFAULT`` (the cardinality governor's
+    default top-K ranking family) out of utils/metrics.py."""
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except SyntaxError:
+        sys.exit(2)  # gauge_merge_prefixes already reported it
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id == "_RANK_FAMILY_DEFAULT"
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    return node.value.value
+    print(
+        f"metrics-lint: _RANK_FAMILY_DEFAULT not found in {path} — "
+        "fix the parser, don't drop the contract",
+        file=sys.stderr,
+    )
+    sys.exit(2)
+
+
 def doc_rows() -> Dict[str, Tuple[str, str]]:
     """→ {name: (kind, merge)} from the catalogue table."""
     text = DOCS.read_text(encoding="utf-8")
@@ -257,10 +282,25 @@ def main() -> int:
                     "gauge row — dead rule or missing documentation"
                 )
 
+    # -- direction 4: the governor's default rank family is real -----------
+    # FJT_METRICS_MAX_SERIES folds per-tenant families to top-K ranked
+    # by _RANK_FAMILY_DEFAULT's counter; a renamed family would
+    # silently degrade every governed fold to magnitude ranking
+    rank = rank_family_default()
+    doc_bases = {name.split("{", 1)[0] for name in documented}
+    if rank not in doc_bases:
+        rc = 1
+        print(
+            f"metrics-lint: governor rank family {rank!r} "
+            "(_RANK_FAMILY_DEFAULT, utils/metrics.py) names no "
+            "catalogued metric base"
+        )
+
     if rc == 0:
         print(
             f"metrics-lint: {len(emitted_names)} metric names in sync "
-            "with the catalogue (merge rules verified)"
+            "with the catalogue (merge rules + governor rank family "
+            "verified)"
         )
     return rc
 
