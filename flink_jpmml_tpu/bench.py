@@ -2794,6 +2794,376 @@ def run_zoo_bench(
     }
 
 
+_STATEFUL_WORKER = r'''
+import os, sys, time
+sys.path.insert(0, sys.argv[10])
+import jax
+jax.config.update("jax_platforms", "cpu")  # correctness phase: host-side
+import numpy as np
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.runtime import state as state_mod
+from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+
+pmml, ckdir, outpath, seed, records, keys, capacity, B, feats = sys.argv[1:10]
+seed, records, keys = int(seed), int(records), int(keys)
+capacity, B, feats = int(capacity), int(B), int(feats)
+# every incarnation regenerates the IDENTICAL stream from the seed: the
+# kill-phase parity claim is about the STATE plane, not the source
+rng = np.random.default_rng(seed)
+data = rng.normal(0.0, 1.0, size=(records, feats)).astype(np.float32)
+data[:, 0] = ((rng.zipf(1.3, size=records) - 1) % keys).astype(np.float32)
+cm = compile_pmml(parse_pmml_file(pmml), batch_size=B)
+pipe = BlockPipeline(
+    # block == dispatch batch and a fill deadline far past any
+    # scheduler hiccup: every dispatch is one aligned B-sized block, so
+    # a restore at a committed (block-aligned) offset replays the exact
+    # batch boundaries of the single-life run — the byte-parity
+    # precondition (scatter-add order inside a batch is fixed; across a
+    # DIFFERENT split it would be float-reassociated)
+    FiniteBlockSource(data, block_size=B), cm,
+    lambda out, n, first_off: None,
+    RuntimeConfig(
+        batch=BatchConfig(size=B, deadline_us=5_000_000),
+        checkpoint_interval_s=0.05,
+    ),
+    checkpoint=CheckpointManager(ckdir),
+    state=state_mod.StateSpec(capacity=capacity, key_col=0),
+)
+pipe.restore()
+pipe.start()
+while pipe.committed_offset < records and pipe._error is None:
+    time.sleep(0.02)
+pipe.stop()
+pipe.join(timeout=30.0)
+if pipe._error is not None:
+    raise SystemExit(f"stateful worker pipeline error: {pipe._error!r}")
+tbl = pipe._state
+jax.block_until_ready(tbl.values)
+tmp_out = outpath + ".tmp"
+np.savez(tmp_out, values=np.asarray(tbl.values),
+         applied_hi=np.int64(tbl.applied_hi))
+os.replace(tmp_out + ".npz", outpath)  # np.savez appends .npz
+'''
+
+
+def _stateful_kill_parity(
+    tmp: str,
+    pmml: str,
+    records: int,
+    keys: int,
+    capacity: int,
+    batch: int,
+    kills: int,
+    seed: int,
+    features: int,
+    timeout_s: float = 240.0,
+) -> dict:
+    """The ``--stateful`` capture's SIGKILL phase: the same keyed
+    stream scored twice through the production BlockPipeline with the
+    state table + checkpoints armed — once uninterrupted (the
+    single-life reference), once SIGKILLed mid-stream ``kills`` times
+    with each incarnation restoring from the latest checkpoint (offsets
+    + npz state sidecar). The two final tables must match BYTE-exactly:
+    restore rehydrates the full mirror (values, keys, touch, epoch,
+    ``applied_hi``), replayed offsets below ``skip_until`` bypass to
+    the scratch row, and block==batch alignment keeps every replayed
+    scatter-add in its original batch. Workers are forced-CPU
+    subprocesses (a restart storm against an exclusive-access tunneled
+    chip would drill the tunnel, not the state plane)."""
+    import signal
+
+    import numpy as np
+
+    from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run_life(tag: str, kill_targets: list) -> tuple:
+        """→ (final npz path, incarnations). Spawns the worker, SIGKILLs
+        it once committed progress passes each target, then lets the
+        final incarnation drain."""
+        ckdir = os.path.join(tmp, f"ck-{tag}")
+        outpath = os.path.join(tmp, f"state-{tag}.npz")
+        argv = [
+            sys.executable, "-c", _STATEFUL_WORKER,
+            pmml, ckdir, outpath, str(seed), str(records),
+            str(keys), str(capacity), str(batch), str(features), repo,
+        ]
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "FJT_XLA_CACHE": os.path.join(tmp, "xla"),
+            "FJT_AUTOTUNE_CACHE": os.path.join(tmp, "autotune"),
+        })
+
+        def committed() -> int:
+            try:
+                st = CheckpointManager(ckdir).load_latest()
+                return int(st["source_offset"]) if st else 0
+            except Exception:
+                return 0
+
+        incarnations = 0
+        pending = list(kill_targets)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            assert time.monotonic() < deadline, (
+                f"stateful kill phase ({tag}) did not drain within "
+                f"{timeout_s}s (committed {committed()}/{records})"
+            )
+            proc = subprocess.Popen(
+                argv, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE, text=True,
+            )
+            incarnations += 1
+            if pending:
+                target = pending[0]
+                while (
+                    proc.poll() is None
+                    and committed() < target
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.02)
+                if proc.poll() is None:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    proc.wait(timeout=10)
+                    pending.pop(0)
+                    continue
+                # the worker finished before the target: no more kills
+                pending.clear()
+            try:
+                proc.wait(timeout=max(deadline - time.monotonic(), 5.0))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise AssertionError(
+                    f"stateful worker ({tag}) wedged past the deadline"
+                )
+            assert proc.returncode == 0, (
+                f"stateful worker ({tag}) rc={proc.returncode}: "
+                f"{(proc.stderr.read() or '')[-800:]}"
+            )
+            assert os.path.exists(outpath), (
+                f"stateful worker ({tag}) exited 0 without its table dump"
+            )
+            return outpath, incarnations
+
+    ref_path, _ = run_life("ref", [])
+    targets = [
+        int(records * (i + 1) / (kills + 1)) for i in range(kills)
+    ]
+    kill_path, incarnations = run_life("kill", targets)
+
+    ref = np.load(ref_path)
+    killed = np.load(kill_path)
+    assert int(ref["applied_hi"]) == int(killed["applied_hi"]) == records
+    mismatch = int(
+        (ref["values"].tobytes() != killed["values"].tobytes())
+    )
+    assert mismatch == 0, (
+        "kill->restore state diverged from the single-life table "
+        f"(shapes {ref['values'].shape} vs {killed['values'].shape})"
+    )
+    return {
+        "records": int(records),
+        "kills": int(kills),
+        "incarnations": int(incarnations),
+        "parity_mismatch_bytes": 0,
+    }
+
+
+def run_stateful_bench(
+    keys: int = 10_000_000,
+    records: int = 10_485_760,
+    capacity: int = 1 << 21,
+    batch: int = 8192,
+    kill_records: int = 49_152,
+    kill_keys: int = 16_384,
+    kill_capacity: int = 32_768,
+    kill_batch: int = 1024,
+    kills: int = 2,
+    trees: int = 20,
+    depth: int = 4,
+    features: int = 8,
+    seed: int = 29,
+) -> dict:
+    """``--stateful``: the keyed-state capture + acceptance drill
+    (ISSUE 19) — per-key session state fused into the scoring dispatch.
+
+    Geometry: one GBM compiled at ``batch``; two key mixes stream
+    ``records`` each through the REAL ``dispatch_quantized`` state
+    stage against a ``capacity``-slot device-resident table:
+
+    - **sweep** — keys walk a multiplicative permutation of the full
+      ``keys`` domain (>= 10M distinct by default), every record a
+      fresh key once the domain exceeds the table: the insert/evict
+      worst case, occupancy pinned at the ceiling;
+    - **zipf** — a=1.1 skew over the same domain: the session-locality
+      case the fused lookup exists for (hit-ratio reported).
+
+    A stateless hand loop over the same model is the overhead
+    denominator. The SIGKILL phase (:func:`_stateful_kill_parity`)
+    re-runs a smaller keyed stream through the production BlockPipeline
+    with checkpoints, kills it mid-stream, and asserts the restored
+    replay's final table is BYTE-identical to an uninterrupted life.
+
+    Raises ``AssertionError`` on violation; → the capture's JSON line
+    (flat numeric fields → tools/bench_trend.py series)."""
+    import jax
+    import numpy as np
+
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime import state as state_mod
+    from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+    from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+    t0 = time.monotonic()
+    assert records % batch == 0, "--stateful-records must divide --stateful-batch"
+    tmp = tempfile.mkdtemp(prefix="fjt-stateful-")
+    try:
+        pmml = gen_gbm(
+            tmp, n_trees=trees, depth=depth, n_features=features,
+            seed=seed,
+        )
+        cm = compile_pmml(parse_pmml_file(pmml), batch_size=batch)
+        q = cm.quantized_scorer()
+        assert q is not None, "stateful bench GBM must be rank-wire eligible"
+        backend = jax.default_backend()
+
+        rng = np.random.default_rng(seed)
+        # feature pool cycled by view: the timed loop must measure the
+        # dispatch, not 10M rows of host-side normal() generation
+        pool_n = 64 * batch
+        pool = rng.normal(0.0, 1.0, size=(pool_n, features)).astype(
+            np.float32
+        )
+        n_batches = records // batch
+        # 0x9E3779B1 (prime): offset -> key is a permutation of the
+        # domain whenever gcd(p, keys) == 1, so the sweep touches
+        # min(records, keys) DISTINCT keys — the >= 10M-key claim is by
+        # construction, not by sampling luck
+        _PERM = 2654435761
+        zipf_keys = ((rng.zipf(1.1, size=records) - 1) % keys).astype(
+            np.int64
+        )
+
+        def sweep_keys(off: int) -> np.ndarray:
+            return (np.arange(off, off + batch, dtype=np.int64)
+                    * _PERM) % keys
+
+        def run_mix(key_fn, table) -> float:
+            last = None
+            t_mix = time.monotonic()
+            for b in range(n_batches):
+                off = b * batch
+                X = pool[(off % pool_n):(off % pool_n) + batch]
+                kw = {}
+                if table is not None:
+                    kw = {
+                        "state": table,
+                        "state_keys": key_fn(off),
+                        "offsets": np.arange(off, off + batch,
+                                             dtype=np.int64),
+                        # steady-state path: the [rows, 8] buffer
+                        # donates and updates in place — without it
+                        # every dispatch copies the whole table
+                        # (capacity x 32 B), and at 2M slots that copy
+                        # IS the bench
+                        "donate": True,
+                    }
+                last = dispatch_quantized(q, X, **kw)
+                # bounded in-flight: let the device run ahead one batch
+                if b % 2:
+                    jax.block_until_ready(last)
+            jax.block_until_ready(last)
+            return records / (time.monotonic() - t_mix)
+
+        spec = state_mod.StateSpec(capacity=capacity, key_col=0)
+        # warm every entry (stateless + state) outside the timed loops
+        warm = state_mod.KeyedStateTable(spec)
+        jax.block_until_ready(dispatch_quantized(
+            q, pool[:batch], state=warm,
+            state_keys=sweep_keys(0),
+            offsets=np.arange(batch, dtype=np.int64),
+            donate=True,
+        ))
+        jax.block_until_ready(dispatch_quantized(q, pool[:batch]))
+        del warm
+
+        stateless_rec_s = run_mix(None, None)
+
+        reg_sweep = MetricsRegistry()
+        sweep_rec_s = run_mix(
+            sweep_keys, state_mod.KeyedStateTable(spec, metrics=reg_sweep)
+        )
+        reg_zipf = MetricsRegistry()
+        zipf_rec_s = run_mix(
+            lambda off: zipf_keys[off:off + batch],
+            state_mod.KeyedStateTable(spec, metrics=reg_zipf),
+        )
+
+        def plane(reg) -> tuple:
+            snap = reg.struct_snapshot()
+            cs, gs = snap["counters"], snap.get("gauges") or {}
+            return cs, {k: v.get("value") for k, v in gs.items()}
+
+        cs_sweep, gs_sweep = plane(reg_sweep)
+        cs_zipf, gs_zipf = plane(reg_zipf)
+        assert int(cs_sweep.get("state_records", 0)) == records
+        assert int(cs_zipf.get("state_records", 0)) == records
+        # the sweep saturates the table: a permutation domain >> slots
+        # must pin occupancy at the ceiling and keep evicting
+        if min(records, keys) > 2 * capacity:
+            assert gs_sweep.get("state_occupancy_frac", 0) > 0.95, gs_sweep
+            assert cs_sweep.get("state_evictions", 0) > 0, cs_sweep
+
+        kill = _stateful_kill_parity(
+            tmp, pmml, records=kill_records, keys=kill_keys,
+            capacity=kill_capacity, batch=kill_batch, kills=kills,
+            seed=seed + 1, features=features,
+        )
+
+        n_dev = max(1, jax.local_device_count())
+        line = {
+            "metric": "stateful_bench",
+            "ok": True,
+            "unit": "records/s/chip",
+            "backend": backend,
+            "key_domain": int(keys),
+            "distinct_keys_swept": int(min(records, keys)),
+            "records_per_mix": int(records),
+            "capacity": int(capacity),
+            "batch": int(batch),
+            "trees": int(trees),
+            # the table lives on ONE device; per-chip == absolute here
+            "value": round(zipf_rec_s / n_dev, 1),
+            "zipf_rec_s": round(zipf_rec_s, 1),
+            "sweep_rec_s": round(sweep_rec_s, 1),
+            "stateless_rec_s": round(stateless_rec_s, 1),
+            "state_overhead_frac": round(
+                max(0.0, 1.0 - zipf_rec_s / stateless_rec_s), 4
+            ),
+            "vs_target": round(zipf_rec_s / 500_000.0, 4),
+            "occupancy_frac": gs_sweep.get("state_occupancy_frac"),
+            "resident_keys": gs_sweep.get("state_resident_keys"),
+            "zipf_hit_ratio": gs_zipf.get("state_hit_ratio"),
+            "sweep_evictions": int(cs_sweep.get("state_evictions", 0)),
+            "sweep_inserts": int(cs_sweep.get("state_inserts", 0)),
+            "zipf_collisions": int(cs_zipf.get("state_collisions", 0)),
+            "kill_records": kill["records"],
+            "kill_incarnations": kill["incarnations"],
+            "parity_mismatch_bytes": kill["parity_mismatch_bytes"],
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+        return line
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_device_fault_drill(
     records: int = 24_000,
     seed: int = 11,
@@ -4153,6 +4523,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="tenants receiving traffic in --zoo")
     ap.add_argument("--zoo-records", type=int, default=1024,
                     help="records per hot tenant in --zoo")
+    ap.add_argument("--stateful", action="store_true",
+                    help="keyed-state capture + acceptance drill: two "
+                         "key mixes (full-domain permutation sweep + "
+                         "zipf skew) stream --stateful-records each "
+                         "through the fused per-key state stage "
+                         "against a --stateful-capacity device table, "
+                         "reporting rec/s/chip, occupancy, hit ratio "
+                         "and the overhead vs a stateless loop; then "
+                         "a SIGKILLed BlockPipeline run with "
+                         "checkpoints must restore and finish with a "
+                         "state table BYTE-identical to an "
+                         "uninterrupted life")
+    ap.add_argument("--stateful-keys", type=int, default=10_000_000,
+                    help="distinct-key domain for --stateful")
+    ap.add_argument("--stateful-records", type=int, default=10_485_760,
+                    help="records per key mix in --stateful (must be "
+                         "a multiple of --stateful-batch)")
+    ap.add_argument("--stateful-capacity", type=int, default=1 << 21,
+                    help="state-table slots for --stateful")
+    ap.add_argument("--stateful-batch", type=int, default=8192,
+                    help="dispatch batch for --stateful")
+    ap.add_argument("--stateful-kills", type=int, default=2,
+                    help="mid-stream SIGKILLs in the --stateful "
+                         "kill->restore parity phase")
     return ap
 
 
@@ -4177,6 +4571,30 @@ def main() -> None:
         except AssertionError as e:
             print(json.dumps({
                 "metric": "zoo_bench", "ok": False, "error": str(e),
+            }))
+            sys.exit(1)
+        print(json.dumps(line))
+        return
+
+    if args.stateful:
+        # keyed-state capture: in-process like --zoo (the state table
+        # and the dispatch loop run on whatever backend resolved; the
+        # SIGKILL phase forces CPU subprocesses on its own)
+        if args.force_cpu:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            line = run_stateful_bench(
+                keys=args.stateful_keys,
+                records=args.stateful_records,
+                capacity=args.stateful_capacity,
+                batch=args.stateful_batch,
+                kills=args.stateful_kills,
+            )
+        except AssertionError as e:
+            print(json.dumps({
+                "metric": "stateful_bench", "ok": False, "error": str(e),
             }))
             sys.exit(1)
         print(json.dumps(line))

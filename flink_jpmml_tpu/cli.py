@@ -877,6 +877,69 @@ def _top_render_zoo(label: str, struct: dict, out) -> None:
               "zoo mode off)", file=out)
 
 
+def _top_render_state(label: str, struct: dict, out) -> None:
+    """The ``--state`` panel: the keyed session-state plane
+    (runtime/state.py) as one operator view — table occupancy and hit
+    ratio, routing outcome counts (hits / inserts / evictions /
+    collisions / overflow), and the correctness counters (bypassed
+    replays, rollbacks). Empty-by-default: a pipeline without a state
+    table registers nothing, and the panel says so instead of
+    rendering a wall of zeros. On a fleet struct ``state_*`` counters
+    and ``state_resident_keys`` arrive SUM-merged,
+    ``state_occupancy_frac`` MAX-merged (the fullest table) and
+    ``state_hit_ratio`` MIN-merged (the coldest), per the catalogue
+    rules."""
+    title = label or "aggregate"
+    print(f"== {title} · state ==", file=out)
+    gauges = struct.get("gauges") or {}
+    counters = struct.get("counters") or {}
+
+    def g(name):
+        v = gauges.get(name)
+        return v.get("value") if isinstance(v, dict) else None
+
+    def c(name):
+        try:
+            return float(counters.get(name, 0) or 0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    resident, occ = g("state_resident_keys"), g("state_occupancy_frac")
+    hit_ratio = g("state_hit_ratio")
+    records = c("state_records")
+    rendered = False
+    if resident is not None or records:
+        rendered = True
+        parts = []
+        if resident is not None:
+            parts.append(f"resident {resident:,.0f} keys")
+        if occ is not None:
+            parts.append(f"occupancy {100.0 * occ:.1f}%")
+        if hit_ratio is not None:
+            parts.append(f"hit-ratio {100.0 * hit_ratio:.1f}%")
+        print("table    " + "   ".join(parts), file=out)
+        print(
+            f"routing  records {records:,.0f}   hits "
+            f"{c('state_hits'):,.0f}   inserts "
+            f"{c('state_inserts'):,.0f}   evictions "
+            f"{c('state_evictions'):,.0f}   collisions "
+            f"{c('state_collisions'):,.0f}   overflow "
+            f"{c('state_overflow'):,.0f}",
+            file=out,
+        )
+    bypassed, rollbacks = c("state_bypass_records"), c("state_rollbacks")
+    if bypassed or rollbacks:
+        rendered = True
+        print(
+            f"safety   bypassed replays {bypassed:,.0f}   rollbacks "
+            f"{rollbacks:,.0f}",
+            file=out,
+        )
+    if not rendered:
+        print("(no keyed-state telemetry recorded — state plane "
+              "unarmed)", file=out)
+
+
 def top_main(argv: Optional[List[str]] = None) -> int:
     """``fjt-top``: the fleet attribution table (see module docstring).
     Renders every labelled source (the supervisor's /varz serves the
@@ -924,6 +987,11 @@ def top_main(argv: Optional[List[str]] = None) -> int:
                          "cold-start economics, per-tenant records/"
                          "shed/latency ranked by traffic) instead of "
                          "the stage table")
+    ap.add_argument("--state", action="store_true",
+                    help="render the keyed session-state panel (table "
+                         "occupancy/hit-ratio, routing outcome counts, "
+                         "bypassed replays and rollbacks) instead of "
+                         "the stage table")
     ap.add_argument("--watch", type=float, default=None, metavar="N",
                     help="re-render every N seconds from a live source "
                          "(operator console mode; mid-watch fetch "
@@ -932,10 +1000,10 @@ def top_main(argv: Optional[List[str]] = None) -> int:
     if args.watch is not None and args.watch <= 0:
         raise SystemExit(f"--watch must be > 0, got {args.watch}")
     if sum((args.freshness, args.overload, args.drift,
-            args.failover, args.mesh, args.zoo)) > 1:
+            args.failover, args.mesh, args.zoo, args.state)) > 1:
         raise SystemExit(
             "--freshness, --overload, --drift, --failover, --mesh, "
-            "and --zoo are exclusive"
+            "--zoo, and --state are exclusive"
         )
     render = (
         _top_render_freshness if args.freshness
@@ -943,6 +1011,7 @@ def top_main(argv: Optional[List[str]] = None) -> int:
         else _top_render_drift if args.drift
         else _top_render_mesh if args.mesh
         else _top_render_zoo if args.zoo
+        else _top_render_state if args.state
         else (
             lambda label, struct, out: _top_render_failover(
                 label, struct, out, source=args.source
@@ -1117,7 +1186,8 @@ def replay_main(argv: Optional[List[str]] = None) -> int:
                          "(fnmatch) to project frames down to")
     ap.add_argument("--panel", default="stage",
                     choices=["stage", "freshness", "overload", "drift",
-                             "failover", "mesh", "zoo", "none"],
+                             "failover", "mesh", "zoo", "state",
+                             "none"],
                     help="fjt-top panel to render over the merged "
                          "range (default: stage; none = timeline only)")
     ap.add_argument("--json", action="store_true",
@@ -1241,6 +1311,7 @@ def replay_main(argv: Optional[List[str]] = None) -> int:
         ),
         "mesh": _top_render_mesh,
         "zoo": _top_render_zoo,
+        "state": _top_render_state,
     }[args.panel]
     print(file=sys.stdout)
     render(label, struct, sys.stdout)

@@ -266,6 +266,21 @@ class PackedScorer:
         its slot."""
         return self._jit_fn(self._params, Xp)
 
+    def dispatch_state(self, Xp: np.ndarray, table, slots, rel, w,
+                       reset, member: int = 0, donate: bool = False):
+        """State-armed launch: every member scores exactly as
+        :meth:`dispatch` (byte-identical outputs — the state stage only
+        appends ops) and the designated ``member``'s value stream folds
+        through the keyed state table → ``(outs, derived, S')``; the
+        caller commits ``S'``. See statekernel.packed_entry for the
+        shared-table semantics."""
+        from flink_jpmml_tpu.compile import statekernel
+
+        fn = statekernel.packed_entry(
+            self, donate, table.spec.decay, table.scratch, member
+        )
+        return fn(self._params, Xp, table.values, slots, rel, w, reset)
+
     def warmup(self) -> float:
         """Force the XLA compile (the pack's cold-start cost) →
         seconds spent."""

@@ -524,6 +524,35 @@ class QuantizedScorer:
         X, K = self.pad_f32(X)
         return self.predict_fused_padded(X, K, donate=donate)
 
+    # -- state-armed entries (compile/statekernel.py) ----------------------
+
+    def predict_padded_state(self, Xq, K: int, table, slots, rel, w,
+                             reset, donate: bool = False):
+        """State-armed twin of :meth:`predict_padded`: one dispatch
+        scores the aligned wire batch AND folds it through the keyed
+        state table → ``(out, derived[B, 8], S')``. ``donate=True``
+        donates both the staged batch and the state buffer (the update
+        is in-place on device); the caller commits ``S'`` back to the
+        table. Slot/decay operands come from
+        ``KeyedStateTable.assign_slots`` (host routing)."""
+        from flink_jpmml_tpu.compile import statekernel
+
+        fn = statekernel.entry_for(
+            self, "wire", K, donate, table.spec.decay, table.scratch
+        )
+        return fn(self.params, Xq, table.values, slots, rel, w, reset)
+
+    def predict_fused_padded_state(self, X, K: int, table, slots, rel,
+                                   w, reset, donate: bool = False):
+        """Fused-encode twin of :meth:`predict_padded_state` (raw f32
+        in, encode+score+state in one dispatch)."""
+        from flink_jpmml_tpu.compile import statekernel
+
+        fn = statekernel.entry_for(
+            self, "fused", K, donate, table.spec.decay, table.scratch
+        )
+        return fn(self.params, X, table.values, slots, rel, w, reset)
+
     def encode_device(self, X):
         """Run ONLY the on-device encode stage (jitted) → rank codes.
         The byte-parity oracle surface: tests assert this equals

@@ -1,0 +1,210 @@
+"""The fused state stage: lookup → derive → score → update, one jit.
+
+This module grafts the keyed state plane (runtime/state.py) into the
+EXISTING scoring dispatch. A state-armed dispatch runs ONE compiled
+program per batch:
+
+    out            = member kernel(params, X)        # unchanged
+    derived[B, 8]  = gather(S, slots) → session features
+    S'             = scatter-add/min/max(S, slots, f(out, w, rel))
+
+The state stage is pure XLA gather/scatter over the batch's slot
+vector — O(batch) work appended to the scoring program, never
+O(capacity) — and composes with EVERY backend the scorer already has:
+XLA, Pallas (the state ops wrap the scan-chunked kernel, outside the
+Pallas grid), fused-encode, and cross-model packs. No new Pallas
+kernel is warranted: per the accelerator guide, TPU scatter of a
+``[B, 8]`` update against a ``[rows, 8]`` table is bandwidth-trivial
+next to the tree-ensemble gathers it rides with, and XLA already fuses
+the gather into the kernel epilogue.
+
+Batch-consistent read semantics: every record's DERIVED features
+reflect the table as of the BATCH start (one gather before the
+batch's updates commit), and the updates themselves are scatter-ADD /
+-MIN / -MAX with product-form decay weights — commutative and
+associative, so the committed state is independent of record order
+within the batch and replay-exact across restarts (the checkpoint
+parity pin in bench --stateful).
+
+Donation: when the caller donates, BOTH the staged batch and the state
+buffer are donated (``donate_argnums=(1, 2)``) — the state update is
+in-place on device, so steady-state state memory is one ``[rows, 8]``
+buffer regardless of dispatch depth.
+
+Bypassed records (shed replay below the exactly-once high-water, pad
+rows) arrive with ``slot == scratch`` and weight 0: they read the
+scratch row (zeros → derived zeros) and their scatter contributions
+land on the scratch row, which the program zeroes before returning —
+by construction they cannot mutate any key's state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from flink_jpmml_tpu.runtime.state import (
+    COL_COUNT,
+    COL_DCOUNT,
+    COL_DSUM,
+    COL_LAST_T,
+    COL_MAX,
+    COL_MIN,
+    COL_SQSUM,
+    COL_SUM,
+    STATE_WIDTH,
+)
+from flink_jpmml_tpu.utils.exceptions import ModelCompilationException
+
+# row written into freshly claimed slots before the batch gather:
+# zero counts, ±inf extrema so the first min/max lands exactly
+_INIT_ROW = (0.0, 0.0, 0.0, 0.0, 0.0, 0.0, float("inf"), float("-inf"))
+# floor on the decayed-count denominator (a key whose decayed mass
+# fully evaporated reads mean 0, not inf)
+_DCOUNT_FLOOR = 1e-30
+
+
+def _state_step(S, score, slots, rel, w, reset, scratch, decay):
+    """One batch's state transition (traced inside the dispatch jit).
+
+    ``S[rows, 8]`` table · ``score[B]`` model outputs · ``slots[B]``
+    row per record (``scratch`` = bypass) · ``rel[B]`` decay stride
+    relative to the table epoch · ``w[B]`` product-form decay weight
+    λ^-rel (0 for bypassed rows) · ``reset[B]`` fresh-slot marks →
+    ``(derived[B, 8], S')``."""
+    f32 = jnp.float32
+    score = score.astype(f32)
+    rel = rel.astype(f32)
+    w = w.astype(f32)
+    init = jnp.asarray(_INIT_ROW, f32)
+    # fresh slots re-initialize; rows with nothing to reset aim the
+    # write at the scratch row (re-zeroed at the end regardless)
+    sel = jnp.where(reset, slots, scratch)
+    S = S.at[sel].set(init)
+    pre = S[slots]
+    count = pre[:, COL_COUNT]
+    seen = count > 0
+    safe = jnp.maximum(count, 1.0)
+    mean = pre[:, COL_SUM] / safe
+    var = jnp.maximum(pre[:, COL_SQSUM] / safe - mean * mean, 0.0)
+    # product form: stored U = Σ λ^-rel_i, decayed count as of this
+    # record's stride = U · λ^rel (≤ U); the decayed mean is the
+    # ratio, where λ^rel cancels — epoch-independent by construction
+    dcount = pre[:, COL_DCOUNT] * jnp.power(f32(decay), rel)
+    dmean = pre[:, COL_DSUM] / jnp.maximum(
+        pre[:, COL_DCOUNT], _DCOUNT_FLOOR
+    )
+    gap = rel - pre[:, COL_LAST_T]
+    derived = jnp.stack(
+        [count, mean, var, dcount, dmean, gap,
+         pre[:, COL_MIN], pre[:, COL_MAX]],
+        axis=1,
+    )
+    derived = jnp.where(seen[:, None], derived, f32(0.0))
+    # commutative scatter updates: the five accumulator columns are
+    # contiguous, so they ride one column-sliced scatter-add
+    adds = jnp.stack(
+        [jnp.ones_like(score), score, score * score, w, w * score],
+        axis=1,
+    )
+    S = S.at[slots, COL_COUNT:COL_DSUM + 1].add(adds)
+    S = S.at[slots, COL_LAST_T].max(rel)
+    S = S.at[slots, COL_MIN].min(score)
+    S = S.at[slots, COL_MAX].max(score)
+    # bypass/pad contributions all landed on the scratch row — zero it
+    # so snapshots stay clean and the next batch's bypass reads zeros
+    S = S.at[scratch].set(jnp.zeros((STATE_WIDTH,), f32))
+    return derived, S
+
+
+def _score_of(out):
+    """The scalar signal the state accumulates: the f32 value stream
+    (classification outputs carry it as the triple's first element)."""
+    return out[0] if isinstance(out, tuple) else out
+
+
+def entry_for(q, kind: str, K: int, donate: bool,
+              decay: float, scratch: int):
+    """The state-armed jit entry for one QuantizedScorer →
+    ``fn(params, X, S, slots, rel, w, reset) → (out, derived, S')``.
+
+    ``kind`` selects the scoring body exactly as the stateless entries
+    do — "wire" wraps the host-encoded kernel, "fused" the
+    encode+score program — and ``K`` scan-chunks it for the Pallas
+    fixed grid. Cached in the scorer's ``_multi_fns`` beside its
+    stateless twins (``adopt_backend`` clears them together)."""
+    key = ("state", kind, int(K), bool(donate),
+           int(scratch), float(decay))
+    fn = q._multi_fns.get(key)
+    if fn is not None:
+        return fn
+    if kind == "fused":
+        if q._fused_inner is None:
+            raise ModelCompilationException(
+                "fused encode unavailable for this model; state "
+                "dispatch needs the host-encode path"
+            )
+        base = q._fused_inner
+    else:
+        base = getattr(q._jit_fn, "__wrapped__", q._jit_fn)
+    inner = base if K == 1 else q._scan_over(base, K)
+
+    def state_fn(p, X, S, slots, rel, w, reset):
+        out = inner(p, X)
+        derived, S2 = _state_step(
+            S, _score_of(out), slots, rel, w, reset, scratch, decay
+        )
+        return out, derived, S2
+
+    fn = jax.jit(
+        state_fn, donate_argnums=(1, 2) if donate else ()
+    )
+    q._multi_fns[key] = fn
+    return fn
+
+
+def packed_entry(pack, donate: bool, decay: float, scratch: int,
+                 member: int = 0):
+    """PackedScorer twin: one launch scores ALL members and folds the
+    designated ``member``'s value stream into the shared state table
+    (the pack batch spans tenants over the SAME records; per-tenant
+    state rides per-tenant tables on the solo path). →
+    ``fn(params, Xp, S, slots, rel, w, reset) → (outs, derived, S')``
+    with every member's output byte-identical to the stateless
+    ``dispatch`` (the state stage only APPENDS ops)."""
+    fns = getattr(pack, "_state_fns", None)
+    if fns is None:
+        fns = pack._state_fns = {}
+    key = (int(member), bool(donate), int(scratch), float(decay))
+    fn = fns.get(key)
+    if fn is not None:
+        return fn
+    base = getattr(pack._jit_fn, "__wrapped__", pack._jit_fn)
+
+    def state_fn(pps, Xp, S, slots, rel, w, reset):
+        outs = base(pps, Xp)
+        derived, S2 = _state_step(
+            S, _score_of(outs[member]), slots, rel, w, reset,
+            scratch, decay,
+        )
+        return outs, derived, S2
+
+    fn = jax.jit(
+        state_fn, donate_argnums=(1, 2) if donate else ()
+    )
+    fns[key] = fn
+    return fn
+
+
+_renorm_fn = None
+
+
+def renorm(S, mul, add):
+    """Epoch renormalization: ``S · mul + add`` broadcast over rows
+    (one rare O(capacity) column op — see KeyedStateTable.maybe_renorm)."""
+    global _renorm_fn
+    if _renorm_fn is None:
+        _renorm_fn = jax.jit(
+            lambda s, m, a: s * m[None, :] + a[None, :]
+        )
+    return _renorm_fn(S, jnp.asarray(mul), jnp.asarray(add))

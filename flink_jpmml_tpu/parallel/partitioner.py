@@ -18,6 +18,8 @@ from __future__ import annotations
 import zlib
 from typing import Any, Callable, List, Sequence
 
+import numpy as np
+
 KeyFn = Callable[[Any], Any]
 
 
@@ -44,6 +46,73 @@ def stable_hash(key: Any) -> int:
     else:
         data = str(key).encode("utf-8")
     return zlib.crc32(data)
+
+
+# table-driven CRC32 (the zlib polynomial, reflected): one 256-entry
+# uint32 table lets stable_hash_vec fold whole key COLUMNS per lookup
+# instead of hashing records one python call at a time — the keyed
+# state plane (runtime/state.py) hashes every record of every batch
+def _crc32_table() -> np.ndarray:
+    table = np.empty(256, np.uint32)
+    for i in range(256):
+        c = np.uint32(i)
+        for _ in range(8):
+            c = np.where(
+                c & np.uint32(1),
+                np.uint32(0xEDB88320) ^ (c >> np.uint32(1)),
+                c >> np.uint32(1),
+            )
+        table[i] = c
+    return table
+
+
+_CRC32_TABLE = _crc32_table()
+
+
+def stable_hash_vec(keys) -> np.ndarray:
+    """Vectorized :func:`stable_hash` for int64 keys → uint32 hashes.
+
+    Bit-identical to ``stable_hash(int(k))`` for every int64 ``k``
+    (pinned in tests): the same ``b"i"`` + minimal-width little-endian
+    two's-complement encoding, the same CRC32 — so state-table slot
+    routing (runtime/state.py) and the rollout split / lane routing
+    that ride the scalar hash agree on every key by construction."""
+    k = np.asarray(keys, np.int64)
+    out = np.empty(k.shape, np.uint32)
+    ku = k.astype(np.uint64)
+    # scalar width = abs(key).bit_length()//8 + 1: b bytes iff
+    # abs(key) < 2^(8b-1), smallest such b (NOT the minimal signed
+    # width — Python widens the negative boundary values, e.g. -128
+    # rides 2 bytes — and the vec twin must match byte for byte).
+    # int64 magnitude in uint64 space so -2^63 doesn't overflow; it is
+    # the one key needing 9 bytes (its sign-extension byte is 0xFF).
+    mag = np.where(k < 0, (~ku) + np.uint64(1), ku)
+    nbytes = np.full(k.shape, 9, np.int8)
+    for b in range(8, 0, -1):
+        lim = np.uint64(1) << np.uint64(8 * b - 1)
+        nbytes = np.where(mag < lim, np.int8(b), nbytes)
+    tbl = _CRC32_TABLE
+    for b in np.unique(nbytes):
+        m = nbytes == b
+        crc = np.full(int(m.sum()), 0xFFFFFFFF, np.uint32)
+        # prefix byte b"i", then the low `b` bytes little-endian (the
+        # int64 two's-complement low bytes ARE the signed encoding
+        # once the formula says the value rides b bytes)
+        crc = tbl[(crc ^ np.uint32(ord("i"))) & np.uint32(0xFF)] ^ (
+            crc >> np.uint32(8)
+        )
+        grp = ku[m]
+        for shift in range(min(int(b), 8)):
+            byte = ((grp >> np.uint64(8 * shift)) & np.uint64(0xFF)).astype(
+                np.uint32
+            )
+            crc = tbl[(crc ^ byte) & np.uint32(0xFF)] ^ (crc >> np.uint32(8))
+        if b == 9:  # sign-extension byte of the 9-byte negatives
+            crc = tbl[(crc ^ np.uint32(0xFF)) & np.uint32(0xFF)] ^ (
+                crc >> np.uint32(8)
+            )
+        out[m] = crc ^ np.uint32(0xFFFFFFFF)
+    return out
 
 
 def rendezvous_pick(key: Any, lanes: Sequence[Any]) -> Any:

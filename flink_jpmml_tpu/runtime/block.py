@@ -38,6 +38,7 @@ from flink_jpmml_tpu.obs import trace as trace_mod
 from flink_jpmml_tpu.runtime import devfault
 from flink_jpmml_tpu.runtime import faults
 from flink_jpmml_tpu.runtime import prefetch as prefetch_mod
+from flink_jpmml_tpu.runtime import state as state_mod
 from flink_jpmml_tpu.runtime.checkpoint import CheckpointPolicy
 from flink_jpmml_tpu.runtime.dlq import (
     REASON_CRASH_LOOP,
@@ -315,6 +316,7 @@ class BlockPipelineBase:
         prefetch: Optional[bool] = None,
         failover=None,
         tenant: Optional[str] = None,
+        state=None,
     ):
         # per-tenant delivery label (serving/zoo.py plane): see
         # engine.Pipeline — records_out stays the total, the labelled
@@ -408,10 +410,29 @@ class BlockPipelineBase:
             checkpoint, metrics=self.metrics
         )
         ckpt_dir = getattr(checkpoint, "directory", None)
+        self._ckpt_dir = ckpt_dir
         self._fingerprint = (
             CrashFingerprint(ckpt_dir)
             if (ckpt_dir is not None and self._dlq is not None) else None
         )
+        # -- keyed per-record state (runtime/state.py) --------------------
+        # state=StateSpec arms the fused state stage (the table joins
+        # THIS pipeline's registry so state_* metrics scrape/merge like
+        # every other family); a prebuilt KeyedStateTable passes
+        # through (caller chose the registry). Unarmed pipelines pay
+        # one None check per dispatch.
+        if isinstance(state, state_mod.StateSpec):
+            state = state_mod.KeyedStateTable(state, metrics=self.metrics)
+        self._state = state
+        # >0 while a recovery/isolation path is dispatching: those
+        # re-dispatches (and bisection probes, which score records
+        # MORE THAN ONCE) must never mutate the table — the PR 8/12
+        # never-delivered contract extended to state
+        self._state_bypass = 0
+        # the batch offsets of the dispatch currently being launched
+        # (stashed by _dispatch_checked for the state stage; the score
+        # loop is single-threaded by the ring contract)
+        self._cur_offsets = None
         # -- device-fault resilience (runtime/devfault.py +
         #    serving/failover.py) ------------------------------------------
         # The recovery ladder (redispatch → OOM batch bisection →
@@ -473,6 +494,29 @@ class BlockPipelineBase:
             extra = snap(self.committed_offset)
             if extra is not None:
                 state["source_state"] = extra
+        if self._state is not None:
+            # the keyed state table rides the checkpoint: an npz
+            # sidecar beside the snapshots (same atomic-writer
+            # discipline) referenced by name, or an inline payload for
+            # small dirless tables. Saved at the SAME instant as the
+            # offsets (this method runs when the policy fires, on the
+            # score thread), so offsets and state agree; the table's
+            # own applied_hi makes replayed records below it bypass
+            # after restore (exactly-once state).
+            ref = (
+                self._state.save_sidecar(self._ckpt_dir)
+                if self._ckpt_dir is not None else None
+            )
+            if ref is not None:
+                state["state_sidecar"] = ref
+            else:
+                try:
+                    state["state"] = self._state.to_payload()
+                except Exception:
+                    # a large table with no checkpoint directory:
+                    # state is not durable — restart loses it (the
+                    # runbook's sizing note), offsets stay correct
+                    pass
         return state
 
     def restore(self) -> bool:
@@ -561,7 +605,13 @@ class BlockPipelineBase:
             )
 
     def _restore_extra(self, state: dict) -> None:
-        pass
+        if self._state is None:
+            return
+        ref = state.get("state_sidecar")
+        if ref and self._ckpt_dir is not None:
+            self._state.restore_sidecar(self._ckpt_dir, ref)
+        elif state.get("state"):
+            self._state.from_payload(state["state"])
 
     def start(self):
         t1 = threading.Thread(
@@ -745,11 +795,26 @@ class BlockPipelineBase:
         donation. ``encode_s``/``h2d_bytes`` accounting lands in this
         pipeline's metrics registry."""
         if bound.q is not None:
+            # keyed state arms here — and ONLY here: recovery ladders
+            # and bisection probes raise _state_bypass, so re-scored
+            # records can never fold into the table twice
+            st = (
+                self._state
+                if self._state is not None and not self._state_bypass
+                else None
+            )
             return dispatch_quantized(
                 bound.q, X,
                 donate=self._resolve_donate(),
                 metrics=self.metrics,
                 donation_hits=self._donation_hits,
+                state=st,
+                offsets=self._cur_offsets if st is not None else None,
+            )
+        if self._state is not None and not self._state_bypass:
+            raise InputValidationException(
+                "stateful scoring requires the rank-wire scorer "
+                "(f32 fallback dispatch cannot carry the state stage)"
             )
         return self._score_f32(bound.model, X, n)
 
@@ -790,6 +855,7 @@ class BlockPipelineBase:
         scored, so bisection isolates an injected poison the same way
         it isolates a real one."""
         faults.fire("score_batch", offsets=offsets)
+        self._cur_offsets = offsets  # state-stage decay clock + replay guard
         return self._dispatch(handle, X, n)
 
     def _on_dispatch_error(self, out, meta, error) -> bool:
@@ -806,6 +872,13 @@ class BlockPipelineBase:
         if shed or X is None or offsets is None:
             return False
         ctx = meta[7] if len(meta) > 7 else None
+        if self._state is not None and not self._state_bypass:
+            # the failed dispatch donated (and thereby poisoned) the
+            # state buffer and may have chained later in-flight batches
+            # on it: restore the last snapshot before ANY recovery
+            # re-dispatch. Bounded, counted loss (state_rollbacks);
+            # the recovery paths below score statelessly.
+            self._state.rollback()
         kind = devfault.classify(error)
         if kind is not None:
             if self._failover is None:
@@ -825,7 +898,11 @@ class BlockPipelineBase:
         an injected persistent fault keeps failing here exactly like a
         real one) → (out, decode), device-synchronized."""
         faults.fire("device_dispatch")
-        out, decode = self._dispatch_checked(handle, X, n, offsets)
+        self._state_bypass += 1  # recovery re-scores: never re-fold state
+        try:
+            out, decode = self._dispatch_checked(handle, X, n, offsets)
+        finally:
+            self._state_bypass -= 1
         faults.fire("device_readback")
         _block_ready(out)
         return out, decode
@@ -1052,6 +1129,13 @@ class BlockPipelineBase:
         BoundScorer's decode closure follows ``handle.model``, so the
         sink path needs no rebind)."""
         handle.model = rebuilt
+        if self._state is not None:
+            # chip loss moves state WITH its keys: slot = hash %
+            # capacity is mesh-independent, so re-placing the value
+            # buffer over the survivors preserves every key's state
+            mesh = getattr(rebuilt, "mesh", None)
+            if mesh is not None:
+                self._state.migrate(mesh)
 
     def _oom_recover(self, handle, X, offsets, error, ctx=None) -> None:
         """Device-OOM ladder step: bisect the BATCH SIZE until runs
@@ -1193,6 +1277,18 @@ class BlockPipelineBase:
         dispatch = dispatch if dispatch is not None else (
             self._dispatch_checked
         )
+        if self._state is not None:
+            # bisection probes score records MORE THAN ONCE (and DLQ'd
+            # records must never land at all): every sub-dispatch of
+            # the scan runs with the state stage disarmed
+            inner_dispatch = dispatch
+
+            def dispatch(h, Xs, ns, os_, _inner=inner_dispatch):
+                self._state_bypass += 1
+                try:
+                    return _inner(h, Xs, ns, os_)
+                finally:
+                    self._state_bypass -= 1
         n = int(X.shape[0])
         if n == 0:
             return
@@ -1455,6 +1551,14 @@ class BlockPipelineBase:
                     monitor.maybe_tick()
                 return
             out, decode = pair
+            derived = None
+            if self._state is not None:
+                # a state-armed dispatch returns (score_out, derived):
+                # the sink sees exactly the stateless output shape,
+                # and the derived session features feed the drift
+                # plane under the model's "#state" label (state
+                # corruption surfaces as feature drift)
+                out, derived = state_mod.split_output(out)
             t_sink = time.monotonic()
             # the completing batch's OWN context wraps the sink: its
             # span (and any exemplar the sink stage captures) must
@@ -1478,6 +1582,16 @@ class BlockPipelineBase:
                     or getattr(decode, "model_key", None),
                     out, n,
                 )
+                if derived is not None:
+                    state_mod.record_derived(
+                        dplane, self._state,
+                        getattr(
+                            getattr(meta[4], "q", None)
+                            if len(meta) > 4 else None,
+                            "model_hash", None,
+                        ),
+                        derived, n,
+                    )
             if jstore is not None and jctx is not None:
                 # the sink hop closes the journey: tail-sampling keeps
                 # it only if it is interesting (exemplar-marked, head
@@ -1719,6 +1833,17 @@ class BlockPipelineBase:
                         "dispatch", jctx, first_off, n,
                         model=getattr(handle, "key", None),
                     )
+                    if (
+                        self._state is not None
+                        and not self._state_bypass
+                    ):
+                        # the state read/update rides THIS dispatch:
+                        # one hop per batch so fjt-trace shows the
+                        # session-state hop in the journey
+                        jstore.hop(
+                            "state", jctx, first_off, n,
+                            resident=self._state.resident,
+                        )
                 try:
                     with trace_mod.use(jctx):
                         disp.launch(
@@ -1752,6 +1877,18 @@ class BlockPipelineBase:
                     # re-raised) inside launch's trim via on_error, so
                     # this exception belongs to THIS batch
                     kind = devfault.classify(e)
+                    if (
+                        self._state is not None
+                        and not self._state_bypass
+                        and (kind is not None and self._failover
+                             is not None
+                             or kind is None and self._dlq is not None)
+                    ):
+                        # a recoverable launch failure may have half-
+                        # applied this batch to the table (host mirror
+                        # mutated, device update never dispatched):
+                        # restore the snapshot before recovery
+                        self._state.rollback()
                     if kind is not None and self._failover is not None:
                         # older in-flight batches must commit BEFORE
                         # this one's synchronous recovery commits its
@@ -1811,6 +1948,7 @@ class BlockPipeline(BlockPipelineBase):
         prefetch: Optional[bool] = None,
         failover=None,
         mesh=None,
+        state=None,
     ):
         if model.batch_size is None:
             raise InputValidationException(
@@ -1868,17 +2006,34 @@ class BlockPipeline(BlockPipelineBase):
             dlq=dlq,
             prefetch=prefetch,
             failover=failover,
+            state=state,
         )
         self._bound = BoundScorer("static", model, use_quantized)
         self.backend = self._bound.backend
         self.metrics.counter(f"scorer_backend_{self.backend}").inc()
+        if self._state is not None:
+            if self._bound.q is None:
+                raise InputValidationException(
+                    "stateful scoring requires the rank-wire scorer: "
+                    "this model is not quantized-eligible (or "
+                    "use_quantized=False)"
+                )
+            model_mesh = getattr(model, "mesh", None)
+            if model_mesh is not None:
+                # shard the table over the mesh data axis alongside
+                # the model it rides with
+                self._state.shard(model_mesh)
         if hasattr(model, "batch_divisor"):
             from flink_jpmml_tpu.obs import mesh as mesh_obs
 
             self._mesh_obs = mesh_obs.telemetry_for(self.metrics, model)
 
     def decode(self, out, n: int):
-        """Sink-received raw output → ``Prediction`` list (host-side)."""
+        """Sink-received raw output → ``Prediction`` list (host-side).
+        A state-armed pipeline's sink still receives the stateless
+        output shape (the pipeline unwraps the derived features before
+        the sink), but decode also tolerates a raw fused pair."""
+        out, _ = state_mod.split_output(out)
         return self._bound.decode(out, n)
 
     def _acquire(self, finish_one):

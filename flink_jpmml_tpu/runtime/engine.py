@@ -20,6 +20,7 @@ Metrics (BASELINE §metrics): records/sec, p50/p99 per-record latency
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -52,9 +53,11 @@ from flink_jpmml_tpu.runtime.dlq import (
     serialize_record,
 )
 from flink_jpmml_tpu.runtime.queues import BoundedQueue, Closed
+from flink_jpmml_tpu.runtime import state as state_mod
 from flink_jpmml_tpu.runtime.sinks import Sink
 from flink_jpmml_tpu.runtime.sources import Source, batch_event_range
 from flink_jpmml_tpu.utils.config import RuntimeConfig
+from flink_jpmml_tpu.utils.exceptions import InputValidationException
 from flink_jpmml_tpu.utils.metrics import MetricsRegistry
 from flink_jpmml_tpu.utils.profiling import StageTimer
 
@@ -108,6 +111,7 @@ class StaticScorer(Scorer):
         emit: Optional[EmitFn] = None,
         replace_nan: Optional[float] = None,
         use_quantized: bool = True,
+        state=None,
     ):
         self._model = model
         self._replace_nan = replace_nan
@@ -119,6 +123,22 @@ class StaticScorer(Scorer):
         # scores through the same f32 predict contract.
         probe = getattr(model, "quantized_scorer", None)
         self._q = probe() if (use_quantized and probe is not None) else None
+        # keyed session state (runtime/state.py): a StateSpec builds a
+        # private table (pass a KeyedStateTable constructed with the
+        # pipeline's MetricsRegistry to surface its state_* family);
+        # state rides the rank-wire dispatch, so the f32 fallback
+        # contract cannot carry it
+        if isinstance(state, state_mod.StateSpec):
+            state = state_mod.KeyedStateTable(state)
+        if state is not None and self._q is None:
+            raise InputValidationException(
+                "keyed state needs the rank-wire scorer (this model "
+                "has no quantized path)"
+            )
+        self.state_table = state
+        # the engine passes per-record source offsets (the state decay
+        # clock + exactly-once replay guard) only to scorers that ask
+        self.accepts_offsets = state is not None
         # which scoring backend this scorer engages (surfaced in the
         # pipeline's metrics as scorer_backend_*)
         self.backend = (
@@ -134,12 +154,15 @@ class StaticScorer(Scorer):
             self._model.field_space, arr, self._replace_nan
         )
 
-    def submit(self, records: Sequence[Any]):
+    def submit(self, records: Sequence[Any], offsets=None):
         from flink_jpmml_tpu.runtime.block import _prefetch_host
 
         X, M = self._extract(records)
         n = X.shape[0]
         if self._q is not None:
+            table = self.state_table
+            if table is not None and not table.bypassed:
+                return self._submit_state(table, records, X, M, offsets)
             Xq = self._q.wire.encode(X, M)
             # predict_wire owns batch-size alignment (padding / chunking)
             out = self._q.predict_wire(Xq)  # async dispatch
@@ -151,13 +174,66 @@ class StaticScorer(Scorer):
         _prefetch_host(out)
         return ("f", out, records, n)
 
+    def _submit_state(self, table, records, X, M, offsets):
+        """State-armed dispatch: host slot routing + ONE fused
+        lookup→score→update launch (cf. pipeline.dispatch_quantized's
+        block-path twin). The updated state buffer commits immediately
+        — the next dispatch chains on it device-side."""
+        from flink_jpmml_tpu.runtime.block import _prefetch_host
+
+        n = X.shape[0]
+        khash = table.hash_records(records)
+        offs = (
+            np.asarray(offsets, np.int64) if offsets is not None
+            else None
+        )
+        first = (
+            int(offs[0]) if offs is not None and offs.size
+            else table.applied_hi
+        )
+        table.maybe_renorm(first)
+        slots, reset, rel, w = table.assign_slots(khash, offs)
+        Xq = self._q.wire.encode(X, M)
+        Xq, K = self._q.pad_wire(Xq)
+        pad = Xq.shape[0] - n
+        if pad > 0:
+            # alignment rows ride the scratch slot with zero weight
+            slots = np.concatenate(
+                [slots, np.full(pad, table.scratch, np.int32)]
+            )
+            reset = np.concatenate([reset, np.zeros(pad, bool)])
+            rel = np.concatenate([rel, np.zeros(pad, np.float32)])
+            w = np.concatenate([w, np.zeros(pad, np.float32)])
+        out, derived, S2 = self._q.predict_padded_state(
+            Xq, K, table, slots, rel, w, reset
+        )
+        table.commit(S2)
+        _prefetch_host((out, derived))
+        return ("q", (out, derived), records, n)
+
     def finish(self, ticket) -> List[Any]:
         kind, out, records, n = ticket
+        out, _derived = state_mod.split_output(out)
         if kind == "q":
             preds = self._q.decode(out, n)  # blocks on device
         else:
             preds = self._model.decode(out, n)  # blocks on device
         return self._emit(records, preds)
+
+    def state(self) -> dict:
+        if self.state_table is None:
+            return {}
+        try:
+            # inline payload (small tables); beyond the inline cap the
+            # record path degrades to stateless restore (documented)
+            return {"keyed_state": self.state_table.to_payload()}
+        except InputValidationException:
+            return {}
+
+    def restore(self, state: dict) -> None:
+        payload = state.get("keyed_state")
+        if payload and self.state_table is not None:
+            self.state_table.from_payload(payload)
 
 
 class Pipeline:
@@ -410,10 +486,29 @@ class Pipeline:
         faults.fire(
             "score_batch", offsets=[self._record_off(s) for s in seq]
         )
-        faults.fire("device_dispatch")
-        ticket = self._scorer.submit([s.record for s in seq])
-        faults.fire("device_readback")
-        return self._scorer.finish(ticket)
+        # isolation/recovery replays score STATELESSLY: these records
+        # may already be folded into the keyed state table (the failed
+        # dispatch committed before the error surfaced) — a bypassed
+        # redispatch cannot double-apply them (runtime/state.py)
+        table = getattr(self._scorer, "state_table", None)
+        ctx = table.bypass() if table is not None else (
+            contextlib.nullcontext()
+        )
+        with ctx:
+            faults.fire("device_dispatch")
+            ticket = self._scorer.submit([s.record for s in seq])
+            faults.fire("device_readback")
+            return self._scorer.finish(ticket)
+
+    def _roll_back_state(self) -> None:
+        """A dispatch error with a donated/chained keyed-state buffer
+        may have poisoned it (or committed a partial update): restore
+        the last snapshot before recovery/isolation replays the range —
+        bounded, counted loss (``state_rollbacks``); the replayed
+        records then score statelessly via ``_score_seq``'s bypass."""
+        table = getattr(self._scorer, "state_table", None)
+        if table is not None and not table.bypassed:
+            table.rollback()
 
     def _book_tenant(self, n: int) -> None:
         if self._tenant is not None:
@@ -775,10 +870,12 @@ class Pipeline:
                 if kind is not None:
                     if not self._devfault_armed:
                         raise  # historical fail-fast: restart instead
+                    self._roll_back_state()
                     self._recover_device(stamped, e, kind, ctx=jctx)
                     return
                 if self._dlq is None:
                     raise
+                self._roll_back_state()
                 self._isolate(stamped, e, ctx=jctx)
                 return
             with trace_mod.use(jctx):
@@ -864,9 +961,23 @@ class Pipeline:
                                 ],
                             )
                             faults.fire("device_dispatch")
-                            ticket = self._scorer.submit(
-                                [s.record for s in stamped]
-                            )
+                            if getattr(
+                                self._scorer, "accepts_offsets", False
+                            ):
+                                # keyed-state scorers get the record
+                                # offsets: the state decay clock + the
+                                # exactly-once replay guard
+                                ticket = self._scorer.submit(
+                                    [s.record for s in stamped],
+                                    offsets=[
+                                        self._record_off(s)
+                                        for s in stamped
+                                    ],
+                                )
+                            else:
+                                ticket = self._scorer.submit(
+                                    [s.record for s in stamped]
+                                )
                 except PoisonIsolationOverflow:
                     raise
                 except Exception as e:
@@ -881,6 +992,7 @@ class Pipeline:
                         raise
                     while in_flight:
                         _finish_one()
+                    self._roll_back_state()
                     if kind is not None:
                         self._recover_device(stamped, e, kind, ctx=jctx)
                     else:

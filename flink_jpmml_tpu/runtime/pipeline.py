@@ -177,6 +177,9 @@ def dispatch_quantized(
     donate: bool = False,
     metrics: Optional[MetricsRegistry] = None,
     donation_hits=None,
+    state=None,
+    state_keys=None,
+    offsets=None,
 ):
     """Featurize + stage + async-dispatch one raw f32 batch through a
     :class:`~flink_jpmml_tpu.compile.qtrees.QuantizedScorer` — the ONE
@@ -204,7 +207,16 @@ def dispatch_quantized(
     donates the staging buffer to the jitted call (released to the
     device allocator at dispatch, not pinned until fetch);
     ``donation_hits`` counts dispatches whose buffer was actually
-    consumed."""
+    consumed.
+
+    ``state`` arms the keyed state stage (runtime/state.py): the batch
+    additionally gathers/updates the table's device buffer inside the
+    SAME dispatch and the return becomes ``(out, derived[B, 8])``
+    (callers unwrap via ``state.is_state_output``). ``state_keys`` are
+    precomputed uint32 key hashes (default: hash the table's key
+    column of ``X``); ``offsets`` are the records' ring offsets —
+    the decay clock and the exactly-once replay guard. Unarmed cost is
+    one ``is None`` check."""
     enc, h2d = (
         _wire_counters(metrics) if metrics is not None else (None, None)
     )
@@ -259,8 +271,50 @@ def dispatch_quantized(
         ledger.observe("encode", t1 - t0)
     if h2d is not None:
         h2d.inc(payload.nbytes)
+    st_args = None
+    if state is not None:
+        # keyed state routing (host-side slot assignment; the state
+        # gather/update itself is traced into the dispatch below) —
+        # one vectorized pass per batch, zero per-record host work
+        n_rec = np.asarray(X).shape[0]
+        khash = (
+            np.asarray(state_keys, np.uint32)
+            if state_keys is not None
+            else state.hash_keys(state.extract_keys(X))
+        )
+        offs = (
+            np.asarray(offsets, np.int64) if offsets is not None
+            else None
+        )
+        first = (
+            int(offs[0]) if offs is not None and offs.size
+            else state.applied_hi
+        )
+        state.maybe_renorm(first)
+        slots, reset, rel, w = state.assign_slots(khash, offs)
+        pad = payload.shape[0] - n_rec
+        if pad > 0:
+            # alignment rows ride the scratch slot with zero weight —
+            # by construction they cannot touch any key's state
+            slots = np.concatenate(
+                [slots, np.full(pad, state.scratch, np.int32)]
+            )
+            reset = np.concatenate([reset, np.zeros(pad, bool)])
+            rel = np.concatenate([rel, np.zeros(pad, np.float32)])
+            w = np.concatenate([w, np.zeros(pad, np.float32)])
+        st_args = (slots, rel, w, reset)
+        predict_state = (
+            q.predict_fused_padded_state if fused
+            else q.predict_padded_state
+        )
     if not donate:
-        out = predict(payload, K)  # async dispatch
+        if st_args is None:
+            out = predict(payload, K)  # async dispatch
+        else:
+            out, derived, S2 = predict_state(payload, K, state,
+                                             *st_args)
+            state.commit(S2)
+            out = (out, derived)
         t2 = time.monotonic()
         spans.emit("h2d_dispatch", t1, t2 - t1, bytes=payload.nbytes)
         if ledger is not None:
@@ -271,7 +325,23 @@ def dispatch_quantized(
     if fused:
         filter_donate_warning(rf"float32\[\d+,{payload.shape[1]}\]")
     staged = jax.device_put(payload)  # async H2D staging copy
-    out = predict(staged, K, donate=True)
+    if st_args is None:
+        out = predict(staged, K, donate=True)
+    else:
+        # the state buffer donates alongside the batch: its update is
+        # in-place on device (one [rows, 8] buffer in steady state)
+        filter_donate_warning(r"float32\[\d+,8\]")
+        if not fused:
+            # the uint wire payload rides the same donated call and can
+            # never output-alias its scores — the same inert warning
+            # the block pipelines' uint-wire filter suppresses
+            filter_donate_warning(
+                rf"uint(?:8|16)\[\d+,{payload.shape[1]}\]"
+            )
+        out, derived, S2 = predict_state(staged, K, state, *st_args,
+                                         donate=True)
+        state.commit(S2)
+        out = (out, derived)
     t2 = time.monotonic()
     spans.emit("h2d_dispatch", t1, t2 - t1, bytes=payload.nbytes)
     if ledger is not None:

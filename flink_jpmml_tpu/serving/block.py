@@ -185,6 +185,7 @@ class DynamicBlockPipeline(BlockPipelineBase):
         return state
 
     def _restore_extra(self, state: dict) -> None:
+        super()._restore_extra(state)  # keyed state table, if armed
         self.registry.restore(state.get("registry", {}))
 
     # -- model resolution --------------------------------------------------

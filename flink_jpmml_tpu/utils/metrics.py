@@ -810,6 +810,11 @@ _GAUGE_MERGE_MAX_PREFIXES = (
     # workers serve the same zoo, so summing double-counts tenants;
     # the fleet value is the fullest worker's registry
     "zoo_tenants",
+    # keyed session state (runtime/state.py): occupancy is a capacity
+    # fraction — the fleet view wants the fullest table (the one next
+    # to evict), so MAX; resident_keys stays a sum (tables are
+    # worker-local, key spaces disjoint by lane routing)
+    "state_occupancy_frac",
 )
 _GAUGE_MERGE_MIN_PREFIXES = (
     "slo_ok", "watermark_ts", "watermark_stage_ts", "adaptive_batch",
@@ -824,6 +829,10 @@ _GAUGE_MERGE_MIN_PREFIXES = (
     # fraction — the fleet is as constrained as its tightest worker, so
     # MIN; averaging (or summing) headroom hides the saturated worker
     "headroom_frac",
+    # keyed session state (runtime/state.py): hit ratio is a quality
+    # fraction — the fleet view is the coldest table (the one churning
+    # keys); a sum of ratios means nothing
+    "state_hit_ratio",
 )
 
 

@@ -20,14 +20,21 @@ def test_perf_smoke_passes():
     # +30s over the pre-device-fault budget: the device-fault check
     # paces a ~12k-record stream through a breaker lifecycle (~3-6s)
     # plus one extra GBM compile; +30s more for the history check's
-    # 1s armed-budget window, GBM compile, and live /history reconcile
-    env["FJT_SMOKE_WATCHDOG_S"] = "270"
+    # 1s armed-budget window, GBM compile, and live /history reconcile;
+    # +60s more for the keyed-state check: one extra GBM compile, two
+    # state-entry jit compiles, 120 timed dispatches, and a replay-
+    # parity pass over 80 more
+    env["FJT_SMOKE_WATCHDOG_S"] = "330"
     env.pop("FJT_FAULTS", None)  # the no-op check requires a clean env
     env.pop("FJT_RESTART_STREAK", None)
     env.pop("FJT_JOURNEY_DIR", None)  # the journey gate check likewise
     env.pop("FJT_FAILOVER", None)  # the fail-fast default likewise
     env.pop("FJT_HISTORY_DIR", None)  # the unarmed-gate check likewise
     env.pop("FJT_METRICS_MAX_SERIES", None)  # reconcile needs raw series
+    env.pop("FJT_STATE_CAPACITY", None)  # keyed-state check sizes its own
+    env.pop("FJT_STATE_PROBE", None)
+    env.pop("FJT_STATE_DECAY", None)
+    env.pop("FJT_STATE_STRIDE", None)
     proc = subprocess.run(
         [sys.executable, str(_SMOKE)],
         capture_output=True, text=True, timeout=420, env=env,
@@ -53,3 +60,4 @@ def test_perf_smoke_passes():
     assert "fault hooks no-op OK" in proc.stdout
     assert "mesh gate no-op OK" in proc.stdout
     assert "history OK" in proc.stdout
+    assert "keyed state OK" in proc.stdout

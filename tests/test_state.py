@@ -1,0 +1,441 @@
+"""Keyed per-key state plane (ISSUE 19): the open-addressed
+device-resident table (runtime/state.py) + the fused gather/fold stage
+(compile/statekernel.py) behind ``dispatch_quantized(state=...)``.
+
+Pins, in order: host slot routing under adversarial hash collisions
+(probe windows, LRU eviction that never steals a slot touched this
+batch, scratch overflow), the exactly-once replay guard, the fold
+columns against hand-computed ground truth, armed-vs-stateless score
+parity, checkpoint payload/sidecar roundtrips, degraded-mesh migration
+parity on the conftest 8-device virtual mesh, and the never-delivered
+contract extended to state: a DLQ'd batch must never leave folds in
+the table (rollback-to-snapshot semantics, deterministic with no
+checkpoint pinned)."""
+
+import numpy as np
+import pytest
+
+from flink_jpmml_tpu.parallel.partitioner import stable_hash
+from flink_jpmml_tpu.runtime import state as state_mod
+from flink_jpmml_tpu.runtime.state import (
+    COL_COUNT,
+    COL_DCOUNT,
+    COL_LAST_T,
+    COL_MAX,
+    COL_MIN,
+    COL_SUM,
+    KeyedStateTable,
+    StateSpec,
+)
+from flink_jpmml_tpu.utils.exceptions import InputValidationException
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+
+def _table(capacity=16, probe=4, **kw):
+    m = MetricsRegistry()
+    return KeyedStateTable(
+        StateSpec(capacity=capacity, probe=probe, **kw), metrics=m
+    ), m
+
+
+def _colliding_keys(capacity, base, n, start=0):
+    """n distinct int keys whose stable hashes all land on probe base
+    ``base`` of a ``capacity``-slot table (brute-force: the adversarial
+    suite the open addressing must survive)."""
+    out, k = [], start
+    while len(out) < n:
+        t = KeyedStateTable(StateSpec(capacity=capacity))
+        h = int(t.hash_keys(np.array([k]))[0])
+        if h % capacity == base:
+            out.append(k)
+        k += 1
+    return out
+
+
+class TestSlotRouting:
+    def test_hit_reuses_slot(self):
+        t, m = _table()
+        kh = t.hash_keys(np.array([5, 9, 5]))
+        s1, r1, _, w1 = t.assign_slots(kh, np.arange(3))
+        assert s1[0] == s1[2] != s1[1]
+        assert r1.all()  # every key fresh this batch
+        assert (w1 > 0).all()
+        s2, r2, _, _ = t.assign_slots(kh, np.arange(3, 6))
+        assert np.array_equal(s1, s2)
+        assert not r2.any()
+        c = m.struct_snapshot()["counters"]
+        assert c["state_inserts"] == 2
+        assert c["state_hits"] == 3
+        assert t.resident == 2
+        assert t.applied_hi == 6
+
+    def test_spec_validation(self):
+        with pytest.raises(InputValidationException):
+            StateSpec(capacity=1)
+        with pytest.raises(InputValidationException):
+            StateSpec(capacity=8, decay=1.0)
+        with pytest.raises(InputValidationException):
+            StateSpec(capacity=8, probe=0)
+
+    def test_collisions_probe_to_distinct_slots(self):
+        cap = 32
+        keys = _colliding_keys(cap, base=3, n=4)
+        t, m = _table(capacity=cap, probe=8)
+        kh = t.hash_keys(np.array(keys))
+        slots, reset, _, _ = t.assign_slots(kh, np.arange(4))
+        assert reset.all()
+        assert len(set(slots.tolist())) == 4, slots
+        # every slot inside the probe window off the shared base
+        assert all((int(s) - 3) % cap < 8 for s in slots)
+        c = m.struct_snapshot()["counters"]
+        assert c["state_collisions"] == 3  # all but one pending at p=0
+
+    def test_eviction_lru_never_this_batch(self):
+        cap = 32
+        a, b, c = _colliding_keys(cap, base=7, n=3)
+        t, m = _table(capacity=cap, probe=2)
+        t.assign_slots(t.hash_keys(np.array([a, b])), np.arange(2))
+        slot_a = int(t.assign_slots(
+            t.hash_keys(np.array([a])), np.array([2])
+        )[0][0])  # refresh A: B becomes the LRU of the window
+        slots_b1, _, _, _ = t.assign_slots(
+            t.hash_keys(np.array([b])), np.array([3])
+        )
+        t.assign_slots(t.hash_keys(np.array([a])), np.array([4]))
+        sc, rc, _, _ = t.assign_slots(
+            t.hash_keys(np.array([c])), np.array([5])
+        )
+        # C landed by evicting LRU B — never A (fresher), never scratch
+        assert int(sc[0]) == int(slots_b1[0]) != slot_a
+        assert rc.all()
+        assert m.struct_snapshot()["counters"]["state_evictions"] == 1
+        # B returns as a fresh insert: its state was evicted with it
+        sb, rb, _, _ = t.assign_slots(
+            t.hash_keys(np.array([b])), np.array([6])
+        )
+        assert rb.all()
+
+    def test_window_overflow_bypasses_to_scratch(self):
+        cap = 32
+        keys = _colliding_keys(cap, base=11, n=3)
+        t, m = _table(capacity=cap, probe=2)
+        kh = t.hash_keys(np.array(keys))
+        slots, _, _, _ = t.assign_slots(kh, np.arange(3))
+        # two claim the window; the third may not evict a slot touched
+        # THIS batch — it overflows to the scratch row
+        assert sorted(slots.tolist())[:2] != [t.scratch, t.scratch]
+        assert int(slots.max()) == t.scratch
+        c = m.struct_snapshot()["counters"]
+        assert c["state_overflow"] == 1
+        assert c["state_evictions"] == 0
+
+    def test_replay_below_skip_until_bypasses(self):
+        t, m = _table()
+        kh = t.hash_keys(np.array([1, 2, 3]))
+        t.assign_slots(kh, np.arange(3))
+        assert t.applied_hi == 3
+        t.skip_until = 3
+        s2, r2, _, w2 = t.assign_slots(kh, np.arange(3))
+        assert (s2 == t.scratch).all()
+        assert not r2.any()
+        assert (w2 == 0).all()
+        assert t.applied_hi == 3
+        c = m.struct_snapshot()["counters"]
+        assert c["state_bypass_records"] == 3
+        # fresh offsets past the guard fold again
+        s3, _, _, w3 = t.assign_slots(kh, np.arange(3, 6))
+        assert (s3 != t.scratch).all()
+        assert (w3 > 0).all()
+
+    def test_bypass_context(self):
+        """``bypass()`` is a CALL-SITE contract: armed dispatch paths
+        check ``table.bypassed`` and score stateless — the table never
+        gates ``assign_slots`` itself.  Assert the flag's scoping and
+        nesting, and that it survives an exception in the window."""
+        t, _ = _table()
+        assert not t.bypassed
+        with t.bypass():
+            assert t.bypassed
+            with t.bypass():  # recovery ladder inside poison bisection
+                assert t.bypassed
+            assert t.bypassed
+        assert not t.bypassed
+        with pytest.raises(RuntimeError):
+            with t.bypass():
+                raise RuntimeError("redispatch blew up")
+        assert not t.bypassed
+
+    def test_hash_matches_scalar_stable_hash(self):
+        t, _ = _table()
+        for k in (-128, -1, 0, 1, 7, 2**40, -(2**40)):
+            assert int(t.hash_keys(np.array([k]))[0]) == (
+                stable_hash(k) & 0xFFFFFFFF
+            ), k
+
+
+@pytest.fixture(scope="module")
+def gbm(tmp_path_factory):
+    from flink_jpmml_tpu.assets_gen import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+
+    tmp = tmp_path_factory.mktemp("state_gbm")
+    path = gen_gbm(str(tmp), n_trees=5, depth=3, n_features=4)
+    return compile_pmml(parse_pmml_file(path), batch_size=32)
+
+
+def _batches(n_batches, keys, seed=11, B=32, feats=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0.0, 1.0, size=(n_batches * B, feats)).astype(
+        np.float32
+    )
+    X[:, 0] = rng.integers(0, keys, size=n_batches * B).astype(
+        np.float32
+    )
+    return [
+        (X[i * B: (i + 1) * B], np.arange(i * B, (i + 1) * B))
+        for i in range(n_batches)
+    ]
+
+
+class TestFusedFold:
+    def test_armed_scores_match_stateless(self, gbm):
+        import jax
+
+        from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+
+        q = gbm.quantized_scorer()
+        t, _ = _table(capacity=64)
+        (X, offs) = _batches(1, keys=8)[0]
+        plain = np.asarray(dispatch_quantized(q, X))
+        res = dispatch_quantized(q, X, state=t, offsets=offs)
+        assert state_mod.is_state_output(res)
+        out, derived = state_mod.split_output(res)
+        jax.block_until_ready(out)
+        assert np.array_equal(np.asarray(out), plain)
+        d = np.asarray(derived)
+        assert d.shape == (32, len(state_mod.DERIVED_FIELDS))
+        # derived features gather PRE-update: a key's first record of
+        # the stream sees count 0
+        first_rows = [
+            int(np.flatnonzero(X[:, 0] == k)[0])
+            for k in np.unique(X[:, 0])
+        ]
+        assert all(d[r, 0] == 0.0 for r in first_rows)
+
+    def test_fold_columns_ground_truth(self, gbm):
+        import jax
+
+        from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+
+        q = gbm.quantized_scorer()
+        t, m = _table(capacity=64)
+        batches = _batches(2, keys=1, seed=3)  # a single key: col 0
+        for X, offs in batches:
+            X[:, 0] = 7.0
+        scores = np.concatenate([
+            np.asarray(dispatch_quantized(q, X)).ravel()
+            for X, _ in batches
+        ])
+        for X, offs in batches:
+            dispatch_quantized(q, X, state=t, offsets=offs)
+        jax.block_until_ready(t.values)
+        kh = int(t.hash_keys(np.array([7]))[0])
+        slot = int(np.flatnonzero(t._occ & (t._keys == kh))[0])
+        v = np.asarray(t.values)[slot]
+        assert v[COL_COUNT] == 64.0
+        # offsets 0..63 sit inside stride 0: every product-form weight
+        # is exactly 1, so the decayed count equals the plain count
+        assert v[COL_DCOUNT] == 64.0
+        assert v[COL_LAST_T] == 0.0
+        assert v[COL_MIN] == scores.min()
+        assert v[COL_MAX] == scores.max()
+        np.testing.assert_allclose(
+            v[COL_SUM], scores.sum(dtype=np.float64), rtol=1e-5
+        )
+        # scratch row stays zero: padding/bypass can never leak state
+        assert not np.asarray(t.values)[t.scratch].any()
+        assert m.struct_snapshot()["counters"]["state_records"] == 64
+
+    def test_donate_matches_copy_fold(self, gbm):
+        import jax
+
+        from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+
+        q = gbm.quantized_scorer()
+        batches = _batches(3, keys=16, seed=5)
+        tables = []
+        for donate in (False, True):
+            t, _ = _table(capacity=64)
+            for X, offs in batches:
+                dispatch_quantized(
+                    q, X.copy(), state=t, offsets=offs, donate=donate,
+                )
+            jax.block_until_ready(t.values)
+            tables.append(np.asarray(t.values).copy())
+        assert tables[0].tobytes() == tables[1].tobytes()
+
+
+class TestCheckpointRoundtrip:
+    def _folded(self, gbm, capacity=64):
+        import jax
+
+        from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+
+        q = gbm.quantized_scorer()
+        t, _ = _table(capacity=capacity)
+        for X, offs in _batches(2, keys=12, seed=9):
+            dispatch_quantized(q, X, state=t, offsets=offs)
+        jax.block_until_ready(t.values)
+        return t
+
+    def test_payload_roundtrip_byte_exact(self, gbm):
+        t = self._folded(gbm)
+        p = t.to_payload()
+        t2, _ = _table(capacity=64)
+        assert t2.from_payload(p)
+        assert (
+            np.asarray(t2.values).tobytes()
+            == np.asarray(t.values).tobytes()
+        )
+        assert np.array_equal(t2._keys, t._keys)
+        assert np.array_equal(t2._occ, t._occ)
+        assert t2.resident == t.resident
+        # restore arms the exactly-once replay guard
+        assert t2.skip_until == t.applied_hi == 64
+
+    def test_sidecar_roundtrip_byte_exact(self, gbm, tmp_path):
+        t = self._folded(gbm)
+        name = t.save_sidecar(str(tmp_path))
+        assert name is not None and (tmp_path / name).exists()
+        t2, _ = _table(capacity=64)
+        assert t2.restore_sidecar(str(tmp_path), name)
+        assert (
+            np.asarray(t2.values).tobytes()
+            == np.asarray(t.values).tobytes()
+        )
+        assert t2.skip_until == t.applied_hi
+        # a second fold on the restored table must keep working
+        from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+
+        q = gbm.quantized_scorer()
+        X, offs = _batches(3, keys=12, seed=9)[2]
+        dispatch_quantized(q, X, state=t2, offsets=offs)
+
+    def test_capacity_mismatch_refused(self, gbm):
+        t = self._folded(gbm)
+        t2, _ = _table(capacity=128)
+        assert not t2.from_payload(t.to_payload())
+
+
+class TestMeshMigration:
+    def test_degraded_migration_preserves_every_key(self, gbm):
+        import jax
+
+        from flink_jpmml_tpu.parallel.mesh import make_mesh
+        from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+        from flink_jpmml_tpu.utils.config import MeshConfig
+
+        q = gbm.quantized_scorer()
+        t, _ = _table(capacity=256)
+        for X, offs in _batches(2, keys=40, seed=21):
+            dispatch_quantized(q, X, state=t, offsets=offs)
+        jax.block_until_ready(t.values)
+        before = np.asarray(t.values).copy()
+        resident = t.resident
+        t.shard(make_mesh(MeshConfig(data=4, model=2)))
+        # chip loss: the rebuilt mesh spans half the data axis — every
+        # surviving key's row re-places byte-identically (slot = hash %
+        # capacity is mesh-independent)
+        t.migrate(
+            make_mesh(MeshConfig(data=2, model=2), allow_subset=True)
+        )
+        assert np.asarray(t.values).tobytes() == before.tobytes()
+        assert t.resident == resident
+        # and the fold keeps running on the migrated placement
+        X, offs = _batches(3, keys=40, seed=21)[2]
+        dispatch_quantized(q, X, state=t, offsets=offs)
+        jax.block_until_ready(t.values)
+        after = np.asarray(t.values)
+        assert after[:, COL_COUNT].sum() > before[:, COL_COUNT].sum()
+
+
+class TestNeverDelivered:
+    def test_dlq_batch_never_folds(self, gbm, tmp_path, monkeypatch):
+        """The PR 8/12 never-delivered contract extended to state: the
+        poisoned record is quarantined to the DLQ, never delivered, and
+        provably never folded (its unique key is absent from the
+        table).  A rollback sheds the in-flight fold window back to the
+        last snapshot (here the initial EMPTY table) and suspect-mode
+        probation keeps trailing batches stateless, so we assert fold
+        INVARIANTS — per-key ≤ stream ground truth, whole armed
+        batches only — not an exact batch suffix, which would pin the
+        probation-window tuning into the contract."""
+        import jax
+
+        from flink_jpmml_tpu.runtime import faults
+        from flink_jpmml_tpu.runtime.block import (
+            BlockPipeline, FiniteBlockSource,
+        )
+        from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+
+        monkeypatch.setenv("FJT_RETRY_BASE_S", "0.01")
+        B, blocks, keys = 32, 5, 6
+        rng = np.random.default_rng(17)
+        data = rng.normal(0.0, 1.0, size=(B * blocks, 4)).astype(
+            np.float32
+        )
+        data[:, 0] = rng.integers(0, keys, size=B * blocks).astype(
+            np.float32
+        )
+        poison = 70  # batch 2 ([64, 96)): batches 0-1 roll back
+        # the quarantined record gets a key NO other record has, so
+        # "never folded" is checkable as key-absence from the table
+        data[poison, 0] = 99.0
+        seen = []
+        m = MetricsRegistry()
+        dlq = DeadLetterQueue(str(tmp_path / "dlq"), metrics=m)
+        assert faults.install_from_env(
+            f"poison_record:offset={poison}"
+        )
+        try:
+            pipe = BlockPipeline(
+                FiniteBlockSource(data, block_size=B), gbm,
+                lambda out, n, first_off: seen.append((first_off, n)),
+                metrics=m,
+                use_native=False,
+                in_flight=1,
+                dlq=dlq,
+                state=StateSpec(capacity=64, key_col=0),
+            )
+            pipe.run_until_exhausted(timeout=60.0)
+        finally:
+            faults.clear()
+        assert sorted(set(dlq.offsets())) == [poison]
+        covered = np.zeros(B * blocks, np.int64)
+        for off, n in seen:
+            covered[off: off + n] += 1
+        assert sorted(np.flatnonzero(covered == 0).tolist()) == [poison]
+        t = pipe._state
+        jax.block_until_ready(t.values)
+        folded_keys = t._keys[t._occ]
+        vals = np.asarray(t.values)[: t.capacity]
+        folded = dict(zip(
+            folded_keys.tolist(),
+            vals[t._occ, COL_COUNT].tolist(),
+        ))
+        # the quarantined record's key never reached the table
+        poison_hash = int(t.hash_keys(np.array([99]))[0])
+        assert poison_hash not in folded
+        # per-key no-over-fold vs. stream ground truth
+        kh = t.hash_keys(data[:, 0].astype(np.int64))
+        uk, n = np.unique(kh, return_counts=True)
+        true_counts = dict(zip(uk.tolist(), n.tolist()))
+        for k, cnt in folded.items():
+            assert cnt <= true_counts[k], (k, cnt, true_counts[k])
+        # folds land as whole armed batches: at least one batch made
+        # it through after recovery, and never a partial batch
+        total = sum(folded.values())
+        assert total >= B and total % B == 0, folded
+        c = m.struct_snapshot()["counters"]
+        assert c["state_rollbacks"] >= 1

@@ -670,6 +670,351 @@ def _soak_zoo_chaos(seed):
         faults.clear()
 
 
+# --chaos --stateful: the keyed-state profile. The worker must be a
+# SUBPROCESS (unlike _soak_chaos) because the profile's crash axis is
+# real SIGKILLs — parent kills at seeded committed-offset targets plus
+# in-worker ``worker_crash`` weather — and the parity claim is about
+# what survives them. One tiny GBM per soak process, like _chaos_model.
+_STATE_PMML = []
+
+
+def _state_chaos_pmml():
+    if not _STATE_PMML:
+        import tempfile
+
+        from flink_jpmml_tpu.assets_gen import gen_gbm
+
+        tmp = tempfile.mkdtemp(prefix="fjt-statechaos-model-")
+        _STATE_PMML.append(
+            gen_gbm(tmp, n_trees=4, depth=3, n_features=5)
+        )
+    return _STATE_PMML[0]
+
+
+_STATE_CHAOS_WORKER = r'''
+import os, sys, time
+# per-incarnation fault seed BEFORE the package imports (env faults arm
+# at import): seeded p-gates draw a fresh pattern per incarnation, so a
+# site-targeted crash can't deterministically re-fire forever
+os.environ["FJT_FAULTS"] = os.environ.get("FJT_FAULTS", "").replace(
+    "PIDSEED", str(os.getpid())
+)
+sys.path.insert(0, sys.argv[10])
+import jax
+jax.config.update("jax_platforms", "cpu")  # correctness soak: host-side
+import numpy as np
+from flink_jpmml_tpu.compile import compile_pmml
+from flink_jpmml_tpu.pmml import parse_pmml_file
+from flink_jpmml_tpu.runtime.block import BlockPipeline, FiniteBlockSource
+from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+from flink_jpmml_tpu.runtime import state as state_mod
+from flink_jpmml_tpu.utils.config import BatchConfig, RuntimeConfig
+from flink_jpmml_tpu.utils.metrics import MetricsRegistry
+
+pmml, ckdir, outpath, emitpath = sys.argv[1:5]
+seed, records, keys, capacity, B = (int(v) for v in sys.argv[5:10])
+# every incarnation regenerates the IDENTICAL keyed stream from the
+# seed — the chaos is in the faults, the stream is the constant
+rng = np.random.default_rng(seed)
+data = rng.normal(0.0, 1.0, size=(records, 5)).astype(np.float32)
+data[:, 0] = rng.integers(0, keys, size=records).astype(np.float32)
+cm = compile_pmml(parse_pmml_file(pmml), batch_size=B)
+m = MetricsRegistry()
+dlq = DeadLetterQueue(os.path.join(ckdir, "dlq"), metrics=m)
+emit = open(emitpath, "a", buffering=1)
+
+def sink(out, n, first_off):
+    emit.write("%d %d\n" % (first_off, n))
+
+pipe = BlockPipeline(
+    # block == dispatch batch + a far fill deadline: every dispatch is
+    # one aligned B-sized block, so a restore replays the exact batch
+    # boundaries of the reference life (the byte-parity precondition —
+    # scatter-add order is fixed within a batch, reassociated across a
+    # different split)
+    FiniteBlockSource(data, block_size=B), cm, sink,
+    RuntimeConfig(
+        batch=BatchConfig(size=B, deadline_us=5_000_000),
+        checkpoint_interval_s=0.05,
+    ),
+    metrics=m,
+    checkpoint=CheckpointManager(ckdir),
+    dlq=dlq,
+    state=state_mod.StateSpec(capacity=capacity, key_col=0),
+)
+pipe.restore()
+pipe.start()
+while pipe.committed_offset < records and pipe._error is None:
+    time.sleep(0.02)
+pipe.stop()
+pipe.join(timeout=30.0)
+if pipe._error is not None:
+    raise SystemExit("state chaos worker died: %r" % (pipe._error,))
+tbl = pipe._state
+jax.block_until_ready(tbl.values)
+c = m.struct_snapshot()["counters"]
+tmp_out = outpath + ".tmp"
+np.savez(
+    tmp_out,
+    values=np.asarray(tbl.values),
+    keys=tbl._keys, occ=tbl._occ,
+    applied_hi=np.int64(tbl.applied_hi),
+    state_rollbacks=np.int64(c.get("state_rollbacks", 0)),
+)
+os.replace(tmp_out + ".npz", outpath)  # np.savez appends .npz
+emit.close()
+'''
+
+
+def _soak_stateful_chaos(seed):
+    """One STATEFUL chaos iteration (ISSUE 19): seeded faults —
+    worker crashes (parent SIGKILLs at committed-offset targets plus
+    in-worker ``worker_crash`` weather), ``device_oom``/``device_error``
+    streaks, and ``poison_record`` offsets — against a keyed stream
+    through a state-armed checkpointed BlockPipeline, run as supervised
+    subprocess incarnations. Per seed, against a same-poison fault-free
+    reference life:
+
+    - delivery contract (every life): the stream drains, the DLQ holds
+      the poison offsets EXACTLY, and the sink's only gaps are those
+      quarantined offsets — crashes and device faults lose nothing and
+      quarantine nothing;
+    - exactly-once fold accounting (every life): NO key ever folds
+      MORE records than its ground-truth occurrence count in the
+      seeded stream — no crash/replay/re-dispatch composition may
+      double-fold. Folding FEWER is legitimate only for rollback
+      seeds: a dispatch error (poison or device fault) restores the
+      last checkpoint snapshot, shedding a wall-clock-sized window of
+      folds by design (bounded, counted loss — ``state_rollbacks``);
+    - state parity: when the composition has no rollback source (kills
+      and ``worker_crash`` weather only), every key's fold count must
+      equal ground truth exactly AND the final table must be
+      BYTE-identical to an uninterrupted fault-free reference life —
+      the bench kill-parity claim extended to crash weather with the
+      DLQ wired."""
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from flink_jpmml_tpu.runtime.checkpoint import CheckpointManager
+    from flink_jpmml_tpu.runtime.dlq import DeadLetterQueue
+
+    rng = np.random.default_rng(seed)
+    records, keys, capacity, B = 2048, 256, 2048, 32
+    pmml = _state_chaos_pmml()
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    tmp = tempfile.mkdtemp(prefix="fjt-statechaos-")
+    try:
+        # ---- seeded composition --------------------------------------
+        poison = []
+        for _ in range(int(rng.integers(0, 3))):
+            o = int(rng.integers(0, records))
+            while o in poison:
+                o = (o + 1) % records
+            poison.append(o)
+        pspec = [f"poison_record:offset={o}" for o in poison]
+        kills = int(rng.integers(0, 3))
+        if not poison and not kills:
+            kills = 1  # never a degenerate fault-free seed
+        weather, dev_budget = [], 0
+        if rng.random() < 0.4:
+            # SIGKILL-anywhere weather: parity-SAFE — exactly-once
+            # restore covers any kill instant, in-worker or parent
+            weather.append(
+                "worker_crash:site=checkpoint_write:p=0.01:n=1"
+                ":after_s=0.3:seed=PIDSEED"
+            )
+        if rng.random() < 0.5:
+            dmenu = []
+            for kind, site, lo, hi in (
+                ("device_error", "device_readback", 2, 6),
+                ("device_oom", "device_dispatch", 1, 4),
+            ):
+                n = int(rng.integers(lo, hi))
+                dmenu.append((f"{kind}:site={site}:n={n}", n))
+            picks = rng.choice(
+                len(dmenu), size=int(rng.integers(1, len(dmenu) + 1)),
+                replace=False,
+            )
+            weather += [dmenu[i][0] for i in picks]
+            dev_budget = sum(dmenu[i][1] for i in picks)
+        chaos_spec = pspec + weather
+        if kills:
+            # stretch the drain so the parent's committed-offset poll
+            # can land its kills (pure delay: no state effect)
+            chaos_spec.append("dispatch_delay:delay_ms=2")
+
+        # ---- one supervised life -------------------------------------
+        def run_life(tag, spec, kill_targets, timeout_s=150.0):
+            ckdir = os.path.join(tmp, f"ck-{tag}")
+            outpath = os.path.join(tmp, f"state-{tag}.npz")
+            emitpath = os.path.join(tmp, f"emit-{tag}.log")
+            open(emitpath, "w").close()
+            argv = [
+                sys.executable, "-c", _STATE_CHAOS_WORKER,
+                pmml, ckdir, outpath, emitpath, str(seed),
+                str(records), str(keys), str(capacity), str(B), repo,
+            ]
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "FJT_FAULTS": ",".join(spec),
+                "FJT_RETRY_BASE_S": "0.01",
+                "FJT_FAILOVER_COOLDOWN_S": "0.1",
+                "FJT_FAILOVER_GREENS": "1",
+                "FJT_XLA_CACHE": os.path.join(tmp, "xla"),
+                "FJT_AUTOTUNE_CACHE": os.path.join(tmp, "autotune"),
+            })
+
+            def committed():
+                try:
+                    st = CheckpointManager(ckdir).load_latest()
+                    return int(st["source_offset"]) if st else 0
+                except Exception:
+                    return 0
+
+            pending = list(kill_targets)
+            incarnations = 0
+            deadline = time.monotonic() + timeout_s
+            while True:
+                assert incarnations < 25, (
+                    f"stateful chaos seed={seed} ({tag}): restart "
+                    f"storm without drain (spec {spec})"
+                )
+                assert time.monotonic() < deadline, (
+                    f"stateful chaos seed={seed} ({tag}): no drain in "
+                    f"{timeout_s}s, committed "
+                    f"{committed()}/{records} (spec {spec})"
+                )
+                proc = subprocess.Popen(
+                    argv, env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE, text=True,
+                )
+                incarnations += 1
+                killed_this = False
+                while proc.poll() is None:
+                    if pending and committed() >= pending[0]:
+                        os.kill(proc.pid, signal.SIGKILL)
+                        proc.wait(timeout=10)
+                        pending.pop(0)
+                        killed_this = True
+                        break
+                    if time.monotonic() >= deadline:
+                        proc.kill()
+                        proc.wait(timeout=10)
+                        break
+                    time.sleep(0.02)
+                if killed_this:
+                    continue
+                if proc.returncode == 0:
+                    assert os.path.exists(outpath), (
+                        f"stateful chaos seed={seed} ({tag}): worker "
+                        "exited 0 without its table dump"
+                    )
+                    return outpath, emitpath, ckdir, incarnations
+                if proc.returncode == -signal.SIGKILL:
+                    continue  # in-worker worker_crash weather: respawn
+                raise AssertionError(
+                    f"stateful chaos seed={seed} ({tag}): worker "
+                    f"rc={proc.returncode} (spec {spec}): "
+                    f"{(proc.stderr.read() or '')[-600:]}"
+                )
+
+        # a dispatch error rolls the table back to the LAST CHECKPOINT
+        # snapshot (wall-clock interval ⇒ nondeterministic shed
+        # window), so exact parity is only claimable for compositions
+        # with no rollback source at all
+        rollback_free = not poison and dev_budget == 0
+
+        targets = [
+            int(records * (i + 1) / (kills + 1)) for i in range(kills)
+        ]
+        ch_path, ch_emit, ch_ck, incarnations = run_life(
+            "chaos", chaos_spec, targets,
+        )
+        lives = [("chaos", ch_path, ch_emit, ch_ck)]
+        if rollback_free:
+            ref_path, ref_emit, ref_ck, _ = run_life("ref", [], [])
+            lives.append(("ref", ref_path, ref_emit, ref_ck))
+
+        # ---- ground truth: the seeded stream's per-key-hash counts ---
+        from flink_jpmml_tpu.parallel.partitioner import stable_hash_vec
+
+        gt = np.random.default_rng(seed)
+        gt.normal(0.0, 1.0, size=(records, 5))  # same draw order
+        raw = gt.integers(0, keys, size=records).astype(np.float32)
+        kh = stable_hash_vec(raw.astype(np.int64))
+        uk, true_n = np.unique(kh, return_counts=True)
+        true = dict(zip(uk.tolist(), true_n.tolist()))
+
+        def counts(d):
+            occ = d["occ"].astype(bool)
+            # values carries scratch/padding rows past capacity; the
+            # mirror indexes only the table proper
+            vals = d["values"][: occ.shape[0]]
+            return dict(zip(
+                d["keys"][occ].tolist(), vals[occ, 0].tolist(),
+            ))
+
+        expected = sorted(poison)
+        for tag, outpath, emitpath, ckdir in lives:
+            # ---- delivery contract -----------------------------------
+            covered = np.zeros(records, np.int64)
+            with open(emitpath) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) != 2:
+                        continue  # torn final line at a SIGKILL
+                    off, n = int(parts[0]), int(parts[1])
+                    covered[off: off + n] += 1
+            q = sorted(set(DeadLetterQueue(
+                os.path.join(ckdir, "dlq")
+            ).offsets()))
+            assert q == expected, (
+                f"stateful chaos seed={seed} ({tag}): DLQ {q} != "
+                f"{expected} (spec {chaos_spec})"
+            )
+            missing = sorted(
+                int(o) for o in np.flatnonzero(covered == 0)
+            )
+            assert missing == expected, (
+                f"stateful chaos seed={seed} ({tag}): sink gaps "
+                f"{missing[:10]} != quarantined {expected} "
+                f"(spec {chaos_spec})"
+            )
+            # ---- exactly-once fold accounting ------------------------
+            folded = counts(np.load(outpath))
+            for k, n in folded.items():
+                assert k in true and n <= true[k], (
+                    f"stateful chaos seed={seed} ({tag}): key {k} "
+                    f"folded {n} records vs {true.get(k, 0)} in the "
+                    f"stream — a replay or re-dispatch double-folded "
+                    f"(spec {chaos_spec})"
+                )
+            if rollback_free:
+                deficit = sum(true.values()) - sum(folded.values())
+                assert deficit == 0, (
+                    f"stateful chaos seed={seed} ({tag}): {deficit} "
+                    f"folds lost with no rollback source composed "
+                    f"(spec {chaos_spec})"
+                )
+
+        # ---- byte parity (rollback-free compositions only) -----------
+        if rollback_free:
+            ref_v = np.load(ref_path)["values"]
+            ch_v = np.load(ch_path)["values"]
+            assert ref_v.tobytes() == ch_v.tobytes(), (
+                f"stateful chaos seed={seed}: table diverged from the "
+                f"fault-free reference after {incarnations} "
+                f"incarnations / {kills} kills (spec {chaos_spec})"
+            )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--families", default=",".join(FAMILIES))
@@ -693,6 +1038,15 @@ def main() -> int:
                          "composed with device faults against the "
                          "packed multi-tenant scorer, verifying the "
                          "per-tenant delivery contract")
+    ap.add_argument("--stateful", action="store_true",
+                    help="with --chaos: the STATEFUL profile instead — "
+                         "seeded worker crashes (SIGKILL), device_oom/"
+                         "device_error streaks, and poison offsets "
+                         "over a keyed stream through a state-armed "
+                         "checkpointed pipeline (subprocess "
+                         "incarnations), asserting state parity vs a "
+                         "fault-free reference + the delivery "
+                         "contract per seed")
     args = ap.parse_args()
 
     if args.mesh:
@@ -715,6 +1069,8 @@ def main() -> int:
             fn, name = _soak_zoo_chaos, "zoo-chaos"
         elif args.mesh:
             fn, name = _soak_mesh_chaos, "mesh-chaos"
+        elif args.stateful:
+            fn, name = _soak_stateful_chaos, "stateful-chaos"
         else:
             fn, name = _soak_chaos, "chaos"
         t0 = time.perf_counter()

@@ -1604,6 +1604,147 @@ def check_history() -> None:
                 os.environ[k] = v
 
 
+def check_stateful() -> None:
+    """Keyed-state tripwire (runtime/state.py + compile/statekernel.py):
+    the unarmed per-dispatch additions (the ``state is None`` branch +
+    ``split_output`` on a stateless output) must stay ≤2µs; an armed
+    dispatch — host slot routing + the fused gather/scatter state
+    stage — must stay within a small constant factor of the stateless
+    dispatch at smoke scale (a per-record host loop would be 100×); a
+    mid-run snapshot restored into a fresh table and replayed from
+    offset 0 must converge to the single-life table BYTE-exactly (the
+    exactly-once replay guard); and a live stateful pipeline's
+    ``/metrics`` scrape must show non-zero ``fjt_state_resident_keys``."""
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from assets.generate import gen_gbm
+    from flink_jpmml_tpu.compile import compile_pmml
+    from flink_jpmml_tpu.obs.server import ObsServer
+    from flink_jpmml_tpu.pmml import parse_pmml_file
+    from flink_jpmml_tpu.runtime import state as state_mod
+    from flink_jpmml_tpu.runtime.block import (
+        BlockPipeline, FiniteBlockSource,
+    )
+    from flink_jpmml_tpu.runtime.pipeline import dispatch_quantized
+
+    import jax
+
+    # -- unarmed gate: the stateless hot path's only new per-dispatch
+    #    work is `state is None` branches plus split_output on the raw
+    #    output object
+    out_stateless = np.zeros(64, np.float32)
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state_mod.split_output(out_stateless)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call <= 2e-6, (
+        f"unarmed state gate costs {per_call * 1e6:.2f}µs/dispatch"
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        doc = parse_pmml_file(
+            gen_gbm(tmp, n_trees=10, depth=3, n_features=4)
+        )
+    cm = compile_pmml(doc, batch_size=256)
+    q = cm.quantized_scorer()
+    B, rounds = 256, 40
+    rng = np.random.default_rng(11)
+    X = rng.normal(0.0, 1.0, size=(rounds * B, 4)).astype(np.float32)
+    X[:, 0] = rng.integers(0, 5000, size=rounds * B).astype(np.float32)
+
+    def run(table):
+        last = None
+        t_run0 = time.perf_counter()
+        for i in range(rounds):
+            xb = X[i * B:(i + 1) * B]
+            if table is None:
+                last = dispatch_quantized(q, xb)
+            else:
+                last = dispatch_quantized(
+                    q, xb, state=table,
+                    offsets=np.arange(i * B, (i + 1) * B),
+                )
+        jax.block_until_ready(last)
+        return time.perf_counter() - t_run0
+
+    spec = state_mod.StateSpec(capacity=8192, key_col=0)
+    # warm both entries (compiles are not the overhead under test)
+    run(None)
+    run(state_mod.KeyedStateTable(spec))
+    t_plain = run(None)
+    t_armed = run(state_mod.KeyedStateTable(spec))
+    assert t_armed <= 5.0 * t_plain + 0.25, (
+        f"armed state overhead unbounded: {t_armed:.3f}s armed vs "
+        f"{t_plain:.3f}s stateless over {rounds} dispatches"
+    )
+
+    # -- kill→restore parity at smoke scale: snapshot mid-run (the
+    #    checkpoint a killed incarnation leaves), restore into a fresh
+    #    table, replay the WHOLE stream from offset 0 — the replayed
+    #    prefix bypasses (exactly-once), the suffix re-applies, and the
+    #    final buffer equals the single-life table bitwise
+    ref = state_mod.KeyedStateTable(spec)
+    payload = None
+    for i in range(rounds):
+        out = dispatch_quantized(
+            q, X[i * B:(i + 1) * B], state=ref,
+            offsets=np.arange(i * B, (i + 1) * B),
+        )
+        if i == rounds // 2 - 1:
+            jax.block_until_ready(ref.values)
+            payload = ref.to_payload()
+    jax.block_until_ready(out)
+    ref_vals = np.asarray(ref.values).copy()
+    rep = state_mod.KeyedStateTable(spec)
+    assert rep.from_payload(payload), "state payload restore failed"
+    assert rep.skip_until == (rounds // 2) * B
+    for i in range(rounds):
+        out = dispatch_quantized(
+            q, X[i * B:(i + 1) * B], state=rep,
+            offsets=np.arange(i * B, (i + 1) * B),
+        )
+    jax.block_until_ready(out)
+    assert np.array_equal(ref_vals, np.asarray(rep.values)), (
+        "kill→restore replay diverged from the single-life state table"
+    )
+
+    # -- live scrape: a stateful pipeline's /metrics shows the family
+    srv = None
+    try:
+        data = rng.normal(0.0, 1.0, size=(2048, 4)).astype(np.float32)
+        data[:, 0] = rng.integers(0, 500, size=2048).astype(np.float32)
+        seen = []
+
+        def sink(out, n_rec, first_off):
+            seen.append(n_rec)
+
+        pipe = BlockPipeline(
+            FiniteBlockSource(data, block_size=256), cm, sink,
+            in_flight=2, use_native=False,
+            state=state_mod.StateSpec(capacity=4096, key_col=0),
+        )
+        srv = ObsServer.for_registry(pipe.metrics)
+        pipe.run_until_exhausted(timeout=60.0)
+        assert sum(seen) == 2048, f"stateful pipeline lost records: {seen}"
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            text = r.read().decode()
+        resident = None
+        for line in text.splitlines():
+            if line.startswith("fjt_state_resident_keys"):
+                resident = float(line.split()[-1])
+        assert resident is not None and resident > 0, (
+            f"/metrics shows no live state_resident_keys: {resident}"
+        )
+    finally:
+        if srv is not None:
+            srv.close()
+
+
 def main() -> int:
     timer = threading.Timer(WATCHDOG_S, _watchdog)
     timer.daemon = True
@@ -1646,6 +1787,8 @@ def main() -> int:
     print("perf-smoke: zoo pack OK", flush=True)
     check_history()
     print("perf-smoke: history OK", flush=True)
+    check_stateful()
+    print("perf-smoke: keyed state OK", flush=True)
     timer.cancel()
     return 0
 
